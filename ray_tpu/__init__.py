@@ -1,0 +1,71 @@
+"""ray_tpu: a TPU-native distributed AI framework.
+
+The task/actor/object core of the reference (Ray) re-designed TPU-first:
+SPMD programs over device meshes are the hot path, TPU slices / ICI domains
+are first-class scheduler resources, collectives lower to XLA over ICI, and
+every parallelism strategy (DP/TP/PP/EP/SP/CP, ring attention, Ulysses) is a
+native mesh-axis library feature.
+
+Public API mirrors the reference's surface so users can switch:
+
+    import ray_tpu as ray
+    ray.init()
+
+    @ray.remote
+    def f(x): return x * 2
+
+    ray.get(f.remote(21))  # 42
+"""
+
+from typing import Any, Optional
+
+from ._internal.api import (available_resources, cancel, cluster_resources,
+                            get, get_runtime_context, init, is_initialized,
+                            kill, nodes, put, shutdown, wait)
+from ._internal.errors import (ActorDiedError, ActorError,
+                               ActorUnavailableError, GetTimeoutError,
+                               ObjectLostError, OutOfMemoryError, RayTpuError,
+                               RpcError, TaskError, WorkerCrashedError)
+from ._internal.object_ref import ObjectRef
+from .actor import ActorClass, ActorHandle, get_actor, method
+from .remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """`@remote` decorator for functions (tasks) and classes (actors),
+    optionally with options: `@remote(num_cpus=2, num_tpus=4)`."""
+    import inspect
+
+    def make(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes only keyword options")
+    return make
+
+
+# Submodules re-exported lazily to keep import light.
+def __getattr__(name):
+    import importlib
+    if name in ("util", "train", "data", "serve", "tune", "rllib",
+                "accelerators", "parallel", "ops", "models", "collective",
+                "cluster_utils", "experimental", "autoscaler"):
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "ObjectRef",
+    "ActorClass", "ActorHandle", "RemoteFunction",
+    "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "ActorUnavailableError", "ObjectLostError", "GetTimeoutError",
+    "WorkerCrashedError", "OutOfMemoryError", "RpcError",
+]

@@ -1,0 +1,240 @@
+"""Top-level API (reference: python/ray/_private/worker.py — init, connect,
+get/put/wait, shutdown, kill, cluster introspection)."""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from .config import CONFIG
+from .core_worker import (CoreWorker, get_core_worker, set_core_worker,
+                          try_get_core_worker, RUNTIME_CTX)
+from .errors import RayTpuError
+from .ids import JobID
+from .node import Node, default_resources
+from .object_ref import ObjectRef
+from .rpc import Address
+
+logger = logging.getLogger(__name__)
+
+_init_lock = threading.Lock()
+_local_node: Optional[Node] = None
+_namespace: str = ""
+
+
+def is_initialized() -> bool:
+    return try_get_core_worker() is not None
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[Dict[str, float]] = None,
+         labels: Optional[Dict[str, str]] = None,
+         namespace: str = "",
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = False,
+         log_to_driver: bool = True,
+         _system_config: Optional[Dict[str, Any]] = None,
+         _node: Optional[Node] = None):
+    """Start (or connect to) a cluster and attach this process as a driver.
+
+    With no address, starts a head node in-process: GCS + raylet on the io
+    loop, workers as subprocesses — the local-mode analog of the reference's
+    `ray.init()` process bring-up (reference: _private/node.py:1340).
+    """
+    global _local_node, _namespace
+    with _init_lock:
+        if is_initialized():
+            if ignore_reinit_error:
+                return get_core_worker()
+            raise RuntimeError("ray_tpu.init() called twice; "
+                               "pass ignore_reinit_error=True to allow")
+        if _system_config:
+            CONFIG.apply_system_config(_system_config)
+        _namespace = namespace
+
+        if _node is not None:
+            node = _node
+            gcs_address = node.gcs_address
+        elif address in (None, "local"):
+            node_resources = dict(resources or {})
+            node_resources.update(default_resources(num_cpus, num_tpus))
+            from ..accelerators import tpu as tpu_accel
+            node_resources.update(tpu_accel.node_tpu_resources())
+            node_labels = dict(labels or {})
+            node_labels.update(tpu_accel.node_tpu_labels())
+            node = Node(head=True, resources=node_resources,
+                        labels=node_labels,
+                        object_store_memory=object_store_memory)
+            node.start()
+            _local_node = node
+            gcs_address = node.gcs_address
+        else:
+            host, port = address.rsplit(":", 1)
+            gcs_address = (host, int(port))
+            node = None
+
+        if node is not None:
+            raylet_address = node.raylet_address
+            node_id = node.node_id
+            node_index = node.node_index
+            session_name = node.session_name
+        else:
+            # Connect to a remote cluster: attach to the head node's raylet.
+            from .gcs_client import GcsClient
+            probe = GcsClient(gcs_address)
+            nodes = probe.call_sync("get_all_nodes")
+            head = next((n for n in nodes if n.get("is_head")), nodes[0])
+            raylet_address = tuple(head["address"])
+            node_id = head["node_id"]
+            node_index = head.get("node_index", 0)
+            session_name = head.get("session_name") or "connected"
+
+        worker = CoreWorker(
+            mode="driver",
+            session_name=node.session_name if node else session_name,
+            gcs_address=gcs_address, raylet_address=raylet_address,
+            node_id=node_id, node_index=node_index)
+        worker.start()
+        job_id = worker.gcs.call_sync(
+            "add_job", driver_address=worker.rpc_address,
+            namespace=namespace)
+        worker.job_id = job_id
+        set_core_worker(worker)
+        atexit.register(_atexit_shutdown)
+        return worker
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    global _local_node
+    worker = try_get_core_worker()
+    if worker is not None:
+        try:
+            worker.gcs.call_sync("mark_job_finished", job_id=worker.job_id,
+                                 timeout=10)
+        except Exception:
+            pass
+        worker.shutdown()
+        set_core_worker(None)
+    if _local_node is not None:
+        _local_node.stop()
+        _local_node = None
+    CONFIG.reset()
+
+
+def put(value: Any) -> ObjectRef:
+    return get_core_worker().put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    worker = get_core_worker()
+    if isinstance(refs, ObjectRef):
+        return worker.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError("get() expects an ObjectRef or a list of ObjectRefs")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() got a non-ObjectRef: {type(r)}")
+    return worker.get(list(refs), timeout)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns exceeds the number of refs")
+    return get_core_worker().wait(list(refs), num_returns, timeout,
+                                  fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ..actor import ActorHandle
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    get_core_worker().gcs.call_sync("kill_actor", actor_id=actor.actor_id,
+                                    no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Best-effort cancellation of a pending task (reference:
+    worker.py cancel). Queued leases are cancelable; running tasks are only
+    killed with force=True (worker process kill)."""
+    worker = get_core_worker()
+    # Round-1 semantics: drop from pending (result becomes TaskCancelledError
+    # via ObjectLost on get) — full propagation lands with the state API.
+    raise NotImplementedError(
+        "cancel() is not implemented yet in this round")
+
+
+def cluster_resources() -> Dict[str, float]:
+    view = get_core_worker().gcs.call_sync("get_cluster_view")
+    out: Dict[str, float] = {}
+    for info in view.values():
+        for name, qty in info["total"].items():
+            out[name] = out.get(name, 0.0) + qty
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    view = get_core_worker().gcs.call_sync("get_cluster_view")
+    out: Dict[str, float] = {}
+    for info in view.values():
+        for name, qty in info["available"].items():
+            out[name] = out.get(name, 0.0) + qty
+    return out
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return get_core_worker().gcs.call_sync("get_all_nodes")
+
+
+class RuntimeContext:
+    """reference: python/ray/runtime_context.py"""
+
+    def __init__(self, worker: CoreWorker):
+        self._worker = worker
+
+    @property
+    def job_id(self) -> JobID:
+        return self._worker.job_id
+
+    @property
+    def node_id(self) -> str:
+        return self._worker.node_id
+
+    @property
+    def namespace(self) -> str:
+        return _namespace
+
+    def get_task_id(self):
+        spec = RUNTIME_CTX.task_spec
+        return spec.task_id if spec else None
+
+    def get_actor_id(self):
+        return RUNTIME_CTX.actor_id
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        spec = RUNTIME_CTX.task_spec
+        return bool(spec and spec.attempt_number > 0)
+
+    def gcs_address(self) -> Address:
+        return self._worker.gcs.address
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext(get_core_worker())

@@ -1,0 +1,124 @@
+"""Runtime flag system.
+
+Equivalent of the reference's `RAY_CONFIG` x-macro table
+(src/ray/common/ray_config_def.h, 224 entries): a typed default table,
+overridable per-process via `RTPU_<name>` environment variables and
+cluster-wide via `init(_system_config={...})`.
+
+Typed access:  `from ray_tpu._internal.config import CONFIG;
+CONFIG.lease_idle_timeout_s`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict
+
+_DEFAULTS: Dict[str, Any] = {
+    # --- RPC layer ---
+    "rpc_connect_timeout_s": 10.0,
+    "rpc_call_timeout_s": 60.0,
+    "rpc_retry_base_delay_ms": 50,
+    "rpc_retry_max_delay_ms": 2000,
+    "rpc_max_retries": 5,
+    # Fault injection: "method:req_prob:resp_prob,method2:..." — probability of
+    # dropping the request / the response of matching RPC methods.
+    # (Reference: src/ray/rpc/rpc_chaos.h RAY_testing_rpc_failure.)
+    "testing_rpc_failure": "",
+    # --- object store ---
+    "object_store_memory_bytes": 2 * 1024**3,
+    # Objects <= this many bytes are returned inline in RPC replies and live
+    # in the in-process memory store instead of shared memory.
+    "max_direct_call_object_size": 100 * 1024,
+    "object_spilling_threshold": 0.8,
+    "object_store_chunk_bytes": 4 * 1024**2,
+    "spill_directory": "",  # default: <session dir>/spill
+    # --- scheduling ---
+    "scheduler_hybrid_threshold": 0.5,
+    "lease_idle_timeout_s": 2.0,
+    "worker_lease_parallelism": 10,
+    "max_pending_lease_requests_per_shape": 10,
+    # --- workers ---
+    "worker_start_timeout_s": 60.0,
+    "num_prestart_workers": 0,
+    "worker_idle_timeout_s": 60.0,
+    "maximum_startup_concurrency": 4,
+    # --- health / failure detection ---
+    "health_check_period_s": 1.0,
+    "health_check_timeout_s": 5.0,
+    "health_check_failure_threshold": 5,
+    "worker_liveness_check_period_s": 1.0,
+    # --- gcs ---
+    "gcs_storage": "memory",  # or a file path for persistence
+    "pubsub_push_timeout_s": 5.0,
+    # --- tasks ---
+    "task_max_retries_default": 3,
+    "actor_max_restarts_default": 0,
+    "max_lineage_bytes": 64 * 1024**2,
+    "inline_arg_max_bytes": 100 * 1024,
+    # --- memory monitor ---
+    "memory_monitor_refresh_ms": 250,
+    "memory_usage_threshold": 0.95,
+    # --- metrics ---
+    "metrics_report_interval_s": 5.0,
+    # --- logging ---
+    "log_to_driver": True,
+    # --- train ---
+    "train_health_check_interval_s": 1.0,
+}
+
+_ENV_PREFIX = "RTPU_"
+
+
+def _coerce(value: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, (dict, list)):
+        return json.loads(value)
+    return value
+
+
+class _Config:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = dict(_DEFAULTS)
+        self._load_env()
+
+    def _load_env(self):
+        for name, default in _DEFAULTS.items():
+            env = os.environ.get(_ENV_PREFIX + name)
+            if env is not None:
+                self._values[name] = _coerce(env, default)
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"unknown config flag: {name}") from None
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self._values.get(name, default)
+
+    def apply_system_config(self, overrides: Dict[str, Any]):
+        with self._lock:
+            for name, value in overrides.items():
+                if name not in _DEFAULTS:
+                    raise ValueError(f"unknown config flag: {name}")
+                self._values[name] = value
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    def reset(self):
+        with self._lock:
+            self._values = dict(_DEFAULTS)
+            self._load_env()
+
+
+CONFIG = _Config()

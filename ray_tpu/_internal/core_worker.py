@@ -1,0 +1,1144 @@
+"""CoreWorker: the per-process runtime.
+
+Equivalent of the reference CoreWorker (src/ray/core_worker/: core_worker.h,
+reference_count.cc, task_manager.cc, normal_task_submitter.cc,
+actor_task_submitter.cc, task_execution/, object_recovery_manager.cc). Linked
+into every driver and worker process. Owns:
+
+- the in-process memory store (small/inlined objects) + shm store access
+- distributed ownership: reference counting with borrower accounting
+- TaskManager: pending tasks, retries, lineage retention for reconstruction
+- NormalTaskSubmitter: lease-based scheduling — ask a raylet for a worker
+  lease, push the task directly to the leased worker, reuse leases for
+  same-shape tasks until idle timeout
+- ActorTaskSubmitter: direct worker-to-worker calls with sequence numbers,
+  queueing across restarts, death propagation
+- the execution loop (worker mode): ordered actor queues, concurrency
+  groups, async actors on the event loop
+- object recovery: lost plasma objects are rebuilt by resubmitting the
+  creating task from retained lineage
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import logging
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from .config import CONFIG
+from .errors import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
+                     ObjectLostError, RayTpuError, TaskError,
+                     WorkerCrashedError)
+from .gcs_client import GcsClient
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .memory_store import MemoryStore
+from .object_ref import ObjectRef
+from .plasma import PlasmaDir
+from .rpc import Address, ClientPool, EventLoopThread, RpcServer
+from . import serialization
+from .task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK,
+                        FunctionManager, TaskArg, TaskSpec, _CallBundle,
+                        _RefPlaceholder)
+
+logger = logging.getLogger(__name__)
+
+_global_worker: Optional["CoreWorker"] = None
+_global_lock = threading.Lock()
+
+
+def get_core_worker() -> "CoreWorker":
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first")
+    return _global_worker
+
+
+def try_get_core_worker() -> Optional["CoreWorker"]:
+    return _global_worker
+
+
+def set_core_worker(worker: Optional["CoreWorker"]):
+    global _global_worker
+    with _global_lock:
+        _global_worker = worker
+
+
+# ---------------------------------------------------------------------------
+# Reference counting (reference: src/ray/core_worker/reference_count.cc)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class RefEntry:
+    local: int = 0
+    submitted: int = 0        # pending tasks that take this ref as an arg
+    borrowers: int = 0        # remote processes holding a deserialized copy
+    contained_in: int = 0     # live outer objects embedding this ref
+    is_owner: bool = False
+    in_plasma: bool = False
+    owner_address: Optional[Address] = None
+    lineage_task: Optional[TaskID] = None
+
+    def total(self) -> int:
+        return self.local + self.submitted + self.borrowers + self.contained_in
+
+
+class ReferenceCounter:
+    def __init__(self, core_worker: "CoreWorker"):
+        self._cw = core_worker
+        self._lock = threading.Lock()
+        self._entries: Dict[ObjectID, RefEntry] = {}
+
+    def _entry(self, object_id: ObjectID) -> RefEntry:
+        entry = self._entries.get(object_id)
+        if entry is None:
+            entry = RefEntry()
+            self._entries[object_id] = entry
+        return entry
+
+    def add_owned(self, object_id: ObjectID, in_plasma: bool = False,
+                  lineage_task: Optional[TaskID] = None):
+        with self._lock:
+            entry = self._entry(object_id)
+            entry.is_owner = True
+            entry.in_plasma = entry.in_plasma or in_plasma
+            entry.lineage_task = lineage_task
+
+    def mark_in_plasma(self, object_id: ObjectID):
+        with self._lock:
+            self._entry(object_id).in_plasma = True
+
+    def add_local_ref(self, ref: ObjectRef):
+        with self._lock:
+            entry = self._entry(ref.id())
+            entry.local += 1
+            if entry.owner_address is None:
+                entry.owner_address = ref.owner_address()
+
+    def remove_local_ref(self, ref: ObjectRef):
+        self._decrement(ref.id(), "local")
+
+    def add_submitted(self, object_ids: List[ObjectID]):
+        with self._lock:
+            for oid in object_ids:
+                self._entry(oid).submitted += 1
+
+    def remove_submitted(self, object_ids: List[ObjectID]):
+        for oid in object_ids:
+            self._decrement(oid, "submitted")
+
+    def add_contained(self, object_ids: List[ObjectID]):
+        with self._lock:
+            for oid in object_ids:
+                self._entry(oid).contained_in += 1
+
+    def remove_contained(self, object_ids: List[ObjectID]):
+        for oid in object_ids:
+            self._decrement(oid, "contained_in")
+
+    def add_borrower(self, object_id: ObjectID):
+        with self._lock:
+            self._entry(object_id).borrowers += 1
+
+    def remove_borrower(self, object_id: ObjectID):
+        self._decrement(object_id, "borrowers")
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        """We just became a borrower of a ref owned elsewhere."""
+        owner = ref.owner_address()
+        if owner is None or owner == self._cw.rpc_address:
+            return
+        self._cw.fire_and_forget(owner, "borrow_addref",
+                                 object_hex=ref.hex())
+
+    def _decrement(self, object_id: ObjectID, kind: str):
+        free = False
+        notify_owner = None
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                return
+            setattr(entry, kind, max(0, getattr(entry, kind) - 1))
+            if entry.total() == 0:
+                del self._entries[object_id]
+                if entry.is_owner:
+                    free = True
+                elif entry.owner_address is not None:
+                    notify_owner = entry.owner_address
+        if free:
+            self._cw._free_owned_object(object_id)
+        elif notify_owner is not None:
+            self._cw.fire_and_forget(notify_owner, "borrow_decref",
+                                     object_hex=object_id.hex())
+
+    def is_owner(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.is_owner
+
+    def num_refs(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# Task manager (reference: src/ray/core_worker/task_manager.cc)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    start_time: float = field(default_factory=time.time)
+    # Dependency snapshot taken at submit time (the submitter may later
+    # inline resolved ref args in place, so the spec can't be re-derived).
+    dep_ids: List[ObjectID] = field(default_factory=list)
+    contained_ids: List[ObjectID] = field(default_factory=list)
+
+
+class TaskManager:
+    def __init__(self, core_worker: "CoreWorker"):
+        self._cw = core_worker
+        self._lock = threading.Lock()
+        self.pending: Dict[TaskID, PendingTask] = {}
+        self.lineage: Dict[TaskID, TaskSpec] = {}
+        self._lineage_bytes = 0
+
+    def add_pending(self, spec: TaskSpec):
+        with self._lock:
+            self.pending[spec.task_id] = PendingTask(
+                spec=spec, retries_left=spec.max_retries,
+                dep_ids=[oid for oid, _ in spec.dependencies()],
+                contained_ids=[c for a in spec.args
+                               for c in a.contained_ref_ids])
+
+    def is_pending(self, task_id: TaskID) -> bool:
+        with self._lock:
+            return task_id in self.pending
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self.pending)
+
+    def on_completed(self, spec: TaskSpec, reply: Dict[str, Any]):
+        with self._lock:
+            pending = self.pending.pop(spec.task_id, None)
+            # Retain lineage so lost plasma returns can be reconstructed.
+            if spec.task_type == NORMAL_TASK and spec.max_retries != 0:
+                self.lineage[spec.task_id] = spec
+                self._lineage_bytes += 256  # spec bookkeeping estimate
+                if self._lineage_bytes > CONFIG.max_lineage_bytes:
+                    # Evict oldest lineage entries.
+                    while self._lineage_bytes > CONFIG.max_lineage_bytes // 2 \
+                            and self.lineage:
+                        self.lineage.pop(next(iter(self.lineage)))
+                        self._lineage_bytes -= 256
+        returns = reply.get("returns", [])
+        for index, ret in enumerate(returns):
+            oid = ObjectID.for_task_return(spec.task_id, index)
+            if ret.get("plasma"):
+                self._cw.reference_counter.mark_in_plasma(oid)
+                self._cw.memory_store.put(oid, None, in_plasma=True)
+            else:
+                value = serialization.deserialize(ret["data"])
+                self._cw.memory_store.put(oid, value)
+        self._release_deps(pending)
+
+    def on_failed(self, spec: TaskSpec, error: Exception,
+                  is_application_error: bool) -> bool:
+        """Returns True if the task will be retried."""
+        with self._lock:
+            pending = self.pending.get(spec.task_id)
+            if pending is None:
+                return False
+            retryable = pending.retries_left != 0
+            if is_application_error:
+                retry_exc = spec.retry_exceptions
+                if retry_exc is False or retry_exc is None:
+                    retryable = False
+                elif isinstance(retry_exc, (list, tuple)):
+                    cause = getattr(error, "cause", error)
+                    retryable = retryable and isinstance(
+                        cause, tuple(retry_exc))
+            if retryable:
+                pending.retries_left -= 1
+                pending.spec.attempt_number += 1
+        if retryable:
+            logger.info("retrying task %s (%s), attempt %d",
+                        spec.name or spec.function.qualname,
+                        spec.task_id.hex()[:12], spec.attempt_number)
+            if spec.task_type == ACTOR_TASK:
+                self._cw.actor_submitter.submit(spec)
+            else:
+                self._cw.submitter.resubmit(spec)
+            return True
+        with self._lock:
+            pending = self.pending.pop(spec.task_id, None)
+        if not isinstance(error, TaskError):
+            error = TaskError(spec.function.display_name(),
+                              "".join(traceback.format_exception(error)),
+                              cause=error)
+        for oid in spec.return_ids():
+            self._cw.memory_store.put(oid, error, is_exception=True)
+        self._release_deps(pending)
+        return False
+
+    def _release_deps(self, pending: Optional[PendingTask]):
+        if pending is None:
+            return
+        self._cw.reference_counter.remove_submitted(
+            pending.dep_ids + pending.contained_ids)
+
+    def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self.lineage.get(task_id)
+
+
+# ---------------------------------------------------------------------------
+# Lease management for normal tasks
+# (reference: src/ray/core_worker/task_submission/normal_task_submitter.cc)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Lease:
+    lease_id: int
+    worker_address: Address
+    worker_id: bytes
+    raylet_address: Address
+    node_id: str
+    last_used: float = field(default_factory=time.monotonic)
+
+
+class NormalTaskSubmitter:
+    def __init__(self, core_worker: "CoreWorker"):
+        self._cw = core_worker
+        self._idle: Dict[Tuple, List[Lease]] = {}
+        self._cleaner_started = False
+
+    def submit(self, spec: TaskSpec):
+        self._cw.loop_call(self._submit(spec))
+
+    def resubmit(self, spec: TaskSpec):
+        self.submit(spec)
+
+    async def _submit(self, spec: TaskSpec):
+        try:
+            await self._resolve_dependencies(spec)
+            lease = await self._acquire_lease(spec)
+        except Exception as e:
+            self._cw.task_manager.on_failed(spec, e, is_application_error=False)
+            return
+        worker = self._cw.clients.get(lease.worker_address)
+        try:
+            reply = await worker.call("push_task", spec=spec,
+                                      lease_id=lease.lease_id, timeout=None)
+        except Exception as e:
+            # Worker died or became unreachable — a system failure.
+            self._drop_lease(lease)
+            self._cw.task_manager.on_failed(
+                spec, WorkerCrashedError(
+                    f"worker {lease.worker_address} failed: {e}"),
+                is_application_error=False)
+            return
+        self._return_lease(spec.shape_key(), lease)
+        error = reply.get("error")
+        if error is not None:
+            self._cw.task_manager.on_failed(
+                spec, error, is_application_error=True)
+        else:
+            self._cw.task_manager.on_completed(spec, reply)
+
+    async def _resolve_dependencies(self, spec: TaskSpec):
+        """Wait until owned args exist; inline small plain values
+        (reference: DependencyResolver)."""
+        for i, arg in enumerate(spec.args):
+            if not arg.is_ref:
+                continue
+            oid = arg.object_id
+            if self._cw.reference_counter.is_owner(oid) or \
+                    self._cw.task_manager.is_pending(oid.task_id()):
+                while not self._cw.memory_store.contains(oid):
+                    if not self._cw.task_manager.is_pending(oid.task_id()) \
+                            and not self._cw.memory_store.contains(oid):
+                        # Owned put object already in plasma: ready.
+                        break
+                    await self._cw.memory_store.wait_ready_async(oid)
+                entry = self._cw.memory_store.get_entry(oid)
+                if entry is not None and entry.is_exception:
+                    raise entry.value if isinstance(entry.value, Exception) \
+                        else TaskError(spec.function.display_name(),
+                                       str(entry.value))
+                if entry is not None and not entry.in_plasma:
+                    sobj = serialization.serialize(entry.value)
+                    if sobj.total_bytes() <= CONFIG.inline_arg_max_bytes \
+                            and not sobj.contained_refs:
+                        spec.args[i] = TaskArg(is_ref=False,
+                                               data=sobj.to_bytes())
+
+    async def _acquire_lease(self, spec: TaskSpec) -> Lease:
+        key = spec.shape_key()
+        idle = self._idle.get(key)
+        while idle:
+            lease = idle.pop()
+            return lease
+        meta = {
+            "resources": spec.resources,
+            "shape_key": key,
+            "runtime_env": spec.runtime_env,
+            "label_selector": spec.label_selector or None,
+        }
+        strategy = spec.scheduling_strategy
+        if strategy.kind == "placement_group":
+            meta["pg"] = (strategy.placement_group_id, strategy.bundle_index)
+        raylet_addr = self._cw.raylet_address
+        if strategy.kind == "node_affinity" and strategy.node_id:
+            addr = await self._cw.node_address(strategy.node_id)
+            if addr is not None:
+                raylet_addr = addr
+        for _hop in range(16):
+            raylet = self._cw.clients.get(raylet_addr)
+            reply = await raylet.call("request_worker_lease", spec_meta=meta,
+                                      timeout=None,
+                                      retries=CONFIG.rpc_max_retries)
+            if reply.get("spillback_to"):
+                raylet_addr = tuple(reply["spillback_to"][1])
+                continue
+            if reply.get("rejected"):
+                await asyncio.sleep(0.05)
+                continue
+            if not self._cleaner_started:
+                self._cleaner_started = True
+                asyncio.ensure_future(self._idle_lease_cleaner())
+            return Lease(
+                lease_id=reply["lease_id"],
+                worker_address=tuple(reply["worker_address"]),
+                worker_id=reply["worker_id"],
+                raylet_address=raylet_addr,
+                node_id=reply["node_id"])
+        raise RayTpuError("could not acquire a worker lease (too many hops)")
+
+    def _return_lease(self, key: Tuple, lease: Lease):
+        lease.last_used = time.monotonic()
+        self._idle.setdefault(key, []).append(lease)
+
+    def _drop_lease(self, lease: Lease):
+        self._cw.fire_and_forget(lease.raylet_address, "return_worker",
+                                 lease_id=lease.lease_id, dispose=True)
+
+    async def _idle_lease_cleaner(self):
+        while True:
+            await asyncio.sleep(CONFIG.lease_idle_timeout_s / 2)
+            now = time.monotonic()
+            for key, leases in list(self._idle.items()):
+                keep = []
+                for lease in leases:
+                    if now - lease.last_used > CONFIG.lease_idle_timeout_s:
+                        self._cw.fire_and_forget(
+                            lease.raylet_address, "return_worker",
+                            lease_id=lease.lease_id)
+                    else:
+                        keep.append(lease)
+                if keep:
+                    self._idle[key] = keep
+                else:
+                    self._idle.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# Actor task submission
+# (reference: src/ray/core_worker/task_submission/actor_task_submitter.cc)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ActorClientState:
+    actor_id: ActorID
+    state: str = "PENDING"          # PENDING|ALIVE|RESTARTING|DEAD
+    address: Optional[Address] = None
+    num_restarts: int = 0
+    seq: int = 0
+    queued: List[TaskSpec] = field(default_factory=list)
+    inflight: Dict[int, TaskSpec] = field(default_factory=dict)
+    death_cause: str = ""
+    reconciling: bool = False
+
+
+class ActorTaskSubmitter:
+    def __init__(self, core_worker: "CoreWorker"):
+        self._cw = core_worker
+        self._actors: Dict[ActorID, ActorClientState] = {}
+        self._subscribed = False
+
+    def state_for(self, actor_id: ActorID) -> ActorClientState:
+        st = self._actors.get(actor_id)
+        if st is None:
+            st = ActorClientState(actor_id=actor_id)
+            self._actors[actor_id] = st
+        return st
+
+    async def ensure_subscribed(self):
+        if not self._subscribed:
+            self._subscribed = True
+            await self._cw.gcs.subscribe("ACTOR", self._on_actor_update)
+
+    def submit(self, spec: TaskSpec):
+        self._cw.loop_call(self._submit(spec))
+
+    async def _submit(self, spec: TaskSpec):
+        await self.ensure_subscribed()
+        st = self.state_for(spec.actor_id)
+        if st.state == "DEAD":
+            self._fail(spec, st.death_cause)
+            return
+        if st.state != "ALIVE" or st.address is None:
+            # Resolve address lazily (handle may have been deserialized in a
+            # process that never saw the creation).
+            info = await self._cw.gcs.call("get_actor_info",
+                                          actor_id=spec.actor_id)
+            if info is not None and info["state"] == "ALIVE":
+                st.state = "ALIVE"
+                st.address = tuple(info["address"])
+            elif info is not None and info["state"] == "DEAD":
+                st.state = "DEAD"
+                st.death_cause = info.get("death_cause", "actor dead")
+                self._fail(spec, st.death_cause)
+                return
+        spec.sequence_number = st.seq
+        st.seq += 1
+        if st.state != "ALIVE":
+            st.queued.append(spec)
+            return
+        await self._push(st, spec)
+
+    async def _push(self, st: ActorClientState, spec: TaskSpec):
+        st.inflight[spec.sequence_number] = spec
+        worker = self._cw.clients.get(st.address)
+        try:
+            reply = await worker.call("push_task", spec=spec, timeout=None)
+        except Exception:
+            st.inflight.pop(spec.sequence_number, None)
+            st.queued.append(spec)
+            # Either the actor is dying/restarting (the GCS will publish an
+            # update that drains the queue) or this was a transient transport
+            # failure with the actor still healthy — reconcile with the GCS
+            # rather than parking forever.
+            asyncio.ensure_future(self._reconcile(st))
+            return
+        st.inflight.pop(spec.sequence_number, None)
+        error = reply.get("error")
+        if error is not None:
+            self._cw.task_manager.on_failed(spec, error,
+                                            is_application_error=True)
+        else:
+            self._cw.task_manager.on_completed(spec, reply)
+
+    def _fail(self, spec: TaskSpec, cause: str):
+        err = ActorDiedError(spec.actor_id, cause or "actor died")
+        self._cw.task_manager.on_failed(spec, err, is_application_error=False)
+
+    async def _reconcile(self, st: ActorClientState):
+        """After a failed push, poll the GCS: if the actor is still ALIVE at
+        the same incarnation the failure was transient — flush the queue
+        ourselves, since no pubsub update will ever arrive."""
+        if st.reconciling:
+            return
+        st.reconciling = True
+        try:
+            for delay in (0.1, 0.3, 1.0, 2.0, 5.0):
+                await asyncio.sleep(delay)
+                if not st.queued and not st.inflight:
+                    return
+                try:
+                    info = await self._cw.gcs.call("get_actor_info",
+                                                   actor_id=st.actor_id)
+                except Exception:
+                    continue
+                if info is None:
+                    continue
+                if info["state"] == "DEAD":
+                    await self._on_actor_update({
+                        "actor_id": st.actor_id, "state": "DEAD",
+                        "death_cause": info.get("death_cause", "")})
+                    return
+                if info["state"] == "ALIVE":
+                    await self._on_actor_update({
+                        "actor_id": st.actor_id, "state": "ALIVE",
+                        "address": info["address"],
+                        "num_restarts": info.get("num_restarts", 0)})
+                    return
+                # RESTARTING/PENDING: keep polling as a pubsub backstop.
+        finally:
+            st.reconciling = False
+
+    async def _on_actor_update(self, message: Dict[str, Any]):
+        actor_id = message["actor_id"]
+        st = self._actors.get(actor_id)
+        if st is None:
+            return
+        state = message["state"]
+        if state == "ALIVE":
+            restarted = message.get("num_restarts", 0) != st.num_restarts
+            st.num_restarts = message.get("num_restarts", 0)
+            st.state = "ALIVE"
+            st.address = tuple(message["address"])
+            pending = sorted(st.queued + list(st.inflight.values()),
+                             key=lambda s: s.sequence_number)
+            st.queued = []
+            st.inflight = {}
+            if restarted:
+                # New actor instance: renumber surviving tasks from 0.
+                st.seq = 0
+                for spec in pending:
+                    spec.sequence_number = st.seq
+                    st.seq += 1
+            for spec in pending:
+                asyncio.ensure_future(self._push(st, spec))
+        elif state == "RESTARTING":
+            st.state = "RESTARTING"
+            st.address = None
+        elif state == "DEAD":
+            st.state = "DEAD"
+            st.death_cause = message.get("death_cause", "actor died")
+            pending = st.queued + list(st.inflight.values())
+            st.queued = []
+            st.inflight = {}
+            for spec in pending:
+                self._fail(spec, st.death_cause)
+
+
+# ---------------------------------------------------------------------------
+# Execution (reference: src/ray/core_worker/task_execution/ +
+# python/ray/_raylet.pyx task_execution_handler/execute_task)
+# ---------------------------------------------------------------------------
+
+class _RuntimeContext(threading.local):
+    def __init__(self):
+        self.task_spec: Optional[TaskSpec] = None
+        self.actor_id: Optional[ActorID] = None
+
+
+RUNTIME_CTX = _RuntimeContext()
+
+
+class TaskExecutor:
+    def __init__(self, core_worker: "CoreWorker"):
+        self._cw = core_worker
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rtpu-exec")
+        self._actor_instance: Any = None
+        self._actor_id: Optional[ActorID] = None
+        self._actor_pools: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
+        self._actor_async_sem: Optional[asyncio.Semaphore] = None
+        self._is_asyncio = False
+        # Ordered execution is per *caller*: each submitting worker numbers
+        # its own stream (reference: per-client actor scheduling queues).
+        self._next_seq: Dict[bytes, int] = {}
+        self._seq_buffer: Dict[bytes,
+                               Dict[int, Tuple[TaskSpec, asyncio.Future]]] = {}
+        self._reply_cache: Dict[bytes, Dict[int, Dict[str, Any]]] = {}
+
+    async def execute(self, spec: TaskSpec) -> Dict[str, Any]:
+        if spec.task_type == ACTOR_TASK:
+            return await self._execute_actor_task(spec)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._pool, self._run_task, spec)
+
+    async def _execute_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        # Enforce per-caller submission order by sequence number.
+        loop = asyncio.get_running_loop()
+        caller = spec.owner_worker_id
+        seq = spec.sequence_number
+        if seq < self._next_seq.get(caller, 0):
+            # Duplicate push (caller lost our reply): serve the cached reply
+            # instead of re-executing (at-most-once execution per seq).
+            cached = self._reply_cache.get(caller, {}).get(seq)
+            if cached is not None:
+                return cached
+            return {"error": TaskError(
+                spec.method_name, "duplicate actor task with evicted reply")}
+        fut = loop.create_future()
+        self._seq_buffer.setdefault(caller, {})[seq] = (spec, fut)
+        await self._drain_ready(caller)
+        reply = await fut
+        cache = self._reply_cache.setdefault(caller, {})
+        cache[seq] = reply
+        while len(cache) > 64:
+            cache.pop(next(iter(cache)))
+        return reply
+
+    async def _drain_ready(self, caller: bytes):
+        buffer = self._seq_buffer.get(caller, {})
+        self._next_seq.setdefault(caller, 0)
+        while self._next_seq[caller] in buffer:
+            spec, fut = buffer.pop(self._next_seq[caller])
+            self._next_seq[caller] += 1
+            if self._is_asyncio:
+                asyncio.ensure_future(self._run_async_actor_task(spec, fut))
+            else:
+                group = spec.concurrency_groups.get("_group") \
+                    if spec.concurrency_groups else None
+                pool = self._actor_pools.get(group or "_default", self._pool)
+                loop = asyncio.get_running_loop()
+
+                def _run(spec=spec, fut=fut, loop=loop):
+                    result = self._run_task(spec)
+                    loop.call_soon_threadsafe(
+                        lambda: fut.set_result(result)
+                        if not fut.done() else None)
+                pool.submit(_run)
+
+    async def _run_async_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
+        async with self._actor_async_sem:
+            result = await self._run_task_async(spec)
+        if not fut.done():
+            fut.set_result(result)
+
+    # -- shared execution helpers ---------------------------------------
+
+    def _load_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
+        bundle = serialization.deserialize(spec.args[0].data)
+        ref_values = []
+        for arg in spec.args[1:]:
+            if arg.is_ref:
+                ref = ObjectRef(arg.object_id, arg.owner_address)
+                ref_values.append(self._cw.get([ref])[0])
+            else:
+                # Resolved ref inlined by the submitter's DependencyResolver.
+                ref_values.append(serialization.deserialize(arg.data))
+
+        def subst(v):
+            return ref_values[v.index] if isinstance(v, _RefPlaceholder) else v
+
+        return (tuple(subst(a) for a in bundle.args),
+                {k: subst(v) for k, v in bundle.kwargs.items()})
+
+    def _package_returns(self, spec: TaskSpec, result: Any) -> Dict[str, Any]:
+        if spec.num_returns == 0:
+            return {"returns": []}
+        values = (result,) if spec.num_returns == 1 else tuple(result)
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            raise ValueError(
+                f"task declared num_returns={spec.num_returns} but returned "
+                f"{len(values)} values")
+        returns = []
+        for index, value in enumerate(values):
+            sobj = serialization.serialize(value)
+            oid = ObjectID.for_task_return(spec.task_id, index)
+            if sobj.total_bytes() > CONFIG.max_direct_call_object_size:
+                self._cw.put_serialized_to_plasma(oid, sobj,
+                                                 owner=spec.owner_address)
+                returns.append({"plasma": True, "size": sobj.total_bytes()})
+            else:
+                returns.append({"data": sobj.to_bytes()})
+        return {"returns": returns}
+
+    def _run_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        RUNTIME_CTX.task_spec = spec
+        RUNTIME_CTX.actor_id = spec.actor_id
+        try:
+            if spec.task_type == ACTOR_TASK \
+                    and spec.method_name == "__rtpu_terminate__":
+                return self._graceful_exit(spec)
+            packed_args, packed_kwargs = self._load_args(spec)
+            if spec.task_type == ACTOR_CREATION_TASK:
+                cls = self._cw.function_manager.load(spec.job_id,
+                                                     spec.function)
+                self._setup_actor(spec)
+                self._actor_instance = cls(*packed_args, **packed_kwargs)
+                self._actor_id = spec.actor_id
+                return {"returns": []}
+            if spec.task_type == ACTOR_TASK:
+                method = getattr(self._actor_instance, spec.method_name)
+                result = method(*packed_args, **packed_kwargs)
+            else:
+                func = self._cw.function_manager.load(spec.job_id,
+                                                      spec.function)
+                result = func(*packed_args, **packed_kwargs)
+            return self._package_returns(spec, result)
+        except Exception as e:  # noqa: BLE001 — crosses process boundary
+            return {"error": TaskError(spec.function.display_name() or
+                                       spec.method_name,
+                                       traceback.format_exc(), cause=e)}
+        finally:
+            RUNTIME_CTX.task_spec = None
+            RUNTIME_CTX.actor_id = None
+
+    def _graceful_exit(self, spec: TaskSpec) -> Dict[str, Any]:
+        try:
+            self._cw.gcs.call_sync("actor_exited", actor_id=spec.actor_id,
+                                   cause="terminate() called", timeout=10)
+        except Exception:
+            pass
+        EventLoopThread.get().loop.call_later(0.1, os._exit, 0)
+        return self._package_returns(spec, None)
+
+    async def _run_task_async(self, spec: TaskSpec) -> Dict[str, Any]:
+        try:
+            if spec.method_name == "__rtpu_terminate__":
+                return self._graceful_exit(spec)
+            args, kwargs = await asyncio.get_running_loop().run_in_executor(
+                None, self._load_args, spec)
+            method = getattr(self._actor_instance, spec.method_name)
+            result = method(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = await result
+            return await asyncio.get_running_loop().run_in_executor(
+                None, self._package_returns, spec, result)
+        except Exception as e:  # noqa: BLE001
+            return {"error": TaskError(spec.method_name,
+                                       traceback.format_exc(), cause=e)}
+
+    def _setup_actor(self, spec: TaskSpec):
+        self._is_asyncio = spec.is_asyncio
+        if spec.is_asyncio:
+            self._actor_async_sem = asyncio.Semaphore(
+                max(1, spec.max_concurrency))
+        elif spec.max_concurrency > 1:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=spec.max_concurrency,
+                thread_name_prefix="rtpu-actor")
+        for name, size in (spec.concurrency_groups or {}).items():
+            self._actor_pools[name] = concurrent.futures.ThreadPoolExecutor(
+                max_workers=size, thread_name_prefix=f"rtpu-cg-{name}")
+
+# ---------------------------------------------------------------------------
+# CoreWorker
+# ---------------------------------------------------------------------------
+
+class CoreWorker:
+    def __init__(self, mode: str, session_name: str, gcs_address: Address,
+                 raylet_address: Address, node_id: str, node_index: int,
+                 job_id: Optional[JobID] = None,
+                 worker_id: Optional[bytes] = None):
+        self.mode = mode  # "driver" | "worker"
+        self.session_name = session_name
+        self.worker_id = worker_id or WorkerID.from_random().binary()
+        self.node_id = node_id
+        self.node_index = node_index
+        self.raylet_address = tuple(raylet_address)
+        self.server = RpcServer(f"{mode}-{self.worker_id.hex()[:8]}")
+        self.clients = ClientPool()
+        self.rpc_address: Optional[Address] = None
+        self.gcs = GcsClient(gcs_address, local_server=self.server)
+        self.memory_store = MemoryStore()
+        self.plasma = PlasmaDir(session_name, node_index)
+        self.reference_counter = ReferenceCounter(self)
+        self.task_manager = TaskManager(self)
+        self.submitter = NormalTaskSubmitter(self)
+        self.actor_submitter = ActorTaskSubmitter(self)
+        self.executor = TaskExecutor(self)
+        self.function_manager = FunctionManager(self.gcs)
+        self.job_id = job_id or JobID.from_int(0)
+        self.current_lease_id: Optional[int] = None
+        self._node_addr_cache: Dict[str, Address] = {}
+        self._shutdown = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self):
+        loop_thread = EventLoopThread.get()
+        self.server.register_instance(self)
+        self.rpc_address = loop_thread.run_sync(self.server.start())
+
+    def shutdown(self):
+        self._shutdown = True
+        try:
+            EventLoopThread.get().run_sync(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+
+    # -- plumbing --------------------------------------------------------
+
+    def loop_call(self, coro):
+        return EventLoopThread.get().call_soon(coro)
+
+    def run_sync(self, coro, timeout=None):
+        return EventLoopThread.get().run_sync(coro, timeout)
+
+    def fire_and_forget(self, address: Address, method: str, **kwargs):
+        client = self.clients.get(address)
+
+        async def _go():
+            try:
+                await client.call(method, timeout=10, **kwargs)
+            except Exception:
+                pass
+        self.loop_call(_go())
+
+    async def node_address(self, node_id: str) -> Optional[Address]:
+        addr = self._node_addr_cache.get(node_id)
+        if addr is not None:
+            return addr
+        nodes = await self.gcs.call("get_all_nodes")
+        for n in nodes:
+            self._node_addr_cache[n["node_id"]] = tuple(n["address"])
+        return self._node_addr_cache.get(node_id)
+
+    # -- public object API ----------------------------------------------
+
+    def put(self, value: Any, _owner_address: Optional[Address] = None
+            ) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        oid = ObjectID.from_random()
+        sobj = serialization.serialize(value)
+        owner = _owner_address or self.rpc_address
+        if sobj.contained_refs:
+            self.reference_counter.add_contained(
+                [r.id() for r in sobj.contained_refs])
+        if sobj.total_bytes() <= CONFIG.max_direct_call_object_size:
+            # Small puts stay in-process; borrowers fetch via get_object rpc.
+            self.reference_counter.add_owned(oid, in_plasma=False)
+            self.memory_store.put(oid, value)
+        else:
+            self.reference_counter.add_owned(oid, in_plasma=True)
+            self.put_serialized_to_plasma(oid, sobj, owner=owner)
+        return ObjectRef(oid, owner)
+
+    def put_serialized_to_plasma(self, oid: ObjectID,
+                                 sobj: serialization.SerializedObject,
+                                 owner: Optional[Address]):
+        self.plasma.put_serialized(oid, sobj)
+        raylet = self.clients.get(self.raylet_address)
+        raylet.call_sync("seal_object", object_hex=oid.hex(),
+                         size=sobj.total_bytes(), owner_address=owner,
+                         retries=CONFIG.rpc_max_retries)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None
+            ) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(ref, remaining))
+        return out
+
+    def get_async(self, ref: ObjectRef) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _work():
+            try:
+                fut.set_result(self._get_one(ref, None))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+        threading.Thread(target=_work, daemon=True).start()
+        return fut
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        oid = ref.id()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        poll = 0.0005
+        while True:
+            entry = self.memory_store.get_entry(oid)
+            if entry is not None and not entry.in_plasma:
+                if entry.is_exception:
+                    err = entry.value
+                    if isinstance(err, TaskError):
+                        raise err.as_instanceof_cause()
+                    raise err
+                return entry.value
+            value, ok = self.plasma.get(oid)
+            if ok:
+                return value
+            # Remote / not-yet-ready paths.
+            if entry is not None and entry.in_plasma:
+                result = self._pull_via_raylet(oid)
+                if result:
+                    continue
+                if self._maybe_reconstruct(oid):
+                    continue
+                raise ObjectLostError(oid)
+            if self.task_manager.is_pending(oid.task_id()):
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"get() timed out waiting for {ref}")
+                self.memory_store.wait_ready([oid], 1,
+                                             min(remaining or 0.2, 0.2))
+                continue
+            if not self.reference_counter.is_owner(oid):
+                # Borrowed ref: ask the owner, then fall back to plasma pull.
+                fetched = self._fetch_from_owner(ref)
+                if fetched is not _MISSING:
+                    return fetched
+                if self._pull_via_raylet(oid):
+                    continue
+            else:
+                if self._pull_via_raylet(oid):
+                    continue
+                if self._maybe_reconstruct(oid):
+                    continue
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(f"get() timed out waiting for {ref}")
+            time.sleep(poll)
+            poll = min(poll * 2, 0.05)
+
+    def _pull_via_raylet(self, oid: ObjectID) -> bool:
+        raylet = self.clients.get(self.raylet_address)
+        try:
+            reply = raylet.call_sync("pull_object", object_hex=oid.hex(),
+                                     timeout=None,
+                                     retries=CONFIG.rpc_max_retries)
+        except Exception:
+            return False
+        return bool(reply.get("ok"))
+
+    def _fetch_from_owner(self, ref: ObjectRef):
+        owner = ref.owner_address()
+        if owner is None or tuple(owner) == self.rpc_address:
+            return _MISSING
+        client = self.clients.get(owner)
+        try:
+            reply = client.call_sync("get_object", object_hex=ref.hex(),
+                                     timeout=30)
+        except Exception:
+            return _MISSING
+        if reply.get("data") is not None:
+            return serialization.deserialize(reply["data"])
+        return _MISSING
+
+    def _maybe_reconstruct(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction (reference: object_recovery_manager.cc):
+        resubmit the creating task if we own it and lineage is retained."""
+        if not oid.is_task_return():
+            return False
+        spec = self.task_manager.lineage_spec(oid.task_id())
+        if spec is None:
+            return False
+        logger.info("reconstructing %s by resubmitting task %s",
+                    oid.hex()[:12], spec.name or spec.function.qualname)
+        # Clear stale state and resubmit.
+        self.memory_store.delete(spec.return_ids())
+        spec.attempt_number += 1
+        self.task_manager.add_pending(spec)
+        dep_ids = [d for d, _ in spec.dependencies()]
+        self.reference_counter.add_submitted(
+            dep_ids + [c for a in spec.args for c in a.contained_ref_ids])
+        self.submitter.submit(spec)
+        # Wait for it to land.
+        self.memory_store.wait_ready(spec.return_ids(), len(spec.return_ids()),
+                                     timeout=CONFIG.rpc_call_timeout_s * 10)
+        return True
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True
+             ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        ready_set: Set[ObjectID] = set()
+        while True:
+            for ref in refs:
+                oid = ref.id()
+                if oid in ready_set:
+                    continue
+                if self._is_ready(ref, fetch_local):
+                    ready.append(ref)
+                    ready_set.add(oid)
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            self.memory_store.wait_ready(
+                [r.id() for r in refs if r.id() not in ready_set],
+                1, timeout=0.02)
+        not_ready = [r for r in refs if r.id() not in ready_set]
+        return ready, not_ready
+
+    def _is_ready(self, ref: ObjectRef, fetch_local: bool) -> bool:
+        oid = ref.id()
+        entry = self.memory_store.get_entry(oid)
+        if entry is not None and not entry.in_plasma:
+            return True
+        if self.plasma.contains(oid):
+            return True
+        if entry is not None and entry.in_plasma:
+            # Completed into plasma somewhere.
+            if fetch_local:
+                return self._pull_via_raylet(oid)
+            return True
+        if self.task_manager.is_pending(oid.task_id()):
+            return False
+        # Unknown object (borrowed put, etc.): consult the directory.
+        try:
+            info = self.gcs.call_sync("get_object_locations",
+                                      object_hex=oid.hex(), timeout=5)
+        except Exception:
+            return False
+        known = bool(info.get("nodes") or info.get("spilled"))
+        if known and fetch_local:
+            return self._pull_via_raylet(oid)
+        if not known and ref.owner_address() is not None:
+            # Small owner-held object: ready iff the owner can serve it now.
+            return self._fetch_from_owner(ref) is not _MISSING
+        return known
+
+    def free_objects(self, refs: List[ObjectRef]):
+        for ref in refs:
+            self._free_owned_object(ref.id())
+
+    def _free_owned_object(self, object_id: ObjectID):
+        self.memory_store.delete([object_id])
+        self.fire_and_forget(self.gcs.address, "free_object",
+                             object_hex=object_id.hex())
+
+    # -- task submission -------------------------------------------------
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.task_manager.add_pending(spec)
+        dep_ids = [oid for oid, _ in spec.dependencies()]
+        contained = [c for a in spec.args for c in a.contained_ref_ids]
+        self.reference_counter.add_submitted(dep_ids + contained)
+        refs = []
+        for oid in spec.return_ids():
+            self.reference_counter.add_owned(
+                oid, lineage_task=spec.task_id)
+            refs.append(ObjectRef(oid, self.rpc_address))
+        if spec.task_type == ACTOR_TASK:
+            self.actor_submitter.submit(spec)
+        else:
+            self.submitter.submit(spec)
+        return refs
+
+    # -- rpc handlers ----------------------------------------------------
+
+    async def handle_push_task(self, spec: TaskSpec,
+                               lease_id: Optional[int] = None):
+        if lease_id is not None:
+            self.current_lease_id = lease_id
+        return await self.executor.execute(spec)
+
+    async def handle_get_object(self, object_hex: str):
+        oid = ObjectID.from_hex(object_hex)
+        entry = self.memory_store.get_entry(oid)
+        if entry is None:
+            return {"data": None}
+        if entry.in_plasma:
+            return {"data": None, "in_plasma": True}
+        if entry.is_exception:
+            return {"data": None, "error": True}
+        sobj = serialization.serialize(entry.value)
+        return {"data": sobj.to_bytes()}
+
+    async def handle_borrow_addref(self, object_hex: str):
+        self.reference_counter.add_borrower(ObjectID.from_hex(object_hex))
+        return True
+
+    async def handle_borrow_decref(self, object_hex: str):
+        self.reference_counter.remove_borrower(ObjectID.from_hex(object_hex))
+        return True
+
+    async def handle_kill_actor(self, actor_id: ActorID):
+        # Hard exit, like the reference's force-kill: no cleanup.
+        EventLoopThread.get().loop.call_later(0.05, os._exit, 1)
+        return True
+
+    async def handle_ping(self):
+        return "pong"
+
+
+_MISSING = object()

@@ -1,0 +1,126 @@
+"""Exception hierarchy for the runtime.
+
+Mirrors the user-facing error surface of the reference
+(python/ray/exceptions.py): task errors wrap the remote traceback, actor
+errors carry death cause, object errors carry the object id.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RpcError(RayTpuError):
+    """A control-plane RPC failed after retries."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get` exceeded its timeout."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception.
+
+    The remote traceback is captured as a string and re-raised on `get` with
+    the original exception chained as ``cause`` when it could be pickled.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str,
+                 cause: Optional[BaseException] = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(self._format())
+
+    def _format(self):
+        return (
+            f"remote task {self.function_name} failed\n"
+            f"--- remote traceback ---\n{self.traceback_str}"
+        )
+
+    def __reduce__(self):
+        cause = self.cause
+        if cause is not None:
+            try:
+                import cloudpickle
+                cloudpickle.dumps(cause)
+            except Exception:
+                cause = None  # unpicklable user exception: keep text only
+        return (TaskError, (self.function_name, self.traceback_str, cause))
+
+    def as_instanceof_cause(self):
+        """Return an exception that is both a TaskError and isinstance of the
+        user's exception type, so `except UserError:` works across the RPC
+        boundary (reference: RayTaskError.as_instanceof_cause)."""
+        if self.cause is None:
+            return self
+        cause_cls = type(self.cause)
+        if cause_cls is TaskError or issubclass(TaskError, cause_cls):
+            return self
+        try:
+            derived = type(
+                "TaskError_" + cause_cls.__name__,
+                (TaskError, cause_cls),
+                {"__init__": lambda s: None},
+            )
+            err = derived()
+            err.function_name = self.function_name
+            err.traceback_str = self.traceback_str
+            err.cause = self.cause
+            err.args = (self._format(),)
+            return err
+        except TypeError:
+            return self
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    def __init__(self, actor_id=None, cause: str = "actor died"):
+        self.actor_id = actor_id
+        super().__init__(f"actor {actor_id} is dead: {cause}")
+
+
+class ActorUnavailableError(ActorError):
+    """Actor is temporarily unreachable (restarting); call may be retried."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id, message="object lost from the object store"):
+        self.object_id = object_id
+        super().__init__(f"{message}: {object_id}")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Task/worker was killed by the memory monitor."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+def format_current_exception() -> str:
+    return traceback.format_exc()

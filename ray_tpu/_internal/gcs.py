@@ -1,0 +1,890 @@
+"""Global Control Service (GCS).
+
+Equivalent of the reference's GCS server (src/ray/gcs/: gcs_server.h,
+gcs_node_manager, gcs_actor_manager, gcs_placement_group_manager/scheduler,
+gcs_resource_manager, gcs_health_check_manager, gcs_kv_manager,
+gcs_job_manager, pubsub_handler). One per cluster, owns all cluster metadata:
+
+- node membership + active health checking of raylets
+- the actor directory and actor lifecycle (schedule / restart / kill)
+- placement groups with two-phase prepare/commit across raylets
+- cluster resource view (built from raylet heartbeats; heartbeat replies
+  carry the aggregated view back so every raylet can make spillback
+  decisions — the role of the reference's RaySyncer gossip)
+- internal KV (function registry, named actors, train rendezvous, etc.)
+- cluster-wide pubsub (push-based; the reference uses long-poll)
+- the object directory for shared-memory objects (location set per object)
+- job table and task-event collection (state API / timeline backend)
+
+Storage is in-memory tables with an optional snapshot file for fault-tolerant
+restart (the reference's Redis mode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import CONFIG
+from .errors import ActorDiedError, PlacementGroupError
+from .ids import ActorID, JobID, NodeID, PlacementGroupID
+from .resources import NodeResources, ResourceSet
+from .rpc import Address, ClientPool, RpcServer, get_loop
+from .scheduling_policy import NodeView, pick_hybrid, pick_node_affinity, \
+    pick_node_label, pick_spread, place_bundles
+from . import serialization
+from .task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Tables
+# ---------------------------------------------------------------------------
+
+@dataclass
+class NodeRecord:
+    node_id: str
+    address: Address            # raylet rpc address
+    resources_total: Dict[str, float]
+    labels: Dict[str, str]
+    state: str = "ALIVE"        # ALIVE | DEAD
+    node_index: int = 0
+    session_name: str = ""
+    last_heartbeat: float = 0.0
+    missed_health_checks: int = 0
+    is_head: bool = False
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    spec: TaskSpec
+    name: str = ""
+    namespace: str = ""
+    state: str = "PENDING"      # PENDING|ALIVE|RESTARTING|DEAD
+    address: Optional[Address] = None     # worker rpc address
+    node_id: Optional[str] = None
+    worker_id: Optional[bytes] = None
+    num_restarts: int = 0
+    max_restarts: int = 0
+    death_cause: str = ""
+    is_detached: bool = False
+    owner_address: Optional[Address] = None
+    placement_group_id: Optional[PlacementGroupID] = None
+    # Bumped on every (re)schedule decision; a stale _schedule_actor loop
+    # observing a different epoch aborts (prevents two live instances).
+    sched_epoch: int = 0
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: PlacementGroupID
+    bundles: List[Dict[str, float]]
+    strategy: str
+    name: str = ""
+    state: str = "PENDING"      # PENDING|CREATED|REMOVED|RESCHEDULING
+    bundle_nodes: List[Optional[str]] = field(default_factory=list)
+    creator_job: Optional[JobID] = None
+    is_detached: bool = False
+
+
+@dataclass
+class JobRecord:
+    job_id: JobID
+    driver_address: Optional[Address]
+    namespace: str = ""
+    state: str = "RUNNING"
+    start_time: float = 0.0
+    end_time: float = 0.0
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+class GcsServer:
+    def __init__(self, session_name: str, persist_path: Optional[str] = None):
+        self.session_name = session_name
+        self.persist_path = persist_path
+        self.server = RpcServer("gcs")
+        self.clients = ClientPool()
+        self.address: Optional[Address] = None
+
+        self.nodes: Dict[str, NodeRecord] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.pgs: Dict[PlacementGroupID, PlacementGroupRecord] = {}
+        self.jobs: Dict[JobID, JobRecord] = {}
+        self.kv: Dict[str, Dict[str, bytes]] = {}
+        # object directory: obj hex -> (owner addr, set of node ids, size)
+        self.object_dir: Dict[str, Tuple[Optional[Address], Set[str], int]] = {}
+        self.spilled: Dict[str, str] = {}   # obj hex -> spilled path
+        # pubsub: channel -> {subscriber addr}
+        self.subscribers: Dict[str, Set[Address]] = {}
+        self.task_events: List[Dict[str, Any]] = []
+        self.actor_sched_lock: Optional[asyncio.Lock] = None
+
+        self._resource_views: Dict[str, NodeView] = {}
+        self._job_counter = 0
+        self._spread_clock = 0
+        self._next_node_index = 1
+        self._health_task = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self.actor_sched_lock = asyncio.Lock()
+        self.server.register_instance(self)
+        self.address = await self.server.start(host, port)
+        self._restore()
+        self._health_task = asyncio.ensure_future(self._health_check_loop())
+        self._started = True
+        return self.address
+
+    async def stop(self):
+        if self._health_task:
+            self._health_task.cancel()
+        await self.server.stop()
+
+    # ------------------------------------------------------------------
+    # persistence (reference: redis store client; here a snapshot file)
+    # ------------------------------------------------------------------
+
+    def _persist(self):
+        if not self.persist_path:
+            return
+        try:
+            snapshot = serialization.dumps({
+                "nodes": self.nodes, "actors": self.actors,
+                "named_actors": self.named_actors, "pgs": self.pgs,
+                "jobs": self.jobs, "kv": self.kv,
+                "job_counter": self._job_counter,
+            })
+            tmp = self.persist_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(snapshot)
+            import os
+            os.replace(tmp, self.persist_path)
+        except Exception:
+            logger.exception("gcs persist failed")
+
+    def _restore(self):
+        if not self.persist_path:
+            return
+        try:
+            with open(self.persist_path, "rb") as f:
+                snap = serialization.loads(f.read())
+        except FileNotFoundError:
+            return
+        except Exception:
+            logger.exception("gcs restore failed")
+            return
+        self.nodes = snap["nodes"]
+        self.actors = snap["actors"]
+        self.named_actors = snap["named_actors"]
+        self.pgs = snap["pgs"]
+        self.jobs = snap["jobs"]
+        self.kv = snap["kv"]
+        self._job_counter = snap["job_counter"]
+        # Nodes must re-register; mark everything stale until they do.
+        for rec in self.nodes.values():
+            rec.missed_health_checks = 0
+
+    # ------------------------------------------------------------------
+    # pubsub
+    # ------------------------------------------------------------------
+
+    async def handle_subscribe(self, channel: str, address: Address):
+        self.subscribers.setdefault(channel, set()).add(tuple(address))
+        return True
+
+    async def handle_unsubscribe(self, channel: str, address: Address):
+        self.subscribers.get(channel, set()).discard(tuple(address))
+        return True
+
+    def publish(self, channel: str, message: Dict[str, Any]):
+        subs = list(self.subscribers.get(channel, ()))
+        for addr in subs:
+            client = self.clients.get(addr)
+            fut = asyncio.ensure_future(client.call(
+                "pubsub_message", channel=channel, message=message,
+                timeout=CONFIG.pubsub_push_timeout_s))
+            fut.add_done_callback(
+                lambda f, a=addr, c=channel: self._on_publish_done(f, a, c))
+
+    def _on_publish_done(self, fut, addr, channel):
+        exc = fut.exception() if not fut.cancelled() else None
+        if exc is not None:
+            # Dead subscriber: drop it.
+            self.subscribers.get(channel, set()).discard(addr)
+
+    # ------------------------------------------------------------------
+    # KV
+    # ------------------------------------------------------------------
+
+    async def handle_kv_put(self, ns: str, key: str, value: bytes,
+                            overwrite: bool = True):
+        table = self.kv.setdefault(ns, {})
+        if not overwrite and key in table:
+            return False
+        table[key] = value
+        return True
+
+    async def handle_kv_get(self, ns: str, key: str):
+        return self.kv.get(ns, {}).get(key)
+
+    async def handle_kv_multi_get(self, ns: str, keys: List[str]):
+        table = self.kv.get(ns, {})
+        return {k: table[k] for k in keys if k in table}
+
+    async def handle_kv_del(self, ns: str, key: str):
+        return self.kv.get(ns, {}).pop(key, None) is not None
+
+    async def handle_kv_keys(self, ns: str, prefix: str = ""):
+        return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
+
+    async def handle_kv_exists(self, ns: str, key: str):
+        return key in self.kv.get(ns, {})
+
+    # ------------------------------------------------------------------
+    # nodes / resources / health
+    # ------------------------------------------------------------------
+
+    async def handle_register_node(self, node_id: str, address: Address,
+                                   resources: Dict[str, float],
+                                   labels: Dict[str, str],
+                                   is_head: bool = False):
+        rec = NodeRecord(
+            node_id=node_id, address=tuple(address),
+            resources_total=resources, labels=labels,
+            node_index=self._next_node_index, is_head=is_head,
+            session_name=self.session_name, last_heartbeat=time.monotonic())
+        self._next_node_index += 1
+        self.nodes[node_id] = rec
+        nr = NodeResources(ResourceSet(resources), labels)
+        self._resource_views[node_id] = NodeView(node_id, nr)
+        self.publish("NODE", {"event": "ALIVE", "node_id": node_id,
+                              "address": rec.address})
+        self._persist()
+        return {"node_index": rec.node_index, "session_name": self.session_name}
+
+    async def handle_heartbeat(self, node_id: str,
+                               resources_available: Dict[str, float],
+                               resources_total: Dict[str, float]):
+        rec = self.nodes.get(node_id)
+        if rec is None or rec.state == "DEAD":
+            return {"dead": True}
+        rec.last_heartbeat = time.monotonic()
+        rec.missed_health_checks = 0
+        view = self._resource_views.get(node_id)
+        if view is not None:
+            total = ResourceSet(resources_total)
+            view.resources.total = total
+            view.resources.available = ResourceSet(resources_available)
+        # Reply with the full cluster view for spillback decisions.
+        return {"dead": False, "view": self.cluster_view_snapshot()}
+
+    def cluster_view_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for nid, view in self._resource_views.items():
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state == "DEAD":
+                continue
+            out[nid] = {
+                "address": rec.address,
+                "total": view.resources.total.to_dict(),
+                "available": view.resources.available.to_dict(),
+                "labels": view.resources.labels,
+            }
+        return out
+
+    async def handle_get_all_nodes(self):
+        return [
+            {
+                "node_id": r.node_id, "address": r.address, "state": r.state,
+                "resources": r.resources_total, "labels": r.labels,
+                "node_index": r.node_index, "is_head": r.is_head,
+                "session_name": r.session_name,
+            }
+            for r in self.nodes.values()
+        ]
+
+    async def handle_drain_node(self, node_id: str):
+        view = self._resource_views.get(node_id)
+        if view is not None:
+            view.draining = True
+        return True
+
+    async def _health_check_loop(self):
+        period = CONFIG.health_check_period_s
+        while True:
+            try:
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                for rec in list(self.nodes.values()):
+                    if rec.state == "DEAD":
+                        continue
+                    stale = now - rec.last_heartbeat
+                    if stale > CONFIG.health_check_timeout_s:
+                        rec.missed_health_checks += 1
+                        # Active probe before declaring death.
+                        try:
+                            await self.clients.get(rec.address).call(
+                                "ping", timeout=CONFIG.health_check_timeout_s)
+                            rec.last_heartbeat = time.monotonic()
+                            rec.missed_health_checks = 0
+                        except Exception:
+                            pass
+                    if rec.missed_health_checks >= \
+                            CONFIG.health_check_failure_threshold:
+                        await self._on_node_death(rec.node_id, "health check failed")
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("gcs health check loop error")
+
+    async def _on_node_death(self, node_id: str, cause: str):
+        rec = self.nodes.get(node_id)
+        if rec is None or rec.state == "DEAD":
+            return
+        logger.warning("node %s declared dead: %s", node_id[:12], cause)
+        rec.state = "DEAD"
+        view = self._resource_views.pop(node_id, None)
+        self.publish("NODE", {"event": "DEAD", "node_id": node_id,
+                              "address": rec.address})
+        # Drop object locations on the dead node; owners reconstruct on demand.
+        for key, (owner, locations, size) in list(self.object_dir.items()):
+            locations.discard(node_id)
+        # Restart or fail actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in ("ALIVE",
+                                                            "RESTARTING",
+                                                            "PENDING"):
+                await self._handle_actor_failure(actor, f"node died: {cause}")
+        # Reschedule placement groups with bundles there.
+        for pg in list(self.pgs.values()):
+            if pg.state in ("CREATED", "PENDING") and \
+                    node_id in [n for n in pg.bundle_nodes if n]:
+                pg.state = "RESCHEDULING"
+                asyncio.ensure_future(self._schedule_pg(pg))
+        self._persist()
+
+    async def handle_report_node_death(self, node_id: str, cause: str):
+        await self._on_node_death(node_id, cause)
+        return True
+
+    # ------------------------------------------------------------------
+    # jobs
+    # ------------------------------------------------------------------
+
+    async def handle_add_job(self, driver_address: Optional[Address],
+                             namespace: str,
+                             metadata: Optional[Dict[str, str]] = None):
+        self._job_counter += 1
+        job_id = JobID.from_int(self._job_counter)
+        self.jobs[job_id] = JobRecord(
+            job_id=job_id,
+            driver_address=tuple(driver_address) if driver_address else None,
+            namespace=namespace, start_time=time.time(),
+            metadata=metadata or {})
+        self._persist()
+        return job_id
+
+    async def handle_mark_job_finished(self, job_id: JobID):
+        rec = self.jobs.get(job_id)
+        if rec:
+            rec.state = "FINISHED"
+            rec.end_time = time.time()
+        # Clean up non-detached actors owned by the job.
+        for actor in list(self.actors.values()):
+            if actor.spec.job_id == job_id and not actor.is_detached \
+                    and actor.state != "DEAD":
+                await self._kill_actor(actor, "job finished", no_restart=True)
+        for pg in list(self.pgs.values()):
+            if pg.creator_job == job_id and not pg.is_detached \
+                    and pg.state != "REMOVED":
+                await self.handle_remove_placement_group(pg.pg_id)
+        self._persist()
+        return True
+
+    async def handle_get_all_jobs(self):
+        return [
+            {"job_id": r.job_id.hex(), "state": r.state,
+             "namespace": r.namespace, "start_time": r.start_time,
+             "end_time": r.end_time, "metadata": r.metadata}
+            for r in self.jobs.values()
+        ]
+
+    # ------------------------------------------------------------------
+    # object directory
+    # ------------------------------------------------------------------
+
+    async def handle_add_object_location(self, object_hex: str, node_id: str,
+                                         size: int,
+                                         owner_address: Optional[Address]):
+        entry = self.object_dir.get(object_hex)
+        if entry is None:
+            self.object_dir[object_hex] = (
+                tuple(owner_address) if owner_address else None,
+                {node_id}, size)
+        else:
+            entry[1].add(node_id)
+        return True
+
+    async def handle_remove_object_location(self, object_hex: str,
+                                            node_id: str):
+        entry = self.object_dir.get(object_hex)
+        if entry is not None:
+            entry[1].discard(node_id)
+        return True
+
+    async def handle_get_object_locations(self, object_hex: str):
+        entry = self.object_dir.get(object_hex)
+        if entry is None:
+            return {"owner": None, "nodes": [], "size": 0,
+                    "spilled": self.spilled.get(object_hex)}
+        owner, nodes, size = entry
+        live = [n for n in nodes if n in self._resource_views]
+        return {"owner": owner, "nodes": live, "size": size,
+                "spilled": self.spilled.get(object_hex)}
+
+    async def handle_add_spilled_location(self, object_hex: str, path: str):
+        self.spilled[object_hex] = path
+        return True
+
+    async def handle_free_object(self, object_hex: str):
+        entry = self.object_dir.pop(object_hex, None)
+        self.spilled.pop(object_hex, None)
+        if entry is not None:
+            _, nodes, _ = entry
+            for node_id in nodes:
+                rec = self.nodes.get(node_id)
+                if rec and rec.state == "ALIVE":
+                    client = self.clients.get(rec.address)
+                    asyncio.ensure_future(client.call(
+                        "free_objects", object_hexes=[object_hex], timeout=5))
+        return True
+
+    # ------------------------------------------------------------------
+    # task events (state API / timeline backend)
+    # ------------------------------------------------------------------
+
+    async def handle_add_task_events(self, events: List[Dict[str, Any]]):
+        self.task_events.extend(events)
+        if len(self.task_events) > 100_000:
+            del self.task_events[: len(self.task_events) - 100_000]
+        return True
+
+    async def handle_get_task_events(self, job_id: Optional[str] = None,
+                                     limit: int = 10_000):
+        events = self.task_events
+        if job_id:
+            events = [e for e in events if e.get("job_id") == job_id]
+        return events[-limit:]
+
+    # ------------------------------------------------------------------
+    # actors
+    # ------------------------------------------------------------------
+
+    async def handle_register_actor(self, spec: TaskSpec, name: str,
+                                    namespace: str, is_detached: bool,
+                                    get_if_exists: bool = False):
+        if name:
+            existing_id = self.named_actors.get((namespace, name))
+            if existing_id is not None:
+                existing = self.actors.get(existing_id)
+                if existing is not None and existing.state != "DEAD":
+                    if get_if_exists:
+                        return {"actor_id": existing_id, "existing": True}
+                    raise ValueError(
+                        f"actor name {name!r} already taken in namespace "
+                        f"{namespace!r}")
+        actor_id = spec.actor_id
+        record = ActorRecord(
+            actor_id=actor_id, spec=spec, name=name, namespace=namespace,
+            max_restarts=spec.max_restarts, is_detached=is_detached,
+            owner_address=spec.owner_address,
+            placement_group_id=spec.scheduling_strategy.placement_group_id)
+        self.actors[actor_id] = record
+        if name:
+            self.named_actors[(namespace, name)] = actor_id
+        record.sched_epoch += 1
+        asyncio.ensure_future(self._schedule_actor(record))
+        self._persist()
+        return {"actor_id": actor_id, "existing": False}
+
+    async def _schedule_actor(self, record: ActorRecord):
+        """Pick a node, lease a worker there, push the creation task
+        (reference: gcs_actor_scheduler.cc)."""
+        epoch = record.sched_epoch
+        spec = record.spec
+        demand = ResourceSet(spec.resources)
+        strategy = spec.scheduling_strategy
+        deadline = time.monotonic() + 1e9  # actors wait indefinitely
+        backoff = 0.05
+        while record.state not in ("DEAD",) and record.sched_epoch == epoch:
+            async with self.actor_sched_lock:
+                node_id = self._pick_node(demand, strategy,
+                                          spec.label_selector)
+            if node_id is None:
+                await asyncio.sleep(min(backoff, 1.0))
+                backoff *= 1.6
+                if time.monotonic() > deadline:
+                    break
+                continue
+            rec = self.nodes.get(node_id)
+            if rec is None or rec.state == "DEAD":
+                continue
+            raylet = self.clients.get(rec.address)
+            try:
+                reply = await raylet.call(
+                    "request_worker_lease",
+                    spec_meta={
+                        "resources": spec.resources,
+                        "shape_key": spec.shape_key(),
+                        "runtime_env": spec.runtime_env,
+                        "pg": (strategy.placement_group_id,
+                               strategy.bundle_index)
+                        if strategy.kind == "placement_group" else None,
+                        "grant_or_reject": True,
+                    },
+                    timeout=CONFIG.worker_start_timeout_s)
+            except Exception as e:
+                logger.warning("actor lease request to %s failed: %s",
+                               node_id[:12], e)
+                await asyncio.sleep(backoff)
+                backoff *= 1.6
+                continue
+            if reply.get("rejected"):
+                await asyncio.sleep(min(backoff, 1.0))
+                backoff *= 1.6
+                continue
+            worker_addr = tuple(reply["worker_address"])
+            lease_id = reply["lease_id"]
+            if record.sched_epoch != epoch or record.state == "DEAD":
+                # Stale loop: give the worker back and bow out.
+                asyncio.ensure_future(raylet.call(
+                    "return_worker", lease_id=lease_id, dispose=True,
+                    timeout=10))
+                return
+            # Push the creation task directly to the leased worker.
+            try:
+                worker = self.clients.get(worker_addr)
+                result = await worker.call(
+                    "push_task", spec=spec, lease_id=lease_id,
+                    timeout=None)
+            except Exception as e:
+                if record.sched_epoch == epoch:
+                    await self._handle_actor_failure(
+                        record, f"creation task push failed: {e}")
+                return
+            if record.sched_epoch != epoch or record.state == "DEAD":
+                asyncio.ensure_future(raylet.call(
+                    "return_worker", lease_id=lease_id, dispose=True,
+                    timeout=10))
+                return
+            if result.get("error") is not None:
+                record.state = "DEAD"
+                record.death_cause = f"creation failed: {result['error']}"
+                self._publish_actor(record)
+                self._persist()
+                return
+            record.state = "ALIVE"
+            record.address = worker_addr
+            record.node_id = node_id
+            record.worker_id = reply.get("worker_id")
+            self._publish_actor(record)
+            self._persist()
+            return
+
+    def _pick_node(self, demand: ResourceSet, strategy,
+                   label_selector) -> Optional[str]:
+        view = self._resource_views
+        if strategy.kind == "placement_group" and strategy.placement_group_id:
+            pg = self.pgs.get(strategy.placement_group_id)
+            if pg is None or pg.state != "CREATED":
+                return None
+            index = strategy.bundle_index if strategy.bundle_index >= 0 else 0
+            return pg.bundle_nodes[index]
+        if strategy.kind == "node_affinity":
+            return pick_node_affinity(view, demand, strategy.node_id,
+                                      strategy.soft)
+        if strategy.kind == "node_label" or label_selector:
+            selector = dict(strategy.label_selector or {})
+            selector.update(label_selector or {})
+            return pick_node_label(view, demand, selector)
+        if strategy.kind == "SPREAD":
+            self._spread_clock += 1
+            return pick_spread(view, demand, self._spread_clock)
+        head = next((n for n in self.nodes.values() if n.is_head), None)
+        local = head.node_id if head else ""
+        node = pick_hybrid(view, demand, local_node_id=local)
+        return node
+
+    def _publish_actor(self, record: ActorRecord):
+        self.publish("ACTOR", {
+            "actor_id": record.actor_id,
+            "state": record.state,
+            "address": record.address,
+            "node_id": record.node_id,
+            "num_restarts": record.num_restarts,
+            "death_cause": record.death_cause,
+        })
+
+    async def _handle_actor_failure(self, record: ActorRecord, cause: str):
+        if record.state == "DEAD":
+            return
+        unlimited = record.max_restarts == -1
+        if unlimited or record.num_restarts < record.max_restarts:
+            record.num_restarts += 1
+            record.state = "RESTARTING"
+            record.address = None
+            record.node_id = None
+            record.sched_epoch += 1
+            self._publish_actor(record)
+            asyncio.ensure_future(self._schedule_actor(record))
+        else:
+            record.state = "DEAD"
+            record.death_cause = cause
+            self._publish_actor(record)
+            if record.name:
+                self.named_actors.pop((record.namespace, record.name), None)
+        self._persist()
+
+    async def handle_report_actor_failure(self, actor_id: ActorID,
+                                          cause: str):
+        record = self.actors.get(actor_id)
+        if record is not None:
+            await self._handle_actor_failure(record, cause)
+        return True
+
+    async def handle_report_worker_death(self, node_id: str, worker_id: bytes,
+                                         cause: str):
+        """Raylet tells us a worker process died; fail any actor on it."""
+        for record in list(self.actors.values()):
+            if record.worker_id == worker_id and record.state == "ALIVE":
+                await self._handle_actor_failure(record, cause)
+        return True
+
+    async def _kill_actor(self, record: ActorRecord, cause: str,
+                          no_restart: bool):
+        if record.address is not None:
+            try:
+                await self.clients.get(record.address).call(
+                    "kill_actor", actor_id=record.actor_id, timeout=5)
+            except Exception:
+                pass
+        if no_restart:
+            record.max_restarts = record.num_restarts  # exhaust budget
+        await self._handle_actor_failure(record, cause)
+
+    async def handle_kill_actor(self, actor_id: ActorID,
+                                no_restart: bool = True):
+        record = self.actors.get(actor_id)
+        if record is None:
+            return False
+        await self._kill_actor(record, "killed via kill()",
+                               no_restart=no_restart)
+        return True
+
+    async def handle_actor_exited(self, actor_id: ActorID, cause: str = ""):
+        """Graceful exit (__ray_terminate__); never restarted."""
+        record = self.actors.get(actor_id)
+        if record is None:
+            return False
+        record.max_restarts = record.num_restarts
+        await self._handle_actor_failure(record, cause or "actor exited")
+        return True
+
+    async def handle_get_actor_info(self, actor_id: Optional[ActorID] = None,
+                                    name: str = "", namespace: str = ""):
+        if actor_id is None and name:
+            actor_id = self.named_actors.get((namespace, name))
+            if actor_id is None:
+                return None
+        record = self.actors.get(actor_id)
+        if record is None:
+            return None
+        return {
+            "actor_id": record.actor_id, "state": record.state,
+            "address": record.address, "node_id": record.node_id,
+            "name": record.name, "namespace": record.namespace,
+            "num_restarts": record.num_restarts,
+            "death_cause": record.death_cause,
+            "is_detached": record.is_detached,
+            "class_name": record.spec.function.qualname,
+        }
+
+    async def handle_list_named_actors(self, namespace: str = "",
+                                       all_namespaces: bool = False):
+        out = []
+        for (ns, name), actor_id in self.named_actors.items():
+            if all_namespaces or ns == namespace:
+                out.append({"name": name, "namespace": ns})
+        return out
+
+    async def handle_get_all_actors(self):
+        return [await self.handle_get_actor_info(actor_id=a)
+                for a in self.actors]
+
+    # ------------------------------------------------------------------
+    # placement groups (two-phase prepare/commit,
+    # reference: gcs_placement_group_scheduler.h:135-211)
+    # ------------------------------------------------------------------
+
+    async def handle_create_placement_group(
+            self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+            strategy: str, name: str, creator_job: Optional[JobID],
+            is_detached: bool = False):
+        record = PlacementGroupRecord(
+            pg_id=pg_id, bundles=bundles, strategy=strategy, name=name,
+            creator_job=creator_job, is_detached=is_detached,
+            bundle_nodes=[None] * len(bundles))
+        self.pgs[pg_id] = record
+        asyncio.ensure_future(self._schedule_pg(record))
+        self._persist()
+        return True
+
+    async def _schedule_pg(self, record: PlacementGroupRecord):
+        demand = [ResourceSet(b) for b in record.bundles]
+        backoff = 0.05
+        # Rescheduling after a node death: release the surviving nodes'
+        # reservations first, else their capacity leaks (and STRICT
+        # strategies can become permanently infeasible).
+        if any(n is not None for n in record.bundle_nodes):
+            await self._cancel_bundles(record)
+        while record.state in ("PENDING", "RESCHEDULING"):
+            placement = place_bundles(self._resource_views, demand,
+                                      record.strategy)
+            if placement is None:
+                await asyncio.sleep(min(backoff, 1.0))
+                backoff = min(backoff * 1.6, 1.0)
+                continue
+            ok = await self._try_place(record, placement)
+            if ok:
+                record.state = "CREATED"
+                record.bundle_nodes = placement
+                self.publish("PG", {"pg_id": record.pg_id,
+                                    "state": "CREATED",
+                                    "bundle_nodes": placement})
+                self._persist()
+                return
+            await asyncio.sleep(min(backoff, 1.0))
+            backoff = min(backoff * 1.6, 1.0)
+
+    async def _try_place(self, record: PlacementGroupRecord,
+                         placement: List[str]) -> bool:
+        # Phase 1: prepare every bundle on its raylet.
+        prepared: List[Tuple[str, int]] = []
+        for index, node_id in enumerate(placement):
+            rec = self.nodes.get(node_id)
+            if rec is None or rec.state == "DEAD":
+                break
+            try:
+                ok = await self.clients.get(rec.address).call(
+                    "prepare_bundle", pg_id=record.pg_id, bundle_index=index,
+                    resources=record.bundles[index], timeout=10)
+            except Exception:
+                ok = False
+            if not ok:
+                break
+            prepared.append((node_id, index))
+        if len(prepared) != len(placement):
+            # Roll back phase 1.
+            for node_id, index in prepared:
+                rec = self.nodes.get(node_id)
+                if rec and rec.state == "ALIVE":
+                    try:
+                        await self.clients.get(rec.address).call(
+                            "cancel_bundle", pg_id=record.pg_id,
+                            bundle_index=index, timeout=10)
+                    except Exception:
+                        pass
+            return False
+        # Phase 2: commit.
+        for node_id, index in prepared:
+            rec = self.nodes.get(node_id)
+            try:
+                await self.clients.get(rec.address).call(
+                    "commit_bundle", pg_id=record.pg_id, bundle_index=index,
+                    timeout=10)
+            except Exception:
+                logger.warning("pg commit failed on %s", node_id[:12])
+        return True
+
+    async def _cancel_bundles(self, record: PlacementGroupRecord):
+        for index, node_id in enumerate(record.bundle_nodes):
+            if node_id is None:
+                continue
+            rec = self.nodes.get(node_id)
+            if rec and rec.state == "ALIVE":
+                try:
+                    await self.clients.get(rec.address).call(
+                        "cancel_bundle", pg_id=record.pg_id,
+                        bundle_index=index, timeout=10)
+                except Exception:
+                    pass
+        record.bundle_nodes = [None] * len(record.bundles)
+
+    async def handle_remove_placement_group(self, pg_id: PlacementGroupID):
+        record = self.pgs.get(pg_id)
+        if record is None:
+            return False
+        record.state = "REMOVED"
+        # Kill actors scheduled into this group.
+        for actor in list(self.actors.values()):
+            if actor.placement_group_id == pg_id and actor.state != "DEAD":
+                await self._kill_actor(actor, "placement group removed",
+                                       no_restart=True)
+        await self._cancel_bundles(record)
+        self.publish("PG", {"pg_id": pg_id, "state": "REMOVED",
+                            "bundle_nodes": []})
+        self._persist()
+        return True
+
+    async def handle_get_placement_group(self, pg_id: Optional[PlacementGroupID] = None,
+                                         name: str = ""):
+        record = None
+        if pg_id is not None:
+            record = self.pgs.get(pg_id)
+        elif name:
+            record = next((p for p in self.pgs.values() if p.name == name),
+                          None)
+        if record is None:
+            return None
+        return {"pg_id": record.pg_id, "state": record.state,
+                "bundles": record.bundles, "strategy": record.strategy,
+                "bundle_nodes": record.bundle_nodes, "name": record.name}
+
+    async def handle_get_all_placement_groups(self):
+        return [await self.handle_get_placement_group(pg_id=p)
+                for p in self.pgs]
+
+    async def handle_wait_placement_group_ready(self, pg_id: PlacementGroupID,
+                                                timeout_s: float = -1):
+        deadline = None if timeout_s < 0 else time.monotonic() + timeout_s
+        while True:
+            record = self.pgs.get(pg_id)
+            if record is None:
+                raise PlacementGroupError(f"placement group {pg_id} not found")
+            if record.state == "CREATED":
+                return True
+            if record.state == "REMOVED":
+                raise PlacementGroupError(f"placement group {pg_id} removed")
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            await asyncio.sleep(0.01)
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+
+    async def handle_ping(self):
+        return "pong"
+
+    async def handle_get_cluster_view(self):
+        return self.cluster_view_snapshot()

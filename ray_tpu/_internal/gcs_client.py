@@ -1,0 +1,82 @@
+"""Client to the GCS (reference: src/ray/gcs_client/ + GlobalStateAccessor).
+
+Thin async wrappers plus sync bridges for user-thread callers. Subscription
+delivery rides the process's own RpcServer: the GCS pushes `pubsub_message`
+RPCs at us and we fan out to registered callbacks.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .config import CONFIG
+from .rpc import Address, EventLoopThread, RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class GcsClient:
+    def __init__(self, gcs_address: Address,
+                 local_server: Optional[RpcServer] = None):
+        self.address = tuple(gcs_address)
+        self.client = RpcClient(self.address)
+        self._local_server = local_server
+        self._subs_lock = threading.Lock()
+        self._subscriptions: Dict[str, List[Callable]] = {}
+        if local_server is not None:
+            local_server.register("pubsub_message", self._on_pubsub_message)
+
+    # -- async core ------------------------------------------------------
+
+    async def call(self, method: str, **kwargs) -> Any:
+        return await self.client.call(
+            method, retries=CONFIG.rpc_max_retries, **kwargs)
+
+    def call_sync(self, method: str, timeout: Optional[float] = None,
+                  **kwargs) -> Any:
+        return self.client.call_sync(
+            method, timeout=timeout, retries=CONFIG.rpc_max_retries, **kwargs)
+
+    # -- pubsub ----------------------------------------------------------
+
+    async def _on_pubsub_message(self, channel: str, message: Dict[str, Any]):
+        with self._subs_lock:
+            callbacks = list(self._subscriptions.get(channel, ()))
+        for cb in callbacks:
+            try:
+                result = cb(message)
+                if hasattr(result, "__await__"):
+                    await result
+            except Exception:
+                logger.exception("pubsub callback failed on %s", channel)
+        return True
+
+    async def subscribe(self, channel: str, callback: Callable):
+        if self._local_server is None or self._local_server.address is None:
+            raise RuntimeError("subscription requires a local rpc server")
+        with self._subs_lock:
+            first = channel not in self._subscriptions
+            self._subscriptions.setdefault(channel, []).append(callback)
+        if first:
+            await self.call("subscribe", channel=channel,
+                            address=self._local_server.address)
+
+    # -- KV (sync surface used by FunctionManager etc.) -------------------
+
+    def put(self, ns: str, key: str, value: bytes, overwrite: bool = True):
+        return self.call_sync("kv_put", ns=ns, key=key, value=value,
+                              overwrite=overwrite)
+
+    def get(self, ns: str, key: str) -> Optional[bytes]:
+        return self.call_sync("kv_get", ns=ns, key=key)
+
+    def delete(self, ns: str, key: str) -> bool:
+        return self.call_sync("kv_del", ns=ns, key=key)
+
+    def keys(self, ns: str, prefix: str = "") -> List[str]:
+        return self.call_sync("kv_keys", ns=ns, prefix=prefix)
+
+    def exists(self, ns: str, key: str) -> bool:
+        return self.call_sync("kv_exists", ns=ns, key=key)

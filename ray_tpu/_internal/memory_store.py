@@ -1,0 +1,89 @@
+"""In-process memory store for small objects.
+
+Equivalent of the reference CoreWorkerMemoryStore
+(src/ray/core_worker/store_provider/memory_store/): holds inlined task
+results and small `put`s; `get` always consults it before the shared-memory
+store. Values are stored as live Python objects (no serialization round-trip
+on the in-process path). Supports both sync (user thread) and async (io loop)
+waiters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set
+
+from .ids import ObjectID
+
+
+class _Entry:
+    __slots__ = ("value", "is_exception", "in_plasma")
+
+    def __init__(self, value: Any, is_exception: bool = False,
+                 in_plasma: bool = False):
+        self.value = value
+        self.is_exception = is_exception
+        # Marker entry: the real value lives in the shared-memory store.
+        self.in_plasma = in_plasma
+
+
+class MemoryStore:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._objects: Dict[ObjectID, _Entry] = {}
+        self._async_waiters: Dict[ObjectID, List] = {}
+
+    def put(self, object_id: ObjectID, value: Any, is_exception: bool = False,
+            in_plasma: bool = False):
+        with self._lock:
+            self._objects[object_id] = _Entry(value, is_exception, in_plasma)
+            self._lock.notify_all()
+            waiters = self._async_waiters.pop(object_id, [])
+        for loop, fut in waiters:
+            loop.call_soon_threadsafe(
+                lambda f=fut: f.set_result(True) if not f.done() else None)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get_entry(self, object_id: ObjectID) -> Optional[_Entry]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def wait_ready(self, object_ids: List[ObjectID], num_returns: int,
+                   timeout: Optional[float]) -> Set[ObjectID]:
+        """Block until `num_returns` of `object_ids` are present (or timeout).
+        Returns the ready subset."""
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = {o for o in object_ids if o in self._objects}
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return ready
+                self._lock.wait(remaining if remaining is not None else 1.0)
+
+    async def wait_ready_async(self, object_id: ObjectID):
+        import asyncio
+        loop = asyncio.get_running_loop()
+        with self._lock:
+            if object_id in self._objects:
+                return
+            fut = loop.create_future()
+            self._async_waiters.setdefault(object_id, []).append((loop, fut))
+        await fut
+
+    def delete(self, object_ids: List[ObjectID]):
+        with self._lock:
+            for object_id in object_ids:
+                self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

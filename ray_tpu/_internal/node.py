@@ -1,0 +1,102 @@
+"""Node bring-up (reference: python/ray/_private/node.py + services.py).
+
+A head node = GCS + raylet; a worker node = raylet only. In local mode both
+run on the driver process's io loop (cheap, shares the in-process RPC fast
+path); `cluster_utils.Cluster.add_node` runs additional raylets as
+subprocesses for real multi-node semantics.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Dict, Optional, Tuple
+
+from .config import CONFIG
+from .gcs import GcsServer
+from .raylet import Raylet
+from .rpc import Address, EventLoopThread
+
+
+def new_session_name() -> str:
+    return f"{int(time.time())}-{uuid.uuid4().hex[:8]}"
+
+
+def default_resources(num_cpus: Optional[float] = None,
+                      num_tpus: Optional[float] = None) -> Dict[str, float]:
+    resources: Dict[str, float] = {}
+    resources["CPU"] = num_cpus if num_cpus is not None \
+        else float(os.cpu_count() or 1)
+    if num_tpus is None:
+        from ..accelerators import tpu as tpu_accel
+        num_tpus = tpu_accel.autodetect_num_chips()
+    if num_tpus:
+        resources["TPU"] = num_tpus
+    return resources
+
+
+class Node:
+    """One node's processes. Head nodes own the GCS."""
+
+    def __init__(self, head: bool, session_name: Optional[str] = None,
+                 gcs_address: Optional[Address] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 node_index: int = 0,
+                 object_store_memory: Optional[int] = None,
+                 gcs_persist_path: Optional[str] = None):
+        self.head = head
+        self.session_name = session_name or new_session_name()
+        self.node_index = node_index
+        self.resources = resources or default_resources()
+        self.labels = labels or {}
+        self.gcs: Optional[GcsServer] = None
+        self.gcs_address = gcs_address
+        self.raylet: Optional[Raylet] = None
+        self.object_store_memory = object_store_memory
+        self.gcs_persist_path = gcs_persist_path
+        self.session_dir = os.path.join("/tmp", "rtpu",
+                                        f"session_{self.session_name}")
+        os.makedirs(self.session_dir, exist_ok=True)
+
+    def start(self):
+        loop = EventLoopThread.get()
+        if self.head:
+            self.gcs = GcsServer(self.session_name,
+                                 persist_path=self.gcs_persist_path)
+            self.gcs_address = loop.run_sync(self.gcs.start())
+        assert self.gcs_address is not None
+        self.raylet = Raylet(
+            session_name=self.session_name,
+            gcs_address=self.gcs_address,
+            resources=self.resources,
+            labels=self.labels,
+            node_index=self.node_index,
+            is_head=self.head,
+            object_store_memory=self.object_store_memory,
+            spill_dir=os.path.join(self.session_dir,
+                                   f"spill-{self.node_index}"))
+        loop.run_sync(self.raylet.start())
+        return self
+
+    def stop(self):
+        loop = EventLoopThread.get()
+        if self.raylet is not None:
+            try:
+                loop.run_sync(self.raylet.stop(), timeout=10)
+            except Exception:
+                pass
+        if self.gcs is not None:
+            try:
+                loop.run_sync(self.gcs.stop(), timeout=10)
+            except Exception:
+                pass
+
+    @property
+    def node_id(self) -> str:
+        return self.raylet.node_id
+
+    @property
+    def raylet_address(self) -> Address:
+        return self.raylet.address

@@ -1,0 +1,93 @@
+"""ObjectRef: a first-class future/handle to an object in the cluster.
+
+Mirrors the reference's ObjectRef semantics (python/ray/includes/object_ref):
+- created by task submission (`f.remote()`), `put()`, or deserialization
+- deleting the last reference releases the object (owner-side refcount;
+  deserialized copies are *borrows* that decref back to the owner)
+- awaitable: `await ref` resolves to the value inside async actors/drivers
+- pickleable only through the framework serializer, which records the ref for
+  borrower accounting (reference: "contained object ids").
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from . import serialization
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_address", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID,
+                 owner_address: Optional[Tuple[str, int]] = None,
+                 _register: bool = True):
+        self._id = object_id
+        self._owner_address = tuple(owner_address) if owner_address else None
+        self._registered = False
+        if _register:
+            from . import core_worker as cw
+            worker = cw.try_get_core_worker()
+            if worker is not None:
+                worker.reference_counter.add_local_ref(self)
+                self._registered = True
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def owner_address(self) -> Optional[Tuple[str, int]]:
+        return self._owner_address
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()[:16]})"
+
+    def __del__(self):
+        if self._registered:
+            try:
+                from . import core_worker as cw
+                worker = cw.try_get_core_worker()
+                if worker is not None:
+                    worker.reference_counter.remove_local_ref(self)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        ctx = serialization.get_context()
+        if ctx is not None:
+            ctx.contained_refs.append(self)
+        return (_rebuild_ref, (self._id, self._owner_address))
+
+    def future(self):
+        """concurrent.futures.Future resolving to the value."""
+        from . import core_worker as cw
+        return cw.get_core_worker().get_async(self)
+
+    def __await__(self):
+        import asyncio
+        from . import core_worker as cw
+        fut = cw.get_core_worker().get_async(self)
+        return asyncio.wrap_future(fut).__await__()
+
+
+def _rebuild_ref(object_id: ObjectID, owner_address):
+    ref = ObjectRef(object_id, owner_address, _register=True)
+    # A deserialized ref is a borrow: tell the owner (async, best-effort; the
+    # in-flight task / containing object pins the window).
+    from . import core_worker as cw
+    worker = cw.try_get_core_worker()
+    if worker is not None:
+        worker.reference_counter.on_ref_deserialized(ref)
+    return ref

@@ -1,0 +1,88 @@
+"""Option validation for tasks and actors.
+
+Mirrors the reference's option surface (python/ray/_common/ray_option_utils.py)
+— the full knob set users of the reference expect, normalized into TaskSpec
+fields. TPU-first addition: `num_tpus` is first-class alongside `num_cpus`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..util.scheduling_strategies import (NodeAffinitySchedulingStrategy,
+                                          NodeLabelSchedulingStrategy,
+                                          PlacementGroupSchedulingStrategy)
+from .task_spec import SchedulingStrategy
+
+_COMMON_OPTIONS = {
+    "num_cpus", "num_gpus", "num_tpus", "resources", "memory",
+    "scheduling_strategy", "label_selector", "runtime_env", "name",
+    "enable_task_events", "num_returns", "accelerator_type",
+    "object_store_memory",
+}
+_TASK_OPTIONS = _COMMON_OPTIONS | {
+    "max_retries", "retry_exceptions", "max_calls",
+}
+_ACTOR_OPTIONS = _COMMON_OPTIONS | {
+    "max_restarts", "max_task_retries", "max_concurrency",
+    "concurrency_groups", "namespace", "lifetime", "get_if_exists",
+    "max_pending_calls",
+}
+
+
+def validate_options(options: Dict[str, Any], for_actor: bool):
+    allowed = _ACTOR_OPTIONS if for_actor else _TASK_OPTIONS
+    for key in options:
+        if key not in allowed:
+            kind = "actor" if for_actor else "task"
+            raise ValueError(f"invalid option {key!r} for a {kind}")
+    num_returns = options.get("num_returns")
+    if num_returns is not None and not (
+            isinstance(num_returns, int) and num_returns >= 0):
+        if num_returns in ("dynamic", "streaming"):
+            raise NotImplementedError(
+                "num_returns='dynamic'/'streaming' (generator tasks) is not "
+                "supported yet; return a list and index it instead")
+        raise ValueError("num_returns must be a non-negative int")
+    lifetime = options.get("lifetime")
+    if lifetime not in (None, "detached", "non_detached"):
+        raise ValueError("lifetime must be None, 'detached' or 'non_detached'")
+
+
+def resources_from_options(options: Dict[str, Any],
+                           default_num_cpus: float) -> Dict[str, float]:
+    resources = dict(options.get("resources") or {})
+    if "CPU" in resources or "TPU" in resources or "GPU" in resources:
+        raise ValueError(
+            "pass CPU/GPU/TPU via num_cpus/num_gpus/num_tpus, not resources=")
+    num_cpus = options.get("num_cpus")
+    resources["CPU"] = default_num_cpus if num_cpus is None else num_cpus
+    if options.get("num_tpus"):
+        resources["TPU"] = options["num_tpus"]
+    if options.get("num_gpus"):
+        resources["GPU"] = options["num_gpus"]
+    if options.get("memory"):
+        resources["memory"] = options["memory"]
+    return {k: v for k, v in resources.items() if v}
+
+
+def normalize_strategy(strategy: Any) -> SchedulingStrategy:
+    if strategy is None or strategy == "DEFAULT":
+        return SchedulingStrategy(kind="DEFAULT")
+    if strategy == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        return SchedulingStrategy(
+            kind="placement_group",
+            placement_group_id=pg.id,
+            bundle_index=strategy.placement_group_bundle_index,
+            capture_child_tasks=strategy.placement_group_capture_child_tasks)
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(kind="node_affinity",
+                                  node_id=strategy.node_id,
+                                  soft=strategy.soft)
+    if isinstance(strategy, NodeLabelSchedulingStrategy):
+        return SchedulingStrategy(kind="node_label",
+                                  label_selector=dict(strategy.hard))
+    raise ValueError(f"unsupported scheduling strategy: {strategy!r}")

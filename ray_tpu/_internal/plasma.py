@@ -1,0 +1,159 @@
+"""Shared-memory object store (plasma equivalent).
+
+Role of the reference's plasma store (src/ray/object_manager/plasma/):
+node-local shared memory holding large immutable objects, zero-copy readable
+by every worker on the node, with LRU eviction and spill-to-disk overflow.
+
+TPU-first design decisions (vs the reference's single store daemon owning one
+dlmalloc arena with fd-passing over a unix socket):
+
+- Objects are individual files in a per-session tmpfs directory
+  (`/dev/shm/rtpu-<session>/`). The *producer* maps and writes the object
+  directly — creation never crosses a process boundary; only the cheap `seal`
+  notification goes to the raylet. Readers `mmap` the file read-only; numpy /
+  jax host arrays deserialize as views over the mapping (pickle-5 out-of-band
+  buffers), so `get` of a 100 GiB array is O(pages touched), not O(copy).
+- Eviction unlinks the file. Linux keeps the pages alive for processes that
+  still hold the mapping, which gives us plasma's "evicted while borrowed is
+  safe" behavior without refcounted fd passing.
+- The raylet owns accounting (capacity, LRU clock, pin counts, spill) in
+  `LocalObjectManager`; this module is just the mechanical shm layer that any
+  process can use.
+
+An optional C++ arena allocator (native/) can back small-object slabs; files
+are the general path.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import shutil
+import threading
+from typing import Dict, Optional
+
+from .ids import ObjectID
+from . import serialization
+
+
+def shm_root() -> str:
+    return "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+
+
+class PlasmaDir:
+    """Mechanical access to one node's object directory in shm."""
+
+    def __init__(self, session_name: str, node_index: int = 0):
+        self.path = os.path.join(shm_root(), f"rtpu-{session_name}-{node_index}")
+        os.makedirs(self.path, exist_ok=True)
+        self._lock = threading.Lock()
+        # Keep created-but-unsealed mmaps so the producer can write then seal.
+        self._creating: Dict[ObjectID, mmap.mmap] = {}
+
+    def _file(self, object_id: ObjectID) -> str:
+        return os.path.join(self.path, object_id.hex())
+
+    # -- producer path ----------------------------------------------------
+
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        path = self._file(object_id) + ".tmp"
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            m = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        with self._lock:
+            self._creating[object_id] = m
+        return memoryview(m)
+
+    def seal(self, object_id: ObjectID) -> int:
+        """Make the object visible to readers; returns its size."""
+        with self._lock:
+            m = self._creating.pop(object_id, None)
+        path = self._file(object_id)
+        os.rename(path + ".tmp", path)
+        size = os.path.getsize(path)
+        if m is not None:
+            m.close()
+        return size
+
+    def put_serialized(self, object_id: ObjectID,
+                       obj: serialization.SerializedObject) -> int:
+        buf = self.create(object_id, obj.total_bytes())
+        obj.write_into(buf)
+        buf.release()
+        return self.seal(object_id)
+
+    def abort(self, object_id: ObjectID):
+        with self._lock:
+            m = self._creating.pop(object_id, None)
+        if m is not None:
+            m.close()
+        try:
+            os.unlink(self._file(object_id) + ".tmp")
+        except FileNotFoundError:
+            pass
+
+    # -- reader path ------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return os.path.exists(self._file(object_id))
+
+    def map_read(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Zero-copy read-only view; None if absent."""
+        try:
+            fd = os.open(self._file(object_id), os.O_RDONLY)
+        except FileNotFoundError:
+            return None
+        try:
+            size = os.fstat(fd).st_size
+            m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        return memoryview(m)
+
+    def get(self, object_id: ObjectID):
+        view = self.map_read(object_id)
+        if view is None:
+            return None, False
+        return serialization.deserialize_from_buffer(view), True
+
+    def read_bytes(self, object_id: ObjectID) -> Optional[bytes]:
+        view = self.map_read(object_id)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            view.release()
+
+    def write_bytes(self, object_id: ObjectID, data: bytes) -> int:
+        buf = self.create(object_id, len(data))
+        buf[:] = data
+        buf.release()
+        return self.seal(object_id)
+
+    # -- management (raylet-only) ----------------------------------------
+
+    def delete(self, object_id: ObjectID):
+        try:
+            os.unlink(self._file(object_id))
+        except FileNotFoundError:
+            pass
+
+    def size_of(self, object_id: ObjectID) -> int:
+        return os.path.getsize(self._file(object_id))
+
+    def spill_to(self, object_id: ObjectID, spill_dir: str) -> str:
+        """Move object to disk; returns the spilled path."""
+        os.makedirs(spill_dir, exist_ok=True)
+        dest = os.path.join(spill_dir, object_id.hex())
+        shutil.move(self._file(object_id), dest)
+        return dest
+
+    def restore_from(self, object_id: ObjectID, spilled_path: str):
+        shutil.move(spilled_path, self._file(object_id))
+
+    def destroy(self):
+        shutil.rmtree(self.path, ignore_errors=True)

@@ -1,0 +1,631 @@
+"""Raylet: the per-node scheduling and data plane.
+
+Equivalent of the reference raylet (src/ray/raylet/): worker-lease
+scheduling with spillback, a worker pool of language workers, placement-group
+bundle accounting with two-phase prepare/commit, the local object manager
+(eviction, spill/restore, remote pulls via chunked transfer — the role of
+plasma's PullManager/PushManager over object_manager.proto), node heartbeats
+carrying the resource view, and worker liveness supervision.
+
+One raylet per node. In local mode it runs inside the driver process on the
+shared io loop; `cluster_utils.Cluster.add_node` runs additional raylets as
+subprocesses for multi-node semantics on one machine (reference:
+python/ray/cluster_utils.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .config import CONFIG
+from .ids import NodeID, ObjectID, PlacementGroupID, WorkerID
+from .memory_store import MemoryStore
+from .plasma import PlasmaDir
+from .resources import NodeResources, ResourceSet
+from .rpc import Address, ClientPool, RpcServer
+from .scheduling_policy import NodeView
+from . import scheduling_policy
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_INTERVAL_S = 0.2
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: bytes
+    address: Optional[Address] = None
+    pid: int = 0
+    proc: Optional[subprocess.Popen] = None
+    state: str = "STARTING"         # STARTING | IDLE | LEASED | DEAD
+    env_key: Tuple = ()
+    lease_id: Optional[int] = None
+    registered: Optional[asyncio.Future] = None
+    last_idle: float = 0.0
+    is_actor_worker: bool = False
+
+
+@dataclass
+class LeaseRequest:
+    lease_id: int
+    demand: ResourceSet
+    spec_meta: Dict[str, Any]
+    future: asyncio.Future = None
+    pg: Optional[Tuple[PlacementGroupID, int]] = None
+
+
+@dataclass
+class BundleAccount:
+    resources: ResourceSet
+    available: ResourceSet
+    committed: bool = False
+
+
+@dataclass
+class ObjectEntry:
+    size: int
+    last_access: float
+    pinned: int = 0
+    spilled_path: Optional[str] = None
+
+
+class Raylet:
+    def __init__(self, session_name: str, gcs_address: Address,
+                 resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None,
+                 node_index: int = 0, is_head: bool = False,
+                 object_store_memory: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        self.session_name = session_name
+        self.node_id = NodeID.from_random().hex()
+        self.gcs_address = tuple(gcs_address)
+        self.is_head = is_head
+        self.node_index = node_index
+        self.labels = dict(labels or {})
+        self.resources = NodeResources(ResourceSet(resources), self.labels)
+        self.server = RpcServer(f"raylet-{node_index}")
+        self.clients = ClientPool()
+        self.address: Optional[Address] = None
+        self.plasma = PlasmaDir(session_name, node_index)
+        self.capacity = object_store_memory or CONFIG.object_store_memory_bytes
+        self.spill_dir = spill_dir or os.path.join(
+            "/tmp", f"rtpu-spill-{session_name}-{node_index}")
+
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.queued: List[LeaseRequest] = []
+        self.leases: Dict[int, Tuple[bytes, ResourceSet,
+                                     Optional[Tuple[PlacementGroupID, int]]]] = {}
+        self.bundles: Dict[Tuple[PlacementGroupID, int], BundleAccount] = {}
+        self.objects: Dict[str, ObjectEntry] = {}
+        self.store_used = 0
+        self.cluster_view: Dict[str, NodeView] = {}
+        self.node_addresses: Dict[str, Address] = {}
+        self._next_lease_id = 0
+        self._tasks: List[asyncio.Task] = []
+        self._pulls: Dict[str, asyncio.Future] = {}
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self.server.register_instance(self)
+        self.address = await self.server.start(host, port)
+        gcs = self.clients.get(self.gcs_address)
+        await gcs.call("register_node", node_id=self.node_id,
+                       address=self.address,
+                       resources=self.resources.total.to_dict(),
+                       labels=self.labels, is_head=self.is_head,
+                       retries=CONFIG.rpc_max_retries)
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._worker_liveness_loop()))
+        return self.address
+
+    async def stop(self):
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for handle in list(self.workers.values()):
+            self._kill_worker(handle)
+        await self.server.stop()
+        self.plasma.destroy()
+
+    # ------------------------------------------------------------------
+    # heartbeats / cluster view
+    # ------------------------------------------------------------------
+
+    async def _heartbeat_loop(self):
+        gcs = self.clients.get(self.gcs_address)
+        while not self._stopped:
+            try:
+                reply = await gcs.call(
+                    "heartbeat", node_id=self.node_id,
+                    resources_available=self.resources.available.to_dict(),
+                    resources_total=self.resources.total.to_dict(),
+                    timeout=CONFIG.health_check_timeout_s)
+                if reply.get("dead"):
+                    logger.warning("raylet %s marked dead by gcs; exiting",
+                                   self.node_id[:12])
+                    return
+                self._update_view(reply.get("view", {}))
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass
+            await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+
+    def _update_view(self, snapshot: Dict[str, Dict[str, Any]]):
+        view = {}
+        for nid, info in snapshot.items():
+            nr = NodeResources(ResourceSet(info["total"]), info["labels"])
+            nr.available = ResourceSet(info["available"])
+            view[nid] = NodeView(nid, nr)
+            self.node_addresses[nid] = tuple(info["address"])
+        self.cluster_view = view
+
+    # ------------------------------------------------------------------
+    # worker pool (reference: src/ray/raylet/worker_pool.cc)
+    # ------------------------------------------------------------------
+
+    def _env_key(self, runtime_env: Dict[str, Any]) -> Tuple:
+        return tuple(sorted((runtime_env or {}).get("env_vars", {}).items()))
+
+    def _spawn_worker(self, env_key: Tuple) -> WorkerHandle:
+        worker_id = WorkerID.from_random().binary()
+        env = dict(os.environ)
+        env.update({k: v for k, v in env_key})
+        env.update({
+            "RTPU_WORKER_ID": worker_id.hex(),
+            "RTPU_SESSION": self.session_name,
+            "RTPU_NODE_ID": self.node_id,
+            "RTPU_NODE_INDEX": str(self.node_index),
+            "RTPU_RAYLET_ADDR": f"{self.address[0]}:{self.address[1]}",
+            "RTPU_GCS_ADDR": f"{self.gcs_address[0]}:{self.gcs_address[1]}",
+        })
+        # Workers must not inherit the driver's TPU chip lock unless the
+        # lease assigns chips (set later via runtime env / accelerator hook).
+        env.setdefault("JAX_PLATFORMS", env.get("RTPU_WORKER_JAX_PLATFORMS",
+                                                "cpu"))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._internal.worker_main"],
+            env=env, stdout=subprocess.DEVNULL if not CONFIG.log_to_driver
+            else None, stderr=None)
+        handle = WorkerHandle(
+            worker_id=worker_id, proc=proc, pid=proc.pid, env_key=env_key,
+            registered=asyncio.get_running_loop().create_future())
+        self.workers[worker_id] = handle
+        return handle
+
+    async def handle_register_worker(self, worker_id: bytes, address: Address,
+                                     pid: int):
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            # Worker from a previous epoch; tell it to exit.
+            return {"exit": True}
+        handle.address = tuple(address)
+        handle.pid = pid
+        handle.state = "IDLE"
+        handle.last_idle = time.monotonic()
+        if handle.registered and not handle.registered.done():
+            handle.registered.set_result(True)
+        return {"exit": False, "node_id": self.node_id,
+                "node_index": self.node_index}
+
+    async def _worker_liveness_loop(self):
+        while not self._stopped:
+            try:
+                await asyncio.sleep(CONFIG.worker_liveness_check_period_s)
+                now = time.monotonic()
+                for handle in list(self.workers.values()):
+                    if handle.proc is not None and handle.proc.poll() is not None \
+                            and handle.state != "DEAD":
+                        await self._on_worker_death(handle)
+                    elif (handle.state == "IDLE" and not handle.is_actor_worker
+                          and now - handle.last_idle >
+                          CONFIG.worker_idle_timeout_s):
+                        self._kill_worker(handle)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("worker liveness loop error")
+
+    async def _on_worker_death(self, handle: WorkerHandle):
+        logger.warning("worker %s (pid %s) died unexpectedly",
+                       handle.worker_id.hex()[:12], handle.pid)
+        handle.state = "DEAD"
+        self.workers.pop(handle.worker_id, None)
+        if handle.lease_id is not None:
+            self._release_lease(handle.lease_id)
+        try:
+            await self.clients.get(self.gcs_address).call(
+                "report_worker_death", node_id=self.node_id,
+                worker_id=handle.worker_id, cause="worker process died",
+                timeout=10)
+        except Exception:
+            pass
+
+    def _kill_worker(self, handle: WorkerHandle):
+        handle.state = "DEAD"
+        self.workers.pop(handle.worker_id, None)
+        if handle.proc is not None:
+            try:
+                handle.proc.terminate()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # leases (reference: node_manager.cc HandleRequestWorkerLease +
+    # local_lease_manager.cc + cluster_lease_manager spillback)
+    # ------------------------------------------------------------------
+
+    async def handle_request_worker_lease(self, spec_meta: Dict[str, Any]):
+        self._next_lease_id += 1
+        req = LeaseRequest(
+            lease_id=self._next_lease_id,
+            demand=ResourceSet(spec_meta.get("resources", {})),
+            spec_meta=spec_meta,
+            future=asyncio.get_running_loop().create_future(),
+            pg=spec_meta.get("pg"))
+        grant = self._try_grant(req)
+        if grant is not None:
+            return await grant
+        if spec_meta.get("grant_or_reject"):
+            return {"rejected": True}
+        # Spillback: is some other node better placed right now?
+        spill = self._pick_spillback(req)
+        if spill is not None:
+            return {"spillback_to": spill}
+        self.queued.append(req)
+        return await req.future
+
+    def _pick_spillback(self, req: LeaseRequest) -> Optional[Tuple[str, Address]]:
+        if req.pg is not None:
+            return None  # PG leases are node-pinned by the bundle
+        selector = req.spec_meta.get("label_selector") or None
+        target = scheduling_policy.pick_hybrid(
+            self.cluster_view, req.demand, local_node_id=self.node_id,
+            label_selector=selector)
+        if target is not None and target != self.node_id:
+            view = self.cluster_view.get(target)
+            if view is not None and view.available(req.demand):
+                addr = self.node_addresses.get(target)
+                if addr is not None:
+                    return (target, addr)
+        return None
+
+    def _try_grant(self, req: LeaseRequest):
+        """Attempt to allocate resources + a worker; returns awaitable reply
+        or None if resources unavailable."""
+        if req.pg is not None:
+            pg_id, index = req.pg
+            if index >= 0:
+                key = (pg_id, index)
+                account = self.bundles.get(key)
+            else:
+                # wildcard bundle index: any committed bundle of this pg
+                key, account = next(
+                    ((k, a) for k, a in self.bundles.items()
+                     if k[0] == pg_id and a.committed
+                     and req.demand.fits(a.available)), (None, None))
+            if account is None or not account.committed \
+                    or not req.demand.fits(account.available):
+                return None
+            account.available = account.available - req.demand
+            req.pg = key  # resolved bundle; release refunds exactly this one
+            charge_node = False
+        else:
+            if not self.resources.try_allocate(req.demand):
+                return None
+            charge_node = True
+        return self._finish_grant(req, charge_node)
+
+    def _refund(self, demand: ResourceSet,
+                pg_key: Optional[Tuple[PlacementGroupID, int]]):
+        if pg_key is not None:
+            account = self.bundles.get(pg_key)
+            if account is not None:
+                account.available = account.available + demand
+        else:
+            self.resources.release(demand)
+
+    async def _finish_grant(self, req: LeaseRequest, charge_node: bool):
+        env_key = self._env_key(req.spec_meta.get("runtime_env", {}))
+        handle = next(
+            (w for w in self.workers.values()
+             if w.state == "IDLE" and w.env_key == env_key), None)
+        if handle is None:
+            handle = self._spawn_worker(env_key)
+            try:
+                await asyncio.wait_for(handle.registered,
+                                       CONFIG.worker_start_timeout_s)
+            except asyncio.TimeoutError:
+                self._kill_worker(handle)
+                self._refund(req.demand, None if charge_node else req.pg)
+                return {"rejected": True,
+                        "error": "worker failed to start in time"}
+        handle.state = "LEASED"
+        handle.lease_id = req.lease_id
+        handle.is_actor_worker = bool(req.spec_meta.get("is_actor"))
+        self.leases[req.lease_id] = (
+            handle.worker_id, req.demand, None if charge_node else req.pg)
+        return {"rejected": False, "lease_id": req.lease_id,
+                "worker_address": handle.address,
+                "worker_id": handle.worker_id, "node_id": self.node_id}
+
+    def _release_lease(self, lease_id: int):
+        entry = self.leases.pop(lease_id, None)
+        if entry is None:
+            return
+        worker_id, demand, pg = entry
+        if not demand.is_empty() or pg is not None:
+            self._refund(demand, pg)
+        handle = self.workers.get(worker_id)
+        if handle is not None and handle.state == "LEASED":
+            handle.state = "IDLE"
+            handle.lease_id = None
+            handle.last_idle = time.monotonic()
+        self._pump_queue()
+
+    def _pump_queue(self):
+        still_queued = []
+        for req in self.queued:
+            grant = self._try_grant(req)
+            if grant is None:
+                still_queued.append(req)
+            else:
+                async def _complete(req=req, grant=grant):
+                    reply = await grant
+                    if not req.future.done():
+                        req.future.set_result(reply)
+                asyncio.ensure_future(_complete())
+        self.queued = still_queued
+
+    async def handle_return_worker(self, lease_id: int,
+                                   dispose: bool = False):
+        entry = self.leases.get(lease_id)
+        if entry and dispose:
+            handle = self.workers.get(entry[0])
+            if handle is not None:
+                self._kill_worker(handle)
+        self._release_lease(lease_id)
+        return True
+
+    async def handle_cancel_lease(self, lease_id: int):
+        for req in list(self.queued):
+            if req.lease_id == lease_id and not req.future.done():
+                req.future.set_result({"rejected": True, "canceled": True})
+                self.queued.remove(req)
+        return True
+
+    # ------------------------------------------------------------------
+    # placement group bundles (two-phase commit, raylet side)
+    # ------------------------------------------------------------------
+
+    async def handle_prepare_bundle(self, pg_id: PlacementGroupID,
+                                    bundle_index: int,
+                                    resources: Dict[str, float]):
+        demand = ResourceSet(resources)
+        key = (pg_id, bundle_index)
+        if key in self.bundles:
+            return True
+        if not self.resources.try_allocate(demand):
+            return False
+        self.bundles[key] = BundleAccount(resources=demand, available=demand)
+        return True
+
+    async def handle_commit_bundle(self, pg_id: PlacementGroupID,
+                                   bundle_index: int):
+        account = self.bundles.get((pg_id, bundle_index))
+        if account is None:
+            return False
+        account.committed = True
+        self._pump_queue()
+        return True
+
+    async def handle_cancel_bundle(self, pg_id: PlacementGroupID,
+                                   bundle_index: int):
+        account = self.bundles.pop((pg_id, bundle_index), None)
+        if account is not None:
+            self.resources.release(account.resources)
+            self._pump_queue()
+        return True
+
+    # ------------------------------------------------------------------
+    # local object manager (reference: local_object_manager.cc + plasma
+    # eviction + pull/push managers)
+    # ------------------------------------------------------------------
+
+    async def handle_seal_object(self, object_hex: str, size: int,
+                                 owner_address: Optional[Address]):
+        self.objects[object_hex] = ObjectEntry(size=size,
+                                               last_access=time.monotonic())
+        self.store_used += size
+        gcs = self.clients.get(self.gcs_address)
+        asyncio.ensure_future(gcs.call(
+            "add_object_location", object_hex=object_hex,
+            node_id=self.node_id, size=size, owner_address=owner_address,
+            timeout=10))
+        if self.store_used > self.capacity * CONFIG.object_spilling_threshold:
+            asyncio.ensure_future(self._evict_until_under())
+        return True
+
+    async def _evict_until_under(self):
+        target = self.capacity * CONFIG.object_spilling_threshold * 0.8
+        victims = sorted(
+            ((h, e) for h, e in self.objects.items() if e.pinned == 0),
+            key=lambda kv: kv[1].last_access)
+        gcs = self.clients.get(self.gcs_address)
+        for object_hex, entry in victims:
+            if self.store_used <= target:
+                break
+            try:
+                oid = ObjectID.from_hex(object_hex)
+                path = self.plasma.spill_to(oid, self.spill_dir)
+                entry.spilled_path = path
+                self.store_used -= entry.size
+                del self.objects[object_hex]
+                await gcs.call("add_spilled_location",
+                               object_hex=object_hex, path=path, timeout=10)
+                await gcs.call("remove_object_location",
+                               object_hex=object_hex, node_id=self.node_id,
+                               timeout=10)
+            except FileNotFoundError:
+                self.objects.pop(object_hex, None)
+            except Exception:
+                logger.exception("spill of %s failed", object_hex[:12])
+
+    async def handle_pull_object(self, object_hex: str):
+        """Ensure the object is locally readable; used by workers on get()."""
+        oid = ObjectID.from_hex(object_hex)
+        entry = self.objects.get(object_hex)
+        if entry is not None:
+            entry.last_access = time.monotonic()
+            return {"ok": True}
+        # Deduplicate concurrent pulls.
+        pending = self._pulls.get(object_hex)
+        if pending is not None:
+            return await pending
+        fut = asyncio.get_running_loop().create_future()
+        self._pulls[object_hex] = fut
+        try:
+            result = await self._pull_object(oid, object_hex)
+            if not fut.done():
+                fut.set_result(result)
+            return result
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+            raise
+        finally:
+            self._pulls.pop(object_hex, None)
+
+    async def _pull_object(self, oid: ObjectID, object_hex: str):
+        gcs = self.clients.get(self.gcs_address)
+        info = await gcs.call("get_object_locations", object_hex=object_hex,
+                              timeout=10)
+        spilled = info.get("spilled")
+        if spilled and os.path.exists(spilled):
+            self.plasma.restore_from(oid, spilled)
+            size = self.plasma.size_of(oid)
+            self.objects[object_hex] = ObjectEntry(
+                size=size, last_access=time.monotonic())
+            self.store_used += size
+            await gcs.call("add_object_location", object_hex=object_hex,
+                           node_id=self.node_id, size=info.get("size", size),
+                           owner_address=info.get("owner"), timeout=10)
+            return {"ok": True}
+        for node_id in info.get("nodes", []):
+            if node_id == self.node_id:
+                if self.plasma.contains(oid):
+                    size = self.plasma.size_of(oid)
+                    self.objects[object_hex] = ObjectEntry(
+                        size=size, last_access=time.monotonic())
+                    self.store_used += size
+                    return {"ok": True}
+                continue
+            addr = self.node_addresses.get(node_id)
+            if addr is None:
+                nodes = await gcs.call("get_all_nodes", timeout=10)
+                for n in nodes:
+                    self.node_addresses[n["node_id"]] = tuple(n["address"])
+                addr = self.node_addresses.get(node_id)
+            if addr is None:
+                continue
+            try:
+                await self._fetch_from(addr, oid, object_hex)
+                return {"ok": True}
+            except Exception as e:
+                logger.warning("pull of %s from %s failed: %s",
+                               object_hex[:12], node_id[:12], e)
+        return {"ok": False, "error": "no reachable copy"}
+
+    async def _fetch_from(self, addr: Address, oid: ObjectID,
+                          object_hex: str):
+        peer = self.clients.get(addr)
+        meta = await peer.call("object_info", object_hex=object_hex,
+                               timeout=30)
+        size = meta["size"]
+        chunk = CONFIG.object_store_chunk_bytes
+        buf = self.plasma.create(oid, size)
+        try:
+            offset = 0
+            while offset < size:
+                n = min(chunk, size - offset)
+                data = await peer.call("fetch_chunk", object_hex=object_hex,
+                                       offset=offset, length=n, timeout=60)
+                buf[offset:offset + len(data)] = data
+                offset += len(data)
+        except Exception:
+            buf.release()
+            self.plasma.abort(oid)
+            raise
+        buf.release()
+        self.plasma.seal(oid)
+        self.objects[object_hex] = ObjectEntry(size=size,
+                                               last_access=time.monotonic())
+        self.store_used += size
+        gcs = self.clients.get(self.gcs_address)
+        await gcs.call("add_object_location", object_hex=object_hex,
+                       node_id=self.node_id, size=size,
+                       owner_address=None, timeout=10)
+
+    async def handle_object_info(self, object_hex: str):
+        oid = ObjectID.from_hex(object_hex)
+        entry = self.objects.get(object_hex)
+        if entry is None or not self.plasma.contains(oid):
+            raise KeyError(f"object {object_hex[:12]} not local")
+        return {"size": self.plasma.size_of(oid)}
+
+    async def handle_fetch_chunk(self, object_hex: str, offset: int,
+                                 length: int):
+        oid = ObjectID.from_hex(object_hex)
+        view = self.plasma.map_read(oid)
+        if view is None:
+            raise KeyError(f"object {object_hex[:12]} not local")
+        try:
+            return bytes(view[offset:offset + length])
+        finally:
+            view.release()
+
+    async def handle_free_objects(self, object_hexes: List[str]):
+        for object_hex in object_hexes:
+            entry = self.objects.pop(object_hex, None)
+            if entry is not None:
+                self.store_used -= entry.size
+            self.plasma.delete(ObjectID.from_hex(object_hex))
+        return True
+
+    async def handle_pin_object(self, object_hex: str, delta: int = 1):
+        entry = self.objects.get(object_hex)
+        if entry is not None:
+            entry.pinned = max(0, entry.pinned + delta)
+        return entry is not None
+
+    # ------------------------------------------------------------------
+    # misc
+    # ------------------------------------------------------------------
+
+    async def handle_ping(self):
+        return "pong"
+
+    async def handle_get_node_stats(self):
+        return {
+            "node_id": self.node_id,
+            "resources_total": self.resources.total.to_dict(),
+            "resources_available": self.resources.available.to_dict(),
+            "num_workers": len(self.workers),
+            "num_leases": len(self.leases),
+            "num_queued_leases": len(self.queued),
+            "object_store_used": self.store_used,
+            "object_store_capacity": self.capacity,
+            "num_objects": len(self.objects),
+            "labels": self.labels,
+        }

@@ -1,0 +1,158 @@
+"""Resource accounting.
+
+Mirrors the reference's model (src/ray/common/scheduling/resource_set.h,
+fixed_point.h, scheduling_ids.h): resource quantities are fixed-point
+integers (1e-4 granularity) so fractional resources add exactly; resource
+names are interned to ints for cheap comparison.
+
+Predefined resources: "CPU", "TPU", "GPU", "memory", "object_store_memory".
+Custom resources (e.g. "TPU-v5p-64-head", node labels) are arbitrary strings.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Mapping, Optional
+
+RESOLUTION = 10000  # 1e-4 granularity, same as the reference FixedPoint.
+
+
+def to_fixed(value: float) -> int:
+    return round(value * RESOLUTION)
+
+
+def from_fixed(value: int) -> float:
+    return value / RESOLUTION
+
+
+class _Interner:
+    """string <-> int interning (reference: scheduling_ids.h)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._to_id: Dict[str, int] = {}
+        self._to_str: list = []
+
+    def intern(self, name: str) -> int:
+        with self._lock:
+            rid = self._to_id.get(name)
+            if rid is None:
+                rid = len(self._to_str)
+                self._to_id[name] = rid
+                self._to_str.append(name)
+            return rid
+
+    def name(self, rid: int) -> str:
+        return self._to_str[rid]
+
+
+RESOURCE_IDS = _Interner()
+for _predef in ("CPU", "TPU", "GPU", "memory", "object_store_memory"):
+    RESOURCE_IDS.intern(_predef)
+
+
+class ResourceSet:
+    """A bag of named fixed-point resource quantities."""
+
+    __slots__ = ("_amounts",)
+
+    def __init__(self, amounts: Optional[Mapping[str, float]] = None,
+                 _fixed: Optional[Dict[int, int]] = None):
+        if _fixed is not None:
+            self._amounts = {r: q for r, q in _fixed.items() if q != 0}
+        else:
+            self._amounts = {}
+            if amounts:
+                for name, qty in amounts.items():
+                    fixed = to_fixed(qty)
+                    if fixed < 0:
+                        raise ValueError(f"negative resource {name}={qty}")
+                    if fixed:
+                        self._amounts[RESOURCE_IDS.intern(name)] = fixed
+
+    def to_dict(self) -> Dict[str, float]:
+        return {RESOURCE_IDS.name(r): from_fixed(q) for r, q in self._amounts.items()}
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._amounts.get(RESOURCE_IDS.intern(name), 0))
+
+    def is_empty(self) -> bool:
+        return not self._amounts
+
+    def names(self) -> Iterable[str]:
+        return [RESOURCE_IDS.name(r) for r in self._amounts]
+
+    def fits(self, available: "ResourceSet") -> bool:
+        """True iff every demanded quantity is <= available."""
+        avail = available._amounts
+        return all(avail.get(r, 0) >= q for r, q in self._amounts.items())
+
+    def __add__(self, other: "ResourceSet") -> "ResourceSet":
+        merged = dict(self._amounts)
+        for r, q in other._amounts.items():
+            merged[r] = merged.get(r, 0) + q
+        return ResourceSet(_fixed=merged)
+
+    def __sub__(self, other: "ResourceSet") -> "ResourceSet":
+        merged = dict(self._amounts)
+        for r, q in other._amounts.items():
+            merged[r] = merged.get(r, 0) - q
+        if any(q < 0 for q in merged.values()):
+            raise ValueError(
+                f"resource underflow: {self.to_dict()} - {other.to_dict()}")
+        return ResourceSet(_fixed=merged)
+
+    def subtract_clamped(self, other: "ResourceSet") -> "ResourceSet":
+        merged = dict(self._amounts)
+        for r, q in other._amounts.items():
+            merged[r] = max(0, merged.get(r, 0) - q)
+        return ResourceSet(_fixed=merged)
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._amounts == other._amounts
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __reduce__(self):
+        return (ResourceSet, (self.to_dict(),))
+
+
+class NodeResources:
+    """Total + available resources of one node, plus string labels.
+
+    Labels (reference: label_selector.h, node labels `ray.io/...`) are exact-
+    match key/values used by label-selector scheduling.
+    """
+
+    def __init__(self, total: ResourceSet, labels: Optional[Dict[str, str]] = None):
+        self.total = total
+        self.available = total
+        self.labels = dict(labels or {})
+
+    def try_allocate(self, demand: ResourceSet) -> bool:
+        if not demand.fits(self.available):
+            return False
+        self.available = self.available - demand
+        return True
+
+    def release(self, demand: ResourceSet):
+        self.available = self.available + demand
+        # Clamp against double-release drift.
+        for r, q in list(self.available._amounts.items()):
+            cap = self.total._amounts.get(r, 0)
+            if q > cap:
+                self.available._amounts[r] = cap
+
+    def utilization(self) -> float:
+        """Max over resources of used/total — drives hybrid scheduling."""
+        best = 0.0
+        for r, total in self.total._amounts.items():
+            if total <= 0:
+                continue
+            used = total - self.available._amounts.get(r, 0)
+            best = max(best, used / total)
+        return best
+
+    def matches_labels(self, selector: Mapping[str, str]) -> bool:
+        return all(self.labels.get(k) == v for k, v in selector.items())

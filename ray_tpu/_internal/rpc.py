@@ -1,0 +1,356 @@
+"""Asyncio RPC layer.
+
+Role-equivalent of the reference's gRPC layer (src/ray/rpc/: GrpcServer,
+GrpcClient, RetryableGrpcClient, rpc_chaos.h). Design differences, chosen for
+the target environment rather than translated:
+
+- Transport is length-prefixed msgpack over TCP with pickled payloads —
+  one event-loop thread per process serves every component in that process
+  (the reference gives each server its own polling threads).
+- In-process fast path: servers register in a process-local table; calls to a
+  local address dispatch directly on the loop with zero serialization. This is
+  what makes "head node in the driver process" mode cheap.
+- Retry with exponential backoff for idempotent control-plane calls
+  (reference: retryable_grpc_client.cc).
+- Fault injection: `testing_rpc_failure` config drops requests/responses by
+  method pattern (reference: rpc_chaos.h) for chaos tests.
+
+Wire frames: 4-byte big-endian length + msgpack map.
+  request:  {"i": id, "m": method, "p": pickled-args-bytes}
+  response: {"i": id, "ok": bool, "p": pickled-result-or-exception}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import struct
+import threading
+import time
+from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+from .config import CONFIG
+from .errors import RpcError
+from . import serialization
+
+logger = logging.getLogger(__name__)
+
+Address = Tuple[str, int]
+Handler = Callable[..., Awaitable[Any]]
+
+_HEADER = struct.Struct(">I")
+
+
+# --------------------------------------------------------------------------
+# Event loop singleton (one io thread per process)
+# --------------------------------------------------------------------------
+
+class EventLoopThread:
+    _instance: Optional["EventLoopThread"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(
+            target=self._run, name="rtpu-io", daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._lock:
+            if cls._instance is None or not cls._instance.thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    def run_sync(self, coro, timeout: Optional[float] = None):
+        if threading.current_thread() is self.thread:
+            raise RuntimeError("run_sync called from the io thread (deadlock)")
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_soon(self, coro) -> "asyncio.Future":
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+
+def get_loop() -> asyncio.AbstractEventLoop:
+    return EventLoopThread.get().loop
+
+
+# --------------------------------------------------------------------------
+# Chaos / fault injection
+# --------------------------------------------------------------------------
+
+class _Chaos:
+    """Parses `testing_rpc_failure` = "method:req_p:resp_p,..." and decides
+    whether to drop a request or response. `method` may be a substring."""
+
+    def __init__(self):
+        self._rules = None
+        self._spec = None
+
+    def _load(self):
+        spec = CONFIG.testing_rpc_failure
+        if spec == self._spec:
+            return
+        self._spec = spec
+        rules = []
+        if spec:
+            for entry in spec.split(","):
+                parts = entry.split(":")
+                rules.append((parts[0], float(parts[1]), float(parts[2])))
+        self._rules = rules
+
+    def drop_request(self, method: str) -> bool:
+        self._load()
+        return any(pat in method and random.random() < p
+                   for pat, p, _ in self._rules)
+
+    def drop_response(self, method: str) -> bool:
+        self._load()
+        return any(pat in method and random.random() < p
+                   for pat, _, p in self._rules)
+
+
+CHAOS = _Chaos()
+
+
+# --------------------------------------------------------------------------
+# Server
+# --------------------------------------------------------------------------
+
+_local_servers: Dict[Address, "RpcServer"] = {}
+_local_servers_lock = threading.Lock()
+
+
+class RpcServer:
+    def __init__(self, name: str):
+        self.name = name
+        self._handlers: Dict[str, Handler] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.address: Optional[Address] = None
+
+    def register(self, method: str, handler: Handler):
+        self._handlers[method] = handler
+
+    def register_instance(self, obj: Any, prefix: str = ""):
+        """Register every `async def handle_<x>` method of obj as rpc `<x>`."""
+        for attr in dir(obj):
+            if attr.startswith("handle_"):
+                self.register(prefix + attr[len("handle_"):], getattr(obj, attr))
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self._server = await asyncio.start_server(self._on_conn, host, port)
+        sock = self._server.sockets[0]
+        self.address = sock.getsockname()[:2]
+        with _local_servers_lock:
+            _local_servers[self.address] = self
+        return self.address
+
+    async def stop(self):
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        with _local_servers_lock:
+            _local_servers.pop(self.address, None)
+
+    async def _dispatch(self, method: str, payload: Dict[str, Any]) -> Any:
+        handler = self._handlers.get(method)
+        if handler is None:
+            raise RpcError(f"{self.name}: no handler for method {method!r}")
+        return await handler(**payload)
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter):
+        try:
+            unpacker = msgpack.Unpacker(raw=False, max_buffer_size=1 << 31)
+            while True:
+                chunk = await reader.read(1 << 20)
+                if not chunk:
+                    break
+                unpacker.feed(chunk)
+                for msg in unpacker:
+                    asyncio.ensure_future(self._handle_msg(msg, writer))
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _handle_msg(self, msg: Dict[str, Any],
+                          writer: asyncio.StreamWriter):
+        method = msg["m"]
+        if CHAOS.drop_request(method):
+            return
+        try:
+            payload = serialization.loads(msg["p"]) if msg["p"] else {}
+            result = await self._dispatch(method, payload)
+            ok, body = True, result
+        except BaseException as e:  # noqa: BLE001 — errors cross the wire
+            ok, body = False, e
+        if CHAOS.drop_response(method):
+            return
+        try:
+            data = serialization.dumps(body)
+        except Exception as e:
+            ok, data = False, serialization.dumps(RpcError(f"unpicklable reply: {e}"))
+        out = msgpack.packb({"i": msg["i"], "ok": ok, "p": data})
+        try:
+            writer.write(out)
+            await writer.drain()
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+
+# --------------------------------------------------------------------------
+# Client
+# --------------------------------------------------------------------------
+
+class RpcClient:
+    """Client to one remote server; persistent connection, multiplexed ids."""
+
+    def __init__(self, address: Address):
+        self.address = (address[0], int(address[1]))
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._reader_task: Optional[asyncio.Task] = None
+
+    def _local(self) -> Optional[RpcServer]:
+        with _local_servers_lock:
+            return _local_servers.get(self.address)
+
+    async def _ensure_conn(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(*self.address),
+                CONFIG.rpc_connect_timeout_s)
+            self._writer = writer
+            self._reader_task = asyncio.ensure_future(self._read_loop(reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader):
+        unpacker = msgpack.Unpacker(raw=False, max_buffer_size=1 << 31)
+        try:
+            while True:
+                chunk = await reader.read(1 << 20)
+                if not chunk:
+                    break
+                unpacker.feed(chunk)
+                for msg in unpacker:
+                    fut = self._pending.pop(msg["i"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(msg)
+        except Exception as e:
+            self._fail_pending(RpcError(f"connection to {self.address} lost: {e}"))
+            return
+        self._fail_pending(RpcError(f"connection to {self.address} closed"))
+
+    def _fail_pending(self, err: Exception):
+        self._writer = None
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+
+    async def call(self, method: str, timeout: Optional[float] = None,
+                   retries: int = 0, **kwargs) -> Any:
+        """Call `method`. Retries only on transport errors (idempotent use)."""
+        timeout = timeout if timeout is not None else CONFIG.rpc_call_timeout_s
+        attempt = 0
+        while True:
+            try:
+                return await self._call_once(method, kwargs, timeout)
+            except (RpcError, ConnectionError, asyncio.TimeoutError, OSError) as e:
+                attempt += 1
+                if attempt > retries:
+                    if isinstance(e, asyncio.TimeoutError):
+                        raise RpcError(
+                            f"rpc {method} to {self.address} timed out") from e
+                    raise
+                delay = min(
+                    CONFIG.rpc_retry_base_delay_ms * (2 ** (attempt - 1)),
+                    CONFIG.rpc_retry_max_delay_ms) / 1000.0
+                await asyncio.sleep(delay * (0.5 + random.random()))
+
+    async def _call_once(self, method: str, payload: Dict[str, Any],
+                         timeout: float) -> Any:
+        local = self._local()
+        if local is not None:
+            # In-process fast path — no sockets, no serialization.
+            if CHAOS.drop_request(method) or CHAOS.drop_response(method):
+                raise asyncio.TimeoutError()
+            return await asyncio.wait_for(
+                local._dispatch(method, payload), timeout)
+        await self._ensure_conn()
+        self._next_id += 1
+        msg_id = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        data = msgpack.packb({
+            "i": msg_id, "m": method, "p": serialization.dumps(payload)})
+        self._writer.write(data)
+        try:
+            await self._writer.drain()
+            msg = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(msg_id, None)
+        body = serialization.loads(msg["p"])
+        if not msg["ok"]:
+            raise body
+        return body
+
+    def call_sync(self, method: str, timeout: Optional[float] = None,
+                  retries: int = 0, **kwargs) -> Any:
+        total = (timeout if timeout is not None else CONFIG.rpc_call_timeout_s)
+        return EventLoopThread.get().run_sync(
+            self.call(method, timeout=timeout, retries=retries, **kwargs),
+            timeout=total * (retries + 1) + 10)
+
+    async def close(self):
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._writer = None
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address (reference: per-service pools)."""
+
+    def __init__(self):
+        self._clients: Dict[Address, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: Address) -> RpcClient:
+        address = (address[0], int(address[1]))
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None:
+                client = RpcClient(address)
+                self._clients[address] = client
+            return client
+
+    def invalidate(self, address: Address):
+        with self._lock:
+            client = self._clients.pop(tuple(address), None)
+        if client is not None:
+            EventLoopThread.get().call_soon(client.close())

@@ -1,0 +1,186 @@
+"""Scheduling policies.
+
+Equivalents of the reference's policy suite
+(src/ray/raylet/scheduling/policy/): hybrid (default — prefer the local node
+until its utilization crosses a threshold, then best-fit across the cluster),
+spread, random, node-affinity, node-label, and bundle (placement-group gang)
+strategies over a cluster resource view.
+
+The view is a plain dict {node_id_hex: NodeView}; policies are pure functions
+so both the GCS (actor/PG scheduling) and each raylet (lease spillback) reuse
+them against whatever snapshot they hold.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from .config import CONFIG
+from .resources import NodeResources, ResourceSet
+
+
+@dataclass
+class NodeView:
+    node_id: str                    # hex
+    resources: NodeResources
+    alive: bool = True
+    # draining nodes accept no new leases
+    draining: bool = False
+
+    def feasible(self, demand: ResourceSet) -> bool:
+        return demand.fits(self.resources.total)
+
+    def available(self, demand: ResourceSet) -> bool:
+        return demand.fits(self.resources.available)
+
+
+def _schedulable(view: Mapping[str, NodeView]) -> List[NodeView]:
+    return [n for n in view.values() if n.alive and not n.draining]
+
+
+def pick_hybrid(view: Mapping[str, NodeView], demand: ResourceSet,
+                local_node_id: str,
+                label_selector: Optional[Mapping[str, str]] = None,
+                threshold: Optional[float] = None) -> Optional[str]:
+    """Default policy (reference: hybrid_scheduling_policy.h:50): stay local
+    while local utilization < threshold and the task fits; otherwise pick the
+    feasible node with the lowest utilization (best-fit by critical resource),
+    breaking ties by node id for determinism."""
+    threshold = (CONFIG.scheduler_hybrid_threshold
+                 if threshold is None else threshold)
+    nodes = _schedulable(view)
+    if label_selector:
+        nodes = [n for n in nodes
+                 if n.resources.matches_labels(label_selector)]
+    local = next((n for n in nodes if n.node_id == local_node_id), None)
+    if (local is not None and local.available(demand)
+            and local.resources.utilization() < threshold):
+        return local.node_id
+    candidates = [n for n in nodes if n.available(demand)]
+    if candidates:
+        return min(candidates,
+                   key=lambda n: (n.resources.utilization(), n.node_id)).node_id
+    feasible = [n for n in nodes if n.feasible(demand)]
+    if feasible:
+        # Queue on the least-loaded feasible node.
+        return min(feasible,
+                   key=lambda n: (n.resources.utilization(), n.node_id)).node_id
+    return None
+
+
+def pick_spread(view: Mapping[str, NodeView], demand: ResourceSet,
+                spread_clock: int,
+                label_selector: Optional[Mapping[str, str]] = None
+                ) -> Optional[str]:
+    """Round-robin across available nodes (reference: spread policy)."""
+    nodes = sorted(_schedulable(view), key=lambda n: n.node_id)
+    if label_selector:
+        nodes = [n for n in nodes
+                 if n.resources.matches_labels(label_selector)]
+    avail = [n for n in nodes if n.available(demand)]
+    pool = avail or [n for n in nodes if n.feasible(demand)]
+    if not pool:
+        return None
+    return pool[spread_clock % len(pool)].node_id
+
+
+def pick_random(view: Mapping[str, NodeView],
+                demand: ResourceSet) -> Optional[str]:
+    pool = [n for n in _schedulable(view) if n.available(demand)]
+    return random.choice(pool).node_id if pool else None
+
+
+def pick_node_affinity(view: Mapping[str, NodeView], demand: ResourceSet,
+                       node_id: str, soft: bool) -> Optional[str]:
+    node = view.get(node_id)
+    if node is not None and node.alive and not node.draining \
+            and node.feasible(demand):
+        return node_id
+    if soft:
+        return pick_hybrid(view, demand, local_node_id=node_id)
+    return None
+
+
+def pick_node_label(view: Mapping[str, NodeView], demand: ResourceSet,
+                    selector: Mapping[str, str]) -> Optional[str]:
+    pool = [n for n in _schedulable(view)
+            if n.resources.matches_labels(selector) and n.available(demand)]
+    if pool:
+        return min(pool, key=lambda n: (n.resources.utilization(),
+                                        n.node_id)).node_id
+    feas = [n for n in _schedulable(view)
+            if n.resources.matches_labels(selector) and n.feasible(demand)]
+    return min(feas, key=lambda n: n.node_id).node_id if feas else None
+
+
+# ---------------------------------------------------------------------------
+# Placement-group bundle placement (reference: bundle_scheduling_policy.cc)
+# ---------------------------------------------------------------------------
+
+def place_bundles(view: Mapping[str, NodeView],
+                  bundles: Sequence[ResourceSet],
+                  strategy: str) -> Optional[List[str]]:
+    """Map each bundle to a node id, or None if infeasible now.
+
+    PACK: minimize node count (greedy first-fit onto fewest nodes).
+    SPREAD: best-effort one bundle per node, reusing nodes when short.
+    STRICT_PACK: all bundles on one node.
+    STRICT_SPREAD: all bundles on distinct nodes.
+    """
+    nodes = sorted(_schedulable(view), key=lambda n: n.node_id)
+    # Work on a scratch copy of availability.
+    scratch: Dict[str, ResourceSet] = {
+        n.node_id: n.resources.available for n in nodes}
+
+    def fits(nid: str, demand: ResourceSet) -> bool:
+        return demand.fits(scratch[nid])
+
+    def take(nid: str, demand: ResourceSet):
+        scratch[nid] = scratch[nid] - demand
+
+    if strategy == "STRICT_PACK":
+        for n in nodes:
+            if all_fit_one(scratch[n.node_id], bundles):
+                return [n.node_id] * len(bundles)
+        return None
+
+    if strategy in ("SPREAD", "STRICT_SPREAD"):
+        placement: List[str] = []
+        used: set = set()
+        for bundle in bundles:
+            candidates = [n.node_id for n in nodes
+                          if n.node_id not in used and fits(n.node_id, bundle)]
+            if not candidates and strategy == "SPREAD":
+                candidates = [n.node_id for n in nodes
+                              if fits(n.node_id, bundle)]
+            if not candidates:
+                return None
+            nid = candidates[0]
+            placement.append(nid)
+            used.add(nid)
+            take(nid, bundle)
+        return placement
+
+    # PACK (default): greedy first-fit, preferring already-used nodes.
+    placement = []
+    used_order: List[str] = []
+    for bundle in bundles:
+        nid = next((u for u in used_order if fits(u, bundle)), None)
+        if nid is None:
+            nid = next((n.node_id for n in nodes if fits(n.node_id, bundle)),
+                       None)
+            if nid is None:
+                return None
+            used_order.append(nid)
+        placement.append(nid)
+        take(nid, bundle)
+    return placement
+
+
+def all_fit_one(available: ResourceSet, bundles: Sequence[ResourceSet]) -> bool:
+    total = ResourceSet()
+    for b in bundles:
+        total = total + b
+    return total.fits(available)

@@ -1,0 +1,135 @@
+"""Object serialization.
+
+Equivalent of the reference's python serialization layer
+(python/ray/_private/serialization.py + vendored cloudpickle): cloudpickle
+with pickle-protocol-5 out-of-band buffers so numpy/jax host arrays round-trip
+zero-copy in and out of the shared-memory object store, plus tracking of
+ObjectRefs embedded inside serialized values (needed for ownership/refcounting
+— the reference tracks "contained object ids" the same way).
+
+Wire format of a serialized object:
+    header  = msgpack({"pickle_len": n, "buffer_lens": [...]})-style framing
+    payload = pickle_bytes + concat(buffers)
+The store keeps payloads as a single contiguous buffer; deserialization maps
+buffer views back out-of-band, so a numpy array read from shared memory is a
+view over the store's mmap (no copy).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+import cloudpickle
+
+_local = threading.local()
+
+
+class SerializationContext:
+    """Collects ObjectRefs encountered while pickling a value."""
+
+    def __init__(self):
+        self.contained_refs: List[Any] = []
+
+
+def get_context() -> Optional[SerializationContext]:
+    return getattr(_local, "ctx", None)
+
+
+class _ContextScope:
+    def __enter__(self):
+        self.prev = getattr(_local, "ctx", None)
+        _local.ctx = SerializationContext()
+        return _local.ctx
+
+    def __exit__(self, *exc):
+        _local.ctx = self.prev
+
+
+class SerializedObject:
+    __slots__ = ("pickle_bytes", "buffers", "contained_refs")
+
+    def __init__(self, pickle_bytes: bytes, buffers: List[memoryview],
+                 contained_refs: List[Any]):
+        self.pickle_bytes = pickle_bytes
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    def total_bytes(self) -> int:
+        return (
+            9
+            + 8 * len(self.buffers)
+            + len(self.pickle_bytes)
+            + sum(b.nbytes for b in self.buffers)
+        )
+
+    def to_bytes(self) -> bytes:
+        """Flatten into one contiguous buffer (header + pickle + buffers)."""
+        out = bytearray(self.total_bytes())
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+    def write_into(self, dest: memoryview) -> int:
+        """Write the flattened representation into `dest`; returns length."""
+        n = len(self.buffers)
+        struct.pack_into(">BII", dest, 0, 1, len(self.pickle_bytes), n)
+        off = 9
+        for b in self.buffers:
+            struct.pack_into(">Q", dest, off, b.nbytes)
+            off += 8
+        end = off + len(self.pickle_bytes)
+        dest[off:end] = self.pickle_bytes
+        off = end
+        for b in self.buffers:
+            end = off + b.nbytes
+            dest[off:end] = b.cast("B") if b.ndim == 1 else memoryview(bytes(b))
+            off = end
+        return off
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    with _ContextScope() as ctx:
+        data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    views = []
+    for pb in buffers:
+        try:
+            views.append(pb.raw())
+        except BufferError:
+            views.append(memoryview(bytes(pb)))  # non-contiguous: copy once
+    return SerializedObject(data, views, ctx.contained_refs)
+
+
+def deserialize_from_buffer(buf: memoryview) -> Any:
+    """Deserialize a flattened object; buffers stay views into `buf`."""
+    kind, pickle_len, n = struct.unpack_from(">BII", buf, 0)
+    if kind != 1:
+        raise ValueError(f"bad serialized object header kind={kind}")
+    off = 9
+    lens = []
+    for _ in range(n):
+        (blen,) = struct.unpack_from(">Q", buf, off)
+        lens.append(blen)
+        off += 8
+    data = buf[off : off + pickle_len]
+    off += pickle_len
+    out_of_band = []
+    for blen in lens:
+        out_of_band.append(buf[off : off + blen])
+        off += blen
+    return pickle.loads(data, buffers=out_of_band)
+
+
+def deserialize(data: bytes) -> Any:
+    return deserialize_from_buffer(memoryview(data))
+
+
+def dumps(value: Any) -> bytes:
+    """Plain in-band cloudpickle (control-plane payloads, not objects)."""
+    return cloudpickle.dumps(value)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

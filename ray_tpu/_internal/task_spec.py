@@ -1,0 +1,190 @@
+"""Task specifications and function registry.
+
+TaskSpec mirrors the reference's TaskSpecification
+(src/ray/common/task/task_spec.h + protobuf/common.proto TaskSpec): the
+complete description of one task invocation — identity, function, arguments
+(inline bytes or object references), resources, scheduling strategy, retry
+policy, actor linkage.
+
+The FunctionManager is the analog of python/ray/_private/function_manager.py:
+functions/actor classes are exported once per job into the control-plane KV
+store keyed by a content hash; workers load and cache them on first use.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import serialization
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+# Task types
+NORMAL_TASK = "normal"
+ACTOR_CREATION_TASK = "actor_creation"
+ACTOR_TASK = "actor_task"
+
+
+@dataclass
+class FunctionDescriptor:
+    module: str
+    qualname: str
+    function_id: str  # content hash; KV key of the pickled function
+
+    def display_name(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+
+@dataclass
+class TaskArg:
+    """One argument: either an inline serialized value or an object ref."""
+    is_ref: bool
+    data: Optional[bytes] = None          # inline: flattened SerializedObject
+    object_id: Optional[ObjectID] = None  # ref
+    owner_address: Optional[Tuple[str, int]] = None
+    # refs contained inside an inline value (for borrower accounting)
+    contained_ref_ids: List[ObjectID] = field(default_factory=list)
+
+
+@dataclass
+class SchedulingStrategy:
+    """Normalized scheduling strategy carried in the spec.
+
+    kind: "DEFAULT" | "SPREAD" | "placement_group" | "node_affinity"
+          | "node_label"
+    """
+    kind: str = "DEFAULT"
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+    node_id: Optional[str] = None       # node_affinity: hex node id
+    soft: bool = False                  # node_affinity soft
+    label_selector: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    task_type: str
+    function: FunctionDescriptor
+    args: List[TaskArg]
+    num_returns: int
+    resources: Dict[str, float]
+    owner_address: Tuple[str, int]
+    owner_worker_id: bytes
+    name: str = ""
+    scheduling_strategy: SchedulingStrategy = field(
+        default_factory=SchedulingStrategy)
+    max_retries: int = 0
+    retry_exceptions: Any = False  # bool or list of exception types (pickled)
+    attempt_number: int = 0
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    # actor linkage
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    sequence_number: int = -1            # actor task ordering
+    max_restarts: int = 0                # actor creation
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    concurrency_groups: Dict[str, int] = field(default_factory=dict)
+    is_asyncio: bool = False
+    is_detached: bool = False
+    generator_backpressure: int = -1
+    enable_task_events: bool = True
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i)
+                for i in range(self.num_returns)]
+
+    def shape_key(self) -> Tuple:
+        """Lease reuse key: tasks with the same shape share leased workers
+        (reference: SchedulingKey in normal_task_submitter.h)."""
+        return (
+            tuple(sorted(self.resources.items())),
+            self.scheduling_strategy.kind,
+            self.scheduling_strategy.placement_group_id,
+            self.scheduling_strategy.bundle_index,
+            self.scheduling_strategy.node_id,
+            tuple(sorted(self.label_selector.items())),
+            tuple(sorted(self.runtime_env.get("env_vars", {}).items())),
+        )
+
+    def dependencies(self) -> List[Tuple[ObjectID, Tuple[str, int]]]:
+        deps = []
+        for arg in self.args:
+            if arg.is_ref:
+                deps.append((arg.object_id, arg.owner_address))
+        return deps
+
+
+class _CallBundle:
+    """Bundles (args, kwargs) into one serialized argument; top-level
+    ObjectRefs are hoisted into explicit TaskArg deps and replaced by
+    placeholders."""
+    __slots__ = ("args", "kwargs")
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self.args = args
+        self.kwargs = kwargs
+
+    def __reduce__(self):
+        return (_CallBundle, (self.args, self.kwargs))
+
+
+class _RefPlaceholder:
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_RefPlaceholder, (self.index,))
+
+
+def compute_function_id(pickled: bytes) -> str:
+    return hashlib.sha1(pickled).hexdigest()
+
+
+class FunctionManager:
+    """Export/load functions & actor classes through the control-plane KV."""
+
+    NS = "fn"
+
+    def __init__(self, kv_client):
+        self._kv = kv_client
+        self._lock = threading.Lock()
+        self._exported: set = set()
+        self._cache: Dict[str, Any] = {}
+
+    def export(self, job_id: JobID, func: Any) -> FunctionDescriptor:
+        pickled = serialization.dumps(func)
+        fid = compute_function_id(pickled)
+        key = f"{job_id.hex()}:{fid}"
+        with self._lock:
+            if key not in self._exported:
+                self._kv.put(self.NS, key, pickled)
+                self._exported.add(key)
+                self._cache[key] = func
+        return FunctionDescriptor(
+            module=getattr(func, "__module__", "") or "",
+            qualname=getattr(func, "__qualname__", repr(func)),
+            function_id=fid,
+        )
+
+    def load(self, job_id: JobID, descriptor: FunctionDescriptor) -> Any:
+        key = f"{job_id.hex()}:{descriptor.function_id}"
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        pickled = self._kv.get(self.NS, key)
+        if pickled is None:
+            raise RuntimeError(
+                f"function {descriptor.display_name()} not found in registry")
+        func = serialization.loads(pickled)
+        with self._lock:
+            self._cache[key] = func
+        return func

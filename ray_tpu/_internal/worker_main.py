@@ -1,0 +1,61 @@
+"""Worker process entrypoint
+(reference: python/ray/_private/workers/default_worker.py).
+
+Spawned by a raylet's worker pool. Registers back with the raylet, then
+serves `push_task` RPCs on its CoreWorker until killed, told to exit, or its
+raylet disappears (a dead raylet orphans the worker — exit so nodes die
+cleanly in fault-tolerance tests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+import time
+
+
+def main():
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[worker %(process)d] %(levelname)s %(name)s: %(message)s")
+    worker_id = bytes.fromhex(os.environ["RTPU_WORKER_ID"])
+    session = os.environ["RTPU_SESSION"]
+    node_id = os.environ["RTPU_NODE_ID"]
+    node_index = int(os.environ["RTPU_NODE_INDEX"])
+    raylet_host, raylet_port = os.environ["RTPU_RAYLET_ADDR"].rsplit(":", 1)
+    gcs_host, gcs_port = os.environ["RTPU_GCS_ADDR"].rsplit(":", 1)
+    raylet_addr = (raylet_host, int(raylet_port))
+    gcs_addr = (gcs_host, int(gcs_port))
+
+    from .core_worker import CoreWorker, set_core_worker
+    from .rpc import EventLoopThread
+
+    worker = CoreWorker(
+        mode="worker", session_name=session, gcs_address=gcs_addr,
+        raylet_address=raylet_addr, node_id=node_id, node_index=node_index,
+        worker_id=worker_id)
+    worker.start()
+    set_core_worker(worker)
+
+    raylet = worker.clients.get(raylet_addr)
+    reply = raylet.call_sync(
+        "register_worker", worker_id=worker_id,
+        address=worker.rpc_address, pid=os.getpid(), retries=5)
+    if reply.get("exit"):
+        sys.exit(0)
+
+    # Stay alive while the raylet does; poll its liveness.
+    while True:
+        time.sleep(2.0)
+        try:
+            raylet.call_sync("ping", timeout=5, retries=2)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "raylet unreachable; worker exiting")
+            os._exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from . import tpu
+
+__all__ = ["tpu"]

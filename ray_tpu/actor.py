@@ -1,0 +1,193 @@
+"""Actors (reference: python/ray/actor.py — ActorClass, ActorMethod).
+
+`@ray_tpu.remote` on a class yields an ActorClass; `.remote(...)` registers
+the actor with the GCS (which schedules, restarts, and tracks it) and returns
+an ActorHandle. Method calls are pushed directly worker-to-worker with
+sequence numbers; async actors (any coroutine method) run on the worker's
+event loop with `max_concurrency` in-flight calls.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional
+
+from ._internal.config import CONFIG
+from ._internal.core_worker import get_core_worker
+from ._internal.ids import ActorID, TaskID
+from ._internal.options import (normalize_strategy, resources_from_options,
+                                validate_options)
+from ._internal.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
+                                  FunctionDescriptor, TaskSpec)
+from .remote_function import pack_args
+
+
+def method(**options):
+    """Per-method options, e.g. `@ray_tpu.method(num_returns=2)`."""
+
+    def decorator(fn):
+        fn.__rtpu_method_options__ = options
+        return fn
+    return decorator
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 options: Optional[Dict[str, Any]] = None):
+        self._handle = handle
+        self._method_name = method_name
+        self._options = dict(options or {})
+
+    def options(self, **new_options) -> "ActorMethod":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return ActorMethod(self._handle, self._method_name, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, self._options)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor method {self._method_name} cannot be called directly; "
+            "use .remote()")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str,
+                 method_options: Dict[str, Dict[str, Any]],
+                 max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_options = method_options
+        self._max_task_retries = max_task_retries
+
+    @property
+    def actor_id(self) -> ActorID:
+        return self._actor_id
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_options.get(name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name,
+                              self._method_options, self._max_task_retries))
+
+    def _submit_method(self, method_name: str, args, kwargs,
+                       options: Dict[str, Any]):
+        worker = get_core_worker()
+        num_returns = options.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=TaskID.of(worker.job_id),
+            job_id=worker.job_id,
+            task_type=ACTOR_TASK,
+            function=FunctionDescriptor("", self._class_name, ""),
+            args=pack_args(args, kwargs),
+            num_returns=num_returns,
+            resources={},
+            owner_address=worker.rpc_address,
+            owner_worker_id=worker.worker_id,
+            name=f"{self._class_name}.{method_name}",
+            actor_id=self._actor_id,
+            method_name=method_name,
+            max_retries=options.get("max_task_retries",
+                                    self._max_task_retries),
+        )
+        refs = worker.submit_task(spec)
+        if num_returns == 0:
+            return None
+        return refs[0] if num_returns == 1 else refs
+
+    def terminate(self):
+        """Graceful exit: flush queued work, then exit the actor process."""
+        return self._submit_method("__rtpu_terminate__", (), {}, {})
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        validate_options(self._options, for_actor=True)
+        self._descriptor = None
+
+    def options(self, **new_options) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return ActorClass(self._cls, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"actor class {self._cls.__name__} cannot be instantiated "
+            "directly; use .remote()")
+
+    def _method_options(self) -> Dict[str, Dict[str, Any]]:
+        out = {}
+        for name, member in inspect.getmembers(self._cls):
+            opts = getattr(member, "__rtpu_method_options__", None)
+            if opts:
+                out[name] = opts
+        return out
+
+    def _is_asyncio(self) -> bool:
+        return any(inspect.iscoroutinefunction(m)
+                   for _, m in inspect.getmembers(
+                       self._cls, inspect.isfunction))
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = get_core_worker()
+        if self._descriptor is None:
+            self._descriptor = worker.function_manager.export(
+                worker.job_id, self._cls)
+        opts = self._options
+        actor_id = ActorID.of(worker.job_id)
+        lifetime = opts.get("lifetime")
+        detached = lifetime == "detached"
+        max_restarts = opts.get("max_restarts",
+                                CONFIG.actor_max_restarts_default)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=worker.job_id,
+            task_type=ACTOR_CREATION_TASK,
+            function=self._descriptor,
+            args=pack_args(args, kwargs),
+            num_returns=0,
+            resources=resources_from_options(opts, default_num_cpus=1),
+            owner_address=worker.rpc_address,
+            owner_worker_id=worker.worker_id,
+            name=opts.get("name") or self._cls.__name__,
+            scheduling_strategy=normalize_strategy(
+                opts.get("scheduling_strategy")),
+            runtime_env=opts.get("runtime_env") or {},
+            label_selector=opts.get("label_selector") or {},
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=opts.get("concurrency_groups") or {},
+            is_asyncio=self._is_asyncio(),
+            is_detached=detached,
+        )
+        reply = worker.gcs.call_sync(
+            "register_actor", spec=spec, name=opts.get("name", "") or "",
+            namespace=opts.get("namespace", "") or "",
+            is_detached=detached,
+            get_if_exists=opts.get("get_if_exists", False),
+            timeout=CONFIG.worker_start_timeout_s)
+        return ActorHandle(reply["actor_id"], self._cls.__name__,
+                           self._method_options(),
+                           opts.get("max_task_retries", 0))
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    worker = get_core_worker()
+    info = worker.gcs.call_sync("get_actor_info", name=name,
+                                namespace=namespace)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"actor {name!r} not found in namespace "
+                         f"{namespace!r}")
+    return ActorHandle(info["actor_id"], info.get("class_name", ""), {})

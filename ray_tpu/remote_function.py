@@ -1,0 +1,102 @@
+"""Remote functions (reference: python/ray/remote_function.py).
+
+`@ray_tpu.remote` on a function yields a RemoteFunction; `.remote(*args)`
+builds a TaskSpec and submits it through the CoreWorker. `.options(**kw)`
+returns a shallow copy with overridden options, like the reference.
+
+Argument packing: positional/keyword args are bundled into one inline
+serialized argument with top-level ObjectRefs hoisted out as explicit
+dependencies (resolved to values before execution); refs *nested* inside
+structures stay refs — the reference's semantics exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+from ._internal import serialization
+from ._internal.config import CONFIG
+from ._internal.core_worker import get_core_worker
+from ._internal.ids import TaskID
+from ._internal.object_ref import ObjectRef
+from ._internal.options import (normalize_strategy, resources_from_options,
+                                validate_options)
+from ._internal.task_spec import (NORMAL_TASK, TaskArg, TaskSpec, _CallBundle,
+                                  _RefPlaceholder)
+
+
+def pack_args(args: Tuple, kwargs: Dict) -> List[TaskArg]:
+    """Bundle (args, kwargs) into TaskArgs: one inline bundle + ref deps."""
+    refs: List[ObjectRef] = []
+
+    def hoist(value):
+        if isinstance(value, ObjectRef):
+            refs.append(value)
+            return _RefPlaceholder(len(refs) - 1)
+        return value
+
+    bundle = _CallBundle(tuple(hoist(a) for a in args),
+                         {k: hoist(v) for k, v in kwargs.items()})
+    sobj = serialization.serialize(bundle)
+    task_args = [TaskArg(is_ref=False, data=sobj.to_bytes(),
+                         contained_ref_ids=[r.id()
+                                            for r in sobj.contained_refs])]
+    for ref in refs:
+        task_args.append(TaskArg(is_ref=True, object_id=ref.id(),
+                                 owner_address=ref.owner_address()))
+    return task_args
+
+
+class RemoteFunction:
+    def __init__(self, function, options: Optional[Dict[str, Any]] = None):
+        self._function = function
+        self._options = dict(options or {})
+        validate_options(self._options, for_actor=False)
+        functools.update_wrapper(self, function)
+        self._descriptor = None
+
+    def options(self, **new_options) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(new_options)
+        return RemoteFunction(self._function, merged)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._function.__name__} cannot be called "
+            "directly; use .remote()")
+
+    def remote(self, *args, **kwargs):
+        worker = get_core_worker()
+        if self._descriptor is None:
+            self._descriptor = worker.function_manager.export(
+                worker.job_id, self._function)
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        max_retries = opts.get("max_retries",
+                               CONFIG.task_max_retries_default)
+        spec = TaskSpec(
+            task_id=TaskID.of(worker.job_id),
+            job_id=worker.job_id,
+            task_type=NORMAL_TASK,
+            function=self._descriptor,
+            args=pack_args(args, kwargs),
+            num_returns=num_returns,
+            resources=resources_from_options(opts, default_num_cpus=1),
+            owner_address=worker.rpc_address,
+            owner_worker_id=worker.worker_id,
+            name=opts.get("name") or self._function.__qualname__,
+            scheduling_strategy=normalize_strategy(
+                opts.get("scheduling_strategy")),
+            max_retries=max_retries,
+            retry_exceptions=opts.get("retry_exceptions", False),
+            runtime_env=opts.get("runtime_env") or {},
+            label_selector=opts.get("label_selector") or {},
+            enable_task_events=opts.get("enable_task_events", True),
+        )
+        refs = worker.submit_task(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs

@@ -1,0 +1,10 @@
+from . import placement_group as _pg_module
+from . import scheduling_strategies
+from .placement_group import (PlacementGroup, get_placement_group,
+                              placement_group, placement_group_table,
+                              remove_placement_group)
+
+__all__ = [
+    "placement_group", "remove_placement_group", "get_placement_group",
+    "placement_group_table", "PlacementGroup", "scheduling_strategies",
+]

@@ -1,0 +1,94 @@
+"""Placement groups (reference: python/ray/util/placement_group.py).
+
+Gang-reserve resource bundles across the cluster with PACK / SPREAD /
+STRICT_PACK / STRICT_SPREAD strategies; the GCS runs two-phase
+prepare/commit across the involved raylets. Tasks/actors target a bundle via
+PlacementGroupSchedulingStrategy.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .._internal.core_worker import get_core_worker
+from .._internal.errors import PlacementGroupError
+from .._internal.ids import PlacementGroupID
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: Optional[List[Dict[str, float]]] = None):
+        self.id = pg_id
+        self._bundles = bundles
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        if self._bundles is None:
+            info = get_core_worker().gcs.call_sync(
+                "get_placement_group", pg_id=self.id)
+            self._bundles = info["bundles"] if info else []
+        return self._bundles
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def ready(self):
+        """ObjectRef-style readiness: returns when the PG is placed. The
+        reference returns an ObjectRef; here a tiny task pinned to bundle 0
+        provides the same pattern."""
+        import ray_tpu
+        from .scheduling_strategies import PlacementGroupSchedulingStrategy
+
+        @ray_tpu.remote(num_cpus=0, scheduling_strategy=
+                        PlacementGroupSchedulingStrategy(
+                            placement_group=self,
+                            placement_group_bundle_index=0))
+        def _pg_ready():
+            return True
+        return _pg_ready.remote()
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return bool(get_core_worker().gcs.call_sync(
+            "wait_placement_group_ready", pg_id=self.id,
+            timeout=timeout_seconds + 5, timeout_s=timeout_seconds))
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self._bundles))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None
+                    ) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(f"strategy must be one of {VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    for bundle in bundles:
+        if not bundle or all(v == 0 for v in bundle.values()):
+            raise ValueError(f"empty bundle in placement group: {bundle}")
+    worker = get_core_worker()
+    pg_id = PlacementGroupID.of(worker.job_id)
+    worker.gcs.call_sync(
+        "create_placement_group", pg_id=pg_id, bundles=list(bundles),
+        strategy=strategy, name=name, creator_job=worker.job_id,
+        is_detached=lifetime == "detached")
+    return PlacementGroup(pg_id, list(bundles))
+
+
+def remove_placement_group(pg: PlacementGroup):
+    get_core_worker().gcs.call_sync("remove_placement_group", pg_id=pg.id)
+
+
+def get_placement_group(name: str) -> PlacementGroup:
+    info = get_core_worker().gcs.call_sync("get_placement_group", name=name)
+    if info is None:
+        raise PlacementGroupError(f"placement group {name!r} not found")
+    return PlacementGroup(info["pg_id"], info["bundles"])
+
+
+def placement_group_table() -> List[Dict]:
+    return get_core_worker().gcs.call_sync("get_all_placement_groups")

@@ -1,0 +1,34 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py).
+
+Passed as `scheduling_strategy=` to `@remote`/`.options()`:
+
+- "DEFAULT": hybrid policy (local until utilization threshold, then best-fit)
+- "SPREAD": round-robin across nodes
+- PlacementGroupSchedulingStrategy: pin to a placement-group bundle
+- NodeAffinitySchedulingStrategy: pin to one node (hard or soft)
+- NodeLabelSchedulingStrategy: restrict to nodes matching labels
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: "object"
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    hard: Dict[str, str] = field(default_factory=dict)
+    soft: Dict[str, str] = field(default_factory=dict)

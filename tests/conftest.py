@@ -1,0 +1,50 @@
+import os
+import sys
+
+# Tests run on a virtual multi-device CPU "TPU" mesh: 8 XLA CPU devices per
+# process (the pattern the driver's dryrun_multichip uses as well). The host
+# may have a real TPU pre-registered by a site hook that also forces
+# jax_platforms — override it at the config level before any backend init.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import faulthandler  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+TEST_TIMEOUT_S = 120  # reference pytest.ini uses 180s per test
+
+
+@pytest.fixture(autouse=True)
+def _test_watchdog():
+    """Dump all stacks and abort if a test wedges (poor man's pytest-timeout)."""
+    faulthandler.dump_traceback_later(TEST_TIMEOUT_S, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Local one-node cluster (reference: tests/conftest.py ray_start_regular)."""
+    import ray_tpu
+    worker = ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+    yield worker
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-node cluster factory (reference: conftest.py ray_start_cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,186 @@
+"""Actor tests (reference coverage: python/ray/tests/test_actor.py,
+test_actor_failures.py): lifecycle, state, ordering, named actors, async
+actors, death and restart semantics."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.value = start
+
+        def inc(self, amount=1):
+            self.value += amount
+            return self.value
+
+        def get(self):
+            return self.value
+
+    counter = Counter.remote(10)
+    assert ray_tpu.get(counter.inc.remote()) == 11
+    assert ray_tpu.get(counter.inc.remote(5)) == 16
+    assert ray_tpu.get(counter.get.remote()) == 16
+
+
+def test_actor_method_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, item):
+            self.items.append(item)
+
+        def get(self):
+            return self.items
+
+    appender = Appender.remote()
+    for i in range(20):
+        appender.add.remote(i)
+    assert ray_tpu.get(appender.get.remote()) == list(range(20))
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Service:
+        def ping(self):
+            return "pong"
+
+    Service.options(name="svc", namespace="ns").remote()
+    handle = ray_tpu.get_actor("svc", namespace="ns")
+    assert ray_tpu.get(handle.ping.remote()) == "pong"
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("missing", namespace="ns")
+
+
+def test_get_if_exists(ray_start_regular):
+    @ray_tpu.remote
+    class Singleton:
+        def __init__(self):
+            self.token = time.time()
+
+        def token_value(self):
+            return self.token
+
+    a = Singleton.options(name="single", get_if_exists=True).remote()
+    b = Singleton.options(name="single", get_if_exists=True).remote()
+    assert ray_tpu.get(a.token_value.remote()) == \
+        ray_tpu.get(b.token_value.remote())
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Flaky:
+        def boom(self):
+            raise RuntimeError("nope")
+
+        def ok(self):
+            return 1
+
+    flaky = Flaky.remote()
+    with pytest.raises(RuntimeError):
+        ray_tpu.get(flaky.boom.remote())
+    # Actor survives method exceptions.
+    assert ray_tpu.get(flaky.ok.remote()) == 1
+
+
+def test_async_actor(ray_start_regular):
+    import asyncio
+
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    worker = AsyncWorker.options(max_concurrency=8).remote()
+    refs = [worker.work.remote(i) for i in range(8)]
+    start = time.time()
+    assert ray_tpu.get(refs, timeout=30) == [i * 2 for i in range(8)]
+    # Concurrency: 8 x 50ms sleeps should overlap.
+    assert time.time() - start < 3.0
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    victim = Victim.remote()
+    assert ray_tpu.get(victim.ping.remote()) == "pong"
+    ray_tpu.kill(victim)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.RayTpuError)):
+        ray_tpu.get(victim.ping.remote(), timeout=30)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.count = 0
+
+        def inc(self):
+            self.count += 1
+            return self.count
+
+        def pid(self):
+            import os
+            return os.getpid()
+
+    phoenix = Phoenix.remote()
+    assert ray_tpu.get(phoenix.inc.remote()) == 1
+    old_pid = ray_tpu.get(phoenix.pid.remote())
+    ray_tpu.kill(phoenix, no_restart=False)
+    # After restart, state is fresh and pid differs.
+    deadline = time.time() + 60
+    while True:
+        try:
+            value = ray_tpu.get(phoenix.inc.remote(), timeout=30)
+            break
+        except ray_tpu.RayTpuError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+    assert value == 1
+    assert ray_tpu.get(phoenix.pid.remote()) != old_pid
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.data = {}
+
+        def put(self, k, v):
+            self.data[k] = v
+
+        def get(self, k):
+            return self.data.get(k)
+
+    @ray_tpu.remote
+    def writer(store, k, v):
+        ray_tpu.get(store.put.remote(k, v))
+        return True
+
+    store = Store.remote()
+    ray_tpu.get(writer.remote(store, "x", 42))
+    assert ray_tpu.get(store.get.remote("x")) == 42
+
+
+def test_actor_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class Multi:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    multi = Multi.remote()
+    r1, r2 = multi.pair.remote()
+    assert ray_tpu.get([r1, r2]) == [1, 2]

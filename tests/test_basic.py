@@ -1,0 +1,147 @@
+"""Core API tests: tasks, objects, get/put/wait.
+
+Mirrors the reference's python/ray/tests/test_basic.py coverage at the
+behaviors that matter: remote calls, argument passing (values, refs, nested
+refs), multiple returns, errors crossing the boundary, large objects through
+shared memory, wait semantics.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_task_with_kwargs_and_refs(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=0, c=0):
+        return a + b + c
+
+    ref = ray_tpu.put(10)
+    assert ray_tpu.get(f.remote(1, b=ref, c=31)) == 42
+
+
+def test_chained_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 5
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    class CustomError(Exception):
+        pass
+
+    @ray_tpu.remote
+    def boom():
+        raise CustomError("bad")
+
+    ref = boom.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(ref)
+    # And the original type is preserved for except clauses.
+    with pytest.raises(CustomError):
+        ray_tpu.get(boom.remote())
+
+
+def test_large_task_result_through_plasma(ray_start_regular):
+    @ray_tpu.remote
+    def big():
+        return np.ones((512, 1024), dtype=np.float32)
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (512, 1024)
+    assert out.dtype == np.float32
+    assert float(out.sum()) == 512 * 1024
+
+
+def test_nested_refs_stay_refs(ray_start_regular):
+    @ray_tpu.remote
+    def consume(container):
+        inner = container["ref"]
+        assert isinstance(inner, ray_tpu.ObjectRef)
+        return ray_tpu.get(inner) + 1
+
+    inner = ray_tpu.put(41)
+    assert ray_tpu.get(consume.remote({"ref": inner})) == 42
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=10)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(30)
+
+    ref = sleeper.remote()
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.5)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(0)) == 11
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4
+    assert len(ray_tpu.nodes()) == 1
