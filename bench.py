@@ -1,0 +1,106 @@
+"""Benchmark: Llama training step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": "train_tokens_per_sec_per_chip", "value": N, "unit": "tok/s",
+   "vs_baseline": MFU/0.40, ...}
+
+The baseline target is the north star from BASELINE.json: >=40% MFU on the
+Llama fine-tune path (the reference has no in-repo number for this — 40% MFU
+is the bar it sets). vs_baseline > 1.0 means above-target MFU.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Peak bf16 FLOP/s per chip by device kind substring.
+PEAK_FLOPS = {
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5 lite": 197e12,  # v5e
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v4": 275e12,
+    "cpu": 1e12,  # nominal, so CPU smoke runs produce a line
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, value in PEAK_FLOPS.items():
+        if key in kind:
+            return value
+    return 197e12
+
+
+def main():
+    from ray_tpu.models import LlamaConfig, LlamaModel, cross_entropy_loss
+    from ray_tpu.parallel import (MeshConfig, create_train_state,
+                                  default_optimizer, make_train_step)
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        config = LlamaConfig.bench_350m()
+        batch, seq, steps = 4, 2048, 20
+    else:
+        config = LlamaConfig.tiny_test()
+        batch, seq, steps = 4, 256, 5
+
+    mesh = MeshConfig(data=-1).build()
+    model = LlamaModel(config)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tokens, mesh,
+        default_optimizer(total_steps=1000))
+
+    def loss_fn(params, batch_data):
+        logits = model.apply({"params": params}, batch_data["tokens"])
+        return cross_entropy_loss(logits[:, :-1], batch_data["tokens"][:, 1:])
+
+    train_step = make_train_step(loss_fn, mesh)
+    rng = jax.random.PRNGKey(1)
+    data = {"tokens": jax.random.randint(rng, (batch, seq), 0,
+                                         config.vocab_size)}
+
+    with mesh:
+        # Warmup / compile. NOTE: fence with device_get of a scalar, not
+        # block_until_ready — some PJRT transports (e.g. relayed remote
+        # execution) resolve buffer readiness at dispatch time.
+        state, metrics = train_step(state, data)
+        float(jax.device_get(metrics["loss"]))
+        start = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = train_step(state, data)
+        final_loss = float(jax.device_get(metrics["loss"]))
+        elapsed = time.perf_counter() - start
+
+    n_devices = jax.device_count()
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / elapsed
+    tokens_per_sec_per_chip = tokens_per_sec / n_devices
+
+    n_params = config.num_params()
+    flops_per_token = 6 * n_params + 12 * config.num_layers * seq * \
+        config.hidden_size
+    achieved = tokens_per_sec_per_chip * flops_per_token
+    peak = peak_flops(jax.devices()[0])
+    mfu = achieved / peak
+
+    print(json.dumps({
+        "metric": "train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": round(mfu, 4),
+        "model_params": n_params,
+        "batch": batch, "seq": seq, "steps": steps,
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

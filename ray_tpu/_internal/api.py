@@ -104,6 +104,15 @@ def init(address: Optional[str] = None, *,
             "add_job", driver_address=worker.rpc_address,
             namespace=namespace)
         worker.job_id = job_id
+        # Propagate the driver's import environment so workers can
+        # deserialize functions defined in driver-side modules (reference:
+        # runtime-env working_dir / py_modules path propagation).
+        import sys as _sys
+        from . import serialization as _ser
+        worker.gcs.put("job_meta", job_id.hex(), _ser.dumps({
+            "sys_path": [p for p in _sys.path if p],
+            "cwd": os.getcwd(),
+        }))
         set_core_worker(worker)
         atexit.register(_atexit_shutdown)
         return worker

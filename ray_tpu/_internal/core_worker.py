@@ -642,6 +642,7 @@ class TaskExecutor:
         self._reply_cache: Dict[bytes, Dict[int, Dict[str, Any]]] = {}
 
     async def execute(self, spec: TaskSpec) -> Dict[str, Any]:
+        await self._cw.ensure_job_env(spec.job_id)
         if spec.task_type == ACTOR_TASK:
             return await self._execute_actor_task(spec)
         loop = asyncio.get_running_loop()
@@ -780,13 +781,34 @@ class TaskExecutor:
         try:
             if spec.method_name == "__rtpu_terminate__":
                 return self._graceful_exit(spec)
-            args, kwargs = await asyncio.get_running_loop().run_in_executor(
+            loop = asyncio.get_running_loop()
+            args, kwargs = await loop.run_in_executor(
                 None, self._load_args, spec)
             method = getattr(self._actor_instance, spec.method_name)
-            result = method(*args, **kwargs)
-            if asyncio.iscoroutine(result):
-                result = await result
-            return await asyncio.get_running_loop().run_in_executor(
+            import inspect
+            if inspect.iscoroutinefunction(method):
+                RUNTIME_CTX.task_spec = spec
+                RUNTIME_CTX.actor_id = spec.actor_id
+                try:
+                    result = await method(*args, **kwargs)
+                finally:
+                    RUNTIME_CTX.task_spec = None
+                    RUNTIME_CTX.actor_id = None
+            else:
+                # Sync method on an async actor: run off-loop so it may
+                # block (e.g. a controller's run() that get()s on workers).
+                def _call(spec=spec):
+                    RUNTIME_CTX.task_spec = spec
+                    RUNTIME_CTX.actor_id = spec.actor_id
+                    try:
+                        return method(*args, **kwargs)
+                    finally:
+                        RUNTIME_CTX.task_spec = None
+                        RUNTIME_CTX.actor_id = None
+                result = await loop.run_in_executor(None, _call)
+                if asyncio.iscoroutine(result):
+                    result = await result
+            return await loop.run_in_executor(
                 None, self._package_returns, spec, result)
         except Exception as e:  # noqa: BLE001
             return {"error": TaskError(spec.method_name,
@@ -835,6 +857,7 @@ class CoreWorker:
         self.job_id = job_id or JobID.from_int(0)
         self.current_lease_id: Optional[int] = None
         self._node_addr_cache: Dict[str, Address] = {}
+        self._job_envs: Dict[JobID, "asyncio.Future"] = {}
         self._shutdown = False
 
     # -- lifecycle -------------------------------------------------------
@@ -850,6 +873,13 @@ class CoreWorker:
             EventLoopThread.get().run_sync(self.server.stop(), timeout=5)
         except Exception:
             pass
+
+    def current_job_id(self) -> JobID:
+        """The job of the task being executed, else this process's job —
+        nested submissions stay inside the driver's job without mutating
+        shared worker state."""
+        spec = RUNTIME_CTX.task_spec
+        return spec.job_id if spec is not None else self.job_id
 
     # -- plumbing --------------------------------------------------------
 
@@ -868,6 +898,36 @@ class CoreWorker:
             except Exception:
                 pass
         self.loop_call(_go())
+
+    async def ensure_job_env(self, job_id: JobID):
+        """Adopt the driver's sys.path so its locally-defined functions
+        deserialize here (reference: runtime-env path propagation).
+        Concurrent callers await one in-flight fetch; failures are retried
+        by the next task instead of being cached."""
+        done = self._job_envs.get(job_id)
+        if done is not None:
+            await done
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._job_envs[job_id] = fut
+        try:
+            raw = await self.gcs.call("kv_get", ns="job_meta",
+                                      key=job_id.hex())
+        except Exception:
+            del self._job_envs[job_id]  # transient: let the next task retry
+            fut.set_result(None)
+            return
+        if raw:
+            import sys
+            meta = serialization.loads(raw)
+            paths = list(meta.get("sys_path", []))
+            cwd = meta.get("cwd")
+            if cwd:
+                paths.append(cwd)  # the driver's '' (cwd) sys.path entry
+            for path in reversed(paths):
+                if path and path not in sys.path:
+                    sys.path.insert(0, path)
+        fut.set_result(None)
 
     async def node_address(self, node_id: str) -> Optional[Address]:
         addr = self._node_addr_cache.get(node_id)

@@ -182,6 +182,14 @@ class Raylet:
         worker_id = WorkerID.from_random().binary()
         env = dict(os.environ)
         env.update({k: v for k, v in env_key})
+        # Workers must import ray_tpu even when it isn't installed — put the
+        # package's parent dir on their PYTHONPATH.
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        existing = env.get("PYTHONPATH", "")
+        if pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
+                                 if existing else pkg_root)
         env.update({
             "RTPU_WORKER_ID": worker_id.hex(),
             "RTPU_SESSION": self.session_name,

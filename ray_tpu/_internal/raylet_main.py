@@ -1,0 +1,58 @@
+"""Standalone raylet process entrypoint (reference: raylet/main.cc via
+`ray start`). Used by cluster_utils.Cluster.add_node and the CLI to run
+worker nodes as real separate processes."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--node-index", type=int, required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--head", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[raylet {args.node_index}] %(levelname)s %(name)s: "
+               "%(message)s")
+    host, port = args.gcs_address.rsplit(":", 1)
+
+    from .raylet import Raylet
+
+    raylet = Raylet(
+        session_name=args.session,
+        gcs_address=(host, int(port)),
+        resources=json.loads(args.resources),
+        labels=json.loads(args.labels),
+        node_index=args.node_index,
+        is_head=args.head,
+        object_store_memory=args.object_store_memory or None)
+
+    async def run():
+        await raylet.start()
+        print(f"RTPU_RAYLET_READY {raylet.node_id} "
+              f"{raylet.address[0]}:{raylet.address[1]}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await raylet.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -81,10 +81,11 @@ class ActorHandle:
     def _submit_method(self, method_name: str, args, kwargs,
                        options: Dict[str, Any]):
         worker = get_core_worker()
+        job_id = worker.current_job_id()
         num_returns = options.get("num_returns", 1)
         spec = TaskSpec(
-            task_id=TaskID.of(worker.job_id),
-            job_id=worker.job_id,
+            task_id=TaskID.of(job_id),
+            job_id=job_id,
             task_type=ACTOR_TASK,
             function=FunctionDescriptor("", self._class_name, ""),
             args=pack_args(args, kwargs),
@@ -140,18 +141,19 @@ class ActorClass:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = get_core_worker()
+        job_id = worker.current_job_id()
         if self._descriptor is None:
             self._descriptor = worker.function_manager.export(
-                worker.job_id, self._cls)
+                job_id, self._cls)
         opts = self._options
-        actor_id = ActorID.of(worker.job_id)
+        actor_id = ActorID.of(job_id)
         lifetime = opts.get("lifetime")
         detached = lifetime == "detached"
         max_restarts = opts.get("max_restarts",
                                 CONFIG.actor_max_restarts_default)
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation(actor_id),
-            job_id=worker.job_id,
+            job_id=job_id,
             task_type=ACTOR_CREATION_TASK,
             function=self._descriptor,
             args=pack_args(args, kwargs),

@@ -1,0 +1,153 @@
+"""Multi-node test cluster on one machine
+(reference: python/ray/cluster_utils.py — Cluster, add_node).
+
+The head node (GCS + raylet) runs in-process; `add_node` launches additional
+raylets as real subprocesses, giving genuine multi-node semantics — separate
+object stores, cross-node object transfer, node kill/failure tests — without
+containers. This fixture carries most of the reference's distributed test
+coverage (SURVEY §4.2)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ._internal.node import Node, new_session_name
+from ._internal.rpc import Address
+
+
+class RemoteNodeHandle:
+    def __init__(self, proc: subprocess.Popen, node_id: str, address: Address,
+                 node_index: int):
+        self.proc = proc
+        self.node_id = node_id
+        self.address = address
+        self.node_index = node_index
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[Dict] = None):
+        self.session_name = new_session_name()
+        self.head_node: Optional[Node] = None
+        self.remote_nodes: List[RemoteNodeHandle] = []
+        self._next_index = 1
+        self._connected = False
+        if initialize_head:
+            args = dict(head_node_args or {})
+            system_config = args.pop("_system_config", None)
+            if system_config:
+                from ._internal.config import CONFIG
+                CONFIG.apply_system_config(system_config)
+            self.head_node = Node(
+                head=True, session_name=self.session_name,
+                resources=args.get("resources", {"CPU": args.get("num_cpus", 2)}),
+                labels=args.get("labels"),
+                object_store_memory=args.get("object_store_memory"))
+            self.head_node.start()
+
+    @property
+    def gcs_address(self) -> Address:
+        return self.head_node.gcs_address
+
+    @property
+    def address(self) -> str:
+        host, port = self.gcs_address
+        return f"{host}:{port}"
+
+    def connect(self, namespace: str = ""):
+        """Attach the current process as the driver."""
+        import ray_tpu
+        worker = ray_tpu.init(_node=self.head_node, namespace=namespace)
+        self._connected = True
+        return worker
+
+    def add_node(self, num_cpus: float = 2, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_memory: int = 0,
+                 env: Optional[Dict[str, str]] = None,
+                 wait: bool = True) -> RemoteNodeHandle:
+        node_resources = dict(resources or {})
+        node_resources.setdefault("CPU", num_cpus)
+        if num_tpus:
+            node_resources["TPU"] = num_tpus
+        index = self._next_index
+        self._next_index += 1
+        cmd = [
+            sys.executable, "-m", "ray_tpu._internal.raylet_main",
+            "--gcs-address", self.address,
+            "--session", self.session_name,
+            "--node-index", str(index),
+            "--resources", json.dumps(node_resources),
+            "--labels", json.dumps(labels or {}),
+        ]
+        if object_store_memory:
+            cmd += ["--object-store-memory", str(object_store_memory)]
+        proc_env = dict(os.environ)
+        proc_env.update(env or {})
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=None, env=proc_env, text=True)
+        node_id, address = None, None
+        if wait:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if line.startswith("RTPU_RAYLET_READY"):
+                    _, node_id, addr = line.split()
+                    host, port = addr.rsplit(":", 1)
+                    address = (host, int(port))
+                    break
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"raylet subprocess exited rc={proc.returncode}")
+            else:
+                raise TimeoutError("raylet did not come up in 60s")
+        handle = RemoteNodeHandle(proc, node_id, address, index)
+        self.remote_nodes.append(handle)
+        return handle
+
+    def remove_node(self, handle: RemoteNodeHandle,
+                    allow_graceful: bool = False):
+        """Kill a node (SIGKILL unless graceful) — failure-injection
+        primitive for fault-tolerance tests."""
+        if allow_graceful:
+            handle.proc.terminate()
+        else:
+            handle.proc.kill()
+        handle.proc.wait(timeout=30)
+        self.remote_nodes.remove(handle)
+
+    def wait_for_nodes(self, count: Optional[int] = None,
+                       timeout: float = 60.0):
+        """Wait until the GCS sees `count` alive nodes (default: all)."""
+        import ray_tpu
+        expected = count if count is not None \
+            else 1 + len(self.remote_nodes)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            alive = [n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]
+            if len(alive) >= expected:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"expected {expected} alive nodes within {timeout}s")
+
+    def shutdown(self):
+        import ray_tpu
+        if self._connected:
+            ray_tpu.shutdown()
+        for handle in list(self.remote_nodes):
+            try:
+                handle.proc.kill()
+                handle.proc.wait(timeout=10)
+            except Exception:
+                pass
+        self.remote_nodes.clear()
+        if self.head_node is not None:
+            self.head_node.stop()
+            self.head_node = None

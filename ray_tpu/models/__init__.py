@@ -1,0 +1,5 @@
+from .llama import (LlamaConfig, LlamaModel, cross_entropy_loss,
+                    init_kv_caches)
+
+__all__ = ["LlamaConfig", "LlamaModel", "cross_entropy_loss",
+           "init_kv_caches"]

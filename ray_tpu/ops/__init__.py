@@ -1,0 +1,4 @@
+from .attention import (attention_chunked, attention_reference,
+                        flash_attention)
+
+__all__ = ["flash_attention", "attention_chunked", "attention_reference"]

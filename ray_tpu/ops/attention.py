@@ -1,0 +1,266 @@
+"""Attention kernels.
+
+The hot op of every transformer in the framework. Three tiers:
+
+1. `attention_reference` — naive O(S^2)-memory jnp implementation; the
+   numerical ground truth for tests.
+2. `attention_chunked` — blockwise online-softmax attention via lax.scan
+   (memory-efficient attention): O(S * chunk) memory, fully differentiable,
+   runs on any backend. Used as the backward pass everywhere and as the
+   forward on non-TPU backends.
+3. `_flash_fwd_tpu` — Pallas TPU kernel: tiled online softmax, fp32
+   accumulators in VMEM scratch, causal block skipping, GQA via kv-head
+   index mapping. Forward-only; `flash_attention` wires it into a
+   custom_vjp whose backward recomputes through (2) (flash-style
+   recompute — no S^2 residuals are ever materialized).
+
+All functions take q/k/v as [batch, heads, seq, head_dim] (BHSD) in bf16 or
+f32, with GQA expressed as k/v having fewer heads (num_q_heads must be a
+multiple of num_kv_heads). `q_offset`/`kv_offset` shift the causal mask for
+sequence-parallel callers (ring attention passes the rotating chunk offset).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _validate(q, k, v):
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError("q/k/v must be [batch, heads, seq, head_dim]")
+    if q.shape[1] % k.shape[1] != 0:
+        raise ValueError(
+            f"q heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}")
+
+
+def _expand_kv(q, k, v):
+    """Repeat kv heads up to q heads for the non-kernel paths."""
+    groups = q.shape[1] // k.shape[1]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=1)
+        v = jnp.repeat(v, groups, axis=1)
+    return k, v
+
+
+def attention_reference(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None,
+                        q_offset: int = 0, kv_offset: int = 0):
+    _validate(q, k, v)
+    k, v = _expand_kv(q, k, v)
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
+        k_pos = kv_offset + jnp.arange(k.shape[2])[None, :]
+        logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def attention_chunked(q, k, v, causal: bool = True,
+                      sm_scale: Optional[float] = None,
+                      q_offset: int = 0, kv_offset: int = 0,
+                      chunk_size: int = 512):
+    """Blockwise attention: scan over KV chunks with running (m, l, acc)."""
+    _validate(q, k, v)
+    k, v = _expand_kv(q, k, v)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    chunk = min(chunk_size, sk)
+    if sk % chunk != 0:
+        # Fall back: odd kv lengths take the reference path.
+        return attention_reference(q, k, v, causal, sm_scale, q_offset,
+                                   kv_offset)
+    n_chunks = sk // chunk
+    kc = k.reshape(b, h, n_chunks, chunk, d)
+    vc = v.reshape(b, h, n_chunks, chunk, d)
+    qf = q.astype(jnp.float32)
+    q_pos = q_offset + jnp.arange(sq)
+
+    def step(carry, inputs):
+        m, l, acc = carry
+        idx, k_blk, v_blk = inputs
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                            k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = kv_offset + idx * chunk + jnp.arange(chunk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, NEG_INF)
+        m_blk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(logits - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        acc_new = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        return (m_new, l_new, acc_new), None
+
+    init = (jnp.full((b, h, sq), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, sq), jnp.float32),
+            jnp.zeros((b, h, sq, d), jnp.float32))
+    kc_t = jnp.moveaxis(kc, 2, 0)
+    vc_t = jnp.moveaxis(vc, 2, 0)
+    (m, l, acc), _ = jax.lax.scan(
+        step, init, (jnp.arange(n_chunks), kc_t, vc_t))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
+                  acc_scratch, *, sm_scale: float, causal: bool,
+                  block_q: int, block_k: int, kv_len: int):
+    """Grid: (batch*q_heads, num_q_blocks, num_k_blocks); the k dimension is
+    the innermost 'arbitrary' axis we accumulate over."""
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qb = pl.program_id(1)
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_prev = m_scratch[:]                      # [block_q, 1]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(logits - m_new)
+        correction = jnp.exp(m_prev - m_new)
+        m_scratch[:] = m_new
+        l_scratch[:] = l_scratch[:] * correction + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_scratch[:] = acc_scratch[:] * correction + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+
+    if causal:
+        # Skip fully-masked kv blocks (k start beyond q end).
+        qb = pl.program_id(1)
+
+        @pl.when(kb * block_k <= qb * block_q + block_q - 1)
+        def _go():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        o_ref[0] = (acc_scratch[:] /
+                    jnp.maximum(l_scratch[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_tpu(q, k, v, causal: bool, sm_scale: float,
+                   block_q: int = 256, block_k: int = 512):
+    b, h, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    groups = h // hk
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError("seq lengths must divide the block sizes")
+    grid = (b * h, sq // block_q, sk // block_k)
+
+    def q_index(bh, qb, kb):
+        return (bh, qb, 0)
+
+    def kv_index(bh, qb, kb):
+        # GQA: query head bh%h maps to kv head (bh%h)//groups.
+        batch = bh // h
+        kv_head = (bh % h) // groups
+        return (batch * hk + kv_head, kb, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k, kv_len=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), q_index),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q.reshape(b * h, sq, d), k.reshape(b * hk, sk, d),
+      v.reshape(b * hk, sk, d))
+    return out.reshape(b, h, sq, d)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    q_offset: int = 0, kv_offset: int = 0):
+    """Dispatching flash attention; differentiable everywhere (backward
+    recomputes through the chunked path — no S^2 residuals)."""
+    return _flash_forward(q, k, v, causal, sm_scale, q_offset, kv_offset)
+
+
+def _flash_forward(q, k, v, causal, sm_scale, q_offset, kv_offset):
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if (_on_tpu() and q_offset == 0 and kv_offset == 0
+            and q.shape[2] >= 128 and q.shape[2] % 128 == 0
+            and k.shape[2] % 128 == 0 and q.shape[3] in (64, 128, 256)):
+        try:
+            return _flash_fwd_tpu(q, k, v, causal, scale)
+        except Exception:
+            pass
+    return attention_chunked(q, k, v, causal, scale, q_offset, kv_offset)
+
+
+def _flash_fwd_rule(q, k, v, causal, sm_scale, q_offset, kv_offset):
+    out = _flash_forward(q, k, v, causal, sm_scale, q_offset, kv_offset)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, sm_scale, q_offset, kv_offset, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_chunked(
+            q_, k_, v_, causal, sm_scale, q_offset, kv_offset), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

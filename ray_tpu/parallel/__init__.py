@@ -1,0 +1,10 @@
+from .mesh import (DEFAULT_LOGICAL_AXIS_RULES, MeshConfig, named_sharding,
+                   params_shardings, shard_logical, unbox)
+from .spmd import (TrainState, create_train_state, default_optimizer,
+                   make_train_step)
+
+__all__ = [
+    "MeshConfig", "DEFAULT_LOGICAL_AXIS_RULES", "named_sharding",
+    "shard_logical", "params_shardings", "unbox", "TrainState",
+    "create_train_state", "make_train_step", "default_optimizer",
+]

@@ -1,0 +1,156 @@
+"""Device meshes and logical-axis sharding.
+
+The TPU-native answer to the reference's parallelism delegation (SURVEY §2d):
+instead of handing TP/PP/SP to an external engine, parallelism here is a
+property of a named device mesh. Pick a MeshConfig, annotate arrays with
+logical axis names, and GSPMD inserts the collectives (allreduce /
+all-gather / reduce-scatter over ICI, DCN axes across slices).
+
+Axis vocabulary (sizes of 1 are legal and erased at trace time):
+  data      — pure data parallelism (batch sharding, gradient allreduce)
+  fsdp      — data parallelism with parameter/optimizer sharding (ZeRO-3:
+              params all-gathered per layer, grads reduce-scattered)
+  tensor    — tensor parallelism (megatron-style head/mlp sharding)
+  sequence  — sequence/context parallelism (ring attention, Ulysses)
+  expert    — expert parallelism for MoE
+  pipeline  — pipeline stages (microbatched shard_map loop)
+
+Logical axis names used by the model libraries are mapped to mesh axes by
+LOGICAL_AXIS_RULES (t5x-style), overridable per MeshConfig.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_ORDER = ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicated)
+DEFAULT_LOGICAL_AXIS_RULES: Tuple[Tuple[str, object], ...] = (
+    ("batch", ("data", "fsdp")),
+    ("activation_batch", ("data", "fsdp")),
+    ("activation_seq", "sequence"),
+    ("activation_embed", None),
+    ("activation_heads", "tensor"),
+    ("activation_kv", None),
+    ("activation_mlp", "tensor"),
+    ("embed", "fsdp"),
+    ("vocab", "tensor"),
+    ("heads", "tensor"),
+    ("kv_heads", "tensor"),
+    ("head_dim", None),
+    ("mlp", "tensor"),
+    ("expert", "expert"),
+    ("layers", None),
+    ("stage", "pipeline"),
+    ("seq", "sequence"),
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape. Unset axes default to 1; `data=-1` absorbs
+    whatever devices remain (like a reshape wildcard)."""
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+    pipeline: int = 1
+    expert: int = 1
+    # Axes that cross slice boundaries ride DCN, not ICI; list them here so
+    # multi-slice topologies lay out correctly (reference for the concept:
+    # jax multi-slice `dcn_mesh_shape`).
+    dcn_axes: Tuple[str, ...] = ()
+    logical_axis_rules: Tuple[Tuple[str, object], ...] = \
+        DEFAULT_LOGICAL_AXIS_RULES
+
+    def axis_sizes(self, num_devices: int) -> Dict[str, int]:
+        sizes = {
+            "data": self.data, "fsdp": self.fsdp, "tensor": self.tensor,
+            "sequence": self.sequence, "pipeline": self.pipeline,
+            "expert": self.expert,
+        }
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        wildcard = [k for k, v in sizes.items() if v == -1]
+        if len(wildcard) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if wildcard:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            sizes[wildcard[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {fixed} devices, have {num_devices}")
+        return sizes
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.axis_sizes(len(devices))
+        shape = tuple(sizes[a] for a in AXIS_ORDER)
+        dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, AXIS_ORDER)
+
+    def rules_dict(self) -> Dict[str, object]:
+        return dict(self.logical_axis_rules)
+
+
+def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
+                         rules: Dict[str, object]) -> P:
+    """Map ('batch','seq','embed') -> PartitionSpec(('data','fsdp'),...)"""
+    out = []
+    for name in logical_axes:
+        if name is None:
+            out.append(None)
+        else:
+            out.append(rules.get(name))
+    return P(*out)
+
+
+def named_sharding(mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                   rules: Optional[Dict[str, object]] = None) -> NamedSharding:
+    rules = rules if rules is not None else dict(DEFAULT_LOGICAL_AXIS_RULES)
+    return NamedSharding(mesh, logical_to_mesh_axes(logical_axes, rules))
+
+
+def shard_logical(x, mesh: Mesh, logical_axes: Sequence[Optional[str]],
+                  rules: Optional[Dict[str, object]] = None):
+    """In-jit sharding constraint by logical axis names."""
+    spec = logical_to_mesh_axes(
+        logical_axes, rules if rules is not None
+        else dict(DEFAULT_LOGICAL_AXIS_RULES))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def params_shardings(params, mesh: Mesh,
+                     rules: Optional[Dict[str, object]] = None):
+    """Build a pytree of NamedShardings from flax logical-axis metadata
+    (nn.with_logical_partitioning names on each param)."""
+    import flax.linen as nn
+    rules_d = rules if rules is not None else dict(DEFAULT_LOGICAL_AXIS_RULES)
+
+    def one(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return named_sharding(mesh, leaf.names, rules_d)
+        return NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        one, params, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def unbox(params):
+    """Strip flax Partitioned boxes to raw arrays."""
+    import flax.linen as nn
+    return jax.tree_util.tree_map(
+        lambda x: x.value if isinstance(x, nn.Partitioned) else x, params,
+        is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def mesh_info(mesh: Mesh) -> Dict[str, int]:
+    return {axis: int(size) for axis, size in mesh.shape.items()}

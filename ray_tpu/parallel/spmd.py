@@ -1,0 +1,122 @@
+"""SPMD training: sharded state creation + pjit train step.
+
+This is the hot path of the whole framework: one jitted function per train
+step, parameters/optimizer state laid out by logical-axis rules, gradients
+synchronized by GSPMD-inserted collectives over ICI (no NCCL-style explicit
+allreduce — the mesh IS the communication backend; SURVEY §2d/§5).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import (DEFAULT_LOGICAL_AXIS_RULES, logical_to_mesh_axes,
+                   named_sharding, params_shardings, unbox)
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state,
+                                                self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(step=self.step + 1, params=new_params,
+                            opt_state=new_opt_state)
+
+
+def logical_names_tree(model: nn.Module, rng, sample_input) -> Any:
+    """Pytree of logical-axis-name tuples (or None) per param leaf."""
+    boxed = jax.eval_shape(lambda r: model.init(r, sample_input), rng)
+    boxed = boxed["params"]
+
+    def one(leaf):
+        if isinstance(leaf, nn.Partitioned):
+            return leaf.names
+        return None
+    return jax.tree_util.tree_map(
+        one, boxed, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def shardings_tree(names_tree, mesh: Mesh, rules: Dict[str, Any]):
+    def one(names):
+        if names is None:
+            return NamedSharding(mesh, P())
+        return named_sharding(mesh, names, rules)
+    return jax.tree_util.tree_map(one, names_tree,
+                                  is_leaf=lambda x: x is None
+                                  or isinstance(x, tuple))
+
+
+def create_train_state(rng, model: nn.Module, sample_input,
+                       mesh: Mesh, tx: optax.GradientTransformation,
+                       rules: Optional[Dict[str, Any]] = None) -> TrainState:
+    """Initialize parameters *already sharded* across the mesh: the init fn
+    is jitted with sharding constraints inside so no host ever materializes
+    the full parameter tree."""
+    rules = rules if rules is not None else dict(DEFAULT_LOGICAL_AXIS_RULES)
+    names = logical_names_tree(model, rng, sample_input)
+    shardings = shardings_tree(names, mesh, rules)
+
+    def init_fn(r):
+        params = unbox(model.init(r, sample_input)["params"])
+        params = jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, params, shardings)
+        opt_state = tx.init(params)
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          opt_state=opt_state, apply_fn=model.apply, tx=tx)
+
+    with mesh:
+        return jax.jit(init_fn)(rng)
+
+
+def make_train_step(loss_fn: Callable, mesh: Mesh,
+                    rules: Optional[Dict[str, Any]] = None,
+                    batch_axes: Tuple = ("batch", "seq"),
+                    donate: bool = True):
+    """Build the jitted SPMD train step.
+
+    loss_fn(params, batch) -> scalar loss (model.apply inside). The batch is
+    constrained to the data axes; everything else is GSPMD's problem.
+    """
+    rules = rules if rules is not None else dict(DEFAULT_LOGICAL_AXIS_RULES)
+    batch_sharding = named_sharding(mesh, batch_axes, rules)
+
+    def step_fn(state: TrainState, batch):
+        batch = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, batch_sharding) if x.ndim == len(batch_axes) else x,
+            batch)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        new_state = state.apply_gradients(grads)
+        metrics = {"loss": loss,
+                   "grad_norm": optax.global_norm(grads)}
+        return new_state, metrics
+
+    return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+
+def default_optimizer(learning_rate: float = 3e-4,
+                      weight_decay: float = 0.1,
+                      warmup_steps: int = 100,
+                      total_steps: int = 10_000,
+                      b1: float = 0.9, b2: float = 0.95,
+                      clip_norm: float = 1.0) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )

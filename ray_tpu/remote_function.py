@@ -68,16 +68,17 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         worker = get_core_worker()
+        job_id = worker.current_job_id()
         if self._descriptor is None:
             self._descriptor = worker.function_manager.export(
-                worker.job_id, self._function)
+                job_id, self._function)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
         max_retries = opts.get("max_retries",
                                CONFIG.task_max_retries_default)
         spec = TaskSpec(
-            task_id=TaskID.of(worker.job_id),
-            job_id=worker.job_id,
+            task_id=TaskID.of(job_id),
+            job_id=job_id,
             task_type=NORMAL_TASK,
             function=self._descriptor,
             args=pack_args(args, kwargs),

@@ -1,0 +1,14 @@
+from .checkpoint import Checkpoint, load_pytree, save_pytree
+from .collectives import barrier, broadcast_from_rank_zero
+from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                     ScalingConfig)
+from .context import get_checkpoint, get_context, get_dataset_shard, report
+from .result import Result
+from .trainer import JaxTrainer
+
+__all__ = [
+    "JaxTrainer", "ScalingConfig", "RunConfig", "FailureConfig",
+    "CheckpointConfig", "Checkpoint", "Result", "report", "get_checkpoint",
+    "get_context", "get_dataset_shard", "barrier",
+    "broadcast_from_rank_zero", "save_pytree", "load_pytree",
+]

@@ -1,0 +1,57 @@
+"""Checkpoints (reference: ray.train.Checkpoint + StorageContext;
+train/v2/_internal/execution/storage.py).
+
+A Checkpoint is a directory handle. Persistence is a filesystem copy into the
+run's storage path (sharded writes via orbax land directly in the target
+directory — checkpoint I/O stays off the train step's critical path when
+called from `report`)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: str) -> str:
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, path: str):
+    """Orbax-backed pytree save (sharded-array aware on TPU)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    checkpointer = ocp.PyTreeCheckpointer()
+    checkpointer.save(path, tree, force=True)
+
+
+def load_pytree(path: str, target: Optional[Any] = None) -> Any:
+    import orbax.checkpoint as ocp
+    checkpointer = ocp.PyTreeCheckpointer()
+    if target is not None:
+        return checkpointer.restore(os.path.abspath(path), item=target)
+    return checkpointer.restore(os.path.abspath(path))
+
+
+def new_checkpoint_dir(storage_path: str, run_name: str, index: int) -> str:
+    return os.path.join(storage_path, run_name,
+                        f"checkpoint_{index:06d}_{uuid.uuid4().hex[:6]}")

@@ -1,0 +1,24 @@
+"""Control-plane collectives for the train loop
+(reference: train/collective/collectives.py:14 broadcast_from_rank_zero,
+:57 barrier — controller-mediated, NOT the tensor data plane)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from .context import get_context
+
+
+def barrier(name: str = "default"):
+    import ray_tpu
+    ctx = get_context()
+    ray_tpu.get(ctx.controller.barrier.remote(
+        name, ctx.rank, ctx.world_size), timeout=600)
+
+
+def broadcast_from_rank_zero(value: Any = None, name: str = "default") -> Any:
+    import ray_tpu
+    ctx = get_context()
+    return ray_tpu.get(ctx.controller.broadcast_from_rank_zero.remote(
+        name, ctx.rank, ctx.world_size,
+        value if ctx.rank == 0 else None), timeout=600)

@@ -1,0 +1,62 @@
+"""Train configuration dataclasses
+(reference: train/v2/api/config.py — ScalingConfig with use_tpu/topology
+:89-123, RunConfig, FailureConfig, CheckpointConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one holds.
+
+    TPU semantics: `use_tpu=True` with `topology` (e.g. "v5p-64") gang-
+    reserves a whole slice (one worker per host, SPREAD across the slice's
+    hosts, all inside one ICI domain) — reference: JaxTrainer's
+    reserve_tpu_slice flow. Single-host: `resources_per_worker={"TPU": n}`.
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def __post_init__(self):
+        if self.use_tpu and self.topology is None \
+                and self.num_workers > 1:
+            raise ValueError(
+                "multi-worker TPU training requires topology= (the slice "
+                "pod type, e.g. 'v5p-64') so the workers land on one ICI "
+                "domain")
+        if self.use_tpu:
+            self.placement_strategy = "SPREAD"
+
+    def worker_resources(self) -> Dict[str, float]:
+        resources = dict(self.resources_per_worker or {})
+        if self.use_tpu and "TPU" not in resources:
+            resources["TPU"] = 4  # chips per host default
+        resources.setdefault("CPU", 1)
+        return resources
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = 2
+    checkpoint_frequency: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)

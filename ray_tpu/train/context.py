@@ -1,0 +1,80 @@
+"""Per-worker train context
+(reference: train/v2/_internal/execution/train_fn_utils.py — report :35,
+get_checkpoint :60, get_dataset_shard :79; ray.train.get_context)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, node_rank: int,
+                 controller_handle, run_name: str,
+                 resume_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.node_rank = node_rank
+        self.controller = controller_handle
+        self.run_name = run_name
+        self.resume_checkpoint = resume_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.report_index = 0
+
+    # -- reference API ----------------------------------------------------
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+    def get_local_rank(self) -> int:
+        return 0  # one worker per host in the TPU model
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_experiment_name(self) -> str:
+        return self.run_name
+
+
+def set_train_context(ctx: Optional[TrainContext]):
+    _local.ctx = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("not inside a train worker; "
+                           "get_context() is only valid in the train loop")
+    return ctx
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (and optionally a checkpoint) to the controller
+    (reference: ray.train.report)."""
+    import ray_tpu
+    ctx = get_context()
+    ctx.report_index += 1
+    checkpoint_path = checkpoint.path if checkpoint is not None else None
+    ray_tpu.get(ctx.controller.report.remote(
+        ctx.rank, ctx.report_index, metrics, checkpoint_path))
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    shard = get_context().dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(f"no dataset shard named {name!r}; pass datasets= "
+                       "to the trainer")
+    return shard

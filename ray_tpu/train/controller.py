@@ -1,0 +1,132 @@
+"""TrainController: the run's control-plane actor
+(reference: train/v2/_internal/execution/controller/controller.py:96 —
+async control loop, worker-group lifecycle, failure policy, checkpoint
+bookkeeping).
+
+The controller is an async actor: worker `report` calls and the driver's
+`run` call interleave on its event loop. Data-plane collectives never touch
+it — gradients ride ICI inside the workers' jitted programs; the controller
+only sees metrics, checkpoints, and liveness."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+
+class TrainController:
+    """Spawned via ray_tpu as an actor by JaxTrainer.fit."""
+
+    def __init__(self, train_fn, train_fn_config, scaling_config_dict,
+                 run_config_dict, run_name: str, storage_path: str,
+                 resume_from: Optional[str] = None,
+                 dataset_factories: Optional[Dict[str, Any]] = None):
+        from .config import (CheckpointConfig, FailureConfig, RunConfig,
+                             ScalingConfig)
+        self.train_fn = train_fn
+        self.train_fn_config = train_fn_config or {}
+        self.scaling = ScalingConfig(**scaling_config_dict)
+        failure = run_config_dict.pop("failure_config", {})
+        ckpt = run_config_dict.pop("checkpoint_config", {})
+        self.run_config = RunConfig(
+            failure_config=FailureConfig(**failure),
+            checkpoint_config=CheckpointConfig(**ckpt),
+            **run_config_dict)
+        self.run_name = run_name
+        self.storage_path = storage_path
+        self.resume_from = resume_from
+        self.dataset_factories = dataset_factories or {}
+        self.worker_group = None
+        self.reports: Dict[int, List[Dict[str, Any]]] = {}
+        self.checkpoints: List[str] = []
+        self.latest_checkpoint: Optional[str] = resume_from
+        self.num_failures = 0
+        self._barriers: Dict[str, Dict] = {}
+        self._broadcasts: Dict[str, Any] = {}
+
+    # -- worker-facing RPCs ----------------------------------------------
+
+    def report(self, rank: int, index: int, metrics: Dict[str, Any],
+               checkpoint_path: Optional[str]):
+        self.reports.setdefault(rank, []).append(metrics)
+        if checkpoint_path and rank == 0:
+            self._register_checkpoint(checkpoint_path)
+        return True
+
+    def _register_checkpoint(self, path: str):
+        self.latest_checkpoint = path
+        self.checkpoints.append(path)
+        keep = self.run_config.checkpoint_config.num_to_keep
+        if keep is not None:
+            while len(self.checkpoints) > keep:
+                victim = self.checkpoints.pop(0)
+                shutil.rmtree(victim, ignore_errors=True)
+
+    async def barrier(self, name: str, rank: int, world_size: int):
+        """Controller-mediated control-plane barrier (reference:
+        train/collective/collectives.py:57 — NOT for tensors)."""
+        entry = self._barriers.setdefault(
+            name, {"count": 0, "event": asyncio.Event(), "gen": 0})
+        entry["count"] += 1
+        if entry["count"] >= world_size:
+            entry["count"] = 0
+            entry["gen"] += 1
+            event = entry["event"]
+            entry["event"] = asyncio.Event()
+            event.set()
+        else:
+            await entry["event"].wait()
+        return True
+
+    async def broadcast_from_rank_zero(self, name: str, rank: int,
+                                       world_size: int, value=None):
+        if rank == 0:
+            self._broadcasts[name] = value
+        await self.barrier(f"__bc_{name}", rank, world_size)
+        return self._broadcasts.get(name)
+
+    # -- driver-facing ----------------------------------------------------
+
+    def run(self):
+        """Synchronous driver entrypoint: start workers, wait, retry on
+        failure per FailureConfig (restart the whole SPMD group from the
+        last checkpoint — a mesh cannot shrink mid-program, so elasticity is
+        re-mesh + resume; SURVEY §7 'hard parts')."""
+        max_failures = self.run_config.failure_config.max_failures
+        while True:
+            try:
+                return self._run_attempt()
+            except Exception:  # noqa: BLE001 — worker failures land here
+                self.num_failures += 1
+                if self.num_failures > max_failures:
+                    raise
+                time.sleep(1.0)
+
+    def _run_attempt(self):
+        import ray_tpu
+        from .worker_group import WorkerGroup
+        from ..actor import ActorHandle
+        self_handle = ray_tpu.get_actor(self.run_name + "-controller")
+        group = WorkerGroup(scaling=self.scaling, run_name=self.run_name,
+                            controller=self_handle)
+        self.worker_group = group
+        try:
+            group.start()
+            futures = group.run_train_fn(
+                self.train_fn, self.train_fn_config,
+                resume_checkpoint=self.latest_checkpoint,
+                dataset_factories=self.dataset_factories)
+            worker_results = ray_tpu.get(futures)
+        finally:
+            group.shutdown()
+        rank0_reports = self.reports.get(0, [])
+        return {
+            "metrics": rank0_reports[-1] if rank0_reports else {},
+            "all_reports": self.reports,
+            "checkpoint": self.latest_checkpoint,
+            "worker_returns": worker_results,
+            "num_failures": self.num_failures,
+        }

@@ -1,0 +1,17 @@
+"""Result of a training run (reference: ray.train.Result / air result)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from .checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Checkpoint]
+    error: Optional[BaseException] = None
+    path: str = ""
+    num_failures: int = 0

@@ -1,0 +1,104 @@
+"""JaxTrainer (reference: train/v2/jax/jax_trainer.py:19 + the
+DataParallelTrainer pattern, v2/api/data_parallel_trainer.py:118).
+
+fit() spawns a named TrainController actor and blocks on controller.run():
+the controller owns the worker group, failure handling, and checkpoint
+bookkeeping; each worker runs `train_loop_per_worker` with
+ray_tpu.train.get_context() available."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from .checkpoint import Checkpoint
+from .config import RunConfig, ScalingConfig
+from .result import Result
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        import ray_tpu
+        from .controller import TrainController
+
+        run_name = self.run_config.name or \
+            f"train-{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:6]}"
+        storage = self.run_config.storage_path or \
+            os.path.join("/tmp", "rtpu-train")
+        os.makedirs(storage, exist_ok=True)
+
+        dataset_factories = {}
+        for name, ds in self.datasets.items():
+            dataset_factories[name] = _dataset_factory(ds)
+
+        controller_cls = ray_tpu.remote(TrainController)
+        controller = controller_cls.options(
+            name=f"{run_name}-controller", num_cpus=0,
+            max_concurrency=max(8, self.scaling_config.num_workers + 2),
+        ).remote(
+            self.train_loop_per_worker, self.train_loop_config,
+            dataclasses.asdict(self.scaling_config),
+            {
+                "name": self.run_config.name,
+                "storage_path": self.run_config.storage_path,
+                "failure_config": dataclasses.asdict(
+                    self.run_config.failure_config),
+                "checkpoint_config": dataclasses.asdict(
+                    self.run_config.checkpoint_config),
+            },
+            run_name, storage,
+            self.resume_from_checkpoint.path
+            if self.resume_from_checkpoint else None,
+            dataset_factories)
+        try:
+            raw = ray_tpu.get(controller.run.remote(), timeout=None)
+        except ray_tpu.TaskError as e:
+            return Result(metrics={}, checkpoint=None,
+                          error=e, path=os.path.join(storage, run_name))
+        finally:
+            try:
+                ray_tpu.kill(controller)
+            except Exception:
+                pass
+        return Result(
+            metrics=raw["metrics"],
+            checkpoint=Checkpoint(raw["checkpoint"])
+            if raw.get("checkpoint") else None,
+            error=None,
+            path=os.path.join(storage, run_name),
+            num_failures=raw.get("num_failures", 0))
+
+
+def _dataset_factory(ds):
+    """Wrap a dataset (ray_tpu.data Dataset, list, or callable) into a
+    per-rank shard factory."""
+    try:
+        from ..data.dataset import Dataset
+    except ImportError:
+        Dataset = None
+    if Dataset is not None and isinstance(ds, Dataset):
+        def factory(rank, world_size, _ds=ds):
+            return _ds.shard(rank, world_size)
+        return factory
+    if callable(ds):
+        return ds
+
+    def const_factory(rank, world_size, _ds=ds):
+        return _ds
+    return const_factory

@@ -1,0 +1,13 @@
+from .collective import (allgather, allreduce, barrier, broadcast,
+                         create_collective_group, destroy_collective_group,
+                         get_rank, get_collective_group_size,
+                         init_collective_group, recv, reduce, reducescatter,
+                         send)
+from . import xla
+
+__all__ = [
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "allreduce", "allgather", "reducescatter",
+    "broadcast", "reduce", "send", "recv", "barrier", "get_rank",
+    "get_collective_group_size", "xla",
+]

@@ -1,0 +1,327 @@
+"""Out-of-program collectives over the host/DCN plane.
+
+Role of the reference's `ray.util.collective` (collective.py:166-708 with its
+NCCL/gloo backends). The TPU framework has TWO collective planes (SURVEY §5):
+
+- **In-program (ICI)**: collectives inside jitted SPMD programs — psum /
+  all_gather / ppermute lowered by GSPMD onto ICI. That plane needs no
+  runtime API at all: it IS the mesh (see `ray_tpu.parallel`). Helpers for
+  explicit in-program use live in `.xla`.
+- **Out-of-program (host/DCN)**: CPU tensors moved between actors/processes
+  outside any jit — parameter broadcast at startup, metric reduction,
+  rendezvous. That is THIS module: a gloo-equivalent over the framework's
+  RPC layer, with GCS-KV rendezvous (the analog of the reference's
+  named-actor ncclUniqueId store, nccl_collective_group.py:28-77).
+
+Semantics: ranks call collectives in the same order (standard collective
+contract). Implementation is rank-0-rooted tree reduce/bcast — correct and
+simple; ring algorithms can land later behind the same API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..._internal.core_worker import get_core_worker
+from ..._internal.rpc import EventLoopThread
+
+SUM, PRODUCT, MIN, MAX = "sum", "product", "min", "max"
+_OPS = {SUM: np.add, PRODUCT: np.multiply, MIN: np.minimum, MAX: np.maximum}
+
+_groups: Dict[str, "CollectiveGroup"] = {}
+_groups_lock = threading.Lock()
+
+
+class _Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._messages: Dict[Tuple, bytes] = {}
+
+    def put(self, key: Tuple, data: bytes):
+        with self._cond:
+            self._messages[key] = data
+            self._cond.notify_all()
+
+    def take(self, key: Tuple, timeout: float = 120.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while key not in self._messages:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"collective message {key} not "
+                                       f"received within {timeout}s")
+                self._cond.wait(remaining)
+            return self._messages.pop(key)
+
+
+_mailbox = _Mailbox()
+_handler_installed = False
+
+
+def _install_handler():
+    global _handler_installed
+    if _handler_installed:
+        return
+    worker = get_core_worker()
+
+    async def handle_collective_msg(key: Tuple, data: bytes):
+        _mailbox.put(tuple(key), data)
+        return True
+
+    worker.server.register("collective_msg", handle_collective_msg)
+    _handler_installed = True
+
+
+class CollectiveGroup:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 members: List[Tuple[str, int]]):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.members = members  # rank -> rpc address
+        self.op_seq = 0
+
+    def _send_to(self, rank: int, key: Tuple, array: np.ndarray):
+        worker = get_core_worker()
+        client = worker.clients.get(tuple(self.members[rank]))
+        payload = _pack(array)
+        client.call_sync("collective_msg", key=key, data=payload,
+                         timeout=120, retries=3)
+
+    def _recv_from(self, key: Tuple) -> np.ndarray:
+        return _unpack(_mailbox.take(key))
+
+    # -- primitives ------------------------------------------------------
+
+    def allreduce(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
+        seq = self._next_seq("allreduce")
+        reduced = self.reduce(array, dst_rank=0, op=op, _seq=seq)
+        return self.broadcast(reduced if self.rank == 0 else array,
+                              src_rank=0, _seq=seq)
+
+    def reduce(self, array: np.ndarray, dst_rank: int = 0, op: str = SUM,
+               _seq: Optional[int] = None) -> np.ndarray:
+        seq = self._next_seq("reduce") if _seq is None else _seq
+        fn = _OPS[op]
+        if self.rank == dst_rank:
+            acc = np.array(array, copy=True)
+            for src in range(self.world_size):
+                if src == dst_rank:
+                    continue
+                acc = fn(acc, self._recv_from(
+                    (self.name, "red", seq, src)))
+            return acc
+        self._send_to(dst_rank, (self.name, "red", seq, self.rank), array)
+        return array
+
+    def broadcast(self, array: np.ndarray, src_rank: int = 0,
+                  _seq: Optional[int] = None) -> np.ndarray:
+        seq = self._next_seq("broadcast") if _seq is None else _seq
+        if self.rank == src_rank:
+            for dst in range(self.world_size):
+                if dst == src_rank:
+                    continue
+                self._send_to(dst, (self.name, "bc", seq, src_rank), array)
+            return array
+        return self._recv_from((self.name, "bc", seq, src_rank))
+
+    def allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        seq = self._next_seq("allgather")
+        if self.rank == 0:
+            parts = [None] * self.world_size
+            parts[0] = np.asarray(array)
+            for src in range(1, self.world_size):
+                parts[src] = self._recv_from((self.name, "ag", seq, src))
+            stacked = parts
+        else:
+            self._send_to(0, (self.name, "ag", seq, self.rank), array)
+            stacked = None
+        # reuse broadcast (rank0 has the list)
+        if self.rank == 0:
+            flat = np.concatenate([p.ravel() for p in stacked])
+            shapes = [p.shape for p in stacked]
+            self._bcast_obj(seq, (flat, shapes))
+            return stacked
+        flat, shapes = self._recv_obj(seq)
+        out, offset = [], 0
+        for shape in shapes:
+            size = int(np.prod(shape))
+            out.append(flat[offset:offset + size].reshape(shape))
+            offset += size
+        return out
+
+    def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
+        reduced = self.allreduce(array, op)
+        chunks = np.array_split(reduced.ravel(), self.world_size)
+        return chunks[self.rank]
+
+    def send(self, array: np.ndarray, dst_rank: int):
+        seq = self._next_seq(f"p2p-{self.rank}-{dst_rank}")
+        self._send_to(dst_rank, (self.name, "p2p", seq, self.rank), array)
+
+    def recv(self, src_rank: int) -> np.ndarray:
+        seq = self._next_seq(f"p2p-{src_rank}-{self.rank}")
+        return self._recv_from((self.name, "p2p", seq, src_rank))
+
+    def barrier(self):
+        self.allreduce(np.zeros(1, np.int8))
+
+    # -- helpers ---------------------------------------------------------
+
+    def _next_seq(self, op: str) -> int:
+        self.op_seq += 1
+        return self.op_seq
+
+    def _bcast_obj(self, seq, obj):
+        from ..._internal import serialization
+        data = serialization.dumps(obj)
+        worker = get_core_worker()
+        for dst in range(1, self.world_size):
+            client = worker.clients.get(tuple(self.members[dst]))
+            client.call_sync("collective_msg",
+                             key=(self.name, "bco", seq, 0), data=data,
+                             timeout=120, retries=3)
+
+    def _recv_obj(self, seq):
+        from ..._internal import serialization
+        return serialization.loads(_mailbox.take((self.name, "bco", seq, 0)))
+
+
+def _pack(array: np.ndarray) -> bytes:
+    array = np.ascontiguousarray(array)
+    from ..._internal import serialization
+    return serialization.dumps((array.dtype.str, array.shape,
+                                array.tobytes()))
+
+
+def _unpack(data: bytes) -> np.ndarray:
+    from ..._internal import serialization
+    dtype, shape, raw = serialization.loads(data)
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
+
+
+# ---------------------------------------------------------------------------
+# public API (reference signatures)
+# ---------------------------------------------------------------------------
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> CollectiveGroup:
+    """Join a collective group; blocks until all ranks have joined.
+    Rendezvous through the GCS KV (the reference uses a named actor)."""
+    if backend not in ("host", "gloo", "cpu"):
+        raise ValueError(
+            f"backend {backend!r} not supported out-of-program; in-program "
+            "ICI collectives are jax.lax ops over the mesh (see "
+            "ray_tpu.util.collective.xla)")
+    _install_handler()
+    worker = get_core_worker()
+    key_prefix = f"{group_name}:"
+    worker.gcs.put("collective", f"{key_prefix}{rank}",
+                   repr(worker.rpc_address).encode())
+    deadline = time.monotonic() + 120
+    members: List = [None] * world_size
+    while time.monotonic() < deadline:
+        found = 0
+        for r in range(world_size):
+            if members[r] is None:
+                raw = worker.gcs.get("collective", f"{key_prefix}{r}")
+                if raw is not None:
+                    members[r] = eval(raw.decode())  # noqa: S307 — own data
+            if members[r] is not None:
+                found += 1
+        if found == world_size:
+            break
+        time.sleep(0.05)
+    else:
+        raise TimeoutError(
+            f"collective group {group_name!r} incomplete: "
+            f"{[i for i, m in enumerate(members) if m is None]} missing")
+    group = CollectiveGroup(group_name, rank, world_size, members)
+    with _groups_lock:
+        _groups[group_name] = group
+    return group
+
+
+def create_collective_group(actors, world_size: int, ranks: List[int],
+                            backend: str = "host",
+                            group_name: str = "default"):
+    """Declarative setup (reference: GroupManager declare path): tell each
+    actor to join the group."""
+    import ray_tpu
+    refs = [
+        actor.__rtpu_collective_init__.remote(world_size, rank, backend,
+                                              group_name)
+        if hasattr(actor, "__rtpu_collective_init__") else
+        _remote_join(actor, world_size, rank, backend, group_name)
+        for actor, rank in zip(actors, ranks)
+    ]
+    return ray_tpu.get(refs)
+
+
+def _remote_join(actor, world_size, rank, backend, group_name):
+    return actor._collective_join.remote(world_size, rank, backend,
+                                         group_name)
+
+
+def _group(group_name: str) -> CollectiveGroup:
+    with _groups_lock:
+        group = _groups.get(group_name)
+    if group is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            "process; call init_collective_group first")
+    return group
+
+
+def destroy_collective_group(group_name: str = "default"):
+    with _groups_lock:
+        _groups.pop(group_name, None)
+    worker = get_core_worker()
+    for key in worker.gcs.keys("collective", f"{group_name}:"):
+        worker.gcs.delete("collective", key)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def allreduce(tensor, op: str = SUM, group_name: str = "default"):
+    return _group(group_name).allreduce(np.asarray(tensor), op)
+
+
+def reduce(tensor, dst_rank: int = 0, op: str = SUM,
+           group_name: str = "default"):
+    return _group(group_name).reduce(np.asarray(tensor), dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(np.asarray(tensor), src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group(group_name).allgather(np.asarray(tensor))
+
+
+def reducescatter(tensor, op: str = SUM, group_name: str = "default"):
+    return _group(group_name).reducescatter(np.asarray(tensor), op)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    return _group(group_name).send(np.asarray(tensor), dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group(group_name).recv(src_rank)
+
+
+def barrier(group_name: str = "default"):
+    _group(group_name).barrier()

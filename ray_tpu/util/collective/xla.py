@@ -1,0 +1,53 @@
+"""In-program (ICI) collectives.
+
+The reference's NCCL backend has no analog here by design: inside a jitted
+SPMD program, collectives are jax.lax primitives lowered by GSPMD onto ICI
+(SURVEY §2d, §5). These are thin aliases plus standalone jitted wrappers for
+applying a collective to an already-sharded global array outside any
+user jit.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+# In-jit aliases (use inside shard_map bodies).
+allreduce = jax.lax.psum
+allreduce_mean = jax.lax.pmean
+all_gather = jax.lax.all_gather
+ppermute = jax.lax.ppermute
+all_to_all = jax.lax.all_to_all
+axis_index = jax.lax.axis_index
+
+
+def psum_scatter(x, axis_name, **kwargs):
+    return jax.lax.psum_scatter(x, axis_name, **kwargs)
+
+
+def device_allreduce(x, mesh: Mesh, axis_name: str = "data",
+                     in_spec: P = None):
+    """Allreduce a global array sharded over `axis_name` (one jitted op)."""
+    spec = in_spec if in_spec is not None else P(axis_name)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                       out_specs=spec, check_rep=False)
+    def _ar(blk):
+        return jax.lax.psum(blk, axis_name)
+
+    return jax.jit(_ar)(x)
+
+
+def device_allgather(x, mesh: Mesh, axis_name: str = "data"):
+    spec = P(axis_name)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
+                       out_specs=P(), check_rep=False)
+    def _ag(blk):
+        return jax.lax.all_gather(blk, axis_name, tiled=True)
+
+    return jax.jit(_ag)(x)

@@ -1,0 +1,129 @@
+"""Multi-node tests (reference coverage: python/ray/tests/ multi-node +
+fault-tolerance suites): spillback scheduling, cross-node object transfer,
+node death with actor restart and lineage reconstruction, STRICT_SPREAD
+placement groups."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+FAST_FAILURE_CONFIG = {
+    "health_check_period_s": 0.2,
+    "health_check_timeout_s": 1.0,
+    "health_check_failure_threshold": 3,
+}
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_node_args={
+        "num_cpus": 1, "_system_config": FAST_FAILURE_CONFIG})
+    yield c
+    c.shutdown()
+
+
+def test_spillback_to_remote_node(cluster):
+    cluster.connect()
+    cluster.add_node(num_cpus=2, resources={"special": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(num_cpus=1, resources={"special": 0.1})
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    node_id = ray_tpu.get(where.remote(), timeout=90)
+    remote_ids = {h.node_id for h in cluster.remote_nodes}
+    assert node_id in remote_ids
+
+
+def test_cross_node_object_transfer(cluster):
+    cluster.connect()
+    node_b = cluster.add_node(num_cpus=2, resources={"b": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(resources={"b": 0.1})
+    def produce():
+        return np.full((500_000,), 7, dtype=np.int32)  # 2MB -> plasma on B
+
+    ref = produce.remote()
+    out = ray_tpu.get(ref, timeout=90)  # pulled to the head node
+    assert out.sum() == 3_500_000
+
+
+def test_actor_restart_after_node_death(cluster):
+    cluster.connect()
+    doomed = cluster.add_node(num_cpus=2, resources={"doomed": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_restarts=1, num_cpus=1)
+    class Survivor:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    survivor = Survivor.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=doomed.node_id, soft=True)).remote()
+    first = ray_tpu.get(survivor.node.remote(), timeout=90)
+    assert first == doomed.node_id
+    cluster.remove_node(doomed)
+    # Wait for the GCS to declare the node dead (the orphaned worker keeps
+    # answering direct calls for a couple of seconds until it notices its
+    # raylet is gone — same window the reference has).
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        states = {n["node_id"]: n["state"] for n in ray_tpu.nodes()}
+        if states.get(doomed.node_id) == "DEAD":
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError("node never declared dead")
+    while True:
+        try:
+            second = ray_tpu.get(survivor.node.remote(), timeout=30)
+            if second != doomed.node_id:
+                break
+        except ray_tpu.RayTpuError:
+            pass
+        if time.time() > deadline:
+            raise TimeoutError("actor did not restart off the dead node")
+        time.sleep(0.5)
+    assert second != doomed.node_id
+
+
+def test_lineage_reconstruction_after_node_death(cluster):
+    cluster.connect()
+    doomed = cluster.add_node(num_cpus=2, resources={"doomed": 1})
+    cluster.wait_for_nodes()
+
+    @ray_tpu.remote(max_retries=2, resources={"doomed": 0.1})
+    def produce_big():
+        return np.ones((400_000,), dtype=np.float64)  # 3.2MB -> plasma
+
+    ref = produce_big.remote()
+    ray_tpu.wait([ref], timeout=90)
+    cluster.remove_node(doomed)
+    # Re-add capacity with the same custom resource so the retry can run.
+    cluster.add_node(num_cpus=2, resources={"doomed": 1})
+    time.sleep(2)  # let the GCS notice the death
+    out = ray_tpu.get(ref, timeout=120)
+    assert float(out.sum()) == 400_000.0
+
+
+def test_strict_spread_pg(cluster):
+    cluster.connect()
+    cluster.add_node(num_cpus=1)
+    cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes()
+    pg = ray_tpu.util.placement_group(
+        [{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(60)
+    table = ray_tpu.util.placement_group_table()
+    entry = next(p for p in table if p["pg_id"] == pg.id)
+    nodes = entry["bundle_nodes"]
+    assert len(set(nodes)) == 3

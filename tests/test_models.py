@@ -1,0 +1,76 @@
+"""Flagship model tests: forward shapes, training convergence on the CPU
+mesh, KV-cache decode consistency."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import (LlamaConfig, LlamaModel, cross_entropy_loss,
+                            init_kv_caches)
+from ray_tpu.parallel import (MeshConfig, create_train_state,
+                              default_optimizer, make_train_step)
+
+
+def test_forward_shapes():
+    config = LlamaConfig.tiny_test()
+    model = LlamaModel(config)
+    tokens = jnp.zeros((2, 64), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, 64, config.vocab_size)
+
+
+def test_train_step_reduces_loss_on_mesh():
+    mesh = MeshConfig(data=2, fsdp=2, tensor=2).build()
+    config = LlamaConfig.tiny_test()
+    model = LlamaModel(config)
+    tokens = jnp.zeros((4, 64), jnp.int32)
+    state = create_train_state(jax.random.PRNGKey(0), model, tokens, mesh,
+                               default_optimizer(learning_rate=1e-2,
+                                                 warmup_steps=1,
+                                                 total_steps=30))
+
+    def loss_fn(params, batch):
+        logits = model.apply({"params": params}, batch["tokens"])
+        return cross_entropy_loss(logits[:, :-1], batch["tokens"][:, 1:])
+
+    step = make_train_step(loss_fn, mesh)
+    # A memorizable batch: fixed tokens.
+    rng = np.random.RandomState(0)
+    batch = {"tokens": jnp.asarray(
+        rng.randint(0, config.vocab_size, (4, 64)), jnp.int32)}
+    with mesh:
+        losses = []
+        for _ in range(12):
+            state, metrics = step(state, batch)
+            losses.append(float(jax.device_get(metrics["loss"])))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_decode_matches_forward():
+    """Prefill+decode through the KV cache must match the full forward."""
+    config = LlamaConfig.tiny_test()
+    model = LlamaModel(config)
+    rng = np.random.RandomState(1)
+    tokens = jnp.asarray(rng.randint(0, config.vocab_size, (1, 16)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    full_logits = model.apply(params, tokens)
+
+    caches = init_kv_caches(config, batch=1, max_len=32, dtype=jnp.float32)
+    # Prefill the first 8 tokens at once.
+    positions = jnp.arange(8)[None, :]
+    logits, caches = model.apply(params, tokens[:, :8], positions=positions,
+                                 kv_caches=caches, cache_index=0)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full_logits[:, :8]),
+                               atol=2e-3, rtol=2e-3)
+    # Decode the rest one token at a time.
+    for i in range(8, 16):
+        positions = jnp.full((1, 1), i, jnp.int32)
+        logits, caches = model.apply(params, tokens[:, i:i + 1],
+                                     positions=positions, kv_caches=caches,
+                                     cache_index=i)
+        np.testing.assert_allclose(np.asarray(logits[:, 0]),
+                                   np.asarray(full_logits[:, i]),
+                                   atol=2e-3, rtol=2e-3)
