@@ -1,0 +1,14 @@
+from .block import Block, BlockAccessor
+from .context import DataContext
+from .dataset import Dataset
+from .iterator import DataIterator
+from .read_api import (from_arrow, from_items, from_numpy, from_pandas,
+                       range, read_binary_files, read_csv, read_json,
+                       read_parquet, read_text)
+
+__all__ = [
+    "Dataset", "DataIterator", "DataContext", "Block", "BlockAccessor",
+    "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
+    "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files",
+]

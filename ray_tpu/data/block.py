@@ -1,0 +1,157 @@
+"""Blocks: the unit of data movement
+(reference: python/ray/data/block.py — blocks are Arrow tables in the object
+store; operators exchange ObjectRefs to blocks).
+
+A Block here is a pyarrow.Table (columnar path) or a Python list (simple/
+object path). BlockAccessor normalizes both. Batches cross into JAX/numpy as
+dicts of numpy arrays — zero-copy from shared memory whenever Arrow's layout
+allows it."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = Union[pa.Table, List[Any]]
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    @staticmethod
+    def batch_to_block(batch: Any) -> Block:
+        """Normalize a user-returned batch into a Block."""
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            return pa.table({k: _to_arrow_array(v) for k, v in batch.items()})
+        if isinstance(batch, list):
+            return batch
+        try:
+            import pandas as pd
+            if isinstance(batch, pd.DataFrame):
+                return pa.Table.from_pandas(batch, preserve_index=False)
+        except ImportError:
+            pass
+        raise TypeError(f"cannot convert batch of type {type(batch)} "
+                        "to a block (use dict of arrays, pyarrow.Table, "
+                        "pandas.DataFrame, or list)")
+
+    # -- introspection ---------------------------------------------------
+
+    def num_rows(self) -> int:
+        if isinstance(self.block, pa.Table):
+            return self.block.num_rows
+        return len(self.block)
+
+    def size_bytes(self) -> int:
+        if isinstance(self.block, pa.Table):
+            return self.block.nbytes
+        return sum(len(repr(r)) for r in self.block[:10]) * \
+            max(1, len(self.block) // 10)
+
+    def schema(self):
+        if isinstance(self.block, pa.Table):
+            return self.block.schema
+        if self.block:
+            first = self.block[0]
+            if isinstance(first, dict):
+                return {k: type(v).__name__ for k, v in first.items()}
+            return type(first).__name__
+        return None
+
+    # -- conversions -----------------------------------------------------
+
+    def to_pylist(self) -> List[Any]:
+        if isinstance(self.block, pa.Table):
+            return self.block.to_pylist()
+        return list(self.block)
+
+    def to_pandas(self):
+        if isinstance(self.block, pa.Table):
+            return self.block.to_pandas()
+        import pandas as pd
+        return pd.DataFrame(self.block)
+
+    def to_numpy_batch(self) -> Dict[str, np.ndarray]:
+        if isinstance(self.block, pa.Table):
+            out = {}
+            for name in self.block.column_names:
+                col = self.block.column(name)
+                try:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    out[name] = np.asarray(col.to_pylist(), dtype=object)
+            return out
+        if self.block and isinstance(self.block[0], dict):
+            keys = self.block[0].keys()
+            return {k: np.asarray([r[k] for r in self.block]) for k in keys}
+        return {"item": np.asarray(self.block)}
+
+    def to_batch(self, batch_format: str):
+        if batch_format == "numpy":
+            return self.to_numpy_batch()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.block if isinstance(self.block, pa.Table) \
+                else pa.table(self.to_numpy_batch())
+        if batch_format == "default":
+            return self.to_numpy_batch()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    # -- slicing ---------------------------------------------------------
+
+    def slice(self, start: int, end: int) -> Block:
+        if isinstance(self.block, pa.Table):
+            return self.block.slice(start, end - start)
+        return self.block[start:end]
+
+    def take_columns_row(self, index: int) -> Any:
+        if isinstance(self.block, pa.Table):
+            return {name: self.block.column(name)[index].as_py()
+                    for name in self.block.column_names}
+        return self.block[index]
+
+    def iter_rows(self) -> Iterator[Any]:
+        if isinstance(self.block, pa.Table):
+            for batch in self.block.to_batches():
+                yield from batch.to_pylist()
+        else:
+            yield from self.block
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        tables = [b for b in blocks if isinstance(b, pa.Table)]
+        if tables and len(tables) == len(blocks):
+            return pa.concat_tables(tables, promote_options="default")
+        out: List[Any] = []
+        for block in blocks:
+            out.extend(BlockAccessor(block).to_pylist())
+        return out
+
+    def sort_by(self, key, descending: bool = False) -> Block:
+        if isinstance(self.block, pa.Table):
+            order = "descending" if descending else "ascending"
+            return self.block.sort_by([(key, order)])
+        return sorted(self.block,
+                      key=(key if callable(key) else
+                           (lambda r: r[key] if isinstance(r, dict) else r)),
+                      reverse=descending)
+
+
+def _to_arrow_array(values):
+    arr = np.asarray(values)
+    if arr.ndim > 1:
+        # Tensors: store as fixed-size lists.
+        flat = arr.reshape(arr.shape[0], -1)
+        return pa.FixedSizeListArray.from_arrays(
+            pa.array(flat.ravel()), flat.shape[1])
+    return pa.array(arr)

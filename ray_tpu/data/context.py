@@ -1,0 +1,26 @@
+"""DataContext (reference: python/ray/data/context.py:304)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    max_tasks_in_flight: int = 16
+    read_parallelism: int = 8
+    shuffle_strategy: str = "pull"
+
+    _current = None
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        with cls._lock:
+            if cls._current is None:
+                cls._current = DataContext()
+            return cls._current

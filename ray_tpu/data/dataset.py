@@ -1,0 +1,467 @@
+"""Dataset: lazy, distributed, streaming data
+(reference: python/ray/data/dataset.py + _internal/plan.py +
+_internal/execution/streaming_executor.py).
+
+A Dataset is a logical plan over blocks held in the shared-memory object
+store. Transformations are lazy; consumption (iter_batches / take /
+materialize / aggregates) triggers execution: map-like stages are fused and
+run as one remote task per block with bounded in-flight windows
+(backpressure); all-to-all stages (shuffle / sort / repartition / groupby)
+materialize boundaries.
+
+TPU-first notes: batches come out as dicts of numpy arrays ready for
+device put; `streaming_split`/`shard` feed Train workers per-rank.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple, Union)
+
+import numpy as np
+
+from .block import Block, BlockAccessor
+from .context import DataContext
+
+# A stage is ("map", block_fn) — fusable — or ("allToAll", plan_fn).
+Stage = Tuple[str, Callable]
+
+
+class Dataset:
+    def __init__(self, source_fn: Callable[[], List],
+                 stages: Optional[List[Stage]] = None,
+                 name: str = "dataset"):
+        # source_fn: () -> list of ObjectRef[Block]
+        self._source_fn = source_fn
+        self._stages = stages or []
+        self._name = name
+        self._materialized: Optional[List] = None
+
+    # ------------------------------------------------------------------
+    # transformations (lazy)
+    # ------------------------------------------------------------------
+
+    def _with_stage(self, stage: Stage, name: str) -> "Dataset":
+        ds = Dataset(self._source_fn, self._stages + [stage],
+                     name=f"{self._name}->{name}")
+        ds._materialized = self._materialized
+        return ds
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    fn_kwargs: Optional[Dict] = None) -> "Dataset":
+        fn_kwargs = fn_kwargs or {}
+
+        def stage(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            size = batch_size or max(n, 1)
+            outs = []
+            for start in range(0, max(n, 1), size):
+                piece = BlockAccessor(acc.slice(start, min(start + size, n)))
+                batch = piece.to_batch(batch_format)
+                result = fn(batch, **fn_kwargs)
+                outs.append(BlockAccessor.batch_to_block(result))
+            if not outs:
+                return block
+            return BlockAccessor.concat(outs)
+
+        return self._with_stage(("map", stage), "map_batches")
+
+    def map(self, fn: Callable) -> "Dataset":
+        def stage(block: Block) -> Block:
+            rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+            return _rows_to_block(rows)
+        return self._with_stage(("map", stage), "map")
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        def stage(block: Block) -> Block:
+            rows = [o for r in BlockAccessor(block).iter_rows()
+                    for o in fn(r)]
+            return _rows_to_block(rows)
+        return self._with_stage(("map", stage), "flat_map")
+
+    def filter(self, fn: Callable) -> "Dataset":
+        def stage(block: Block) -> Block:
+            rows = [r for r in BlockAccessor(block).iter_rows() if fn(r)]
+            return _rows_to_block(rows)
+        return self._with_stage(("map", stage), "filter")
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def add(batch):
+            batch[name] = fn(batch)
+            return batch
+        return self.map_batches(add)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+        return self.map_batches(drop)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+        return self.map_batches(select)
+
+    def limit(self, n: int) -> "Dataset":
+        def plan_fn(block_refs: List) -> List:
+            import ray_tpu
+            taken, out = 0, []
+            for ref in block_refs:
+                if taken >= n:
+                    break
+                block = ray_tpu.get(ref)
+                rows = BlockAccessor(block).num_rows()
+                if taken + rows <= n:
+                    out.append(ref)
+                    taken += rows
+                else:
+                    sliced = BlockAccessor(block).slice(0, n - taken)
+                    out.append(ray_tpu.put(sliced))
+                    taken = n
+            return out
+        return self._with_stage(("allToAll", plan_fn), f"limit[{n}]")
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def plan_fn(block_refs: List) -> List:
+            import ray_tpu
+            blocks = ray_tpu.get(list(block_refs))
+            merged = BlockAccessor.concat(blocks) if blocks else []
+            acc = BlockAccessor(merged)
+            total = acc.num_rows()
+            out = []
+            per = max(1, -(-total // num_blocks)) if total else 0
+            for i in range(num_blocks):
+                start = min(i * per, total)
+                end = min(start + per, total)
+                out.append(ray_tpu.put(acc.slice(start, end)))
+            return out
+        return self._with_stage(("allToAll", plan_fn),
+                                f"repartition[{num_blocks}]")
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def plan_fn(block_refs: List) -> List:
+            import ray_tpu
+            blocks = ray_tpu.get(list(block_refs))
+            rows = [r for b in blocks
+                    for r in BlockAccessor(b).iter_rows()]
+            rng = _random.Random(seed)
+            rng.shuffle(rows)
+            n_out = max(1, len(block_refs))
+            per = max(1, -(-len(rows) // n_out))
+            return [ray_tpu.put(_rows_to_block(rows[i * per:(i + 1) * per]))
+                    for i in range(n_out)]
+        return self._with_stage(("allToAll", plan_fn), "random_shuffle")
+
+    def sort(self, key: Union[str, Callable], descending: bool = False
+             ) -> "Dataset":
+        def plan_fn(block_refs: List) -> List:
+            import ray_tpu
+            blocks = ray_tpu.get(list(block_refs))
+            merged = BlockAccessor.concat(blocks) if blocks else []
+            result = BlockAccessor(merged).sort_by(key, descending)
+            return [ray_tpu.put(result)]
+        return self._with_stage(("allToAll", plan_fn), "sort")
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        parents = [self, *others]
+
+        def source():
+            refs = []
+            for parent in parents:
+                refs.extend(parent._execute())
+            return refs
+        return Dataset(source, [], name="union")
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left, right = self, other
+
+        def source():
+            import ray_tpu
+            l_rows = left.take_all()
+            r_rows = right.take_all()
+            rows = []
+            for a, b in zip(l_rows, r_rows):
+                da, db = _as_dict(a), _as_dict(b)
+                merged = dict(da)
+                for key, value in db.items():
+                    # Suffix only on conflict (reference zip semantics).
+                    merged[key if key not in merged else f"{key}_1"] = value
+                rows.append(merged)
+            return [ray_tpu.put(_rows_to_block(rows))]
+        return Dataset(source, [], name="zip")
+
+    def groupby(self, key: str) -> "GroupedData":
+        from .grouped import GroupedData
+        return GroupedData(self, key)
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+
+    def _execute(self) -> List:
+        """Run the plan; returns block refs. Fused map stages run as one
+        remote task per block with a bounded in-flight window."""
+        import ray_tpu
+        if self._materialized is not None and not self._stages:
+            return self._materialized
+        refs = list(self._source_fn())
+        stages = list(self._stages)
+        i = 0
+        while i < len(stages):
+            # Collect a run of fusable map stages.
+            fused: List[Callable] = []
+            while i < len(stages) and stages[i][0] == "map":
+                fused.append(stages[i][1])
+                i += 1
+            if fused:
+                refs = _run_map_tasks(refs, fused)
+            if i < len(stages):
+                kind, plan_fn = stages[i]
+                refs = plan_fn(refs)
+                i += 1
+        return refs
+
+    def materialize(self) -> "Dataset":
+        refs = self._execute()
+        ds = Dataset(lambda: refs, [], name=self._name)
+        ds._materialized = refs
+        return ds
+
+    def num_blocks(self) -> int:
+        return len(self._execute())
+
+    def count(self) -> int:
+        import ray_tpu
+        refs = self._execute()
+        counts = _run_map_tasks(
+            refs, [lambda b: [BlockAccessor(b).num_rows()]])
+        return sum(BlockAccessor(ray_tpu.get(r)).to_pylist()[0]
+                   for r in counts)
+
+    def schema(self):
+        import ray_tpu
+        refs = self._execute()
+        if not refs:
+            return None
+        return BlockAccessor(ray_tpu.get(refs[0])).schema()
+
+    def take(self, n: int = 20) -> List[Any]:
+        import ray_tpu
+        out: List[Any] = []
+        for ref in self._execute():
+            for row in BlockAccessor(ray_tpu.get(ref)).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> List[Any]:
+        import ray_tpu
+        out: List[Any] = []
+        for ref in self._execute():
+            out.extend(BlockAccessor(ray_tpu.get(ref)).iter_rows())
+        return out
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def to_pandas(self):
+        import pandas as pd
+        import ray_tpu
+        frames = [BlockAccessor(ray_tpu.get(r)).to_pandas()
+                  for r in self._execute()]
+        return pd.concat(frames, ignore_index=True) if frames \
+            else pd.DataFrame()
+
+    # -- aggregates ------------------------------------------------------
+
+    def sum(self, on: Optional[str] = None):
+        return self._simple_agg(np.sum, on)
+
+    def min(self, on: Optional[str] = None):
+        return self._simple_agg(np.min, on)
+
+    def max(self, on: Optional[str] = None):
+        return self._simple_agg(np.max, on)
+
+    def mean(self, on: Optional[str] = None):
+        rows = self._column_values(on)
+        return float(np.mean(rows)) if len(rows) else None
+
+    def std(self, on: Optional[str] = None):
+        rows = self._column_values(on)
+        return float(np.std(rows, ddof=1)) if len(rows) > 1 else None
+
+    def _column_values(self, on: Optional[str]) -> np.ndarray:
+        rows = self.take_all()
+        if not rows:
+            return np.asarray([])
+        if isinstance(rows[0], dict):
+            if on is None:
+                raise ValueError("specify on= for record datasets")
+            return np.asarray([r[on] for r in rows])
+        return np.asarray(rows)
+
+    def _simple_agg(self, fn, on):
+        values = self._column_values(on)
+        if not len(values):
+            return None
+        result = fn(values)
+        return result.item() if hasattr(result, "item") else result
+
+    # -- iteration / train integration ----------------------------------
+
+    def iter_rows(self) -> Iterator[Any]:
+        import ray_tpu
+        for ref in self._execute():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator[Any]:
+        import ray_tpu
+        refs = self._execute()
+        carry: Optional[Block] = None
+        for ref in refs:
+            block = ray_tpu.get(ref)
+            if carry is not None:
+                block = BlockAccessor.concat([carry, block])
+                carry = None
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            start = 0
+            while n - start >= batch_size:
+                piece = BlockAccessor(acc.slice(start, start + batch_size))
+                yield piece.to_batch(batch_format)
+                start += batch_size
+            if start < n:
+                carry = acc.slice(start, n)
+        if carry is not None and not drop_last:
+            yield BlockAccessor(carry).to_batch(batch_format)
+
+    def split(self, n: int, *, locality_hints=None) -> List["Dataset"]:
+        refs = self.repartition(n)._execute()
+        out = []
+        per = max(1, -(-len(refs) // n))
+        for i in range(n):
+            chunk = refs[i * per:(i + 1) * per]
+            ds = Dataset(lambda c=chunk: c, [], name=f"{self._name}-split{i}")
+            ds._materialized = chunk
+            out.append(ds)
+        return out
+
+    def shard(self, rank: int, world_size: int) -> "Dataset":
+        """Per-rank shard for Train workers (row-round-robin by block)."""
+        refs = self._execute()
+        mine = refs[rank::world_size]
+        ds = Dataset(lambda: mine, [], name=f"{self._name}-shard{rank}")
+        ds._materialized = mine
+        return ds
+
+    def streaming_split(self, n: int, *, equal: bool = True,
+                        locality_hints=None) -> List["DataIterator"]:
+        """Reference: Dataset.streaming_split — one iterator per consumer,
+        fed by a coordinator splitting this dataset's output."""
+        from .iterator import DataIterator
+        splits = self.split(n)
+        return [DataIterator(s) for s in splits]
+
+    def iterator(self) -> "DataIterator":
+        from .iterator import DataIterator
+        return DataIterator(self)
+
+    # -- writes ----------------------------------------------------------
+
+    def write_parquet(self, path: str):
+        import os
+        import pyarrow.parquet as pq
+        import pyarrow as pa
+        import ray_tpu
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref)
+            table = block if isinstance(block, pa.Table) else \
+                pa.table(BlockAccessor(block).to_numpy_batch())
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str):
+        import os
+        import pyarrow.csv as pacsv
+        import pyarrow as pa
+        import ray_tpu
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref)
+            table = block if isinstance(block, pa.Table) else \
+                pa.table(BlockAccessor(block).to_numpy_batch())
+            pacsv.write_csv(table, os.path.join(path, f"part-{i:05d}.csv"))
+
+    def write_json(self, path: str):
+        import json as _json
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, batch in enumerate([self.take_all()]):
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for row in batch:
+                    f.write(_json.dumps(_jsonable(row)) + "\n")
+
+    def __repr__(self):
+        return f"Dataset(name={self._name}, stages={len(self._stages)})"
+
+
+def _rows_to_block(rows: List[Any]) -> Block:
+    if rows and isinstance(rows[0], dict) and all(
+            np.isscalar(v) or isinstance(v, (np.ndarray, list, str))
+            for v in rows[0].values()):
+        try:
+            import pyarrow as pa
+            keys = rows[0].keys()
+            return pa.table({k: [r[k] for r in rows] for k in keys})
+        except Exception:
+            return rows
+    return rows
+
+
+def _as_dict(row, suffix=""):
+    if isinstance(row, dict):
+        return row if not suffix else {f"{k}{suffix}": v
+                                       for k, v in row.items()}
+    return {f"item{suffix}": row}
+
+
+def _jsonable(row):
+    if isinstance(row, dict):
+        return {k: _jsonable(v) for k, v in row.items()}
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    if isinstance(row, (np.integer, np.floating)):
+        return row.item()
+    return row
+
+
+def _run_map_tasks(refs: List, fns: List[Callable]) -> List:
+    """Run fused block transforms as remote tasks with a bounded window."""
+    import ray_tpu
+
+    ctx = DataContext.get_current()
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def _apply(block, fns=fns):
+        for fn in fns:
+            block = fn(block)
+        return block
+
+    window = ctx.max_tasks_in_flight
+    out: List = []
+    pending: List = []
+    for ref in refs:
+        pending.append(_apply.remote(ref))
+        if len(pending) >= window:
+            out.append(pending.pop(0))
+    out.extend(pending)
+    return out

@@ -1,0 +1,74 @@
+"""Grouped aggregations (reference: python/ray/data/grouped_data.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+from .block import BlockAccessor
+
+
+class GroupedData:
+    def __init__(self, dataset, key: str):
+        self._dataset = dataset
+        self._key = key
+
+    def _groups(self) -> Dict[Any, List[Any]]:
+        groups: Dict[Any, List[Any]] = {}
+        for row in self._dataset.take_all():
+            groups.setdefault(row[self._key], []).append(row)
+        return groups
+
+    def _agg(self, fn: Callable, on: str, name: str):
+        from .dataset import Dataset, _rows_to_block
+        key = self._key
+        groups = self._groups()
+        rows = [{key: k, name: fn([r[on] for r in rs])}
+                for k, rs in sorted(groups.items(), key=lambda kv: str(kv[0]))]
+
+        def source():
+            import ray_tpu
+            return [ray_tpu.put(_rows_to_block(rows))]
+        return Dataset(source, [], name=f"groupby({key}).{name}")
+
+    def count(self):
+        from .dataset import Dataset, _rows_to_block
+        key = self._key
+        rows = [{key: k, "count()": len(rs)}
+                for k, rs in sorted(self._groups().items(),
+                                    key=lambda kv: str(kv[0]))]
+
+        def source():
+            import ray_tpu
+            return [ray_tpu.put(_rows_to_block(rows))]
+        return Dataset(source, [], name=f"groupby({key}).count")
+
+    def sum(self, on: str):
+        return self._agg(lambda v: float(np.sum(v)), on, f"sum({on})")
+
+    def mean(self, on: str):
+        return self._agg(lambda v: float(np.mean(v)), on, f"mean({on})")
+
+    def min(self, on: str):
+        return self._agg(lambda v: float(np.min(v)), on, f"min({on})")
+
+    def max(self, on: str):
+        return self._agg(lambda v: float(np.max(v)), on, f"max({on})")
+
+    def std(self, on: str):
+        return self._agg(lambda v: float(np.std(v, ddof=1)), on,
+                         f"std({on})")
+
+    def map_groups(self, fn: Callable):
+        from .dataset import Dataset, _rows_to_block
+        groups = self._groups()
+        out_rows: List[Any] = []
+        for _, rows in sorted(groups.items(), key=lambda kv: str(kv[0])):
+            result = fn(rows)
+            out_rows.extend(result if isinstance(result, list) else [result])
+
+        def source():
+            import ray_tpu
+            return [ray_tpu.put(_rows_to_block(out_rows))]
+        return Dataset(source, [], name="map_groups")

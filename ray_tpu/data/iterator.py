@@ -1,0 +1,28 @@
+"""DataIterator (reference: python/ray/data/iterator.py +
+stream_split_iterator): the per-consumer view a Train worker iterates."""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+
+class DataIterator:
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator[Any]:
+        return self._dataset.iter_batches(
+            batch_size=batch_size, batch_format=batch_format,
+            prefetch_batches=prefetch_batches, drop_last=drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._dataset.iter_rows()
+
+    def materialize(self):
+        return self._dataset.materialize()
+
+    def count(self) -> int:
+        return self._dataset.count()

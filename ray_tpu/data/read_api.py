@@ -1,0 +1,165 @@
+"""Dataset creation (reference: python/ray/data/read_api.py)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .block import BlockAccessor
+from .context import DataContext
+from .dataset import Dataset, _rows_to_block
+
+
+def _put_blocks(blocks: List) -> Dataset:
+    def source():
+        import ray_tpu
+        return [ray_tpu.put(b) for b in blocks]
+    return Dataset(source, [], name="in-memory")
+
+
+_builtin_range = range  # shadowed below by the Dataset-producing `range`
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    ctx = DataContext.get_current()
+    parallelism = parallelism if parallelism > 0 else ctx.read_parallelism
+    per = max(1, -(-n // parallelism))
+
+    def source():
+        import ray_tpu
+        import pyarrow as pa
+        refs = []
+        for start in _builtin_range(0, n, per):
+            stop = min(start + per, n)
+            refs.append(ray_tpu.put(
+                pa.table({"id": np.arange(start, stop)})))
+        return refs
+    return Dataset(source, [], name=f"range[{n}]")
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    parallelism = parallelism if parallelism > 0 else ctx.read_parallelism
+    per = max(1, -(-len(items) // parallelism)) if items else 1
+    blocks = [_rows_to_block(items[i:i + per])
+              for i in _builtin_range(0, max(len(items), 1), per)]
+    return _put_blocks(blocks)
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return _put_blocks([pa.Table.from_pandas(df, preserve_index=False)
+                        for df in dfs])
+
+
+def from_numpy(arrays) -> Dataset:
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    import pyarrow as pa
+    blocks = []
+    for arr in arrays:
+        blocks.append(BlockAccessor.batch_to_block({"data": arr}))
+    return _put_blocks(blocks)
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return _put_blocks(tables)
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            pattern = os.path.join(path, f"*{suffix}" if suffix else "*")
+            out.extend(sorted(_glob.glob(pattern)))
+        elif any(ch in path for ch in "*?["):
+            out.extend(sorted(_glob.glob(path)))
+        else:
+            out.append(path)
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path, columns=columns):
+            import pyarrow.parquet as pq
+            return pq.read_table(path, columns=columns)
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_parquet")
+
+
+def read_csv(paths) -> Dataset:
+    files = _expand_paths(paths, ".csv")
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            import pyarrow.csv as pacsv
+            return pacsv.read_csv(path)
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_csv")
+
+
+def read_json(paths) -> Dataset:
+    files = _expand_paths(paths, ".json")
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            import json
+            rows = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            return _rows_to_block(rows)
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_json")
+
+
+def read_text(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            with open(path) as f:
+                lines = [line.rstrip("\n") for line in f]
+            return _rows_to_block([{"text": line} for line in lines])
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_text")
+
+
+def read_binary_files(paths) -> Dataset:
+    files = _expand_paths(paths)
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            with open(path, "rb") as f:
+                return [{"path": path, "bytes": f.read()}]
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_binary_files")

@@ -48,6 +48,9 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     remat: bool = True
     use_flash: bool = True
+    # "flash" (pallas fwd + chunked bwd), "chunked", or "reference"
+    # (full-logits, XLA-fused — fastest backward at moderate seq lengths).
+    attention_impl: str = "flash"
 
     @property
     def head_dim_(self) -> int:
@@ -178,11 +181,15 @@ class Attention(nn.Module):
             out = jnp.einsum("bhqk,bhkd->bhqd", probs,
                              vv.astype(jnp.float32)).astype(cfg.dtype)
         else:
-            if cfg.use_flash:
-                out = flash_attention(q, k, v, True, None)
-            else:
+            impl = cfg.attention_impl if cfg.use_flash else "chunked"
+            if impl == "reference":
+                from ..ops.attention import attention_reference
+                out = attention_reference(q, k, v, True)
+            elif impl == "chunked":
                 from ..ops.attention import attention_chunked
                 out = attention_chunked(q, k, v, True)
+            else:
+                out = flash_attention(q, k, v, True, None)
         out = jnp.transpose(out, (0, 2, 1, 3))  # [b, s, h, d]
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,

@@ -1,29 +1,33 @@
 """Attention kernels.
 
-The hot op of every transformer in the framework. Three tiers:
+The hot op of every transformer in the framework. Tiers:
 
 1. `attention_reference` — naive O(S^2)-memory jnp implementation; the
    numerical ground truth for tests.
 2. `attention_chunked` — blockwise online-softmax attention via lax.scan
-   (memory-efficient attention): O(S * chunk) memory, fully differentiable,
-   runs on any backend. Used as the backward pass everywhere and as the
-   forward on non-TPU backends.
-3. `_flash_fwd_tpu` — Pallas TPU kernel: tiled online softmax, fp32
-   accumulators in VMEM scratch, causal block skipping, GQA via kv-head
-   index mapping. Forward-only; `flash_attention` wires it into a
-   custom_vjp whose backward recomputes through (2) (flash-style
-   recompute — no S^2 residuals are ever materialized).
+   (memory-efficient attention): O(S * chunk) memory, differentiable,
+   runs on any backend.
+3. Pallas TPU flash attention, forward AND backward:
+   - forward: tiled online softmax, fp32 accumulators in VMEM scratch,
+     causal block skipping, GQA via kv-head index mapping; emits the
+     per-row logsumexp (LSE) residual.
+   - backward: two-pass flash backward — kernel A recomputes P per tile and
+     accumulates dK/dV over the query blocks; kernel B accumulates dQ over
+     the kv blocks. No S^2 tensor is ever materialized.
+   On non-TPU backends the same kernels run in Pallas interpret mode for
+   tests; `flash_attention` dispatches to (2) when shapes don't fit the
+   kernel constraints or offsets are used (ring attention's rotating chunks
+   handle their own masking).
 
-All functions take q/k/v as [batch, heads, seq, head_dim] (BHSD) in bf16 or
-f32, with GQA expressed as k/v having fewer heads (num_q_heads must be a
-multiple of num_kv_heads). `q_offset`/`kv_offset` shift the causal mask for
-sequence-parallel callers (ring attention passes the rotating chunk offset).
+All functions take q/k/v as [batch, heads, seq, head_dim] (BHSD), GQA as
+fewer kv heads (num_q_heads % num_kv_heads == 0). `q_offset`/`kv_offset`
+shift the causal mask for sequence-parallel callers.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +50,6 @@ def _validate(q, k, v):
 
 
 def _expand_kv(q, k, v):
-    """Repeat kv heads up to q heads for the non-kernel paths."""
     groups = q.shape[1] // k.shape[1]
     if groups > 1:
         k = jnp.repeat(k, groups, axis=1)
@@ -83,7 +86,6 @@ def attention_chunked(q, k, v, causal: bool = True,
     scale = sm_scale if sm_scale is not None else d ** -0.5
     chunk = min(chunk_size, sk)
     if sk % chunk != 0:
-        # Fall back: odd kv lengths take the reference path.
         return attention_reference(q, k, v, causal, sm_scale, q_offset,
                                    kv_offset)
     n_chunks = sk // chunk
@@ -121,14 +123,19 @@ def attention_chunked(q, k, v, causal: bool = True,
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU forward kernel
+# Pallas TPU kernels
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
-                  acc_scratch, *, sm_scale: float, causal: bool,
-                  block_q: int, block_k: int, kv_len: int):
-    """Grid: (batch*q_heads, num_q_blocks, num_k_blocks); the k dimension is
-    the innermost 'arbitrary' axis we accumulate over."""
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
+                acc_scratch, *, sm_scale, causal, block_q, block_k):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -139,8 +146,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [block_q, d]
-        k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -152,7 +159,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        m_prev = m_scratch[:]                      # [block_q, 1]
+        m_prev = m_scratch[:]
         m_blk = jnp.max(logits, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
         p = jnp.exp(logits - m_new)
@@ -161,10 +168,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
         l_scratch[:] = l_scratch[:] * correction + jnp.sum(
             p, axis=-1, keepdims=True)
         acc_scratch[:] = acc_scratch[:] * correction + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            p, v, preferred_element_type=jnp.float32)
 
     if causal:
-        # Skip fully-masked kv blocks (k start beyond q end).
         qb = pl.program_id(1)
 
         @pl.when(kb * block_k <= qb * block_q + block_q - 1)
@@ -175,41 +181,167 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scratch, l_scratch,
 
     @pl.when(kb == nk - 1)
     def _finalize():
-        o_ref[0] = (acc_scratch[:] /
-                    jnp.maximum(l_scratch[:], 1e-30)).astype(o_ref.dtype)
+        l_final = jnp.maximum(l_scratch[:], 1e-30)
+        o_ref[0] = (acc_scratch[:] / l_final).astype(o_ref.dtype)
+        lse_ref[0] = (m_scratch[:] + jnp.log(l_final))[:, 0]
 
 
-def _flash_fwd_tpu(q, k, v, causal: bool, sm_scale: float,
-                   block_q: int = 256, block_k: int = 512):
+def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dk_ref, dv_ref, dk_scratch, dv_scratch,
+                   *, sm_scale, causal, block_q, block_k):
+    """Grid (bh, nk, nq): for one kv tile, accumulate dK/dV over q tiles."""
+    qb = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scratch[:] = jnp.zeros_like(dk_scratch)
+        dv_scratch[:] = jnp.zeros_like(dv_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # [bq, d]
+        lse = lse_ref[0][:, None]                 # [bq, 1]
+        delta = delta_ref[0][:, None]             # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
+        if causal:
+            kb = pl.program_id(1)
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+        dv_scratch[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # p^T do -> [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, bk]
+        ds = p * (dp - delta) * sm_scale
+        dk_scratch[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # ds^T q -> [bk, d]
+
+    if causal:
+        kb = pl.program_id(1)
+
+        @pl.when(qb * block_q + block_q - 1 >= kb * block_k)
+        def _go():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qb == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scratch[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scratch[:].astype(dv_ref.dtype)
+
+
+def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                  dq_ref, dq_scratch, *, sm_scale, causal, block_q, block_k):
+    """Grid (bh, nq, nk): for one q tile, accumulate dQ over kv tiles."""
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scratch[:] = jnp.zeros_like(dq_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            qb = pl.program_id(1)
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scratch[:] += jax.lax.dot(
+            ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        qb = pl.program_id(1)
+
+        @pl.when(kb * block_k <= qb * block_q + block_q - 1)
+        def _go():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scratch[:].astype(dq_ref.dtype)
+
+
+def _kernel_params(sq: int, sk: int, d: int):
+    block_q = min(DEFAULT_BLOCK_Q, sq)
+    block_k = min(DEFAULT_BLOCK_K, sk)
+    return block_q, block_k
+
+
+def _pallas_ok(q, k) -> bool:
+    if pltpu is None:
+        return False
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q, block_k = _kernel_params(sq, sk, d)
+    return (sq % block_q == 0 and sk % block_k == 0
+            and block_q >= 8 and block_k >= 8
+            and d % 8 == 0 and block_q % 128 == 0 and block_k % 128 == 0)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale
+                      ) -> Tuple[jax.Array, jax.Array]:
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     groups = h // hk
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError("seq lengths must divide the block sizes")
+    block_q, block_k = _kernel_params(sq, sk, d)
     grid = (b * h, sq // block_q, sk // block_k)
 
     def q_index(bh, qb, kb):
         return (bh, qb, 0)
 
     def kv_index(bh, qb, kb):
-        # GQA: query head bh%h maps to kv head (bh%h)//groups.
-        batch = bh // h
-        kv_head = (bh % h) // groups
-        return (batch * hk + kv_head, kb, 0)
+        return ((bh // h) * hk + (bh % h) // groups, kb, 0)
 
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=sk),
+    def lse_index(bh, qb, kb):
+        return (bh, qb)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), q_index),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q), lse_index),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -217,50 +349,147 @@ def _flash_fwd_tpu(q, k, v, causal: bool, sm_scale: float,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
     )(q.reshape(b * h, sq, d), k.reshape(b * hk, sk, d),
       v.reshape(b * hk, sk, d))
-    return out.reshape(b, h, sq, d)
+    return out.reshape(b, h, sq, d), lse
 
 
-def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
+    b, h, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    groups = h // hk
+    block_q, block_k = _kernel_params(sq, sk, d)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hk, sk, d)
+    vf = v.reshape(b * hk, sk, d)
+    dof = g.reshape(b * h, sq, d)
+    of = out.reshape(b * h, sq, d)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)  # [bh, sq]
+
+    def q_index(bh, a, c):
+        return (bh, a if _Q_MAJOR else c, 0)
+
+    # -- dK/dV pass: grid (bh, nk, nq) ----------------------------------
+    def kv_pass():
+        def qi(bh, kb, qb):
+            return (bh, qb, 0)
+
+        def kvi(bh, kb, qb):
+            return ((bh // h) * hk + (bh % h) // groups, kb, 0)
+
+        def li(bh, kb, qb):
+            return (bh, qb)
+
+        def dkvi(bh, kb, qb):
+            return (bh, kb, 0)
+
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_kv_kernel, sm_scale=sm_scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k),
+            grid=(b * h, sk // block_k, sq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), qi),
+                pl.BlockSpec((1, block_k, d), kvi),
+                pl.BlockSpec((1, block_k, d), kvi),
+                pl.BlockSpec((1, block_q, d), qi),
+                pl.BlockSpec((1, block_q), li),
+                pl.BlockSpec((1, block_q), li),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), dkvi),
+                pl.BlockSpec((1, block_k, d), dkvi),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+                jax.ShapeDtypeStruct((b * h, sk, d), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(qf, kf, vf, dof, lse, delta)
+        return dk, dv
+
+    # -- dQ pass: grid (bh, nq, nk) -------------------------------------
+    def q_pass():
+        def qi(bh, qb, kb):
+            return (bh, qb, 0)
+
+        def kvi(bh, qb, kb):
+            return ((bh // h) * hk + (bh % h) // groups, kb, 0)
+
+        def li(bh, qb, kb):
+            return (bh, qb)
+
+        dq = pl.pallas_call(
+            functools.partial(_bwd_q_kernel, sm_scale=sm_scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k),
+            grid=(b * h, sq // block_q, sk // block_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), qi),
+                pl.BlockSpec((1, block_k, d), kvi),
+                pl.BlockSpec((1, block_k, d), kvi),
+                pl.BlockSpec((1, block_q, d), qi),
+                pl.BlockSpec((1, block_q), li),
+                pl.BlockSpec((1, block_q), li),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), qi),
+            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary")),
+            interpret=_interpret(),
+        )(qf, kf, vf, dof, lse, delta)
+        return dq
+
+    dk, dv = kv_pass()
+    dq = q_pass()
+    dq = dq.reshape(b, h, sq, d).astype(q.dtype)
+    # GQA: per-q-head dK/dV reduce over the group.
+    dk = dk.reshape(b, hk, groups, sk, d).sum(axis=2).astype(k.dtype)
+    dv = dv.reshape(b, hk, groups, sk, d).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+_Q_MAJOR = True  # documentation aid for q_index above
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_tpu(q, k, v, causal: bool, sm_scale: float):
+    out, _ = _flash_fwd_pallas(q, k, v, causal, sm_scale)
+    return out
+
+
+def _flash_tpu_fwd(q, k, v, causal, sm_scale):
+    out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_tpu_bwd(causal, sm_scale, residuals, g):
+    q, k, v, out, lse = residuals
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale)
+
+
+_flash_tpu.defvjp(_flash_tpu_fwd, _flash_tpu_bwd)
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    q_offset: int = 0, kv_offset: int = 0):
-    """Dispatching flash attention; differentiable everywhere (backward
-    recomputes through the chunked path — no S^2 residuals)."""
-    return _flash_forward(q, k, v, causal, sm_scale, q_offset, kv_offset)
-
-
-def _flash_forward(q, k, v, causal, sm_scale, q_offset, kv_offset):
+                    q_offset: int = 0, kv_offset: int = 0,
+                    force_pallas: bool = False):
+    """Dispatching flash attention, differentiable everywhere."""
+    _validate(q, k, v)
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
-    if (_on_tpu() and q_offset == 0 and kv_offset == 0
-            and q.shape[2] >= 128 and q.shape[2] % 128 == 0
-            and k.shape[2] % 128 == 0 and q.shape[3] in (64, 128, 256)):
-        try:
-            return _flash_fwd_tpu(q, k, v, causal, scale)
-        except Exception:
-            pass
+    if (q_offset == 0 and kv_offset == 0
+            and (force_pallas or not _interpret()) and _pallas_ok(q, k)):
+        return _flash_tpu(q, k, v, causal, scale)
     return attention_chunked(q, k, v, causal, scale, q_offset, kv_offset)
-
-
-def _flash_fwd_rule(q, k, v, causal, sm_scale, q_offset, kv_offset):
-    out = _flash_forward(q, k, v, causal, sm_scale, q_offset, kv_offset)
-    return out, (q, k, v)
-
-
-def _flash_bwd_rule(causal, sm_scale, q_offset, kv_offset, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_chunked(
-            q_, k_, v_, causal, sm_scale, q_offset, kv_offset), q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

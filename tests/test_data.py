@@ -1,0 +1,125 @@
+"""Data library tests (reference coverage: python/ray/data/tests basics:
+creation, transforms, aggregates, groupby, shuffle/sort, io, iteration,
+train-shard integration)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def data_cluster():
+    worker = ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024,
+                          ignore_reinit_error=True)
+    yield worker
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(data_cluster):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+
+
+def test_map_batches_and_filter(data_cluster):
+    ds = rd.range(64).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    ds = ds.filter(lambda r: r["sq"] % 2 == 0)
+    rows = ds.take_all()
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+    assert all(r["sq"] % 2 == 0 for r in rows)
+    assert len(rows) == 32
+
+
+def test_map_and_flat_map(data_cluster):
+    ds = rd.from_items([1, 2, 3]).map(lambda x: x * 10)
+    assert sorted(ds.take_all()) == [10, 20, 30]
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x])
+    assert sorted(ds2.take_all()) == [1, 1, 2, 2]
+
+
+def test_aggregates(data_cluster):
+    ds = rd.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+
+
+def test_groupby(data_cluster):
+    items = [{"k": i % 3, "v": i} for i in range(12)]
+    out = rd.from_items(items).groupby("k").sum("v").take_all()
+    assert out == [
+        {"k": 0, "sum(v)": 0 + 3 + 6 + 9},
+        {"k": 1, "sum(v)": 1 + 4 + 7 + 10},
+        {"k": 2, "sum(v)": 2 + 5 + 8 + 11},
+    ]
+
+
+def test_sort_and_limit(data_cluster):
+    ds = rd.from_items([{"x": v} for v in [5, 3, 8, 1]])
+    assert [r["x"] for r in ds.sort("x").take_all()] == [1, 3, 5, 8]
+    assert ds.limit(2).count() == 2
+
+
+def test_random_shuffle_preserves_rows(data_cluster):
+    ds = rd.range(50).random_shuffle(seed=42)
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(50))
+
+
+def test_repartition(data_cluster):
+    ds = rd.range(100, parallelism=2).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+
+
+def test_iter_batches(data_cluster):
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sizes == [32, 32, 32, 4]
+    assert batches[0]["id"].dtype == np.int64
+
+
+def test_parquet_roundtrip(data_cluster, tmp_path):
+    path = str(tmp_path / "pq")
+    rd.range(20).write_parquet(path)
+    back = rd.read_parquet(path)
+    assert back.count() == 20
+    assert sorted(r["id"] for r in back.take_all()) == list(range(20))
+
+
+def test_csv_roundtrip(data_cluster, tmp_path):
+    path = str(tmp_path / "csv")
+    rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]).write_csv(path)
+    back = rd.read_csv(path)
+    assert back.count() == 2
+
+
+def test_shard_for_train(data_cluster):
+    ds = rd.range(64, parallelism=4).materialize()
+    shard0 = ds.shard(0, 2)
+    shard1 = ds.shard(1, 2)
+    total = shard0.count() + shard1.count()
+    assert total == 64
+    assert shard0.count() > 0 and shard1.count() > 0
+
+
+def test_split_and_streaming_split(data_cluster):
+    ds = rd.range(60)
+    splits = ds.split(3)
+    assert sum(s.count() for s in splits) == 60
+    iters = rd.range(40).streaming_split(2)
+    counts = [sum(len(b["id"]) for b in it.iter_batches(batch_size=10))
+              for it in iters]
+    assert sum(counts) == 40
+
+
+def test_union_and_zip(data_cluster):
+    a = rd.from_items([{"x": 1}, {"x": 2}])
+    b = rd.from_items([{"x": 3}])
+    assert a.union(b).count() == 3
+    z = rd.from_items([{"l": 1}]).zip(rd.from_items([{"r": 2}]))
+    assert z.take_all() == [{"l": 1, "r": 2}]
