@@ -128,11 +128,18 @@ def get_tpu_slice_name() -> Optional[str]:
     return name
 
 
-# Chips per host by generation. v2/v3 suffixes count cores (2/chip); the
-# others count chips. v5e/v6e multi-host slices use 4-chip hosts (8-chip
-# hosts exist only as single-host topologies, where this yields 1 anyway).
+# Chips per host by generation. v5e/v6e multi-host slices use 4-chip hosts;
+# their 8-chip slices (ct5lp-hightpu-8t / ct6e-standard-8t, topology 2x4)
+# are a single 8-chip host and are special-cased below.
 _CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5p": 4,
                    "v5litepod": 4, "v5e": 4, "v6e": 4}
+
+_CHIP_SUFFIX_SINGLE_HOST_8 = ("v5litepod", "v5e", "v6e")
+
+# Generations whose pod-type suffix counts TensorCores (2 per chip), not
+# chips (reference: _private/accelerators/tpu.py SINGLE_CORE_TPU_TYPES —
+# v2/v3/v4/v5p all name slices by core count: v5p-8 is one 4-chip host).
+_CORE_SUFFIX_GENERATIONS = ("v2", "v3", "v4", "v5p")
 
 
 def num_workers_in_slice(pod_type: str, topology: Optional[str]) -> int:
@@ -142,8 +149,10 @@ def num_workers_in_slice(pod_type: str, topology: Optional[str]) -> int:
     except (IndexError, ValueError):
         return 1
     generation = pod_type.split("-")[0]
-    if generation in ("v2", "v3"):
-        chips //= 2  # suffix counts cores
+    if generation in _CORE_SUFFIX_GENERATIONS:
+        chips //= 2  # suffix counts TensorCores
+    if generation in _CHIP_SUFFIX_SINGLE_HOST_8 and chips == 8:
+        return 1  # one 8-chip host, not two 4-chip hosts
     per_host = _CHIPS_PER_HOST.get(generation, 4)
     chips_per_host = min(chips, per_host)
     return max(1, chips // chips_per_host)

@@ -456,12 +456,16 @@ def _run_map_tasks(refs: List, fns: List[Callable]) -> List:
             block = fn(block)
         return block
 
-    window = ctx.max_tasks_in_flight
+    window = max(1, ctx.max_tasks_in_flight)
     out: List = []
     pending: List = []
     for ref in refs:
-        pending.append(_apply.remote(ref))
         if len(pending) >= window:
-            out.append(pending.pop(0))
+            # Backpressure: block until the oldest in-flight task lands
+            # before submitting the next one.
+            oldest = pending.pop(0)
+            ray_tpu.wait([oldest], num_returns=1)
+            out.append(oldest)
+        pending.append(_apply.remote(ref))
     out.extend(pending)
     return out

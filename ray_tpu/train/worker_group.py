@@ -42,8 +42,26 @@ class TrainWorker:
         self._jax_initialized = True
         return True
 
-    def get_address(self):
-        return socket.gethostname()
+    def get_coordinator(self) -> str:
+        """Pick a routable IP + free port on THIS worker's host.
+
+        The JAX coordination service binds on rank 0's host, so the port
+        must be probed here — a port free on the controller's host may be
+        taken on this one — and `gethostname()` may not resolve from peers,
+        so the IP comes from the UDP-connect trick.
+        """
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            sock.connect(("8.8.8.8", 80))
+            ip = sock.getsockname()[0]
+        except OSError:
+            ip = "127.0.0.1"
+        finally:
+            sock.close()
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        return f"{ip}:{port}"
 
     def set_coordinator(self, coordinator: str):
         self.coordinator = coordinator
@@ -134,20 +152,14 @@ class WorkerGroup:
                      self.scaling.use_tpu, coordinator)
             self.workers.append(worker)
             if rank == 0 and n > 1:
-                host = ray_tpu.get(worker.get_address.remote(), timeout=300)
-                coordinator = f"{host}:{self._free_port()}"
+                coordinator = ray_tpu.get(worker.get_coordinator.remote(),
+                                          timeout=300)
         if n > 1:
             ray_tpu.get([w.set_coordinator.remote(coordinator)
                          for w in self.workers], timeout=300)
         ray_tpu.get([w.setup_distributed.remote() for w in self.workers],
                     timeout=600)
         return self
-
-    @staticmethod
-    def _free_port() -> int:
-        with socket.socket() as s:
-            s.bind(("", 0))
-            return s.getsockname()[1]
 
     def run_train_fn(self, train_fn, config, resume_checkpoint,
                      dataset_factories):

@@ -14,12 +14,14 @@ NCCL/gloo backends). The TPU framework has TWO collective planes (SURVEY §5):
   named-actor ncclUniqueId store, nccl_collective_group.py:28-77).
 
 Semantics: ranks call collectives in the same order (standard collective
-contract). Implementation is rank-0-rooted tree reduce/bcast — correct and
-simple; ring algorithms can land later behind the same API.
+contract). Implementation is a rank-0-rooted star (serial sends at the
+root, O(world) latency) — correct and simple for control-plane sizes;
+ring/tree algorithms can land later behind the same API.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -83,7 +85,7 @@ class CollectiveGroup:
         self.rank = rank
         self.world_size = world_size
         self.members = members  # rank -> rpc address
-        self.op_seq = 0
+        self.op_seq: Dict[str, int] = {}
 
     def _send_to(self, rank: int, key: Tuple, array: np.ndarray):
         worker = get_core_worker()
@@ -173,8 +175,14 @@ class CollectiveGroup:
     # -- helpers ---------------------------------------------------------
 
     def _next_seq(self, op: str) -> int:
-        self.op_seq += 1
-        return self.op_seq
+        # Collective ops execute in lockstep on every rank, so they share
+        # one counter (which also keeps allreduce's inner "red" keys
+        # disjoint from a standalone reduce's). P2P advances per directed
+        # channel, so two ranks with different op histories still derive
+        # the same sequence number for the same send/recv pair.
+        chan = op if op.startswith("p2p-") else "collective"
+        self.op_seq[chan] = self.op_seq.get(chan, 0) + 1
+        return self.op_seq[chan]
 
     def _bcast_obj(self, seq, obj):
         from ..._internal import serialization
@@ -222,7 +230,7 @@ def init_collective_group(world_size: int, rank: int,
     worker = get_core_worker()
     key_prefix = f"{group_name}:"
     worker.gcs.put("collective", f"{key_prefix}{rank}",
-                   repr(worker.rpc_address).encode())
+                   json.dumps(list(worker.rpc_address)).encode())
     deadline = time.monotonic() + 120
     members: List = [None] * world_size
     while time.monotonic() < deadline:
@@ -231,7 +239,7 @@ def init_collective_group(world_size: int, rank: int,
             if members[r] is None:
                 raw = worker.gcs.get("collective", f"{key_prefix}{r}")
                 if raw is not None:
-                    members[r] = eval(raw.decode())  # noqa: S307 — own data
+                    members[r] = tuple(json.loads(raw.decode()))
             if members[r] is not None:
                 found += 1
         if found == world_size:

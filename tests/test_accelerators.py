@@ -1,0 +1,32 @@
+"""TPU accelerator-manager logic (no hardware; pure pod-type math).
+
+Reference semantics: _private/accelerators/tpu.py — v2/v3/v4/v5p pod-type
+suffixes count TensorCores (2 per chip); v5e/v6e count chips.
+"""
+
+from ray_tpu.accelerators.tpu import num_workers_in_slice
+
+
+def test_core_suffix_generations_halved():
+    # v5p-8 = 8 cores = 4 chips = one 4-chip host.
+    assert num_workers_in_slice("v5p-8", None) == 1
+    # v4-16 = 16 cores = 8 chips = two hosts.
+    assert num_workers_in_slice("v4-16", None) == 2
+    assert num_workers_in_slice("v2-8", None) == 1
+    assert num_workers_in_slice("v3-32", None) == 4
+
+
+def test_chip_suffix_generations_not_halved():
+    assert num_workers_in_slice("v5litepod-16", None) == 4
+    assert num_workers_in_slice("v5litepod-4", None) == 1
+
+
+def test_v5e_v6e_8_chip_is_single_host():
+    # ct5lp-hightpu-8t / ct6e-standard-8t: one 8-chip host (topology 2x4).
+    assert num_workers_in_slice("v6e-8", None) == 1
+    assert num_workers_in_slice("v5litepod-8", None) == 1
+
+
+def test_malformed_pod_type_defaults_to_one():
+    assert num_workers_in_slice("weird", None) == 1
+    assert num_workers_in_slice("v5p-x", None) == 1
