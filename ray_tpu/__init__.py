@@ -25,7 +25,8 @@ from ._internal.api import (available_resources, cancel, cluster_resources,
 from ._internal.errors import (ActorDiedError, ActorError,
                                ActorUnavailableError, GetTimeoutError,
                                ObjectLostError, OutOfMemoryError, RayTpuError,
-                               RpcError, TaskError, WorkerCrashedError)
+                               RpcError, TaskCancelledError, TaskError,
+                               WorkerCrashedError)
 from ._internal.object_ref import ObjectRef
 from .actor import ActorClass, ActorHandle, get_actor, method
 from .remote_function import RemoteFunction
@@ -68,4 +69,5 @@ __all__ = [
     "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
     "ActorUnavailableError", "ObjectLostError", "GetTimeoutError",
     "WorkerCrashedError", "OutOfMemoryError", "RpcError",
+    "TaskCancelledError",
 ]

@@ -179,14 +179,21 @@ def kill(actor, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    """Best-effort cancellation of a pending task (reference:
-    worker.py cancel). Queued leases are cancelable; running tasks are only
-    killed with force=True (worker process kill)."""
-    worker = get_core_worker()
-    # Round-1 semantics: drop from pending (result becomes TaskCancelledError
-    # via ObjectLost on get) — full propagation lands with the state API.
-    raise NotImplementedError(
-        "cancel() is not implemented yet in this round")
+    """Cancel a pending or running task (reference: worker.py cancel).
+
+    The task's returns resolve to TaskCancelledError on get(). Tasks that
+    have not started never run; running async actor tasks are
+    asyncio-cancelled; running sync tasks are only stopped with force=True
+    (worker process kill). Already-finished tasks are a no-op.
+    """
+    from .object_ref import ObjectRefGenerator
+    if isinstance(ref, ObjectRefGenerator):
+        ref = ref._generator_ref
+        if ref is None:
+            return  # already-materialized generator: task finished
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("cancel() expects an ObjectRef or ObjectRefGenerator")
+    get_core_worker().cancel_task(ref, force=force, recursive=recursive)
 
 
 def cluster_resources() -> Dict[str, float]:

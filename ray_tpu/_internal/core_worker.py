@@ -207,6 +207,7 @@ class TaskManager:
         self._lock = threading.Lock()
         self.pending: Dict[TaskID, PendingTask] = {}
         self.lineage: Dict[TaskID, TaskSpec] = {}
+        self.cancelled: Set[TaskID] = set()
         self._lineage_bytes = 0
 
     def add_pending(self, spec: TaskSpec):
@@ -225,7 +226,37 @@ class TaskManager:
         with self._lock:
             return len(self.pending)
 
+    def cancel(self, task_id: TaskID) -> Optional[TaskSpec]:
+        """Mark a pending task cancelled; its returns resolve to
+        TaskCancelledError and any late reply is discarded. Returns the
+        spec if the task was still pending, else None."""
+        with self._lock:
+            pending = self.pending.pop(task_id, None)
+            if pending is None:
+                return None
+            self.cancelled.add(task_id)
+            spec = pending.spec
+        from .errors import TaskCancelledError
+        err = TaskCancelledError(task_id.hex()[:16])
+        for oid in spec.return_ids():
+            self._cw.memory_store.put(oid, err, is_exception=True)
+        self._release_deps(pending)
+        return spec
+
+    def is_cancelled(self, task_id: TaskID) -> bool:
+        with self._lock:
+            return task_id in self.cancelled
+
+    def _take_cancelled(self, task_id: TaskID) -> bool:
+        with self._lock:
+            if task_id in self.cancelled:
+                self.cancelled.discard(task_id)
+                return True
+            return False
+
     def on_completed(self, spec: TaskSpec, reply: Dict[str, Any]):
+        if self._take_cancelled(spec.task_id):
+            return  # late reply for a cancelled task: returns already failed
         with self._lock:
             pending = self.pending.pop(spec.task_id, None)
             # Retain lineage so lost plasma returns can be reconstructed.
@@ -239,19 +270,35 @@ class TaskManager:
                         self.lineage.pop(next(iter(self.lineage)))
                         self._lineage_bytes -= 256
         returns = reply.get("returns", [])
-        for index, ret in enumerate(returns):
-            oid = ObjectID.for_task_return(spec.task_id, index)
+        for i, ret in enumerate(returns):
+            oid = ObjectID.for_task_return(spec.task_id, ret.get("index", i))
             if ret.get("plasma"):
                 self._cw.reference_counter.mark_in_plasma(oid)
                 self._cw.memory_store.put(oid, None, in_plasma=True)
             else:
                 value = serialization.deserialize(ret["data"])
                 self._cw.memory_store.put(oid, value)
+        num_dynamic = reply.get("num_dynamic")
+        if num_dynamic is not None:
+            # Generator task: materialize the handle at index 0, owning
+            # every item ref (lineage points at the creating task).
+            from .object_ref import ObjectRefGenerator
+            item_refs = []
+            for i in range(1, num_dynamic + 1):
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                self._cw.reference_counter.add_owned(
+                    oid, lineage_task=spec.task_id)
+                item_refs.append(ObjectRef(oid, self._cw.rpc_address))
+            self._cw.memory_store.put(
+                ObjectID.for_task_return(spec.task_id, 0),
+                ObjectRefGenerator(refs=item_refs))
         self._release_deps(pending)
 
     def on_failed(self, spec: TaskSpec, error: Exception,
                   is_application_error: bool) -> bool:
         """Returns True if the task will be retried."""
+        if self._take_cancelled(spec.task_id):
+            return False  # cancelled: no retry, returns already failed
         with self._lock:
             pending = self.pending.get(spec.task_id)
             if pending is None:
@@ -318,6 +365,7 @@ class NormalTaskSubmitter:
     def __init__(self, core_worker: "CoreWorker"):
         self._cw = core_worker
         self._idle: Dict[Tuple, List[Lease]] = {}
+        self._running: Dict[TaskID, Lease] = {}  # pushed, awaiting reply
         self._cleaner_started = False
 
     def submit(self, spec: TaskSpec):
@@ -327,13 +375,21 @@ class NormalTaskSubmitter:
         self.submit(spec)
 
     async def _submit(self, spec: TaskSpec):
+        # Early-return paths consume the cancelled mark: no push means no
+        # reply will ever arrive to consume it.
+        if self._cw.task_manager._take_cancelled(spec.task_id):
+            return
         try:
             await self._resolve_dependencies(spec)
             lease = await self._acquire_lease(spec)
         except Exception as e:
             self._cw.task_manager.on_failed(spec, e, is_application_error=False)
             return
+        if self._cw.task_manager._take_cancelled(spec.task_id):
+            self._return_lease(spec.shape_key(), lease)
+            return
         worker = self._cw.clients.get(lease.worker_address)
+        self._running[spec.task_id] = lease
         try:
             reply = await worker.call("push_task", spec=spec,
                                       lease_id=lease.lease_id, timeout=None)
@@ -345,6 +401,8 @@ class NormalTaskSubmitter:
                     f"worker {lease.worker_address} failed: {e}"),
                 is_application_error=False)
             return
+        finally:
+            self._running.pop(spec.task_id, None)
         self._return_lease(spec.shape_key(), lease)
         error = reply.get("error")
         if error is not None:
@@ -391,6 +449,7 @@ class NormalTaskSubmitter:
             "shape_key": key,
             "runtime_env": spec.runtime_env,
             "label_selector": spec.label_selector or None,
+            "task_hex": spec.task_id.hex(),  # lease cancellation key
         }
         strategy = spec.scheduling_strategy
         if strategy.kind == "placement_group":
@@ -405,6 +464,9 @@ class NormalTaskSubmitter:
             reply = await raylet.call("request_worker_lease", spec_meta=meta,
                                       timeout=None,
                                       retries=CONFIG.rpc_max_retries)
+            if reply.get("canceled"):
+                raise RayTpuError(f"lease for task {spec.task_id.hex()[:12]} "
+                                  "canceled")  # consumed by on_failed
             if reply.get("spillback_to"):
                 raylet_addr = tuple(reply["spillback_to"][1])
                 continue
@@ -515,6 +577,11 @@ class ActorTaskSubmitter:
         await self._push(st, spec)
 
     async def _push(self, st: ActorClientState, spec: TaskSpec):
+        if self._cw.task_manager.is_cancelled(spec.task_id):
+            # Cancelled while queued: the sequence number must still reach
+            # the actor (its ordered queues advance per-seq), so push a
+            # tombstone the executor completes without running user code.
+            spec.method_name = "__rtpu_cancelled__"
         st.inflight[spec.sequence_number] = spec
         worker = self._cw.clients.get(st.address)
         try:
@@ -640,6 +707,21 @@ class TaskExecutor:
         self._seq_buffer: Dict[bytes,
                                Dict[int, Tuple[TaskSpec, asyncio.Future]]] = {}
         self._reply_cache: Dict[bytes, Dict[int, Dict[str, Any]]] = {}
+        # Cancellation: tasks marked before they start never run; running
+        # async actor tasks are asyncio-cancelled (sync tasks cannot be
+        # interrupted mid-flight without force-killing the worker).
+        self.cancelled_tasks: Set[TaskID] = set()
+        self._running_async: Dict[TaskID, asyncio.Task] = {}
+        self._running_sync: Set[TaskID] = set()
+
+    def cancel(self, task_id: TaskID):
+        self.cancelled_tasks.add(task_id)
+        atask = self._running_async.get(task_id)
+        if atask is not None:
+            atask.cancel()
+
+    def is_running(self, task_id: TaskID) -> bool:
+        return task_id in self._running_sync or task_id in self._running_async
 
     async def execute(self, spec: TaskSpec) -> Dict[str, Any]:
         await self._cw.ensure_job_env(spec.job_id)
@@ -693,8 +775,19 @@ class TaskExecutor:
                 pool.submit(_run)
 
     async def _run_async_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
-        async with self._actor_async_sem:
-            result = await self._run_task_async(spec)
+        self._running_async[spec.task_id] = asyncio.current_task()
+        try:
+            async with self._actor_async_sem:
+                if spec.task_id in self.cancelled_tasks:
+                    self.cancelled_tasks.discard(spec.task_id)
+                    result = {"cancelled": True}
+                else:
+                    result = await self._run_task_async(spec)
+        except asyncio.CancelledError:
+            result = {"cancelled": True}
+        finally:
+            self._running_async.pop(spec.task_id, None)
+            self.cancelled_tasks.discard(spec.task_id)
         if not fut.done():
             fut.set_result(result)
 
@@ -718,6 +811,8 @@ class TaskExecutor:
                 {k: subst(v) for k, v in bundle.kwargs.items()})
 
     def _package_returns(self, spec: TaskSpec, result: Any) -> Dict[str, Any]:
+        if spec.is_generator():
+            return self._package_dynamic_returns(spec, result)
         if spec.num_returns == 0:
             return {"returns": []}
         values = (result,) if spec.num_returns == 1 else tuple(result)
@@ -737,9 +832,34 @@ class TaskExecutor:
                 returns.append({"data": sobj.to_bytes()})
         return {"returns": returns}
 
+    def _package_dynamic_returns(self, spec: TaskSpec,
+                                 result: Any) -> Dict[str, Any]:
+        """Generator task: each yielded item becomes its own return object
+        at index 1..N; index 0 is reserved for the generator handle the
+        owner materializes on completion."""
+        returns = []
+        index = 0
+        for value in result:
+            index += 1
+            sobj = serialization.serialize(value)
+            oid = ObjectID.for_task_return(spec.task_id, index)
+            if sobj.total_bytes() > CONFIG.max_direct_call_object_size:
+                self._cw.put_serialized_to_plasma(oid, sobj,
+                                                  owner=spec.owner_address)
+                returns.append({"index": index, "plasma": True,
+                                "size": sobj.total_bytes()})
+            else:
+                returns.append({"index": index, "data": sobj.to_bytes()})
+        return {"returns": returns, "num_dynamic": index}
+
     def _run_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        if spec.method_name == "__rtpu_cancelled__" \
+                or spec.task_id in self.cancelled_tasks:
+            self.cancelled_tasks.discard(spec.task_id)
+            return {"cancelled": True}
         RUNTIME_CTX.task_spec = spec
         RUNTIME_CTX.actor_id = spec.actor_id
+        self._running_sync.add(spec.task_id)
         try:
             if spec.task_type == ACTOR_TASK \
                     and spec.method_name == "__rtpu_terminate__":
@@ -767,6 +887,10 @@ class TaskExecutor:
         finally:
             RUNTIME_CTX.task_spec = None
             RUNTIME_CTX.actor_id = None
+            self._running_sync.discard(spec.task_id)
+            # A cancel that raced past the start check is moot once the
+            # task finishes; drop the mark so the set stays bounded.
+            self.cancelled_tasks.discard(spec.task_id)
 
     def _graceful_exit(self, spec: TaskSpec) -> Dict[str, Any]:
         try:
@@ -779,6 +903,8 @@ class TaskExecutor:
 
     async def _run_task_async(self, spec: TaskSpec) -> Dict[str, Any]:
         try:
+            if spec.method_name == "__rtpu_cancelled__":
+                return {"cancelled": True}
             if spec.method_name == "__rtpu_terminate__":
                 return self._graceful_exit(spec)
             loop = asyncio.get_running_loop()
@@ -1190,6 +1316,56 @@ class CoreWorker:
 
     async def handle_borrow_decref(self, object_hex: str):
         self.reference_counter.remove_borrower(ObjectID.from_hex(object_hex))
+        return True
+
+    def cancel_task(self, ref: ObjectRef, force: bool = False,
+                    recursive: bool = False) -> bool:
+        """Owner-side cancel (reference: _private/worker.py cancel).
+
+        Marks the task cancelled (its returns resolve to
+        TaskCancelledError, late replies are dropped, no retries) and
+        best-effort notifies the executing worker: queued tasks never
+        start, running async actor tasks are asyncio-cancelled, and
+        force=True kills the worker process outright. `recursive` is
+        accepted for API parity; child tasks are not tracked yet.
+        """
+        task_id = ref.id().task_id()
+        spec = self.task_manager.cancel(task_id)
+        if spec is None:
+            return False  # already finished (or not ours)
+        if spec.task_type == ACTOR_TASK:
+            # Queued specs stay in the stream (pushed as tombstones so the
+            # actor's per-caller sequence numbering stays dense); a running
+            # task is asyncio-cancelled on the actor.
+            st = self.actor_submitter._actors.get(spec.actor_id)
+            if st is not None and st.address is not None:
+                self.fire_and_forget(st.address, "cancel_task",
+                                     task_hex=task_id.hex(), force=False)
+        else:
+            lease = self.submitter._running.get(task_id)
+            if lease is not None:
+                self.fire_and_forget(lease.worker_address, "cancel_task",
+                                     task_hex=task_id.hex(), force=force)
+            else:
+                # Not pushed yet: drop any queued lease request so the
+                # cancelled task stops competing for resources.
+                self.fire_and_forget(self.raylet_address,
+                                     "cancel_lease_by_task",
+                                     task_hex=task_id.hex())
+        return True
+
+    async def handle_cancel_task(self, task_hex: str, force: bool = False):
+        task_id = TaskID.from_hex(task_hex)
+        if force:
+            # Exit only if that task is actually still executing here — the
+            # lease may have been returned and reused for an unrelated task
+            # by the time this RPC lands.
+            if self.executor.is_running(task_id):
+                EventLoopThread.get().loop.call_later(0.05, os._exit, 1)
+            else:
+                self.executor.cancel(task_id)
+            return True
+        self.executor.cancel(task_id)
         return True
 
     async def handle_kill_actor(self, actor_id: ActorID):

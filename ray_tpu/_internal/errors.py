@@ -82,6 +82,14 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via `ray_tpu.cancel()` before it finished."""
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} was cancelled")
+
+
 class ActorError(RayTpuError):
     pass
 

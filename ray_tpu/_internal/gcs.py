@@ -281,10 +281,15 @@ class GcsServer:
         rec.last_heartbeat = time.monotonic()
         rec.missed_health_checks = 0
         view = self._resource_views.get(node_id)
-        if view is not None:
-            total = ResourceSet(resources_total)
-            view.resources.total = total
-            view.resources.available = ResourceSet(resources_available)
+        if view is None:
+            # Node restored from a snapshot after a GCS restart: its view
+            # (not persisted) is rebuilt from the first heartbeat.
+            view = NodeView(node_id, NodeResources(
+                ResourceSet(resources_total), rec.labels))
+            self._resource_views[node_id] = view
+        total = ResourceSet(resources_total)
+        view.resources.total = total
+        view.resources.available = ResourceSet(resources_available)
         # Reply with the full cluster view for spillback decisions.
         return {"dead": False, "view": self.cluster_view_snapshot()}
 

@@ -82,6 +82,52 @@ class ObjectRef:
         return asyncio.wrap_future(fut).__await__()
 
 
+class ObjectRefGenerator:
+    """Handle to the refs of a generator task (reference: _raylet.pyx:297).
+
+    `num_returns="dynamic"`: `get()` on the task's return resolves to one of
+    these, holding the materialized item refs. `num_returns="streaming"`:
+    `remote()` returns one directly; iteration lazily waits for the task to
+    finish, then yields the item refs (item-by-item arrival streaming can
+    layer in behind the same interface).
+    """
+
+    def __init__(self, refs=None, generator_ref: "ObjectRef" = None):
+        self._refs = list(refs) if refs is not None else None
+        self._generator_ref = generator_ref
+
+    def _materialize(self):
+        if self._refs is None:
+            from . import core_worker as cw
+            resolved = cw.get_core_worker().get([self._generator_ref])[0]
+            self._refs = list(resolved._refs)
+        return self._refs
+
+    def __iter__(self):
+        # One-shot iterator (like the reference's ObjectRefGenerator):
+        # next() and for-loops share one cursor, so peeking an item then
+        # looping does not re-yield it.
+        return self
+
+    def __next__(self):
+        if not hasattr(self, "_iter"):
+            self._iter = iter(self._materialize())
+        return next(self._iter)
+
+    def __len__(self):
+        return len(self._materialize())
+
+    def __getitem__(self, index):
+        return self._materialize()[index]
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._refs, self._generator_ref))
+
+    def __repr__(self):
+        n = "?" if self._refs is None else len(self._refs)
+        return f"ObjectRefGenerator({n} refs)"
+
+
 def _rebuild_ref(object_id: ObjectID, owner_address):
     ref = ObjectRef(object_id, owner_address, _register=True)
     # A deserialized ref is a borrow: tell the owner (async, best-effort; the

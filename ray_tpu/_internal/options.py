@@ -38,12 +38,11 @@ def validate_options(options: Dict[str, Any], for_actor: bool):
             raise ValueError(f"invalid option {key!r} for a {kind}")
     num_returns = options.get("num_returns")
     if num_returns is not None and not (
-            isinstance(num_returns, int) and num_returns >= 0):
-        if num_returns in ("dynamic", "streaming"):
-            raise NotImplementedError(
-                "num_returns='dynamic'/'streaming' (generator tasks) is not "
-                "supported yet; return a list and index it instead")
-        raise ValueError("num_returns must be a non-negative int")
+            isinstance(num_returns, int) and num_returns >= 0) \
+            and num_returns not in ("dynamic", "streaming"):
+        raise ValueError(
+            "num_returns must be a non-negative int, 'dynamic' or "
+            "'streaming'")
     lifetime = options.get("lifetime")
     if lifetime not in (None, "detached", "non_detached"):
         raise ValueError("lifetime must be None, 'detached' or 'non_detached'")

@@ -127,6 +127,9 @@ class Raylet:
                        retries=CONFIG.rpc_max_retries)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._worker_liveness_loop()))
+        if CONFIG.memory_monitor_refresh_ms > 0:
+            self._tasks.append(
+                asyncio.ensure_future(self._memory_monitor_loop()))
         return self.address
 
     async def stop(self):
@@ -257,6 +260,71 @@ class Raylet:
                 "report_worker_death", node_id=self.node_id,
                 worker_id=handle.worker_id, cause="worker process died",
                 timeout=10)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # memory monitor (reference: src/ray/common/memory_monitor.h:52 +
+    # raylet/worker_killing_policy.h:39 retriable-FIFO variant)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _system_memory_usage_fraction() -> float:
+        """Used fraction of system memory from /proc/meminfo."""
+        try:
+            fields = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    name, _, rest = line.partition(":")
+                    fields[name] = int(rest.split()[0])
+            total = fields.get("MemTotal", 0)
+            avail = fields.get("MemAvailable", total)
+            if total <= 0:
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:  # pragma: no cover
+            return 0.0
+
+    # Overridable for tests / fake pressure injection.
+    _memory_usage_fn = None
+
+    async def _memory_monitor_loop(self):
+        period = CONFIG.memory_monitor_refresh_ms / 1000.0
+        while not self._stopped:
+            try:
+                await asyncio.sleep(period)
+                usage_fn = (self._memory_usage_fn
+                            or self._system_memory_usage_fraction)
+                usage = usage_fn()
+                if usage > CONFIG.memory_usage_threshold:
+                    self._kill_for_memory(usage)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("memory monitor loop error")
+
+    def _kill_for_memory(self, usage: float):
+        """Retriable-FIFO policy: kill the most recently leased
+        task-worker first (its owner retries it), sparing actor workers
+        as long as possible; at most one kill per refresh tick."""
+        leased = [w for w in self.workers.values()
+                  if w.state == "LEASED" and w.proc is not None]
+        if not leased:
+            return
+        leased.sort(key=lambda w: ((0 if not w.is_actor_worker else 1),
+                                   -(w.lease_id or 0)))
+        victim = leased[0]
+        consequence = ("callers see ActorDiedError unless max_restarts "
+                       "allows a restart" if victim.is_actor_worker
+                       else "the owner will retry retriable tasks")
+        logger.warning(
+            "memory usage %.1f%% above threshold %.1f%%: killing worker "
+            "%s (pid %s, %s) to relieve pressure; %s",
+            usage * 100, CONFIG.memory_usage_threshold * 100,
+            victim.worker_id.hex()[:12], victim.pid,
+            "actor" if victim.is_actor_worker else "task", consequence)
+        try:
+            victim.proc.kill()
         except Exception:
             pass
 
@@ -404,6 +472,16 @@ class Raylet:
             if handle is not None:
                 self._kill_worker(handle)
         self._release_lease(lease_id)
+        return True
+
+    async def handle_cancel_lease_by_task(self, task_hex: str):
+        """Drop a queued lease request for a cancelled task so it stops
+        competing for resources (and never cold-starts a worker)."""
+        for req in list(self.queued):
+            if req.spec_meta.get("task_hex") == task_hex:
+                if not req.future.done():
+                    req.future.set_result({"canceled": True})
+                self.queued.remove(req)
         return True
 
     async def handle_cancel_lease(self, lease_id: int):

@@ -157,7 +157,10 @@ class RpcServer:
         if self._server is not None:
             self._server.close()
             try:
-                await self._server.wait_closed()
+                # 3.12's wait_closed blocks until every open connection
+                # finishes; peers hold persistent connections, so cap it —
+                # the listening socket is already closed by close().
+                await asyncio.wait_for(self._server.wait_closed(), 2)
             except Exception:
                 pass
         with _local_servers_lock:

@@ -96,7 +96,15 @@ class TaskSpec:
     generator_backpressure: int = -1
     enable_task_events: bool = True
 
+    def is_generator(self) -> bool:
+        return self.num_returns in ("dynamic", "streaming")
+
     def return_ids(self) -> List[ObjectID]:
+        # Generator tasks own one "generator ref" at index 0; the yielded
+        # items land at indices 1..N once N is known (reference:
+        # _raylet.pyx ObjectRefGenerator dynamic return ids).
+        if self.is_generator():
+            return [ObjectID.for_task_return(self.task_id, 0)]
         return [ObjectID.for_task_return(self.task_id, i)
                 for i in range(self.num_returns)]
 

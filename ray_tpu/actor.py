@@ -100,6 +100,11 @@ class ActorHandle:
                                     self._max_task_retries),
         )
         refs = worker.submit_task(spec)
+        if num_returns == "streaming":
+            from ._internal.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(generator_ref=refs[0])
+        if num_returns == "dynamic":
+            return refs[0]
         if num_returns == 0:
             return None
         return refs[0] if num_returns == 1 else refs

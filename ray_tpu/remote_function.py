@@ -96,6 +96,11 @@ class RemoteFunction:
             enable_task_events=opts.get("enable_task_events", True),
         )
         refs = worker.submit_task(spec)
+        if num_returns == "streaming":
+            from ._internal.object_ref import ObjectRefGenerator
+            return ObjectRefGenerator(generator_ref=refs[0])
+        if num_returns == "dynamic":
+            return refs[0]
         if num_returns == 0:
             return None
         if num_returns == 1:
