@@ -129,6 +129,8 @@ class BlockAccessor:
 
     @staticmethod
     def concat(blocks: List[Block]) -> Block:
+        if not blocks:
+            return []
         tables = [b for b in blocks if isinstance(b, pa.Table)]
         if tables and len(tables) == len(blocks):
             return pa.concat_tables(tables, promote_options="default")
@@ -136,6 +138,24 @@ class BlockAccessor:
         for block in blocks:
             out.extend(BlockAccessor(block).to_pylist())
         return out
+
+    @staticmethod
+    def empty() -> Block:
+        return []
+
+    @staticmethod
+    def from_rows(rows: List[Any]) -> Block:
+        """Rows (dicts of scalars/arrays, or plain values) to a block —
+        arrow table when the shape allows, else a list block."""
+        if rows and isinstance(rows[0], dict) and all(
+                np.isscalar(v) or isinstance(v, (np.ndarray, list, str))
+                for v in rows[0].values()):
+            try:
+                keys = rows[0].keys()
+                return pa.table({k: [r[k] for r in rows] for k in keys})
+            except Exception:
+                return rows
+        return rows
 
     def sort_by(self, key, descending: bool = False) -> Block:
         if isinstance(self.block, pa.Table):

@@ -13,7 +13,12 @@ class DataContext:
     target_min_block_size: int = 1 * 1024 * 1024
     max_tasks_in_flight: int = 16
     read_parallelism: int = 8
-    shuffle_strategy: str = "pull"
+    shuffle_strategy: str = "push"
+    # Streaming executor buffers (in blocks): per-operator edge buffer and
+    # the consumer-facing output queue — both bound memory and carry the
+    # backpressure signal upstream.
+    op_output_buffer_blocks: int = 8
+    streaming_output_buffer_blocks: int = 8
 
     _current = None
     _lock = threading.Lock()

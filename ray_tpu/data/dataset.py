@@ -51,7 +51,12 @@ class Dataset:
 
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy",
-                    fn_kwargs: Optional[Dict] = None) -> "Dataset":
+                    fn_kwargs: Optional[Dict] = None,
+                    compute: Optional[str] = None,
+                    concurrency: Optional[int] = None) -> "Dataset":
+        """compute="actors" runs this stage on a pool of `concurrency`
+        actors (reference: ActorPoolMapOperator) instead of per-block
+        tasks — for fns with expensive setup (models, tokenizers)."""
         fn_kwargs = fn_kwargs or {}
 
         def stage(block: Block) -> Block:
@@ -68,7 +73,9 @@ class Dataset:
                 return block
             return BlockAccessor.concat(outs)
 
-        return self._with_stage(("map", stage), "map_batches")
+        opts = {"compute": compute, "concurrency": concurrency} \
+            if compute or concurrency else {}
+        return self._with_stage(("map", stage, opts), "map_batches")
 
     def map(self, fn: Callable) -> "Dataset":
         def stage(block: Block) -> Block:
@@ -125,45 +132,28 @@ class Dataset:
         return self._with_stage(("allToAll", plan_fn), f"limit[{n}]")
 
     def repartition(self, num_blocks: int) -> "Dataset":
+        from .exchange import repartition_exchange
+
         def plan_fn(block_refs: List) -> List:
-            import ray_tpu
-            blocks = ray_tpu.get(list(block_refs))
-            merged = BlockAccessor.concat(blocks) if blocks else []
-            acc = BlockAccessor(merged)
-            total = acc.num_rows()
-            out = []
-            per = max(1, -(-total // num_blocks)) if total else 0
-            for i in range(num_blocks):
-                start = min(i * per, total)
-                end = min(start + per, total)
-                out.append(ray_tpu.put(acc.slice(start, end)))
-            return out
-        return self._with_stage(("allToAll", plan_fn),
+            return repartition_exchange(block_refs, num_blocks)
+        return self._with_stage(("allToAll", plan_fn, "repartition"),
                                 f"repartition[{num_blocks}]")
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        from .exchange import shuffle_exchange
+
         def plan_fn(block_refs: List) -> List:
-            import ray_tpu
-            blocks = ray_tpu.get(list(block_refs))
-            rows = [r for b in blocks
-                    for r in BlockAccessor(b).iter_rows()]
-            rng = _random.Random(seed)
-            rng.shuffle(rows)
-            n_out = max(1, len(block_refs))
-            per = max(1, -(-len(rows) // n_out))
-            return [ray_tpu.put(_rows_to_block(rows[i * per:(i + 1) * per]))
-                    for i in range(n_out)]
-        return self._with_stage(("allToAll", plan_fn), "random_shuffle")
+            return shuffle_exchange(block_refs, seed)
+        return self._with_stage(("allToAll", plan_fn, "shuffle"),
+                                "random_shuffle")
 
     def sort(self, key: Union[str, Callable], descending: bool = False
              ) -> "Dataset":
+        from .exchange import sort_exchange
+
         def plan_fn(block_refs: List) -> List:
-            import ray_tpu
-            blocks = ray_tpu.get(list(block_refs))
-            merged = BlockAccessor.concat(blocks) if blocks else []
-            result = BlockAccessor(merged).sort_by(key, descending)
-            return [ray_tpu.put(result)]
-        return self._with_stage(("allToAll", plan_fn), "sort")
+            return sort_exchange(block_refs, key, descending)
+        return self._with_stage(("allToAll", plan_fn, "sort"), "sort")
 
     def union(self, *others: "Dataset") -> "Dataset":
         parents = [self, *others]
@@ -201,28 +191,31 @@ class Dataset:
     # execution
     # ------------------------------------------------------------------
 
+    def _make_executor(self):
+        """Lower stages into a streaming-operator topology."""
+        from .context import DataContext
+        from .streaming import StreamingExecutor, build_ops
+        ctx = DataContext.get_current()
+        ops = build_ops(list(self._stages), ctx.max_tasks_in_flight)
+        return StreamingExecutor(self._source_fn, ops, name=self._name)
+
+    def iter_block_refs(self) -> Iterator:
+        """Stream block refs as the plan produces them (backpressured);
+        training can consume while upstream stages still run."""
+        if self._materialized is not None and not self._stages:
+            yield from self._materialized
+            return
+        executor = self._make_executor().run_async()
+        try:
+            yield from executor.iter_output()
+        finally:
+            executor.stop()
+
     def _execute(self) -> List:
-        """Run the plan; returns block refs. Fused map stages run as one
-        remote task per block with a bounded in-flight window."""
-        import ray_tpu
+        """Run the plan to completion; returns all block refs."""
         if self._materialized is not None and not self._stages:
             return self._materialized
-        refs = list(self._source_fn())
-        stages = list(self._stages)
-        i = 0
-        while i < len(stages):
-            # Collect a run of fusable map stages.
-            fused: List[Callable] = []
-            while i < len(stages) and stages[i][0] == "map":
-                fused.append(stages[i][1])
-                i += 1
-            if fused:
-                refs = _run_map_tasks(refs, fused)
-            if i < len(stages):
-                kind, plan_fn = stages[i]
-                refs = plan_fn(refs)
-                i += 1
-        return refs
+        return list(self.iter_block_refs())
 
     def materialize(self) -> "Dataset":
         refs = self._execute()
@@ -251,7 +244,7 @@ class Dataset:
     def take(self, n: int = 20) -> List[Any]:
         import ray_tpu
         out: List[Any] = []
-        for ref in self._execute():
+        for ref in self.iter_block_refs():  # stops the stream early
             for row in BlockAccessor(ray_tpu.get(ref)).iter_rows():
                 out.append(row)
                 if len(out) >= n:
@@ -261,7 +254,7 @@ class Dataset:
     def take_all(self) -> List[Any]:
         import ray_tpu
         out: List[Any] = []
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             out.extend(BlockAccessor(ray_tpu.get(ref)).iter_rows())
         return out
 
@@ -317,7 +310,7 @@ class Dataset:
 
     def iter_rows(self) -> Iterator[Any]:
         import ray_tpu
-        for ref in self._execute():
+        for ref in self.iter_block_refs():
             yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
 
     def iter_batches(self, *, batch_size: int = 256,
@@ -325,24 +318,12 @@ class Dataset:
                      prefetch_batches: int = 1,
                      drop_last: bool = False) -> Iterator[Any]:
         import ray_tpu
-        refs = self._execute()
-        carry: Optional[Block] = None
-        for ref in refs:
-            block = ray_tpu.get(ref)
-            if carry is not None:
-                block = BlockAccessor.concat([carry, block])
-                carry = None
-            acc = BlockAccessor(block)
-            n = acc.num_rows()
-            start = 0
-            while n - start >= batch_size:
-                piece = BlockAccessor(acc.slice(start, start + batch_size))
-                yield piece.to_batch(batch_format)
-                start += batch_size
-            if start < n:
-                carry = acc.slice(start, n)
-        if carry is not None and not drop_last:
-            yield BlockAccessor(carry).to_batch(batch_format)
+
+        def blocks():
+            for ref in self.iter_block_refs():
+                yield ray_tpu.get(ref)
+        yield from _batches_from_blocks(blocks(), batch_size, batch_format,
+                                        drop_last)
 
     def split(self, n: int, *, locality_hints=None) -> List["Dataset"]:
         refs = self.repartition(n)._execute()
@@ -365,11 +346,16 @@ class Dataset:
 
     def streaming_split(self, n: int, *, equal: bool = True,
                         locality_hints=None) -> List["DataIterator"]:
-        """Reference: Dataset.streaming_split — one iterator per consumer,
-        fed by a coordinator splitting this dataset's output."""
-        from .iterator import DataIterator
-        splits = self.split(n)
-        return [DataIterator(s) for s in splits]
+        """One iterator per consumer, fed by a coordinator actor that
+        streams this dataset's output round-robin to the consumers WHILE
+        upstream stages still run (reference: Dataset.streaming_split →
+        stream_split_iterator.py:36 + the SplitCoordinator actor)."""
+        import ray_tpu
+        from .iterator import StreamSplitIterator
+        coordinator_cls = ray_tpu.remote(_SplitCoordinator)
+        coordinator = coordinator_cls.options(
+            max_concurrency=n + 2).remote(self, n)
+        return [StreamSplitIterator(coordinator, i) for i in range(n)]
 
     def iterator(self) -> "DataIterator":
         from .iterator import DataIterator
@@ -415,16 +401,66 @@ class Dataset:
 
 
 def _rows_to_block(rows: List[Any]) -> Block:
-    if rows and isinstance(rows[0], dict) and all(
-            np.isscalar(v) or isinstance(v, (np.ndarray, list, str))
-            for v in rows[0].values()):
+    return BlockAccessor.from_rows(rows)
+
+
+def _batches_from_blocks(blocks: Iterable[Block], batch_size: int,
+                         batch_format: str, drop_last: bool
+                         ) -> Iterator[Any]:
+    """Re-batch a stream of blocks into fixed-size batches."""
+    carry: Optional[Block] = None
+    for block in blocks:
+        if carry is not None:
+            block = BlockAccessor.concat([carry, block])
+            carry = None
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        start = 0
+        while n - start >= batch_size:
+            piece = BlockAccessor(acc.slice(start, start + batch_size))
+            yield piece.to_batch(batch_format)
+            start += batch_size
+        if start < n:
+            carry = acc.slice(start, n)
+    if carry is not None and not drop_last:
+        yield BlockAccessor(carry).to_batch(batch_format)
+
+
+class _SplitCoordinator:
+    """Actor distributing one streaming execution across n consumers.
+
+    Runs the StreamingExecutor in its own process; consumers call
+    `get_next(idx)` (a blocking actor method — the actor runs with
+    max_concurrency > n so every split can park a thread). Blocks are
+    handed out round-robin; queue bounds backpressure the stream when a
+    consumer lags."""
+
+    def __init__(self, dataset: "Dataset", n: int):
+        import queue as _queue
+        import threading as _threading
+        self._n = n
+        self._error: Optional[str] = None
+        self._queues = [_queue.Queue(maxsize=4) for _ in range(n)]
+        self._executor = dataset._make_executor().run_async()
+        self._thread = _threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
+
+    def _pump(self):
         try:
-            import pyarrow as pa
-            keys = rows[0].keys()
-            return pa.table({k: [r[k] for r in rows] for k in keys})
-        except Exception:
-            return rows
-    return rows
+            for i, ref in enumerate(self._executor.iter_output()):
+                self._queues[i % self._n].put(ref)
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumers
+            self._error = repr(e)
+        finally:
+            for q in self._queues:
+                q.put(None)  # per-consumer end-of-stream
+
+    def get_next(self, idx: int):
+        """Next block ref for consumer idx, or None at end of stream."""
+        return self._queues[idx].get()
+
+    def get_error(self) -> Optional[str]:
+        return self._error
 
 
 def _as_dict(row, suffix=""):

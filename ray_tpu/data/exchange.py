@@ -1,0 +1,183 @@
+"""Distributed all-to-all exchanges: repartition / shuffle / sort / groupby.
+
+Role of the reference's exchange task schedulers
+(python/ray/data/_internal/planner/exchange/push_based_shuffle_task_scheduler.py:460,
+sort_task_spec.py:94): a map phase splits every input block into one part
+per output partition (tasks, num_returns=N), and a reduce phase merges the
+j-th part of every input (one task per output partition). The driver only
+routes refs — block payloads never pass through it.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Callable, List, Optional, Union
+
+from .block import BlockAccessor
+
+
+def _split_remote(n_out: int):
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def split_block(block):
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        per = -(-n // n_out) if n else 0
+        parts = tuple(acc.slice(min(i * per, n), min((i + 1) * per, n))
+                      for i in range(n_out))
+        return parts if n_out > 1 else parts[0]
+
+    return split_block
+
+
+def repartition_exchange(refs: List, n_out: int) -> List:
+    """Contiguous rebalance into n_out blocks; fully distributed."""
+    import ray_tpu
+    if not refs:
+        return [ray_tpu.put(BlockAccessor.empty()) for _ in range(n_out)]
+
+    split_block = _split_remote(n_out)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge(*blocks):
+        return BlockAccessor.concat(list(blocks))
+
+    parts = [split_block.remote(r) for r in refs]
+    if n_out == 1:
+        return [merge.remote(*parts)]
+    return [merge.remote(*[parts[i][j] for i in range(len(refs))])
+            for j in range(n_out)]
+
+
+def shuffle_exchange(refs: List, seed: Optional[int]) -> List:
+    """Random shuffle: per-block shuffle + round-robin scatter, then
+    per-partition merge + local shuffle."""
+    import ray_tpu
+    if not refs:
+        return refs
+    n_out = len(refs)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def scatter(block, block_seed):
+        acc = BlockAccessor(block)
+        rows = list(acc.iter_rows())
+        rng = _random.Random(block_seed)
+        rng.shuffle(rows)
+        parts = tuple(BlockAccessor.from_rows(rows[j::n_out])
+                      for j in range(n_out))
+        return parts if n_out > 1 else parts[0]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def gather(part_seed, *blocks):
+        rows = [r for b in blocks for r in BlockAccessor(b).iter_rows()]
+        rng = _random.Random(part_seed)
+        rng.shuffle(rows)
+        return BlockAccessor.from_rows(rows)
+
+    base = seed if seed is not None else _random.randrange(1 << 30)
+    parts = [scatter.remote(r, base + i) for i, r in enumerate(refs)]
+    if n_out == 1:
+        return [gather.remote(base + 7, *parts)]
+    return [gather.remote(base + 7 + j,
+                          *[parts[i][j] for i in range(len(refs))])
+            for j in range(n_out)]
+
+
+def sort_exchange(refs: List, key: Union[str, Callable],
+                  descending: bool) -> List:
+    """Sample-partition-merge distributed sort (reference:
+    sort_task_spec.py:94 SortTaskSpec boundary sampling)."""
+    import ray_tpu
+    if not refs:
+        return refs
+    n_out = len(refs)
+    key_fn = key if callable(key) else (lambda r: r[key])
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def sample(block):
+        acc = BlockAccessor(block)
+        rows = list(acc.iter_rows())
+        if not rows:
+            return []
+        step = max(1, len(rows) // 8)
+        return sorted(key_fn(r) for r in rows[::step])
+
+    if n_out == 1:
+        @ray_tpu.remote(num_cpus=1, max_retries=2)
+        def merge_all(*blocks):
+            merged = BlockAccessor.concat(list(blocks))
+            return BlockAccessor(merged).sort_by(key, descending)
+        return [merge_all.remote(*refs)]
+
+    samples = sorted(s for part in ray_tpu.get([sample.remote(r)
+                                                for r in refs])
+                     for s in part)
+    if not samples:
+        return refs
+    # n_out-1 boundaries at even sample quantiles.
+    boundaries = [samples[(i * len(samples)) // n_out]
+                  for i in range(1, n_out)]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def partition(block):
+        import bisect
+        acc = BlockAccessor(block)
+        buckets: List[List] = [[] for _ in range(n_out)]
+        for row in acc.iter_rows():
+            buckets[bisect.bisect_right(boundaries, key_fn(row))].append(row)
+        return tuple(BlockAccessor.from_rows(b) for b in buckets)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge_sorted(*blocks):
+        merged = BlockAccessor.concat(list(blocks))
+        return BlockAccessor(merged).sort_by(key, descending)
+
+    parts = [partition.remote(r) for r in refs]
+    out = [merge_sorted.remote(*[parts[i][j] for i in range(len(refs))])
+           for j in range(n_out)]
+    return list(reversed(out)) if descending else out
+
+
+def groupby_exchange(refs: List, key: str, agg_fn: Callable,
+                     agg_name: str, value_col: Optional[str]) -> List:
+    """Hash-partition by key, then per-partition group + aggregate
+    (reference: execution/operators/hash_shuffle.py hash aggregate)."""
+    import ray_tpu
+    if not refs:
+        return refs
+    n_out = min(len(refs), 8)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def hash_partition(block):
+        acc = BlockAccessor(block)
+        buckets: List[List] = [[] for _ in range(n_out)]
+        for row in acc.iter_rows():
+            buckets[hash(row[key]) % n_out].append(row)
+        return tuple(BlockAccessor.from_rows(b) for b in buckets)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def group_agg(*blocks):
+        groups = {}
+        for block in blocks:
+            for row in BlockAccessor(block).iter_rows():
+                groups.setdefault(row[key], []).append(row)
+        out = []
+        for k in sorted(groups, key=_sort_token):
+            rows = groups[k]
+            values = [r[value_col] for r in rows] if value_col else rows
+            out.append({key: k, agg_name: agg_fn(values)})
+        return BlockAccessor.from_rows(out)
+
+    parts = [hash_partition.remote(r) for r in refs]
+    if n_out == 1:
+        return [group_agg.remote(*parts)]
+    return [group_agg.remote(*[parts[i][j] for i in range(len(refs))])
+            for j in range(n_out)]
+
+
+def _sort_token(value):
+    try:
+        return (0, value)
+    except Exception:  # pragma: no cover
+        return (1, str(value))

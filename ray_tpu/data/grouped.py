@@ -1,4 +1,11 @@
-"""Grouped aggregations (reference: python/ray/data/grouped_data.py)."""
+"""Grouped aggregations (reference: python/ray/data/grouped_data.py).
+
+Aggregations run as a distributed hash exchange (hash-partition by key,
+per-partition group+agg tasks — reference: hash_shuffle.py's aggregate
+path) followed by a distributed sort on the key so output order is
+deterministic. Only `map_groups` still gathers rows in the driver (its
+output shape is user-defined and typically small).
+"""
 
 from __future__ import annotations
 
@@ -14,35 +21,19 @@ class GroupedData:
         self._dataset = dataset
         self._key = key
 
-    def _groups(self) -> Dict[Any, List[Any]]:
-        groups: Dict[Any, List[Any]] = {}
-        for row in self._dataset.take_all():
-            groups.setdefault(row[self._key], []).append(row)
-        return groups
-
-    def _agg(self, fn: Callable, on: str, name: str):
-        from .dataset import Dataset, _rows_to_block
+    def _agg(self, fn: Callable, on, name: str):
+        from .exchange import groupby_exchange
         key = self._key
-        groups = self._groups()
-        rows = [{key: k, name: fn([r[on] for r in rs])}
-                for k, rs in sorted(groups.items(), key=lambda kv: str(kv[0]))]
 
-        def source():
-            import ray_tpu
-            return [ray_tpu.put(_rows_to_block(rows))]
-        return Dataset(source, [], name=f"groupby({key}).{name}")
+        def plan_fn(refs: List) -> List:
+            return groupby_exchange(refs, key, fn, name, on)
+
+        ds = self._dataset._with_stage(("allToAll", plan_fn, "groupby"),
+                                       f"groupby({key}).{name}")
+        return ds.sort(key)
 
     def count(self):
-        from .dataset import Dataset, _rows_to_block
-        key = self._key
-        rows = [{key: k, "count()": len(rs)}
-                for k, rs in sorted(self._groups().items(),
-                                    key=lambda kv: str(kv[0]))]
-
-        def source():
-            import ray_tpu
-            return [ray_tpu.put(_rows_to_block(rows))]
-        return Dataset(source, [], name=f"groupby({key}).count")
+        return self._agg(len, None, "count()")
 
     def sum(self, on: str):
         return self._agg(lambda v: float(np.sum(v)), on, f"sum({on})")
@@ -62,7 +53,9 @@ class GroupedData:
 
     def map_groups(self, fn: Callable):
         from .dataset import Dataset, _rows_to_block
-        groups = self._groups()
+        groups: Dict[Any, List[Any]] = {}
+        for row in self._dataset.take_all():
+            groups.setdefault(row[self._key], []).append(row)
         out_rows: List[Any] = []
         for _, rows in sorted(groups.items(), key=lambda kv: str(kv[0])):
             result = fn(rows)

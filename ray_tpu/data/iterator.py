@@ -1,5 +1,7 @@
 """DataIterator (reference: python/ray/data/iterator.py +
-stream_split_iterator): the per-consumer view a Train worker iterates."""
+_internal/iterator/stream_split_iterator.py:36): the per-consumer view a
+Train worker iterates. StreamSplitIterator pulls block refs from the
+SplitCoordinator actor as upstream stages produce them."""
 
 from __future__ import annotations
 
@@ -26,3 +28,44 @@ class DataIterator:
 
     def count(self) -> int:
         return self._dataset.count()
+
+
+class StreamSplitIterator(DataIterator):
+    """One consumer's slice of a streaming execution. Blocks arrive from
+    the coordinator actor while upstream operators are still running."""
+
+    def __init__(self, coordinator, split_index: int):
+        self._coordinator = coordinator
+        self._split_index = split_index
+
+    def _iter_blocks(self):
+        import ray_tpu
+        while True:
+            ref = ray_tpu.get(
+                self._coordinator.get_next.remote(self._split_index))
+            if ref is None:
+                error = ray_tpu.get(self._coordinator.get_error.remote())
+                if error:
+                    raise RuntimeError(f"streaming split failed: {error}")
+                return
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator[Any]:
+        from .dataset import _batches_from_blocks
+        return _batches_from_blocks(self._iter_blocks(), batch_size,
+                                    batch_format, drop_last)
+
+    def iter_rows(self) -> Iterator[Any]:
+        from .block import BlockAccessor
+        for block in self._iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def materialize(self):
+        raise NotImplementedError(
+            "a streaming split is a one-shot consumer stream")
+
+    def count(self) -> int:
+        return sum(1 for _ in self.iter_rows())

@@ -1,0 +1,330 @@
+"""Streaming execution of Dataset plans.
+
+Role of the reference's StreamingExecutor
+(python/ray/data/_internal/execution/streaming_executor.py:61 — runs as a
+thread; _scheduling_loop_step :421) and its operator state machine
+(execution/interfaces/physical_operator.py:214):
+
+- the plan becomes a linear topology of operators (map ops with fused
+  transform chains; all-to-all exchanges as barriers);
+- each map operator keeps a bounded number of tasks in flight and a bounded
+  output buffer — when the downstream (ultimately the consumer iterator)
+  falls behind, upstream submission stalls: end-to-end backpressure;
+- blocks stream to the consumer as they finish, so training can iterate
+  batches while upstream stages are still producing;
+- map operators run either as a task pool or as an actor pool
+  (`compute="actors"` — reference: actor-pool map operator).
+
+The executor is a daemon thread in the consuming process; block payloads
+live in the shared-memory object store, only refs flow through the queues.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .context import DataContext
+
+_SENTINEL = object()
+
+
+class _MapWorker:
+    """Actor-pool map worker (reference: ActorPoolMapOperator's workers)."""
+
+    def __init__(self, fns):
+        self._fns = fns
+
+    def apply(self, block):
+        for fn in self._fns:
+            block = fn(block)
+        return block
+
+    def ping(self):
+        return "pong"
+
+
+class Op:
+    """Base physical operator: pull refs from `input`, push to `out`."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.input: deque = deque()
+        self.out: deque = deque()
+        self.input_done = False
+        self.output_done = False
+
+    def start(self):
+        pass
+
+    def shutdown(self):
+        pass
+
+    def num_in_flight(self) -> int:
+        return 0
+
+    def schedule(self, output_room: int) -> bool:
+        """Advance; return True if any progress was made."""
+        raise NotImplementedError
+
+
+class MapOp(Op):
+    """Fused map chain over blocks; task pool or actor pool."""
+
+    def __init__(self, name: str, fns: List[Callable],
+                 compute: Optional[str] = None,
+                 concurrency: Optional[int] = None):
+        super().__init__(name)
+        self.fns = fns
+        self.compute = compute
+        ctx = DataContext.get_current()
+        self.window = concurrency or ctx.max_tasks_in_flight
+        self.in_flight: List = []
+        self._remote_fn = None
+        self._actors: List = []
+        self._actor_rr = 0
+
+    def start(self):
+        import ray_tpu
+        if self.compute == "actors":
+            actor_cls = ray_tpu.remote(_MapWorker)
+            self._actors = [actor_cls.remote(self.fns)
+                            for _ in range(max(1, self.window))]
+        else:
+            fns = self.fns
+
+            @ray_tpu.remote(num_cpus=1, max_retries=2)
+            def _apply(block, _fns=fns):
+                for fn in _fns:
+                    block = fn(block)
+                return block
+
+            self._remote_fn = _apply
+
+    def shutdown(self):
+        import ray_tpu
+        for actor in self._actors:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        self._actors = []
+
+    def num_in_flight(self) -> int:
+        return len(self.in_flight)
+
+    def schedule(self, output_room: int) -> bool:
+        import ray_tpu
+        progress = False
+        # Launch: bounded by the task window AND downstream room (the
+        # backpressure signal — never produce more than the consumer and
+        # output buffer can hold).
+        while (self.input and len(self.in_flight) < self.window
+               and len(self.in_flight) + len(self.out) < output_room):
+            ref = self.input.popleft()
+            if self._actors:
+                actor = self._actors[self._actor_rr % len(self._actors)]
+                self._actor_rr += 1
+                self.in_flight.append(actor.apply.remote(ref))
+            else:
+                self.in_flight.append(self._remote_fn.remote(ref))
+            progress = True
+        # Harvest finished tasks in order (stream, don't barrier).
+        if self.in_flight:
+            ready, _ = ray_tpu.wait(self.in_flight,
+                                    num_returns=len(self.in_flight),
+                                    timeout=0, fetch_local=False)
+            ready_set = set(r.id() for r in ready)
+            still = []
+            for ref in self.in_flight:
+                if ref.id() in ready_set:
+                    self.out.append(ref)
+                    progress = True
+                else:
+                    still.append(ref)
+            self.in_flight = still
+        if self.input_done and not self.input and not self.in_flight:
+            if not self.output_done:
+                self.output_done = True
+                progress = True
+        return progress
+
+
+class AllToAllOp(Op):
+    """Barrier operator: consume the whole input, then run `plan_fn`
+    (which submits the distributed exchange tasks) once."""
+
+    def __init__(self, name: str, plan_fn: Callable[[List], List]):
+        super().__init__(name)
+        self.plan_fn = plan_fn
+        self._ran = False
+        self._collected: List = []
+
+    def schedule(self, output_room: int) -> bool:
+        progress = False
+        while self.input:
+            self._collected.append(self.input.popleft())
+            progress = True
+        if self.input_done and not self._ran:
+            self._ran = True
+            for ref in self.plan_fn(self._collected):
+                self.out.append(ref)
+            self._collected = []
+            self.output_done = True
+            progress = True
+        return progress
+
+
+class StreamingExecutor:
+    """Drives a topology of ops in a daemon thread; the consumer iterates
+    `out_queue` (bounded — consumer lag backpressures the whole stream)."""
+
+    def __init__(self, source_fn: Callable[[], List], ops: List[Op],
+                 name: str = "dataset"):
+        self.source_fn = source_fn
+        self.ops = ops
+        self.name = name
+        ctx = DataContext.get_current()
+        self.out_queue: "queue.Queue" = queue.Queue(
+            maxsize=max(2, ctx.streaming_output_buffer_blocks))
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- consumer interface ---------------------------------------------
+
+    def run_async(self) -> "StreamingExecutor":
+        self._thread = threading.Thread(
+            target=self._run, name=f"rtpu-data-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def iter_output(self):
+        """Yield block refs as they are produced."""
+        if self._thread is None:
+            self.run_async()
+        while True:
+            item = self.out_queue.get()
+            if item is _SENTINEL:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item
+
+    def stop(self):
+        """Abandon the stream (early consumer exit, e.g. take(n))."""
+        self._stop.set()
+        # Drain so a blocked producer wakes up and sees the stop flag.
+        try:
+            while True:
+                self.out_queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    # -- executor thread -------------------------------------------------
+
+    def _run(self):
+        ctx = DataContext.get_current()
+        per_op_buffer = max(2, ctx.op_output_buffer_blocks)
+        try:
+            for op in self.ops:
+                op.start()
+            first = self.ops[0] if self.ops else None
+            source_refs = list(self.source_fn())
+            if first is not None:
+                first.input.extend(source_refs)
+                first.input_done = True
+            else:
+                for ref in source_refs:
+                    if not self._emit(ref):
+                        return
+                return
+            idle_backoff = 0.001
+            while not self._stop.is_set():
+                progress = False
+                for i, op in enumerate(self.ops):
+                    if i + 1 < len(self.ops):
+                        room = per_op_buffer
+                    else:
+                        # Last op: its room is the consumer queue's slack.
+                        room = max(
+                            1, self.out_queue.maxsize - self.out_queue.qsize()
+                            + op.num_in_flight())
+                    if op.schedule(room):
+                        progress = True
+                    # Move outputs downstream / to the consumer.
+                    if i + 1 < len(self.ops):
+                        nxt = self.ops[i + 1]
+                        while op.out and len(nxt.input) < per_op_buffer:
+                            nxt.input.append(op.out.popleft())
+                            progress = True
+                        if op.output_done and not op.out:
+                            if not nxt.input_done:
+                                nxt.input_done = True
+                                progress = True
+                    else:
+                        while op.out:
+                            if not self._emit(op.out.popleft()):
+                                return
+                            progress = True
+                        if op.output_done and not op.out:
+                            return
+                if not progress:
+                    self._stop.wait(idle_backoff)
+                    idle_backoff = min(idle_backoff * 2, 0.05)
+                else:
+                    idle_backoff = 0.001
+        except BaseException as e:  # noqa: BLE001 — surfaced to consumer
+            self._error = e
+        finally:
+            for op in self.ops:
+                try:
+                    op.shutdown()
+                except Exception:
+                    pass
+            self._finish()
+
+    def _emit(self, ref) -> bool:
+        while not self._stop.is_set():
+            try:
+                self.out_queue.put(ref, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _finish(self):
+        while not self._stop.is_set():
+            try:
+                self.out_queue.put(_SENTINEL, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+
+def build_ops(stages: List, default_window: int) -> List[Op]:
+    """Lower ("map", fn[, opts]) / ("allToAll", plan_fn) stages into ops,
+    fusing adjacent map stages with identical compute settings."""
+    ops: List[Op] = []
+    i = 0
+    while i < len(stages):
+        kind = stages[i][0]
+        if kind == "map":
+            fns = []
+            opts: Dict[str, Any] = stages[i][2] if len(stages[i]) > 2 else {}
+            key = (opts.get("compute"), opts.get("concurrency"))
+            while i < len(stages) and stages[i][0] == "map":
+                nxt_opts = stages[i][2] if len(stages[i]) > 2 else {}
+                if (nxt_opts.get("compute"),
+                        nxt_opts.get("concurrency")) != key:
+                    break
+                fns.append(stages[i][1])
+                i += 1
+            ops.append(MapOp("map", fns, compute=key[0], concurrency=key[1]))
+        else:
+            ops.append(AllToAllOp(stages[i][2] if len(stages[i]) > 2
+                                  else "exchange", stages[i][1]))
+            i += 1
+    return ops
