@@ -53,6 +53,10 @@ _DEFAULTS: Dict[str, Any] = {
     # --- gcs ---
     "gcs_storage": "memory",  # or a file path for persistence
     "pubsub_push_timeout_s": 5.0,
+    # --- actors ---
+    # Bound on actor __init__: a wedged-but-alive worker must fail the
+    # creation (and reschedule) rather than park it forever.
+    "actor_creation_timeout_s": 600.0,
     # --- tasks ---
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,
@@ -63,6 +67,8 @@ _DEFAULTS: Dict[str, Any] = {
     "memory_usage_threshold": 0.95,
     # --- metrics ---
     "metrics_report_interval_s": 5.0,
+    # --- task events (reference: RAY_task_events_* flags) ---
+    "enable_task_events": True,
     # --- logging ---
     "log_to_driver": True,
     # --- train ---

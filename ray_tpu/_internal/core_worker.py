@@ -22,6 +22,7 @@ into every driver and worker process. Owns:
 from __future__ import annotations
 
 import asyncio
+import collections
 import concurrent.futures
 import logging
 import os
@@ -93,6 +94,10 @@ class ReferenceCounter:
         self._cw = core_worker
         self._lock = threading.Lock()
         self._entries: Dict[ObjectID, RefEntry] = {}
+        # (deadline, oid) FIFO — appended with monotonically increasing
+        # deadlines (constant ttl), so the head is always the earliest.
+        self._transit_pins: collections.deque = collections.deque()
+        self._sweeper_started = False
 
     def _entry(self, object_id: ObjectID) -> RefEntry:
         entry = self._entries.get(object_id)
@@ -181,9 +186,95 @@ class ReferenceCounter:
             entry = self._entries.get(object_id)
             return entry is not None and entry.is_owner
 
+    def pin_for_transit(self, refs, ttl: float = 60.0):
+        """Pin owned refs being serialized into an outbound reply.
+
+        Without this, an owner can free an object in the gap between
+        sending a reply containing its ref and the receiver's async
+        borrow_addref arriving (reference: the borrower protocol in
+        reference_count.cc closes this with ownership 'borrowed refs'
+        bookkeeping piggybacked on task replies; a TTL pin is the simple
+        equivalent — the real borrower's addref takes over within the
+        window or the object was never fetched). Expiry is handled by ONE
+        sweeper thread over a deadline queue, not a thread per pin."""
+        pinned = False
+        for ref in refs:
+            oid = ref.id()
+            if not self.is_owner(oid):
+                continue
+            self.add_borrower(oid)
+            self._transit_pins.append((time.monotonic() + ttl, oid))
+            pinned = True
+        if pinned and not self._sweeper_started:
+            with self._lock:
+                if not self._sweeper_started:
+                    self._sweeper_started = True
+                    t = threading.Thread(target=self._sweep_transit_pins,
+                                         daemon=True,
+                                         name="rtpu-transit-sweeper")
+                    t.start()
+
+    def _sweep_transit_pins(self):
+        while True:
+            time.sleep(1.0)
+            now = time.monotonic()
+            while self._transit_pins and self._transit_pins[0][0] <= now:
+                _deadline, oid = self._transit_pins.popleft()
+                self.remove_borrower(oid)
+
     def num_refs(self) -> int:
         with self._lock:
             return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# Task event buffer (reference: src/ray/core_worker/task_event_buffer.cc —
+# batches task state transitions and flushes them to the GCS task manager,
+# feeding the state API / timeline)
+# ---------------------------------------------------------------------------
+
+class TaskEventBuffer:
+    def __init__(self, core_worker: "CoreWorker"):
+        self._cw = core_worker
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+        self._flusher_started = False
+
+    def record(self, spec: "TaskSpec", event: str, **extra):
+        if not CONFIG.enable_task_events or not spec.enable_task_events:
+            return
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "attempt": spec.attempt_number,
+            "name": spec.name or spec.function.display_name(),
+            "job_id": spec.job_id.hex(),
+            "type": spec.task_type,
+            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
+            "event": event,
+            "ts": time.time(),
+            "worker_id": self._cw.worker_id.hex()
+            if isinstance(self._cw.worker_id, bytes) else None,
+            "node_index": self._cw.node_index,
+        }
+        ev.update(extra)
+        with self._lock:
+            self._events.append(ev)
+            if len(self._events) > 10_000:  # drop oldest under pressure
+                del self._events[:5_000]
+            if not self._flusher_started:
+                self._flusher_started = True
+                self._cw.loop_call(self._flush_loop())
+
+    async def _flush_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            with self._lock:
+                batch, self._events = self._events, []
+            if batch:
+                try:
+                    await self._cw.gcs.call("add_task_events", events=batch)
+                except Exception:  # noqa: BLE001 — observability best-effort
+                    pass
 
 
 # ---------------------------------------------------------------------------
@@ -217,6 +308,7 @@ class TaskManager:
                 dep_ids=[oid for oid, _ in spec.dependencies()],
                 contained_ids=[c for a in spec.args
                                for c in a.contained_ref_ids])
+        self._cw.task_events.record(spec, "SUBMITTED")
 
     def is_pending(self, task_id: TaskID) -> bool:
         with self._lock:
@@ -257,6 +349,7 @@ class TaskManager:
     def on_completed(self, spec: TaskSpec, reply: Dict[str, Any]):
         if self._take_cancelled(spec.task_id):
             return  # late reply for a cancelled task: returns already failed
+        self._cw.task_events.record(spec, "FINISHED")
         with self._lock:
             pending = self.pending.pop(spec.task_id, None)
             # Retain lineage so lost plasma returns can be reconstructed.
@@ -330,6 +423,8 @@ class TaskManager:
             error = TaskError(spec.function.display_name(),
                               "".join(traceback.format_exception(error)),
                               cause=error)
+        self._cw.task_events.record(spec, "FAILED",
+                                    error=str(error)[:500])
         for oid in spec.return_ids():
             self._cw.memory_store.put(oid, error, is_exception=True)
         self._release_deps(pending)
@@ -366,7 +461,15 @@ class NormalTaskSubmitter:
         self._cw = core_worker
         self._idle: Dict[Tuple, List[Lease]] = {}
         self._running: Dict[TaskID, Lease] = {}  # pushed, awaiting reply
+        self._waiters: Dict[Tuple, collections.deque] = {}
+        self._inflight_requests: Dict[Tuple, int] = {}
+        self._request_tasks: set = set()
         self._cleaner_started = False
+
+    async def cancel_pending_requests(self):
+        """Cancel lease requests still queued at raylets (shutdown path)."""
+        for task in list(self._request_tasks):
+            task.cancel()
 
     def submit(self, spec: TaskSpec):
         self._cw.loop_call(self._submit(spec))
@@ -385,6 +488,8 @@ class NormalTaskSubmitter:
         except Exception as e:
             self._cw.task_manager.on_failed(spec, e, is_application_error=False)
             return
+        if lease is None:
+            return  # cancelled while queued; returns already resolved
         if self._cw.task_manager._take_cancelled(spec.task_id):
             self._return_lease(spec.shape_key(), lease)
             return
@@ -438,15 +543,87 @@ class NormalTaskSubmitter:
                         spec.args[i] = TaskArg(is_ref=False,
                                                data=sobj.to_bytes())
 
-    async def _acquire_lease(self, spec: TaskSpec) -> Lease:
+    async def _acquire_lease(self, spec: TaskSpec) -> Optional[Lease]:
+        """Lease pipelining (reference: normal_task_submitter.cc — one
+        pool of leased workers per task shape, pending tasks queue on it).
+
+        A burst of N submissions must NOT translate into N independent
+        raylet round-trips each waiting for its own grant: finished tasks
+        hand their lease directly to the next waiter, and extra raylet
+        requests are issued only while waiters outnumber grants in
+        flight. Without the handoff, returned leases sit idle (resources
+        still charged at the raylet) while queued requests starve."""
         key = spec.shape_key()
         idle = self._idle.get(key)
-        while idle:
-            lease = idle.pop()
-            return lease
+        if idle:
+            return idle.pop()
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(key, collections.deque()).append(
+            (spec.task_id, fut))
+        self._maybe_request_lease(key, spec)
+        return await fut
+
+    def _maybe_request_lease(self, key: Tuple, spec: TaskSpec):
+        # Bounded pipelining (reference: maximum_pending_lease_requests):
+        # beyond the cap, demand is served by lease handoff from finishing
+        # tasks; unbounded requests would make the raylet's queue pump
+        # quadratic in burst size.
+        waiting = len(self._waiters.get(key, ()))
+        inflight = self._inflight_requests.get(key, 0)
+        if inflight < min(waiting, CONFIG.max_pending_lease_requests_per_shape):
+            self._inflight_requests[key] = inflight + 1
+            task = asyncio.ensure_future(self._request_lease(key, spec))
+            self._request_tasks.add(task)
+            task.add_done_callback(self._request_tasks.discard)
+
+    async def _request_lease(self, key: Tuple, spec: TaskSpec):
+        try:
+            lease = await self._request_new_lease(spec)
+        except Exception as e:  # noqa: BLE001 — delivered to one waiter
+            self._inflight_requests[key] -= 1
+            waiters = self._waiters.get(key)
+            while waiters:
+                _tid, fut = waiters.popleft()
+                if not fut.done():
+                    fut.set_exception(e)
+                    break
+            self._maybe_request_lease(key, spec)
+            return
+        self._inflight_requests[key] -= 1
+        if lease is None:
+            # Request dropped at the raylet (cancel_lease_by_task on the
+            # tagging task). Reap that task's own waiter so the pool
+            # doesn't count it as live demand — otherwise we'd re-issue a
+            # replacement request (cold-starting a worker) for a task
+            # that will never run.
+            waiters = self._waiters.get(key)
+            if waiters:
+                for entry in list(waiters):
+                    tid, fut = entry
+                    if tid == spec.task_id:
+                        waiters.remove(entry)
+                        if not fut.done():
+                            fut.set_result(None)
+                        break
+            self._maybe_request_lease(key, spec)
+            return
+        self._deliver_lease(key, lease)
+        self._maybe_request_lease(key, spec)
+
+    def _deliver_lease(self, key: Tuple, lease: Lease):
+        waiters = self._waiters.get(key)
+        while waiters:
+            _tid, fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(lease)
+                return
+        lease.last_used = time.monotonic()
+        self._idle.setdefault(key, []).append(lease)
+
+    async def _request_new_lease(self, spec: TaskSpec) -> Optional[Lease]:
         meta = {
             "resources": spec.resources,
-            "shape_key": key,
+            "shape_key": spec.shape_key(),
             "runtime_env": spec.runtime_env,
             "label_selector": spec.label_selector or None,
             "task_hex": spec.task_id.hex(),  # lease cancellation key
@@ -465,8 +642,7 @@ class NormalTaskSubmitter:
                                       timeout=None,
                                       retries=CONFIG.rpc_max_retries)
             if reply.get("canceled"):
-                raise RayTpuError(f"lease for task {spec.task_id.hex()[:12]} "
-                                  "canceled")  # consumed by on_failed
+                return None  # dropped at the raylet; caller re-issues
             if reply.get("spillback_to"):
                 raylet_addr = tuple(reply["spillback_to"][1])
                 continue
@@ -485,8 +661,7 @@ class NormalTaskSubmitter:
         raise RayTpuError("could not acquire a worker lease (too many hops)")
 
     def _return_lease(self, key: Tuple, lease: Lease):
-        lease.last_used = time.monotonic()
-        self._idle.setdefault(key, []).append(lease)
+        self._deliver_lease(key, lease)
 
     def _drop_lease(self, lease: Lease):
         self._cw.fire_and_forget(lease.raylet_address, "return_worker",
@@ -707,6 +882,9 @@ class TaskExecutor:
         self._seq_buffer: Dict[bytes,
                                Dict[int, Tuple[TaskSpec, asyncio.Future]]] = {}
         self._reply_cache: Dict[bytes, Dict[int, Dict[str, Any]]] = {}
+        # Replies still being computed, keyed like the reply cache: a
+        # duplicate push for a running task awaits the original's future.
+        self._inflight: Dict[bytes, Dict[int, asyncio.Future]] = {}
         # Cancellation: tasks marked before they start never run; running
         # async actor tasks are asyncio-cancelled (sync tasks cannot be
         # interrupted mid-flight without force-killing the worker).
@@ -737,21 +915,41 @@ class TaskExecutor:
         seq = spec.sequence_number
         if seq < self._next_seq.get(caller, 0):
             # Duplicate push (caller lost our reply): serve the cached reply
-            # instead of re-executing (at-most-once execution per seq).
+            # instead of re-executing (at-most-once execution per seq). A
+            # still-running original has no cached reply yet — piggyback on
+            # its future (shielded: this RPC's cancellation must not cancel
+            # the real execution).
             cached = self._reply_cache.get(caller, {}).get(seq)
             if cached is not None:
                 return cached
+            inflight = self._inflight.get(caller, {}).get(seq)
+            if inflight is not None:
+                return await asyncio.shield(inflight)
             return {"error": TaskError(
                 spec.method_name, "duplicate actor task with evicted reply")}
+        buffered = self._seq_buffer.get(caller, {}).get(seq)
+        if buffered is not None:
+            # Re-push of a still-buffered seq (caller reconnected before
+            # the original dispatched): piggyback on the original future —
+            # replacing it would orphan the first handler forever.
+            return await asyncio.shield(buffered[1])
         fut = loop.create_future()
         self._seq_buffer.setdefault(caller, {})[seq] = (spec, fut)
+        self._inflight.setdefault(caller, {})[seq] = fut
+
+        def _finish(f, caller=caller, seq=seq):
+            # Cache the reply the moment it exists — even if the push RPC
+            # that started this task was dropped, a retried push must find it.
+            self._inflight.get(caller, {}).pop(seq, None)
+            if f.cancelled() or f.exception() is not None:
+                return
+            cache = self._reply_cache.setdefault(caller, {})
+            cache[seq] = f.result()
+            while len(cache) > 64:
+                cache.pop(next(iter(cache)))
+        fut.add_done_callback(_finish)
         await self._drain_ready(caller)
-        reply = await fut
-        cache = self._reply_cache.setdefault(caller, {})
-        cache[seq] = reply
-        while len(cache) > 64:
-            cache.pop(next(iter(cache)))
-        return reply
+        return await asyncio.shield(fut)
 
     async def _drain_ready(self, caller: bytes):
         buffer = self._seq_buffer.get(caller, {})
@@ -823,6 +1021,7 @@ class TaskExecutor:
         returns = []
         for index, value in enumerate(values):
             sobj = serialization.serialize(value)
+            self._cw.reference_counter.pin_for_transit(sobj.contained_refs)
             oid = ObjectID.for_task_return(spec.task_id, index)
             if sobj.total_bytes() > CONFIG.max_direct_call_object_size:
                 self._cw.put_serialized_to_plasma(oid, sobj,
@@ -842,6 +1041,7 @@ class TaskExecutor:
         for value in result:
             index += 1
             sobj = serialization.serialize(value)
+            self._cw.reference_counter.pin_for_transit(sobj.contained_refs)
             oid = ObjectID.for_task_return(spec.task_id, index)
             if sobj.total_bytes() > CONFIG.max_direct_call_object_size:
                 self._cw.put_serialized_to_plasma(oid, sobj,
@@ -860,6 +1060,7 @@ class TaskExecutor:
         RUNTIME_CTX.task_spec = spec
         RUNTIME_CTX.actor_id = spec.actor_id
         self._running_sync.add(spec.task_id)
+        self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
         try:
             if spec.task_type == ACTOR_TASK \
                     and spec.method_name == "__rtpu_terminate__":
@@ -910,6 +1111,7 @@ class TaskExecutor:
             loop = asyncio.get_running_loop()
             args, kwargs = await loop.run_in_executor(
                 None, self._load_args, spec)
+            self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
             method = getattr(self._actor_instance, spec.method_name)
             import inspect
             if inspect.iscoroutinefunction(method):
@@ -975,6 +1177,7 @@ class CoreWorker:
         self.memory_store = MemoryStore()
         self.plasma = PlasmaDir(session_name, node_index)
         self.reference_counter = ReferenceCounter(self)
+        self.task_events = TaskEventBuffer(self)
         self.task_manager = TaskManager(self)
         self.submitter = NormalTaskSubmitter(self)
         self.actor_submitter = ActorTaskSubmitter(self)
@@ -995,6 +1198,11 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown = True
+        try:
+            EventLoopThread.get().run_sync(
+                self.submitter.cancel_pending_requests(), timeout=5)
+        except Exception:
+            pass
         try:
             EventLoopThread.get().run_sync(self.server.stop(), timeout=5)
         except Exception:
@@ -1165,10 +1373,11 @@ class CoreWorker:
             poll = min(poll * 2, 0.05)
 
     def _pull_via_raylet(self, oid: ObjectID) -> bool:
+        # Bounded: a pull for an object that exists nowhere must fail back
+        # into the caller's retry/timeout loop, not park forever.
         raylet = self.clients.get(self.raylet_address)
         try:
             reply = raylet.call_sync("pull_object", object_hex=oid.hex(),
-                                     timeout=None,
                                      retries=CONFIG.rpc_max_retries)
         except Exception:
             return False
@@ -1308,6 +1517,7 @@ class CoreWorker:
         if entry.is_exception:
             return {"data": None, "error": True}
         sobj = serialization.serialize(entry.value)
+        self.reference_counter.pin_for_transit(sobj.contained_refs)
         return {"data": sobj.to_bytes()}
 
     async def handle_borrow_addref(self, object_hex: str):

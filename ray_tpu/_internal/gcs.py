@@ -120,6 +120,8 @@ class GcsServer:
         # object directory: obj hex -> (owner addr, set of node ids, size)
         self.object_dir: Dict[str, Tuple[Optional[Address], Set[str], int]] = {}
         self.spilled: Dict[str, str] = {}   # obj hex -> spilled path
+        # per-node unmet lease demand, from heartbeats (autoscaler input)
+        self._pending_demand: Dict[str, List[Dict[str, float]]] = {}
         # pubsub: channel -> {subscriber addr}
         self.subscribers: Dict[str, Set[Address]] = {}
         self.task_events: List[Dict[str, Any]] = []
@@ -274,7 +276,8 @@ class GcsServer:
 
     async def handle_heartbeat(self, node_id: str,
                                resources_available: Dict[str, float],
-                               resources_total: Dict[str, float]):
+                               resources_total: Dict[str, float],
+                               pending_demand: Optional[List[Dict]] = None):
         rec = self.nodes.get(node_id)
         if rec is None or rec.state == "DEAD":
             return {"dead": True}
@@ -290,8 +293,27 @@ class GcsServer:
         total = ResourceSet(resources_total)
         view.resources.total = total
         view.resources.available = ResourceSet(resources_available)
+        # Unmet lease demand feeds the autoscaler (reference:
+        # gcs_autoscaler_state_manager.cc resource_load).
+        self._pending_demand[node_id] = pending_demand or []
         # Reply with the full cluster view for spillback decisions.
         return {"dead": False, "view": self.cluster_view_snapshot()}
+
+    async def handle_get_cluster_demand(self):
+        """Aggregate unmet demand for the autoscaler: queued lease shapes
+        per node + pending placement-group bundles
+        (reference: autoscaler v2 reads GcsAutoscalerStateManager state)."""
+        demands = []
+        for nid, shapes in self._pending_demand.items():
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state == "DEAD":
+                continue
+            demands.extend(shapes)
+        pending_bundles = []
+        for pg in self.pgs.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                pending_bundles.extend(pg.bundles)
+        return {"task_demand": demands, "pg_demand": pending_bundles}
 
     def cluster_view_snapshot(self) -> Dict[str, Dict[str, Any]]:
         out = {}
@@ -447,6 +469,17 @@ class GcsServer:
             entry[1].discard(node_id)
         return True
 
+    async def handle_get_all_object_locations(self, limit: int = 10_000):
+        """State-API listing of location-tracked (plasma) objects."""
+        out = []
+        for object_hex, (owner, nodes, size) in self.object_dir.items():
+            out.append({"object_id": object_hex, "owner": owner,
+                        "nodes": sorted(nodes), "size": size,
+                        "spilled": self.spilled.get(object_hex)})
+            if len(out) >= limit:
+                break
+        return out
+
     async def handle_get_object_locations(self, object_hex: str):
         entry = self.object_dir.get(object_hex)
         if entry is None:
@@ -576,13 +609,22 @@ class GcsServer:
                     "return_worker", lease_id=lease_id, dispose=True,
                     timeout=10))
                 return
-            # Push the creation task directly to the leased worker.
+            # Push the creation task directly to the leased worker. Bounded:
+            # a worker wedged inside a pathological __init__ (alive, never
+            # replying) must fail the creation and reschedule, not hang
+            # actor scheduling forever.
             try:
                 worker = self.clients.get(worker_addr)
                 result = await worker.call(
                     "push_task", spec=spec, lease_id=lease_id,
-                    timeout=None)
+                    timeout=CONFIG.actor_creation_timeout_s)
             except Exception as e:
+                # Dispose the (possibly wedged) worker and free its lease —
+                # a gang-reserved slice must not stay held by a failed
+                # creation attempt or the restart can never place.
+                asyncio.ensure_future(raylet.call(
+                    "return_worker", lease_id=lease_id, dispose=True,
+                    timeout=10))
                 if record.sched_epoch == epoch:
                     await self._handle_actor_failure(
                         record, f"creation task push failed: {e}")

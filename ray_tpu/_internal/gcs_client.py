@@ -12,7 +12,8 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from .config import CONFIG
-from .rpc import Address, EventLoopThread, RpcClient, RpcServer
+from .rpc import (DEFAULT_TIMEOUT, Address, EventLoopThread, RpcClient,
+                  RpcServer)
 
 logger = logging.getLogger(__name__)
 
@@ -34,7 +35,8 @@ class GcsClient:
         return await self.client.call(
             method, retries=CONFIG.rpc_max_retries, **kwargs)
 
-    def call_sync(self, method: str, timeout: Optional[float] = None,
+    def call_sync(self, method: str,
+                  timeout: Optional[float] = DEFAULT_TIMEOUT,
                   **kwargs) -> Any:
         return self.client.call_sync(
             method, timeout=timeout, retries=CONFIG.rpc_max_retries, **kwargs)

@@ -80,10 +80,51 @@ class PlasmaDir:
 
     def put_serialized(self, object_id: ObjectID,
                        obj: serialization.SerializedObject) -> int:
-        buf = self.create(object_id, obj.total_bytes())
-        obj.write_into(buf)
-        buf.release()
-        return self.seal(object_id)
+        """Write header + pickle + out-of-band buffers with one writev.
+
+        Faster than memcpy into a fresh mmap (which page-faults every 4K
+        on first touch): the kernel streams into the page cache at memory
+        bandwidth. Readers still mmap the sealed file for zero-copy views.
+        """
+        import struct as _struct
+        path = self._file(object_id) + ".tmp"
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+        try:
+            header = bytearray(9 + 8 * len(obj.buffers))
+            _struct.pack_into(">BII", header, 0, 1, len(obj.pickle_bytes),
+                              len(obj.buffers))
+            off = 9
+            for b in obj.buffers:
+                _struct.pack_into(">Q", header, off, b.nbytes)
+                off += 8
+            parts = [bytes(header), obj.pickle_bytes]
+            for b in obj.buffers:
+                parts.append(b.cast("B") if b.ndim == 1
+                             else memoryview(bytes(b)))
+            total = sum(len(p) if isinstance(p, bytes) else p.nbytes
+                        for p in parts)
+            written = 0
+            while parts:
+                # IOV_MAX (1024) bounds a single writev; large pytrees
+                # serialize to thousands of out-of-band buffers.
+                wrote = os.writev(fd, parts[:1024])
+                written += wrote
+                while parts and wrote >= (len(parts[0])
+                                          if isinstance(parts[0], bytes)
+                                          else parts[0].nbytes):
+                    first = parts.pop(0)
+                    wrote -= (len(first) if isinstance(first, bytes)
+                              else first.nbytes)
+                if wrote and parts:
+                    head = parts[0]
+                    head = memoryview(head) if isinstance(head, bytes) \
+                        else head
+                    parts[0] = head[wrote:]
+            assert written == total, (written, total)
+        finally:
+            os.close(fd)
+        os.rename(path, self._file(object_id))
+        return total
 
     def abort(self, object_id: ObjectID):
         with self._lock:

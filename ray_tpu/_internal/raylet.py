@@ -153,6 +153,8 @@ class Raylet:
                     "heartbeat", node_id=self.node_id,
                     resources_available=self.resources.available.to_dict(),
                     resources_total=self.resources.total.to_dict(),
+                    pending_demand=[req.demand.to_dict()
+                                    for req in self.queued[:100]],
                     timeout=CONFIG.health_check_timeout_s)
                 if reply.get("dead"):
                     logger.warning("raylet %s marked dead by gcs; exiting",
@@ -173,6 +175,11 @@ class Raylet:
             view[nid] = NodeView(nid, nr)
             self.node_addresses[nid] = tuple(info["address"])
         self.cluster_view = view
+        # New nodes / freed remote capacity can unblock queued requests via
+        # spillback — a request infeasible here would otherwise park forever
+        # (reference: cluster_lease_manager re-runs scheduling on every
+        # resource-view change, node_manager.cc ScheduleAndGrantLeases).
+        self._pump_queue()
 
     # ------------------------------------------------------------------
     # worker pool (reference: src/ray/raylet/worker_pool.cc)
@@ -205,6 +212,15 @@ class Raylet:
         # lease assigns chips (set later via runtime env / accelerator hook).
         env.setdefault("JAX_PLATFORMS", env.get("RTPU_WORKER_JAX_PLATFORMS",
                                                 "cpu"))
+        platforms = env["JAX_PLATFORMS"] or \
+            env.get("RTPU_WORKER_JAX_PLATFORMS", "")
+        if platforms and "tpu" not in platforms and "axon" not in platforms:
+            # CPU-only workers skip the TPU site hook (it imports jax at
+            # interpreter startup — seconds of cold-start per worker).
+            # Empty platforms means auto-detect (TPU train workers are
+            # launched with JAX_PLATFORMS="" exactly so they grab the
+            # chip) — those must keep the hook.
+            env["PALLAS_AXON_POOL_IPS"] = ""
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._internal.worker_main"],
             env=env, stdout=subprocess.DEVNULL if not CONFIG.log_to_driver
@@ -454,14 +470,25 @@ class Raylet:
         still_queued = []
         for req in self.queued:
             grant = self._try_grant(req)
-            if grant is None:
-                still_queued.append(req)
-            else:
+            if grant is not None:
                 async def _complete(req=req, grant=grant):
                     reply = await grant
                     if not req.future.done():
                         req.future.set_result(reply)
                 asyncio.ensure_future(_complete())
+                continue
+            spill = self._pick_spillback(req)
+            if spill is not None and not req.future.done():
+                # Debit the snapshot so one freed remote slot doesn't spill
+                # the whole queue there in a herd (each bounce burns one of
+                # the client's spillback hops).
+                target_view = self.cluster_view.get(spill[0])
+                if target_view is not None:
+                    target_view.resources.available = \
+                        target_view.resources.available - req.demand
+                req.future.set_result({"spillback_to": spill})
+                continue
+            still_queued.append(req)
         self.queued = still_queued
 
     async def handle_return_worker(self, lease_id: int,
@@ -714,4 +741,10 @@ class Raylet:
             "object_store_capacity": self.capacity,
             "num_objects": len(self.objects),
             "labels": self.labels,
+            "workers": [
+                {"worker_id": h.worker_id.hex(), "pid": h.pid,
+                 "state": h.state,
+                 "is_actor_worker": h.is_actor_worker}
+                for h in self.workers.values()
+            ],
         }

@@ -120,6 +120,10 @@ class _Chaos:
 
 CHAOS = _Chaos()
 
+# Sentinel distinguishing "use the configured default timeout" from
+# timeout=None, which means no deadline at all (unbounded pushes).
+DEFAULT_TIMEOUT = object()
+
 
 # --------------------------------------------------------------------------
 # Server
@@ -271,10 +275,15 @@ class RpcClient:
             if not fut.done():
                 fut.set_exception(err)
 
-    async def call(self, method: str, timeout: Optional[float] = None,
+    async def call(self, method: str, timeout: Optional[float] = DEFAULT_TIMEOUT,
                    retries: int = 0, **kwargs) -> Any:
-        """Call `method`. Retries only on transport errors (idempotent use)."""
-        timeout = timeout if timeout is not None else CONFIG.rpc_call_timeout_s
+        """Call `method`. Retries only on transport errors (idempotent use).
+
+        timeout=None disables the deadline entirely (used for pushes whose
+        execution time is unbounded, e.g. a long-running actor task); the
+        connection read-loop still fails the call if the peer dies."""
+        if timeout is DEFAULT_TIMEOUT:
+            timeout = CONFIG.rpc_call_timeout_s
         attempt = 0
         while True:
             try:
@@ -318,12 +327,14 @@ class RpcClient:
             raise body
         return body
 
-    def call_sync(self, method: str, timeout: Optional[float] = None,
+    def call_sync(self, method: str, timeout: Optional[float] = DEFAULT_TIMEOUT,
                   retries: int = 0, **kwargs) -> Any:
-        total = (timeout if timeout is not None else CONFIG.rpc_call_timeout_s)
+        if timeout is DEFAULT_TIMEOUT:
+            timeout = CONFIG.rpc_call_timeout_s
+        total = (timeout * (retries + 1) + 10) if timeout is not None else None
         return EventLoopThread.get().run_sync(
             self.call(method, timeout=timeout, retries=retries, **kwargs),
-            timeout=total * (retries + 1) + 10)
+            timeout=total)
 
     async def close(self):
         if self._reader_task is not None:

@@ -1,0 +1,162 @@
+"""Core runtime microbenchmarks
+(reference: python/ray/_private/ray_perf.py — the canonical microbenchmark
+set whose published numbers are in BASELINE.md / release/perf_metrics/
+microbenchmark.json).
+
+Run: python -m ray_tpu.perf [--quick]
+Prints one JSON line per metric: {"metric", "value", "unit", "baseline",
+"vs_baseline"} where baseline is the reference's published number on its
+own hardware (m4.16xlarge-class) — an envelope comparison, not
+like-for-like hardware."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+# Reference numbers: release/perf_metrics/microbenchmark.json (BASELINE.md).
+BASELINES = {
+    "tasks_sync_per_s": 901.0,
+    "tasks_async_per_s": 7_419.0,
+    "actor_calls_sync_per_s": 1_826.0,
+    "actor_calls_async_per_s": 7_926.0,
+    "actor_calls_async_nn_per_s": 24_809.0,
+    "put_small_per_s": 4_795.0,
+    "get_small_per_s": 9_177.0,
+    "put_gib_per_s": 20.35,
+    "pg_create_remove_per_s": 751.0,
+}
+
+
+def _rate(n: int, fn: Callable[[], None]) -> float:
+    start = time.perf_counter()
+    fn()
+    return n / (time.perf_counter() - start)
+
+
+def _report(metric: str, value: float, unit: str):
+    baseline = BASELINES.get(metric)
+    row = {"metric": metric, "value": round(value, 2), "unit": unit,
+           "baseline": baseline,
+           "vs_baseline": round(value / baseline, 3) if baseline else None}
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main(quick: bool = False) -> Dict[str, float]:
+    import ray_tpu
+
+    scale = 1 if quick else 4
+    ray_tpu.init(num_cpus=8, object_store_memory=2 * 1024**3)
+    results = {}
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return None
+
+        async def aping(self):
+            return None
+
+    # Warm up the worker pool + dispatch path (the reference benchmark
+    # also measures steady state, not worker cold-start).
+    ray_tpu.get([noop.remote() for _ in range(200)])
+
+    n = 200 * scale
+    results["tasks_sync_per_s"] = _rate(
+        n, lambda: [ray_tpu.get(noop.remote()) for _ in range(n)])
+    _report("tasks_sync_per_s", results["tasks_sync_per_s"], "tasks/s")
+
+    n = 1000 * scale
+    ray_tpu.get([noop.remote() for _ in range(n)])  # warm burst
+    results["tasks_async_per_s"] = _rate(
+        n, lambda: ray_tpu.get([noop.remote() for _ in range(n)]))
+    _report("tasks_async_per_s", results["tasks_async_per_s"], "tasks/s")
+
+    actor = Sink.remote()
+    ray_tpu.get(actor.ping.remote())
+    n = 500 * scale
+    results["actor_calls_sync_per_s"] = _rate(
+        n, lambda: [ray_tpu.get(actor.ping.remote()) for _ in range(n)])
+    _report("actor_calls_sync_per_s", results["actor_calls_sync_per_s"],
+            "calls/s")
+
+    n = 2000 * scale
+    results["actor_calls_async_per_s"] = _rate(
+        n, lambda: ray_tpu.get([actor.ping.remote() for _ in range(n)]))
+    _report("actor_calls_async_per_s", results["actor_calls_async_per_s"],
+            "calls/s")
+
+    # n:n — 4 async actors, 4 submitting threads.
+    import threading
+    actors = [Sink.options(max_concurrency=16).remote() for _ in range(4)]
+    ray_tpu.get([a.aping.remote() for a in actors for _ in range(50)])
+    n_per = 500 * scale
+
+    def _pound(a):
+        ray_tpu.get([a.aping.remote() for _ in range(n_per)])
+
+    def _nn():
+        threads = [threading.Thread(target=_pound, args=(a,))
+                   for a in actors]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    results["actor_calls_async_nn_per_s"] = _rate(4 * n_per, _nn)
+    _report("actor_calls_async_nn_per_s",
+            results["actor_calls_async_nn_per_s"], "calls/s")
+
+    small = np.zeros(8, np.int64)
+    n = 1000 * scale
+    results["put_small_per_s"] = _rate(
+        n, lambda: [ray_tpu.put(small) for _ in range(n)])
+    _report("put_small_per_s", results["put_small_per_s"], "puts/s")
+
+    ref = ray_tpu.put(small)
+    results["get_small_per_s"] = _rate(
+        n, lambda: [ray_tpu.get(ref) for _ in range(n)])
+    _report("get_small_per_s", results["get_small_per_s"], "gets/s")
+
+    # Put throughput: 40 x 25 MiB numpy arrays through plasma (the
+    # reference benchmark also puts numpy — pickle-5 out-of-band, the
+    # array body memcpys straight into the store mmap).
+    chunk = np.random.randint(0, 255, 25 * 1024**2, np.uint8)
+    reps = 10 if quick else 40
+    start = time.perf_counter()
+    refs = [ray_tpu.put(chunk) for _ in range(reps)]
+    dt = time.perf_counter() - start
+    del refs
+    results["put_gib_per_s"] = reps * 25 / 1024 / dt
+    _report("put_gib_per_s", results["put_gib_per_s"], "GiB/s")
+
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+    n = 50 * scale
+
+    def _pg_cycle():
+        for _ in range(n):
+            pg = placement_group([{"CPU": 1}])
+            pg.wait(timeout_seconds=30)
+            remove_placement_group(pg)
+    results["pg_create_remove_per_s"] = _rate(n, _pg_cycle)
+    _report("pg_create_remove_per_s", results["pg_create_remove_per_s"],
+            "pgs/s")
+
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    main(quick=args.quick)
