@@ -440,24 +440,59 @@ class _SplitCoordinator:
         import threading as _threading
         self._n = n
         self._error: Optional[str] = None
+        self._done = False
         self._queues = [_queue.Queue(maxsize=4) for _ in range(n)]
         self._executor = dataset._make_executor().run_async()
         self._thread = _threading.Thread(target=self._pump, daemon=True)
         self._thread.start()
 
+    # A consumer that stops pulling wedges the round-robin pump on its full
+    # queue (that's the intended backpressure for LAGGING consumers, but an
+    # ABANDONED one would deadlock every split). After this stall the whole
+    # stream fails loudly instead (reference semantics: all splits must be
+    # consumed together).
+    ABANDONED_CONSUMER_TIMEOUT_S = 120.0
+
     def _pump(self):
+        import queue as _queue
         try:
             for i, ref in enumerate(self._executor.iter_output()):
-                self._queues[i % self._n].put(ref)
+                q = self._queues[i % self._n]
+                waited = 0.0
+                while True:
+                    try:
+                        q.put(ref, timeout=1.0)
+                        break
+                    except _queue.Full:
+                        waited += 1.0
+                        if waited >= self.ABANDONED_CONSUMER_TIMEOUT_S:
+                            raise RuntimeError(
+                                f"streaming split consumer {i % self._n} "
+                                f"stopped consuming for {waited:.0f}s — "
+                                "all splits must be consumed concurrently")
         except BaseException as e:  # noqa: BLE001 — forwarded to consumers
             self._error = repr(e)
         finally:
-            for q in self._queues:
-                q.put(None)  # per-consumer end-of-stream
+            # End-of-stream is a flag, not a sentinel put: a put on a full
+            # queue of an abandoned/lagging consumer would block (or leak a
+            # thread) and could delay EOS to the other splits.
+            self._done = True
 
     def get_next(self, idx: int):
         """Next block ref for consumer idx, or None at end of stream."""
-        return self._queues[idx].get()
+        import queue as _queue
+        while True:
+            try:
+                return self._queues[idx].get(timeout=0.25)
+            except _queue.Empty:
+                if self._done:
+                    # The pump may have enqueued a final block between our
+                    # timeout and the flag check — drain before declaring
+                    # end of stream.
+                    try:
+                        return self._queues[idx].get_nowait()
+                    except _queue.Empty:
+                        return None
 
     def get_error(self) -> Optional[str]:
         return self._error

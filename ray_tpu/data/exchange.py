@@ -153,7 +153,7 @@ def groupby_exchange(refs: List, key: str, agg_fn: Callable,
         acc = BlockAccessor(block)
         buckets: List[List] = [[] for _ in range(n_out)]
         for row in acc.iter_rows():
-            buckets[hash(row[key]) % n_out].append(row)
+            buckets[_stable_hash(row[key]) % n_out].append(row)
         return tuple(BlockAccessor.from_rows(b) for b in buckets)
 
     @ray_tpu.remote(num_cpus=1, max_retries=2)
@@ -176,8 +176,39 @@ def groupby_exchange(refs: List, key: str, agg_fn: Callable,
             for j in range(n_out)]
 
 
+def _stable_hash(value) -> int:
+    """Deterministic across processes. Only str/bytes builtin hashes are
+    per-process randomized; numeric hashes are stable AND equal across
+    numerically-equal types (hash(2) == hash(2.0) == hash(np.int64(2))),
+    which partitioning must preserve — arrow blocks yield Python ints
+    where list blocks may hold numpy scalars for the same key."""
+    import zlib
+    if isinstance(value, str):
+        return zlib.crc32(value.encode())
+    if isinstance(value, bytes):
+        return zlib.crc32(value)
+    if isinstance(value, tuple):
+        h = 0
+        for item in value:
+            h = zlib.crc32(_stable_hash(item).to_bytes(4, "big"), h)
+        return h
+    import numbers
+    if isinstance(value, numbers.Number):
+        # Builtin numeric hashing is process-stable AND equates
+        # numerically-equal types; anything else hashable may transitively
+        # hash strings (frozensets, dataclasses) and inherit the
+        # per-process randomization.
+        return hash(value) & 0x7FFFFFFF
+    return zlib.crc32(repr(value).encode())
+
+
 def _sort_token(value):
-    try:
-        return (0, value)
-    except Exception:  # pragma: no cover
-        return (1, str(value))
+    """Total order over heterogeneous group keys: homogeneous primitives
+    sort natively within their type class; everything else by repr."""
+    if isinstance(value, bool):
+        return (0, "bool", value)
+    if isinstance(value, (int, float)):
+        return (0, "num", value)
+    if isinstance(value, str):
+        return (1, "str", value)
+    return (2, type(value).__name__, repr(value))

@@ -111,9 +111,16 @@ def test_split_and_streaming_split(data_cluster):
     ds = rd.range(60)
     splits = ds.split(3)
     assert sum(s.count() for s in splits) == 60
+    # Streaming splits feed independent consumers (train workers) and must
+    # be drained concurrently — reference semantics (stream_split_iterator
+    # coordinates all splits through one executor).
+    import concurrent.futures
     iters = rd.range(40).streaming_split(2)
-    counts = [sum(len(b["id"]) for b in it.iter_batches(batch_size=10))
-              for it in iters]
+    with concurrent.futures.ThreadPoolExecutor(2) as pool:
+        counts = list(pool.map(
+            lambda it: sum(len(b["id"])
+                           for b in it.iter_batches(batch_size=10)),
+            iters))
     assert sum(counts) == 40
 
 
