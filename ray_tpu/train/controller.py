@@ -109,6 +109,11 @@ class TrainController:
         import ray_tpu
         from .worker_group import WorkerGroup
         from ..actor import ActorHandle
+        # A crashed attempt can leave barriers half-counted (dead workers
+        # that incremented but never released); a fresh attempt must not
+        # inherit them or its first barrier would release early.
+        self._barriers = {}
+        self._broadcasts = {}
         self_handle = ray_tpu.get_actor(self.run_name + "-controller")
         group = WorkerGroup(scaling=self.scaling, run_name=self.run_name,
                             controller=self_handle)
@@ -119,7 +124,20 @@ class TrainController:
                 self.train_fn, self.train_fn_config,
                 resume_checkpoint=self.latest_checkpoint,
                 dataset_factories=self.dataset_factories)
-            worker_results = ray_tpu.get(futures)
+            # Drain results one at a time: the first failed rank must abort
+            # the whole attempt immediately — surviving ranks are likely
+            # blocked in collectives/barriers waiting for the dead one, so
+            # a get-all would deadlock the gang (reference: the controller
+            # reacts to WorkerGroupPollStatus errors each tick, not to the
+            # join of all workers).
+            pending = list(futures)
+            results = {}
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1,
+                                              timeout=None)
+                for ref in ready:
+                    results[id(ref)] = ray_tpu.get(ref)  # raises on failure
+            worker_results = [results[id(f)] for f in futures]
         finally:
             group.shutdown()
         rank0_reports = self.reports.get(0, [])

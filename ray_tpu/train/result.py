@@ -15,3 +15,4 @@ class Result:
     error: Optional[BaseException] = None
     path: str = ""
     num_failures: int = 0
+    worker_returns: list = dataclasses.field(default_factory=list)

@@ -82,7 +82,8 @@ class JaxTrainer:
             if raw.get("checkpoint") else None,
             error=None,
             path=os.path.join(storage, run_name),
-            num_failures=raw.get("num_failures", 0))
+            num_failures=raw.get("num_failures", 0),
+            worker_returns=raw.get("worker_returns", []))
 
 
 def _dataset_factory(ds):

@@ -31,8 +31,13 @@ class TrainWorker:
 
     def setup_distributed(self):
         """Initialize the JAX coordination service for multi-host meshes.
-        Single-worker groups skip this: the local mesh needs no service."""
-        if self.world_size <= 1 or self._jax_initialized:
+
+        Single-worker groups skip this (the local mesh needs no service),
+        and so do CPU groups: without accelerators jax.distributed cannot
+        federate devices into one global runtime, so the data plane is the
+        host collective backend (ray_tpu.util.collective) instead and the
+        coordination service would only add a flaky moving part."""
+        if self.world_size <= 1 or not self.use_tpu or self._jax_initialized:
             return True
         import jax
         jax.distributed.initialize(
