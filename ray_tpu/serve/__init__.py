@@ -1,0 +1,27 @@
+"""ray_tpu.serve — model serving on the TPU-native runtime
+(reference: python/ray/serve — serve.run api.py:685, ServeController
+_private/controller.py:103, deployment state machine
+_private/deployment_state.py:1712,3220, replicas _private/replica.py,
+HTTP proxy _private/proxy.py:706,1125, pow-2 router
+_private/request_router/pow_2_router.py:27, autoscaling formula
+serve/autoscaling_policy.py:13).
+
+The design keeps the reference's split — control plane (controller actor
+reconciling replica sets) vs data plane (proxy/handle → router → replica
+actor) — but the replica hot path is TPU-shaped: model replicas hold jitted
+programs and KV caches on device, and scale-out follows mesh placement rather
+than process-per-request concurrency."""
+
+from .api import (Application, Deployment, delete, deployment,
+                  get_app_handle, get_deployment_handle, run, shutdown,
+                  start, status)
+from .batching import batch
+from .config import AutoscalingConfig, HTTPOptions
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
+    "DeploymentResponse", "HTTPOptions", "batch", "delete", "deployment",
+    "get_app_handle", "get_deployment_handle", "run", "shutdown", "start",
+    "status",
+]

@@ -1,0 +1,53 @@
+"""Shared serve-internal names and small types
+(reference: serve/_private/common.py DeploymentID/ReplicaID/statuses)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+PROXY_NAME = "SERVE_PROXY"
+SERVE_NAMESPACE = "serve"
+
+# Replica lifecycle (reference: deployment_state.py ReplicaState).
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+STOPPING = "STOPPING"
+UNHEALTHY = "UNHEALTHY"
+
+# Deployment status (reference: common.py DeploymentStatus).
+DEPLOY_UPDATING = "UPDATING"
+DEPLOY_HEALTHY = "HEALTHY"
+DEPLOY_UNHEALTHY = "UNHEALTHY"
+DEPLOY_UPSCALING = "UPSCALING"
+DEPLOY_DOWNSCALING = "DOWNSCALING"
+
+
+def replica_actor_name(app: str, deployment: str, replica_tag: str) -> str:
+    return f"SERVE_REPLICA::{app}#{deployment}#{replica_tag}"
+
+
+@dataclasses.dataclass
+class DeploymentID:
+    name: str
+    app: str = "default"
+
+    def key(self) -> str:
+        return f"{self.app}#{self.name}"
+
+    @staticmethod
+    def from_key(key: str) -> "DeploymentID":
+        app, name = key.split("#", 1)
+        return DeploymentID(name=name, app=app)
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    """What the router needs to reach one replica. Carries the actor id so
+    handles are constructed without a GCS name lookup (the actor submitter
+    resolves addresses lazily — keeps the router loop-safe and RPC-free)."""
+    replica_tag: str
+    actor_name: str
+    actor_id: Any = None
+    max_ongoing_requests: int = 100

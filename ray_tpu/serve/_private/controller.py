@@ -1,0 +1,247 @@
+"""ServeController: the serving control plane
+(reference: serve/_private/controller.py:103 — detached actor whose
+reconciliation loop drives DeploymentStateManager.deploy, health checks,
+autoscaling, and config push to proxies via long-poll long_poll.py).
+
+Async actor. The reconcile loop runs as a background asyncio task; RPCs
+from handles/proxies (get_replica_set, listen_for_change) interleave on the
+same loop. Nothing on the request data plane goes through the controller."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .common import PROXY_NAME, SERVE_NAMESPACE
+from .deployment_state import DeploymentState
+from ..config import DeploymentConfig
+
+logger = logging.getLogger(__name__)
+
+
+class ServeController:
+    def __init__(self, http_host: str = "127.0.0.1", http_port: int = 8000):
+        self.deployments: Dict[str, DeploymentState] = {}
+        # app -> {"route_prefix": str, "ingress": deployment key}
+        self.apps: Dict[str, Dict[str, Any]] = {}
+        self._replica_set_version: Dict[str, int] = {}
+        self._route_version = 0
+        self._change_events: Dict[str, asyncio.Event] = {}
+        self._http_host = http_host
+        self._http_port = http_port
+        self._proxy_handle = None
+        # __init__ runs off-loop (actor creation executes in a pool thread);
+        # the reconcile loop is started lazily from the first async RPC.
+        self._loop_task = None
+        self._shutdown = False
+
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.ensure_future(self._reconcile_loop())
+
+    # -- deploy API (driver-facing) ---------------------------------------
+
+    async def deploy_application(self, app_name: str, route_prefix: str,
+                                 ingress_key: str,
+                                 deployments: List[dict]) -> bool:
+        """deployments: [{key, definition, init_args, init_kwargs, config,
+        version}]. The whole app deploys atomically (reference:
+        deploy_applications → DeploymentStateManager.deploy :3220)."""
+        self._ensure_loop()
+        for spec in deployments:
+            key = spec["key"]
+            state = self.deployments.get(key)
+            if state is None:
+                state = DeploymentState(key, self._on_replica_set_change)
+                self.deployments[key] = state
+            state.set_target(
+                spec["definition"], spec.get("init_args"),
+                spec.get("init_kwargs"),
+                DeploymentConfig(**spec["config"]),
+                spec.get("version") or uuid.uuid4().hex[:8])
+        old = self.apps.get(app_name)
+        self.apps[app_name] = {"route_prefix": route_prefix,
+                               "ingress": ingress_key}
+        if old is None or old.get("route_prefix") != route_prefix or \
+                old.get("ingress") != ingress_key:
+            self._route_version += 1
+            self._signal("routes")
+        return True
+
+    async def delete_application(self, app_name: str) -> bool:
+        app = self.apps.pop(app_name, None)
+        if app is None:
+            return False
+        prefix = f"{app_name}#"
+        for key, state in self.deployments.items():
+            if key.startswith(prefix):
+                state.set_deleting()
+        self._route_version += 1
+        self._signal("routes")
+        return True
+
+    async def shutdown(self) -> bool:
+        self._shutdown = True
+        for state in self.deployments.values():
+            state.set_deleting()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if all(s.is_deleted() for s in self.deployments.values()):
+                break
+            for state in self.deployments.values():
+                await state.reconcile()
+            await asyncio.sleep(0.05)
+        if self._proxy_handle is not None:
+            import ray_tpu
+            handle = self._proxy_handle
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: ray_tpu.kill(handle))
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    # -- proxy management --------------------------------------------------
+
+    async def ensure_proxy(self) -> Tuple[str, int]:
+        self._ensure_loop()
+        if self._proxy_handle is None:
+            host, port = self._http_host, self._http_port
+
+            def _create():
+                # Blocking GCS round-trips — keep off the event loop.
+                import ray_tpu
+                from .common import CONTROLLER_NAME
+                from .proxy import ProxyActor
+                try:
+                    return ray_tpu.get_actor(PROXY_NAME,
+                                             namespace=SERVE_NAMESPACE)
+                except ValueError:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                                   namespace=SERVE_NAMESPACE)
+                    proxy_cls = ray_tpu.remote(ProxyActor)
+                    return proxy_cls.options(
+                        name=PROXY_NAME, namespace=SERVE_NAMESPACE,
+                        lifetime="detached", num_cpus=0, get_if_exists=True,
+                        max_concurrency=1000).remote(controller, host, port)
+            loop = asyncio.get_running_loop()
+            self._proxy_handle = await loop.run_in_executor(None, _create)
+            # Block until the HTTP server is listening.
+            host, port = await self._proxy_handle.ready.remote()
+            self._http_host, self._http_port = host, port
+        return self._http_host, self._http_port
+
+    # -- router/proxy-facing -----------------------------------------------
+
+    async def get_replica_set(self, key: str) -> Tuple[int, List[dict]]:
+        state = self.deployments.get(key)
+        if state is None:
+            return (0, [])
+        version = self._replica_set_version.get(key, 0)
+        return (version, state.running_replica_infos())
+
+    async def get_routes(self) -> Tuple[int, Dict[str, str]]:
+        """route_prefix -> ingress deployment key."""
+        return (self._route_version,
+                {info["route_prefix"]: info["ingress"]
+                 for info in self.apps.values()})
+
+    async def listen_for_change(self, topic: str, known_version: int,
+                                timeout_s: float = 30.0):
+        """Long-poll (reference: _private/long_poll.py LongPollHost): block
+        until `topic`'s version exceeds known_version, then return the new
+        snapshot. Topics: 'routes' or a deployment key."""
+        deadline = time.monotonic() + timeout_s
+        while not self._shutdown:
+            if topic == "routes":
+                version, snapshot = await self.get_routes()
+            else:
+                version, snapshot = await self.get_replica_set(topic)
+            if version > known_version:
+                return (version, snapshot)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return (known_version, None)  # timed out, nothing new
+            event = self._change_events.setdefault(topic, asyncio.Event())
+            try:
+                await asyncio.wait_for(event.wait(),
+                                       min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                pass
+        return (known_version, None)
+
+    def _signal(self, topic: str):
+        event = self._change_events.pop(topic, None)
+        if event is not None:
+            event.set()
+
+    def _on_replica_set_change(self, key: str):
+        self._replica_set_version[key] = \
+            self._replica_set_version.get(key, 0) + 1
+        self._signal(key)
+
+    # -- status ------------------------------------------------------------
+
+    async def get_serve_status(self) -> Dict[str, Any]:
+        return {
+            "apps": {
+                name: {
+                    "route_prefix": info["route_prefix"],
+                    "deployments": {
+                        key.split("#", 1)[1]: self.deployments[key].status()
+                        for key in self.deployments
+                        if key.startswith(f"{name}#")
+                    },
+                } for name, info in self.apps.items()
+            },
+        }
+
+    async def ping(self) -> bool:
+        return True
+
+    # -- reconcile loop ----------------------------------------------------
+
+    async def _reconcile_loop(self):
+        metrics_interval = 0.25
+        last_metrics = 0.0
+        while not self._shutdown:
+            try:
+                for key, state in list(self.deployments.items()):
+                    await state.reconcile()
+                    if state.is_deleted() and state.deleting:
+                        del self.deployments[key]
+                        self._on_replica_set_change(key)
+                now = time.monotonic()
+                if now - last_metrics >= metrics_interval:
+                    last_metrics = now
+                    await self._collect_metrics_and_autoscale()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("reconcile tick failed")
+            await asyncio.sleep(0.05)
+
+    async def _collect_metrics_and_autoscale(self):
+        for state in self.deployments.values():
+            auto = state.target_config.autoscaling_config \
+                if state.target_config else None
+            if not auto:
+                continue
+            total = 0.0
+            probes = []
+            replicas = [r for r in state.replicas.values()
+                        if r.state == "RUNNING" and r.handle is not None]
+            for r in replicas:
+                probes.append(r.handle.get_metrics.remote())
+            if probes:
+                try:
+                    results = await asyncio.wait_for(
+                        asyncio.gather(*probes, return_exceptions=True), 5)
+                except asyncio.TimeoutError:
+                    results = []
+                for r, res in zip(replicas, results):
+                    if isinstance(res, dict):
+                        state.last_metrics[r.tag] = res
+                        total += res.get("ongoing", 0)
+            state.autoscale_tick(total)

@@ -1,0 +1,301 @@
+"""Deployment state machine: reconciles replica actors toward a target
+(reference: serve/_private/deployment_state.py — DeploymentState :1712,
+DeploymentStateManager :2929, deploy :3220; replica transitions
+STARTING→RUNNING→STOPPING and UNHEALTHY replacement).
+
+Runs inside the ServeController's event loop. Each `reconcile()` tick is
+non-blocking: replica starts/health probes are tracked as asyncio tasks and
+harvested on later ticks, mirroring the reference's poll-based loop."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from .common import (DEPLOY_HEALTHY, DEPLOY_UNHEALTHY, DEPLOY_UPDATING,
+                     RUNNING, SERVE_NAMESPACE, STARTING, STOPPING,
+                     replica_actor_name)
+from ..config import DeploymentConfig
+
+logger = logging.getLogger(__name__)
+
+
+class ReplicaState:
+    def __init__(self, tag: str, actor_name: str, version: str):
+        self.tag = tag
+        self.actor_name = actor_name
+        self.version = version
+        self.state = STARTING
+        self.handle = None
+        self.start_task: Optional[asyncio.Task] = None
+        self.health_task: Optional[asyncio.Task] = None
+        self.last_health_check = 0.0
+        self.consecutive_health_failures = 0
+
+    def info_dict(self, max_ongoing: int) -> dict:
+        return {"replica_tag": self.tag, "actor_name": self.actor_name,
+                "actor_id": self.handle.actor_id if self.handle else None,
+                "max_ongoing_requests": max_ongoing}
+
+
+class DeploymentState:
+    """Target + actual replica set for one deployment."""
+
+    def __init__(self, key: str, on_replica_set_change):
+        self.key = key  # "app#name"
+        self.target_version: Optional[str] = None
+        self.target_config: Optional[DeploymentConfig] = None
+        self.definition = None
+        self.init_args: tuple = ()
+        self.init_kwargs: dict = {}
+        self.target_num_replicas = 0
+        self.replicas: Dict[str, ReplicaState] = {}
+        self.deleting = False
+        self._notify = on_replica_set_change
+        self._autoscale_above_since: Optional[float] = None
+        self._autoscale_below_since: Optional[float] = None
+        self.last_metrics: Dict[str, dict] = {}
+
+    # -- target updates ---------------------------------------------------
+
+    def set_target(self, definition, init_args, init_kwargs,
+                   config: DeploymentConfig, version: str):
+        self.definition = definition
+        self.init_args = init_args or ()
+        self.init_kwargs = init_kwargs or {}
+        self.target_config = config
+        self.target_version = version
+        self.deleting = False
+        auto = config.autoscaling_config
+        if auto:
+            initial = auto.get("initial_replicas") or auto["min_replicas"]
+            # Keep the current count when redeploying under autoscaling.
+            current = self.target_num_replicas or initial
+            self.target_num_replicas = min(
+                max(current, auto["min_replicas"]), auto["max_replicas"])
+        else:
+            self.target_num_replicas = config.num_replicas
+
+    def set_deleting(self):
+        self.deleting = True
+        self.target_num_replicas = 0
+
+    # -- status ------------------------------------------------------------
+
+    def status(self) -> dict:
+        running = [r for r in self.replicas.values()
+                   if r.state == RUNNING and r.version == self.target_version]
+        if self.deleting:
+            status = DEPLOY_UPDATING
+        elif len(running) >= self.target_num_replicas and all(
+                r.version == self.target_version
+                for r in self.replicas.values()):
+            status = DEPLOY_HEALTHY
+        elif any(r.consecutive_health_failures >= 3
+                 for r in self.replicas.values()):
+            status = DEPLOY_UNHEALTHY
+        else:
+            status = DEPLOY_UPDATING
+        return {"status": status,
+                "target": self.target_num_replicas,
+                "running": len(running),
+                "total": len(self.replicas)}
+
+    # -- reconcile tick ----------------------------------------------------
+
+    async def reconcile(self):
+        """One non-blocking pass; called repeatedly by the controller."""
+        self._harvest_starts()
+        await self._stop_wrong_version()
+        self._scale()
+        self._health_checks()
+        self._harvest_stops()
+
+    def _harvest_starts(self):
+        changed = False
+        for r in self.replicas.values():
+            if r.state == STARTING and r.start_task and r.start_task.done():
+                r.start_task_result = None
+                try:
+                    r.start_task.result()
+                    r.state = RUNNING
+                    changed = True
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("replica %s failed to start: %s",
+                                   r.actor_name, e)
+                    r.state = STOPPING
+                    r.health_task = asyncio.ensure_future(
+                        self._stop_replica(r))
+                r.start_task = None
+        if changed:
+            self._notify(self.key)
+
+    async def _stop_wrong_version(self):
+        """Rolling update: stop old-version replicas only once enough
+        new-version replicas are RUNNING (start-then-stop, so capacity never
+        dips below target)."""
+        new_running = sum(1 for r in self.replicas.values()
+                         if r.version == self.target_version
+                         and r.state == RUNNING)
+        for r in list(self.replicas.values()):
+            if r.version != self.target_version and r.state == RUNNING \
+                    and new_running >= self.target_num_replicas:
+                self._begin_stop(r)
+
+    def _scale(self):
+        active = [r for r in self.replicas.values()
+                  if r.state in (STARTING, RUNNING)
+                  and r.version == self.target_version]
+        missing = self.target_num_replicas - len(active)
+        for _ in range(max(0, missing)):
+            self._start_replica()
+        if missing < 0:
+            # Prefer stopping STARTING replicas, then RUNNING.
+            victims = sorted(active, key=lambda r: r.state != STARTING)
+            for r in victims[:abs(missing)]:
+                self._begin_stop(r)
+
+    def _start_replica(self):
+        app, name = self.key.split("#", 1)
+        tag = uuid.uuid4().hex[:8]
+        actor_name = replica_actor_name(app, name, tag)
+        rs = ReplicaState(tag, actor_name, self.target_version)
+        config = self.target_config
+        options = dict(config.ray_actor_options or {})
+        options.setdefault("num_cpus", 0)
+        options.update(name=actor_name, namespace=SERVE_NAMESPACE,
+                       max_concurrency=max(config.max_ongoing_requests, 8),
+                       lifetime="detached")
+        definition, init_args = self.definition, self.init_args
+        init_kwargs = self.init_kwargs
+
+        def _create():
+            # Actor registration is a blocking GCS round-trip — keep it off
+            # the controller's event loop.
+            import ray_tpu
+            from .replica import Replica
+            replica_cls = ray_tpu.remote(Replica)
+            return replica_cls.options(**options).remote(
+                name, tag, definition, init_args, init_kwargs,
+                user_config=config.user_config,
+                max_ongoing_requests=config.max_ongoing_requests)
+
+        async def _create_and_wait():
+            loop = asyncio.get_running_loop()
+            rs.handle = await loop.run_in_executor(None, _create)
+            await rs.handle.check_health.remote()
+        rs.start_task = asyncio.ensure_future(_create_and_wait())
+        self.replicas[tag] = rs
+
+    def _begin_stop(self, r: ReplicaState):
+        if r.state == STOPPING:
+            return
+        r.state = STOPPING
+        r.health_task = asyncio.ensure_future(self._stop_replica(r))
+        self._notify(self.key)
+
+    async def _stop_replica(self, r: ReplicaState):
+        import ray_tpu
+        timeout = self.target_config.graceful_shutdown_timeout_s \
+            if self.target_config else 5.0
+        if r.handle is not None:
+            try:
+                await asyncio.wait_for(
+                    r.handle.prepare_for_shutdown.remote(), timeout)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                pass
+            loop = asyncio.get_running_loop()
+            try:
+                await loop.run_in_executor(
+                    None, lambda: ray_tpu.kill(r.handle))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _harvest_stops(self):
+        for tag, r in list(self.replicas.items()):
+            if r.state == STOPPING and r.health_task and r.health_task.done():
+                del self.replicas[tag]
+
+    def _health_checks(self):
+        now = time.monotonic()
+        config = self.target_config
+        period = config.health_check_period_s if config else 2.0
+        for r in self.replicas.values():
+            if r.state != RUNNING:
+                continue
+            if r.health_task is not None:
+                if not r.health_task.done():
+                    if now - r.last_health_check > \
+                            (config.health_check_timeout_s if config else 10):
+                        self._mark_unhealthy(r, "health check timed out")
+                    continue
+                try:
+                    r.health_task.result()
+                    r.consecutive_health_failures = 0
+                except Exception as e:  # noqa: BLE001
+                    self._mark_unhealthy(r, str(e))
+                r.health_task = None
+            elif now - r.last_health_check >= period:
+                r.last_health_check = now
+                r.health_task = asyncio.ensure_future(
+                    self._probe(r))
+
+    async def _probe(self, r: ReplicaState):
+        await r.handle.check_health.remote()
+
+    def _mark_unhealthy(self, r: ReplicaState, cause: str):
+        logger.warning("replica %s unhealthy: %s — replacing",
+                       r.actor_name, cause)
+        r.health_task = None
+        self._begin_stop(r)  # scale() will start a replacement
+
+    # -- autoscaling -------------------------------------------------------
+
+    def autoscale_tick(self, total_ongoing: float):
+        """Adjust target_num_replicas from the ongoing-request metric
+        (reference: serve/autoscaling_policy.py:13
+        _calculate_desired_num_replicas + autoscaling_state.py delays)."""
+        config = self.target_config
+        auto = config.autoscaling_config if config else None
+        if not auto or self.deleting:
+            return
+        from ..autoscaling_policy import calculate_desired_num_replicas
+        desired = calculate_desired_num_replicas(auto, total_ongoing)
+        now = time.monotonic()
+        if desired > self.target_num_replicas:
+            self._autoscale_below_since = None
+            if self._autoscale_above_since is None:
+                self._autoscale_above_since = now
+            if now - self._autoscale_above_since >= auto["upscale_delay_s"]:
+                logger.info("autoscaling %s: %d -> %d (ongoing=%.1f)",
+                            self.key, self.target_num_replicas, desired,
+                            total_ongoing)
+                self.target_num_replicas = desired
+                self._autoscale_above_since = None
+        elif desired < self.target_num_replicas:
+            self._autoscale_above_since = None
+            if self._autoscale_below_since is None:
+                self._autoscale_below_since = now
+            if now - self._autoscale_below_since >= auto["downscale_delay_s"]:
+                logger.info("autoscaling %s: %d -> %d (ongoing=%.1f)",
+                            self.key, self.target_num_replicas, desired,
+                            total_ongoing)
+                self.target_num_replicas = desired
+                self._autoscale_below_since = None
+        else:
+            self._autoscale_above_since = None
+            self._autoscale_below_since = None
+
+    # -- views -------------------------------------------------------------
+
+    def running_replica_infos(self) -> List[dict]:
+        max_ongoing = self.target_config.max_ongoing_requests \
+            if self.target_config else 100
+        return [r.info_dict(max_ongoing) for r in self.replicas.values()
+                if r.state == RUNNING]
+
+    def is_deleted(self) -> bool:
+        return self.deleting and not self.replicas

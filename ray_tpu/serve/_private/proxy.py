@@ -1,0 +1,211 @@
+"""HTTP proxy actor: the ingress data plane
+(reference: serve/_private/proxy.py — HTTPProxy :706, ProxyActor :1125;
+the reference embeds uvicorn/starlette, here the server is a dependency-free
+asyncio HTTP/1.1 implementation with chunked streaming for token streams).
+
+Request path: client HTTP → ProxyActor → longest-prefix route match →
+PowerOfTwoChoicesRouter → replica actor → response (JSON / text / bytes /
+chunked stream). Routes and replica sets arrive from the controller by
+long-poll push (reference: _private/long_poll.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import urllib.parse
+from typing import Any, Dict, Optional, Tuple
+
+from .common import ReplicaInfo, SERVE_NAMESPACE
+from .router import PowerOfTwoChoicesRouter
+
+logger = logging.getLogger(__name__)
+
+
+class Request:
+    """What a deployment's __call__ receives for HTTP requests
+    (reference passes a starlette Request; same essential surface)."""
+
+    __slots__ = ("method", "path", "query_params", "headers", "body")
+
+    def __init__(self, method: str, path: str,
+                 query_params: Dict[str, str], headers: Dict[str, str],
+                 body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query_params
+        self.headers = headers
+        self.body = body
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"{}")
+
+    def text(self) -> str:
+        return (self.body or b"").decode()
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query_params,
+                          self.headers, self.body))
+
+
+class ProxyActor:
+    """Async actor running the HTTP server in its event loop."""
+
+    def __init__(self, controller, host: str, port: int):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._routes: Dict[str, str] = {}  # prefix -> deployment key
+        self._routes_version = -1
+        self._routers: Dict[str, PowerOfTwoChoicesRouter] = {}
+        self._poll_task: Optional[asyncio.Task] = None
+
+    async def ready(self) -> Tuple[str, int]:
+        """Start the server (idempotent); returns the bound address."""
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._port)
+            self._port = self._server.sockets[0].getsockname()[1]
+            self._poll_task = asyncio.ensure_future(self._poll_routes())
+        return (self._host, self._port)
+
+    # -- config push -------------------------------------------------------
+
+    async def _poll_routes(self):
+        while True:
+            try:
+                version, snapshot = await self._controller.\
+                    listen_for_change.remote("routes", self._routes_version)
+                if snapshot is not None:
+                    self._routes_version = version
+                    self._routes = dict(snapshot)
+                    live = set(self._routes.values())
+                    self._routers = {k: v for k, v in self._routers.items()
+                                     if k in live}
+            except Exception:  # noqa: BLE001 — controller restarting
+                await asyncio.sleep(0.5)
+
+    def _router_for(self, key: str) -> PowerOfTwoChoicesRouter:
+        router = self._routers.get(key)
+        if router is None:
+            router = PowerOfTwoChoicesRouter(key, self._controller,
+                                             refresh_ttl_s=0.25)
+            self._routers[key] = router
+        return router
+
+    # -- HTTP server -------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
+                    break
+                keep_alive = request.headers.get(
+                    "connection", "keep-alive").lower() != "close"
+                await self._dispatch(request, writer)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("proxy connection handler failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader
+                            ) -> Optional[Request]:
+        try:
+            request_line = await reader.readline()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            return None
+        if not request_line or request_line in (b"\r\n", b"\n"):
+            return None
+        try:
+            method, target, _version = \
+                request_line.decode("latin1").strip().split(" ", 2)
+        except ValueError:
+            return None
+        headers: Dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if not line or line in (b"\r\n", b"\n"):
+                break
+            name, _, value = line.decode("latin1").partition(":")
+            headers[name.strip().lower()] = value.strip()
+        length = int(headers.get("content-length", 0) or 0)
+        body = await reader.readexactly(length) if length else b""
+        parsed = urllib.parse.urlsplit(target)
+        query = dict(urllib.parse.parse_qsl(parsed.query))
+        return Request(method.upper(), parsed.path, query, headers, body)
+
+    async def _dispatch(self, request: Request,
+                        writer: asyncio.StreamWriter):
+        if request.path == "/-/healthz":
+            await self._respond(writer, 200, b"ok", "text/plain")
+            return
+        if request.path == "/-/routes":
+            await self._respond(
+                writer, 200, json.dumps(self._routes).encode(),
+                "application/json")
+            return
+        key = self._match_route(request.path)
+        if key is None:
+            await self._respond(writer, 404, b"no route", "text/plain")
+            return
+        router = self._router_for(key)
+        tracked = await router.choose_async()
+        if tracked is None:
+            await self._respond(writer, 503, b"no replicas", "text/plain")
+            return
+        router._inc(tracked.actor_name)
+        try:
+            result = await tracked.handle.handle_request.remote(
+                "__call__", (request,), {})
+        except Exception as e:  # noqa: BLE001
+            router.evict(tracked.actor_name)
+            logger.warning("replica %s failed: %s", tracked.actor_name, e)
+            await self._respond(writer, 500, str(e).encode(), "text/plain")
+            return
+        finally:
+            router._dec(tracked.actor_name)
+        status, payload, ctype = _encode_response(result)
+        await self._respond(writer, status, payload, ctype)
+
+    def _match_route(self, path: str) -> Optional[str]:
+        best = None
+        best_len = -1
+        for prefix, key in self._routes.items():
+            if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
+                    or prefix == "/") and len(prefix) > best_len:
+                best = key
+                best_len = len(prefix)
+        return best
+
+    async def _respond(self, writer: asyncio.StreamWriter, status: int,
+                       body: bytes, content_type: str):
+        reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"\r\n".encode("latin1") + body)
+        await writer.drain()
+
+
+def _encode_response(result: Any) -> Tuple[int, bytes, str]:
+    status = 200
+    if isinstance(result, tuple) and len(result) == 2 and \
+            isinstance(result[0], int):
+        status, result = result
+    if isinstance(result, bytes):
+        return status, result, "application/octet-stream"
+    if isinstance(result, str):
+        return status, result.encode(), "text/plain; charset=utf-8"
+    return status, json.dumps(result).encode(), "application/json"

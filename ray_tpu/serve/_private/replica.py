@@ -1,0 +1,135 @@
+"""Replica actor: wraps the user's deployment callable
+(reference: serve/_private/replica.py — UserCallableWrapper, request
+handling with ongoing-request accounting, health checks, reconfigure).
+
+One replica = one async actor. TPU deployments hold their jitted programs
+and device state (params, KV caches) as instance attributes; concurrency
+within the replica is asyncio (max_ongoing_requests bounds it)."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Replica:
+    """Async actor hosting one copy of the deployment.
+
+    `definition` is the user's class or function (cloudpickled through the
+    task-spec plane). Functions are called directly; classes are
+    instantiated with the deployment's init args.
+    """
+
+    def __init__(self, deployment_name: str, replica_tag: str,
+                 definition: Any, init_args: tuple, init_kwargs: dict,
+                 user_config: Any = None,
+                 max_ongoing_requests: int = 100):
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        self._ongoing = 0
+        self._total_served = 0
+        self._max_ongoing = max_ongoing_requests
+        self._is_function = inspect.isfunction(definition) or \
+            inspect.isbuiltin(definition)
+        if self._is_function:
+            self._callable = definition
+        else:
+            self._callable = definition(*init_args, **(init_kwargs or {}))
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    def _apply_user_config(self, user_config: Any):
+        reconfigure = getattr(self._callable, "reconfigure", None)
+        if reconfigure is None:
+            raise ValueError(
+                f"deployment {self.deployment_name} got user_config but "
+                "the callable defines no reconfigure() method")
+        out = reconfigure(user_config)
+        if inspect.isawaitable(out):
+            # We're called from __init__ (sync context in the actor's loop
+            # setup) — run to completion on a throwaway loop is wrong; defer
+            # to first use instead.
+            self._pending_reconfigure = out
+
+    # -- data plane -------------------------------------------------------
+
+    async def handle_request(self, method_name: Optional[str],
+                             args: tuple, kwargs: dict) -> Any:
+        pending = getattr(self, "_pending_reconfigure", None)
+        if pending is not None:
+            self._pending_reconfigure = None
+            await pending
+        self._ongoing += 1
+        try:
+            target = self._resolve(method_name)
+            out = target(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            self._total_served += 1
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def handle_request_streaming(self, method_name: Optional[str],
+                                       args: tuple, kwargs: dict):
+        """Generator variant: yields chunks (called with
+        num_returns='streaming'). The user target must return a (sync or
+        async) generator."""
+        self._ongoing += 1
+        try:
+            target = self._resolve(method_name)
+            out = target(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if hasattr(out, "__aiter__"):
+                async for item in out:
+                    yield item
+            else:
+                for item in out:
+                    yield item
+            self._total_served += 1
+        finally:
+            self._ongoing -= 1
+
+    def _resolve(self, method_name: Optional[str]):
+        if self._is_function:
+            if method_name not in (None, "__call__"):
+                raise AttributeError(
+                    f"function deployment {self.deployment_name} has no "
+                    f"method {method_name!r}")
+            return self._callable
+        return getattr(self._callable, method_name or "__call__")
+
+    # -- control plane ----------------------------------------------------
+
+    def get_metrics(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "served": self._total_served}
+
+    async def check_health(self) -> bool:
+        probe = getattr(self._callable, "check_health", None)
+        if probe is not None:
+            out = probe()
+            if inspect.isawaitable(out):
+                await out
+        return True
+
+    async def reconfigure(self, user_config: Any) -> bool:
+        reconfigure = getattr(self._callable, "reconfigure", None)
+        if reconfigure is None:
+            raise ValueError(
+                f"deployment {self.deployment_name} has no reconfigure()")
+        out = reconfigure(user_config)
+        if inspect.isawaitable(out):
+            await out
+        return True
+
+    async def prepare_for_shutdown(self):
+        """Drain: wait for ongoing requests to finish (bounded by the
+        controller's graceful_shutdown_timeout_s on the calling side)."""
+        while self._ongoing > 0:
+            await asyncio.sleep(0.01)
+        return True

@@ -1,0 +1,163 @@
+"""Request router: picks a replica for each request
+(reference: serve/_private/router.py:433 Router +
+request_router/pow_2_router.py:27 PowerOfTwoChoicesRequestRouter).
+
+The router lives in every handle owner (driver, proxy, composing replica).
+It keeps a cached replica set refreshed from the controller — TTL poll in
+sync contexts, long-poll push in the proxy (reference: long_poll.py) — and
+chooses per request by power-of-two-choices on locally tracked in-flight
+counts (the reference probes replica queue lengths; local counts are the
+same signal without an extra RPC per request)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .common import SERVE_NAMESPACE, ReplicaInfo
+
+
+class PowerOfTwoChoicesRouter:
+    def __init__(self, deployment_key: str, controller_handle,
+                 refresh_ttl_s: float = 1.0):
+        self._key = deployment_key
+        self._controller = controller_handle
+        self._ttl = refresh_ttl_s
+        self._lock = threading.Lock()
+        self._replicas: List[ReplicaInfo] = []
+        self._handles: Dict[str, object] = {}  # actor_name -> ActorHandle
+        self._inflight: Dict[str, int] = {}
+        self._version = -1
+        self._last_refresh = 0.0
+
+    # -- replica set maintenance -----------------------------------------
+
+    def update_replicas(self, version: int, replicas: List[dict]):
+        """Install a pushed replica set (long-poll path)."""
+        with self._lock:
+            if version <= self._version:
+                return
+            self._version = version
+            self._replicas = [ReplicaInfo(**r) for r in replicas]
+            live = {r.actor_name for r in self._replicas}
+            self._handles = {k: v for k, v in self._handles.items()
+                             if k in live}
+            self._inflight = {k: v for k, v in self._inflight.items()
+                              if k in live}
+            self._last_refresh = time.monotonic()
+
+    def _stale(self, force: bool) -> bool:
+        return force or not self._replicas or \
+            time.monotonic() - self._last_refresh >= self._ttl
+
+    def _maybe_refresh(self, force: bool = False):
+        if not self._stale(force):
+            return
+        import ray_tpu
+        try:
+            version, replicas = ray_tpu.get(
+                self._controller.get_replica_set.remote(self._key),
+                timeout=30)
+        except Exception:
+            if force:
+                raise
+            return
+        self._install(version, replicas)
+
+    async def _maybe_refresh_async(self, force: bool = False):
+        """Loop-safe refresh: awaits the controller call instead of a
+        blocking get (for routers living inside async actors)."""
+        if not self._stale(force):
+            return
+        try:
+            version, replicas = await \
+                self._controller.get_replica_set.remote(self._key)
+        except Exception:
+            if force:
+                raise
+            return
+        self._install(version, replicas)
+
+    def _install(self, version: int, replicas: List[dict]):
+        if version > self._version:
+            self.update_replicas(version, replicas)
+        else:
+            with self._lock:
+                self._last_refresh = time.monotonic()
+
+    # -- choice -----------------------------------------------------------
+
+    def choose(self) -> Optional[object]:
+        """Return a tracked replica handle, or None if the deployment
+        currently has no running replicas."""
+        self._maybe_refresh()
+        picked = self._pick()
+        if picked is None:
+            self._maybe_refresh(force=True)
+            picked = self._pick()
+        return picked
+
+    async def choose_async(self) -> Optional[object]:
+        await self._maybe_refresh_async()
+        picked = self._pick()
+        if picked is None:
+            await self._maybe_refresh_async(force=True)
+            picked = self._pick()
+        return picked
+
+    def _pick(self) -> Optional["_Tracked"]:
+        with self._lock:
+            candidates = list(self._replicas)
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            pick = candidates[0]
+        else:
+            a, b = random.sample(candidates, 2)
+            pick = a if self._inflight.get(a.actor_name, 0) <= \
+                self._inflight.get(b.actor_name, 0) else b
+        return self._handle_for(pick)
+
+    def _handle_for(self, info: ReplicaInfo):
+        with self._lock:
+            handle = self._handles.get(info.actor_name)
+        if handle is None:
+            from ...actor import ActorHandle
+            handle = ActorHandle(info.actor_id, "Replica", {})
+            with self._lock:
+                self._handles[info.actor_name] = handle
+        return _Tracked(self, info.actor_name, handle)
+
+    def _inc(self, actor_name: str):
+        with self._lock:
+            self._inflight[actor_name] = self._inflight.get(actor_name, 0) + 1
+
+    def _dec(self, actor_name: str):
+        with self._lock:
+            n = self._inflight.get(actor_name, 1)
+            if n <= 1:
+                self._inflight.pop(actor_name, None)
+            else:
+                self._inflight[actor_name] = n - 1
+
+    def evict(self, actor_name: str):
+        """Drop a replica that failed a call; force refresh next choose."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r.actor_name != actor_name]
+            self._handles.pop(actor_name, None)
+            self._last_refresh = 0.0
+
+
+class _Tracked:
+    """A chosen replica with in-flight accounting hooks."""
+
+    __slots__ = ("router", "actor_name", "handle")
+
+    def __init__(self, router: PowerOfTwoChoicesRouter, actor_name: str,
+                 handle):
+        self.router = router
+        self.actor_name = actor_name
+        self.handle = handle

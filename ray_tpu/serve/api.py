@@ -1,0 +1,249 @@
+"""Serve public API
+(reference: serve/api.py — @serve.deployment :320-ish, serve.run :685 →
+build_app :571 → client.deploy_applications :607, serve.start, serve.delete,
+serve.status, get_deployment_handle)."""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from typing import Any, Callable, Dict, Optional, Union
+
+from ._private.common import (CONTROLLER_NAME, DEPLOY_HEALTHY,
+                              SERVE_NAMESPACE)
+from .config import AutoscalingConfig, HTTPOptions
+from .handle import DeploymentHandle
+
+logger = logging.getLogger(__name__)
+
+
+class Deployment:
+    """A deployment definition plus its options; `.bind()` produces an
+    Application node (reference: serve/deployment.py Deployment)."""
+
+    def __init__(self, definition: Union[type, Callable],
+                 name: Optional[str] = None,
+                 num_replicas: Optional[int] = None,
+                 autoscaling_config: Optional[
+                     Union[AutoscalingConfig, Dict[str, Any]]] = None,
+                 user_config: Optional[Any] = None,
+                 max_ongoing_requests: int = 100,
+                 health_check_period_s: float = 2.0,
+                 health_check_timeout_s: float = 10.0,
+                 graceful_shutdown_timeout_s: float = 5.0,
+                 ray_actor_options: Optional[Dict[str, Any]] = None,
+                 version: Optional[str] = None):
+        self.definition = definition
+        self.name = name or getattr(definition, "__name__", "deployment")
+        self.num_replicas = num_replicas
+        self.autoscaling_config = autoscaling_config
+        self.user_config = user_config
+        self.max_ongoing_requests = max_ongoing_requests
+        self.health_check_period_s = health_check_period_s
+        self.health_check_timeout_s = health_check_timeout_s
+        self.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        self.ray_actor_options = ray_actor_options
+        self.version = version
+
+    def options(self, **overrides) -> "Deployment":
+        merged = dict(
+            name=self.name, num_replicas=self.num_replicas,
+            autoscaling_config=self.autoscaling_config,
+            user_config=self.user_config,
+            max_ongoing_requests=self.max_ongoing_requests,
+            health_check_period_s=self.health_check_period_s,
+            health_check_timeout_s=self.health_check_timeout_s,
+            graceful_shutdown_timeout_s=self.graceful_shutdown_timeout_s,
+            ray_actor_options=self.ray_actor_options, version=self.version)
+        merged.update(overrides)
+        return Deployment(self.definition, **merged)
+
+    def bind(self, *init_args, **init_kwargs) -> "Application":
+        return Application(self, init_args, init_kwargs)
+
+    def _config_dict(self) -> Dict[str, Any]:
+        auto = self.autoscaling_config
+        if isinstance(auto, AutoscalingConfig):
+            auto = auto.to_dict()
+        num = self.num_replicas
+        if num is None:
+            num = 1
+        return {
+            "num_replicas": num,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "user_config": self.user_config,
+            "autoscaling_config": auto,
+            "health_check_period_s": self.health_check_period_s,
+            "health_check_timeout_s": self.health_check_timeout_s,
+            "graceful_shutdown_timeout_s": self.graceful_shutdown_timeout_s,
+            "ray_actor_options": self.ray_actor_options,
+        }
+
+
+class Application:
+    """A bound deployment graph node. The ingress node's bound args may
+    contain other Application nodes: they deploy together and the inner
+    nodes are replaced with DeploymentHandles (reference: model composition
+    via serve.dag / handle-passing)."""
+
+    def __init__(self, deployment: Deployment, init_args: tuple,
+                 init_kwargs: dict):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+
+
+def deployment(_func_or_class=None, **options):
+    """@serve.deployment decorator (reference: serve/api.py deployment)."""
+    def wrap(target):
+        return Deployment(target, **options)
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# controller lifecycle
+# ---------------------------------------------------------------------------
+
+def start(http_options: Optional[HTTPOptions] = None, detached: bool = True):
+    """Ensure the Serve controller (and HTTP proxy) is running
+    (reference: serve/api.py start / _private/client ServeControllerClient)."""
+    import ray_tpu
+    http = http_options or HTTPOptions()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+    except ValueError:
+        pass
+    from ._private.controller import ServeController
+    controller_cls = ray_tpu.remote(ServeController)
+    controller = controller_cls.options(
+        name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
+        lifetime="detached", num_cpus=0, max_concurrency=1000,
+        get_if_exists=True).remote(http.host, http.port)
+    ray_tpu.get(controller.ping.remote(), timeout=60)
+    return controller
+
+
+def _collect_graph(app: Application):
+    """Flatten a bound graph: inner Application nodes become handles."""
+    specs = []
+    seen: Dict[int, DeploymentHandle] = {}
+
+    def visit(node: Application, app_name: str) -> DeploymentHandle:
+        if id(node) in seen:
+            return seen[id(node)]
+        handle = DeploymentHandle(node.deployment.name, app_name)
+        seen[id(node)] = handle
+        args = tuple(visit(a, app_name) if isinstance(a, Application) else a
+                     for a in node.init_args)
+        kwargs = {k: visit(v, app_name) if isinstance(v, Application) else v
+                  for k, v in node.init_kwargs.items()}
+        specs.append({
+            "key": f"{app_name}#{node.deployment.name}",
+            "definition": node.deployment.definition,
+            "init_args": args,
+            "init_kwargs": kwargs,
+            "config": node.deployment._config_dict(),
+            "version": node.deployment.version or uuid.uuid4().hex[:8],
+        })
+        return handle
+
+    return specs, visit
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        http_options: Optional[HTTPOptions] = None,
+        wait_for_ready_timeout_s: float = 60.0,
+        _blocking: bool = True) -> DeploymentHandle:
+    """Deploy an application and wait until healthy
+    (reference: serve.run api.py:685)."""
+    import ray_tpu
+    controller = start(http_options)
+    specs, visit = _collect_graph(app)
+    visit(app, name)
+    ingress_key = f"{name}#{app.deployment.name}"
+    ray_tpu.get(controller.deploy_application.remote(
+        name, route_prefix or "/", ingress_key, specs), timeout=60)
+    if route_prefix is not None:
+        ray_tpu.get(controller.ensure_proxy.remote(), timeout=60)
+    if _blocking:
+        _wait_healthy(controller, name, wait_for_ready_timeout_s)
+    return DeploymentHandle(app.deployment.name, name)
+
+
+def _wait_healthy(controller, app_name: str, timeout_s: float):
+    import ray_tpu
+    deadline = time.monotonic() + timeout_s
+    deps: Dict[str, Any] = {}
+    while time.monotonic() < deadline:
+        status_snapshot = ray_tpu.get(
+            controller.get_serve_status.remote(), timeout=30)
+        app = status_snapshot["apps"].get(app_name, {})
+        deps = app.get("deployments", {})
+        if deps and all(d["status"] == DEPLOY_HEALTHY
+                        for d in deps.values()):
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"application {app_name!r} not healthy after {timeout_s}s: {deps}")
+
+
+def delete(name: str = "default"):
+    import ray_tpu
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_application.remote(name), timeout=60)
+
+
+def status() -> Dict[str, Any]:
+    import ray_tpu
+    controller = _get_controller()
+    return ray_tpu.get(controller.get_serve_status.remote(), timeout=30)
+
+
+def shutdown():
+    """Tear down all applications, replicas, the proxy, and the controller."""
+    import ray_tpu
+    try:
+        controller = _get_controller()
+    except Exception:  # noqa: BLE001 — nothing to shut down
+        return
+    try:
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+    finally:
+        try:
+            ray_tpu.kill(controller)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _get_controller():
+    import ray_tpu
+    return ray_tpu.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu
+    controller = _get_controller()
+    _version, routes = ray_tpu.get(controller.get_routes.remote(),
+                                   timeout=30)
+    for _prefix, key in routes.items():
+        app, dep = key.split("#", 1)
+        if app == name:
+            return DeploymentHandle(dep, app)
+    raise ValueError(f"no application named {name!r}")
+
+
+def get_http_address() -> str:
+    """Host:port of the running proxy (test/client convenience)."""
+    import ray_tpu
+    controller = _get_controller()
+    host, port = ray_tpu.get(controller.ensure_proxy.remote(), timeout=60)
+    return f"http://{host}:{port}"

@@ -1,0 +1,20 @@
+"""Autoscaling policy: desired replica count from request metrics
+(reference: serve/autoscaling_policy.py:13 _calculate_desired_num_replicas
+— target ongoing-requests-per-replica formula; delays live in
+autoscaling_state.py and here in DeploymentState.autoscale_tick)."""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+
+def calculate_desired_num_replicas(autoscaling_config: Dict[str, Any],
+                                   total_ongoing_requests: float) -> int:
+    """ceil(total_ongoing / target_per_replica), clamped to [min, max]."""
+    target = autoscaling_config["target_ongoing_requests"]
+    if target <= 0:
+        return autoscaling_config["max_replicas"]
+    desired = math.ceil(total_ongoing_requests / target)
+    return min(max(desired, autoscaling_config["min_replicas"]),
+               autoscaling_config["max_replicas"])

@@ -1,0 +1,89 @@
+"""@serve.batch — dynamic request batching
+(reference: serve/batching.py _BatchQueue/batch decorator).
+
+Decorate an async method that takes a LIST of inputs and returns a LIST of
+outputs; concurrent callers are coalesced up to max_batch_size or
+batch_wait_timeout_s. On TPU this is the mechanism that turns concurrent
+single requests into one large MXU-friendly batched forward pass."""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = batch_wait_timeout_s
+        self.queue: List = []  # (item, future)
+        self._flush_task: Optional[asyncio.Task] = None
+
+    async def submit(self, item: Any) -> Any:
+        fut = asyncio.get_running_loop().create_future()
+        self.queue.append((item, fut))
+        if len(self.queue) >= self.max_batch_size:
+            self._flush()
+        elif self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.ensure_future(self._delayed_flush())
+        return await fut
+
+    async def _delayed_flush(self):
+        await asyncio.sleep(self.timeout_s)
+        self._flush()
+
+    def _flush(self):
+        if self._flush_task is not None:
+            self._flush_task.cancel()
+            self._flush_task = None
+        batch, self.queue = self.queue, []
+        if batch:
+            asyncio.ensure_future(self._run(batch))
+
+    async def _run(self, batch):
+        items = [item for item, _ in batch]
+        try:
+            outputs = await self.fn(items)
+            if len(outputs) != len(items):
+                raise ValueError(
+                    f"batched function returned {len(outputs)} results for "
+                    f"{len(items)} inputs")
+            for (_, fut), out in zip(batch, outputs):
+                if not fut.done():
+                    fut.set_result(out)
+        except Exception as e:  # noqa: BLE001 — propagate to every caller
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    def wrap(fn):
+        queues = {}  # per-instance (self) queue; functions share one
+
+        @functools.wraps(fn)
+        async def wrapper(*args, **kwargs):
+            if args and not isinstance(args[0], (int, float, str, bytes,
+                                                 list, tuple, dict)) and \
+                    hasattr(args[0].__class__, fn.__name__):
+                instance, item = args[0], args[1]
+                bound = functools.partial(fn, instance)
+                key = id(instance)
+            else:
+                item = args[0]
+                bound = fn
+                key = None
+            q = queues.get(key)
+            if q is None:
+                q = _BatchQueue(bound, max_batch_size, batch_wait_timeout_s)
+                queues[key] = q
+            return await q.submit(item)
+        wrapper._rtpu_batched = True
+        return wrapper
+    if _func is not None:
+        return wrap(_func)
+    return wrap

@@ -1,0 +1,50 @@
+"""Serve configuration dataclasses
+(reference: serve/config.py AutoscalingConfig/HTTPOptions/DeploymentConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Target-ongoing-requests autoscaling
+    (reference: serve/config.py AutoscalingConfig +
+    autoscaling_policy.py:13 _calculate_desired_num_replicas)."""
+    min_replicas: int = 1
+    max_replicas: int = 10
+    target_ongoing_requests: float = 2.0
+    # Stability windows, in controller ticks (the reference uses wall-clock
+    # upscale_delay_s/downscale_delay_s; ticks keep tests deterministic).
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    initial_replicas: Optional[int] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Resolved per-deployment target config held by the controller."""
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 10.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,177 @@
+"""DeploymentHandle: Python-API calls into a deployment
+(reference: serve/handle.py:692 DeploymentHandle / DeploymentResponse).
+
+Handles are serializable (they re-resolve the controller by name), so they
+compose: a deployment's init args may contain handles to other deployments
+(model-composition graphs, reference: serve/dag.py). Dispatch is lazy —
+`remote()` captures the call; the replica is chosen when the response is
+awaited (async actors, loop-safe) or `.result()`ed (drivers/threads,
+blocking)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ._private.common import CONTROLLER_NAME, SERVE_NAMESPACE
+from ._private.router import PowerOfTwoChoicesRouter
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote()
+    (reference: handle.py DeploymentResponse)."""
+
+    def __init__(self, handle: "DeploymentHandle", method_name: str,
+                 args: tuple, kwargs: dict):
+        self._handle = handle
+        self._method_name = method_name
+        self._args = args
+        self._kwargs = kwargs
+        self._ref = None
+        self._tracked = None
+        self._done = False
+
+    # -- sync path ---------------------------------------------------------
+
+    def _dispatch_sync(self, timeout_s: float):
+        router = self._handle._get_router()
+        deadline = time.monotonic() + timeout_s
+        tracked = router.choose()
+        while tracked is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"deployment {self._handle.deployment_name!r} has no "
+                    "running replicas")
+            time.sleep(0.2)
+            tracked = router.choose()
+        self._issue(tracked)
+
+    def _issue(self, tracked):
+        router = self._handle._get_router()
+        self._tracked = tracked
+        router._inc(tracked.actor_name)
+        self._ref = tracked.handle.handle_request.remote(
+            self._method_name, self._args, self._kwargs)
+
+    def _finish(self):
+        if not self._done and self._tracked is not None:
+            self._done = True
+            self._handle._get_router()._dec(self._tracked.actor_name)
+
+    def result(self, timeout_s: Optional[float] = 60.0) -> Any:
+        import ray_tpu
+        if self._ref is None:
+            self._dispatch_sync(timeout_s if timeout_s is not None else 60.0)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        except Exception:
+            self._handle._get_router().evict(self._tracked.actor_name)
+            raise
+        finally:
+            self._finish()
+
+    # -- async path --------------------------------------------------------
+
+    def __await__(self):
+        return self._await_impl().__await__()
+
+    async def _await_impl(self):
+        import asyncio
+        if self._ref is None:
+            router = await self._handle._get_router_async()
+            deadline = time.monotonic() + 60.0
+            tracked = await router.choose_async()
+            while tracked is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"deployment {self._handle.deployment_name!r} has "
+                        "no running replicas")
+                await asyncio.sleep(0.2)
+                tracked = await router.choose_async()
+            self._issue(tracked)
+        try:
+            return await self._ref
+        except Exception:
+            self._handle._get_router().evict(self._tracked.actor_name)
+            raise
+        finally:
+            self._finish()
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: Optional[str] = None):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._router: Optional[PowerOfTwoChoicesRouter] = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _key(self) -> str:
+        return f"{self.app_name}#{self.deployment_name}"
+
+    def _get_router(self) -> PowerOfTwoChoicesRouter:
+        if self._router is None:
+            import ray_tpu
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
+            self._router = PowerOfTwoChoicesRouter(self._key(), controller)
+        return self._router
+
+    async def _get_router_async(self) -> PowerOfTwoChoicesRouter:
+        """Loop-safe router construction (controller lookup via the async
+        GCS client instead of a blocking call_sync)."""
+        if self._router is None:
+            from .._internal.core_worker import get_core_worker
+            from ..actor import ActorHandle
+            info = await get_core_worker().gcs.call(
+                "get_actor_info", name=CONTROLLER_NAME,
+                namespace=SERVE_NAMESPACE)
+            if info is None or info["state"] == "DEAD":
+                raise RuntimeError("serve controller is not running")
+            controller = ActorHandle(info["actor_id"], "ServeController", {})
+            self._router = PowerOfTwoChoicesRouter(self._key(), controller)
+        return self._router
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self._method_name))
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        handle = DeploymentHandle(self.deployment_name, self.app_name,
+                                  method_name=name)
+        handle._router = self._router
+        return handle
+
+    def options(self, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        handle = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name=method_name or self._method_name)
+        handle._router = self._router
+        return handle
+
+    # -- calls -------------------------------------------------------------
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        response = DeploymentResponse(
+            self, self._method_name or "__call__", args, kwargs)
+        # Sync callers (drivers/threads) dispatch eagerly so N remote()
+        # calls overlap on the replicas (batching, parallel fan-out). On an
+        # event loop the blocking choose is illegal — dispatch happens at
+        # await time instead.
+        import asyncio
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            tracked = self._get_router().choose()
+            if tracked is not None:
+                response._issue(tracked)
+        return response
