@@ -1,0 +1,29 @@
+"""ray_tpu.tune — hyperparameter search on the TPU-native runtime
+(reference: python/ray/tune — Tuner tune/tuner.py:312, TuneController
+tune/execution/tune_controller.py:68 `step` :666, schedulers
+tune/schedulers/async_hyperband.py (ASHA) + pbt.py, search spaces
+tune/search/sample.py, variant generation
+tune/search/basic_variant.py).
+
+Trials are actors; the controller is a driver-side event loop that starts
+trial actors under a concurrency budget, polls their reported metrics,
+and lets the scheduler (ASHA / PBT) stop, or exploit/explore them. Train's
+JaxTrainer integrates as a trainable, so one tuned trial can itself be a
+gang-scheduled multi-host SPMD run."""
+
+from .result_grid import Result, ResultGrid
+from .sample import (choice, grid_search, loguniform, qrandint, quniform,
+                     randint, randn, uniform)
+from .schedulers import (AsyncHyperBandScheduler, ASHAScheduler,
+                         FIFOScheduler, PopulationBasedTraining)
+from .search import BasicVariantGenerator
+from .tune_context import get_checkpoint, get_context, report
+from .tuner import TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
+    "FIFOScheduler", "PopulationBasedTraining", "Result", "ResultGrid",
+    "TuneConfig", "Tuner", "choice", "get_checkpoint", "get_context",
+    "grid_search", "loguniform", "qrandint", "quniform", "randint", "randn",
+    "report", "uniform",
+]

@@ -1,0 +1,68 @@
+"""Results of a tuning run (reference: tune/result_grid.py ResultGrid +
+air Result)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    config: Dict[str, Any]
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    trial_id: str = ""
+    path: str = ""
+
+    @property
+    def checkpoint(self):
+        if self.checkpoint_path is None:
+            return None
+        from ..train.checkpoint import Checkpoint
+        return Checkpoint(self.checkpoint_path)
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __getitem__(self, index: int) -> Result:
+        return self._results[index]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given to rank results by")
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        """Rows of final metrics+config (plain list of dicts; no pandas
+        dependency)."""
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            row["trial_id"] = r.trial_id
+            rows.append(row)
+        return rows

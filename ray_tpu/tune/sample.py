@@ -1,0 +1,110 @@
+"""Search-space primitives (reference: tune/search/sample.py —
+Categorical/Float/Integer domains + grid_search marker)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, List, Sequence
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class QUniform(Domain):
+    def __init__(self, low: float, high: float, q: float):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        value = rng.uniform(self.low, self.high)
+        return round(value / self.q) * self.q
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class Randint(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QRandint(Domain):
+    def __init__(self, low: int, high: int, q: int):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        return (rng.randrange(self.low, self.high) // self.q) * self.q
+
+
+class Randn(Domain):
+    def __init__(self, mean: float = 0.0, sd: float = 1.0):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+class GridSearch:
+    """Marker: expands the variant grid instead of sampling."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def quniform(low: float, high: float, q: float) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> Randint:
+    return Randint(low, high)
+
+
+def qrandint(low: int, high: int, q: int) -> QRandint:
+    return QRandint(low, high, q)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Randn:
+    return Randn(mean, sd)
+
+
+def choice(categories: Sequence[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def grid_search(values: Sequence[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}

@@ -1,0 +1,157 @@
+"""Trial schedulers
+(reference: tune/schedulers/ — FIFO trial_scheduler.py, ASHA
+async_hyperband.py AsyncHyperBandScheduler/_Bracket, PBT pbt.py
+PopulationBasedTraining._exploit/_explore).
+
+The controller calls `on_result(trial_id, result)` for every report and
+acts on the returned decision: CONTINUE, STOP (kill the trial), or for PBT
+a ("EXPLOIT", source_trial_id, new_config) directive (restart the trial
+from the source's checkpoint with a perturbed config)."""
+
+from __future__ import annotations
+
+import math
+import random
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def __init__(self):
+        self.metric = None
+        self.mode = "max"
+
+    def setup(self, metric: str, mode: str):
+        self.metric = metric
+        self.mode = mode
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str):
+        pass
+
+
+class AsyncHyperBandScheduler(FIFOScheduler):
+    """ASHA: asynchronous successive halving
+    (reference: async_hyperband.py _Bracket.on_result — a trial reaching a
+    rung is stopped unless it is in the top 1/reduction_factor of results
+    recorded at that rung)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 3.0, brackets: int = 1):
+        super().__init__()
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung t -> recorded metric values (milestones grace*rf^k)
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+        self._milestones = []
+        t = grace_period
+        while t < max_t:
+            self._milestones.append(t)
+            t = int(math.ceil(t * reduction_factor))
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        for milestone in self._milestones:
+            if t == milestone:
+                rung = self._rungs[milestone]
+                value = self._norm(metric)
+                rung.append(value)
+                k = max(1, int(len(rung) / self.rf))
+                cutoff = sorted(rung, reverse=True)[k - 1]
+                if value < cutoff:
+                    decision = STOP
+        return decision
+
+
+# Reference alias (tune exports both names).
+ASHAScheduler = AsyncHyperBandScheduler
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT (reference: pbt.py — at each perturbation_interval, trials in
+    the bottom quantile clone the checkpoint of a top-quantile trial and
+    perturb its hyperparameters by 1.2x / 0.8x or resample)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: int = 0):
+        super().__init__()
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._latest: Dict[str, Tuple[float, Dict[str, Any]]] = {}
+        self.num_perturbations = 0
+
+    def _norm(self, value: float) -> float:
+        return value if self.mode == "max" else -value
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]):
+        t = result.get(self.time_attr)
+        metric = result.get(self.metric)
+        if t is None or metric is None:
+            return CONTINUE
+        self._latest[trial_id] = (self._norm(metric), result)
+        last = self._last_perturb.get(trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        ranked = sorted(self._latest.items(), key=lambda kv: kv[1][0],
+                        reverse=True)
+        if len(ranked) < 2:
+            return CONTINUE
+        n_quant = max(1, int(len(ranked) * self.quantile))
+        bottom_ids = [tid for tid, _ in ranked[-n_quant:]]
+        top_ids = [tid for tid, _ in ranked[:n_quant]]
+        if trial_id in bottom_ids and trial_id not in top_ids:
+            source = self._rng.choice(top_ids)
+            self.num_perturbations += 1
+            return ("EXPLOIT", source, self._explore)
+        return CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """Perturb mutation keys of a (copied) config."""
+        import copy
+        out = copy.deepcopy(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob:
+                if callable(spec):
+                    out[key] = spec()
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                else:
+                    out[key] = spec.sample(self._rng)
+            else:
+                current = out.get(key)
+                if isinstance(current, (int, float)):
+                    factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                    out[key] = type(current)(current * factor)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+        return out
+
+    def on_trial_complete(self, trial_id: str):
+        self._latest.pop(trial_id, None)

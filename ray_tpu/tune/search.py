@@ -1,0 +1,63 @@
+"""Variant generation (reference: tune/search/basic_variant.py
+BasicVariantGenerator — grid_search expansion × num_samples random
+sampling of Domain leaves)."""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Tuple
+
+from .sample import Domain
+
+
+def _find_special(space: Dict[str, Any], path=()):
+    """Yield (path, spec) for grid_search dicts and Domain leaves."""
+    for key, value in space.items():
+        p = path + (key,)
+        if isinstance(value, dict):
+            if set(value.keys()) == {"grid_search"}:
+                yield (p, value)
+            else:
+                yield from _find_special(value, p)
+        elif isinstance(value, Domain):
+            yield (p, value)
+
+
+def _set_path(config: Dict[str, Any], path: Tuple[str, ...], value: Any):
+    node = config
+    for key in path[:-1]:
+        node = node[key]
+    node[path[-1]] = value
+
+
+def _deepcopy_space(space):
+    import copy
+    return copy.deepcopy(space)
+
+
+class BasicVariantGenerator:
+    """grid_search keys form a cartesian grid; Domain leaves are sampled
+    once per (grid point × sample index)."""
+
+    def __init__(self, seed: int = 0):
+        self._rng = random.Random(seed)
+
+    def generate(self, param_space: Dict[str, Any],
+                 num_samples: int) -> List[Dict[str, Any]]:
+        specials = list(_find_special(param_space))
+        grid_paths = [(p, s["grid_search"]) for p, s in specials
+                      if isinstance(s, dict)]
+        domain_paths = [(p, s) for p, s in specials if isinstance(s, Domain)]
+        grids = [values for _, values in grid_paths] or [[None]]
+        configs = []
+        for _sample_idx in range(num_samples):
+            for combo in itertools.product(*grids):
+                config = _deepcopy_space(param_space)
+                if grid_paths:
+                    for (path, _values), value in zip(grid_paths, combo):
+                        _set_path(config, path, value)
+                for path, domain in domain_paths:
+                    _set_path(config, path, domain.sample(self._rng))
+                configs.append(config)
+        return configs

@@ -1,0 +1,59 @@
+"""TrialRunner actor: runs one trial's trainable
+(reference: tune/trainable/ Trainable + the trial-actor model of
+tune_controller.py — each trial is an actor the controller polls).
+
+Sync actor with a small thread pool: `run` occupies one thread for the
+trainable's whole life; `poll` answers from another, draining buffered
+reports (the reference streams results back the same way via futures)."""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class TrialRunner:
+    def __init__(self, trial_id: str, trainable, config: Dict[str, Any],
+                 resume_checkpoint_path: Optional[str] = None):
+        from ..train.checkpoint import Checkpoint
+        self.trial_id = trial_id
+        self._trainable = trainable
+        self._config = config
+        self._resume = (Checkpoint(resume_checkpoint_path)
+                        if resume_checkpoint_path else None)
+        self._lock = threading.Lock()
+        self._reports: List[Dict[str, Any]] = []
+        self._checkpoints: List[Optional[str]] = []
+        self._done = False
+        self._error: Optional[str] = None
+        self._final: Any = None
+
+    # called by tune_context.report from the trainable's thread
+    def _record(self, row: Dict[str, Any], checkpoint_path: Optional[str]):
+        with self._lock:
+            self._reports.append(row)
+            self._checkpoints.append(checkpoint_path)
+
+    def run(self) -> bool:
+        from .tune_context import TuneContext, set_tune_context
+        ctx = TuneContext(self.trial_id, self._config, self, self._resume)
+        set_tune_context(ctx)
+        try:
+            self._final = self._trainable(self._config)
+            return True
+        except Exception:  # noqa: BLE001 — reported via poll
+            with self._lock:
+                self._error = traceback.format_exc()
+            return False
+        finally:
+            set_tune_context(None)
+            with self._lock:
+                self._done = True
+
+    def poll(self, since: int) -> Tuple[List[Dict[str, Any]],
+                                        List[Optional[str]], bool,
+                                        Optional[str]]:
+        with self._lock:
+            return (self._reports[since:], self._checkpoints[since:],
+                    self._done, self._error)

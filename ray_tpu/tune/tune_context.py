@@ -1,0 +1,48 @@
+"""Per-trial context: report/get_checkpoint inside a trainable
+(reference: tune reuses ray.train's train_fn_utils — session.report /
+tune.report)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_local = threading.local()
+
+
+class TuneContext:
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 runner, resume_checkpoint):
+        self.trial_id = trial_id
+        self.config = config
+        self.runner = runner  # TrialRunner instance (in-process)
+        self.resume_checkpoint = resume_checkpoint
+        self.iteration = 0
+
+    def get_trial_id(self) -> str:
+        return self.trial_id
+
+
+def set_tune_context(ctx: Optional[TuneContext]):
+    _local.ctx = ctx
+
+
+def get_context() -> TuneContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("not inside a tune trial")
+    return ctx
+
+
+def report(metrics: Dict[str, Any], checkpoint=None):
+    """Record one result row (reference: tune.report). Adds
+    training_iteration automatically — the attr ASHA/PBT schedule on."""
+    ctx = get_context()
+    ctx.iteration += 1
+    row = dict(metrics)
+    row.setdefault("training_iteration", ctx.iteration)
+    ctx.runner._record(row, checkpoint.path if checkpoint else None)
+
+
+def get_checkpoint():
+    return get_context().resume_checkpoint

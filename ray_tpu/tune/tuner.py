@@ -1,0 +1,246 @@
+"""Tuner + the trial-driving event loop
+(reference: tune/tuner.py:312 Tuner.fit → tune/execution/
+tune_controller.py:68 TuneController, `step` :666 — start trials under a
+concurrency budget, harvest results, apply scheduler decisions, checkpoint
+experiment state for restore).
+
+The controller runs in the driver (like the reference's); trials are
+actors. STOP kills the trial actor; PBT EXPLOIT restarts the trial from the
+source trial's checkpoint with a perturbed config."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from .result_grid import Result, ResultGrid
+from .schedulers import CONTINUE, STOP, FIFOScheduler
+from .search import BasicVariantGenerator
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[Any] = None
+    search_alg: Optional[Any] = None
+    trial_resources: Optional[Dict[str, float]] = None
+    time_budget_s: Optional[float] = None
+
+
+class _Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.id = trial_id
+        self.config = config
+        self.status = PENDING
+        self.actor = None
+        self.run_ref = None
+        self.polled = 0
+        self.reports: List[Dict[str, Any]] = []
+        self.last_checkpoint: Optional[str] = None
+        self.error: Optional[str] = None
+        self.restarts = 0
+
+    def record(self) -> Dict[str, Any]:
+        return {"id": self.id, "config": _jsonable(self.config),
+                "status": self.status,
+                "last_result": _jsonable(self.reports[-1])
+                if self.reports else None,
+                "num_reports": len(self.reports),
+                "checkpoint": self.last_checkpoint, "error": self.error}
+
+
+def _jsonable(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable,
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        from ..train.config import RunConfig
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored_trials: Optional[List[Dict[str, Any]]] = None
+
+    # -- experiment restore ------------------------------------------------
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Resume an interrupted experiment from its state file
+        (reference: Tuner.restore tuner.py + experiment_state json)."""
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        tuner = cls(trainable,
+                    tune_config=TuneConfig(**state["tune_config"]))
+        from ..train.config import RunConfig
+        tuner.run_config = RunConfig(name=state["name"],
+                                     storage_path=state["storage_path"])
+        tuner._restored_trials = state["trials"]
+        return tuner
+
+    # -- fit ---------------------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        scheduler.setup(tc.metric, tc.mode)
+        searcher = tc.search_alg or BasicVariantGenerator()
+
+        name = self.run_config.name or \
+            f"tune-{time.strftime('%Y%m%d-%H%M%S')}-{uuid.uuid4().hex[:4]}"
+        storage = self.run_config.storage_path or "/tmp/rtpu-tune"
+        exp_dir = os.path.join(storage, name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        if self._restored_trials is not None:
+            trials = []
+            for rec in self._restored_trials:
+                t = _Trial(rec["id"], rec["config"])
+                if rec["status"] == TERMINATED:
+                    t.status = TERMINATED
+                    if rec["last_result"]:
+                        t.reports.append(rec["last_result"])
+                t.last_checkpoint = rec.get("checkpoint")
+                trials.append(t)
+        else:
+            configs = searcher.generate(self.param_space, tc.num_samples)
+            trials = [_Trial(f"trial_{i:05d}", config)
+                      for i, config in enumerate(configs)]
+
+        max_concurrent = tc.max_concurrent_trials or len(trials)
+        resources = tc.trial_resources or {"CPU": 1}
+        runner_cls = ray_tpu.remote(_load_trial_runner())
+        deadline = (time.monotonic() + tc.time_budget_s
+                    if tc.time_budget_s else None)
+
+        def start_trial(trial: _Trial, checkpoint: Optional[str] = None,
+                        config: Optional[Dict[str, Any]] = None):
+            if config is not None:
+                trial.config = config
+            trial.actor = runner_cls.options(
+                num_cpus=resources.get("CPU", 1),
+                resources={k: v for k, v in resources.items()
+                           if k not in ("CPU", "GPU")} or None,
+                max_concurrency=4,
+            ).remote(trial.id, self.trainable, trial.config,
+                     checkpoint or trial.last_checkpoint)
+            trial.run_ref = trial.actor.run.remote()
+            trial.status = RUNNING
+
+        def stop_trial(trial: _Trial, status: str = TERMINATED):
+            trial.status = status
+            if trial.actor is not None:
+                try:
+                    ray_tpu.kill(trial.actor)
+                except Exception:  # noqa: BLE001
+                    pass
+                trial.actor = None
+            scheduler.on_trial_complete(trial.id)
+
+        # ---- event loop (reference: TuneController.step :666) ----
+        while True:
+            running = [t for t in trials if t.status == RUNNING]
+            pending = [t for t in trials if t.status == PENDING]
+            for trial in pending[:max(0, max_concurrent - len(running))]:
+                start_trial(trial)
+            running = [t for t in trials if t.status == RUNNING]
+            if not running and not pending:
+                break
+            if deadline and time.monotonic() > deadline:
+                for t in running:
+                    stop_trial(t)
+                break
+
+            for trial in running:
+                try:
+                    rows, ckpts, done, error = ray_tpu.get(
+                        trial.actor.poll.remote(trial.polled), timeout=60)
+                except Exception as e:  # noqa: BLE001 — actor died
+                    trial.error = str(e)
+                    stop_trial(trial, ERROR)
+                    continue
+                trial.polled += len(rows)
+                decision = CONTINUE
+                for row, ckpt in zip(rows, ckpts):
+                    trial.reports.append(row)
+                    if ckpt:
+                        trial.last_checkpoint = ckpt
+                    verdict = scheduler.on_result(trial.id, row)
+                    if verdict == STOP:
+                        decision = STOP
+                    elif isinstance(verdict, tuple) and \
+                            verdict[0] == "EXPLOIT":
+                        decision = verdict
+                if done:
+                    if error is not None:
+                        trial.error = error
+                        stop_trial(trial, ERROR)
+                    else:
+                        stop_trial(trial)
+                elif decision == STOP:
+                    stop_trial(trial)
+                elif isinstance(decision, tuple):
+                    _kind, source_id, explore = decision
+                    source = next(t for t in trials if t.id == source_id)
+                    if source.last_checkpoint:
+                        stop_trial(trial, PENDING)  # will restart below
+                        trial.restarts += 1
+                        trial.polled = 0
+                        start_trial(trial,
+                                    checkpoint=source.last_checkpoint,
+                                    config=explore(source.config))
+            self._save_experiment_state(exp_dir, name, storage, trials)
+            time.sleep(0.05)
+
+        self._save_experiment_state(exp_dir, name, storage, trials)
+        results = [
+            Result(metrics=t.reports[-1] if t.reports else {},
+                   config=t.config, checkpoint_path=t.last_checkpoint,
+                   error=t.error, trial_id=t.id, path=exp_dir)
+            for t in trials
+        ]
+        return ResultGrid(results, metric=tc.metric, mode=tc.mode)
+
+    def _save_experiment_state(self, exp_dir: str, name: str, storage: str,
+                               trials: List[_Trial]):
+        tc = self.tune_config
+        state = {
+            "name": name,
+            "storage_path": storage,
+            "tune_config": {
+                "metric": tc.metric, "mode": tc.mode,
+                "num_samples": tc.num_samples,
+                "max_concurrent_trials": tc.max_concurrent_trials,
+            },
+            "trials": [t.record() for t in trials],
+        }
+        tmp = os.path.join(exp_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1)
+        os.replace(tmp, os.path.join(exp_dir, "experiment_state.json"))
+
+
+def _load_trial_runner():
+    from .trial_runner import TrialRunner
+    return TrialRunner

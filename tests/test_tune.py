@@ -1,0 +1,187 @@
+"""Tune tests: grid/random search, ASHA early stopping, PBT
+exploit/explore, experiment checkpoint/restore, JaxTrainer-as-trainable
+(reference coverage: tune/tests/test_tune_controller.py,
+test_trial_scheduler.py (ASHA), test_trial_scheduler_pbt.py,
+test_tuner_restore.py)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+
+
+@pytest.fixture
+def tune_cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=200 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_and_sampling_search_space():
+    gen = tune.BasicVariantGenerator(seed=1)
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.loguniform(1e-5, 1e-1),
+        "layers": tune.choice([2, 4]),
+        "nested": {"momentum": tune.uniform(0.8, 0.99)},
+    }
+    configs = gen.generate(space, num_samples=3)
+    assert len(configs) == 6  # 2 grid points x 3 samples
+    assert {c["lr"] for c in configs} == {0.1, 0.01}
+    for c in configs:
+        assert 1e-5 <= c["wd"] <= 1e-1
+        assert c["layers"] in (2, 4)
+        assert 0.8 <= c["nested"]["momentum"] <= 0.99
+
+
+def _quadratic(config):
+    """Converges toward score = 100 - (x-7)^2 over iterations."""
+    x = config["x"]
+    for i in range(config.get("iters", 10)):
+        score = (100 - (x - 7) ** 2) * (i + 1) / config.get("iters", 10)
+        tune.report({"score": score})
+        time.sleep(0.01)
+    return x
+
+
+def test_basic_tune_run_finds_best(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _quadratic,
+        param_space={"x": tune.grid_search([1, 5, 7, 11])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=1),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert len(results) == 4
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.config["x"] == 7
+    assert best.metrics["score"] == 100
+
+
+def test_asha_early_stops_bad_trials(tune_cluster, tmp_path):
+    def slow_quadratic(config):
+        x = config["x"]
+        for i in range(20):
+            tune.report({"score": 100 - (x - 7) ** 2 + i * 0.01})
+            # Slow enough that the controller polls several times per
+            # trial — a trial that finishes between polls cannot be
+            # early-stopped (same poll-granularity caveat as the
+            # reference's event-based controller).
+            time.sleep(0.05)
+
+    tuner = tune.Tuner(
+        slow_quadratic,
+        param_space={"x": tune.grid_search([1, 3, 5, 6, 7, 8, 9, 30, 50,
+                                            100])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=5,
+            scheduler=tune.ASHAScheduler(max_t=20, grace_period=2,
+                                         reduction_factor=3)),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert len(results) == 10
+    iters = {r.config["x"]: r.metrics.get("training_iteration", 0)
+             for r in results}
+    # The worst trials must have been cut early; the best ran to max_t.
+    assert iters[100] < 20
+    assert iters[50] < 20
+    assert max(iters.values()) >= 19
+    # Early stopping saved real work: not every trial ran to completion.
+    stopped_early = sum(1 for v in iters.values() if v < 20)
+    assert stopped_early >= 3
+
+
+def test_pbt_exploits_and_perturbs(tune_cluster, tmp_path):
+    def trainable(config):
+        # 'velocity' is the tuned hparam; state persists via checkpoints so
+        # an exploited trial continues from the source's altitude.
+        resume = tune.get_checkpoint()
+        altitude = 0.0
+        if resume is not None:
+            with open(os.path.join(resume.path, "state.json")) as f:
+                altitude = json.load(f)["altitude"]
+        for i in range(20):
+            altitude += config["velocity"]
+            ckpt_dir = os.path.join(config["ckpt_root"],
+                                    f"{tune.get_context().get_trial_id()}"
+                                    f"_{i}_{time.time_ns()}")
+            os.makedirs(ckpt_dir, exist_ok=True)
+            with open(os.path.join(ckpt_dir, "state.json"), "w") as f:
+                json.dump({"altitude": altitude}, f)
+            tune.report({"altitude": altitude},
+                        checkpoint=Checkpoint(ckpt_dir))
+            time.sleep(0.02)
+
+    scheduler = tune.PopulationBasedTraining(
+        perturbation_interval=4,
+        hyperparam_mutations={"velocity": tune.uniform(0.0, 10.0)},
+        quantile_fraction=0.34, seed=3)
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"velocity": tune.grid_search([0.1, 1.0, 9.0]),
+                     "ckpt_root": str(tmp_path / "ckpts")},
+        tune_config=tune.TuneConfig(metric="altitude", mode="max",
+                                    scheduler=scheduler),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert not results.errors
+    assert scheduler.num_perturbations >= 1
+    best = results.get_best_result()
+    assert best.metrics["altitude"] > 20  # exploitation amplified altitude
+
+
+def test_experiment_state_saved_and_restorable(tune_cluster, tmp_path):
+    tuner = tune.Tuner(
+        _quadratic,
+        param_space={"x": tune.grid_search([2, 7])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="exp1", storage_path=str(tmp_path)))
+    results = tuner.fit()
+    state_file = tmp_path / "exp1" / "experiment_state.json"
+    assert state_file.exists()
+    state = json.loads(state_file.read_text())
+    assert len(state["trials"]) == 2
+    assert all(t["status"] == "TERMINATED" for t in state["trials"])
+
+    # Restore: completed trials are not re-run.
+    restored = tune.Tuner.restore(str(tmp_path / "exp1"), _quadratic)
+    results2 = restored.fit()
+    assert len(results2) == 2
+    best = results2.get_best_result(metric="score", mode="max")
+    assert best.config["x"] == 7
+
+
+def test_jax_trainer_as_trainable(tune_cluster, tmp_path):
+    """A tuned trial that itself runs a (1-worker) JaxTrainer."""
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def train_fn(config):
+        import ray_tpu.train as train
+        # Toy quadratic 'loss' standing in for a model fine-tune.
+        loss = (config["lr"] - 0.01) ** 2
+        train.report({"loss": loss})
+
+    def trainable(config):
+        trainer = JaxTrainer(
+            train_fn, train_loop_config=config,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(storage_path=config["storage"]))
+        result = trainer.fit()
+        tune.report({"loss": result.metrics["loss"]})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.001, 0.01, 0.1]),
+                     "storage": str(tmp_path / "train")},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    results = tuner.fit()
+    assert not results.errors
+    assert results.get_best_result().config["lr"] == 0.01
