@@ -1,0 +1,20 @@
+"""jax version compatibility for shard_map.
+
+jax 0.8 moved shard_map out of experimental and renamed check_rep ->
+check_vma. CHECK_KW is the right "replication checking off" kwarg for the
+installed version (ppermute/collective results are device-varying)."""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+CHECK_KW = ({"check_vma": False}
+            if "check_vma" in inspect.signature(shard_map).parameters
+            else {"check_rep": False})
+
+__all__ = ["CHECK_KW", "shard_map"]

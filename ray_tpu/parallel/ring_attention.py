@@ -21,7 +21,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+from ._compat import CHECK_KW, shard_map
 
 NEG_INF = -1e30
 
@@ -64,7 +65,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sequence",
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_rep=False)
+        out_specs=spec, **CHECK_KW)
     def _ring(q_blk, k_blk, v_blk):
         b, h, s_local, d = q_blk.shape
         rank = jax.lax.axis_index(axis_name)
@@ -116,7 +117,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sequence",
 
     @functools.partial(
         shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_rep=False)
+        out_specs=spec, **CHECK_KW)
     def _ulysses(q_blk, k_blk, v_blk):
         # [b, H, S/n, d] -> [b, H/n, S, d]
         def swap_in(x):

@@ -14,7 +14,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from ...parallel._compat import CHECK_KW, shard_map
 
 # In-jit aliases (use inside shard_map bodies).
 allreduce = jax.lax.psum
@@ -35,7 +35,7 @@ def device_allreduce(x, mesh: Mesh, axis_name: str = "data",
     spec = in_spec if in_spec is not None else P(axis_name)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
-                       out_specs=spec, check_rep=False)
+                       out_specs=spec, **CHECK_KW)
     def _ar(blk):
         return jax.lax.psum(blk, axis_name)
 
@@ -46,7 +46,7 @@ def device_allgather(x, mesh: Mesh, axis_name: str = "data"):
     spec = P(axis_name)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=(spec,),
-                       out_specs=P(), check_rep=False)
+                       out_specs=P(), **CHECK_KW)
     def _ag(blk):
         return jax.lax.all_gather(blk, axis_name, tiled=True)
 

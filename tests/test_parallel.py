@@ -1,0 +1,204 @@
+"""Pipeline + expert parallelism tests on the virtual 8-device mesh:
+GPipe exact-match (forward + grads) vs sequential execution, MoE routing
+correctness vs a dense per-token reference, EP sharded training step
+(reference gap being filled: SURVEY §2d — the reference delegates PP/EP to
+vLLM, vllm_models.py:173,234)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.mesh import MeshConfig
+from ray_tpu.parallel.pipeline import (gpipe, make_stage_fn,
+                                       split_layers_into_stages,
+                                       stack_stage_params)
+
+
+def _mlp_layer(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _make_layer_params(key, width, scale=0.5):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (width, width)) * scale / np.sqrt(width),
+        "b1": jnp.zeros((width,)),
+        "w2": jax.random.normal(k2, (width, width)) * scale / np.sqrt(width),
+        "b2": jnp.zeros((width,)),
+    }
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    return MeshConfig(data=2, pipeline=4).build()
+
+
+def test_gpipe_forward_matches_sequential(pp_mesh):
+    S, L, width, batch, micro = 4, 8, 16, 8, 4
+    keys = jax.random.split(jax.random.PRNGKey(0), L)
+    layers = [_make_layer_params(k, width) for k in keys]
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, width))
+
+    # Sequential reference.
+    ref = x
+    for lp in layers:
+        ref = _mlp_layer(lp, ref)
+
+    stages = split_layers_into_stages(layers, S)
+    stacked = stack_stage_params(stages)
+    stage_fn = make_stage_fn(_mlp_layer)
+    pipelined = gpipe(stage_fn, num_stages=S, num_microbatches=micro,
+                      mesh=pp_mesh)
+    with pp_mesh:
+        out = jax.jit(pipelined)(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_gradients_match_sequential(pp_mesh):
+    S, L, width, batch, micro = 4, 4, 8, 8, 2
+    keys = jax.random.split(jax.random.PRNGKey(2), L)
+    layers = [_make_layer_params(k, width) for k in keys]
+    x = jax.random.normal(jax.random.PRNGKey(3), (batch, width))
+    target = jax.random.normal(jax.random.PRNGKey(4), (batch, width))
+
+    def seq_loss(layer_list):
+        h = x
+        for lp in layer_list:
+            h = _mlp_layer(lp, h)
+        return jnp.mean((h - target) ** 2)
+
+    ref_grads = jax.grad(seq_loss)(layers)
+
+    stages = split_layers_into_stages(layers, S)
+    stacked = stack_stage_params(stages)
+    stage_fn = make_stage_fn(_mlp_layer)
+    pipelined = gpipe(stage_fn, num_stages=S, num_microbatches=micro,
+                      mesh=pp_mesh)
+
+    def pp_loss(stacked_params):
+        out = pipelined(stacked_params, x)
+        return jnp.mean((out - target) ** 2)
+
+    with pp_mesh:
+        pp_grads = jax.jit(jax.grad(pp_loss))(stacked)
+
+    # Regroup the reference per-layer grads the same way (stage s holds
+    # layers [s*per, (s+1)*per) stacked on axis 0 inside the stage, and
+    # stages stacked on a new leading axis).
+    per = L // S
+    for s in range(S):
+        for i in range(per):
+            ref_lp = ref_grads[s * per + i]
+            for name in ("w1", "b1", "w2", "b2"):
+                np.testing.assert_allclose(
+                    np.asarray(pp_grads[name][s][i]),
+                    np.asarray(ref_lp[name]), rtol=1e-4, atol=1e-4)
+
+
+def test_gpipe_batch_not_divisible_raises(pp_mesh):
+    stage_fn = make_stage_fn(_mlp_layer)
+    pipelined = gpipe(stage_fn, num_stages=4, num_microbatches=3,
+                      mesh=pp_mesh)
+    layers = [_make_layer_params(jax.random.PRNGKey(i), 8) for i in range(4)]
+    stacked = stack_stage_params(split_layers_into_stages(layers, 4))
+    x = jnp.zeros((8, 8))  # 8 % 3 != 0
+    with pytest.raises(Exception):
+        with pp_mesh:
+            jax.jit(pipelined)(stacked, x)
+
+
+# ---------------------------------------------------------------------------
+# MoE / expert parallelism
+# ---------------------------------------------------------------------------
+
+def _dense_moe_reference(tokens, params, k):
+    """Per-token dense computation of the same top-k MoE (no capacity)."""
+    T, D = tokens.shape
+    logits = tokens.astype(np.float32) @ np.asarray(params["router"])
+    weights = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    top_w, top_idx = jax.lax.top_k(weights, k)
+    top_w = top_w / np.clip(np.asarray(top_w).sum(-1, keepdims=True), 1e-9,
+                            None)
+    out = np.zeros_like(tokens)
+    for t in range(T):
+        for j in range(k):
+            e = int(top_idx[t, j])
+            w = float(top_w[t, j])
+            h = jax.nn.silu(tokens[t] @ params["wi_gate"][e]) * \
+                (tokens[t] @ params["wi_up"][e])
+            out[t] += w * np.asarray(h @ params["wo"][e])
+    return out
+
+
+def test_moe_matches_dense_reference():
+    from ray_tpu.models.moe import MoELayer
+    from ray_tpu.parallel.mesh import unbox
+
+    B, S, D, E, M = 2, 8, 16, 4, 32
+    layer = MoELayer(num_experts=E, embed_dim=D, mlp_dim=M,
+                     num_experts_per_token=2, capacity_factor=4.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    params = unbox(layer.init(jax.random.PRNGKey(1), x)["params"])
+    out, aux = layer.apply({"params": params}, x)
+    assert out.shape == (B, S, D)
+    assert float(aux) > 0
+
+    ref = _dense_moe_reference(
+        np.asarray(x).reshape(-1, D),
+        {k: np.asarray(v) for k, v in params.items()}, k=2)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, D), ref,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_capacity_drops_tokens():
+    from ray_tpu.models.moe import MoELayer
+    from ray_tpu.parallel.mesh import unbox
+
+    B, S, D, E = 1, 16, 8, 2
+    layer = MoELayer(num_experts=E, embed_dim=D, mlp_dim=16,
+                     num_experts_per_token=1, capacity_factor=0.25)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    params = unbox(layer.init(jax.random.PRNGKey(1), x)["params"])
+    out, _aux = layer.apply({"params": params}, x)
+    # capacity = 0.25 * 16 * 1 / 2 = 2 slots per expert -> at most 4 of 16
+    # tokens routed; the rest must be exactly zero (residual carries them).
+    routed = np.count_nonzero(np.abs(np.asarray(out)).sum(-1) > 1e-9)
+    assert routed <= 4
+
+
+def test_moe_ep_sharded_training_step():
+    """MoE trains under an expert-parallel mesh: loss decreases and expert
+    weights stay sharded."""
+    import optax
+    from ray_tpu.models.moe import MoELayer
+    from ray_tpu.parallel.mesh import MeshConfig, unbox
+
+    mesh = MeshConfig(data=2, expert=4).build()
+    B, S, D, E = 8, 4, 16, 4
+    layer = MoELayer(num_experts=E, embed_dim=D, mlp_dim=32,
+                     num_experts_per_token=2, capacity_factor=2.0)
+    x = jax.random.normal(jax.random.PRNGKey(0), (B, S, D))
+    y = jnp.roll(x, 1, axis=-1)  # learnable linear-ish map
+    params = unbox(layer.init(jax.random.PRNGKey(1), x)["params"])
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    def loss_fn(p, x, y):
+        out, aux = layer.apply({"params": p}, x)
+        return jnp.mean((out - y) ** 2) + aux
+
+    @jax.jit
+    def step(p, o, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    with mesh:
+        losses = []
+        for _ in range(30):
+            params, opt_state, loss = step(params, opt_state, x, y)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
