@@ -1,0 +1,19 @@
+"""ray_tpu.rllib — reinforcement learning on the TPU-native runtime
+(reference: python/ray/rllib — Algorithm algorithms/algorithm.py:207,
+EnvRunnerGroup env/env_runner_group.py:71, SingleAgentEnvRunner
+env/single_agent_env_runner.py:68, Learner core/learner/learner.py:106
+compute_gradients :463 / update :979, PPO algorithms/ppo/).
+
+Architecture (TPU-first redesign of the reference's data path):
+env-runner actors sample episodes with a CPU copy of the policy; the
+learner holds the canonical parameters on a device mesh and runs ONE
+jitted update per minibatch (GAE + clipped surrogate + value + entropy in
+a single XLA program); fresh weights broadcast back to runners each
+iteration. The reference's torch DDP learner-group maps here to mesh
+data-parallelism inside the jitted update."""
+
+from .algorithm import PPO, PPOConfig
+from .env_runner import SingleAgentEnvRunner
+from .learner import PPOLearner
+
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner"]

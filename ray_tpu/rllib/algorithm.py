@@ -1,0 +1,159 @@
+"""PPO Algorithm: config + training loop
+(reference: rllib/algorithms/algorithm.py:207 Algorithm.step :1007 /
+training_step :2068; AlgorithmConfig builder pattern
+algorithm_config.py; PPO algorithms/ppo/ppo.py).
+
+training_step: env-runner actors sample fragments in parallel → GAE →
+flatten → learner minibatch update → weights broadcast back to runners."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class PPOConfig:
+    """Builder-style config (reference: AlgorithmConfig)."""
+
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_epochs = 6
+        self.minibatch_size = 512
+        self.grad_clip = 0.5
+        self.model = {"hidden": (64, 64)}
+        self.seed = 0
+
+    def environment(self, env: str) -> "PPOConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "PPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "PPOConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        import ray_tpu
+        from .env_runner import SingleAgentEnvRunner
+        from .learner import PPOLearner
+
+        self.config = config
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, config.num_envs_per_env_runner,
+                config.rollout_fragment_length, dict(config.model),
+                seed=config.seed + 1000 * (i + 1), gamma=config.gamma)
+            for i in range(config.num_env_runners)
+        ]
+        obs_shape = ray_tpu.get(
+            self._runners[0].observation_shape.remote(), timeout=120)
+        import gymnasium as gym
+        probe = gym.make(config.env_name)
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self._learner = PPOLearner(
+            obs_shape=obs_shape, num_actions=num_actions,
+            model_config=dict(config.model), lr=config.lr,
+            clip_param=config.clip_param, vf_coeff=config.vf_coeff,
+            entropy_coeff=config.entropy_coeff, grad_clip=config.grad_clip,
+            seed=config.seed)
+        self._broadcast_weights()
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+
+    def _broadcast_weights(self):
+        import ray_tpu
+        weights = self._learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self._runners],
+                    timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: Algorithm.step :1007)."""
+        import ray_tpu
+        from .learner import compute_gae
+
+        config = self.config
+        t0 = time.perf_counter()
+        fragments = ray_tpu.get(
+            [r.sample.remote() for r in self._runners], timeout=300)
+        sample_time = time.perf_counter() - t0
+
+        obs, actions, logp, adv, rets = [], [], [], [], []
+        for frag in fragments:
+            a, r = compute_gae(frag["rewards"], frag["values"],
+                               frag["dones"], frag["bootstrap_value"],
+                               config.gamma, config.lambda_)
+            obs.append(frag["obs"].reshape(-1, *frag["obs"].shape[2:]))
+            actions.append(frag["actions"].reshape(-1))
+            logp.append(frag["logp"].reshape(-1))
+            adv.append(a.reshape(-1))
+            rets.append(r.reshape(-1))
+            self._recent_returns.extend(frag["episode_returns"].tolist())
+        batch = {
+            "obs": np.concatenate(obs),
+            "actions": np.concatenate(actions),
+            "logp_old": np.concatenate(logp),
+            "advantages": np.concatenate(adv),
+            "returns": np.concatenate(rets),
+        }
+        t1 = time.perf_counter()
+        learn_metrics = self._learner.update(
+            batch, num_epochs=config.num_epochs,
+            minibatch_size=config.minibatch_size,
+            seed=config.seed + self._iteration)
+        learn_time = time.perf_counter() - t1
+        self._broadcast_weights()
+
+        self._iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        num_samples = len(batch["obs"])
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled": num_samples,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else float("nan"),
+            "num_episodes": len(self._recent_returns),
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+            "learner_samples_per_s": num_samples / max(learn_time, 1e-9),
+            **learn_metrics,
+        }
+
+    def stop(self):
+        import ray_tpu
+        for runner in self._runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:  # noqa: BLE001
+                pass

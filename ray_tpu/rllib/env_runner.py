@@ -1,0 +1,115 @@
+"""SingleAgentEnvRunner actor
+(reference: rllib/env/single_agent_env_runner.py:68 — vectorized gym envs,
+samples fixed-length fragments with the current policy, reports episode
+returns; EnvRunnerGroup env_runner_group.py:71 manages N of these actors).
+
+Runs the policy on CPU (jitted once); the learner owns the canonical
+device-mesh copy and pushes weights here every iteration."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_name: str, num_envs: int,
+                 rollout_fragment_length: int, model_config: Dict[str, Any],
+                 seed: int = 0, gamma: float = 0.99):
+        import gymnasium as gym
+        import jax
+        from .models import ActorCriticMLP
+
+        env_fns = [lambda: gym.make(env_name) for _ in range(num_envs)]
+        try:
+            # Same-step autoreset: the done step carries the episode's real
+            # final reward and the returned obs is already the reset obs —
+            # every recorded transition is a genuine one. (The 1.x default
+            # NEXT_STEP mode ignores the action on the post-done step and
+            # returns reward 0: one corrupt transition per episode.)
+            self._envs = gym.vector.SyncVectorEnv(
+                env_fns, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        except (AttributeError, TypeError):  # older gymnasium
+            self._envs = gym.vector.SyncVectorEnv(env_fns)
+        self._num_envs = num_envs
+        self._T = rollout_fragment_length
+        self._gamma = gamma
+        self._model = ActorCriticMLP(
+            num_actions=int(self._envs.single_action_space.n),
+            hidden=tuple(model_config.get("hidden", (64, 64))))
+        self._rng = jax.random.PRNGKey(seed)
+        self._params = None
+
+        from .models import sample_action
+        self._sample = jax.jit(
+            lambda p, obs, rng: sample_action(p, self._model, obs, rng))
+
+        obs, _info = self._envs.reset(seed=seed)
+        self._obs = obs.astype(np.float32)
+        self._episode_returns = np.zeros(num_envs, np.float64)
+        self._completed_returns: List[float] = []
+
+    def observation_shape(self):
+        return tuple(self._envs.single_observation_space.shape)
+
+    def set_weights(self, params) -> bool:
+        self._params = params
+        return True
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """One fragment: arrays shaped [T, N, ...] plus bootstrap values.
+        Also drains completed-episode returns for metrics."""
+        import jax
+        assert self._params is not None, "set_weights first"
+        T, N = self._T, self._num_envs
+        obs_buf = np.empty((T, N) + self._obs.shape[1:], np.float32)
+        act_buf = np.empty((T, N), np.int32)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), np.float32)
+
+        for t in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._sample(self._params, self._obs, key)
+            action = np.asarray(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            next_obs, reward, terminated, truncated, infos = \
+                self._envs.step(action)
+            done = np.logical_or(terminated, truncated)
+            rew_buf[t] = reward
+            if np.any(truncated):
+                # Time-limit truncation is NOT termination: bootstrap the
+                # cut-off return with V(final_obs) folded into the reward
+                # (reference: postprocessing treats truncated episodes by
+                # bootstrapping the value of the last observation).
+                finals = infos.get("final_obs",
+                                   infos.get("final_observation"))
+                idx = np.nonzero(truncated)[0]
+                if finals is not None:
+                    fobs = np.stack([np.asarray(finals[i], np.float32)
+                                     for i in idx])
+                    self._rng, fkey = jax.random.split(self._rng)
+                    _fa, _fl, fval = self._sample(self._params, fobs, fkey)
+                    rew_buf[t, idx] += self._gamma * np.asarray(fval)
+            done_buf[t] = done.astype(np.float32)
+            self._episode_returns += reward
+            for i in np.nonzero(done)[0]:
+                self._completed_returns.append(float(
+                    self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+            self._obs = next_obs.astype(np.float32)
+
+        self._rng, key = jax.random.split(self._rng)
+        _a, _lp, last_value = self._sample(self._params, self._obs, key)
+        returns, self._completed_returns = self._completed_returns, []
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "bootstrap_value": np.asarray(last_value, np.float32),
+            "episode_returns": np.asarray(returns, np.float64),
+        }

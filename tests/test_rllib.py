@@ -1,0 +1,112 @@
+"""RLlib tests: env-runner sampling contract, PPO learner update math,
+GAE correctness, and the BASELINE.json config-1 bar — PPO on CartPole-v1
+reaching episode return >= 475 (reference coverage:
+rllib/algorithms/ppo/tests/test_ppo.py, core/learner/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.learner import PPOLearner, compute_gae
+
+
+@pytest.fixture
+def rl_cluster():
+    ray_tpu.init(num_cpus=6, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_gae_matches_manual():
+    T, N = 4, 1
+    rewards = np.ones((T, N), np.float32)
+    values = np.zeros((T, N), np.float32)
+    dones = np.zeros((T, N), np.float32)
+    dones[-1] = 1.0
+    bootstrap = np.array([10.0], np.float32)  # masked by the done
+    gamma, lam = 0.9, 1.0
+    adv, rets = compute_gae(rewards, values, dones, bootstrap, gamma, lam)
+    # With V=0 and lam=1: adv[t] = sum_{k>=t} gamma^(k-t) * r_k (episode
+    # ends at T-1, bootstrap masked).
+    expected = np.array([[1 + 0.9 + 0.81 + 0.729], [1 + 0.9 + 0.81],
+                         [1 + 0.9], [1.0]], np.float32)
+    np.testing.assert_allclose(adv, expected, rtol=1e-5)
+    np.testing.assert_allclose(rets, expected, rtol=1e-5)  # V=0
+
+
+def test_gae_bootstrap_without_done():
+    rewards = np.zeros((2, 1), np.float32)
+    values = np.zeros((2, 1), np.float32)
+    dones = np.zeros((2, 1), np.float32)
+    bootstrap = np.array([4.0], np.float32)
+    adv, _ = compute_gae(rewards, values, dones, bootstrap, 0.5, 1.0)
+    np.testing.assert_allclose(adv[0], [0.5 * 0.5 * 4.0])
+    np.testing.assert_allclose(adv[1], [0.5 * 4.0])
+
+
+def test_learner_update_improves_objective():
+    rng = np.random.RandomState(0)
+    n = 256
+    learner = PPOLearner(obs_shape=(4,), num_actions=2, lr=5e-3)
+    obs = rng.randn(n, 4).astype(np.float32)
+    # Reward action 0 when obs[0] > 0: advantages teach the rule.
+    actions = rng.randint(0, 2, n).astype(np.int32)
+    correct = (actions == (obs[:, 0] < 0).astype(np.int32))
+    batch = {
+        "obs": obs, "actions": actions,
+        "logp_old": np.full(n, -np.log(2), np.float32),
+        "advantages": np.where(correct, 1.0, -1.0).astype(np.float32),
+        "returns": np.zeros(n, np.float32),
+    }
+    metrics = learner.update(batch, num_epochs=10, minibatch_size=64)
+    assert metrics["policy_loss"] < 0  # surrogate pushed in the right way
+    import jax
+    import jax.numpy as jnp
+    logits, _ = learner.model.apply({"params": learner.params},
+                                    jnp.asarray(obs))
+    pred = np.asarray(jnp.argmax(logits, -1))
+    acc = np.mean(pred == (obs[:, 0] < 0).astype(np.int32))
+    assert acc > 0.9, acc
+
+
+def test_env_runner_sampling_contract(rl_cluster):
+    from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+    runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+    runner = runner_cls.remote("CartPole-v1", 4, 32, {"hidden": (16,)},
+                               seed=1)
+    learner = PPOLearner(obs_shape=(4,), num_actions=2,
+                         model_config={"hidden": (16,)})
+    ray_tpu.get(runner.set_weights.remote(learner.get_weights()),
+                timeout=120)
+    frag = ray_tpu.get(runner.sample.remote(), timeout=120)
+    assert frag["obs"].shape == (32, 4, 4)
+    assert frag["actions"].shape == (32, 4)
+    assert frag["bootstrap_value"].shape == (4,)
+    assert set(np.unique(frag["actions"])) <= {0, 1}
+    assert np.isfinite(frag["logp"]).all()
+    # Fragments chain: a second sample continues from the same state.
+    frag2 = ray_tpu.get(runner.sample.remote(), timeout=120)
+    assert not np.array_equal(frag["obs"][0], frag2["obs"][0])
+
+
+@pytest.mark.timeout_s(900)
+def test_ppo_cartpole_reaches_475(rl_cluster):
+    """BASELINE.json config 1: PPO on CartPole-v1 to >= 475 mean return."""
+    algo = (PPOConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(lr=1e-3, num_epochs=10, minibatch_size=256,
+                      entropy_coeff=0.0)
+            .build())
+    best = 0.0
+    solved = False
+    for _ in range(250):
+        result = algo.train()
+        best = max(best, result["episode_return_mean"])
+        if result["episode_return_mean"] >= 475 and \
+                result["num_episodes"] >= 20:
+            solved = True
+            break
+    algo.stop()
+    assert solved, f"best mean return {best:.1f} after 250 iterations"
