@@ -1,0 +1,11 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling
+(reference: python/ray/autoscaler/v2 — Autoscaler autoscaler.py:47,
+scheduler.py bin-packing, InstanceManager/Reconciler instance_manager/,
+ICloudInstanceProvider node_provider.py:149, fake provider for tests
+_private/fake_multi_node/node_provider.py)."""
+
+from .autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from .node_provider import FakeNodeProvider, NodeProvider
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "FakeNodeProvider",
+           "NodeProvider", "NodeTypeConfig"]

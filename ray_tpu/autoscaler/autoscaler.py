@@ -1,0 +1,211 @@
+"""Autoscaler: declarative reconciliation of cluster size to demand
+(reference: autoscaler/v2/autoscaler.py:47 Autoscaler.try_schedule →
+scheduler.py ResourceDemandScheduler bin-packing; reconciler.py drives
+instances toward the target; idle termination per
+idle_timeout_node states).
+
+One reconcile() pass:
+ 1. read unmet demand from the GCS (queued lease shapes + pending PG
+    bundles, shipped up in raylet heartbeats),
+ 2. subtract capacity already free on live nodes,
+ 3. bin-pack the remainder onto the cheapest fitting node types
+    (bounded by max_workers),
+ 4. launch via the provider; terminate nodes idle past the timeout
+    (bounded by min_workers)."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class AutoscalerConfig:
+    node_types: List[NodeTypeConfig]
+    idle_timeout_s: float = 30.0
+    max_launch_batch: int = 5
+
+
+class Autoscaler:
+    def __init__(self, config: AutoscalerConfig, provider, gcs_client):
+        self.config = config
+        self.provider = provider
+        self.gcs = gcs_client
+        self._idle_since: Dict[str, float] = {}  # node_id -> ts
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # -- demand/supply snapshot -------------------------------------------
+
+    def _snapshot(self):
+        demand_info = self.gcs.call_sync("get_cluster_demand")
+        view = self.gcs.call_sync("get_cluster_view")
+        instances = self.provider.non_terminated_instances()
+        return demand_info, view, instances
+
+    # -- one reconcile pass ------------------------------------------------
+
+    def reconcile(self) -> Dict[str, int]:
+        demand_info, view, instances = self._snapshot()
+        demands = [dict(d) for d in demand_info["task_demand"]] + \
+            [dict(b) for b in demand_info["pg_demand"]]
+
+        # 2. cancel out demand satisfiable by capacity already free.
+        free: List[Dict[str, float]] = [
+            dict(info.get("available", {})) for info in view.values()]
+        unmet = []
+        for demand in demands:
+            placed = False
+            for cap in free:
+                if all(cap.get(k, 0.0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(demand)
+
+        counts = self._count_by_type(instances)
+        launched = 0
+
+        # min_workers floor first (reference: scheduler enforces min counts).
+        for nt in self.config.node_types:
+            while counts.get(nt.name, 0) < nt.min_workers:
+                self._launch(nt)
+                counts[nt.name] = counts.get(nt.name, 0) + 1
+                launched += 1
+
+        # 3. bin-pack unmet demand onto new nodes.
+        pending_caps: List[Dict[str, float]] = []
+        for demand in unmet:
+            placed = False
+            for cap in pending_caps:
+                if all(cap.get(k, 0.0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            node_type = self._pick_type(demand, counts)
+            if node_type is None:
+                logger.warning("autoscaler: demand %s unsatisfiable by any "
+                               "node type under max_workers", demand)
+                continue
+            if launched >= self.config.max_launch_batch:
+                break
+            self._launch(node_type)
+            counts[node_type.name] = counts.get(node_type.name, 0) + 1
+            launched += 1
+            cap = dict(node_type.resources)
+            for k, v in demand.items():
+                cap[k] = cap.get(k, 0.0) - v
+            pending_caps.append(cap)
+
+        # 4. idle termination.
+        terminated = self._terminate_idle(view, instances, counts,
+                                          bool(unmet))
+        return {"launched": launched, "terminated": terminated,
+                "unmet": len(unmet)}
+
+    def _count_by_type(self, instances) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for info in instances.values():
+            counts[info["node_type"]] = counts.get(info["node_type"], 0) + 1
+        return counts
+
+    def _pick_type(self, demand: Dict[str, float],
+                   counts: Dict[str, int]) -> Optional[NodeTypeConfig]:
+        """Smallest node type that fits the demand and is under its cap."""
+        fitting = [
+            nt for nt in self.config.node_types
+            if all(nt.resources.get(k, 0.0) >= v for k, v in demand.items())
+            and counts.get(nt.name, 0) < nt.max_workers
+        ]
+        if not fitting:
+            return None
+        return min(fitting, key=lambda nt: sum(nt.resources.values()))
+
+    def _launch(self, node_type: NodeTypeConfig):
+        logger.info("autoscaler: launching %s", node_type.name)
+        self.provider.launch(node_type.name, dict(node_type.resources),
+                             dict(node_type.labels))
+        self.num_launches += 1
+
+    def _terminate_idle(self, view, instances, counts,
+                        has_unmet: bool) -> int:
+        now = time.monotonic()
+        terminated = 0
+        node_to_instance = {info["node_id"]: iid
+                            for iid, info in instances.items()}
+        live_ids = set(view.keys())
+        for node_id, info in view.items():
+            total = info.get("total", {})
+            avail = info.get("available", {})
+            busy = any(avail.get(k, 0.0) < v for k, v in total.items())
+            if busy or has_unmet:
+                self._idle_since.pop(node_id, None)
+                continue
+            since = self._idle_since.setdefault(node_id, now)
+            if now - since < self.config.idle_timeout_s:
+                continue
+            instance_id = node_to_instance.get(node_id)
+            if instance_id is None:
+                continue  # not ours (e.g. the head node)
+            node_type = instances[instance_id]["node_type"]
+            nt = next((t for t in self.config.node_types
+                       if t.name == node_type), None)
+            if nt is not None and counts.get(node_type, 0) <= nt.min_workers:
+                continue
+            logger.info("autoscaler: terminating idle node %s (%s)",
+                        node_id[:12], node_type)
+            self.provider.terminate(instance_id)
+            counts[node_type] = counts.get(node_type, 0) - 1
+            self._idle_since.pop(node_id, None)
+            self.num_terminations += 1
+            terminated += 1
+        # Forget nodes that disappeared.
+        for node_id in list(self._idle_since):
+            if node_id not in live_ids:
+                self._idle_since.pop(node_id, None)
+        return terminated
+
+
+class Monitor:
+    """Background reconcile loop (reference: autoscaler v2 monitor.py)."""
+
+    def __init__(self, autoscaler: Autoscaler, interval_s: float = 1.0):
+        import threading
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-autoscaler")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.reconcile()
+            except Exception:  # noqa: BLE001 — keep reconciling
+                logger.exception("autoscaler reconcile failed")
+            self._stop.wait(self.interval_s)

@@ -1,0 +1,72 @@
+"""Node providers
+(reference: autoscaler/v2/instance_manager/node_provider.py:149
+ICloudInstanceProvider ABC + the v1-adapter; test double:
+_private/fake_multi_node/node_provider.py FakeMultiNodeProvider).
+
+A provider owns machine lifecycle only — launch/terminate/list. The
+autoscaler decides WHAT to launch; the raylet on the new machine registers
+itself with the GCS. The fake provider backs "machines" with extra raylet
+subprocesses on this host (cluster_utils), which is also how the TPU
+provider maps: one "node" = one TPU host joining the slice."""
+
+from __future__ import annotations
+
+import abc
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class NodeProvider(abc.ABC):
+    @abc.abstractmethod
+    def launch(self, node_type: str, resources: Dict[str, float],
+               labels: Dict[str, str]) -> str:
+        """Start one node of `node_type`; returns a provider instance id."""
+
+    @abc.abstractmethod
+    def terminate(self, instance_id: str) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def non_terminated_instances(self) -> Dict[str, Dict[str, Any]]:
+        """instance_id -> {"node_type": ..., "node_id": <raylet id or None>}"""
+
+
+class FakeNodeProvider(NodeProvider):
+    """Launches extra raylet subprocesses on this host (reference:
+    FakeMultiNodeProvider — the autoscaler test substrate)."""
+
+    def __init__(self, cluster):
+        """cluster: a ray_tpu.cluster_utils.Cluster (already connected)."""
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._instances: Dict[str, Dict[str, Any]] = {}
+
+    def launch(self, node_type: str, resources: Dict[str, float],
+               labels: Dict[str, str]) -> str:
+        instance_id = f"fake-{uuid.uuid4().hex[:8]}"
+        num_cpus = int(resources.get("CPU", 1))
+        extra = {k: v for k, v in resources.items() if k != "CPU"} or None
+        node = self._cluster.add_node(
+            num_cpus=num_cpus, resources=extra,
+            labels=dict(labels, **{"ray.io/node-type": node_type}))
+        with self._lock:
+            self._instances[instance_id] = {
+                "node_type": node_type, "node": node,
+                "node_id": node.node_id,
+            }
+        return instance_id
+
+    def terminate(self, instance_id: str) -> bool:
+        with self._lock:
+            info = self._instances.pop(instance_id, None)
+        if info is None:
+            return False
+        self._cluster.remove_node(info["node"], allow_graceful=True)
+        return True
+
+    def non_terminated_instances(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {iid: {"node_type": i["node_type"],
+                          "node_id": i["node_id"]}
+                    for iid, i in self._instances.items()}

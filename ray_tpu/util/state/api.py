@@ -1,0 +1,192 @@
+"""State API implementation
+(reference: python/ray/util/state/api.py — list_* functions backed by the
+GCS's tables via StateApiClient; state_cli.py renders them as `ray list`).
+
+Every listing is a list of plain dicts (the reference returns dataclass
+rows; dicts keep the surface serialization-free). `timeline()` exports the
+task-event buffer as a chrome://tracing JSON trace (reference:
+_private/state.py:1013 chrome_tracing_dump)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def _gcs():
+    from ..._internal.core_worker import get_core_worker
+    return get_core_worker().gcs
+
+
+def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
+    nodes = _gcs().call_sync("get_all_nodes")
+    view = _gcs().call_sync("get_cluster_view")
+    out = []
+    for node in nodes[:limit]:
+        live = view.get(node["node_id"], {})
+        out.append({
+            "node_id": node["node_id"],
+            "state": node.get("state", "ALIVE"),
+            "address": node.get("address"),
+            "node_index": node.get("node_index"),
+            "resources_total": node.get("resources", {}),
+            "resources_available": live.get("available", {}),
+            "labels": node.get("labels", {}),
+            "is_head": node.get("is_head", False),
+        })
+    return out
+
+
+def get_node(node_id: str) -> Optional[Dict[str, Any]]:
+    for node in list_nodes():
+        if node["node_id"] == node_id:
+            return node
+    return None
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    actors = _gcs().call_sync("get_all_actors")
+    out = []
+    for a in actors[:limit]:
+        aid = a["actor_id"]
+        out.append({
+            "actor_id": aid.hex() if hasattr(aid, "hex") else str(aid),
+            "class_name": a.get("class_name", ""),
+            "state": a["state"],
+            "name": a.get("name", ""),
+            "namespace": a.get("namespace", ""),
+            "node_id": a.get("node_id"),
+            "address": a.get("address"),
+            "is_detached": a.get("is_detached", False),
+            "num_restarts": a.get("num_restarts", 0),
+            "death_cause": a.get("death_cause"),
+        })
+    return out
+
+
+def get_actor(actor_id_hex: str) -> Optional[Dict[str, Any]]:
+    for a in list_actors():
+        if a["actor_id"].startswith(actor_id_hex):
+            return a
+    return None
+
+
+def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+    pgs = _gcs().call_sync("get_all_placement_groups")
+    out = []
+    for pg in pgs[:limit]:
+        pg_id = pg.get("pg_id")
+        out.append({
+            "placement_group_id": pg_id.hex() if hasattr(pg_id, "hex")
+            else str(pg_id),
+            "name": pg.get("name", ""),
+            "state": pg.get("state"),
+            "strategy": pg.get("strategy"),
+            "bundles": pg.get("bundles"),
+            "bundle_nodes": pg.get("bundle_nodes"),
+        })
+    return out
+
+
+def list_jobs(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _gcs().call_sync("get_all_jobs")[:limit]
+
+
+def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Per-node worker processes, from each raylet's node stats."""
+    from ..._internal.core_worker import get_core_worker
+    cw = get_core_worker()
+    out = []
+    for node in _gcs().call_sync("get_all_nodes"):
+        if node.get("state") == "DEAD" or not node.get("address"):
+            continue
+        try:
+            stats = cw.clients.get(tuple(node["address"])).call_sync(
+                "get_node_stats", timeout=10)
+        except Exception:  # noqa: BLE001 — node may be going away
+            continue
+        for worker in stats.get("workers", []):
+            out.append(dict(worker, node_id=node["node_id"]))
+    return out[:limit]
+
+
+def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
+               detail: bool = False) -> List[Dict[str, Any]]:
+    """Task rows folded from the task-event stream: one row per
+    (task_id, attempt) with its latest state + timings."""
+    events = _gcs().call_sync("get_task_events", job_id=job_id,
+                              limit=100_000)
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for ev in events:
+        key = (ev["task_id"], ev.get("attempt", 0))
+        row = rows.setdefault(key, {
+            "task_id": ev["task_id"], "attempt": ev.get("attempt", 0),
+            "name": ev.get("name"), "job_id": ev.get("job_id"),
+            "type": ev.get("type"), "actor_id": ev.get("actor_id"),
+            "state": None, "submitted_at": None, "started_at": None,
+            "finished_at": None, "error": None, "node_index": None,
+            "pid": None,
+        })
+        kind = ev["event"]
+        if kind == "SUBMITTED":
+            row["submitted_at"] = ev["ts"]
+            row["state"] = row["state"] or "PENDING"
+        elif kind == "RUNNING":
+            row["started_at"] = ev["ts"]
+            row["pid"] = ev.get("pid")
+            row["node_index"] = ev.get("node_index")
+            if row["state"] not in ("FINISHED", "FAILED"):
+                row["state"] = "RUNNING"
+        elif kind == "FINISHED":
+            row["finished_at"] = ev["ts"]
+            row["state"] = "FINISHED"
+        elif kind == "FAILED":
+            row["finished_at"] = ev["ts"]
+            row["state"] = "FAILED"
+            row["error"] = ev.get("error")
+    out = list(rows.values())
+    out.sort(key=lambda r: r.get("submitted_at") or 0)
+    return out[-limit:]
+
+
+def summarize_tasks(job_id: Optional[str] = None) -> Dict[str, Any]:
+    """Counts by (name, state) (reference: `ray summary tasks`)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for row in list_tasks(job_id=job_id, limit=100_000):
+        by_state = summary.setdefault(row["name"] or "?", {})
+        state = row["state"] or "?"
+        by_state[state] = by_state.get(state, 0) + 1
+    return summary
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Plasma-resident (location-tracked) objects cluster-wide."""
+    rows = _gcs().call_sync("get_all_object_locations")
+    return rows[:limit]
+
+
+def timeline(filename: Optional[str] = None,
+             job_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-trace ('catapult') export of task execution spans
+    (reference: ray.timeline → _private/state.py chrome_tracing_dump).
+    Load the output in chrome://tracing or Perfetto."""
+    trace = []
+    for row in list_tasks(job_id=job_id, limit=100_000):
+        if row["started_at"] is None:
+            continue
+        end = row["finished_at"] or row["started_at"]
+        trace.append({
+            "name": row["name"],
+            "cat": "task" if row["type"] != 2 else "actor_task",
+            "ph": "X",
+            "ts": row["started_at"] * 1e6,
+            "dur": max(0.0, (end - row["started_at"]) * 1e6),
+            "pid": f"node{row['node_index']}",
+            "tid": f"worker-pid-{row['pid']}",
+            "args": {"task_id": row["task_id"], "state": row["state"],
+                     "attempt": row["attempt"]},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
