@@ -1,0 +1,265 @@
+"""Command-line interface
+(reference: python/ray/scripts/scripts.py — `ray start` :679, stop,
+status, job submit/logs/stop, `ray list ...` via util/state/state_cli.py,
+`ray timeline`).
+
+Usage: python -m ray_tpu.cli <command> ...
+
+  start --head [--num-cpus N] [--port P] [--dashboard]   run a head node
+  start --address HOST:PORT [--num-cpus N]               join as a worker
+  stop                                                   stop local nodes
+  status   [--address ...]                               cluster resources
+  list     {nodes,actors,tasks,placement_groups,objects,workers,jobs}
+  timeline [--output FILE]                               chrome trace
+  dashboard                                              start + print URL
+  submit   [--wait] -- ENTRYPOINT...                     submit a job
+  job      {logs,stop,list} [ID]
+  perf     [--quick]                                     microbenchmarks
+
+The head address is written to /tmp/rtpu/head_address; commands default
+to it so `--address` is rarely needed (reference: ray's address file in
+the session dir)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+ADDRESS_FILE = "/tmp/rtpu/head_address"
+
+
+def _write_address(address: str):
+    os.makedirs(os.path.dirname(ADDRESS_FILE), exist_ok=True)
+    with open(ADDRESS_FILE, "w") as f:
+        f.write(address)
+
+
+def _resolve_address(arg) -> str:
+    if arg:
+        return arg
+    try:
+        with open(ADDRESS_FILE) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        raise SystemExit(
+            "no --address given and no head found "
+            f"({ADDRESS_FILE} missing); run `python -m ray_tpu.cli "
+            "start --head` first")
+
+
+def _connect(args):
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        return
+    ray_tpu.init(address=_resolve_address(getattr(args, "address", None)),
+                 ignore_reinit_error=True)
+
+
+# -- commands ---------------------------------------------------------------
+
+def cmd_start(args):
+    from ray_tpu._internal.node import Node, default_resources
+
+    resources = default_resources(args.num_cpus, None)
+    if args.head:
+        node = Node(head=True, resources=resources)
+        node.start()
+        address = f"{node.gcs_address[0]}:{node.gcs_address[1]}"
+        _write_address(address)
+        print(f"head started; GCS at {address}", flush=True)
+        if args.dashboard:
+            import ray_tpu
+            from ray_tpu.dashboard import start_dashboard
+            ray_tpu.init(address=address, ignore_reinit_error=True)
+            print(f"dashboard at {start_dashboard()}", flush=True)
+        print("press Ctrl-C to stop", flush=True)
+        _block_until_signal()
+        node.stop()
+        return
+    address = _resolve_address(args.address)
+    host, port = address.rsplit(":", 1)
+    from ray_tpu._internal.gcs_client import GcsClient
+    probe = GcsClient((host, int(port)))
+    nodes = probe.call_sync("get_all_nodes")
+    session = next((n.get("session_name") for n in nodes
+                    if n.get("is_head")), "connected")
+    index = max((n.get("node_index", 0) for n in nodes), default=0) + 1
+    from ray_tpu._internal import raylet_main
+    sys.argv = ["raylet"]
+    raylet_main.main([
+        "--gcs-address", address, "--session", session or "connected",
+        "--node-index", str(index),
+        "--resources", json.dumps(resources),
+    ])
+
+
+def _block_until_signal():
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    while not stop:
+        time.sleep(0.5)
+
+
+def cmd_stop(_args):
+    import subprocess
+    patterns = ["ray_tpu._internal.raylet_main",
+                "ray_tpu._internal.worker_main",
+                "ray_tpu.cli start"]
+    for pattern in patterns:
+        subprocess.run(["pkill", "-f", pattern], check=False)
+    try:
+        os.unlink(ADDRESS_FILE)
+    except FileNotFoundError:
+        pass
+    print("stopped")
+
+
+def cmd_status(args):
+    _connect(args)
+    from ray_tpu.util import state as st
+    from ray_tpu._internal.core_worker import get_core_worker
+    nodes = st.list_nodes()
+    demand = get_core_worker().gcs.call_sync("get_cluster_demand")
+    print(f"nodes: {len(nodes)}")
+    total, avail = {}, {}
+    for node in nodes:
+        mark = " (head)" if node["is_head"] else ""
+        print(f"  {node['node_id'][:12]}{mark}  "
+              f"{node['resources_available']} / "
+              f"{node['resources_total']}")
+        for k, v in node["resources_total"].items():
+            total[k] = total.get(k, 0) + v
+        for k, v in node["resources_available"].items():
+            avail[k] = avail.get(k, 0) + v
+    print(f"resources: {avail} available of {total}")
+    pending = len(demand["task_demand"]) + len(demand["pg_demand"])
+    print(f"pending demand: {pending} shapes")
+
+
+def cmd_list(args):
+    _connect(args)
+    from ray_tpu.util import state as st
+    listing = {
+        "nodes": st.list_nodes, "actors": st.list_actors,
+        "tasks": st.list_tasks,
+        "placement_groups": st.list_placement_groups,
+        "objects": st.list_objects, "workers": st.list_workers,
+    }
+    if args.what == "jobs":
+        from ray_tpu.job_submission import JobManager
+        rows = JobManager().list_jobs()
+    else:
+        rows = listing[args.what](limit=args.limit)
+    print(json.dumps(rows, indent=1, default=str))
+
+
+def cmd_timeline(args):
+    _connect(args)
+    from ray_tpu.util import state as st
+    trace = st.timeline(args.output)
+    print(f"wrote {len(trace)} spans to {args.output}")
+
+
+def cmd_dashboard(args):
+    _connect(args)
+    from ray_tpu.dashboard import start_dashboard
+    print(start_dashboard())
+
+
+def cmd_submit(args):
+    _connect(args)
+    from ray_tpu.job_submission import JobManager, JobStatus
+    import shlex
+    manager = JobManager()
+    entrypoint = shlex.join(args.entrypoint)
+    submission_id = manager.submit_job(entrypoint=entrypoint)
+    print(f"submitted {submission_id}")
+    if args.wait:
+        status = manager.wait_until_finished(submission_id,
+                                             timeout_s=args.timeout)
+        print(manager.get_job_logs(submission_id), end="")
+        print(f"job {submission_id}: {status}")
+        if status != JobStatus.SUCCEEDED:
+            raise SystemExit(1)
+
+
+def cmd_job(args):
+    _connect(args)
+    from ray_tpu.job_submission import JobManager
+    manager = JobManager()
+    if args.action == "list":
+        print(json.dumps(manager.list_jobs(), indent=1, default=str))
+    elif args.action == "logs":
+        print(manager.get_job_logs(args.id), end="")
+    elif args.action == "stop":
+        print("stopped" if manager.stop_job(args.id) else "not running")
+
+
+def cmd_perf(args):
+    from ray_tpu import perf
+    perf.main(quick=args.quick)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--dashboard", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("list")
+    p.add_argument("what", choices=["nodes", "actors", "tasks",
+                                    "placement_groups", "objects",
+                                    "workers", "jobs"])
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("timeline")
+    p.add_argument("--output", default="timeline.json")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("dashboard")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_dashboard)
+
+    p = sub.add_parser("submit")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--address")
+    p.add_argument("entrypoint", nargs="+")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("job")
+    p.add_argument("action", choices=["list", "logs", "stop"])
+    p.add_argument("id", nargs="?")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser("perf")
+    p.add_argument("--quick", action="store_true")
+    p.set_defaults(fn=cmd_perf)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+"""ray_tpu.dashboard — REST observability + job submission endpoint
+(reference: python/ray/dashboard — DashboardHead head.py:49, job REST
+modules/job/, state aggregation state_aggregator.py, Prometheus metrics
+modules/metrics/)."""
+
+from .head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,201 @@
+"""DashboardHead: the cluster's REST surface
+(reference: dashboard/head.py:49 DashboardHead — aiohttp app serving the
+state API, job routes, and Prometheus metrics; here a dependency-free
+asyncio HTTP server inside a detached actor).
+
+Routes:
+  GET  /api/cluster_status            nodes + aggregate resources
+  GET  /api/nodes|actors|tasks|placement_groups|objects|workers
+  GET  /api/jobs/                     submitted jobs
+  POST /api/jobs/                     {entrypoint, ...} -> submission_id
+  GET  /api/jobs/<id>                 job info
+  GET  /api/jobs/<id>/logs            {"logs": ...}
+  POST /api/jobs/<id>/stop
+  GET  /api/timeline                  chrome-trace JSON of task spans
+  GET  /metrics                       Prometheus exposition
+  GET  /-/healthz
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import re
+import urllib.parse
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+DASHBOARD_NAME = "DASHBOARD_HEAD"
+DASHBOARD_NAMESPACE = "_dashboard"
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._job_manager = None
+
+    async def ready(self) -> Tuple[str, int]:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._port)
+            self._port = self._server.sockets[0].getsockname()[1]
+        return (self._host, self._port)
+
+    # -- HTTP plumbing (same shape as serve's proxy) ----------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                method, target, _v = line.decode("latin1").strip().split(
+                    " ", 2)
+                headers = {}
+                while True:
+                    hline = await reader.readline()
+                    if not hline or hline in (b"\r\n", b"\n"):
+                        break
+                    name, _, value = hline.decode("latin1").partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                length = int(headers.get("content-length", 0) or 0)
+                body = await reader.readexactly(length) if length else b""
+                parsed = urllib.parse.urlsplit(target)
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                status, payload, ctype = await self._route(
+                    method.upper(), parsed.path, query, body)
+                reason = {200: "OK", 404: "Not Found",
+                          400: "Bad Request",
+                          500: "Internal Server Error"}.get(status, "")
+                writer.write(
+                    f"HTTP/1.1 {status} {reason}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(payload)}\r\n\r\n"
+                    .encode("latin1") + payload)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError, ValueError):
+            pass
+        except Exception:  # noqa: BLE001
+            logger.exception("dashboard connection failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _route(self, method: str, path: str, query: Dict[str, str],
+                     body: bytes) -> Tuple[int, bytes, str]:
+        loop = asyncio.get_running_loop()
+        try:
+            # Blocking state/GCS lookups run off-loop.
+            return await loop.run_in_executor(
+                None, self._route_sync, method, path, query, body)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("route %s %s failed", method, path)
+            return (500, json.dumps({"error": str(e)}).encode(),
+                    "application/json")
+
+    def _json(self, obj, status: int = 200) -> Tuple[int, bytes, str]:
+        return (status, json.dumps(obj, default=str).encode(),
+                "application/json")
+
+    def _route_sync(self, method: str, path: str, query: Dict[str, str],
+                    body: bytes) -> Tuple[int, bytes, str]:
+        from ..util import state as st
+
+        if path == "/-/healthz":
+            return (200, b"ok", "text/plain")
+        if path == "/metrics":
+            from .._internal.core_worker import get_core_worker
+            from ..util.metrics import (collect_cluster_metrics,
+                                        prometheus_text)
+            text = prometheus_text(
+                collect_cluster_metrics(get_core_worker().gcs))
+            return (200, text.encode(), "text/plain; version=0.0.4")
+        if path == "/api/cluster_status":
+            nodes = st.list_nodes()
+            total: Dict[str, float] = {}
+            available: Dict[str, float] = {}
+            for node in nodes:
+                for k, v in node["resources_total"].items():
+                    total[k] = total.get(k, 0) + v
+                for k, v in node["resources_available"].items():
+                    available[k] = available.get(k, 0) + v
+            return self._json({"nodes": nodes, "resources_total": total,
+                               "resources_available": available})
+        if path == "/api/nodes":
+            return self._json(st.list_nodes())
+        if path == "/api/actors":
+            return self._json(st.list_actors())
+        if path == "/api/tasks":
+            return self._json(st.list_tasks(
+                job_id=query.get("job_id"),
+                limit=int(query.get("limit", 1000))))
+        if path == "/api/placement_groups":
+            return self._json(st.list_placement_groups())
+        if path == "/api/objects":
+            return self._json(st.list_objects())
+        if path == "/api/workers":
+            return self._json(st.list_workers())
+        if path == "/api/timeline":
+            return self._json(st.timeline())
+
+        job_match = re.fullmatch(r"/api/jobs/([^/]*)(/logs|/stop)?", path)
+        if path == "/api/jobs/" or job_match:
+            return self._route_jobs(method, job_match, body)
+        return (404, b"not found", "text/plain")
+
+    def _route_jobs(self, method: str, match, body: bytes):
+        from ..job_submission import JobManager
+        if self._job_manager is None:
+            self._job_manager = JobManager()
+        manager = self._job_manager
+        sub_id = match.group(1) if match else ""
+        action = match.group(2) if match else None
+
+        if method == "POST" and not sub_id:
+            payload = json.loads(body or b"{}")
+            submission_id = manager.submit_job(
+                entrypoint=payload["entrypoint"],
+                submission_id=payload.get("submission_id"),
+                runtime_env=payload.get("runtime_env"),
+                metadata=payload.get("metadata"))
+            return self._json({"submission_id": submission_id})
+        if method == "GET" and not sub_id:
+            return self._json(manager.list_jobs())
+        if method == "GET" and action == "/logs":
+            return self._json({"logs": manager.get_job_logs(sub_id)})
+        if method == "POST" and action == "/stop":
+            return self._json({"stopped": manager.stop_job(sub_id)})
+        if method == "GET" and sub_id:
+            info = manager.get_job_info(sub_id)
+            if info is None:
+                return self._json({"error": "no such job"}, 404)
+            return self._json(info)
+        return (400, b"bad job request", "text/plain")
+
+    def ping(self):
+        return True
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> str:
+    """Start (or find) the dashboard head actor; returns its http address."""
+    import ray_tpu
+    try:
+        head = ray_tpu.get_actor(DASHBOARD_NAME,
+                                 namespace=DASHBOARD_NAMESPACE)
+    except ValueError:
+        head_cls = ray_tpu.remote(DashboardHead)
+        head = head_cls.options(
+            name=DASHBOARD_NAME, namespace=DASHBOARD_NAMESPACE,
+            lifetime="detached", num_cpus=0, max_concurrency=100,
+            get_if_exists=True).remote(host, port)
+    bound_host, bound_port = ray_tpu.get(head.ready.remote(), timeout=60)
+    return f"http://{bound_host}:{bound_port}"

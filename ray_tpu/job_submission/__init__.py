@@ -1,0 +1,8 @@
+"""Job submission (reference: python/ray/dashboard/modules/job/ —
+JobManager job_manager.py:60, JobSupervisor job_supervisor.py:56, REST
+routes job_head.py; SDK python/ray/job_submission/)."""
+
+from .job_manager import JobManager, JobStatus
+from .client import JobSubmissionClient
+
+__all__ = ["JobManager", "JobStatus", "JobSubmissionClient"]

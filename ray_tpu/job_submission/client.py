@@ -1,0 +1,74 @@
+"""JobSubmissionClient: HTTP SDK against the dashboard REST
+(reference: python/ray/job_submission/job_submission_client.py wrapping
+dashboard/modules/job REST routes)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """address: http://host:port of the dashboard."""
+        self._base = address.rstrip("/")
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None):
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            self._base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(
+                f"{method} {path} -> {e.code}: {e.read().decode()}") from e
+        return json.loads(body) if body else None
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        reply = self._request("POST", "/api/jobs/", {
+            "entrypoint": entrypoint, "submission_id": submission_id,
+            "runtime_env": runtime_env, "metadata": metadata})
+        return reply["submission_id"]
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._request("GET", f"/api/jobs/{submission_id}")["status"]
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/jobs/{submission_id}")
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        return self._request("GET", "/api/jobs/")
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._request("GET",
+                             f"/api/jobs/{submission_id}/logs")["logs"]
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._request("POST",
+                             f"/api/jobs/{submission_id}/stop")["stopped"]
+
+    def tail_job_logs(self, submission_id: str, interval_s: float = 0.5):
+        """Generator yielding new log output until the job finishes."""
+        import time
+        from .job_manager import JobStatus
+        seen = 0
+        while True:
+            logs = self.get_job_logs(submission_id)
+            if len(logs) > seen:
+                yield logs[seen:]
+                seen = len(logs)
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                rest = self.get_job_logs(submission_id)
+                if len(rest) > seen:
+                    yield rest[seen:]
+                return
+            time.sleep(interval_s)

@@ -1,0 +1,187 @@
+"""JobManager + JobSupervisor
+(reference: dashboard/modules/job/job_manager.py:60 — submit/stop/status/
+logs; job_supervisor.py:56 — an actor managing the entrypoint driver
+subprocess).
+
+A submitted job = one detached supervisor actor that runs the entrypoint
+command as a subprocess (a driver: it may ray_tpu.init() against this
+cluster), captures combined output to a log file in the session dir, and
+records status transitions in the GCS KV."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+JOBS_KV_NS = "jobs_api"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+class _JobSupervisor:
+    """Detached actor owning one job's driver subprocess."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 log_path: str, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.log_path = log_path
+        self.env_vars = env_vars or {}
+        self.working_dir = working_dir
+        self._proc = None
+
+    def _put_status(self, status: str, message: str = ""):
+        from .._internal.core_worker import get_core_worker
+        worker = get_core_worker()
+        raw = worker.gcs.get(JOBS_KV_NS, self.submission_id)
+        record = json.loads(raw.decode()) if raw else {}
+        record.update(status=status, message=message,
+                      end_time=time.time()
+                      if status in JobStatus.TERMINAL else None)
+        worker.gcs.put(JOBS_KV_NS, self.submission_id,
+                       json.dumps(record).encode())
+
+    def run(self) -> str:
+        """Blocks until the entrypoint exits; returns the final status."""
+        import subprocess
+        env = dict(os.environ)
+        env.update(self.env_vars)
+        env["RTPU_JOB_SUBMISSION_ID"] = self.submission_id
+        self._put_status(JobStatus.RUNNING)
+        os.makedirs(os.path.dirname(self.log_path), exist_ok=True)
+        with open(self.log_path, "ab") as log:
+            self._proc = subprocess.Popen(
+                self.entrypoint, shell=True, stdout=log,
+                stderr=subprocess.STDOUT, env=env,
+                cwd=self.working_dir or None)
+            rc = self._proc.wait()
+        if rc == 0:
+            self._put_status(JobStatus.SUCCEEDED)
+            return JobStatus.SUCCEEDED
+        if rc < 0:  # killed by signal (stop_job)
+            self._put_status(JobStatus.STOPPED,
+                             f"terminated by signal {-rc}")
+            return JobStatus.STOPPED
+        self._put_status(JobStatus.FAILED, f"entrypoint exited rc={rc}")
+        return JobStatus.FAILED
+
+    def stop(self) -> bool:
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.terminate()
+            return True
+        return False
+
+    def ping(self):
+        return True
+
+
+class JobManager:
+    """Driver/head-side job orchestration; the dashboard REST wraps this."""
+
+    def __init__(self):
+        from .._internal.core_worker import get_core_worker
+        self._worker = get_core_worker()
+
+    def _log_path(self, submission_id: str) -> str:
+        from .._internal import api as api_mod
+        node = api_mod._local_node
+        base = node.session_dir if node is not None else "/tmp/rtpu-jobs"
+        return os.path.join(base, "job-logs", f"{submission_id}.log")
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        import ray_tpu
+        submission_id = submission_id or \
+            f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        if self._worker.gcs.get(JOBS_KV_NS, submission_id) is not None:
+            raise ValueError(f"job {submission_id!r} already exists")
+        runtime_env = runtime_env or {}
+        log_path = self._log_path(submission_id)
+        record = {
+            "submission_id": submission_id, "entrypoint": entrypoint,
+            "status": JobStatus.PENDING, "message": "",
+            "start_time": time.time(), "end_time": None,
+            "metadata": metadata or {}, "log_path": log_path,
+            "runtime_env": {k: v for k, v in runtime_env.items()
+                            if k in ("env_vars", "working_dir")},
+        }
+        self._worker.gcs.put(JOBS_KV_NS, submission_id,
+                             json.dumps(record).encode())
+        supervisor_cls = ray_tpu.remote(_JobSupervisor)
+        supervisor = supervisor_cls.options(
+            name=f"_job_supervisor_{submission_id}", lifetime="detached",
+            namespace="_jobs", num_cpus=0, max_concurrency=4,
+        ).remote(submission_id, entrypoint, log_path,
+                 env_vars=runtime_env.get("env_vars"),
+                 working_dir=runtime_env.get("working_dir"))
+        supervisor.run.remote()  # fire and track via KV status
+        return submission_id
+
+    def get_job_status(self, submission_id: str) -> Optional[str]:
+        info = self.get_job_info(submission_id)
+        return info["status"] if info else None
+
+    def get_job_info(self, submission_id: str) -> Optional[Dict[str, Any]]:
+        raw = self._worker.gcs.get(JOBS_KV_NS, submission_id)
+        return json.loads(raw.decode()) if raw else None
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        jobs = []
+        for key in self._worker.gcs.keys(JOBS_KV_NS, ""):
+            info = self.get_job_info(key)
+            if info:
+                jobs.append(info)
+        jobs.sort(key=lambda j: j.get("start_time") or 0)
+        return jobs
+
+    def get_job_logs(self, submission_id: str,
+                     tail_bytes: Optional[int] = None) -> str:
+        info = self.get_job_info(submission_id)
+        if info is None:
+            raise ValueError(f"no job {submission_id!r}")
+        try:
+            with open(info["log_path"], "rb") as f:
+                if tail_bytes:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - tail_bytes))
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+        info = self.get_job_info(submission_id)
+        if info is None or info["status"] in JobStatus.TERMINAL:
+            return False
+        try:
+            supervisor = ray_tpu.get_actor(
+                f"_job_supervisor_{submission_id}", namespace="_jobs")
+            return ray_tpu.get(supervisor.stop.remote(), timeout=30)
+        except ValueError:
+            return False
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout_s: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {submission_id} not finished "
+                           f"after {timeout_s}s")

@@ -1,0 +1,198 @@
+"""Application + runtime metrics
+(reference: python/ray/util/metrics.py Counter/Gauge/Histogram over the
+C++ stats layer src/ray/stats/metric.h; export via dashboard agent to
+Prometheus).
+
+Design: each process keeps a local registry; a background flusher pushes
+snapshots into the GCS KV under a per-worker key; the dashboard head
+aggregates all snapshots into one Prometheus text exposition at /metrics.
+No OpenCensus/OTel dependency — the exposition format is the interface."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_flusher_started = False
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000]
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        # tag-tuple -> value (Counter/Gauge) or histogram state
+        self._series: Dict[Tuple, Any] = {}
+        with _registry_lock:
+            _registry[name] = self
+        _ensure_flusher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        unknown = set(merged) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {sorted(unknown)} for "
+                             f"metric {self._name} (declared "
+                             f"{self._tag_keys})")
+        return tuple(merged.get(k, "") for k in self._tag_keys)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            series = {",".join(k): v for k, v in self._series.items()}
+        return {"name": self._name, "kind": self.kind,
+                "description": self._description,
+                "tag_keys": list(self._tag_keys), "series": series}
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._key(tags)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            self._series[key] = float(value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = self._key(tags)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = {"buckets": [0] * (len(self._boundaries) + 1),
+                         "sum": 0.0, "count": 0,
+                         "boundaries": self._boundaries}
+                self._series[key] = state
+            for i, bound in enumerate(self._boundaries):
+                if value <= bound:
+                    state["buckets"][i] += 1
+                    break
+            else:
+                state["buckets"][-1] += 1
+            state["sum"] += value
+            state["count"] += 1
+
+
+# ---------------------------------------------------------------------------
+# export plumbing
+# ---------------------------------------------------------------------------
+
+METRICS_KV_NS = "metrics"
+
+
+def _ensure_flusher():
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    t = threading.Thread(target=_flush_loop, daemon=True,
+                         name="rtpu-metrics-flush")
+    t.start()
+
+
+def _flush_loop():
+    import json
+    from .._internal.config import CONFIG
+    while True:
+        time.sleep(CONFIG.metrics_report_interval_s)
+        try:
+            from .._internal.core_worker import try_get_core_worker
+            worker = try_get_core_worker()
+            if worker is None:
+                continue
+            with _registry_lock:
+                metrics = list(_registry.values())
+            payload = json.dumps([m.snapshot() for m in metrics])
+            wid = worker.worker_id.hex() if isinstance(
+                worker.worker_id, bytes) else str(worker.worker_id)
+            worker.gcs.put(METRICS_KV_NS, wid, payload.encode())
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+
+
+def collect_cluster_metrics(gcs) -> List[Dict[str, Any]]:
+    """All processes' snapshots from the GCS KV (dashboard side)."""
+    import json
+    out = []
+    for key in gcs.keys(METRICS_KV_NS, ""):
+        raw = gcs.get(METRICS_KV_NS, key)
+        if raw:
+            try:
+                out.extend(json.loads(raw.decode()))
+            except ValueError:
+                pass
+    return out
+
+
+def prometheus_text(snapshots: List[Dict[str, Any]]) -> str:
+    """Merge snapshots into Prometheus exposition format."""
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for snap in snapshots:
+        by_name.setdefault(snap["name"], []).append(snap)
+    lines = []
+    for name, snaps in sorted(by_name.items()):
+        first = snaps[0]
+        if first["description"]:
+            lines.append(f"# HELP {name} {first['description']}")
+        kind = first["kind"]
+        lines.append(f"# TYPE {name} "
+                     f"{kind if kind != 'histogram' else 'histogram'}")
+        for snap in snaps:
+            keys = snap["tag_keys"]
+            for tag_str, value in snap["series"].items():
+                tags = tag_str.split(",") if keys else []
+                label = ",".join(f'{k}="{v}"' for k, v in zip(keys, tags))
+                label = "{" + label + "}" if label else ""
+                if kind == "histogram":
+                    cum = 0
+                    bounds = value["boundaries"] + ["+Inf"]
+                    for b, n in zip(bounds, value["buckets"]):
+                        cum += n
+                        extra = (label[:-1] + "," if label else "{") + \
+                            f'le="{b}"' + "}"
+                        lines.append(f"{name}_bucket{extra} {cum}")
+                    lines.append(f"{name}_sum{label} {value['sum']}")
+                    lines.append(f"{name}_count{label} {value['count']}")
+                else:
+                    lines.append(f"{name}{label} {value}")
+    return "\n".join(lines) + "\n"

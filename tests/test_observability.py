@@ -1,0 +1,212 @@
+"""Observability tests: metrics registry + Prometheus exposition,
+dashboard REST (state + jobs + metrics endpoints), job submission
+lifecycle incl. stop and logs, CLI status/list against a live head
+(reference coverage: dashboard/modules/job/tests, tests/test_metrics_*,
+util/state tests)."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def obs_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def _post(url, payload, timeout=15):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_metrics_registry_and_prometheus_text():
+    from ray_tpu.util.metrics import (Counter, Gauge, Histogram,
+                                      prometheus_text)
+    c = Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = Gauge("test_inflight", "gauge")
+    g.set(7)
+    h = Histogram("test_latency_s", "hist", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = prometheus_text([c.snapshot(), g.snapshot(), h.snapshot()])
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert 'test_requests_total{route="/b"} 1.0' in text
+    assert "test_inflight 7.0" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1' in text
+    assert 'test_latency_s_bucket{le="+Inf"} 3' in text
+    assert "test_latency_s_count 3" in text
+    with pytest.raises(ValueError):
+        c.inc(tags={"bogus": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+# ---------------------------------------------------------------------------
+# dashboard REST + jobs
+# ---------------------------------------------------------------------------
+
+def test_dashboard_state_and_job_lifecycle(obs_cluster, tmp_path):
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    address = start_dashboard()
+
+    # Run something so state endpoints have content.
+    @ray_tpu.remote
+    def noop():
+        return 1
+    ray_tpu.get([noop.remote() for _ in range(3)])
+
+    status, body = _get(f"{address}/-/healthz")
+    assert body == b"ok"
+    _s, body = _get(f"{address}/api/cluster_status")
+    snap = json.loads(body)
+    assert snap["resources_total"].get("CPU", 0) >= 4
+    _s, body = _get(f"{address}/api/nodes")
+    assert len(json.loads(body)) == 1
+    time.sleep(1.5)  # task event flush
+    _s, body = _get(f"{address}/api/tasks")
+    assert any(t["name"].endswith("noop") for t in json.loads(body))
+    _s, body = _get(f"{address}/metrics")
+    assert b"# TYPE" in body or body == b"\n"  # exposition shape
+
+    # Job submission end to end over HTTP.
+    client = JobSubmissionClient(address)
+    marker = tmp_path / "ran.txt"
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello-from-job'); "
+                   f"open('{marker}','w').write('1')\"")
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if client.get_job_status(job_id) in JobStatus.TERMINAL:
+            break
+        time.sleep(0.25)
+    assert client.get_job_status(job_id) == JobStatus.SUCCEEDED
+    assert marker.exists()
+    assert "hello-from-job" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["submission_id"] == job_id for j in jobs)
+
+
+def test_job_stop_and_failure(obs_cluster):
+    from ray_tpu.job_submission import JobManager, JobStatus
+    manager = JobManager()
+
+    # Failing entrypoint -> FAILED with rc message.
+    fail_id = manager.submit_job(
+        entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    status = manager.wait_until_finished(fail_id, timeout_s=60)
+    assert status == JobStatus.FAILED
+    assert "rc=3" in manager.get_job_info(fail_id)["message"]
+
+    # Long-running entrypoint -> stop() -> STOPPED.
+    stop_id = manager.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if manager.get_job_status(stop_id) == JobStatus.RUNNING:
+            break
+        time.sleep(0.2)
+    assert manager.stop_job(stop_id)
+    status = manager.wait_until_finished(stop_id, timeout_s=60)
+    assert status == JobStatus.STOPPED
+
+
+# ---------------------------------------------------------------------------
+# CLI (in-process invocation against a live head)
+# ---------------------------------------------------------------------------
+
+def test_cli_status_list_timeline(obs_cluster, tmp_path, capsys):
+    from ray_tpu import cli
+
+    @ray_tpu.remote
+    def touch():
+        return "x"
+    ray_tpu.get(touch.remote())
+    time.sleep(1.2)
+
+    class A:
+        address = None
+    cli.cmd_status(A())
+    out = capsys.readouterr().out
+    assert "nodes: 1" in out
+
+    class L:
+        address = None
+        what = "actors"
+        limit = 10
+    cli.cmd_list(L())
+
+    class T:
+        address = None
+        output = str(tmp_path / "trace.json")
+    cli.cmd_timeline(T())
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    assert isinstance(trace, list)
+
+
+def test_cli_head_process_roundtrip(tmp_path):
+    """Real `start --head` subprocess: address file, remote status, stop."""
+    import subprocess
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="")
+    try:
+        os.unlink("/tmp/rtpu/head_address")
+    except FileNotFoundError:
+        pass
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.cli", "start", "--head",
+         "--num-cpus", "2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if os.path.exists("/tmp/rtpu/head_address"):
+                break
+            time.sleep(0.2)
+        assert os.path.exists("/tmp/rtpu/head_address")
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.cli", "status"],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "nodes: 1" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.cli", "submit", "--wait",
+             "--", sys.executable, "-c", "print(40+2)"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "42" in out.stdout
+        assert "SUCCEEDED" in out.stdout
+    finally:
+        head.terminate()
+        try:
+            head.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            head.kill()
+        try:
+            os.unlink("/tmp/rtpu/head_address")
+        except FileNotFoundError:
+            pass
