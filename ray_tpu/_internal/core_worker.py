@@ -1065,6 +1065,9 @@ class TaskExecutor:
             if spec.task_type == ACTOR_TASK \
                     and spec.method_name == "__rtpu_terminate__":
                 return self._graceful_exit(spec)
+            if spec.runtime_env:
+                self._cw.runtime_env_manager.apply(spec.runtime_env,
+                                                   self._cw.gcs)
             packed_args, packed_kwargs = self._load_args(spec)
             if spec.task_type == ACTOR_CREATION_TASK:
                 cls = self._cw.function_manager.load(spec.job_id,
@@ -1179,6 +1182,10 @@ class CoreWorker:
         self.reference_counter = ReferenceCounter(self)
         self.task_events = TaskEventBuffer(self)
         self.task_manager = TaskManager(self)
+        from .runtime_env import RuntimeEnvManager
+        self.runtime_env_manager = RuntimeEnvManager(
+            os.path.join("/tmp", "rtpu", f"session_{session_name}",
+                         "runtime_env"))
         self.submitter = NormalTaskSubmitter(self)
         self.actor_submitter = ActorTaskSubmitter(self)
         self.executor = TaskExecutor(self)

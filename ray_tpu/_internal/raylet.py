@@ -186,12 +186,21 @@ class Raylet:
     # ------------------------------------------------------------------
 
     def _env_key(self, runtime_env: Dict[str, Any]) -> Tuple:
-        return tuple(sorted((runtime_env or {}).get("env_vars", {}).items()))
+        """Workers are dedicated per runtime environment: env vars are
+        process state, and working_dir/py_modules mutate sys.path/cwd —
+        none of these may leak between environments via worker reuse."""
+        env = runtime_env or {}
+        return (
+            tuple(sorted((env.get("env_vars") or {}).items())),
+            env.get("working_dir") or "",
+            tuple(env.get("py_modules") or ()),
+            tuple(env.get("pip") or ()),
+        )
 
     def _spawn_worker(self, env_key: Tuple) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
         env = dict(os.environ)
-        env.update({k: v for k, v in env_key})
+        env.update({k: v for k, v in env_key[0]})  # env_vars component
         # Workers must import ray_tpu even when it isn't installed — put the
         # package's parent dir on their PYTHONPATH.
         pkg_root = os.path.dirname(os.path.dirname(

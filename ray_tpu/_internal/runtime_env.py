@@ -1,0 +1,182 @@
+"""Runtime environments: per-task/actor working_dir, py_modules, env_vars
+(reference: python/ray/_private/runtime_env/ — the agent
+agent/runtime_env_agent.py:165,298 creates envs per URI; working_dir/
+py_modules packaging packaging.py; URI cache uri_cache.py).
+
+Design (agentless): the driver packages local directories into
+content-hashed zips stored in the GCS KV (`gcs://<sha>` URIs — the KV is
+the small-package store, like the reference's GCS-backed packages up to
+100MB); workers extract each URI once into a per-session cache directory
+and prepend it to sys.path (py_modules) or chdir into it (working_dir).
+env_vars are applied at worker spawn via the env-keyed worker pool, so a
+worker process never mixes environments."""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import threading
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+PACKAGE_KV_NS = "runtime_env_packages"
+MAX_PACKAGE_BYTES = 100 * 1024 * 1024
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def package_directory(path: str) -> Tuple[str, bytes]:
+    """Zip a directory deterministically; returns (uri, zip_bytes)."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    buf = io.BytesIO()
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for name in sorted(files):
+            full = os.path.join(root, name)
+            entries.append((os.path.relpath(full, path), full))
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel, full in entries:
+            info = zipfile.ZipInfo(rel)  # fixed date -> stable hash
+            with open(full, "rb") as f:
+                zf.writestr(info, f.read())
+    data = buf.getvalue()
+    if len(data) > MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package {path!r} is {len(data)} bytes "
+            f"(limit {MAX_PACKAGE_BYTES}); exclude large artifacts")
+    digest = hashlib.sha256(data).hexdigest()[:24]
+    return f"gcs://{digest}", data
+
+
+# abspath -> (dir signature, uploaded uri): avoid re-zipping per submission
+_upload_cache: Dict[str, Tuple[Tuple, str]] = {}
+_upload_lock = threading.Lock()
+
+
+def _dir_signature(path: str) -> Tuple:
+    count, newest, total = 0, 0.0, 0
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+        for name in files:
+            try:
+                st = os.stat(os.path.join(root, name))
+            except OSError:
+                continue
+            count += 1
+            newest = max(newest, st.st_mtime)
+            total += st.st_size
+    return (count, newest, total)
+
+
+def upload_packages(runtime_env: Optional[Dict[str, Any]], gcs
+                    ) -> Dict[str, Any]:
+    """Driver-side: replace local paths with content-addressed URIs,
+    uploading each package once (reference: packaging.upload_package_if_
+    needed + uri_cache)."""
+    if not runtime_env:
+        return {}
+    out = dict(runtime_env)
+
+    def upload(path: str) -> str:
+        path = os.path.abspath(path)
+        sig = _dir_signature(path)
+        with _upload_lock:
+            cached = _upload_cache.get(path)
+            if cached is not None and cached[0] == sig:
+                return cached[1]
+        uri, data = package_directory(path)
+        key = uri.split("://", 1)[1]
+        if not gcs.call_sync("kv_exists", ns=PACKAGE_KV_NS, key=key):
+            gcs.put(PACKAGE_KV_NS, key, data)
+        with _upload_lock:
+            _upload_cache[path] = (sig, uri)
+        return uri
+
+    working_dir = out.get("working_dir")
+    if working_dir and not working_dir.startswith("gcs://"):
+        out["working_dir"] = upload(working_dir)
+    modules = out.get("py_modules")
+    if modules:
+        out["py_modules"] = [
+            m if m.startswith("gcs://") else upload(m) for m in modules]
+    pip = out.get("pip")
+    if pip:
+        # Zero-egress environments cannot create venvs; the contract here
+        # is "verify importable, else fail fast" (documented limitation).
+        out["pip"] = list(pip)
+    return out
+
+
+class RuntimeEnvManager:
+    """Worker-side URI cache + activation
+    (reference: uri_cache.py + working_dir/py_modules plugins)."""
+
+    def __init__(self, cache_root: str):
+        self._root = cache_root
+        self._lock = threading.Lock()
+        self._ready: Dict[str, str] = {}  # uri -> extracted dir
+
+    def _fetch_and_extract(self, uri: str, gcs) -> str:
+        with self._lock:
+            path = self._ready.get(uri)
+        if path is not None:
+            return path
+        key = uri.split("://", 1)[1]
+        target = os.path.join(self._root, key)
+        if not os.path.isdir(target):
+            data = gcs.get(PACKAGE_KV_NS, key)
+            if data is None:
+                raise RuntimeError(f"runtime_env package {uri} not found")
+            # The cache dir is shared by every worker process on the node;
+            # stage into a per-process unique dir, then rename — losers of
+            # the race just discard their copy.
+            import shutil
+            import tempfile
+            os.makedirs(self._root, exist_ok=True)
+            tmp = tempfile.mkdtemp(prefix=f".{key}-", dir=self._root)
+            with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                zf.extractall(tmp)
+            try:
+                os.rename(tmp, target)
+            except OSError:
+                if os.path.isdir(target):  # someone else won
+                    shutil.rmtree(tmp, ignore_errors=True)
+                else:
+                    raise
+        with self._lock:
+            self._ready[uri] = target
+        return target
+
+    def apply(self, runtime_env: Dict[str, Any], gcs):
+        """Activate working_dir/py_modules/pip in THIS worker process."""
+        import sys
+        if not runtime_env:
+            return
+        for uri in runtime_env.get("py_modules") or []:
+            path = self._fetch_and_extract(uri, gcs)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+        working_dir = runtime_env.get("working_dir")
+        if working_dir:
+            path = self._fetch_and_extract(working_dir, gcs)
+            if path not in sys.path:
+                sys.path.insert(0, path)
+            os.chdir(path)
+        for req in runtime_env.get("pip") or []:
+            module = req.split("==")[0].split(">=")[0].strip()
+            module = {"pyyaml": "yaml", "pillow": "PIL"}.get(
+                module.lower(), module).replace("-", "_")
+            try:
+                __import__(module)
+            except ImportError as e:
+                raise RuntimeError(
+                    f"runtime_env pip requirement {req!r} is not available "
+                    "in this zero-egress image (packages cannot be "
+                    "installed at runtime; bake them into the image)"
+                ) from e

@@ -110,7 +110,11 @@ class TaskSpec:
 
     def shape_key(self) -> Tuple:
         """Lease reuse key: tasks with the same shape share leased workers
-        (reference: SchedulingKey in normal_task_submitter.h)."""
+        (reference: SchedulingKey in normal_task_submitter.h). Must cover
+        the FULL runtime environment — the raylet dedicates workers per
+        env (_env_key) and lease handoff between different envs would
+        bypass that isolation (stale sys.path/cwd/modules)."""
+        env = self.runtime_env or {}
         return (
             tuple(sorted(self.resources.items())),
             self.scheduling_strategy.kind,
@@ -118,7 +122,10 @@ class TaskSpec:
             self.scheduling_strategy.bundle_index,
             self.scheduling_strategy.node_id,
             tuple(sorted(self.label_selector.items())),
-            tuple(sorted(self.runtime_env.get("env_vars", {}).items())),
+            tuple(sorted((env.get("env_vars") or {}).items())),
+            env.get("working_dir") or "",
+            tuple(env.get("py_modules") or ()),
+            tuple(env.get("pip") or ()),
         )
 
     def dependencies(self) -> List[Tuple[ObjectID, Tuple[str, int]]]:

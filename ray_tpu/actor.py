@@ -17,6 +17,7 @@ from ._internal.core_worker import get_core_worker
 from ._internal.ids import ActorID, TaskID
 from ._internal.options import (normalize_strategy, resources_from_options,
                                 validate_options)
+from ._internal.runtime_env import upload_packages
 from ._internal.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
                                   FunctionDescriptor, TaskSpec)
 from .remote_function import pack_args
@@ -169,7 +170,8 @@ class ActorClass:
             name=opts.get("name") or self._cls.__name__,
             scheduling_strategy=normalize_strategy(
                 opts.get("scheduling_strategy")),
-            runtime_env=opts.get("runtime_env") or {},
+            runtime_env=upload_packages(opts.get("runtime_env"),
+                                        worker.gcs),
             label_selector=opts.get("label_selector") or {},
             actor_id=actor_id,
             max_restarts=max_restarts,
@@ -198,3 +200,4 @@ def get_actor(name: str, namespace: str = "") -> ActorHandle:
         raise ValueError(f"actor {name!r} not found in namespace "
                          f"{namespace!r}")
     return ActorHandle(info["actor_id"], info.get("class_name", ""), {})
+

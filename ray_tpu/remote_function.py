@@ -22,6 +22,7 @@ from ._internal.ids import TaskID
 from ._internal.object_ref import ObjectRef
 from ._internal.options import (normalize_strategy, resources_from_options,
                                 validate_options)
+from ._internal.runtime_env import upload_packages
 from ._internal.task_spec import (NORMAL_TASK, TaskArg, TaskSpec, _CallBundle,
                                   _RefPlaceholder)
 
@@ -91,7 +92,8 @@ class RemoteFunction:
                 opts.get("scheduling_strategy")),
             max_retries=max_retries,
             retry_exceptions=opts.get("retry_exceptions", False),
-            runtime_env=opts.get("runtime_env") or {},
+            runtime_env=upload_packages(opts.get("runtime_env"),
+                                        worker.gcs),
             label_selector=opts.get("label_selector") or {},
             enable_task_events=opts.get("enable_task_events", True),
         )
@@ -106,3 +108,4 @@ class RemoteFunction:
         if num_returns == 1:
             return refs[0]
         return refs
+
