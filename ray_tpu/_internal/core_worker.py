@@ -1077,8 +1077,14 @@ class TaskExecutor:
                 self._actor_id = spec.actor_id
                 return {"returns": []}
             if spec.task_type == ACTOR_TASK:
-                method = getattr(self._actor_instance, spec.method_name)
-                result = method(*packed_args, **packed_kwargs)
+                if spec.method_name == "__rtpu_dag_exec__":
+                    # Compiled-graph exec loop: pin this actor into its
+                    # channel-driven schedule (reference: do_exec_tasks).
+                    from ..dag.worker_loop import exec_loop
+                    result = exec_loop(self._actor_instance, *packed_args)
+                else:
+                    method = getattr(self._actor_instance, spec.method_name)
+                    result = method(*packed_args, **packed_kwargs)
             else:
                 func = self._cw.function_manager.load(spec.job_id,
                                                       spec.function)

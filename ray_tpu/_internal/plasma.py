@@ -40,6 +40,12 @@ def shm_root() -> str:
     return "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
 
 
+# Objects up to this size go through the C++ arena (one lock + memcpy, no
+# syscalls); larger ones are individual files (mmap views, spillable).
+ARENA_OBJECT_LIMIT = 256 * 1024
+ARENA_CAPACITY = 256 * 1024 * 1024
+
+
 class PlasmaDir:
     """Mechanical access to one node's object directory in shm."""
 
@@ -49,6 +55,49 @@ class PlasmaDir:
         self._lock = threading.Lock()
         # Keep created-but-unsealed mmaps so the producer can write then seal.
         self._creating: Dict[ObjectID, mmap.mmap] = {}
+        self._arena = self._attach_arena()
+
+    def _attach_arena(self):
+        """Shared C++ arena for small objects (reference: the plasma
+        dlmalloc arena, N9). First process to win the lock file
+        initializes; everyone else attaches. Failure -> files only."""
+        try:
+            from .._native.shm_store import ArenaStore
+        except Exception:  # noqa: BLE001 — optional native path
+            return None
+        arena_path = os.path.join(self.path, "arena")
+        try:
+            try:
+                fd = os.open(arena_path + ".lock",
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                creator = True
+            except FileExistsError:
+                creator = False
+            if creator:
+                # Build fully at a private path, then publish atomically:
+                # attachers must never observe a zero-length/uninitialized
+                # segment (mmap of an empty file raises and would silently
+                # degrade that process to files-only, splitting the node's
+                # view of small objects).
+                tmp = arena_path + f".init-{os.getpid()}"
+                store = ArenaStore(tmp, ARENA_CAPACITY, create=True)
+                os.rename(tmp, arena_path)
+                store.path = arena_path
+                return store
+            import time
+            deadline = time.monotonic() + 120  # creator may be compiling
+            while not os.path.exists(arena_path):
+                if time.monotonic() > deadline:
+                    return None
+                time.sleep(0.01)
+            return ArenaStore(arena_path, 0, create=False)
+        except Exception:  # noqa: BLE001 — toolchain/init failure
+            return None
+
+    def _akey(self, object_id: ObjectID) -> bytes:
+        import hashlib
+        return hashlib.sha1(object_id.binary()).digest()
 
     def _file(self, object_id: ObjectID) -> str:
         return os.path.join(self.path, object_id.hex())
@@ -86,6 +135,28 @@ class PlasmaDir:
         on first touch): the kernel streams into the page cache at memory
         bandwidth. Readers still mmap the sealed file for zero-copy views.
         """
+        total_bytes = obj.total_bytes()
+        if self._arena is not None and total_bytes <= ARENA_OBJECT_LIMIT:
+            from .._native.shm_store import ArenaStoreError
+            key = self._akey(object_id)
+            try:
+                buf = self._arena.create(key, total_bytes)
+            except ArenaStoreError:
+                buf = None  # full/exists: fall through to the file path
+            if buf is not None:
+                try:
+                    obj.write_into(buf)
+                    buf.release()
+                    self._arena.seal(key)
+                except BaseException:
+                    # Never leak an unsealed (unevictable) entry.
+                    try:
+                        buf.release()
+                    except Exception:  # noqa: BLE001 — already released
+                        pass
+                    self._arena.delete(key)
+                    raise
+                return total_bytes
         import struct as _struct
         path = self._file(object_id) + ".tmp"
         fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
@@ -139,14 +210,35 @@ class PlasmaDir:
     # -- reader path ------------------------------------------------------
 
     def contains(self, object_id: ObjectID) -> bool:
-        return os.path.exists(self._file(object_id))
+        if os.path.exists(self._file(object_id)):
+            return True
+        return self._arena is not None and \
+            self._arena.contains(self._akey(object_id))
+
+    def _arena_read(self, object_id: ObjectID) -> Optional[bytes]:
+        """Copy a small object out of the arena (and unpin). Small objects
+        are copied rather than viewed so the pin can be dropped
+        immediately — zero-copy stays the contract for large (file)
+        objects only."""
+        if self._arena is None:
+            return None
+        key = self._akey(object_id)
+        view = self._arena.get(key)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            view.release()
+            self._arena.release(key)
 
     def map_read(self, object_id: ObjectID) -> Optional[memoryview]:
         """Zero-copy read-only view; None if absent."""
         try:
             fd = os.open(self._file(object_id), os.O_RDONLY)
         except FileNotFoundError:
-            return None
+            data = self._arena_read(object_id)
+            return memoryview(data) if data is not None else None
         try:
             size = os.fstat(fd).st_size
             m = mmap.mmap(fd, size, prot=mmap.PROT_READ)
@@ -181,20 +273,39 @@ class PlasmaDir:
         try:
             os.unlink(self._file(object_id))
         except FileNotFoundError:
-            pass
+            if self._arena is not None:
+                self._arena.delete(self._akey(object_id))
 
     def size_of(self, object_id: ObjectID) -> int:
-        return os.path.getsize(self._file(object_id))
+        try:
+            return os.path.getsize(self._file(object_id))
+        except FileNotFoundError:
+            data = self._arena_read(object_id)
+            if data is None:
+                raise
+            return len(data)
 
     def spill_to(self, object_id: ObjectID, spill_dir: str) -> str:
         """Move object to disk; returns the spilled path."""
         os.makedirs(spill_dir, exist_ok=True)
         dest = os.path.join(spill_dir, object_id.hex())
-        shutil.move(self._file(object_id), dest)
+        file_path = self._file(object_id)
+        if os.path.exists(file_path):
+            shutil.move(file_path, dest)
+        else:
+            data = self._arena_read(object_id)
+            if data is None:
+                raise FileNotFoundError(file_path)
+            with open(dest, "wb") as f:
+                f.write(data)
+            self._arena.delete(self._akey(object_id))
         return dest
 
     def restore_from(self, object_id: ObjectID, spilled_path: str):
         shutil.move(spilled_path, self._file(object_id))
 
     def destroy(self):
+        if self._arena is not None:
+            self._arena.close()
+            self._arena = None
         shutil.rmtree(self.path, ignore_errors=True)

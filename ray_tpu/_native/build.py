@@ -1,0 +1,54 @@
+"""Build-on-first-use for the C++ native components.
+
+The image bakes g++ but not pybind11, so native code exposes a C ABI and
+Python binds with ctypes. The shared library is compiled once per source
+hash into a cache dir; concurrent builders race benignly via a unique tmp
+name + rename."""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src")
+_CACHE_DIR = os.environ.get(
+    "RTPU_NATIVE_CACHE", os.path.expanduser("~/.cache/rtpu-native"))
+
+
+def build_library(name: str) -> Optional[str]:
+    """Compile src/<name>.cpp into a cached .so; returns its path or None
+    if the toolchain is unavailable/failing (callers fall back to the
+    pure-Python path)."""
+    src = os.path.join(_SRC_DIR, f"{name}.cpp")
+    try:
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    except OSError:
+        return None
+    out = os.path.join(_CACHE_DIR, f"{name}-{digest}.so")
+    if os.path.exists(out):
+        return out
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    tmp = tempfile.mktemp(prefix=f"{name}-", suffix=".so",
+                          dir=_CACHE_DIR)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           src, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable (%s); using python "
+                       "fallback", e)
+        return None
+    if proc.returncode != 0:
+        logger.warning("native build of %s failed:\n%s", name,
+                       proc.stderr.decode()[-2000:])
+        return None
+    os.replace(tmp, out)
+    return out

@@ -1,0 +1,154 @@
+"""ctypes binding for the C++ shared-memory object store core
+(src/shm_store.cpp — the TPU-native equivalent of the reference's plasma
+allocator/dlmalloc + object tables + LRU eviction, N9 in SURVEY §2a).
+
+One arena file per node in /dev/shm; every process maps the same file, so
+offsets returned by the C side are valid views in all of them. Object ids
+are the 20-byte ObjectID digests."""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import Optional, Tuple
+
+from .build import build_library
+
+
+class ArenaStoreError(Exception):
+    pass
+
+
+class ArenaFullError(ArenaStoreError):
+    pass
+
+
+_lib = None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = build_library("shm_store")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.store_init.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.store_init.restype = ctypes.c_int
+    lib.store_is_initialized.argtypes = [ctypes.c_void_p]
+    lib.store_is_initialized.restype = ctypes.c_int
+    lib.store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint64, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_int)]
+    lib.store_create.restype = ctypes.c_uint64
+    lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.store_seal.restype = ctypes.c_int
+    lib.store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.POINTER(ctypes.c_uint64)]
+    lib.store_get.restype = ctypes.c_uint64
+    for fn in ("store_release", "store_delete", "store_contains"):
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        getattr(lib, fn).restype = ctypes.c_int
+    lib.store_used_bytes.argtypes = [ctypes.c_void_p]
+    lib.store_used_bytes.restype = ctypes.c_uint64
+    lib.store_capacity.argtypes = [ctypes.c_void_p]
+    lib.store_capacity.restype = ctypes.c_uint64
+    _lib = lib
+    return lib
+
+
+class ArenaStore:
+    """One node-wide arena segment, shared by all local processes."""
+
+    def __init__(self, path: str, capacity: int, create: bool):
+        lib = load()
+        if lib is None:
+            raise ArenaStoreError("native library unavailable")
+        self._lib = lib
+        self.path = path
+        total = capacity
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+            os.ftruncate(fd, total)
+        else:
+            fd = os.open(path, os.O_RDWR)
+            total = os.fstat(fd).st_size
+        try:
+            self._mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        # Hold the buffer export for the map's lifetime: it pins the mmap
+        # (close() raises BufferError while exported), so a concurrent
+        # store_* call can never dereference an unmapped segment.
+        self._keepalive = ctypes.c_char.from_buffer(self._mm)
+        self._addr = ctypes.addressof(self._keepalive)
+        if create and not lib.store_is_initialized(self._addr):
+            rc = lib.store_init(self._addr, total)
+            if rc != 0:
+                raise ArenaStoreError(f"store_init rc={rc}")
+        else:
+            # Attacher: wait for the creator's init publication.
+            import time
+            deadline = time.monotonic() + 10
+            while not lib.store_is_initialized(self._addr):
+                if time.monotonic() > deadline:
+                    raise ArenaStoreError("segment never initialized")
+                time.sleep(0.005)
+
+    # -- producer ----------------------------------------------------------
+
+    def create(self, object_id: bytes, size: int,
+               allow_evict: bool = False) -> memoryview:
+        err = ctypes.c_int(0)
+        off = self._lib.store_create(self._addr, object_id, size,
+                                     1 if allow_evict else 0,
+                                     ctypes.byref(err))
+        if off == 0:
+            if err.value == 1:
+                raise ArenaStoreError("object already exists")
+            if err.value == 2:
+                raise ArenaFullError(
+                    f"arena full ({self.used_bytes()}/{self.capacity()})")
+            raise ArenaStoreError(f"create failed err={err.value}")
+        return memoryview(self._mm)[off:off + size]
+
+    def seal(self, object_id: bytes):
+        rc = self._lib.store_seal(self._addr, object_id)
+        if rc != 0:
+            raise ArenaStoreError(f"seal rc={rc}")
+
+    # -- consumer ----------------------------------------------------------
+
+    def get(self, object_id: bytes) -> Optional[memoryview]:
+        """Pinned zero-copy view; call release(id) when done."""
+        size = ctypes.c_uint64(0)
+        off = self._lib.store_get(self._addr, object_id,
+                                  ctypes.byref(size))
+        if off == 0:
+            return None
+        return memoryview(self._mm)[off:off + size.value]
+
+    def release(self, object_id: bytes):
+        self._lib.store_release(self._addr, object_id)
+
+    def delete(self, object_id: bytes) -> bool:
+        return self._lib.store_delete(self._addr, object_id) == 0
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.store_contains(self._addr, object_id))
+
+    def used_bytes(self) -> int:
+        return self._lib.store_used_bytes(self._addr)
+
+    def capacity(self) -> int:
+        return self._lib.store_capacity(self._addr)
+
+    def close(self):
+        try:
+            del self._keepalive
+            del self._addr
+            self._mm.close()
+        except (BufferError, AttributeError):
+            pass  # exported views keep the map alive

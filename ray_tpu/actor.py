@@ -48,6 +48,11 @@ class ActorMethod:
         return self._handle._submit_method(
             self._method_name, args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Build a compiled-graph node (reference: dag method binding)."""
+        from .dag.nodes import bind as _bind
+        return _bind(self, *args, **kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._method_name} cannot be called directly; "
@@ -121,6 +126,7 @@ class ActorClass:
         self._options = dict(options or {})
         validate_options(self._options, for_actor=True)
         self._descriptor = None
+        self._descriptor_owner = None
 
     def options(self, **new_options) -> "ActorClass":
         merged = dict(self._options)
@@ -148,9 +154,13 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         worker = get_core_worker()
         job_id = worker.current_job_id()
-        if self._descriptor is None:
+        # The export cache must be per core-worker: a module-level actor
+        # class outlives ray_tpu.shutdown()/init() cycles, and a stale
+        # descriptor points at a previous cluster's function registry.
+        if self._descriptor is None or self._descriptor_owner is not worker:
             self._descriptor = worker.function_manager.export(
                 job_id, self._cls)
+            self._descriptor_owner = worker
         opts = self._options
         actor_id = ActorID.of(job_id)
         lifetime = opts.get("lifetime")

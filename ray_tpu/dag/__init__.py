@@ -1,0 +1,9 @@
+"""ray_tpu.dag — compiled actor dataflow graphs
+(reference: python/ray/dag/ — DAGNode bind API, CompiledDAG
+compiled_dag_node.py:805, per-actor exec loops :186/:1863, driver
+execute :2546)."""
+
+from .compiled_dag import CompiledDAG
+from .nodes import InputNode, MultiOutputNode
+
+__all__ = ["CompiledDAG", "InputNode", "MultiOutputNode"]

@@ -1,0 +1,228 @@
+"""CompiledDAG: freeze a bound graph into channel-connected exec loops
+(reference: dag/compiled_dag_node.py:805 CompiledDAG — channel allocation,
+per-actor schedules, exec-loop installation :1863, driver execute :2546 /
+teardown).
+
+Why compiled graphs exist: per-call actor RPC costs ~1ms through the
+control plane. A fixed dataflow topology (e.g. a pipelined inference
+graph between device-holding actors) pays channel hops instead —
+microseconds over shared memory, no per-step scheduling. This is the
+control-plane analog of the reference's accelerator channels: device data
+stays put inside each actor; only (small) values cross."""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Tuple
+
+from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
+
+
+class CompiledDAG:
+    def __init__(self, output_node: DAGNode,
+                 channel_capacity: int = 8 * 1024 * 1024,
+                 timeout_s: float = 60.0):
+        self._dag_id = uuid.uuid4().hex[:10]
+        self._capacity = channel_capacity
+        self._timeout = timeout_s
+        self._torn_down = False
+
+        if isinstance(output_node, MultiOutputNode):
+            self._final_nodes = list(output_node.outputs)
+        else:
+            self._final_nodes = [output_node]
+        for node in self._final_nodes:
+            if not isinstance(node, ClassMethodNode):
+                raise TypeError("DAG outputs must be bound actor methods")
+
+        self._order = self._toposort()
+        self._compile()
+
+    # -- graph analysis ----------------------------------------------------
+
+    def _toposort(self) -> List[ClassMethodNode]:
+        order: List[ClassMethodNode] = []
+        seen = set()
+
+        def visit(node: DAGNode):
+            if id(node) in seen or not isinstance(node, ClassMethodNode):
+                return
+            seen.add(id(node))
+            for up in node.upstream_nodes():
+                visit(up)
+            order.append(node)
+
+        for node in self._final_nodes:
+            visit(node)
+        return order
+
+    def _chan_path(self, edge: str) -> str:
+        root = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+        return os.path.join(root, f"rtpu-dag-{self._dag_id}-{edge}")
+
+    def _compile(self):
+        from ..experimental.channel import SharedMemoryChannel
+        import ray_tpu
+        from .._internal.core_worker import get_core_worker
+
+        # Channels are files in this node's /dev/shm: every participating
+        # actor must be co-located with the driver (cross-node compiled
+        # graphs would need an RPC/DCN channel type — not yet built).
+        worker = get_core_worker()
+        gcs = worker.gcs
+        for node in self._order:
+            deadline = time.monotonic() + 60
+            while True:
+                info = gcs.call_sync("get_actor_info",
+                                     actor_id=node.actor.actor_id)
+                if info is not None and info["state"] == "ALIVE":
+                    break
+                if info is not None and info["state"] == "DEAD" or \
+                        time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"actor for {node.method_name} is not alive")
+                time.sleep(0.05)
+            actor_host = (info.get("address") or (None, None))[0]
+            if actor_host and actor_host != worker.rpc_address[0]:
+                raise NotImplementedError(
+                    "compiled DAGs currently require all actors on the "
+                    "driver's host (shared-memory channels); actor "
+                    f"{node.method_name} is on {actor_host}")
+
+        node_index = {id(n): i for i, n in enumerate(self._order)}
+        # Edges: producer node -> consumer arg slots; input -> consumers.
+        self._input_paths: List[str] = []       # driver writes these
+        self._output_paths: List[str] = []      # driver reads these
+        out_edges: Dict[int, List[str]] = {i: [] for i in
+                                           range(len(self._order))}
+        arg_sources: Dict[int, List[Tuple[str, Any]]] = {}
+        kwarg_sources: Dict[int, Dict[str, Tuple[str, Any]]] = {}
+        created: List[SharedMemoryChannel] = []
+
+        def make_channel(edge: str) -> str:
+            path = self._chan_path(edge)
+            created.append(SharedMemoryChannel(
+                path, capacity=self._capacity, create=True))
+            return path
+
+        for i, node in enumerate(self._order):
+            sources = []
+            for j, arg in enumerate(node.args):
+                sources.append(self._source_for(arg, i, j, node_index,
+                                                out_edges, make_channel))
+            arg_sources[i] = sources
+            ksources = {}
+            for name, value in node.kwargs.items():
+                ksources[name] = self._source_for(
+                    value, i, f"k{name}", node_index, out_edges,
+                    make_channel)
+            kwarg_sources[i] = ksources
+
+        for node in self._final_nodes:
+            i = node_index[id(node)]
+            path = make_channel(f"out-{i}")
+            out_edges[i].append(path)
+            self._output_paths.append(path)
+
+        self._channels = created
+
+        # Group steps per actor, preserving topological order.
+        per_actor: Dict[bytes, Tuple[Any, List[Dict[str, Any]]]] = {}
+        actor_local_index: Dict[Tuple[bytes, int], int] = {}
+        for i, node in enumerate(self._order):
+            key = node.actor.actor_id
+            if key not in per_actor:
+                per_actor[key] = (node.actor, [])
+            _, steps = per_actor[key]
+            # Rewrite ("node", producer_idx) into local/channel sources.
+            def resolve(src):
+                kind, value = src
+                if kind != "node":
+                    return src
+                producer = value
+                if self._order[producer].actor.actor_id == key:
+                    return ("local", actor_local_index[(key, producer)])
+                # Cross-actor edge: a dedicated channel.
+                path = make_channel(f"e{producer}-{i}-{len(created)}")
+                out_edges[producer].append(path)
+                return ("chan", path)
+            steps.append({
+                "method": node.method_name,
+                "args": [resolve(s) for s in arg_sources[i]],
+                "kwargs": {k: resolve(s)
+                           for k, s in kwarg_sources[i].items()},
+                "outs": out_edges[i],  # shared list: filled as edges added
+                "_index": i,
+            })
+            actor_local_index[(key, i)] = len(steps) - 1
+
+        # Out-edge lists were mutated after step construction; materialize.
+        for _actor, steps in per_actor.values():
+            for step in steps:
+                step["outs"] = list(out_edges[step.pop("_index")])
+
+        self._loop_refs = []
+        self._actors = []
+        for actor, steps in per_actor.values():
+            self._actors.append(actor)
+            ref = actor._submit_method("__rtpu_dag_exec__",
+                                       (steps, self._timeout), {}, {})
+            self._loop_refs.append(ref)
+
+    def _source_for(self, arg, consumer_idx, slot, node_index, out_edges,
+                    make_channel):
+        if isinstance(arg, InputNode):
+            path = make_channel(f"in-{consumer_idx}-{slot}")
+            self._input_paths.append(path)
+            return ("chan", path)
+        if isinstance(arg, ClassMethodNode):
+            return ("node", node_index[id(arg)])
+        if isinstance(arg, DAGNode):
+            raise TypeError(f"unsupported DAG node {type(arg).__name__}")
+        return ("const", arg)
+
+    # -- driver API --------------------------------------------------------
+
+    def execute(self, *input_value) -> Any:
+        """One synchronous step: feed the input, return the output(s)."""
+        if self._torn_down:
+            raise RuntimeError("DAG has been torn down")
+        value = input_value[0] if len(input_value) == 1 else input_value
+        for path in self._input_paths:
+            self._chan_by_path(path).put(value, timeout=self._timeout)
+        outs = [self._chan_by_path(p).get(timeout=self._timeout)
+                for p in self._output_paths]
+        from ..experimental.channel import DagTaskError
+        for out in outs:
+            if isinstance(out, DagTaskError):
+                raise out
+        return outs if len(outs) > 1 else outs[0]
+
+    def _chan_by_path(self, path: str):
+        for ch in self._channels:
+            if ch.path == path:
+                return ch
+        raise KeyError(path)
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import ray_tpu
+        for ch in self._channels:
+            ch.close()
+        # Loops observe the close sentinel and return their iteration count.
+        try:
+            ray_tpu.get(self._loop_refs, timeout=30)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+        for ch in self._channels:
+            ch.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001
+            pass

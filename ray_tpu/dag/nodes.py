@@ -1,0 +1,55 @@
+"""DAG node API: `.bind()` builds the graph, `experimental_compile()`
+freezes it (reference: dag/dag_node.py DAGNode + class_node/method
+binding; InputNode input_node.py; MultiOutputNode output_node.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class DAGNode:
+    def __init__(self):
+        self._downstream: List["DAGNode"] = []
+
+    def experimental_compile(self, **kwargs):
+        from .compiled_dag import CompiledDAG
+        return CompiledDAG(self, **kwargs)
+
+
+class InputNode(DAGNode):
+    """The driver-provided input (context-manager form mirrors the
+    reference: `with InputNode() as inp: ...`)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method invocation in the graph."""
+
+    def __init__(self, actor, method_name: str, args: tuple,
+                 kwargs: dict):
+        super().__init__()
+        self.actor = actor
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+    def upstream_nodes(self) -> List[DAGNode]:
+        return [a for a in self.args if isinstance(a, DAGNode)] + \
+            [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__()
+        self.outputs = list(outputs)
+
+
+def bind(actor_method, *args, **kwargs) -> ClassMethodNode:
+    """actor.method.bind(...) — attached to ActorMethod."""
+    handle = actor_method._handle
+    return ClassMethodNode(handle, actor_method._method_name, args, kwargs)

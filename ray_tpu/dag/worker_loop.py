@@ -1,0 +1,82 @@
+"""Actor-side compiled-DAG execution loop
+(reference: dag/compiled_dag_node.py do_exec_tasks :186 — the actor is
+pinned into a loop that reads input channels, runs its bound methods, and
+writes output channels until torn down)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+from ..experimental.channel import (ChannelClosedError, DagTaskError,
+                                    SharedMemoryChannel)
+
+logger = logging.getLogger(__name__)
+
+
+def exec_loop(instance: Any, plan: List[Dict[str, Any]],
+              timeout_s: float) -> int:
+    """Run this actor's steps until any channel closes.
+
+    plan: topologically ordered steps:
+      {"method": str,
+       "args": [("const", value) | ("chan", path) | ("local", step_idx)],
+       "kwargs": {name: same-source-tuples},
+       "outs": [channel paths]}
+    Channels are opened lazily here (the compiler creates the files).
+    """
+    channels: Dict[str, SharedMemoryChannel] = {}
+
+    def chan(path: str) -> SharedMemoryChannel:
+        ch = channels.get(path)
+        if ch is None:
+            ch = SharedMemoryChannel(path, create=False)
+            channels[path] = ch
+        return ch
+
+    iterations = 0
+    try:
+        while True:
+            local_results: List[Any] = []
+            for step in plan:
+                args = []
+                for kind, value in step["args"]:
+                    if kind == "const":
+                        args.append(value)
+                    elif kind == "chan":
+                        args.append(chan(value).get(timeout=timeout_s))
+                    else:
+                        args.append(local_results[value])
+                kwargs = {}
+                for name, (kind, value) in step["kwargs"].items():
+                    if kind == "const":
+                        kwargs[name] = value
+                    elif kind == "chan":
+                        kwargs[name] = chan(value).get(timeout=timeout_s)
+                    else:
+                        kwargs[name] = local_results[value]
+                poison = next(
+                    (a for a in [*args, *kwargs.values()]
+                     if isinstance(a, DagTaskError)), None)
+                if poison is not None:
+                    out = poison  # forward upstream failure unexecuted
+                else:
+                    try:
+                        out = getattr(instance, step["method"])(
+                            *args, **kwargs)
+                    except Exception:  # noqa: BLE001 — to the driver
+                        import traceback
+                        out = DagTaskError(step["method"],
+                                           traceback.format_exc())
+                local_results.append(out)
+                for path in step["outs"]:
+                    chan(path).put(out, timeout=timeout_s)
+            iterations += 1
+    except ChannelClosedError:
+        return iterations
+    finally:
+        for ch in channels.values():
+            try:
+                ch.close()
+            except Exception:  # noqa: BLE001
+                pass

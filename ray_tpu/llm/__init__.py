@@ -1,0 +1,15 @@
+"""ray_tpu.llm — native TPU LLM serving
+(reference: python/ray/llm — serve deployments wrapping vLLM
+llm/_internal/serve/deployments/llm/vllm/; builders
+serve/llm/__init__.py:92 build_llm_deployment / :168 build_openai_app).
+
+The reference delegates the engine to vLLM (CUDA); no such engine exists
+for TPU, so this package IS the engine (SURVEY §7 step 8): a
+continuous-batching decode loop over slot-structured KV caches, jitted
+once per shape bucket, deployed behind ray_tpu.serve."""
+
+from .engine import EngineConfig, GenerationRequest, LLMEngine
+from .serving import build_llm_deployment
+
+__all__ = ["EngineConfig", "GenerationRequest", "LLMEngine",
+           "build_llm_deployment"]

@@ -1,0 +1,278 @@
+"""Continuous-batching LLM engine
+(the TPU-native replacement for the reference's vLLM delegation,
+vllm_models.py — scheduling/continuous batching live HERE, not in an
+external engine; conceptually the Orca/vLLM iteration-level scheduler).
+
+Design for the MXU/XLA:
+- KV caches are slot-structured: [max_batch, kv_heads, max_len, head_dim]
+  per layer. A request occupies one slot from admission to completion.
+- ONE jitted decode step serves every active slot together: q_len-1
+  forward with per-slot positions (per-row one-hot cache writes), then
+  greedy/temperature sampling — a single compiled program per engine.
+- Prefill is jitted per power-of-two length bucket (static shapes — no
+  recompiles per prompt) on a batch-1 slice, then the slot's rows are
+  scattered into the big cache with `dynamic_update_slice`.
+- Inactive slots still flow through the decode matmuls (masked out after)
+  — wasted FLOPs are cheaper than dynamic shapes on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: LlamaConfig
+    max_batch: int = 4
+    max_len: int = 512
+    prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256)
+    temperature: float = 0.0  # 0 = greedy
+    eos_token: Optional[int] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class GenerationRequest:
+    prompt_tokens: List[int]
+    max_new_tokens: int = 32
+    request_id: str = ""
+    temperature: Optional[float] = None
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: Optional[GenerationRequest] = None
+    position: int = 0            # next cache write index
+    generated: List[int] = dataclasses.field(default_factory=list)
+    last_token: int = 0
+    done_callback: Optional[Callable] = None
+
+
+class LLMEngine:
+    def __init__(self, config: EngineConfig, params: Optional[Any] = None,
+                 mesh=None):
+        self.config = config
+        self.model = LlamaModel(config.model)
+        self.mesh = mesh
+        rng = jax.random.PRNGKey(config.seed)
+        if params is None:
+            from ..parallel.mesh import unbox
+            sample = jnp.zeros((1, 8), jnp.int32)
+            params = unbox(self.model.init(rng, sample)["params"])
+        self.params = params
+        self._rng = rng
+        B, L = config.max_batch, config.max_len
+        self.kv_caches = init_kv_caches(config.model, B, L)
+        self.slots: List[_Slot] = [_Slot() for _ in range(B)]
+        self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._steps = 0
+        self._tokens_generated = 0
+
+        # -- jitted programs ----------------------------------------------
+        model = self.model
+
+        def decode_step(params, caches, tokens, positions, rng,
+                        temperature):
+            # tokens [B,1]; positions [B]; temperature [B] (per slot —
+            # requests with different sampling settings share one batch).
+            logits, new_caches = model.apply(
+                {"params": params}, tokens, positions=positions[:, None],
+                kv_caches=caches, cache_index=positions)
+            last = logits[:, -1, :].astype(jnp.float32)
+            greedy = jnp.argmax(last, axis=-1)
+            sampled = jax.random.categorical(
+                rng, last / jnp.maximum(temperature, 1e-6)[:, None])
+            out = jnp.where(temperature > 0, sampled, greedy)
+            return out.astype(jnp.int32), new_caches
+
+        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+
+        def prefill(params, tokens, positions):
+            # Single sequence [1, bucket]; fresh caches for the bucket.
+            caches = init_kv_caches(config.model, 1, L)
+            logits, new_caches = model.apply(
+                {"params": params}, tokens, positions=positions,
+                kv_caches=caches, cache_index=0)
+            return logits.astype(jnp.float32), new_caches
+
+        self._prefill = jax.jit(prefill)
+
+        def write_slot(caches, slot_caches, slot_index):
+            out = []
+            for (ck, cv), (sk, sv) in zip(caches, slot_caches):
+                ck = jax.lax.dynamic_update_slice(
+                    ck, sk.astype(ck.dtype), (slot_index, 0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cv, sv.astype(cv.dtype), (slot_index, 0, 0, 0))
+                out.append((ck, cv))
+            return out
+
+        self._write_slot = jax.jit(write_slot, donate_argnums=(0,))
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, request: GenerationRequest,
+               done_callback: Optional[Callable] = None):
+        n = len(request.prompt_tokens)
+        if n >= self.config.max_len:
+            raise ValueError("prompt longer than max_len")
+        if n > self.config.prefill_buckets[-1]:
+            raise ValueError(
+                f"prompt of {n} tokens exceeds the largest prefill bucket "
+                f"{self.config.prefill_buckets[-1]}")
+        request._done_callback = done_callback  # type: ignore[attr-defined]
+        self._pending.put(request)
+
+    def has_work(self) -> bool:
+        return (not self._pending.empty()) or \
+            any(s.request is not None for s in self.slots)
+
+    # -- the scheduler tick ------------------------------------------------
+
+    def step(self) -> List[Tuple[GenerationRequest, List[int]]]:
+        """One iteration: admit waiting requests into free slots
+        (prefill), then one batched decode step; returns newly finished
+        (request, tokens) pairs."""
+        self._admit()
+        finished = []
+        active = [i for i, s in enumerate(self.slots)
+                  if s.request is not None]
+        if active:
+            finished.extend(self._decode_tick(active))
+        self._steps += 1
+        return finished
+
+    def _admit(self):
+        for index, slot in enumerate(self.slots):
+            if slot.request is not None:
+                continue
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                self._prefill_into(index, request)
+            except Exception as e:  # noqa: BLE001 — per-request failure
+                # A bad request must neither kill the engine loop nor
+                # strand its submitter: deliver the error via the
+                # callback (tokens slot carries the exception).
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, e)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt of {n} tokens exceeds the largest "
+                         f"prefill bucket {self.config.prefill_buckets[-1]}")
+
+    def _prefill_into(self, index: int, request: GenerationRequest):
+        prompt = request.prompt_tokens
+        bucket = self._bucket(len(prompt))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        positions = np.arange(bucket, dtype=np.int32)[None, :]
+        logits, slot_caches = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions))
+        self.kv_caches = self._write_slot(self.kv_caches, slot_caches,
+                                          index)
+        last_logits = np.asarray(logits[0, len(prompt) - 1],
+                                 dtype=np.float64)
+        temp = self._temp_of(request)
+        if temp > 0:
+            self._rng, key = jax.random.split(self._rng)
+            scaled = last_logits / max(temp, 1e-6)
+            probs = np.exp(scaled - scaled.max())
+            probs /= probs.sum()
+            first_token = int(np.random.default_rng(
+                int(jax.random.randint(key, (), 0, 2**31 - 1))
+            ).choice(len(probs), p=probs))
+        else:
+            first_token = int(np.argmax(last_logits))
+        slot = self.slots[index]
+        slot.request = request
+        slot.position = len(prompt)
+        slot.generated = [first_token]
+        slot.last_token = first_token
+        self._tokens_generated += 1
+
+    def _temp_of(self, request: GenerationRequest) -> float:
+        return request.temperature if request.temperature is not None \
+            else self.config.temperature
+
+    def _decode_tick(self, active: List[int]):
+        B = self.config.max_batch
+        tokens = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        for i in active:
+            tokens[i, 0] = self.slots[i].last_token
+            positions[i] = self.slots[i].position
+            temps[i] = self._temp_of(self.slots[i].request)
+        self._rng, key = jax.random.split(self._rng)
+        out, self.kv_caches = self._decode(
+            self.params, self.kv_caches, jnp.asarray(tokens),
+            jnp.asarray(positions), key, jnp.asarray(temps))
+        out = np.asarray(out)
+        finished = []
+        for i in active:
+            slot = self.slots[i]
+            token = int(out[i])
+            slot.generated.append(token)
+            slot.last_token = token
+            slot.position += 1
+            self._tokens_generated += 1
+            request = slot.request
+            hit_eos = (self.config.eos_token is not None
+                       and token == self.config.eos_token)
+            out_len = len(slot.generated)
+            if hit_eos or out_len >= request.max_new_tokens or \
+                    slot.position >= self.config.max_len - 1:
+                finished.append((request, list(slot.generated)))
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, list(slot.generated))
+                self.slots[i] = _Slot()
+        return finished
+
+    # -- conveniences ------------------------------------------------------
+
+    def generate(self, prompts: List[List[int]],
+                 max_new_tokens: int = 32,
+                 timeout_s: float = 300.0) -> List[List[int]]:
+        """Synchronous batch generation (drives the loop inline)."""
+        results: Dict[int, List[int]] = {}
+        for i, prompt in enumerate(prompts):
+            request = GenerationRequest(prompt_tokens=prompt,
+                                        max_new_tokens=max_new_tokens,
+                                        request_id=str(i))
+            self.submit(request)
+        deadline = time.monotonic() + timeout_s
+        while len(results) < len(prompts):
+            if time.monotonic() > deadline:
+                raise TimeoutError("generation timed out")
+            for request, tokens in self.step():
+                results[int(request.request_id)] = tokens
+        return [results[i] for i in range(len(prompts))]
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "steps": self._steps,
+            "tokens_generated": self._tokens_generated,
+            "active_slots": sum(1 for s in self.slots
+                                if s.request is not None),
+            "pending": self._pending.qsize(),
+        }

@@ -1,0 +1,90 @@
+"""LLM serving deployment
+(reference: llm/_internal/serve/deployments/llm/ — the vLLM server class;
+builders serve/llm/__init__.py:92 build_llm_deployment. Here the engine
+is in-process and TPU-native instead of a vLLM subprocess.)
+
+The deployment's asyncio loop drives the engine: requests enqueue into
+the engine's scheduler and await completion futures; one background task
+steps the engine whenever work is pending — iteration-level (continuous)
+batching across concurrent HTTP/handle requests."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class LLMServer:
+    """The replica callable (wrapped by serve.deployment)."""
+
+    def __init__(self, engine_config, params=None):
+        from .engine import LLMEngine
+        self._engine = LLMEngine(engine_config, params=params)
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake: Optional[asyncio.Event] = None
+
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._wake = asyncio.Event()
+            self._loop_task = asyncio.ensure_future(self._drive())
+
+    async def _drive(self):
+        loop = asyncio.get_running_loop()
+        while True:
+            if not self._engine.has_work():
+                self._wake.clear()
+                await self._wake.wait()
+            # One engine tick off-loop (it blocks on device compute).
+            try:
+                await loop.run_in_executor(None, self._engine.step)
+            except Exception:  # noqa: BLE001 — keep serving other requests
+                logger.exception("engine step failed")
+                await asyncio.sleep(0.1)
+
+    async def generate(self, prompt_tokens: List[int],
+                       max_new_tokens: int = 32) -> Dict[str, Any]:
+        from .engine import GenerationRequest
+        self._ensure_loop()
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def on_done(request, tokens):
+            def _resolve():
+                if future.done():
+                    return
+                if isinstance(tokens, Exception):
+                    future.set_exception(tokens)
+                else:
+                    future.set_result(tokens)
+            loop.call_soon_threadsafe(_resolve)
+
+        request = GenerationRequest(prompt_tokens=list(prompt_tokens),
+                                    max_new_tokens=max_new_tokens)
+        self._engine.submit(request, done_callback=on_done)
+        self._wake.set()
+        tokens = await future
+        return {"tokens": tokens, "num_generated": len(tokens)}
+
+    async def __call__(self, http_request) -> Dict[str, Any]:
+        body = http_request.json()
+        return await self.generate(
+            body["prompt_tokens"],
+            max_new_tokens=int(body.get("max_new_tokens", 32)))
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self._engine.stats()
+
+
+def build_llm_deployment(engine_config, *, name: str = "LLMServer",
+                         num_replicas: int = 1, params=None,
+                         max_ongoing_requests: int = 64):
+    """Serve application for the engine
+    (reference: serve/llm/__init__.py:92 build_llm_deployment)."""
+    from .. import serve
+    deployment = serve.deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests)
+    return deployment.bind(engine_config, params)

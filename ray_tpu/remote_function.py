@@ -56,6 +56,7 @@ class RemoteFunction:
         validate_options(self._options, for_actor=False)
         functools.update_wrapper(self, function)
         self._descriptor = None
+        self._descriptor_owner = None
 
     def options(self, **new_options) -> "RemoteFunction":
         merged = dict(self._options)
@@ -70,9 +71,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         worker = get_core_worker()
         job_id = worker.current_job_id()
-        if self._descriptor is None:
+        # Per core-worker export cache: module-level remote functions
+        # outlive shutdown()/init() cycles (see ActorClass.remote).
+        if self._descriptor is None or self._descriptor_owner is not worker:
             self._descriptor = worker.function_manager.export(
                 job_id, self._function)
+            self._descriptor_owner = worker
         opts = self._options
         num_returns = opts.get("num_returns", 1)
         max_retries = opts.get("max_retries",

@@ -1,0 +1,370 @@
+// Shared-memory object store core
+// (TPU-native equivalent of the reference's plasma store internals:
+//  src/ray/object_manager/plasma/plasma_allocator.cc + dlmalloc.cc arena,
+//  object_store.cc tables, eviction_policy.cc LRU — here as one
+//  cross-process arena with an intrusive free list, an open-addressed
+//  object table, sealed/refcount states, and LRU eviction, all inside a
+//  single mmapped segment so every process on the node shares one copy).
+//
+// Exposed as a C ABI for ctypes (no pybind11 in the image). All offsets
+// are relative to the segment base so they are valid in every mapping.
+//
+// Concurrency: one PTHREAD_PROCESS_SHARED mutex in the header guards
+// allocator + table metadata. Payload writes happen outside the lock
+// (the slot is private until seal).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <pthread.h>
+
+extern "C" {
+
+static const uint64_t MAGIC = 0x5254505553544f52ULL;  // "RTPUSTOR"
+static const uint32_t NSLOTS_DEFAULT = 65536;
+static const uint64_t ALIGN = 64;
+
+struct Slot {           // object table entry
+  uint8_t id[20];       // object id bytes (20)
+  uint64_t offset;      // payload offset from segment base; 0 = free slot
+  uint64_t size;
+  int32_t refcount;     // pinned readers/writers
+  uint8_t state;        // 0 free, 1 creating, 2 sealed
+  uint8_t in_lru;
+  uint16_t _pad;
+  uint64_t lru_prev;    // slot indices + 1; 0 = none
+  uint64_t lru_next;
+};
+
+struct Block {          // free/used block header, intrusive in the arena
+  uint64_t size;        // payload size (excl. header)
+  uint64_t next_free;   // offset of next free block; 0 = none (free only)
+  uint8_t used;
+  uint8_t _pad[7];
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;        // arena bytes (excl. header/table)
+  uint64_t arena_off;       // offset of arena start
+  uint64_t used_bytes;
+  uint32_t nslots;
+  uint32_t _pad;
+  uint64_t free_head;       // offset of first free block
+  uint64_t lru_head;        // slot index + 1 of least-recently-used
+  uint64_t lru_tail;        // slot index + 1 of most-recently-used
+  pthread_mutex_t mutex;
+};
+
+static inline Slot* slots(Header* h) {
+  return reinterpret_cast<Slot*>(reinterpret_cast<char*>(h)
+                                 + sizeof(Header));
+}
+
+static inline char* base(Header* h) {
+  return reinterpret_cast<char*>(h);
+}
+
+static uint64_t align_up(uint64_t x) { return (x + ALIGN - 1) & ~(ALIGN - 1); }
+
+// --------------------------------------------------------------------------
+// init / attach
+// --------------------------------------------------------------------------
+
+// Initialize a zeroed mapping of `total` bytes. Returns 0 on success.
+int store_init(void* mem, uint64_t total) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  uint64_t table_bytes = sizeof(Slot) * NSLOTS_DEFAULT;
+  uint64_t arena_off = align_up(sizeof(Header) + table_bytes);
+  if (total <= arena_off + sizeof(Block) + ALIGN) return -1;
+  h->capacity = total - arena_off;
+  h->arena_off = arena_off;
+  h->used_bytes = 0;
+  h->nslots = NSLOTS_DEFAULT;
+  h->free_head = arena_off;
+  h->lru_head = 0;
+  h->lru_tail = 0;
+  Block* first = reinterpret_cast<Block*>(base(h) + arena_off);
+  first->size = h->capacity - sizeof(Block);
+  first->next_free = 0;
+  first->used = 0;
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  h->magic = MAGIC;  // last: publication
+  return 0;
+}
+
+int store_is_initialized(void* mem) {
+  return reinterpret_cast<Header*>(mem)->magic == MAGIC ? 1 : 0;
+}
+
+static int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {  // a process died holding the lock
+    pthread_mutex_consistent(&h->mutex);
+    return 0;
+  }
+  return rc;
+}
+
+// --------------------------------------------------------------------------
+// object table
+// --------------------------------------------------------------------------
+
+static uint64_t hash_id(const uint8_t* id) {
+  uint64_t x;
+  memcpy(&x, id, 8);
+  x ^= x >> 33; x *= 0xff51afd7ed558ccdULL; x ^= x >> 33;
+  return x;
+}
+
+// find slot for id; if absent and want_free, return a free slot.
+static Slot* find_slot(Header* h, const uint8_t* id, bool want_free) {
+  Slot* tab = slots(h);
+  uint32_t n = h->nslots;
+  uint64_t i = hash_id(id) % n;
+  Slot* first_free = nullptr;
+  for (uint32_t probe = 0; probe < n; probe++) {
+    Slot* s = &tab[(i + probe) % n];
+    if (s->state == 0) {
+      if (!first_free) first_free = s;
+      if (s->offset == 0) break;  // never-used slot: end of chain
+      continue;                   // tombstone: keep probing
+    }
+    if (memcmp(s->id, id, 20) == 0) return s;
+  }
+  return want_free ? first_free : nullptr;
+}
+
+// --------------------------------------------------------------------------
+// LRU list (sealed, refcount==0 objects are evictable)
+// --------------------------------------------------------------------------
+
+static void lru_remove(Header* h, Slot* s) {
+  if (!s->in_lru) return;
+  Slot* tab = slots(h);
+  if (s->lru_prev) tab[s->lru_prev - 1].lru_next = s->lru_next;
+  else h->lru_head = s->lru_next;
+  if (s->lru_next) tab[s->lru_next - 1].lru_prev = s->lru_prev;
+  else h->lru_tail = s->lru_prev;
+  s->in_lru = 0;
+  s->lru_prev = s->lru_next = 0;
+}
+
+static void lru_push_mru(Header* h, Slot* s) {
+  Slot* tab = slots(h);
+  uint64_t me = (uint64_t)(s - tab) + 1;
+  s->lru_prev = h->lru_tail;
+  s->lru_next = 0;
+  if (h->lru_tail) tab[h->lru_tail - 1].lru_next = me;
+  h->lru_tail = me;
+  if (!h->lru_head) h->lru_head = me;
+  s->in_lru = 1;
+}
+
+// --------------------------------------------------------------------------
+// allocator: first-fit free list with coalescing on free
+// --------------------------------------------------------------------------
+
+static uint64_t alloc_block(Header* h, uint64_t size) {
+  size = align_up(size);
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur) {
+    Block* b = reinterpret_cast<Block*>(base(h) + cur);
+    if (!b->used && b->size >= size) {
+      uint64_t remain = b->size - size;
+      if (remain > sizeof(Block) + ALIGN) {  // split
+        uint64_t tail_off = cur + sizeof(Block) + size;
+        Block* tail = reinterpret_cast<Block*>(base(h) + tail_off);
+        tail->size = remain - sizeof(Block);
+        tail->used = 0;
+        tail->next_free = b->next_free;
+        b->size = size;
+        if (prev) reinterpret_cast<Block*>(base(h) + prev)->next_free
+            = tail_off;
+        else h->free_head = tail_off;
+      } else {
+        if (prev) reinterpret_cast<Block*>(base(h) + prev)->next_free
+            = b->next_free;
+        else h->free_head = b->next_free;
+      }
+      b->used = 1;
+      b->next_free = 0;
+      h->used_bytes += b->size + sizeof(Block);
+      return cur + sizeof(Block);  // payload offset
+    }
+    prev = cur;
+    cur = b->next_free;
+  }
+  return 0;
+}
+
+static void free_block(Header* h, uint64_t payload_off) {
+  uint64_t off = payload_off - sizeof(Block);
+  Block* b = reinterpret_cast<Block*>(base(h) + off);
+  b->used = 0;
+  h->used_bytes -= b->size + sizeof(Block);
+  // Address-ordered insert + forward coalesce.
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur && cur < off) {
+    prev = cur;
+    cur = reinterpret_cast<Block*>(base(h) + cur)->next_free;
+  }
+  b->next_free = cur;
+  if (prev) reinterpret_cast<Block*>(base(h) + prev)->next_free = off;
+  else h->free_head = off;
+  // Coalesce with next.
+  if (cur && off + sizeof(Block) + b->size == cur) {
+    Block* nb = reinterpret_cast<Block*>(base(h) + cur);
+    b->size += sizeof(Block) + nb->size;
+    b->next_free = nb->next_free;
+  }
+  // Coalesce with prev.
+  if (prev) {
+    Block* pb = reinterpret_cast<Block*>(base(h) + prev);
+    if (prev + sizeof(Block) + pb->size == off) {
+      pb->size += sizeof(Block) + b->size;
+      pb->next_free = b->next_free;
+    }
+  }
+}
+
+// Evict LRU sealed objects until at least `needed` contiguous-ish bytes
+// could plausibly be free. Returns number of evicted objects.
+static int evict_for(Header* h, uint64_t needed) {
+  int evicted = 0;
+  Slot* tab = slots(h);
+  while (h->lru_head && h->used_bytes + needed + sizeof(Block)
+         > h->capacity) {
+    Slot* victim = &tab[h->lru_head - 1];
+    lru_remove(h, victim);
+    free_block(h, victim->offset);
+    victim->state = 0;  // tombstone (offset stays nonzero)
+    evicted++;
+  }
+  return evicted;
+}
+
+// --------------------------------------------------------------------------
+// public object API
+// --------------------------------------------------------------------------
+
+// Create an object slot; returns payload offset or 0 (OOM / exists).
+// allow_evict: whether LRU entries may be dropped to make room. The
+// plasma integration passes 0 — object lifetime is owned by the
+// distributed refcount layer, and silently evicting a live object there
+// turns gets into hangs; callers that own their lifetimes (caches,
+// benchmarks) pass 1.
+uint64_t store_create(void* mem, const uint8_t* id, uint64_t size,
+                      int allow_evict, int* err) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (lock(h)) { *err = 3; return 0; }
+  Slot* existing = find_slot(h, id, false);
+  if (existing && existing->state != 0) {
+    pthread_mutex_unlock(&h->mutex);
+    *err = 1;  // already exists
+    return 0;
+  }
+  uint64_t off = alloc_block(h, size);
+  if (!off && allow_evict) {
+    evict_for(h, size);
+    off = alloc_block(h, size);
+  }
+  if (!off) {
+    pthread_mutex_unlock(&h->mutex);
+    *err = 2;  // out of memory
+    return 0;
+  }
+  Slot* s = find_slot(h, id, true);
+  if (!s) {
+    free_block(h, off);
+    pthread_mutex_unlock(&h->mutex);
+    *err = 4;  // table full
+    return 0;
+  }
+  memcpy(s->id, id, 20);
+  s->offset = off;
+  s->size = size;
+  s->refcount = 1;  // creator holds it until seal
+  s->state = 1;
+  s->in_lru = 0;
+  s->lru_prev = s->lru_next = 0;
+  pthread_mutex_unlock(&h->mutex);
+  *err = 0;
+  return off;
+}
+
+int store_seal(void* mem, const uint8_t* id) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (lock(h)) return 3;
+  Slot* s = find_slot(h, id, false);
+  if (!s || s->state != 1) { pthread_mutex_unlock(&h->mutex); return 1; }
+  s->state = 2;
+  s->refcount = 0;
+  lru_push_mru(h, s);
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+// Pin + locate a sealed object. Returns offset, fills size; 0 if absent.
+uint64_t store_get(void* mem, const uint8_t* id, uint64_t* size) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (lock(h)) return 0;
+  Slot* s = find_slot(h, id, false);
+  if (!s || s->state != 2) { pthread_mutex_unlock(&h->mutex); return 0; }
+  s->refcount++;
+  lru_remove(h, s);
+  *size = s->size;
+  uint64_t off = s->offset;
+  pthread_mutex_unlock(&h->mutex);
+  return off;
+}
+
+int store_release(void* mem, const uint8_t* id) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (lock(h)) return 3;
+  Slot* s = find_slot(h, id, false);
+  if (!s || s->state != 2) { pthread_mutex_unlock(&h->mutex); return 1; }
+  if (s->refcount > 0) s->refcount--;
+  if (s->refcount == 0 && !s->in_lru) lru_push_mru(h, s);
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+int store_delete(void* mem, const uint8_t* id) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (lock(h)) return 3;
+  Slot* s = find_slot(h, id, false);
+  if (!s || s->state == 0) { pthread_mutex_unlock(&h->mutex); return 1; }
+  if (s->refcount > 0 && s->state == 2) {
+    pthread_mutex_unlock(&h->mutex);
+    return 2;  // pinned
+  }
+  lru_remove(h, s);
+  free_block(h, s->offset);
+  s->state = 0;  // tombstone
+  pthread_mutex_unlock(&h->mutex);
+  return 0;
+}
+
+int store_contains(void* mem, const uint8_t* id) {
+  Header* h = reinterpret_cast<Header*>(mem);
+  if (lock(h)) return 0;
+  Slot* s = find_slot(h, id, false);
+  int ok = (s && s->state == 2) ? 1 : 0;
+  pthread_mutex_unlock(&h->mutex);
+  return ok;
+}
+
+uint64_t store_used_bytes(void* mem) {
+  return reinterpret_cast<Header*>(mem)->used_bytes;
+}
+
+uint64_t store_capacity(void* mem) {
+  return reinterpret_cast<Header*>(mem)->capacity;
+}
+
+}  // extern "C"

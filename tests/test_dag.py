@@ -1,0 +1,177 @@
+"""Compiled-graph tests: channel semantics, chain/fan-out execution,
+repeated steps, teardown, and the latency win over per-call actor RPC
+(reference coverage: dag/tests/experimental/test_accelerated_dag.py,
+experimental/channel tests)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture
+def dag_cluster():
+    ray_tpu.init(num_cpus=6, object_store_memory=200 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_shared_memory_channel_roundtrip(tmp_path):
+    from ray_tpu.experimental.channel import SharedMemoryChannel
+    path = str(tmp_path / "chan")
+    writer = SharedMemoryChannel(path, capacity=1 << 20, create=True)
+    reader = SharedMemoryChannel(path, create=False)
+    writer.put({"x": 1, "arr": np.arange(5)})
+    out = reader.get()
+    assert out["x"] == 1 and np.array_equal(out["arr"], np.arange(5))
+    # Values survive slot reuse (reader copies before acking).
+    writer.put(np.full(4, 7))
+    second = reader.get()
+    writer.put(np.zeros(4))
+    _third = reader.get()
+    assert np.array_equal(second, np.full(4, 7))
+    writer.destroy()
+
+
+def test_channel_close_unblocks_reader(tmp_path):
+    import threading
+    from ray_tpu.experimental.channel import (ChannelClosedError,
+                                              SharedMemoryChannel)
+    path = str(tmp_path / "chan2")
+    ch = SharedMemoryChannel(path, capacity=1 << 16, create=True)
+    errs = []
+
+    def read():
+        try:
+            ch.get(timeout=30)
+        except ChannelClosedError:
+            errs.append("closed")
+    t = threading.Thread(target=read)
+    t.start()
+    time.sleep(0.2)
+    ch.close()
+    t.join(timeout=10)
+    assert errs == ["closed"]
+    ch.destroy()
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, bias):
+        self.bias = bias
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.bias
+
+    def get_calls(self):
+        return self.calls
+
+
+def test_compiled_chain(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        mid = a.add.bind(inp)
+        out = b.add.bind(mid)
+    dag = out.experimental_compile()
+    try:
+        assert dag.execute(5) == 16
+        assert dag.execute(100) == 111
+        for i in range(20):
+            assert dag.execute(i) == i + 11
+    finally:
+        dag.teardown()
+
+
+def test_compiled_fan_out_multi_output(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        left = a.add.bind(inp)
+        right = b.add.bind(inp)
+    dag = MultiOutputNode([left, right]).experimental_compile()
+    try:
+        assert dag.execute(10) == [11, 12]
+    finally:
+        dag.teardown()
+
+
+def test_compiled_same_actor_two_steps(dag_cluster):
+    a = Adder.remote(5)
+    with InputNode() as inp:
+        once = a.add.bind(inp)
+        twice = a.add.bind(once)  # local handoff inside the actor
+    dag = twice.experimental_compile()
+    try:
+        assert dag.execute(0) == 10
+    finally:
+        dag.teardown()
+
+
+def test_compiled_faster_than_actor_calls(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(1)
+    # Warm the RPC path.
+    ray_tpu.get(b.add.remote(ray_tpu.get(a.add.remote(0))))
+
+    n = 50
+    start = time.perf_counter()
+    for i in range(n):
+        ray_tpu.get(b.add.remote(ray_tpu.get(a.add.remote(i))))
+    rpc_time = time.perf_counter() - start
+
+    with InputNode() as inp:
+        out = b.add.bind(a.add.bind(inp))
+    dag = out.experimental_compile()
+    try:
+        dag.execute(0)  # warm channels
+        start = time.perf_counter()
+        for i in range(n):
+            dag.execute(i)
+        dag_time = time.perf_counter() - start
+    finally:
+        dag.teardown()
+    # The channel plane must beat two RPC round-trips per step.
+    assert dag_time < rpc_time, (dag_time, rpc_time)
+
+
+def test_teardown_returns_actors_to_service(dag_cluster):
+    a = Adder.remote(3)
+    with InputNode() as inp:
+        out = a.add.bind(inp)
+    dag = out.experimental_compile()
+    assert dag.execute(1) == 4
+    dag.teardown()
+    # After teardown the exec loop exited; normal calls work again.
+    assert ray_tpu.get(a.add.remote(1), timeout=30) == 4
+    assert ray_tpu.get(a.get_calls.remote(), timeout=30) >= 2
+
+
+def test_dag_task_error_propagates_to_driver(dag_cluster):
+    from ray_tpu.experimental.channel import DagTaskError
+
+    @ray_tpu.remote
+    class Flaky:
+        def work(self, x):
+            if x == 13:
+                raise ValueError("unlucky input")
+            return x * 2
+
+    a = Flaky.remote()
+    b = Flaky.remote()
+    with InputNode() as inp:
+        out = b.work.bind(a.work.bind(inp))
+    dag = out.experimental_compile()
+    try:
+        assert dag.execute(2) == 8
+        with pytest.raises(DagTaskError, match="unlucky input"):
+            dag.execute(13)
+        # The loop survives the error: later steps still work.
+        assert dag.execute(3) == 12
+    finally:
+        dag.teardown()
