@@ -40,6 +40,10 @@ _DEFAULTS: Dict[str, Any] = {
     "lease_idle_timeout_s": 2.0,
     "worker_lease_parallelism": 10,
     "max_pending_lease_requests_per_shape": 10,
+    # Pipelined task pushes per leased worker (reference:
+    # normal_task_submitter.h max_tasks_in_flight_per_worker). The worker
+    # executes serially; >1 hides push/reply latency behind execution.
+    "max_tasks_in_flight_per_lease": 8,
     # --- workers ---
     "worker_start_timeout_s": 60.0,
     "num_prestart_workers": 0,

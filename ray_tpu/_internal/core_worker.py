@@ -38,7 +38,7 @@ from .errors import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                      WorkerCrashedError)
 from .gcs_client import GcsClient
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
-from .memory_store import MemoryStore
+from .memory_store import MemoryStore, resolve_entry
 from .object_ref import ObjectRef
 from .plasma import PlasmaDir
 from .rpc import Address, ClientPool, EventLoopThread, RpcServer
@@ -368,9 +368,15 @@ class TaskManager:
             if ret.get("plasma"):
                 self._cw.reference_counter.mark_in_plasma(oid)
                 self._cw.memory_store.put(oid, None, in_plasma=True)
-            else:
+            elif ret.get("refs"):
+                # Contains ObjectRefs: deserialize now so borrows register
+                # inside the sender's transit-pin window.
                 value = serialization.deserialize(ret["data"])
                 self._cw.memory_store.put(oid, value)
+            else:
+                # Defer deserialization to the consuming thread (off the
+                # io loop; parallel across getters).
+                self._cw.memory_store.put_raw(oid, ret["data"])
         num_dynamic = reply.get("num_dynamic")
         if num_dynamic is not None:
             # Generator task: materialize the handle at index 0, owning
@@ -454,6 +460,13 @@ class Lease:
     raylet_address: Address
     node_id: str
     last_used: float = field(default_factory=time.monotonic)
+    # Pipelined pushes currently outstanding on this leased worker
+    # (reference: normal_task_submitter.h max_tasks_in_flight_per_worker —
+    # the worker executes serially; pipelining hides push/reply latency).
+    inflight: int = 0
+    # Set by _drop_lease: other pipelined tasks finishing on this lease
+    # must not recycle it back into the idle pool.
+    dead: bool = False
 
 
 class NormalTaskSubmitter:
@@ -472,7 +485,7 @@ class NormalTaskSubmitter:
             task.cancel()
 
     def submit(self, spec: TaskSpec):
-        self._cw.loop_call(self._submit(spec))
+        self._cw.loop_post(self._submit(spec))
 
     def resubmit(self, spec: TaskSpec):
         self.submit(spec)
@@ -537,6 +550,13 @@ class NormalTaskSubmitter:
                         else TaskError(spec.function.display_name(),
                                        str(entry.value))
                 if entry is not None and not entry.in_plasma:
+                    if entry.raw is not None:
+                        # Ref-free serialized reply: inline the bytes as-is.
+                        raw = entry.raw
+                        if raw is not None and \
+                                len(raw) <= CONFIG.inline_arg_max_bytes:
+                            spec.args[i] = TaskArg(is_ref=False, data=raw)
+                        continue
                     sobj = serialization.serialize(entry.value)
                     if sobj.total_bytes() <= CONFIG.inline_arg_max_bytes \
                             and not sobj.contained_refs:
@@ -556,7 +576,13 @@ class NormalTaskSubmitter:
         key = spec.shape_key()
         idle = self._idle.get(key)
         if idle:
-            return idle.pop()
+            # Least-loaded lease first so bursts spread across workers
+            # before pipelining deepens any one queue.
+            lease = min(idle, key=lambda l: l.inflight)
+            lease.inflight += 1
+            if lease.inflight >= CONFIG.max_tasks_in_flight_per_lease:
+                idle.remove(lease)
+            return lease
         fut = asyncio.get_running_loop().create_future()
         self._waiters.setdefault(key, collections.deque()).append(
             (spec.task_id, fut))
@@ -611,14 +637,24 @@ class NormalTaskSubmitter:
         self._maybe_request_lease(key, spec)
 
     def _deliver_lease(self, key: Tuple, lease: Lease):
+        """Hand the lease's free pipeline slots to waiters; park whatever
+        capacity remains on the idle list (invariant: `_idle[key]` holds
+        exactly the leases with spare capacity, no duplicates)."""
+        cap = CONFIG.max_tasks_in_flight_per_lease
         waiters = self._waiters.get(key)
-        while waiters:
+        while waiters and lease.inflight < cap:
             _tid, fut = waiters.popleft()
-            if not fut.done():
-                fut.set_result(lease)
-                return
+            if fut.done():
+                continue
+            lease.inflight += 1
+            fut.set_result(lease)
         lease.last_used = time.monotonic()
-        self._idle.setdefault(key, []).append(lease)
+        idle = self._idle.setdefault(key, [])
+        if lease.inflight < cap:
+            if lease not in idle:
+                idle.append(lease)
+        elif lease in idle:
+            idle.remove(lease)
 
     async def _request_new_lease(self, spec: TaskSpec) -> Optional[Lease]:
         meta = {
@@ -661,11 +697,23 @@ class NormalTaskSubmitter:
         raise RayTpuError("could not acquire a worker lease (too many hops)")
 
     def _return_lease(self, key: Tuple, lease: Lease):
+        lease.inflight -= 1
+        if lease.dead:
+            return
         self._deliver_lease(key, lease)
 
     def _drop_lease(self, lease: Lease):
+        if lease.dead:
+            return
+        lease.dead = True
         self._cw.fire_and_forget(lease.raylet_address, "return_worker",
                                  lease_id=lease.lease_id, dispose=True)
+        # With pipelining a failed lease may still be advertised as having
+        # capacity — stop handing it out.
+        for leases in self._idle.values():
+            if lease in leases:
+                leases.remove(lease)
+                break
 
     async def _idle_lease_cleaner(self):
         while True:
@@ -674,7 +722,8 @@ class NormalTaskSubmitter:
             for key, leases in list(self._idle.items()):
                 keep = []
                 for lease in leases:
-                    if now - lease.last_used > CONFIG.lease_idle_timeout_s:
+                    if lease.inflight == 0 and \
+                            now - lease.last_used > CONFIG.lease_idle_timeout_s:
                         self._cw.fire_and_forget(
                             lease.raylet_address, "return_worker",
                             lease_id=lease.lease_id)
@@ -723,7 +772,7 @@ class ActorTaskSubmitter:
             await self._cw.gcs.subscribe("ACTOR", self._on_actor_update)
 
     def submit(self, spec: TaskSpec):
-        self._cw.loop_call(self._submit(spec))
+        self._cw.loop_post(self._submit(spec))
 
     async def _submit(self, spec: TaskSpec):
         await self.ensure_subscribed()
@@ -905,8 +954,23 @@ class TaskExecutor:
         await self._cw.ensure_job_env(spec.job_id)
         if spec.task_type == ACTOR_TASK:
             return await self._execute_actor_task(spec)
-        loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(self._pool, self._run_task, spec)
+        fut = asyncio.get_running_loop().create_future()
+        self._pool.submit(self._run_to_future, spec, fut)
+        return await fut
+
+    def _run_to_future(self, spec: TaskSpec, fut: "asyncio.Future"):
+        """Pool-thread wrapper: always resolves `fut` on the io loop with a
+        batched wakeup (vs run_in_executor's per-task self-pipe write).
+        BaseExceptions (sys.exit in user code) must still produce a reply —
+        an unset future would hang the caller's push forever."""
+        try:
+            result = self._run_task(spec)
+        except BaseException as e:  # noqa: BLE001 — must answer the RPC
+            result = {"error": TaskError(
+                spec.function.display_name() or spec.method_name,
+                f"task raised {type(e).__name__}: {e}", cause=None)}
+        EventLoopThread.get().post_call(
+            lambda: fut.set_result(result) if not fut.done() else None)
 
     async def _execute_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
         # Enforce per-caller submission order by sequence number.
@@ -963,14 +1027,7 @@ class TaskExecutor:
                 group = spec.concurrency_groups.get("_group") \
                     if spec.concurrency_groups else None
                 pool = self._actor_pools.get(group or "_default", self._pool)
-                loop = asyncio.get_running_loop()
-
-                def _run(spec=spec, fut=fut, loop=loop):
-                    result = self._run_task(spec)
-                    loop.call_soon_threadsafe(
-                        lambda: fut.set_result(result)
-                        if not fut.done() else None)
-                pool.submit(_run)
+                pool.submit(self._run_to_future, spec, fut)
 
     async def _run_async_actor_task(self, spec: TaskSpec, fut: asyncio.Future):
         self._running_async[spec.task_id] = asyncio.current_task()
@@ -1028,7 +1085,12 @@ class TaskExecutor:
                                                  owner=spec.owner_address)
                 returns.append({"plasma": True, "size": sobj.total_bytes()})
             else:
-                returns.append({"data": sobj.to_bytes()})
+                ret = {"data": sobj.to_bytes()}
+                if sobj.contained_refs:
+                    # Owner must deserialize eagerly so the borrower
+                    # registration happens inside the transit-pin window.
+                    ret["refs"] = True
+                returns.append(ret)
         return {"returns": returns}
 
     def _package_dynamic_returns(self, spec: TaskSpec,
@@ -1049,7 +1111,10 @@ class TaskExecutor:
                 returns.append({"index": index, "plasma": True,
                                 "size": sobj.total_bytes()})
             else:
-                returns.append({"index": index, "data": sobj.to_bytes()})
+                ret = {"index": index, "data": sobj.to_bytes()}
+                if sobj.contained_refs:
+                    ret["refs"] = True  # owner must deserialize eagerly
+                returns.append(ret)
         return {"returns": returns, "num_dynamic": index}
 
     def _run_task(self, spec: TaskSpec) -> Dict[str, Any]:
@@ -1200,6 +1265,8 @@ class CoreWorker:
         self.current_lease_id: Optional[int] = None
         self._node_addr_cache: Dict[str, Address] = {}
         self._job_envs: Dict[JobID, "asyncio.Future"] = {}
+        self._pending_frees: List[str] = []
+        self._free_lock = threading.Lock()
         self._shutdown = False
 
     # -- lifecycle -------------------------------------------------------
@@ -1233,6 +1300,10 @@ class CoreWorker:
     def loop_call(self, coro):
         return EventLoopThread.get().call_soon(coro)
 
+    def loop_post(self, coro):
+        """Fire-and-forget on the io loop; wakeups batched across a burst."""
+        EventLoopThread.get().post(coro)
+
     def run_sync(self, coro, timeout=None):
         return EventLoopThread.get().run_sync(coro, timeout)
 
@@ -1244,7 +1315,7 @@ class CoreWorker:
                 await client.call(method, timeout=10, **kwargs)
             except Exception:
                 pass
-        self.loop_call(_go())
+        self.loop_post(_go())
 
     async def ensure_job_env(self, job_id: JobID):
         """Adopt the driver's sys.path so its locally-defined functions
@@ -1348,7 +1419,7 @@ class CoreWorker:
                     if isinstance(err, TaskError):
                         raise err.as_instanceof_cause()
                     raise err
-                return entry.value
+                return resolve_entry(entry)
             value, ok = self.plasma.get(oid)
             if ok:
                 return value
@@ -1491,8 +1562,24 @@ class CoreWorker:
 
     def _free_owned_object(self, object_id: ObjectID):
         self.memory_store.delete([object_id])
-        self.fire_and_forget(self.gcs.address, "free_object",
-                             object_hex=object_id.hex())
+        # Batch the directory-free notifications: a burst of ref releases
+        # (e.g. a list of ObjectRefs going out of scope) becomes one GCS RPC.
+        with self._free_lock:
+            self._pending_frees.append(object_id.hex())
+            if len(self._pending_frees) > 1:
+                return  # drain already posted
+        self.loop_post(self._drain_frees())
+
+    async def _drain_frees(self):
+        with self._free_lock:
+            hexes, self._pending_frees = self._pending_frees, []
+        if not hexes:
+            return
+        try:
+            await self.gcs.call("free_objects", object_hexes=hexes,
+                                timeout=10)
+        except Exception:
+            pass
 
     # -- task submission -------------------------------------------------
 
@@ -1529,6 +1616,10 @@ class CoreWorker:
             return {"data": None, "in_plasma": True}
         if entry.is_exception:
             return {"data": None, "error": True}
+        if entry.raw is not None:
+            raw = entry.raw
+            if raw is not None:
+                return {"data": raw}  # ref-free serialized form, as-is
         sobj = serialization.serialize(entry.value)
         self.reference_counter.pin_for_transit(sobj.contained_refs)
         return {"data": sobj.to_bytes()}

@@ -495,16 +495,25 @@ class GcsServer:
         return True
 
     async def handle_free_object(self, object_hex: str):
-        entry = self.object_dir.pop(object_hex, None)
-        self.spilled.pop(object_hex, None)
-        if entry is not None:
-            _, nodes, _ = entry
-            for node_id in nodes:
-                rec = self.nodes.get(node_id)
-                if rec and rec.state == "ALIVE":
-                    client = self.clients.get(rec.address)
-                    asyncio.ensure_future(client.call(
-                        "free_objects", object_hexes=[object_hex], timeout=5))
+        return await self.handle_free_objects([object_hex])
+
+    async def handle_free_objects(self, object_hexes: List[str]):
+        """Batched owner-side frees: one raylet notification per node for
+        the whole batch (owners batch their ref-release traffic)."""
+        per_node: Dict[str, List[str]] = {}
+        for object_hex in object_hexes:
+            entry = self.object_dir.pop(object_hex, None)
+            self.spilled.pop(object_hex, None)
+            if entry is not None:
+                _, nodes, _ = entry
+                for node_id in nodes:
+                    per_node.setdefault(node_id, []).append(object_hex)
+        for node_id, hexes in per_node.items():
+            rec = self.nodes.get(node_id)
+            if rec and rec.state == "ALIVE":
+                client = self.clients.get(rec.address)
+                asyncio.ensure_future(client.call(
+                    "free_objects", object_hexes=hexes, timeout=5))
         return True
 
     # ------------------------------------------------------------------

@@ -20,8 +20,23 @@ Layout choices (sizes differ from the reference; semantics match):
 from __future__ import annotations
 
 import os
+import random
 import struct
 import threading
+
+# ID randomness: a per-process PRNG seeded from the OS (os.urandom is a
+# syscall per call — measurable at task-submission rates). Collision risk
+# is negligible: each process seeds with >=128 bits of OS entropy, and
+# forked children reseed so parent/child never share a stream.
+_randbytes = random.Random(os.urandom(16)).randbytes
+
+
+def _reseed_after_fork():
+    global _randbytes
+    _randbytes = random.Random(os.urandom(16)).randbytes
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
 
 _NIL = b""
 
@@ -40,7 +55,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(_randbytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -108,7 +123,7 @@ class ActorID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "ActorID":
-        return cls(os.urandom(cls.UNIQUE_BYTES) + job_id.binary())
+        return cls(_randbytes(cls.UNIQUE_BYTES) + job_id.binary())
 
     def job_id(self) -> JobID:
         return JobID(self._bytes[self.UNIQUE_BYTES :])
@@ -120,7 +135,7 @@ class TaskID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "TaskID":
-        return cls(os.urandom(cls.UNIQUE_BYTES) + job_id.binary())
+        return cls(_randbytes(cls.UNIQUE_BYTES) + job_id.binary())
 
     @classmethod
     def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":
@@ -155,7 +170,7 @@ class ObjectID(BaseID):
     def from_random(cls):
         # `put` objects use a random "task" part with the max index bit set so
         # they can never collide with task returns.
-        return cls(os.urandom(TaskID.SIZE) + struct.pack(">I", 0x80000000))
+        return cls(_randbytes(TaskID.SIZE) + struct.pack(">I", 0x80000000))
 
     def task_id(self) -> TaskID:
         return TaskID(self._bytes[: TaskID.SIZE])
@@ -173,7 +188,7 @@ class PlacementGroupID(BaseID):
 
     @classmethod
     def of(cls, job_id: JobID) -> "PlacementGroupID":
-        return cls(os.urandom(cls.UNIQUE_BYTES) + job_id.binary())
+        return cls(_randbytes(cls.UNIQUE_BYTES) + job_id.binary())
 
 
 class _Counter:

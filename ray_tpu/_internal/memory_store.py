@@ -17,14 +17,29 @@ from .ids import ObjectID
 
 
 class _Entry:
-    __slots__ = ("value", "is_exception", "in_plasma")
+    __slots__ = ("value", "is_exception", "in_plasma", "raw")
 
     def __init__(self, value: Any, is_exception: bool = False,
-                 in_plasma: bool = False):
+                 in_plasma: bool = False, raw: Optional[bytes] = None):
         self.value = value
         self.is_exception = is_exception
         # Marker entry: the real value lives in the shared-memory store.
         self.in_plasma = in_plasma
+        # Lazily-deserialized payload: the reply's serialized bytes, decoded
+        # on first access *by the consuming thread* — keeps deserialization
+        # off the io loop and parallelizes it across getter threads.
+        self.raw = raw
+
+
+def resolve_entry(entry: _Entry) -> Any:
+    raw = entry.raw
+    if raw is not None:
+        from . import serialization
+        # Benign race: concurrent resolvers deserialize the same bytes and
+        # assign equal values; value is set before raw is cleared.
+        entry.value = serialization.deserialize(raw)
+        entry.raw = None
+    return entry.value
 
 
 class MemoryStore:
@@ -37,6 +52,16 @@ class MemoryStore:
             in_plasma: bool = False):
         with self._lock:
             self._objects[object_id] = _Entry(value, is_exception, in_plasma)
+            self._lock.notify_all()
+            waiters = self._async_waiters.pop(object_id, [])
+        for loop, fut in waiters:
+            loop.call_soon_threadsafe(
+                lambda f=fut: f.set_result(True) if not f.done() else None)
+
+    def put_raw(self, object_id: ObjectID, data: bytes):
+        """Store a still-serialized reply payload (no contained refs)."""
+        with self._lock:
+            self._objects[object_id] = _Entry(None, raw=data)
             self._lock.notify_all()
             waiters = self._async_waiters.pop(object_id, [])
         for loop, fut in waiters:

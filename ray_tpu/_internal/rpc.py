@@ -1,12 +1,14 @@
-"""Asyncio RPC layer.
+"""RPC layer: native epoll transport with an asyncio fallback.
 
 Role-equivalent of the reference's gRPC layer (src/ray/rpc/: GrpcServer,
 GrpcClient, RetryableGrpcClient, rpc_chaos.h). Design differences, chosen for
 the target environment rather than translated:
 
-- Transport is length-prefixed msgpack over TCP with pickled payloads —
-  one event-loop thread per process serves every component in that process
-  (the reference gives each server its own polling threads).
+- Hot path is native: when src/fastrpc.cpp builds, all socket I/O, framing,
+  and write batching run on a C++ epoll thread (the analog of gRPC's
+  completion-queue threads); Python sees one loop wakeup per *batch* of
+  messages. Without a toolchain the same wire format runs over asyncio
+  streams with per-tick write coalescing.
 - In-process fast path: servers register in a process-local table; calls to a
   local address dispatch directly on the loop with zero serialization. This is
   what makes "head node in the driver process" mode cheap.
@@ -15,22 +17,22 @@ the target environment rather than translated:
 - Fault injection: `testing_rpc_failure` config drops requests/responses by
   method pattern (reference: rpc_chaos.h) for chaos tests.
 
-Wire frames: 4-byte big-endian length + msgpack map.
-  request:  {"i": id, "m": method, "p": pickled-args-bytes}
-  response: {"i": id, "ok": bool, "p": pickled-result-or-exception}
+Wire frames (both transports):
+  u32le body_len | u64le msg_id | u8 flags | u16le method_len |
+  method utf8 | payload (pickled kwargs / result)
+  flags: bit0 = response, bit1 = ok (responses only).
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import random
 import struct
 import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
-
-import msgpack
 
 from .config import CONFIG
 from .errors import RpcError
@@ -41,7 +43,64 @@ logger = logging.getLogger(__name__)
 Address = Tuple[str, int]
 Handler = Callable[..., Awaitable[Any]]
 
-_HEADER = struct.Struct(">I")
+# Frame header: u32 body_len, u64 msg_id, u8 flags, u16 method_len.
+_FRAME_HDR = struct.Struct("<IQBH")
+_BODY_HDR = struct.Struct("<QBH")
+_BODY_HDR_LEN = _BODY_HDR.size
+FLAG_RESP = 1
+FLAG_OK = 2
+
+
+def pack_frame(msg_id: int, flags: int, method: bytes,
+               payload: bytes) -> bytes:
+    return _FRAME_HDR.pack(_BODY_HDR_LEN + len(method) + len(payload),
+                           msg_id, flags, len(method)) + method + payload
+
+
+def unpack_body(body) -> Tuple[int, int, str, bytes]:
+    """Parse a frame body (past the length prefix) -> (id, flags, method,
+    payload). Copies the payload: callers may outlive the recv buffer."""
+    msg_id, flags, mlen = _BODY_HDR.unpack_from(body, 0)
+    method = bytes(body[_BODY_HDR_LEN:_BODY_HDR_LEN + mlen]).decode() \
+        if mlen else ""
+    payload = bytes(body[_BODY_HDR_LEN + mlen:])
+    return msg_id, flags, method, payload
+
+
+class FrameReader:
+    """Incremental length-prefix frame splitter for the asyncio path."""
+
+    __slots__ = ("_buf", "_off")
+
+    def __init__(self):
+        self._buf = bytearray()
+        self._off = 0
+
+    def feed(self, chunk: bytes):
+        self._buf += chunk
+        buf, off = self._buf, self._off
+        out = []
+        n = len(buf)
+        while n - off >= 4:
+            (body_len,) = struct.unpack_from("<I", buf, off)
+            if n - off - 4 < body_len:
+                break
+            out.append(memoryview(buf)[off + 4:off + 4 + body_len])
+            off += 4 + body_len
+        if off == n:
+            # Fully consumed: swap in a fresh buffer. The returned
+            # memoryviews keep the old bytearray alive and it is never
+            # mutated again, so no copy is needed.
+            self._buf = bytearray()
+            self._off = 0
+        else:
+            out = [bytes(b) for b in out]
+            if off > (1 << 20):
+                del self._buf[:off]
+                self._off = 0
+            else:
+                self._off = off
+        return out
 
 
 # --------------------------------------------------------------------------
@@ -54,6 +113,9 @@ class EventLoopThread:
 
     def __init__(self):
         self.loop = asyncio.new_event_loop()
+        self._post_q: collections.deque = collections.deque()
+        self._post_lock = threading.Lock()
+        self._post_scheduled = False
         self.thread = threading.Thread(
             target=self._run, name="rtpu-io", daemon=True)
         self.thread.start()
@@ -77,6 +139,43 @@ class EventLoopThread:
 
     def call_soon(self, coro) -> "asyncio.Future":
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def post(self, coro) -> None:
+        """Fire-and-forget a coroutine on the loop with batched wakeups.
+
+        A burst of N posts from caller threads costs ONE loop wakeup
+        (call_soon_threadsafe writes a self-pipe byte per call — the
+        dominant per-op cost of run_coroutine_threadsafe at high rates).
+        Posts from one thread retain their order.
+        """
+        on_loop = threading.current_thread() is self.thread
+        with self._post_lock:
+            self._post_q.append(coro)
+            if self._post_scheduled:
+                return
+            self._post_scheduled = True
+        if on_loop:
+            self.loop.call_soon(self._drain_posts)
+        else:
+            self.loop.call_soon_threadsafe(self._drain_posts)
+
+    def _drain_posts(self):
+        with self._post_lock:
+            items = list(self._post_q)
+            self._post_q.clear()
+            self._post_scheduled = False
+        for item in items:
+            if callable(item):
+                try:
+                    item()
+                except Exception:
+                    logger.exception("posted callback failed")
+            else:
+                self.loop.create_task(item)
+
+    def post_call(self, fn) -> None:
+        """Like post() but for a plain callable run on the loop."""
+        self.post(fn)
 
 
 def get_loop() -> asyncio.AbstractEventLoop:
@@ -126,6 +225,130 @@ DEFAULT_TIMEOUT = object()
 
 
 # --------------------------------------------------------------------------
+# Write coalescing
+# --------------------------------------------------------------------------
+
+# Above this much buffered outbound data, writers await drain() so a slow
+# peer applies backpressure instead of unbounded memory growth.
+_DRAIN_THRESHOLD = 8 << 20
+
+
+class CoalescingWriter:
+    """Batches frames produced within one event-loop tick into one
+    transport write (one syscall), instead of a send() per frame.
+
+    All methods must run on the event loop. Small frames dominate the
+    control plane; a burst of replies/calls in one tick becomes a single
+    b"".join + write. Large frames are written directly (no join copy).
+    """
+
+    __slots__ = ("_writer", "_buf", "_buf_bytes", "_scheduled")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._buf: list = []
+        self._buf_bytes = 0
+        self._scheduled = False
+
+    def write(self, data: bytes):
+        if len(data) >= (1 << 16):
+            self._flush()
+            self._writer.write(data)
+            return
+        self._buf.append(data)
+        self._buf_bytes += len(data)
+        if not self._scheduled:
+            self._scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self):
+        self._scheduled = False
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        self._buf_bytes = 0
+        try:
+            if len(buf) == 1:
+                self._writer.write(buf[0])
+            else:
+                self._writer.write(b"".join(buf))
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+    def needs_drain(self) -> bool:
+        transport = self._writer.transport
+        size = transport.get_write_buffer_size() if transport else 0
+        return size + self._buf_bytes > _DRAIN_THRESHOLD
+
+    async def drain(self):
+        self._flush()
+        try:
+            await self._writer.drain()
+        except (ConnectionResetError, RuntimeError):
+            pass
+
+
+# --------------------------------------------------------------------------
+# Native I/O core plumbing
+# --------------------------------------------------------------------------
+
+_native_checked = False
+_native_instance = None
+
+
+def _native_io():
+    """The process NativeIO singleton, or None (build failure / disabled)."""
+    global _native_checked, _native_instance
+    if not _native_checked:
+        try:
+            from .._native.fastrpc import NativeIO
+            _native_instance = NativeIO.get()
+        except Exception:
+            logger.exception("native rpc unavailable; using asyncio")
+            _native_instance = None
+        _native_checked = True
+    return _native_instance
+
+
+async def _native_drain_wait(nio, conn_id: int):
+    """Poll-based backpressure: wait until the native out-queue drains."""
+    while nio.out_bytes(conn_id) > _DRAIN_THRESHOLD // 2:
+        await asyncio.sleep(0.005)
+
+
+class NativeCoalescer:
+    """Per-connection frame batcher for the native transport: frames
+    produced within one loop tick become one ctypes send (one buffer copy,
+    one io-thread wakeup). Mirrors CoalescingWriter for asyncio."""
+
+    __slots__ = ("_nio", "_conn", "_buf", "_scheduled")
+
+    def __init__(self, nio, conn_id: int):
+        self._nio = nio
+        self._conn = conn_id
+        self._buf: list = []
+        self._scheduled = False
+
+    def write(self, frame: bytes) -> bool:
+        if len(frame) >= (1 << 16):
+            self._flush()
+            return self._nio.send(self._conn, frame)
+        self._buf.append(frame)
+        if not self._scheduled:
+            self._scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+        return True
+
+    def _flush(self):
+        self._scheduled = False
+        if not self._buf:
+            return
+        buf, self._buf = self._buf, []
+        self._nio.send(self._conn,
+                       buf[0] if len(buf) == 1 else b"".join(buf))
+
+
+# --------------------------------------------------------------------------
 # Server
 # --------------------------------------------------------------------------
 
@@ -139,6 +362,9 @@ class RpcServer:
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.address: Optional[Address] = None
+        self._native = None            # NativeIO when serving natively
+        self._native_listener: Optional[int] = None
+        self._native_conns: set = set()
 
     def register(self, method: str, handler: Handler):
         self._handlers[method] = handler
@@ -150,6 +376,18 @@ class RpcServer:
                 self.register(prefix + attr[len("handle_"):], getattr(obj, attr))
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        nio = _native_io()
+        if nio is not None:
+            nio.attach(asyncio.get_running_loop())
+            res = nio.listen(host, port, self._native_accept)
+            if res is not None:
+                self._native = nio
+                self._native_listener, bound_port = res
+                self.address = (host, bound_port)
+                with _local_servers_lock:
+                    _local_servers[self.address] = self
+                return self.address
+            logger.warning("native listen failed; falling back to asyncio")
         self._server = await asyncio.start_server(self._on_conn, host, port)
         sock = self._server.sockets[0]
         self.address = sock.getsockname()[:2]
@@ -158,6 +396,12 @@ class RpcServer:
         return self.address
 
     async def stop(self):
+        if self._native is not None:
+            self._native.close(self._native_listener,
+                               listener_id=self._native_listener)
+            for conn in list(self._native_conns):
+                self._native.close(conn)
+            self._native_conns.clear()
         if self._server is not None:
             self._server.close()
             try:
@@ -176,17 +420,48 @@ class RpcServer:
             raise RpcError(f"{self.name}: no handler for method {method!r}")
         return await handler(**payload)
 
+    # -- native transport ------------------------------------------------
+
+    def _native_accept(self, conn_id: int):
+        self._native_conns.add(conn_id)
+        coalescer = NativeCoalescer(self._native, conn_id)
+
+        def sink(kind, body):
+            if kind == 2:  # closed
+                self._native_conns.discard(conn_id)
+                return
+            msg_id, _flags, method, payload = unpack_body(body)
+            asyncio.ensure_future(
+                self._handle_request(method, payload, msg_id,
+                                     self._native_reply, coalescer))
+        return sink
+
+    def _native_reply(self, coalescer: "NativeCoalescer", frame: bytes):
+        coalescer.write(frame)
+        if self._native.out_bytes(coalescer._conn) > _DRAIN_THRESHOLD:
+            return _native_drain_wait(self._native, coalescer._conn)
+
+    # -- asyncio transport -----------------------------------------------
+
     async def _on_conn(self, reader: asyncio.StreamReader,
                        writer: asyncio.StreamWriter):
+        cw = CoalescingWriter(writer)
+        frames = FrameReader()
+
+        def reply(_conn, frame):
+            cw.write(frame)
+            if cw.needs_drain():
+                return cw.drain()
         try:
-            unpacker = msgpack.Unpacker(raw=False, max_buffer_size=1 << 31)
             while True:
                 chunk = await reader.read(1 << 20)
                 if not chunk:
                     break
-                unpacker.feed(chunk)
-                for msg in unpacker:
-                    asyncio.ensure_future(self._handle_msg(msg, writer))
+                for body in frames.feed(chunk):
+                    msg_id, _flags, method, payload = unpack_body(body)
+                    asyncio.ensure_future(
+                        self._handle_request(method, payload, msg_id,
+                                             reply, None))
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -195,14 +470,15 @@ class RpcServer:
             except Exception:
                 pass
 
-    async def _handle_msg(self, msg: Dict[str, Any],
-                          writer: asyncio.StreamWriter):
-        method = msg["m"]
+    # -- shared dispatch -------------------------------------------------
+
+    async def _handle_request(self, method: str, payload: bytes,
+                              msg_id: int, reply, conn):
         if CHAOS.drop_request(method):
             return
         try:
-            payload = serialization.loads(msg["p"]) if msg["p"] else {}
-            result = await self._dispatch(method, payload)
+            kwargs = serialization.loads(payload) if payload else {}
+            result = await self._dispatch(method, kwargs)
             ok, body = True, result
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             ok, body = False, e
@@ -211,13 +487,12 @@ class RpcServer:
         try:
             data = serialization.dumps(body)
         except Exception as e:
-            ok, data = False, serialization.dumps(RpcError(f"unpicklable reply: {e}"))
-        out = msgpack.packb({"i": msg["i"], "ok": ok, "p": data})
-        try:
-            writer.write(out)
-            await writer.drain()
-        except (ConnectionResetError, RuntimeError):
-            pass
+            ok, data = False, serialization.dumps(
+                RpcError(f"unpicklable reply: {e}"))
+        flags = FLAG_RESP | (FLAG_OK if ok else 0)
+        waiter = reply(conn, pack_frame(msg_id, flags, b"", data))
+        if waiter is not None:
+            await waiter  # transport backpressure
 
 
 # --------------------------------------------------------------------------
@@ -230,6 +505,10 @@ class RpcClient:
     def __init__(self, address: Address):
         self.address = (address[0], int(address[1]))
         self._writer: Optional[asyncio.StreamWriter] = None
+        self._cw: Optional[CoalescingWriter] = None
+        self._native = None           # NativeIO when connected natively
+        self._native_conn: Optional[int] = None
+        self._native_cw: Optional["NativeCoalescer"] = None
         self._pending: Dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._conn_lock: Optional[asyncio.Lock] = None
@@ -239,30 +518,65 @@ class RpcClient:
         with _local_servers_lock:
             return _local_servers.get(self.address)
 
+    def _connected(self) -> bool:
+        if self._native_conn is not None:
+            return True
+        return self._writer is not None and not self._writer.is_closing()
+
     async def _ensure_conn(self):
         if self._conn_lock is None:
             self._conn_lock = asyncio.Lock()
         async with self._conn_lock:
-            if self._writer is not None and not self._writer.is_closing():
+            if self._connected():
+                return
+            nio = _native_io()
+            if nio is not None:
+                loop = asyncio.get_running_loop()
+                nio.attach(loop)
+                host, port = self.address
+                timeout_ms = int(CONFIG.rpc_connect_timeout_s * 1000)
+                conn = await loop.run_in_executor(
+                    None, nio.connect, host, port, timeout_ms)
+                if conn is None:
+                    raise ConnectionError(
+                        f"connect to {self.address} failed")
+                self._native = nio
+                self._native_conn = conn
+                self._native_cw = NativeCoalescer(nio, conn)
+                # On the loop: safe w.r.t. _drain's orphan buffering. A
+                # close that raced the connect flushes here and fails the
+                # (not yet issued) calls via _fail_pending.
+                nio.register(conn, self._on_native_event)
                 return
             reader, writer = await asyncio.wait_for(
                 asyncio.open_connection(*self.address),
                 CONFIG.rpc_connect_timeout_s)
             self._writer = writer
+            self._cw = CoalescingWriter(writer)
             self._reader_task = asyncio.ensure_future(self._read_loop(reader))
 
+    def _on_native_event(self, kind: int, body):
+        if kind == 2:  # closed
+            self._fail_pending(
+                RpcError(f"connection to {self.address} closed"))
+            return
+        msg_id, flags, _method, payload = unpack_body(body)
+        fut = self._pending.pop(msg_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result((flags, payload))
+
     async def _read_loop(self, reader: asyncio.StreamReader):
-        unpacker = msgpack.Unpacker(raw=False, max_buffer_size=1 << 31)
+        frames = FrameReader()
         try:
             while True:
                 chunk = await reader.read(1 << 20)
                 if not chunk:
                     break
-                unpacker.feed(chunk)
-                for msg in unpacker:
-                    fut = self._pending.pop(msg["i"], None)
+                for body in frames.feed(chunk):
+                    msg_id, flags, _method, payload = unpack_body(body)
+                    fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
-                        fut.set_result(msg)
+                        fut.set_result((flags, payload))
         except Exception as e:
             self._fail_pending(RpcError(f"connection to {self.address} lost: {e}"))
             return
@@ -270,6 +584,11 @@ class RpcClient:
 
     def _fail_pending(self, err: Exception):
         self._writer = None
+        self._cw = None
+        if self._native_conn is not None and self._native is not None:
+            self._native.close(self._native_conn)
+        self._native_conn = None
+        self._native_cw = None
         pending, self._pending = self._pending, {}
         for fut in pending.values():
             if not fut.done():
@@ -314,16 +633,25 @@ class RpcClient:
         msg_id = self._next_id
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        data = msgpack.packb({
-            "i": msg_id, "m": method, "p": serialization.dumps(payload)})
-        self._writer.write(data)
+        frame = pack_frame(msg_id, 0, method.encode(),
+                           serialization.dumps(payload) if payload else b"")
         try:
-            await self._writer.drain()
-            msg = await asyncio.wait_for(fut, timeout)
+            if self._native_conn is not None:
+                conn = self._native_conn
+                if not self._native_cw.write(frame):
+                    raise ConnectionError(f"send to {self.address} failed")
+                if self._native.out_bytes(conn) > _DRAIN_THRESHOLD:
+                    await _native_drain_wait(self._native, conn)
+            else:
+                cw = self._cw
+                cw.write(frame)
+                if cw.needs_drain():
+                    await cw.drain()
+            flags, data = await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(msg_id, None)
-        body = serialization.loads(msg["p"])
-        if not msg["ok"]:
+        body = serialization.loads(data)
+        if not (flags & FLAG_OK):
             raise body
         return body
 
@@ -345,6 +673,9 @@ class RpcClient:
             except Exception:
                 pass
         self._writer = None
+        if self._native_conn is not None and self._native is not None:
+            self._native.close(self._native_conn)
+            self._native_conn = None
 
 
 class ClientPool:

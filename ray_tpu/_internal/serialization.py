@@ -17,6 +17,7 @@ view over the store's mmap (no copy).
 
 from __future__ import annotations
 
+import io
 import pickle
 import struct
 import threading
@@ -126,9 +127,38 @@ def deserialize(data: bytes) -> Any:
     return deserialize_from_buffer(memoryview(data))
 
 
+class _NeedsCloudpickle(Exception):
+    pass
+
+
+class _StrictPickler(pickle.Pickler):
+    """Plain pickler that refuses anything plain pickle would encode
+    by-reference into the sender's `__main__` — the receiver's `__main__`
+    is a different module, so such pickles succeed locally but fail to
+    load remotely. Refusal triggers the cloudpickle fallback, which
+    encodes those by value (cloudpickle's own split, applied eagerly)."""
+
+    def reducer_override(self, obj):
+        if isinstance(obj, type) or callable(obj):
+            mod = getattr(obj, "__module__", None)
+            if mod in (None, "__main__", "__mp_main__"):
+                raise _NeedsCloudpickle
+        return NotImplemented
+
+
 def dumps(value: Any) -> bytes:
-    """Plain in-band cloudpickle (control-plane payloads, not objects)."""
-    return cloudpickle.dumps(value)
+    """In-band control-plane payload pickle (not user objects).
+
+    Plain pickle first — ~4x cheaper than cloudpickle for the framework
+    dataclasses (TaskSpec etc.) that dominate RPC traffic. Payloads
+    touching `__main__`-defined classes/functions, closures, or anything
+    else plain pickle can't represent portably fall back to cloudpickle."""
+    try:
+        buf = io.BytesIO()
+        _StrictPickler(buf, protocol=5).dump(value)
+        return buf.getvalue()
+    except Exception:
+        return cloudpickle.dumps(value)
 
 
 def loads(data: bytes) -> Any:

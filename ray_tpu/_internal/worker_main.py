@@ -20,6 +20,10 @@ def main():
     logging.basicConfig(
         level=logging.INFO,
         format="[worker %(process)d] %(levelname)s %(name)s: %(message)s")
+    if os.environ.get("RTPU_WORKER_PROFILE"):
+        # Dev/profiling hook: dump the io-loop thread's cProfile stats on
+        # SIGUSR1 to RTPU_WORKER_PROFILE/<pid>.prof.
+        _install_profile_hook(os.environ["RTPU_WORKER_PROFILE"])
     worker_id = bytes.fromhex(os.environ["RTPU_WORKER_ID"])
     session = os.environ["RTPU_SESSION"]
     node_id = os.environ["RTPU_NODE_ID"]
@@ -55,6 +59,36 @@ def main():
             logging.getLogger(__name__).warning(
                 "raylet unreachable; worker exiting")
             os._exit(1)
+
+
+def _install_profile_hook(out_dir: str):
+    import cProfile
+    import pstats
+    import signal
+    import threading
+
+    from .rpc import EventLoopThread
+
+    prof = cProfile.Profile()
+    state = {"on": False}
+
+    def toggle(_sig, _frm):
+        loop = EventLoopThread.get().loop
+        if not state["on"]:
+            state["on"] = True
+            loop.call_soon_threadsafe(prof.enable)
+        else:
+            state["on"] = False
+            loop.call_soon_threadsafe(prof.disable)
+
+            def dump():
+                os.makedirs(out_dir, exist_ok=True)
+                path = os.path.join(out_dir, f"{os.getpid()}.prof")
+                with open(path, "w") as f:
+                    pstats.Stats(prof, stream=f).sort_stats(
+                        "cumulative").print_stats(40)
+            threading.Thread(target=dump, daemon=True).start()
+    signal.signal(signal.SIGUSR1, toggle)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,208 @@
+"""ctypes binding for the native RPC I/O core (src/fastrpc.cpp).
+
+One NativeIO per process: owns the C epoll thread, routes received frames
+to the RpcServer / RpcClient that own each connection, and wakes the
+asyncio loop once per *batch* of messages via the core's notify eventfd
+(reference role: src/ray/rpc/ — gRPC's completion-queue threads).
+
+All routing callbacks run on the asyncio event loop thread.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+_U64 = struct.Struct("<Q")
+
+from .build import build_library
+
+logger = logging.getLogger(__name__)
+
+# kind codes from the C core
+KIND_FRAME = 0
+KIND_ACCEPT = 1
+KIND_CLOSED = 2
+
+_RECV_CAP = 1024
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    path = build_library("fastrpc")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.frpc_start.restype = ctypes.c_int
+    lib.frpc_listen.restype = ctypes.c_int64
+    lib.frpc_listen.argtypes = [ctypes.c_char_p,
+                                ctypes.POINTER(ctypes.c_int)]
+    lib.frpc_connect.restype = ctypes.c_int64
+    lib.frpc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.frpc_send.restype = ctypes.c_int
+    lib.frpc_send.argtypes = [ctypes.c_int64, ctypes.c_char_p,
+                              ctypes.c_uint64]
+    lib.frpc_out_bytes.restype = ctypes.c_uint64
+    lib.frpc_out_bytes.argtypes = [ctypes.c_int64]
+    lib.frpc_recv.restype = ctypes.c_int64
+    lib.frpc_recv.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64]
+    lib.frpc_next_len.restype = ctypes.c_uint64
+    lib.frpc_close.argtypes = [ctypes.c_int64]
+    return lib
+
+
+class NativeIO:
+    """Process singleton wrapping the native core + asyncio integration."""
+
+    _instance: Optional["NativeIO"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, lib: ctypes.CDLL, notify_fd: int):
+        self._lib = lib
+        self._notify_fd = notify_fd
+        self._attached_loop = None
+        # conn_id -> callable(kind, memoryview-body)
+        self._sinks: Dict[int, Callable[[int, memoryview], None]] = {}
+        # listener_id -> callable(conn_id) -> sink for accepted conns
+        self._listeners: Dict[int, Callable[[int], Callable]] = {}
+        # Events that raced registration: the C thread can deliver for a
+        # conn/listener id before connect()/listen() returns it to the
+        # caller. Buffered (copied) and flushed on registration.
+        self._orphans: Dict[int, list] = {}
+        self._buf = ctypes.create_string_buffer(4 << 20)
+        self._conn_ids = (ctypes.c_int64 * _RECV_CAP)()
+        self._kinds = (ctypes.c_uint8 * _RECV_CAP)()
+        self._offsets = (ctypes.c_uint64 * _RECV_CAP)()
+        self._lengths = (ctypes.c_uint64 * _RECV_CAP)()
+
+    @classmethod
+    def get(cls) -> Optional["NativeIO"]:
+        with cls._lock:
+            if cls._instance is None:
+                if os.environ.get("RTPU_DISABLE_NATIVE_RPC"):
+                    return None
+                lib = _load()
+                if lib is None:
+                    return None
+                fd = lib.frpc_start()
+                if fd < 0:
+                    return None
+                cls._instance = cls(lib, fd)
+            return cls._instance
+
+    # -- loop integration ------------------------------------------------
+
+    def attach(self, loop):
+        """Watch the notify eventfd on `loop`; must run on the loop."""
+        if self._attached_loop is loop:
+            return
+        if self._attached_loop is not None:
+            try:
+                self._attached_loop.remove_reader(self._notify_fd)
+            except Exception:
+                pass
+        self._attached_loop = loop
+        loop.add_reader(self._notify_fd, self._drain)
+
+    def _drain(self):
+        lib = self._lib
+        while True:
+            n = lib.frpc_recv(self._conn_ids, self._kinds, self._buf,
+                              len(self._buf), self._offsets, self._lengths,
+                              _RECV_CAP)
+            if n == 0:
+                need = lib.frpc_next_len()
+                if need > len(self._buf):
+                    self._buf = ctypes.create_string_buffer(
+                        int(need) + (1 << 20))
+                    continue
+                return
+            mv = memoryview(self._buf)
+            for i in range(n):
+                conn = self._conn_ids[i]
+                kind = self._kinds[i]
+                body = mv[self._offsets[i]:self._offsets[i] + self._lengths[i]]
+                self._dispatch(conn, kind, body)
+            if n < _RECV_CAP:
+                # queue drained (or next frame needs a larger buffer)
+                if lib.frpc_next_len() == 0:
+                    return
+
+    def _dispatch(self, conn: int, kind: int, body):
+        if kind == KIND_ACCEPT:
+            (lid,) = _U64.unpack(body)
+            factory = self._listeners.get(lid)
+            if factory is None:
+                # listen() hasn't registered the id yet — buffer (copy:
+                # the recv buffer is reused).
+                self._orphans.setdefault(lid, []).append(
+                    (conn, kind, bytes(body)))
+                return
+            self._register_accepted(conn, factory)
+            return
+        sink = self._sinks.get(conn)
+        if sink is None:
+            if len(self._orphans) > 1024:  # rogue peers must not leak
+                self._orphans.pop(next(iter(self._orphans)))
+            self._orphans.setdefault(conn, []).append(
+                (conn, kind, bytes(body)))
+            return
+        if kind != KIND_FRAME:
+            self._sinks.pop(conn, None)
+        try:
+            sink(kind, body)
+        except Exception:
+            logger.exception("native rpc sink failed")
+
+    def _register_accepted(self, conn: int, factory):
+        self._sinks[conn] = factory(conn)
+        self._flush_orphans_for_conn(conn)
+
+    def _flush_orphans_for_conn(self, conn: int):
+        for c, kind, body in self._orphans.pop(conn, ()):
+            self._dispatch(c, kind, body)
+
+    # -- operations ------------------------------------------------------
+    # listen/register run on the event loop (same thread as _drain), so
+    # the orphan-buffer check-then-act sequences cannot interleave.
+
+    def listen(self, host: str, port: int,
+               accept_factory: Callable[[int], Callable]
+               ) -> Optional[Tuple[int, int]]:
+        p = ctypes.c_int(port)
+        lid = self._lib.frpc_listen(host.encode(), ctypes.byref(p))
+        if lid < 0:
+            return None
+        self._listeners[lid] = accept_factory
+        for conn, kind, body in self._orphans.pop(lid, ()):
+            self._dispatch(conn, kind, body)
+        return lid, p.value
+
+    def connect(self, host: str, port: int, timeout_ms: int) -> Optional[int]:
+        """Raw connect (blocking; call off the loop). The caller must then
+        register(conn, sink) ON the loop before using the conn."""
+        conn = self._lib.frpc_connect(host.encode(), port, timeout_ms)
+        return None if conn < 0 else conn
+
+    def register(self, conn_id: int, sink: Callable[[int, memoryview], None]):
+        self._sinks[conn_id] = sink
+        self._flush_orphans_for_conn(conn_id)
+
+    def send(self, conn_id: int, frame: bytes) -> bool:
+        return self._lib.frpc_send(conn_id, frame, len(frame)) == 0
+
+    def out_bytes(self, conn_id: int) -> int:
+        return self._lib.frpc_out_bytes(conn_id)
+
+    def close(self, conn_id: int, listener_id: Optional[int] = None):
+        self._sinks.pop(conn_id, None)
+        if listener_id is not None:
+            self._listeners.pop(listener_id, None)
+        self._lib.frpc_close(conn_id)

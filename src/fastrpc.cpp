@@ -1,0 +1,522 @@
+// fastrpc: native RPC I/O core for the ray_tpu control plane.
+//
+// Role-equivalent of the reference's C++ gRPC transport layer
+// (src/ray/rpc/grpc_server.h, client_call.h): the per-message socket work —
+// accept/connect, length-delimited framing, batched writev, read-side frame
+// parsing — runs on one native epoll thread with no Python involvement.
+// Python (rpc.py) packs/unpacks frame *bodies* (header + pickled payload)
+// and drains received frames in batches through a single eventfd wakeup per
+// burst, so a storm of small control messages costs one GIL entry per batch
+// rather than one asyncio callback per message.
+//
+// Exposed as a C ABI for ctypes (pybind11 is not in the image):
+//   frpc_start()            -> notify eventfd (Python adds it to asyncio)
+//   frpc_listen(ip, &port)  -> listener id (port 0 = ephemeral, written back)
+//   frpc_connect(ip, port)  -> conn id
+//   frpc_send(conn, buf, n) -> 0/-1     (buf = one complete frame)
+//   frpc_recv(...)          -> batch of received frames/events
+//   frpc_out_bytes(conn)    -> queued-unsent bytes (backpressure probe)
+//   frpc_close(conn)
+//
+// Wire format (shared with the pure-Python asyncio fallback in rpc.py):
+//   u32le total_len, then `total_len` bytes of frame body. The body's
+//   layout (msg id, flags, method, payload) is parsed in Python.
+//
+// Event kinds delivered by frpc_recv:
+//   0 = frame (data = frame body)
+//   1 = accepted conn (data = u64le listener id)
+//   2 = conn closed (data empty)
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr size_t kReadChunk = 256 * 1024;
+constexpr size_t kMaxIov = 64;
+constexpr size_t kInHighWater = 256ULL * 1024 * 1024;
+
+struct Conn {
+  int fd = -1;
+  int64_t id = 0;
+  bool listener = false;
+  int64_t accepted_by = 0;  // listener id for accepted conns
+  // write side (producer: any python thread; consumer: epoll thread)
+  std::mutex out_mu;
+  std::deque<std::string> out;
+  size_t out_off = 0;
+  std::atomic<size_t> out_bytes{0};
+  bool want_write = false;  // epoll thread only
+  // read side (epoll thread only)
+  std::string in;
+  size_t in_off = 0;
+  bool closed = false;
+};
+
+struct InEvent {
+  int64_t conn;
+  uint8_t kind;
+  std::string data;
+};
+
+struct Core {
+  int epfd = -1;
+  int wakefd = -1;    // wake epoll thread (sends pending / close requests)
+  int notifyfd = -1;  // wake python (events pending)
+  std::thread thread;
+  std::mutex mu;  // conns map + pending registration lists
+  std::unordered_map<int64_t, Conn*> conns;
+  std::vector<Conn*> pending_add;
+  std::vector<int64_t> pending_close;
+  std::vector<int64_t> dirty;  // conns with newly queued output
+  std::atomic<int64_t> next_id{1};
+  // inbound event queue
+  std::mutex in_mu;
+  std::deque<InEvent> inq;
+  size_t inq_bytes = 0;
+  bool notified = false;
+  bool paused = false;  // EPOLLIN parked due to inq high-water
+};
+
+Core* g_core = nullptr;
+std::mutex g_start_mu;
+
+void set_nonblock(int fd) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void notify_python(Core* c) {
+  // caller holds in_mu
+  if (!c->notified) {
+    c->notified = true;
+    uint64_t one = 1;
+    ssize_t r = write(c->notifyfd, &one, sizeof(one));
+    (void)r;
+  }
+}
+
+void push_event(Core* c, int64_t conn, uint8_t kind, std::string data) {
+  std::lock_guard<std::mutex> lk(c->in_mu);
+  c->inq_bytes += data.size();
+  c->inq.push_back(InEvent{conn, kind, std::move(data)});
+  notify_python(c);
+}
+
+void epoll_mod(Core* c, Conn* conn) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (conn->want_write ? EPOLLOUT : 0);
+  ev.data.u64 = static_cast<uint64_t>(conn->id);
+  epoll_ctl(c->epfd, EPOLL_CTL_MOD, conn->fd, &ev);
+}
+
+void close_conn(Core* c, Conn* conn, bool deliver_event) {
+  if (conn->closed) return;
+  conn->closed = true;
+  epoll_ctl(c->epfd, EPOLL_CTL_DEL, conn->fd, nullptr);
+  close(conn->fd);
+  if (deliver_event && !conn->listener)
+    push_event(c, conn->id, 2, std::string());
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->conns.erase(conn->id);
+  // Conn object intentionally leaked until process exit would be wasteful;
+  // but python threads may still hold the id for frpc_send, which now
+  // fails by lookup. Safe to delete: lookups go through the map.
+  delete conn;
+}
+
+void handle_accept(Core* c, Conn* listener) {
+  for (;;) {
+    int fd = accept4(listener->fd, nullptr, nullptr, SOCK_NONBLOCK);
+    if (fd < 0) return;
+    set_nodelay(fd);
+    Conn* conn = new Conn();
+    conn->fd = fd;
+    conn->id = c->next_id.fetch_add(1);
+    conn->accepted_by = listener->id;
+    {
+      std::lock_guard<std::mutex> lk(c->mu);
+      c->conns[conn->id] = conn;
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = static_cast<uint64_t>(conn->id);
+    epoll_ctl(c->epfd, EPOLL_CTL_ADD, fd, &ev);
+    std::string payload(8, '\0');
+    uint64_t lid = static_cast<uint64_t>(listener->id);
+    memcpy(&payload[0], &lid, 8);
+    push_event(c, conn->id, 1, std::move(payload));
+  }
+}
+
+// Parse complete frames out of conn->in; deliver bodies to the in-queue.
+void parse_frames(Core* c, Conn* conn) {
+  std::string& buf = conn->in;
+  size_t off = conn->in_off;
+  for (;;) {
+    if (buf.size() - off < 4) break;
+    uint32_t len;
+    memcpy(&len, buf.data() + off, 4);
+    if (buf.size() - off - 4 < len) break;
+    push_event(c, conn->id, 0, buf.substr(off + 4, len));
+    off += 4 + static_cast<size_t>(len);
+  }
+  if (off == buf.size()) {
+    buf.clear();
+    conn->in_off = 0;
+  } else if (off > (1 << 20)) {
+    buf.erase(0, off);
+    conn->in_off = 0;
+  } else {
+    conn->in_off = off;
+  }
+}
+
+void handle_read(Core* c, Conn* conn) {
+  char tmp[kReadChunk];
+  for (;;) {
+    ssize_t n = read(conn->fd, tmp, sizeof(tmp));
+    if (n > 0) {
+      conn->in.append(tmp, static_cast<size_t>(n));
+      parse_frames(c, conn);
+      if (n < static_cast<ssize_t>(sizeof(tmp))) return;
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
+    close_conn(c, conn, true);
+    return;
+  }
+}
+
+void handle_write(Core* c, Conn* conn) {
+  std::unique_lock<std::mutex> lk(conn->out_mu);
+  while (!conn->out.empty()) {
+    iovec iov[kMaxIov];
+    size_t n_iov = 0;
+    size_t first_off = conn->out_off;
+    for (auto it = conn->out.begin();
+         it != conn->out.end() && n_iov < kMaxIov; ++it, ++n_iov) {
+      const std::string& s = *it;
+      size_t skip = (n_iov == 0) ? first_off : 0;
+      iov[n_iov].iov_base = const_cast<char*>(s.data()) + skip;
+      iov[n_iov].iov_len = s.size() - skip;
+    }
+    ssize_t written = writev(conn->fd, iov, static_cast<int>(n_iov));
+    if (written < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      lk.unlock();
+      close_conn(c, conn, true);
+      return;
+    }
+    size_t w = static_cast<size_t>(written);
+    conn->out_bytes.fetch_sub(w);
+    while (w > 0 && !conn->out.empty()) {
+      std::string& front = conn->out.front();
+      size_t avail = front.size() - conn->out_off;
+      if (w >= avail) {
+        w -= avail;
+        conn->out.pop_front();
+        conn->out_off = 0;
+      } else {
+        conn->out_off += w;
+        w = 0;
+      }
+    }
+  }
+  bool need = !conn->out.empty();
+  if (need != conn->want_write) {
+    conn->want_write = need;
+    epoll_mod(c, conn);
+  }
+}
+
+void io_loop(Core* c) {
+  epoll_event evs[128];
+  for (;;) {
+    int n = epoll_wait(c->epfd, evs, 128, 1000);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return;
+    }
+    // Drain registration/close/wake requests.
+    {
+      std::vector<Conn*> add;
+      std::vector<int64_t> closes;
+      {
+        std::lock_guard<std::mutex> lk(c->mu);
+        add.swap(c->pending_add);
+        closes.swap(c->pending_close);
+      }
+      for (Conn* conn : add) {
+        epoll_event ev{};
+        ev.events = EPOLLIN;
+        ev.data.u64 = static_cast<uint64_t>(conn->id);
+        epoll_ctl(c->epfd, EPOLL_CTL_ADD, conn->fd, &ev);
+      }
+      for (int64_t id : closes) {
+        Conn* conn = nullptr;
+        {
+          std::lock_guard<std::mutex> lk(c->mu);
+          auto it = c->conns.find(id);
+          if (it != c->conns.end()) conn = it->second;
+        }
+        if (conn) close_conn(c, conn, false);
+      }
+    }
+    for (int i = 0; i < n; i++) {
+      uint64_t id = evs[i].data.u64;
+      if (id == 0) {  // wake eventfd
+        uint64_t buf;
+        ssize_t r = read(c->wakefd, &buf, 8);
+        (void)r;
+        // Flush exactly the conns marked dirty by frpc_send.
+        std::vector<Conn*> flush;
+        {
+          std::lock_guard<std::mutex> lk(c->mu);
+          for (int64_t cid : c->dirty) {
+            auto it = c->conns.find(cid);
+            if (it != c->conns.end() && !it->second->listener)
+              flush.push_back(it->second);
+          }
+          c->dirty.clear();
+        }
+        for (Conn* conn : flush) handle_write(c, conn);
+        continue;
+      }
+      Conn* conn = nullptr;
+      {
+        std::lock_guard<std::mutex> lk(c->mu);
+        auto it = c->conns.find(static_cast<int64_t>(id));
+        if (it != c->conns.end()) conn = it->second;
+      }
+      if (!conn) continue;
+      if (conn->listener) {
+        handle_accept(c, conn);
+        continue;
+      }
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(c, conn, true);
+        continue;
+      }
+      if (evs[i].events & EPOLLOUT) handle_write(c, conn);
+      if (evs[i].events & EPOLLIN) handle_read(c, conn);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Starts the io thread; returns the notify eventfd for Python to watch,
+// or -1 on failure. Idempotent.
+int frpc_start() {
+  std::lock_guard<std::mutex> lk(g_start_mu);
+  if (g_core) return g_core->notifyfd;
+  Core* c = new Core();
+  c->epfd = epoll_create1(EPOLL_CLOEXEC);
+  c->wakefd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  c->notifyfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (c->epfd < 0 || c->wakefd < 0 || c->notifyfd < 0) {
+    delete c;
+    return -1;
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // id 0 = wake
+  epoll_ctl(c->epfd, EPOLL_CTL_ADD, c->wakefd, &ev);
+  c->thread = std::thread(io_loop, c);
+  c->thread.detach();
+  g_core = c;
+  return c->notifyfd;
+}
+
+int64_t frpc_listen(const char* ip, int* port_inout) {
+  Core* c = g_core;
+  if (!c) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(*port_inout));
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      listen(fd, 512) < 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  *port_inout = ntohs(addr.sin_port);
+  Conn* conn = new Conn();
+  conn->fd = fd;
+  conn->id = c->next_id.fetch_add(1);
+  conn->listener = true;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->conns[conn->id] = conn;
+    c->pending_add.push_back(conn);
+  }
+  uint64_t onev = 1;
+  ssize_t r = write(c->wakefd, &onev, 8);
+  (void)r;
+  return conn->id;
+}
+
+int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
+  Core* c = g_core;
+  if (!c) return -1;
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, ip, &addr.sin_addr);
+  // Bounded blocking connect (callers invoke off the event loop).
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    close(fd);
+    return -1;
+  }
+  set_nonblock(fd);
+  set_nodelay(fd);
+  Conn* conn = new Conn();
+  conn->fd = fd;
+  conn->id = c->next_id.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->conns[conn->id] = conn;
+    c->pending_add.push_back(conn);
+  }
+  uint64_t onev = 1;
+  ssize_t r = write(c->wakefd, &onev, 8);
+  (void)r;
+  return conn->id;
+}
+
+// Queue one frame (caller passes the 4-byte length prefix + body already
+// packed). Thread-safe. Returns 0, or -1 if the conn is gone.
+int frpc_send(int64_t conn_id, const void* buf, uint64_t len) {
+  Core* c = g_core;
+  if (!c) return -1;
+  bool wake;
+  {
+    // Hold the registry lock across the enqueue: close_conn deletes the
+    // Conn under this lock, so holding it here excludes use-after-free.
+    std::lock_guard<std::mutex> lk(c->mu);
+    auto it = c->conns.find(conn_id);
+    if (it == c->conns.end()) return -1;
+    Conn* conn = it->second;
+    {
+      std::lock_guard<std::mutex> olk(conn->out_mu);
+      conn->out.emplace_back(static_cast<const char*>(buf), len);
+      conn->out_bytes.fetch_add(len);
+    }
+    // Wake the io thread only on empty->dirty transition: a burst of
+    // sends to one conn costs one eventfd write + one flush pass.
+    wake = c->dirty.empty();
+    bool already = false;
+    for (int64_t d : c->dirty)
+      if (d == conn_id) { already = true; break; }
+    if (!already) c->dirty.push_back(conn_id);
+  }
+  if (wake) {
+    uint64_t one = 1;
+    ssize_t r = write(c->wakefd, &one, 8);
+    (void)r;
+  }
+  return 0;
+}
+
+uint64_t frpc_out_bytes(int64_t conn_id) {
+  Core* c = g_core;
+  if (!c) return 0;
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->conns.find(conn_id);
+  return it == c->conns.end() ? 0 : it->second->out_bytes.load();
+}
+
+// Drain up to `cap` pending events whose bodies fit in out_buf (first
+// event always delivered even if larger than buf_cap... callers size
+// buf generously). Parallel output arrays describe each event. Returns
+// the number of events written.
+int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
+                  uint64_t buf_cap, uint64_t* offsets, uint64_t* lengths,
+                  int64_t cap) {
+  Core* c = g_core;
+  if (!c) return 0;
+  std::lock_guard<std::mutex> lk(c->in_mu);
+  int64_t n = 0;
+  uint64_t used = 0;
+  while (n < cap && !c->inq.empty()) {
+    InEvent& e = c->inq.front();
+    if (n > 0 && used + e.data.size() > buf_cap) break;
+    if (e.data.size() > buf_cap) break;  // caller must grow its buffer
+    memcpy(out_buf + used, e.data.data(), e.data.size());
+    conn_ids[n] = e.conn;
+    kinds[n] = e.kind;
+    offsets[n] = used;
+    lengths[n] = e.data.size();
+    used += e.data.size();
+    c->inq_bytes -= e.data.size();
+    c->inq.pop_front();
+    n++;
+  }
+  if (c->inq.empty()) {
+    c->notified = false;
+    uint64_t buf;
+    ssize_t r = read(c->notifyfd, &buf, 8);
+    (void)r;
+  }
+  return n;
+}
+
+// Size of the next pending event (0 if none) — lets Python grow its
+// receive buffer before a frpc_recv that would otherwise stall.
+uint64_t frpc_next_len(void) {
+  Core* c = g_core;
+  if (!c) return 0;
+  std::lock_guard<std::mutex> lk(c->in_mu);
+  return c->inq.empty() ? 0 : c->inq.front().data.size();
+}
+
+void frpc_close(int64_t conn_id) {
+  Core* c = g_core;
+  if (!c) return;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->pending_close.push_back(conn_id);
+  }
+  uint64_t one = 1;
+  ssize_t r = write(c->wakefd, &one, 8);
+  (void)r;
+}
+
+}  // extern "C"
