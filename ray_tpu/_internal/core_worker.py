@@ -751,12 +751,27 @@ class ActorClientState:
     inflight: Dict[int, TaskSpec] = field(default_factory=dict)
     death_cause: str = ""
     reconciling: bool = False
+    # One-way push stream: specs accumulated within a loop tick go out as
+    # a single push_actor_tasks message.
+    sendq: List[TaskSpec] = field(default_factory=list)
+    flush_scheduled: bool = False
 
 
 class ActorTaskSubmitter:
+    """Actor task stream (reference: actor_task_submitter.cc PushActorTask).
+
+    Pushes are one-way and batched per loop tick; completions return on a
+    batched `actor_tasks_done` stream keyed by task id. The worker orders
+    execution by per-caller sequence number (so push reordering is safe)
+    and dedups redelivered seqs via its reply cache. Loss of either stream
+    is recovered through GCS actor-state pubsub + reconcile polling, which
+    resubmits or fails whatever is still marked in flight."""
+
     def __init__(self, core_worker: "CoreWorker"):
         self._cw = core_worker
         self._actors: Dict[ActorID, ActorClientState] = {}
+        # task_id -> (state, spec) for tasks pushed and not yet reported
+        self._awaiting: Dict[TaskID, Tuple[ActorClientState, TaskSpec]] = {}
         self._subscribed = False
 
     def state_for(self, actor_id: ActorID) -> ActorClientState:
@@ -807,18 +822,50 @@ class ActorTaskSubmitter:
             # tombstone the executor completes without running user code.
             spec.method_name = "__rtpu_cancelled__"
         st.inflight[spec.sequence_number] = spec
+        self._awaiting[spec.task_id] = (st, spec)
+        st.sendq.append(spec)
+        if not st.flush_scheduled:
+            st.flush_scheduled = True
+            asyncio.get_running_loop().call_soon(
+                lambda: asyncio.ensure_future(self._flush(st)))
+
+    async def _flush(self, st: ActorClientState):
+        st.flush_scheduled = False
+        if not st.sendq:
+            return
+        if st.state != "ALIVE" or st.address is None:
+            # Address lost between enqueue and flush: park in queued; the
+            # next ALIVE update re-pushes. Only specs still awaiting are
+            # ours to park (an actor-state update may have reclaimed them).
+            for spec in st.sendq:
+                if self._awaiting.pop(spec.task_id, None) is not None:
+                    st.inflight.pop(spec.sequence_number, None)
+                    st.queued.append(spec)
+            st.sendq = []
+            return
+        specs, st.sendq = st.sendq, []
         worker = self._cw.clients.get(st.address)
         try:
-            reply = await worker.call("push_task", spec=spec, timeout=None)
+            await worker.oneway("push_actor_tasks", specs=specs,
+                                done_to=self._cw.rpc_address)
         except Exception:
-            st.inflight.pop(spec.sequence_number, None)
-            st.queued.append(spec)
+            for spec in specs:
+                if self._awaiting.pop(spec.task_id, None) is not None:
+                    st.inflight.pop(spec.sequence_number, None)
+                    st.queued.append(spec)
             # Either the actor is dying/restarting (the GCS will publish an
             # update that drains the queue) or this was a transient transport
             # failure with the actor still healthy — reconcile with the GCS
             # rather than parking forever.
             asyncio.ensure_future(self._reconcile(st))
+
+    def on_done(self, task_id: TaskID, reply: Dict[str, Any]):
+        """A completion from the actor's done stream (possibly duplicated
+        on redelivery; only the first report wins)."""
+        entry = self._awaiting.pop(task_id, None)
+        if entry is None:
             return
+        st, spec = entry
         st.inflight.pop(spec.sequence_number, None)
         error = reply.get("error")
         if error is not None:
@@ -880,6 +927,9 @@ class ActorTaskSubmitter:
                              key=lambda s: s.sequence_number)
             st.queued = []
             st.inflight = {}
+            st.sendq = []  # unsent specs are in inflight, hence in pending
+            for spec in pending:
+                self._awaiting.pop(spec.task_id, None)
             if restarted:
                 # New actor instance: renumber surviving tasks from 0.
                 st.seq = 0
@@ -897,7 +947,9 @@ class ActorTaskSubmitter:
             pending = st.queued + list(st.inflight.values())
             st.queued = []
             st.inflight = {}
+            st.sendq = []
             for spec in pending:
+                self._awaiting.pop(spec.task_id, None)
                 self._fail(spec, st.death_cause)
 
 
@@ -1267,6 +1319,7 @@ class CoreWorker:
         self._job_envs: Dict[JobID, "asyncio.Future"] = {}
         self._pending_frees: List[str] = []
         self._free_lock = threading.Lock()
+        self._done_batches: Dict[Address, List] = {}
         self._shutdown = False
 
     # -- lifecycle -------------------------------------------------------
@@ -1606,6 +1659,41 @@ class CoreWorker:
         if lease_id is not None:
             self.current_lease_id = lease_id
         return await self.executor.execute(spec)
+
+    async def handle_push_actor_tasks(self, specs: List[TaskSpec],
+                                      done_to):
+        """One-way actor task stream (reference: PushActorTask). Each spec
+        executes under the actor's sequence ordering; completions flow
+        back on the batched `actor_tasks_done` stream to `done_to`."""
+        done_to = tuple(done_to)
+        for spec in specs:
+            asyncio.ensure_future(self._exec_and_report(spec, done_to))
+
+    async def _exec_and_report(self, spec: TaskSpec, done_to: Address):
+        try:
+            reply = await self.executor.execute(spec)
+        except BaseException as e:  # noqa: BLE001 — must report something
+            reply = {"error": TaskError(spec.method_name,
+                                        f"executor failed: {e}")}
+        q = self._done_batches.setdefault(done_to, [])
+        q.append((spec.task_id.hex(), reply))
+        if len(q) == 1:
+            asyncio.get_running_loop().call_soon(
+                lambda: asyncio.ensure_future(self._flush_done(done_to)))
+
+    async def _flush_done(self, done_to: Address):
+        results = self._done_batches.pop(done_to, [])
+        if not results:
+            return
+        client = self.clients.get(done_to)
+        try:
+            await client.oneway("actor_tasks_done", results=results)
+        except Exception:
+            pass  # owner unreachable; actor-state pubsub recovers the rest
+
+    async def handle_actor_tasks_done(self, results):
+        for task_hex, reply in results:
+            self.actor_submitter.on_done(TaskID.from_hex(task_hex), reply)
 
     async def handle_get_object(self, object_hex: str):
         oid = ObjectID.from_hex(object_hex)

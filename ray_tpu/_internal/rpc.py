@@ -482,6 +482,10 @@ class RpcServer:
             ok, body = True, result
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             ok, body = False, e
+            if msg_id == 0:
+                logger.warning("one-way rpc %s failed: %s", method, e)
+        if msg_id == 0:
+            return  # one-way message: no response frame
         if CHAOS.drop_response(method):
             return
         try:
@@ -654,6 +658,31 @@ class RpcClient:
         if not (flags & FLAG_OK):
             raise body
         return body
+
+    async def oneway(self, method: str, **kwargs):
+        """Send a message expecting no response (msg id 0). Loses silently
+        on transport failure mid-flight; callers rely on higher-level
+        liveness (GCS health/pubsub) for recovery. Raises only if no
+        connection can be established."""
+        local = self._local()
+        if local is not None:
+            if not CHAOS.drop_request(method):
+                asyncio.ensure_future(local._dispatch(method, kwargs))
+            return
+        await self._ensure_conn()
+        frame = pack_frame(0, 0, method.encode(),
+                           serialization.dumps(kwargs) if kwargs else b"")
+        if self._native_conn is not None:
+            conn = self._native_conn
+            if not self._native_cw.write(frame):
+                raise ConnectionError(f"send to {self.address} failed")
+            if self._native.out_bytes(conn) > _DRAIN_THRESHOLD:
+                await _native_drain_wait(self._native, conn)
+        else:
+            cw = self._cw
+            cw.write(frame)
+            if cw.needs_drain():
+                await cw.drain()
 
     def call_sync(self, method: str, timeout: Optional[float] = DEFAULT_TIMEOUT,
                   retries: int = 0, **kwargs) -> Any:
