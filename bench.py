@@ -36,24 +36,38 @@ def peak_flops(device) -> float:
 
 
 def main():
+    import optax
+
     from ray_tpu.models import LlamaConfig, LlamaModel, cross_entropy_loss
     from ray_tpu.parallel import (MeshConfig, create_train_state,
                                   default_optimizer, make_train_step)
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        config = LlamaConfig.bench_350m()
-        batch, seq, steps = 4, 2048, 20
+        # ~1.26B params (VERDICT r2 item 3: bench the 7B-class path, not
+        # 350M). 16 heads of head_dim=128 keep the MXU's 128-wide
+        # contraction full. Memory budget on one v5e (16 GB HBM):
+        # fp32 params 5.0 GB + adafactor's factored second moments (~row+
+        # col vectors, MBs) + remat'd activations + donated bf16 grads.
+        # AdamW's m/v would add +10 GB and spill; adafactor is the
+        # standard TPU memory-frugal choice (T5/PaLM lineage).
+        config = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=22, num_heads=16, num_kv_heads=16, max_seq_len=2048)
+        batch, seq, steps = 4, 2048, 12
+        tx = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adafactor(learning_rate=1e-3))
     else:
         config = LlamaConfig.tiny_test()
         batch, seq, steps = 4, 256, 5
+        tx = default_optimizer(total_steps=1000)
 
     mesh = MeshConfig(data=-1).build()
     model = LlamaModel(config)
     tokens = jnp.zeros((batch, seq), jnp.int32)
     state = create_train_state(
-        jax.random.PRNGKey(0), model, tokens, mesh,
-        default_optimizer(total_steps=1000))
+        jax.random.PRNGKey(0), model, tokens, mesh, tx)
 
     def loss_fn(params, batch_data):
         logits = model.apply({"params": params}, batch_data["tokens"])

@@ -772,7 +772,9 @@ class ActorTaskSubmitter:
         self._actors: Dict[ActorID, ActorClientState] = {}
         # task_id -> (state, spec) for tasks pushed and not yet reported
         self._awaiting: Dict[TaskID, Tuple[ActorClientState, TaskSpec]] = {}
+        self._push_time: Dict[TaskID, float] = {}
         self._subscribed = False
+        self._sweeper_started = False
 
     def state_for(self, actor_id: ActorID) -> ActorClientState:
         st = self._actors.get(actor_id)
@@ -823,11 +825,15 @@ class ActorTaskSubmitter:
             spec.method_name = "__rtpu_cancelled__"
         st.inflight[spec.sequence_number] = spec
         self._awaiting[spec.task_id] = (st, spec)
+        self._push_time[spec.task_id] = time.monotonic()
         st.sendq.append(spec)
         if not st.flush_scheduled:
             st.flush_scheduled = True
             asyncio.get_running_loop().call_soon(
                 lambda: asyncio.ensure_future(self._flush(st)))
+        if not self._sweeper_started:
+            self._sweeper_started = True
+            asyncio.ensure_future(self._straggler_sweep())
 
     async def _flush(self, st: ActorClientState):
         st.flush_scheduled = False
@@ -863,16 +869,85 @@ class ActorTaskSubmitter:
         """A completion from the actor's done stream (possibly duplicated
         on redelivery; only the first report wins)."""
         entry = self._awaiting.pop(task_id, None)
+        self._push_time.pop(task_id, None)
         if entry is None:
             return
         st, spec = entry
         st.inflight.pop(spec.sequence_number, None)
+        sys_err = reply.get("system_error")
+        if sys_err is not None:
+            # Worker-side infrastructure failure: resend (bounded), the
+            # analog of the old request/response path's requeue.
+            if spec.attempt_number < 3:
+                spec.attempt_number += 1
+                asyncio.ensure_future(self._push(st, spec))
+            else:
+                self._fail(spec, sys_err)
+            return
         error = reply.get("error")
         if error is not None:
             self._cw.task_manager.on_failed(spec, error,
                                             is_application_error=True)
         else:
             self._cw.task_manager.on_completed(spec, reply)
+
+    async def _straggler_sweep(self):
+        """Backstop for lost done-stream messages (the oneway push/done
+        frames vanish if a connection drops mid-flight while the actor
+        stays ALIVE — no pubsub update will ever fire). Periodically asks
+        each actor for the status of long-outstanding tasks; cached
+        replies are recovered, never-arrived pushes are resent."""
+        while not self._cw._shutdown:
+            await asyncio.sleep(10.0)
+            try:
+                await self._sweep_once(30.0)
+            except Exception:
+                logger.exception("actor straggler sweep failed")
+
+    async def _sweep_once(self, age_s: float):
+        now = time.monotonic()
+        stale_by_actor: Dict[ActorID, List[TaskSpec]] = {}
+        for task_id, t in list(self._push_time.items()):
+            if now - t < age_s:
+                continue
+            entry = self._awaiting.get(task_id)
+            if entry is None:
+                self._push_time.pop(task_id, None)
+                continue
+            st, spec = entry
+            if st.state == "ALIVE" and spec.sequence_number in st.inflight:
+                stale_by_actor.setdefault(st.actor_id, []).append(spec)
+        for actor_id, specs in stale_by_actor.items():
+            st = self._actors.get(actor_id)
+            if st is None or st.state != "ALIVE" or st.address is None:
+                continue
+            client = self._cw.clients.get(st.address)
+            queries = [(self._cw.worker_id.hex(), s.sequence_number,
+                        s.task_id.hex()) for s in specs]
+            try:
+                statuses = await client.call("actor_task_status",
+                                             queries=queries, timeout=30)
+            except Exception:
+                asyncio.ensure_future(self._reconcile(st))
+                continue
+            for (task_hex, status, cached), spec in zip(statuses, specs):
+                task_id = spec.task_id
+                if status == "done":
+                    self.on_done(task_id, cached)
+                elif status == "running":
+                    self._push_time[task_id] = time.monotonic()
+                elif status == "unknown":
+                    # push never arrived: resend the same seq
+                    if self._awaiting.pop(task_id, None) is not None:
+                        self._push_time.pop(task_id, None)
+                        st.inflight.pop(spec.sequence_number, None)
+                        asyncio.ensure_future(self._push(st, spec))
+                else:  # lost: executed but reply evicted — unrecoverable
+                    if self._awaiting.pop(task_id, None) is not None:
+                        self._push_time.pop(task_id, None)
+                        st.inflight.pop(spec.sequence_number, None)
+                        self._fail(spec,
+                                   "actor task reply lost (cache evicted)")
 
     def _fail(self, spec: TaskSpec, cause: str):
         err = ActorDiedError(spec.actor_id, cause or "actor died")
@@ -1560,15 +1635,28 @@ class CoreWorker:
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True
              ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """Reference: CoreWorker::Wait. Local readiness is event-driven
+        (memory-store condition, notified on every completion); checks that
+        need an RPC (borrowed/unknown objects, plasma pulls) are throttled
+        to one sweep per 200 ms instead of every wakeup — a wait() over
+        10k refs must not hammer the GCS."""
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         ready_set: Set[ObjectID] = set()
+        remote_poll_at = 0.0
         while True:
+            now = time.monotonic()
+            poll_remote = now >= remote_poll_at
+            if poll_remote:
+                remote_poll_at = now + 0.2
             for ref in refs:
                 oid = ref.id()
                 if oid in ready_set:
                     continue
-                if self._is_ready(ref, fetch_local):
+                ok = self._is_ready_local(oid)
+                if ok is None and poll_remote:
+                    ok = self._is_ready_remote(ref, fetch_local)
+                if ok:
                     ready.append(ref)
                     ready_set.add(oid)
             if len(ready) >= num_returns:
@@ -1577,24 +1665,31 @@ class CoreWorker:
                 break
             self.memory_store.wait_ready(
                 [r.id() for r in refs if r.id() not in ready_set],
-                1, timeout=0.02)
+                1, timeout=0.05)
         not_ready = [r for r in refs if r.id() not in ready_set]
         return ready, not_ready
 
-    def _is_ready(self, ref: ObjectRef, fetch_local: bool) -> bool:
-        oid = ref.id()
+    def _is_ready_local(self, oid: ObjectID) -> Optional[bool]:
+        """True/False from process-local state only; None = needs an RPC."""
         entry = self.memory_store.get_entry(oid)
         if entry is not None and not entry.in_plasma:
             return True
         if self.plasma.contains(oid):
             return True
         if entry is not None and entry.in_plasma:
+            return None  # completed somewhere; pulling it is an RPC
+        if self.task_manager.is_pending(oid.task_id()):
+            return False
+        return None  # unknown/borrowed: directory lookup is an RPC
+
+    def _is_ready_remote(self, ref: ObjectRef, fetch_local: bool) -> bool:
+        oid = ref.id()
+        entry = self.memory_store.get_entry(oid)
+        if entry is not None and entry.in_plasma:
             # Completed into plasma somewhere.
             if fetch_local:
                 return self._pull_via_raylet(oid)
             return True
-        if self.task_manager.is_pending(oid.task_id()):
-            return False
         # Unknown object (borrowed put, etc.): consult the directory.
         try:
             info = self.gcs.call_sync("get_object_locations",
@@ -1608,6 +1703,12 @@ class CoreWorker:
             # Small owner-held object: ready iff the owner can serve it now.
             return self._fetch_from_owner(ref) is not _MISSING
         return known
+
+    def _is_ready(self, ref: ObjectRef, fetch_local: bool) -> bool:
+        ok = self._is_ready_local(ref.id())
+        if ok is None:
+            return self._is_ready_remote(ref, fetch_local)
+        return ok
 
     def free_objects(self, refs: List[ObjectRef]):
         for ref in refs:
@@ -1672,9 +1773,12 @@ class CoreWorker:
     async def _exec_and_report(self, spec: TaskSpec, done_to: Address):
         try:
             reply = await self.executor.execute(spec)
+        except asyncio.CancelledError:
+            return  # shutdown/kill: owner recovers via pubsub or sweep
         except BaseException as e:  # noqa: BLE001 — must report something
-            reply = {"error": TaskError(spec.method_name,
-                                        f"executor failed: {e}")}
+            # Infrastructure failure (env setup, dispatch) — NOT an
+            # application error: the owner requeues instead of failing.
+            reply = {"system_error": f"executor failed: {e!r}"}
         q = self._done_batches.setdefault(done_to, [])
         q.append((spec.task_id.hex(), reply))
         if len(q) == 1:
@@ -1694,6 +1798,27 @@ class CoreWorker:
     async def handle_actor_tasks_done(self, results):
         for task_hex, reply in results:
             self.actor_submitter.on_done(TaskID.from_hex(task_hex), reply)
+
+    async def handle_actor_task_status(self, queries):
+        """Straggler probe from an owner: for each (caller_hex, seq,
+        task_hex), report done (with the cached reply), running, unknown
+        (push never arrived — owner should resend), or lost (executed but
+        the reply cache evicted it)."""
+        ex = self.executor
+        out = []
+        for caller_hex, seq, task_hex in queries:
+            caller = bytes.fromhex(caller_hex)
+            cached = ex._reply_cache.get(caller, {}).get(seq)
+            if cached is not None:
+                out.append((task_hex, "done", cached))
+            elif seq in ex._inflight.get(caller, {}) \
+                    or seq in ex._seq_buffer.get(caller, {}):
+                out.append((task_hex, "running", None))
+            elif seq < ex._next_seq.get(caller, 0):
+                out.append((task_hex, "lost", None))
+            else:
+                out.append((task_hex, "unknown", None))
+        return out
 
     async def handle_get_object(self, object_hex: str):
         oid = ObjectID.from_hex(object_hex)

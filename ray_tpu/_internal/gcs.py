@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
@@ -128,6 +129,14 @@ class GcsServer:
         self.actor_sched_lock: Optional[asyncio.Lock] = None
 
         self._resource_views: Dict[str, NodeView] = {}
+        # Cluster-view delta state (reference: ray_syncer versioning).
+        # The epoch token distinguishes GCS incarnations: a raylet's
+        # known_ver from before a GCS restart must not be mistaken for a
+        # valid baseline in the new numbering.
+        self._view_version = 0
+        self._view_epoch = int.from_bytes(os.urandom(8), "big")
+        self._view_removals: List[Tuple[int, str]] = []
+        self._removals_trimmed_ver = 0
         self._job_counter = 0
         self._spread_clock = 0
         self._next_node_index = 1
@@ -269,6 +278,7 @@ class GcsServer:
         self.nodes[node_id] = rec
         nr = NodeResources(ResourceSet(resources), labels)
         self._resource_views[node_id] = NodeView(node_id, nr)
+        self._bump_view(node_id)
         self.publish("NODE", {"event": "ALIVE", "node_id": node_id,
                               "address": rec.address})
         self._persist()
@@ -277,7 +287,8 @@ class GcsServer:
     async def handle_heartbeat(self, node_id: str,
                                resources_available: Dict[str, float],
                                resources_total: Dict[str, float],
-                               pending_demand: Optional[List[Dict]] = None):
+                               pending_demand: Optional[List[Dict]] = None,
+                               known_ver: int = -1, known_epoch: int = 0):
         rec = self.nodes.get(node_id)
         if rec is None or rec.state == "DEAD":
             return {"dead": True}
@@ -290,14 +301,23 @@ class GcsServer:
             view = NodeView(node_id, NodeResources(
                 ResourceSet(resources_total), rec.labels))
             self._resource_views[node_id] = view
-        total = ResourceSet(resources_total)
-        view.resources.total = total
-        view.resources.available = ResourceSet(resources_available)
+            self._bump_view(node_id)
+        changed = (view.resources.total.to_dict() != resources_total
+                   or view.resources.available.to_dict()
+                   != resources_available)
+        if changed:
+            view.resources.total = ResourceSet(resources_total)
+            view.resources.available = ResourceSet(resources_available)
+            self._bump_view(node_id)
         # Unmet lease demand feeds the autoscaler (reference:
         # gcs_autoscaler_state_manager.cc resource_load).
         self._pending_demand[node_id] = pending_demand or []
-        # Reply with the full cluster view for spillback decisions.
-        return {"dead": False, "view": self.cluster_view_snapshot()}
+        # Reply with the cluster-view *delta* since the raylet's last known
+        # version (reference: ray_syncer.h's versioned resource broadcast —
+        # a stable cluster exchanges no per-node payload at all, vs the
+        # O(nodes^2) traffic of full snapshots every interval).
+        return {"dead": False,
+                "view": self.view_delta(known_ver, known_epoch)}
 
     async def handle_get_cluster_demand(self):
         """Aggregate unmet demand for the autoscaler: queued lease shapes
@@ -328,6 +348,51 @@ class GcsServer:
                 "labels": view.resources.labels,
             }
         return out
+
+    # -- versioned view deltas ------------------------------------------
+
+    def _bump_view(self, node_id: str):
+        self._view_version += 1
+        view = self._resource_views.get(node_id)
+        if view is not None:
+            view.ver = self._view_version
+
+    def _record_view_removal(self, node_id: str):
+        self._view_version += 1
+        self._view_removals.append((self._view_version, node_id))
+        if len(self._view_removals) > 1000:
+            dropped = self._view_removals[:-1000]
+            self._view_removals = self._view_removals[-1000:]
+            self._removals_trimmed_ver = max(self._removals_trimmed_ver,
+                                             dropped[-1][0])
+
+    def view_delta(self, since: int, epoch: int = 0) -> Dict[str, Any]:
+        """Entries changed after `since`, or a full snapshot when `since`
+        predates retained removal history, comes from another GCS
+        incarnation, or is -1 for a fresh raylet."""
+        if since < 0 or epoch != self._view_epoch \
+                or since < self._removals_trimmed_ver \
+                or since > self._view_version:
+            return {"full": True, "ver": self._view_version,
+                    "epoch": self._view_epoch,
+                    "delta": self.cluster_view_snapshot(), "removed": []}
+        delta = {}
+        for nid, view in self._resource_views.items():
+            if getattr(view, "ver", 0) <= since:
+                continue
+            rec = self.nodes.get(nid)
+            if rec is None or rec.state == "DEAD":
+                continue
+            delta[nid] = {
+                "address": rec.address,
+                "total": view.resources.total.to_dict(),
+                "available": view.resources.available.to_dict(),
+                "labels": view.resources.labels,
+            }
+        removed = [nid for ver, nid in self._view_removals if ver > since]
+        return {"full": False, "ver": self._view_version,
+                "epoch": self._view_epoch, "delta": delta,
+                "removed": removed}
 
     async def handle_get_all_nodes(self):
         return [
@@ -381,6 +446,7 @@ class GcsServer:
         logger.warning("node %s declared dead: %s", node_id[:12], cause)
         rec.state = "DEAD"
         view = self._resource_views.pop(node_id, None)
+        self._record_view_removal(node_id)
         self.publish("NODE", {"event": "DEAD", "node_id": node_id,
                               "address": rec.address})
         # Drop object locations on the dead node; owners reconstruct on demand.
@@ -598,6 +664,7 @@ class GcsServer:
                                strategy.bundle_index)
                         if strategy.kind == "placement_group" else None,
                         "grant_or_reject": True,
+                        "is_actor": True,
                     },
                     timeout=CONFIG.worker_start_timeout_s)
             except Exception as e:

@@ -106,6 +106,8 @@ class Raylet:
         self.objects: Dict[str, ObjectEntry] = {}
         self.store_used = 0
         self.cluster_view: Dict[str, NodeView] = {}
+        self._view_ver = -1  # last merged GCS view version (-1 = none)
+        self._view_epoch = 0  # GCS incarnation the version belongs to
         self.node_addresses: Dict[str, Address] = {}
         self._next_lease_id = 0
         self._tasks: List[asyncio.Task] = []
@@ -155,6 +157,8 @@ class Raylet:
                     resources_total=self.resources.total.to_dict(),
                     pending_demand=[req.demand.to_dict()
                                     for req in self.queued[:100]],
+                    known_ver=self._view_ver,
+                    known_epoch=self._view_epoch,
                     timeout=CONFIG.health_check_timeout_s)
                 if reply.get("dead"):
                     logger.warning("raylet %s marked dead by gcs; exiting",
@@ -167,14 +171,29 @@ class Raylet:
                 pass
             await asyncio.sleep(HEARTBEAT_INTERVAL_S)
 
-    def _update_view(self, snapshot: Dict[str, Dict[str, Any]]):
-        view = {}
-        for nid, info in snapshot.items():
+    def _update_view(self, vd: Dict[str, Any]):
+        """Merge a versioned view delta (stable cluster => empty payload;
+        reference: ray_syncer.h eventually-consistent resource views)."""
+        delta = vd.get("delta", vd if vd and "ver" not in vd else {})
+        changed = bool(delta) or bool(vd.get("removed"))
+        if vd.get("full", "ver" not in vd):
+            view = {}
+        else:
+            view = self.cluster_view
+            for nid in vd.get("removed", ()):
+                view.pop(nid, None)
+                self.node_addresses.pop(nid, None)
+        for nid, info in delta.items():
             nr = NodeResources(ResourceSet(info["total"]), info["labels"])
             nr.available = ResourceSet(info["available"])
             view[nid] = NodeView(nid, nr)
             self.node_addresses[nid] = tuple(info["address"])
         self.cluster_view = view
+        if "ver" in vd:
+            self._view_ver = vd["ver"]
+            self._view_epoch = vd.get("epoch", 0)
+        if not changed:
+            return
         # New nodes / freed remote capacity can unblock queued requests via
         # spillback — a request infeasible here would otherwise park forever
         # (reference: cluster_lease_manager re-runs scheduling on every
@@ -230,14 +249,40 @@ class Raylet:
             # launched with JAX_PLATFORMS="" exactly so they grab the
             # chip) — those must keep the hook.
             env["PALLAS_AXON_POOL_IPS"] = ""
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._internal.worker_main"],
-            env=env, stdout=subprocess.DEVNULL if not CONFIG.log_to_driver
-            else None, stderr=None)
         handle = WorkerHandle(
-            worker_id=worker_id, proc=proc, pid=proc.pid, env_key=env_key,
+            worker_id=worker_id, proc=None, pid=0, env_key=env_key,
             registered=asyncio.get_running_loop().create_future())
         self.workers[worker_id] = handle
+        loop = asyncio.get_running_loop()
+
+        def _popen():
+            # fork/exec off the event loop: a spawn burst must not starve
+            # lease/heartbeat handling (1-core boxes stall for seconds).
+            return subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._internal.worker_main"],
+                env=env, stdout=subprocess.DEVNULL if not CONFIG.log_to_driver
+                else None, stderr=None)
+
+        def _attach(fut):
+            try:
+                proc = fut.result()
+            except Exception as e:
+                logger.warning("worker spawn failed: %s", e)
+                self.workers.pop(worker_id, None)
+                if not handle.registered.done():
+                    handle.registered.set_exception(
+                        RuntimeError(f"worker spawn failed: {e}"))
+                return
+            handle.proc = proc
+            handle.pid = proc.pid
+            if handle.state == "DEAD":
+                # killed while the fork was in flight — don't leak it
+                try:
+                    proc.terminate()
+                except Exception:
+                    pass
+        spawn_fut = loop.run_in_executor(None, _popen)
+        spawn_fut.add_done_callback(_attach)
         return handle
 
     async def handle_register_worker(self, worker_id: bytes, address: Address,
@@ -274,8 +319,12 @@ class Raylet:
                 logger.exception("worker liveness loop error")
 
     async def _on_worker_death(self, handle: WorkerHandle):
-        logger.warning("worker %s (pid %s) died unexpectedly",
-                       handle.worker_id.hex()[:12], handle.pid)
+        # Actor workers routinely die on purpose (ray.kill / job teardown
+        # kill_actor goes GCS->worker directly); the GCS owns their
+        # restart-or-fail decision, so that's not warning-worthy here.
+        log = logger.info if handle.is_actor_worker else logger.warning
+        log("worker %s (pid %s) died unexpectedly",
+            handle.worker_id.hex()[:12], handle.pid)
         handle.state = "DEAD"
         self.workers.pop(handle.worker_id, None)
         if handle.lease_id is not None:
@@ -447,7 +496,7 @@ class Raylet:
             try:
                 await asyncio.wait_for(handle.registered,
                                        CONFIG.worker_start_timeout_s)
-            except asyncio.TimeoutError:
+            except Exception:  # timeout or spawn failure
                 self._kill_worker(handle)
                 self._refund(req.demand, None if charge_node else req.pg)
                 return {"rejected": True,

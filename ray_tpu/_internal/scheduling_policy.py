@@ -28,6 +28,8 @@ class NodeView:
     alive: bool = True
     # draining nodes accept no new leases
     draining: bool = False
+    # GCS cluster-view delta version (0 = never broadcast)
+    ver: int = 0
 
     def feasible(self, demand: ResourceSet) -> bool:
         return demand.fits(self.resources.total)

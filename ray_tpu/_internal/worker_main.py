@@ -50,15 +50,31 @@ def main():
     if reply.get("exit"):
         sys.exit(0)
 
-    # Stay alive while the raylet does; poll its liveness.
+    # Stay alive while the raylet does. The raylet is our parent process,
+    # so reparenting (getppid changes) is the authoritative death signal —
+    # it is immune to event-loop starvation, which on a 1-core box can
+    # stall RPC pings for tens of seconds during worker-spawn bursts.
+    # Pings remain as a slow fallback for a wedged-but-alive raylet.
+    parent = os.getppid()
+    ping_misses = 0
+    last_ping = time.monotonic()
     while True:
         time.sleep(2.0)
-        try:
-            raylet.call_sync("ping", timeout=5, retries=2)
-        except Exception:
+        if os.getppid() != parent:
             logging.getLogger(__name__).warning(
-                "raylet unreachable; worker exiting")
+                "raylet process gone; worker exiting")
             os._exit(1)
+        if time.monotonic() - last_ping >= 10.0:
+            last_ping = time.monotonic()
+            try:
+                raylet.call_sync("ping", timeout=10, retries=0)
+                ping_misses = 0
+            except Exception:
+                ping_misses += 1
+                if ping_misses >= 30:  # ~5 min of continuous failure
+                    logging.getLogger(__name__).warning(
+                        "raylet unresponsive for ~5min; worker exiting")
+                    os._exit(1)
 
 
 def _install_profile_hook(out_dir: str):

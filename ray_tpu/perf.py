@@ -155,8 +155,72 @@ def main(quick: bool = False) -> Dict[str, float]:
     return results
 
 
+def collectives_bench(world: int = 8, mb: int = 64) -> Dict[str, float]:
+    """Host-plane collective microbench: ring vs star allreduce of
+    `mb` MiB float32 across `world` single-process ranks.
+
+    NOTE on this container: with ONE physical core the ring's parallel
+    neighbor transfers serialize onto the same core, so wall-clock gains
+    are modest; the ring's property is that per-rank traffic is
+    2(W-1)/W x N with no root hotspot, which pays off with real cores
+    and NICs (see PERF.md machine calibration)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=world + 1)
+
+    @ray_tpu.remote(num_cpus=1)
+    class R:
+        def __init__(self, rank, world, group):
+            self.rank, self.world, self.group = rank, world, group
+
+        def join(self, ring_min_bytes):
+            from ray_tpu.util.collective import collective as col
+            col._RING_MIN_BYTES = ring_min_bytes
+            col.init_collective_group(self.world, self.rank,
+                                      group_name=self.group)
+            return True
+
+        def allreduce(self, n_elems, tag):
+            from ray_tpu.util.collective import collective as col
+            x = np.full(n_elems, float(self.rank), np.float32)
+            t0 = time.perf_counter()
+            out = col.allreduce(x, group_name=self.group)
+            dt = time.perf_counter() - t0
+            expect = self.world * (self.world - 1) / 2.0
+            assert out[0] == expect, (tag, out[0], expect)
+            return dt
+
+    n_elems = mb * (1 << 20) // 4
+    results = {}
+    for mode, threshold in (("ring", 1 << 16), ("star", 1 << 62)):
+        group = f"bench-{mode}"
+        ranks = [R.remote(r, world, group) for r in range(world)]
+        ray_tpu.get([a.join.remote(threshold) for a in ranks], timeout=180)
+        # warm connections with a small round
+        ray_tpu.get([a.allreduce.remote(1 << 12, "warm") for a in ranks],
+                    timeout=180)
+        t0 = time.perf_counter()
+        ray_tpu.get([a.allreduce.remote(n_elems, mode) for a in ranks],
+                    timeout=600)
+        wall = time.perf_counter() - t0
+        results[mode] = wall
+        _report(f"allreduce_{mode}_{mb}mb_x{world}", wall, "s")
+        for a in ranks:
+            ray_tpu.kill(a)
+        del ranks
+    _report("ring_vs_star_speedup", results["star"] / results["ring"], "x")
+    ray_tpu.shutdown()
+    return results
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--collectives", action="store_true")
+    parser.add_argument("--world", type=int, default=8)
+    parser.add_argument("--mb", type=int, default=64)
     args = parser.parse_args()
-    main(quick=args.quick)
+    if args.collectives:
+        collectives_bench(world=args.world, mb=args.mb)
+    else:
+        main(quick=args.quick)

@@ -14,9 +14,18 @@ NCCL/gloo backends). The TPU framework has TWO collective planes (SURVEY §5):
   named-actor ncclUniqueId store, nccl_collective_group.py:28-77).
 
 Semantics: ranks call collectives in the same order (standard collective
-contract). Implementation is a rank-0-rooted star (serial sends at the
-root, O(world) latency) — correct and simple for control-plane sizes;
-ring/tree algorithms can land later behind the same API.
+contract). Algorithm selection (reference concept:
+nccl_collective_group.py's NCCL rings, re-derived for the host plane):
+
+- small payloads / tiny worlds: rank-0-rooted star — two hops, minimal
+  latency, fine for control-plane sizes.
+- large payloads (>= _RING_MIN_BYTES) with world >= 3: **chunked ring**
+  — reduce-scatter then allgather, 2(W-1)/W x N bytes per rank with no
+  root hotspot; each rank only ever talks to its neighbors, so bandwidth
+  scales with the number of links instead of one root NIC.
+
+Sends are one-way messages over the framework RPC plane (reliable,
+in-order per connection); receives block on a local mailbox.
 """
 
 from __future__ import annotations
@@ -33,6 +42,10 @@ from ..._internal.rpc import EventLoopThread
 
 SUM, PRODUCT, MIN, MAX = "sum", "product", "min", "max"
 _OPS = {SUM: np.add, PRODUCT: np.multiply, MIN: np.minimum, MAX: np.maximum}
+
+# Below this many bytes the star's two-hop latency beats the ring's
+# 2(W-1) steps.
+_RING_MIN_BYTES = 1 << 16
 
 _groups: Dict[str, "CollectiveGroup"] = {}
 _groups_lock = threading.Lock()
@@ -58,6 +71,21 @@ class _Mailbox:
                                        f"received within {timeout}s")
                 self._cond.wait(remaining)
             return self._messages.pop(key)
+
+    def take_any(self, keys: List[Tuple], timeout: float = 120.0
+                 ) -> Tuple[Tuple, bytes]:
+        """Block until any of `keys` arrives; returns (key, data)."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                for key in keys:
+                    if key in self._messages:
+                        return key, self._messages.pop(key)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"none of {keys} received within "
+                                       f"{timeout}s")
+                self._cond.wait(remaining)
 
 
 _mailbox = _Mailbox()
@@ -94,6 +122,15 @@ class CollectiveGroup:
         client.call_sync("collective_msg", key=key, data=payload,
                          timeout=120, retries=3)
 
+    def _post_to(self, rank: int, key: Tuple, array: np.ndarray):
+        """Fire-and-forget send (ring steps don't need the ack round
+        trip; the receiver's own step-s recv is the synchronization)."""
+        worker = get_core_worker()
+        client = worker.clients.get(tuple(self.members[rank]))
+        payload = _pack(array)
+        EventLoopThread.get().post(
+            client.oneway("collective_msg", key=key, data=payload))
+
     def _recv_from(self, key: Tuple) -> np.ndarray:
         return _unpack(_mailbox.take(key))
 
@@ -101,9 +138,93 @@ class CollectiveGroup:
 
     def allreduce(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
         seq = self._next_seq("allreduce")
+        if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
+            chunks = self._ring_reduce_scatter(array, op, seq)
+            chunks = self._ring_allgather_chunks(chunks, seq)
+            return np.concatenate(chunks).reshape(array.shape)
         reduced = self.reduce(array, dst_rank=0, op=op, _seq=seq)
         return self.broadcast(reduced if self.rank == 0 else array,
                               src_rank=0, _seq=seq)
+
+    # -- ring internals --------------------------------------------------
+    #
+    # Standard 2-phase ring over chunk indices (W chunks of the flattened
+    # payload), offset so that after reduce-scatter rank r owns fully
+    # reduced chunk r (send index (r-s-1) mod W at step s). The allgather
+    # phase rotates the finished chunks W-1 more steps. 2(W-1)/W x N
+    # bytes per rank, neighbor links only — no root hotspot.
+
+    def _ring_reduce_scatter(self, array: np.ndarray, op: str,
+                             seq: int) -> List[np.ndarray]:
+        W, r = self.world_size, self.rank
+        fn = _OPS[op]
+        flat = np.ascontiguousarray(array).ravel()
+        chunks = [c.copy() for c in np.array_split(flat, W)]
+        nxt = (r + 1) % W
+        for s in range(W - 1):
+            send_idx = (r - s - 1) % W
+            recv_idx = (r - s - 2) % W
+            self._post_to(nxt, (self.name, "rs", seq, s, send_idx),
+                          chunks[send_idx])
+            incoming = self._recv_from((self.name, "rs", seq, s, recv_idx))
+            chunks[recv_idx] = fn(chunks[recv_idx], incoming)
+        return chunks  # chunks[r] is this rank's fully-reduced share
+
+    def _ring_allgather_chunks(self, chunks: List[np.ndarray],
+                               seq: int) -> List[np.ndarray]:
+        W, r = self.world_size, self.rank
+        nxt = (r + 1) % W
+        for s in range(W - 1):
+            send_idx = (r - s) % W
+            recv_idx = (r - s - 1) % W
+            self._post_to(nxt, (self.name, "ag2", seq, s, send_idx),
+                          chunks[send_idx])
+            chunks[recv_idx] = self._recv_from(
+                (self.name, "ag2", seq, s, recv_idx))
+        return chunks
+
+    def _post_obj(self, rank: int, key: Tuple, obj):
+        from ..._internal import serialization
+        worker = get_core_worker()
+        client = worker.clients.get(tuple(self.members[rank]))
+        EventLoopThread.get().post(
+            client.oneway("collective_msg", key=key,
+                          data=serialization.dumps(obj)))
+
+    def _chain_broadcast_src(self, array: np.ndarray, src_rank: int,
+                             seq: int) -> np.ndarray:
+        """Pipelined chunked chain src -> src+1 -> ... : every link
+        carries each chunk once, and forwarding overlaps with receiving
+        (reference concept: push_manager.cc chunked pushes)."""
+        succ = (self.rank + 1) % self.world_size
+        chunk_elems = max(1, (1 << 20) // max(1, array.itemsize))
+        flat = np.ascontiguousarray(array).ravel()
+        pieces = [flat[i:i + chunk_elems]
+                  for i in range(0, len(flat), chunk_elems)] or [flat]
+        self._post_obj(succ, (self.name, "bh", seq),
+                       (len(pieces), array.shape, array.dtype.str))
+        for k, piece in enumerate(pieces):
+            self._post_to(succ, (self.name, "bch", seq, k), piece)
+        return array
+
+    def _chain_broadcast_recv(self, header_data: bytes, src_rank: int,
+                              seq: int) -> np.ndarray:
+        from ..._internal import serialization
+        W, r = self.world_size, self.rank
+        pos = (r - src_rank) % W
+        succ = (r + 1) % W if pos < W - 1 else None
+        n_chunks, shape, dtype = serialization.loads(header_data)
+        if succ is not None:
+            self._post_obj(succ, (self.name, "bh", seq),
+                           (n_chunks, shape, dtype))
+        pieces = []
+        for k in range(n_chunks):
+            piece = self._recv_from((self.name, "bch", seq, k))
+            if succ is not None:
+                self._post_to(succ, (self.name, "bch", seq, k), piece)
+            pieces.append(piece)
+        return np.concatenate(pieces).astype(np.dtype(dtype),
+                                             copy=False).reshape(shape)
 
     def reduce(self, array: np.ndarray, dst_rank: int = 0, op: str = SUM,
                _seq: Optional[int] = None) -> np.ndarray:
@@ -122,17 +243,42 @@ class CollectiveGroup:
 
     def broadcast(self, array: np.ndarray, src_rank: int = 0,
                   _seq: Optional[int] = None) -> np.ndarray:
+        """Non-src `array` is a placeholder (never read), so the algorithm
+        choice is the SOURCE's alone: src picks star (small) or pipelined
+        chain (large); non-src ranks block on either key and follow
+        whichever message arrives."""
         seq = self._next_seq("broadcast") if _seq is None else _seq
         if self.rank == src_rank:
+            if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
+                return self._chain_broadcast_src(array, src_rank, seq)
             for dst in range(self.world_size):
                 if dst == src_rank:
                     continue
                 self._send_to(dst, (self.name, "bc", seq, src_rank), array)
             return array
-        return self._recv_from((self.name, "bc", seq, src_rank))
+        key, data = _mailbox.take_any([
+            (self.name, "bc", seq, src_rank),   # star payload
+            (self.name, "bh", seq),             # chain header
+        ])
+        if key[1] == "bc":
+            return _unpack(data)
+        return self._chain_broadcast_recv(data, src_rank, seq)
 
     def allgather(self, array: np.ndarray) -> List[np.ndarray]:
         seq = self._next_seq("allgather")
+        if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
+            # ring rotation: each rank forwards what it just received;
+            # (W-1) x N per rank over neighbor links, no root funnel
+            W, r = self.world_size, self.rank
+            nxt = (r + 1) % W
+            parts: List[Optional[np.ndarray]] = [None] * W
+            parts[r] = np.asarray(array)
+            cur = parts[r]
+            for s in range(W - 1):
+                self._post_to(nxt, (self.name, "agr", seq, s), cur)
+                cur = self._recv_from((self.name, "agr", seq, s))
+                parts[(r - s - 1) % W] = cur
+            return parts
         if self.rank == 0:
             parts = [None] * self.world_size
             parts[0] = np.asarray(array)
@@ -157,6 +303,11 @@ class CollectiveGroup:
         return out
 
     def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
+        if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
+            seq = self._next_seq("reducescatter")
+            # ring reduce-scatter alone: (W-1)/W x N bytes per rank,
+            # half the full allreduce's traffic
+            return self._ring_reduce_scatter(array, op, seq)[self.rank]
         reduced = self.allreduce(array, op)
         chunks = np.array_split(reduced.ravel(), self.world_size)
         return chunks[self.rank]

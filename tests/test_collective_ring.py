@@ -1,0 +1,123 @@
+"""Ring host-collective correctness (reference concept: NCCL ring
+algorithms in util/collective/collective_group/nccl_collective_group.py,
+re-derived for the host/DCN plane).
+
+Payloads above the ring threshold run chunked ring reduce-scatter +
+allgather / chain broadcast; small payloads keep the 2-hop star. Both
+paths must agree with numpy exactly (int dtype => associativity-proof).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+WORLD = 4
+N_BIG = 40_000  # int64 -> 320 KB, well past the 64 KB ring threshold
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=WORLD + 1)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote(num_cpus=1)
+class Rank:
+    def __init__(self, rank, world, group):
+        self.rank, self.world, self.group = rank, world, group
+
+    def join(self):
+        from ray_tpu.util.collective import collective as col
+        col.init_collective_group(self.world, self.rank,
+                                  group_name=self.group)
+        return True
+
+    def run(self, op_name, payload_kind):
+        from ray_tpu.util.collective import collective as col
+        rng = np.random.RandomState(self.rank)
+        if payload_kind == "big":
+            x = rng.randint(-1000, 1000, size=N_BIG).astype(np.int64)
+        else:
+            x = rng.randint(-1000, 1000, size=64).astype(np.int64)
+        if op_name == "allreduce":
+            out = col.allreduce(x, group_name=self.group)
+        elif op_name == "allreduce_max":
+            out = col.allreduce(x, op=col.MAX, group_name=self.group)
+        elif op_name == "broadcast":
+            out = col.broadcast(x, src_rank=1, group_name=self.group)
+        elif op_name == "allgather":
+            return [np.asarray(p) for p in
+                    col.allgather(x, group_name=self.group)]
+        elif op_name == "reducescatter":
+            out = col.reducescatter(x, group_name=self.group)
+        else:
+            raise ValueError(op_name)
+        return np.asarray(out)
+
+    def leave(self):
+        from ray_tpu.util.collective import collective as col
+        col.destroy_collective_group(self.group)
+        return True
+
+
+def _expected_inputs(kind):
+    return [np.random.RandomState(r).randint(
+        -1000, 1000, size=N_BIG if kind == "big" else 64).astype(np.int64)
+        for r in range(WORLD)]
+
+
+@pytest.fixture(scope="module")
+def ranks(cluster):
+    actors = [Rank.remote(r, WORLD, "ringtest") for r in range(WORLD)]
+    ray_tpu.get([a.join.remote() for a in actors])
+    yield actors
+    ray_tpu.get([a.leave.remote() for a in actors])
+
+
+@pytest.mark.parametrize("kind", ["small", "big"])
+def test_allreduce_sum(ranks, kind):
+    outs = ray_tpu.get([a.run.remote("allreduce", kind) for a in ranks],
+                       timeout=120)
+    want = sum(_expected_inputs(kind))
+    for out in outs:
+        np.testing.assert_array_equal(out, want)
+
+
+def test_allreduce_max_big(ranks):
+    outs = ray_tpu.get([a.run.remote("allreduce_max", "big")
+                        for a in ranks], timeout=120)
+    want = np.maximum.reduce(_expected_inputs("big"))
+    for out in outs:
+        np.testing.assert_array_equal(out, want)
+
+
+@pytest.mark.parametrize("kind", ["small", "big"])
+def test_broadcast(ranks, kind):
+    outs = ray_tpu.get([a.run.remote("broadcast", kind) for a in ranks],
+                       timeout=120)
+    want = _expected_inputs(kind)[1]  # src_rank=1
+    for out in outs:
+        np.testing.assert_array_equal(out.reshape(want.shape), want)
+
+
+def test_allgather_big(ranks):
+    outs = ray_tpu.get([a.run.remote("allgather", "big") for a in ranks],
+                       timeout=120)
+    want = _expected_inputs("big")
+    for per_rank in outs:
+        assert len(per_rank) == WORLD
+        for got, exp in zip(per_rank, want):
+            np.testing.assert_array_equal(got, exp)
+
+
+def test_reducescatter_big(ranks):
+    outs = ray_tpu.get([a.run.remote("reducescatter", "big")
+                        for a in ranks], timeout=120)
+    full = sum(_expected_inputs("big"))
+    want_chunks = np.array_split(full.ravel(), WORLD)
+    for r, out in enumerate(outs):
+        np.testing.assert_array_equal(out, want_chunks[r])

@@ -1,0 +1,143 @@
+"""Scale-envelope tests (reference: release/benchmarks/README.md:9-31 —
+many queued tasks, many actors, wide wait sets).
+
+The reference's published envelope (1M queued tasks, 40k actors) was
+measured on 64x64-core clusters; this container has ONE core, so the
+sizes here are chosen to exercise the same *mechanisms* (driver-side
+lease-waiter queue depth, worker-pool churn, notification-driven wait)
+within the box's physical spawn/execute rates. Set RTPU_SCALE_FULL=1 to
+run the reference-scale counts (1k actors / 200k tasks) on real hardware.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+FULL = bool(os.environ.get("RTPU_SCALE_FULL"))
+
+N_TASKS = 200_000 if FULL else 50_000
+N_ACTORS = 1_000 if FULL else 150
+N_WAIT = 10_000
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=1 << 30)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.mark.timeout_s(600 if FULL else 240)
+def test_many_queued_tasks(cluster):
+    """N tasks submitted in one burst: the driver-side waiter queue holds
+    ~N entries while only max_pending_lease_requests hit the raylet; the
+    burst must drain completely and in bounded memory."""
+
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    # warm the worker pool so the measured section is steady-state
+    ray_tpu.get([tiny.remote(i) for i in range(200)])
+
+    t0 = time.perf_counter()
+    refs = [tiny.remote(i) for i in range(N_TASKS)]
+    submit_s = time.perf_counter() - t0
+    out = ray_tpu.get(refs, timeout=580 if FULL else 220)
+    total_s = time.perf_counter() - t0
+    assert out[0] == 0 and out[-1] == N_TASKS - 1
+    assert len(out) == N_TASKS
+    print(f"\n{N_TASKS} tasks: submit {N_TASKS/submit_s:.0f}/s, "
+          f"end-to-end {N_TASKS/total_s:.0f}/s")
+
+
+@pytest.mark.timeout_s(600 if FULL else 240)
+def test_many_actors(cluster):
+    """N concurrently-alive actors (each its own worker process, like the
+    reference): create, call each once, then release."""
+
+    @ray_tpu.remote(num_cpus=0.001)
+    class Probe:
+        def __init__(self, idx):
+            self.idx = idx
+
+        def whoami(self):
+            return (os.getpid(), self.idx)
+
+    t0 = time.perf_counter()
+    actors = [Probe.remote(i) for i in range(N_ACTORS)]
+    infos = ray_tpu.get([a.whoami.remote() for a in actors],
+                        timeout=580 if FULL else 220)
+    dt = time.perf_counter() - t0
+    # every actor is its own live process and answered as itself
+    assert [idx for _pid, idx in infos] == list(range(N_ACTORS))
+    print(f"\n{N_ACTORS} actors alive in {dt:.1f}s = {N_ACTORS/dt:.1f}/s")
+    # Tear the fleet down NOW and wait for the processes to reap — a
+    # 1-core box under a 150-process exit storm otherwise starves the
+    # tests that follow this module.
+    for a in actors:
+        ray_tpu.kill(a)
+    del actors
+    deadline = time.monotonic() + 90
+    import subprocess
+    while time.monotonic() < deadline:
+        try:
+            n = int(subprocess.run(
+                ["pgrep", "-cf", "ray_tpu._internal.worker_main"],
+                capture_output=True, text=True).stdout.strip() or 0)
+        except Exception:
+            break
+        if n <= 12:
+            break
+        time.sleep(2)
+
+
+@pytest.mark.timeout_s(120)
+def test_wait_on_10k_refs(cluster):
+    """wait() across a 10k-ref set must be notification-driven: with all
+    refs already owned+ready it returns in O(one sweep), and with a mix
+    of ready/pending it must not spin RPCs per not-ready ref."""
+    refs = [ray_tpu.put(i) for i in range(N_WAIT)]
+    t0 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(refs, num_returns=N_WAIT, timeout=30)
+    dt = time.perf_counter() - t0
+    assert len(ready) == N_WAIT and not not_ready
+    assert dt < 10.0, f"wait over {N_WAIT} ready refs took {dt:.1f}s"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.0)
+        return 1
+
+    # Mixed: one pending task among 10k ready refs; wait for everything.
+    mixed = refs + [slow.remote()]
+    t0 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(mixed, num_returns=len(mixed),
+                                    timeout=60)
+    dt = time.perf_counter() - t0
+    assert not not_ready
+    assert dt < 30.0, f"mixed wait took {dt:.1f}s"
+
+
+@pytest.mark.timeout_s(120)
+def test_wait_returns_in_completion_order_bulk(cluster):
+    """num_returns<k over a large pending set resolves as soon as k
+    complete, not after a full-set sweep."""
+
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def never():
+        time.sleep(600)
+
+    refs = [fast.remote() for _ in range(64)] + [never.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=64, timeout=60)
+    assert len(ready) == 64
+    assert len(not_ready) == 1
