@@ -114,8 +114,31 @@ def init(address: Optional[str] = None, *,
             "cwd": os.getcwd(),
         }))
         set_core_worker(worker)
+        if log_to_driver and CONFIG.log_to_driver:
+            _attach_log_stream(worker)
         atexit.register(_atexit_shutdown)
         return worker
+
+
+def _attach_log_stream(worker):
+    """Print worker stdout/stderr streamed over GCS pubsub (reference:
+    _private/log_monitor.py + worker.py print_logs)."""
+    import sys
+
+    async def _on_logs(message):
+        stream = sys.stderr if message.get("stream") == "stderr" \
+            else sys.stdout
+        pid = message.get("pid")
+        for line in message.get("lines", ()):
+            print(f"(pid={pid}) {line}", file=stream)
+        try:
+            stream.flush()
+        except Exception:
+            pass
+
+    from .rpc import EventLoopThread
+    EventLoopThread.get().post(
+        worker.gcs.subscribe("WORKER_LOGS", _on_logs))
 
 
 def _atexit_shutdown():

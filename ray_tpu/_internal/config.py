@@ -33,6 +33,10 @@ _DEFAULTS: Dict[str, Any] = {
     # in the in-process memory store instead of shared memory.
     "max_direct_call_object_size": 100 * 1024,
     "object_spilling_threshold": 0.8,
+    # fsspec URL prefix for cloud spilling ("" = node-local directory);
+    # e.g. "memory://rtpu-spill", "s3://bucket/prefix"
+    # (reference: _private/external_storage.py:398 smart_open driver)
+    "object_spilling_uri": "",
     "object_store_chunk_bytes": 4 * 1024**2,
     "spill_directory": "",  # default: <session dir>/spill
     # --- scheduling ---

@@ -26,6 +26,7 @@ import collections
 import concurrent.futures
 import logging
 import os
+import sys
 import threading
 import time
 import traceback
@@ -663,6 +664,7 @@ class NormalTaskSubmitter:
             "runtime_env": spec.runtime_env,
             "label_selector": spec.label_selector or None,
             "task_hex": spec.task_id.hex(),  # lease cancellation key
+            "job": spec.job_id.hex(),        # log-stream routing
         }
         strategy = spec.scheduling_strategy
         if strategy.kind == "placement_group":
@@ -683,6 +685,9 @@ class NormalTaskSubmitter:
                 raylet_addr = tuple(reply["spillback_to"][1])
                 continue
             if reply.get("rejected"):
+                if reply.get("permanent"):
+                    raise RayTpuError(
+                        f"worker environment failed: {reply.get('error')}")
                 await asyncio.sleep(0.05)
                 continue
             if not self._cleaner_started:
@@ -877,12 +882,17 @@ class ActorTaskSubmitter:
         sys_err = reply.get("system_error")
         if sys_err is not None:
             # Worker-side infrastructure failure: resend (bounded), the
-            # analog of the old request/response path's requeue.
+            # analog of the old request/response path's requeue. A
+            # system_error means execute() raised BEFORE consuming the
+            # sequence number, so giving up leaves a hole the executor's
+            # ordered queue would wait on forever — fill it with a
+            # tombstone (same trick as cancellation) after failing.
             if spec.attempt_number < 3:
                 spec.attempt_number += 1
                 asyncio.ensure_future(self._push(st, spec))
             else:
                 self._fail(spec, sys_err)
+                self._push_untracked_tombstone(st, spec)
             return
         error = reply.get("error")
         if error is not None:
@@ -952,6 +962,19 @@ class ActorTaskSubmitter:
     def _fail(self, spec: TaskSpec, cause: str):
         err = ActorDiedError(spec.actor_id, cause or "actor died")
         self._cw.task_manager.on_failed(spec, err, is_application_error=False)
+
+    def _push_untracked_tombstone(self, st: ActorClientState,
+                                  spec: TaskSpec):
+        """Send an abandoned task's sequence number to the actor as a
+        no-op so the ordered execution queue advances past it. The task
+        itself is already failed locally; the tombstone's done report
+        finds no _awaiting entry and is ignored."""
+        spec.method_name = "__rtpu_cancelled__"
+        st.sendq.append(spec)
+        if not st.flush_scheduled:
+            st.flush_scheduled = True
+            asyncio.get_running_loop().call_soon(
+                lambda: asyncio.ensure_future(self._flush(st)))
 
     async def _reconcile(self, st: ActorClientState):
         """After a failed push, poll the GCS: if the actor is still ALIVE at
@@ -1395,6 +1418,9 @@ class CoreWorker:
         self._pending_frees: List[str] = []
         self._free_lock = threading.Lock()
         self._done_batches: Dict[Address, List] = {}
+        # Called with the ObjectID whenever an owned object is freed
+        # (device-resident object pins, experimental/device_objects.py).
+        self.device_object_free_hooks: List = []
         self._shutdown = False
 
     # -- lifecycle -------------------------------------------------------
@@ -1704,17 +1730,17 @@ class CoreWorker:
             return self._fetch_from_owner(ref) is not _MISSING
         return known
 
-    def _is_ready(self, ref: ObjectRef, fetch_local: bool) -> bool:
-        ok = self._is_ready_local(ref.id())
-        if ok is None:
-            return self._is_ready_remote(ref, fetch_local)
-        return ok
 
     def free_objects(self, refs: List[ObjectRef]):
         for ref in refs:
             self._free_owned_object(ref.id())
 
     def _free_owned_object(self, object_id: ObjectID):
+        for hook in self.device_object_free_hooks:
+            try:
+                hook(object_id)
+            except Exception:
+                pass
         self.memory_store.delete([object_id])
         # Batch the directory-free notifications: a burst of ref releases
         # (e.g. a list of ObjectRefs going out of scope) becomes one GCS RPC.
@@ -1902,6 +1928,60 @@ class CoreWorker:
 
     async def handle_ping(self):
         return "pong"
+
+    async def handle_capture_profile(self, kind: str = "pystack",
+                                     duration_s: float = 1.0):
+        """On-demand profiling (reference: dashboard/modules/reporter/
+        profile_manager.py:82 py-spy / memray; TPU equivalent = the jax
+        profiler's xplane capture).
+
+        kinds:
+          pystack — sampled stacks of every thread, collapsed-stack text
+                    (flamegraph input; the py-spy analog without py-spy)
+          jax     — jax.profiler trace for `duration_s`; returns a zip of
+                    the xplane/trace-event artifacts
+        """
+        duration_s = min(float(duration_s), 30.0)
+        loop = asyncio.get_running_loop()
+        if kind == "jax":
+            def _jax_trace():
+                import io as _io
+                import zipfile
+                import tempfile
+
+                import jax
+                with tempfile.TemporaryDirectory() as td:
+                    with jax.profiler.trace(td):
+                        time.sleep(duration_s)
+                    buf = _io.BytesIO()
+                    with zipfile.ZipFile(buf, "w",
+                                         zipfile.ZIP_DEFLATED) as zf:
+                        for root, _dirs, files in os.walk(td):
+                            for f in files:
+                                p = os.path.join(root, f)
+                                zf.write(p, os.path.relpath(p, td))
+                    return buf.getvalue()
+            data = await loop.run_in_executor(None, _jax_trace)
+            return {"kind": "jax", "format": "xplane-zip", "data": data}
+
+        def _pystack():
+            import collections
+            import traceback
+            counts: Dict[str, int] = collections.Counter()
+            deadline = time.monotonic() + duration_s
+            while time.monotonic() < deadline:
+                for frame in list(sys._current_frames().values()):
+                    stack = traceback.extract_stack(frame)
+                    key = ";".join(f"{fr.name} ({os.path.basename(fr.filename)}"
+                                   f":{fr.lineno})" for fr in stack)
+                    counts[key] += 1
+                time.sleep(0.01)
+            text = "\n".join(f"{k} {v}" for k, v in
+                             sorted(counts.items(), key=lambda kv: -kv[1]))
+            return text.encode()
+        data = await loop.run_in_executor(None, _pystack)
+        return {"kind": "pystack", "format": "collapsed-stacks",
+                "data": data}
 
 
 _MISSING = object()

@@ -1,0 +1,72 @@
+"""Pluggable spill storage (reference: _private/external_storage.py —
+ExternalStorage :72 filesystem, smart_open/S3 :398; here the cloud
+driver is fsspec-based, so memory://, file://, s3://, gcs:// all ride
+one implementation).
+
+Selected by `CONFIG.object_spilling_uri`:
+  ""                      -> node-local directory (fast rename path)
+  "memory://rtpu-spill"   -> fsspec in-process memory fs (tests)
+  "s3://bucket/prefix"    -> any fsspec-supported remote store
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class FsspecStorage:
+    """Spill driver over an fsspec URL prefix."""
+
+    def __init__(self, base_uri: str):
+        import fsspec
+        self.base_uri = base_uri.rstrip("/")
+        self._fs, self._base_path = fsspec.core.url_to_fs(self.base_uri)
+        try:
+            self._fs.makedirs(self._base_path, exist_ok=True)
+        except Exception:
+            pass
+
+    def _path(self, key: str) -> str:
+        return f"{self._base_path}/{key}"
+
+    def uri_for(self, key: str) -> str:
+        return f"{self.base_uri}/{key}"
+
+    def put(self, key: str, data: bytes) -> str:
+        with self._fs.open(self._path(key), "wb") as f:
+            f.write(data)
+        return self.uri_for(key)
+
+    def get(self, uri: str) -> Optional[bytes]:
+        import fsspec
+        try:
+            with fsspec.open(uri, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def delete(self, uri: str):
+        import fsspec
+        fs, path = fsspec.core.url_to_fs(uri)
+        try:
+            fs.rm(path)
+        except Exception:
+            pass
+
+
+def storage_from_config() -> Optional[FsspecStorage]:
+    from .config import CONFIG
+    uri = getattr(CONFIG, "object_spilling_uri", "") or \
+        os.environ.get("RTPU_OBJECT_SPILLING_URI", "")
+    if not uri:
+        return None
+    try:
+        return FsspecStorage(uri)
+    except Exception:
+        logger.exception("fsspec spill storage %r unavailable; "
+                         "falling back to local-disk spilling", uri)
+        return None

@@ -217,6 +217,12 @@ class GcsServer:
         self.subscribers.get(channel, set()).discard(tuple(address))
         return True
 
+    async def handle_publish(self, channel: str, message: Dict[str, Any]):
+        """External publisher entry (raylets publishing worker logs etc.;
+        reference: GcsPublisher)."""
+        self.publish(channel, message)
+        return True
+
     def publish(self, channel: str, message: Dict[str, Any]):
         subs = list(self.subscribers.get(channel, ()))
         for addr in subs:
@@ -665,6 +671,7 @@ class GcsServer:
                         if strategy.kind == "placement_group" else None,
                         "grant_or_reject": True,
                         "is_actor": True,
+                        "job": spec.job_id.hex(),
                     },
                     timeout=CONFIG.worker_start_timeout_s)
             except Exception as e:
@@ -674,6 +681,15 @@ class GcsServer:
                 backoff *= 1.6
                 continue
             if reply.get("rejected"):
+                if reply.get("permanent"):
+                    # deterministic env failure: creating again would fail
+                    # the same way — fail the actor instead of spinning
+                    if record.sched_epoch == epoch:
+                        await self._handle_actor_failure(
+                            record,
+                            f"worker environment failed: "
+                            f"{reply.get('error')}", restartable=False)
+                    return
                 await asyncio.sleep(min(backoff, 1.0))
                 backoff *= 1.6
                 continue
@@ -758,11 +774,13 @@ class GcsServer:
             "death_cause": record.death_cause,
         })
 
-    async def _handle_actor_failure(self, record: ActorRecord, cause: str):
+    async def _handle_actor_failure(self, record: ActorRecord, cause: str,
+                                    restartable: bool = True):
         if record.state == "DEAD":
             return
         unlimited = record.max_restarts == -1
-        if unlimited or record.num_restarts < record.max_restarts:
+        if restartable and \
+                (unlimited or record.num_restarts < record.max_restarts):
             record.num_restarts += 1
             record.state = "RESTARTING"
             record.address = None

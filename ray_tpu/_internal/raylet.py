@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import subprocess
 import sys
 import time
@@ -50,6 +51,7 @@ class WorkerHandle:
     registered: Optional[asyncio.Future] = None
     last_idle: float = 0.0
     is_actor_worker: bool = False
+    job_hex: Optional[str] = None  # last-leased job (log-stream routing)
 
 
 @dataclass
@@ -108,6 +110,10 @@ class Raylet:
         self.cluster_view: Dict[str, NodeView] = {}
         self._view_ver = -1  # last merged GCS view version (-1 = none)
         self._view_epoch = 0  # GCS incarnation the version belongs to
+        # in-progress push-broadcast assemblies: object_hex -> state
+        self._push_assembly: Dict[str, Dict[str, Any]] = {}
+        from .external_storage import storage_from_config
+        self.spill_storage = storage_from_config()
         self.node_addresses: Dict[str, Address] = {}
         self._next_lease_id = 0
         self._tasks: List[asyncio.Task] = []
@@ -208,13 +214,8 @@ class Raylet:
         """Workers are dedicated per runtime environment: env vars are
         process state, and working_dir/py_modules mutate sys.path/cwd —
         none of these may leak between environments via worker reuse."""
-        env = runtime_env or {}
-        return (
-            tuple(sorted((env.get("env_vars") or {}).items())),
-            env.get("working_dir") or "",
-            tuple(env.get("py_modules") or ()),
-            tuple(env.get("pip") or ()),
-        )
+        from .task_spec import runtime_env_key
+        return runtime_env_key(runtime_env)
 
     def _spawn_worker(self, env_key: Tuple) -> WorkerHandle:
         worker_id = WorkerID.from_random().binary()
@@ -229,6 +230,9 @@ class Raylet:
             env["PYTHONPATH"] = (pkg_root + os.pathsep + existing
                                  if existing else pkg_root)
         env.update({
+            # piped stdout must not sit in an 8KB block buffer — the log
+            # stream to the driver needs lines as they are printed
+            "PYTHONUNBUFFERED": "1",
             "RTPU_WORKER_ID": worker_id.hex(),
             "RTPU_SESSION": self.session_name,
             "RTPU_NODE_ID": self.node_id,
@@ -258,10 +262,28 @@ class Raylet:
         def _popen():
             # fork/exec off the event loop: a spawn burst must not starve
             # lease/heartbeat handling (1-core boxes stall for seconds).
+            # With log_to_driver, worker output is piped and streamed to
+            # the driver via GCS pubsub (reference: _private/log_monitor.py).
+            from .task_spec import ENV_KEY_PYTHON_ENV
+            interpreter = sys.executable
+            pyenv_reqs = env_key[ENV_KEY_PYTHON_ENV] \
+                if len(env_key) > ENV_KEY_PYTHON_ENV else ()
+            if pyenv_reqs:
+                # isolated venv interpreter (reference: conda/uv plugins)
+                from .runtime_env import ensure_python_env
+                interpreter = ensure_python_env(
+                    list(pyenv_reqs),
+                    os.path.join("/tmp", "rtpu",
+                                 f"session_{self.session_name}", "pyenvs"))
+            if CONFIG.log_to_driver:
+                out_target = err_target = subprocess.PIPE
+            else:
+                # stderr stays inherited: crash tracebacks must surface
+                # somewhere even with log streaming disabled
+                out_target, err_target = subprocess.DEVNULL, None
             return subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._internal.worker_main"],
-                env=env, stdout=subprocess.DEVNULL if not CONFIG.log_to_driver
-                else None, stderr=None)
+                [interpreter, "-m", "ray_tpu._internal.worker_main"],
+                env=env, stdout=out_target, stderr=err_target)
 
         def _attach(fut):
             try:
@@ -275,6 +297,8 @@ class Raylet:
                 return
             handle.proc = proc
             handle.pid = proc.pid
+            if CONFIG.log_to_driver:
+                self._start_log_forwarders(proc)
             if handle.state == "DEAD":
                 # killed while the fork was in flight — don't leak it
                 try:
@@ -284,6 +308,59 @@ class Raylet:
         spawn_fut = loop.run_in_executor(None, _popen)
         spawn_fut.add_done_callback(_attach)
         return handle
+
+    def _start_log_forwarders(self, proc: subprocess.Popen):
+        """Tail the worker's stdout/stderr pipes and publish line batches
+        to the WORKER_LOGS pubsub channel (reference:
+        _private/log_monitor.py -> driver prints them)."""
+        import threading
+
+        from .rpc import EventLoopThread
+
+        gcs = self.clients.get(self.gcs_address)
+
+        def _pump(stream, name):
+            batch: List[str] = []
+            last_flush = time.monotonic()
+
+            def flush():
+                nonlocal batch, last_flush
+                if not batch:
+                    return
+                lines, batch = batch, []
+                last_flush = time.monotonic()
+                EventLoopThread.get().post(gcs.call(
+                    "publish", channel="WORKER_LOGS",
+                    message={"pid": proc.pid, "node_id": self.node_id,
+                             "stream": name, "lines": lines},
+                    timeout=10))
+            import select
+            try:
+                while True:
+                    # select-bounded reads: a quiet stream still flushes
+                    # whatever is batched within ~100ms
+                    ready, _, _ = select.select([stream], [], [], 0.1)
+                    if not ready:
+                        flush()
+                        continue
+                    raw = stream.readline()
+                    if not raw:
+                        break
+                    batch.append(raw.decode("utf-8", "replace")
+                                 .rstrip("\n"))
+                    if len(batch) >= 100 or \
+                            time.monotonic() - last_flush > 0.1:
+                        flush()
+            except Exception:
+                pass
+            finally:
+                flush()
+        for stream, name in ((proc.stdout, "stdout"),
+                             (proc.stderr, "stderr")):
+            if stream is not None:
+                threading.Thread(target=_pump, args=(stream, name),
+                                 daemon=True,
+                                 name=f"rtpu-log-{proc.pid}").start()
 
     async def handle_register_worker(self, worker_id: bytes, address: Address,
                                      pid: int):
@@ -313,6 +390,15 @@ class Raylet:
                           and now - handle.last_idle >
                           CONFIG.worker_idle_timeout_s):
                         self._kill_worker(handle)
+                # Reap abandoned push assemblies (sender died mid-stream).
+                for ohex, assy in list(self._push_assembly.items()):
+                    if now - assy["t"] > 120:
+                        self._push_assembly.pop(ohex, None)
+                        try:
+                            assy["buf"].release()
+                            self.plasma.abort(ObjectID.from_hex(ohex))
+                        except Exception:
+                            pass
             except asyncio.CancelledError:
                 return
             except Exception:
@@ -496,14 +582,21 @@ class Raylet:
             try:
                 await asyncio.wait_for(handle.registered,
                                        CONFIG.worker_start_timeout_s)
-            except Exception:  # timeout or spawn failure
+            except asyncio.TimeoutError:
                 self._kill_worker(handle)
                 self._refund(req.demand, None if charge_node else req.pg)
                 return {"rejected": True,
                         "error": "worker failed to start in time"}
+            except Exception as e:  # spawn failure (bad runtime env...)
+                self._kill_worker(handle)
+                self._refund(req.demand, None if charge_node else req.pg)
+                # Deterministic failures must not retry forever.
+                return {"rejected": True, "permanent": True,
+                        "error": str(e)}
         handle.state = "LEASED"
         handle.lease_id = req.lease_id
         handle.is_actor_worker = bool(req.spec_meta.get("is_actor"))
+        handle.job_hex = req.spec_meta.get("job")
         self.leases[req.lease_id] = (
             handle.worker_id, req.demand, None if charge_node else req.pg)
         return {"rejected": False, "lease_id": req.lease_id,
@@ -639,7 +732,17 @@ class Raylet:
                 break
             try:
                 oid = ObjectID.from_hex(object_hex)
-                path = self.plasma.spill_to(oid, self.spill_dir)
+                if self.spill_storage is not None:
+                    # Cloud spilling (reference: external_storage.py:398):
+                    # ship the bytes through fsspec, free the local copy.
+                    data = self.plasma.read_bytes(oid)
+                    if data is None:
+                        raise FileNotFoundError(object_hex)
+                    path = await asyncio.get_running_loop().run_in_executor(
+                        None, self.spill_storage.put, object_hex, data)
+                    self.plasma.delete(oid)
+                else:
+                    path = self.plasma.spill_to(oid, self.spill_dir)
                 entry.spilled_path = path
                 self.store_used -= entry.size
                 del self.objects[object_hex]
@@ -679,11 +782,39 @@ class Raylet:
             self._pulls.pop(object_hex, None)
 
     async def _pull_object(self, oid: ObjectID, object_hex: str):
+        # A push of this object may be assembling right now — it owns the
+        # store's tmp file, so wait for it rather than racing the create.
+        if object_hex in self._push_assembly:
+            deadline = time.monotonic() + 120
+            while object_hex in self._push_assembly:
+                if time.monotonic() > deadline:
+                    break
+                await asyncio.sleep(0.05)
+            if self.plasma.contains(oid):
+                size = self.plasma.size_of(oid)
+                self.objects.setdefault(object_hex, ObjectEntry(
+                    size=size, last_access=time.monotonic()))
+                return {"ok": True}
         gcs = self.clients.get(self.gcs_address)
         info = await gcs.call("get_object_locations", object_hex=object_hex,
                               timeout=10)
         spilled = info.get("spilled")
-        if spilled and os.path.exists(spilled):
+        if spilled and "://" in spilled and self.spill_storage is not None:
+            data = await asyncio.get_running_loop().run_in_executor(
+                None, self.spill_storage.get, spilled)
+            if data is not None:
+                self.plasma.write_bytes(oid, data)
+                size = len(data)
+                self.objects[object_hex] = ObjectEntry(
+                    size=size, last_access=time.monotonic())
+                self.store_used += size
+                await gcs.call("add_object_location",
+                               object_hex=object_hex,
+                               node_id=self.node_id,
+                               size=info.get("size", size),
+                               owner_address=info.get("owner"), timeout=10)
+                return {"ok": True}
+        if spilled and "://" not in spilled and os.path.exists(spilled):
             self.plasma.restore_from(oid, spilled)
             size = self.plasma.size_of(oid)
             self.objects[object_hex] = ObjectEntry(
@@ -693,7 +824,14 @@ class Raylet:
                            node_id=self.node_id, size=info.get("size", size),
                            owner_address=info.get("owner"), timeout=10)
             return {"ok": True}
-        for node_id in info.get("nodes", []):
+        # Randomize replica choice so a broadcast storm spreads across the
+        # nodes that already hold a copy instead of funnelling into the
+        # first-listed (usually the origin) node.
+        candidates = list(info.get("nodes", []))
+        random.shuffle(candidates)
+        if self.node_id in info.get("nodes", []):
+            candidates.insert(0, self.node_id)
+        for node_id in candidates:
             if node_id == self.node_id:
                 if self.plasma.contains(oid):
                     size = self.plasma.size_of(oid)
@@ -727,13 +865,27 @@ class Raylet:
         chunk = CONFIG.object_store_chunk_bytes
         buf = self.plasma.create(oid, size)
         try:
-            offset = 0
-            while offset < size:
-                n = min(chunk, size - offset)
-                data = await peer.call("fetch_chunk", object_hex=object_hex,
-                                       offset=offset, length=n, timeout=60)
-                buf[offset:offset + len(data)] = data
-                offset += len(data)
+            # Windowed parallel chunk fetch (reference: pull_manager.cc
+            # keeps several chunk requests in flight): overlaps the
+            # peer's read+serialize with our write.
+            sem = asyncio.Semaphore(4)
+
+            async def _one(offset: int, n: int):
+                async with sem:
+                    data = await peer.call(
+                        "fetch_chunk", object_hex=object_hex,
+                        offset=offset, length=n, timeout=60)
+                    buf[offset:offset + len(data)] = data
+            tasks = [asyncio.ensure_future(
+                _one(off, min(chunk, size - off)))
+                for off in range(0, size, chunk)]
+            try:
+                await asyncio.gather(*tasks)
+            except BaseException:
+                for t in tasks:  # stop siblings before releasing buf
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
+                raise
         except Exception:
             buf.release()
             self.plasma.abort(oid)
@@ -765,6 +917,141 @@ class Raylet:
             return bytes(view[offset:offset + length])
         finally:
             view.release()
+
+    # ------------------------------------------------------------------
+    # push-based broadcast (reference: src/ray/object_manager/
+    # push_manager.cc — owner-initiated chunked pushes; here arranged as
+    # a binary forwarding tree so source egress is O(2N) regardless of
+    # the receiver count, and every tree level streams in parallel)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _tree_split(nodes: List) -> List[List]:
+        """Binary forwarding-tree split: two contiguous halves, each led
+        by its first element."""
+        mid = (len(nodes) + 1) // 2
+        return [g for g in (nodes[:mid], nodes[mid:]) if g]
+
+    async def handle_profile_worker(self, pid: int, kind: str = "pystack",
+                                    duration_s: float = 1.0):
+        """Forward a profile capture to the worker with `pid` on this
+        node (reference: reporter agent routing profile requests)."""
+        for handle in self.workers.values():
+            if handle.pid == pid and handle.address is not None:
+                client = self.clients.get(handle.address)
+                return await client.call(
+                    "capture_profile", kind=kind, duration_s=duration_s,
+                    timeout=duration_s + 60)
+        return {"error": f"no worker with pid {pid} on this node"}
+
+    async def handle_push_object(self, object_hex: str,
+                                 target_node_ids: Optional[List[str]] = None):
+        """Push a locally-held object to `target_node_ids` (default: every
+        other alive node). Returns when all receivers have sealed it."""
+        oid = ObjectID.from_hex(object_hex)
+        if not self.plasma.contains(oid):
+            return {"ok": False, "error": "object not local to this node"}
+        size = self.plasma.size_of(oid)
+        if target_node_ids is None:
+            target_node_ids = [nid for nid in self.cluster_view
+                               if nid != self.node_id]
+        addrs = []
+        for nid in target_node_ids:
+            if nid == self.node_id:
+                continue
+            addr = self.node_addresses.get(nid)
+            if addr is not None:
+                addrs.append(tuple(addr))
+        if not addrs:
+            return {"ok": True, "receivers": 0}
+        await self._push_stream(oid, object_hex, size, addrs)
+        return {"ok": True, "receivers": len(addrs)}
+
+    async def _push_stream(self, oid, object_hex: str, size: int,
+                           addrs: List[Address]):
+        """Stream chunks to the two tree children (each forwarding to its
+        own subtree), windowed for pipelining."""
+        groups = self._tree_split(addrs)
+        chunk = CONFIG.object_store_chunk_bytes
+        view = self.plasma.map_read(oid)
+        if view is None:
+            raise KeyError(f"object {object_hex[:12]} vanished mid-push")
+        sem = asyncio.Semaphore(4)
+
+        async def _send(group, offset, n):
+            peer = self.clients.get(group[0])
+            async with sem:
+                data = bytes(view[offset:offset + n])
+                await peer.call(
+                    "push_chunk", object_hex=object_hex, size=size,
+                    offset=offset, data=data,
+                    forward_to=list(group[1:]), timeout=120)
+        tasks = [asyncio.ensure_future(
+            _send(group, off, min(chunk, size - off)))
+            for group in groups for off in range(0, size, chunk)]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # siblings must stop touching the view before we release it
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        finally:
+            view.release()
+
+    async def handle_push_chunk(self, object_hex: str, size: int,
+                                offset: int, data: bytes,
+                                forward_to: List):
+        """Receive one pushed chunk, forward it down the subtree, seal on
+        completion. Replies only after local write + forward, so the
+        sender's window regulates the whole pipeline. Forwarding happens
+        even when the local copy is skipped (already held, or a pull of
+        the same object is in flight) — the subtree must still be fed."""
+        oid = ObjectID.from_hex(object_hex)
+        skip_local = object_hex in self._pulls  # pull owns the tmp file
+        assy = None
+        if not skip_local:
+            assy = self._push_assembly.get(object_hex)
+            if assy is None:
+                if self.plasma.contains(oid):
+                    skip_local = True
+                else:
+                    buf = self.plasma.create(oid, size)
+                    assy = {"buf": buf, "received": 0, "size": size,
+                            "offsets": set(), "t": time.monotonic()}
+                    self._push_assembly[object_hex] = assy
+        if assy is not None:
+            if offset not in assy["offsets"]:  # dedup concurrent pushes
+                assy["buf"][offset:offset + len(data)] = data
+                assy["received"] += len(data)
+                assy["offsets"].add(offset)
+            assy["t"] = time.monotonic()
+        if forward_to:
+            await asyncio.gather(*[
+                self.clients.get(tuple(g[0])).call(
+                    "push_chunk", object_hex=object_hex, size=size,
+                    offset=offset, data=data, forward_to=list(g[1:]),
+                    timeout=120)
+                for g in self._tree_split(forward_to)])
+        if assy is None:
+            return {"ok": True, "dup": True}
+        # Single-seal guard: concurrent chunk handlers resume from their
+        # forwarding awaits after completion; only the first may seal.
+        if assy["received"] >= size and not assy.get("sealed"):
+            assy["sealed"] = True
+            self._push_assembly.pop(object_hex, None)
+            assy["buf"].release()
+            self.plasma.seal(oid)
+            self.objects[object_hex] = ObjectEntry(
+                size=size, last_access=time.monotonic())
+            self.store_used += size
+            gcs = self.clients.get(self.gcs_address)
+            asyncio.ensure_future(gcs.call(
+                "add_object_location", object_hex=object_hex,
+                node_id=self.node_id, size=size, owner_address=None,
+                timeout=10))
+        return {"ok": True}
 
     async def handle_free_objects(self, object_hexes: List[str]):
         for object_hex in object_hexes:

@@ -180,3 +180,93 @@ class RuntimeEnvManager:
                     "in this zero-egress image (packages cannot be "
                     "installed at runtime; bake them into the image)"
                 ) from e
+
+
+# ---------------------------------------------------------------------------
+# Isolated python environments (reference: _private/runtime_env/conda.py /
+# uv.py — a per-requirements interpreter env; here a venv with
+# system-site-packages, which in a zero-egress image validates/overlays
+# requirements against the baked packages instead of downloading)
+# ---------------------------------------------------------------------------
+
+def python_env_key(requirements: List[str]) -> str:
+    digest = hashlib.sha256(
+        "\n".join(sorted(requirements)).encode()).hexdigest()[:16]
+    return f"pyenv-{digest}"
+
+
+def ensure_python_env(requirements: List[str], root: str) -> str:
+    """Create (once) an isolated venv for `requirements`; returns its
+    python executable. Safe under concurrent callers via sentinel+wait.
+    """
+    import subprocess
+    import sys
+    import time as _time
+
+    env_dir = os.path.join(root, python_env_key(requirements))
+    py = os.path.join(env_dir, "bin", "python")
+    marker = os.path.join(env_dir, ".rtpu-ready")
+    if os.path.exists(marker):
+        return py
+    os.makedirs(root, exist_ok=True)
+    lock_path = env_dir + ".lock"
+    try:
+        try:
+            # a lock older than any plausible build is from a builder
+            # that died mid-build (SIGKILL) — reclaim it
+            if _time.time() - os.path.getmtime(lock_path) > 360:
+                os.unlink(lock_path)
+        except OSError:
+            pass
+        fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+    except FileExistsError:
+        # another process is building it: wait for the marker
+        deadline = _time.monotonic() + 300
+        while not os.path.exists(marker):
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"python_env {env_dir} build did not finish")
+            _time.sleep(0.25)
+        return py
+    try:
+        import venv
+        venv.create(env_dir, system_site_packages=True, with_pip=True,
+                    clear=True)
+        # The launching interpreter may itself be a venv (its packages
+        # are NOT the base python's "system site"): link its
+        # site-packages into the new env so baked packages satisfy
+        # requirements offline (reference: conda.py inherits the base
+        # env's packages the same way).
+        import glob as _glob
+        import site as _site
+        env_sites = _glob.glob(os.path.join(
+            env_dir, "lib", "python*", "site-packages"))
+        parent_sites = [p for p in _site.getsitepackages()
+                        if os.path.isdir(p)]
+        for env_site in env_sites:
+            with open(os.path.join(env_site, "_rtpu_parent.pth"),
+                      "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        if requirements:
+            req_file = os.path.join(env_dir, "requirements.txt")
+            with open(req_file, "w") as f:
+                f.write("\n".join(requirements) + "\n")
+            # Zero-egress friendly: requirements already satisfied by the
+            # system site pass instantly; anything else fails loudly.
+            proc = subprocess.run(
+                [py, "-m", "pip", "install", "--no-index",
+                 "-r", req_file],
+                capture_output=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    "python_env requirements not satisfiable offline:\n"
+                    + proc.stderr.decode()[-2000:])
+        with open(marker, "w") as f:
+            f.write("ok")
+        return py
+    finally:
+        try:
+            os.unlink(lock_path)
+        except OSError:
+            pass

@@ -112,9 +112,8 @@ class TaskSpec:
         """Lease reuse key: tasks with the same shape share leased workers
         (reference: SchedulingKey in normal_task_submitter.h). Must cover
         the FULL runtime environment — the raylet dedicates workers per
-        env (_env_key) and lease handoff between different envs would
-        bypass that isolation (stale sys.path/cwd/modules)."""
-        env = self.runtime_env or {}
+        env (runtime_env_key) and lease handoff between different envs
+        would bypass that isolation (stale sys.path/cwd/modules)."""
         return (
             tuple(sorted(self.resources.items())),
             self.scheduling_strategy.kind,
@@ -122,11 +121,7 @@ class TaskSpec:
             self.scheduling_strategy.bundle_index,
             self.scheduling_strategy.node_id,
             tuple(sorted(self.label_selector.items())),
-            tuple(sorted((env.get("env_vars") or {}).items())),
-            env.get("working_dir") or "",
-            tuple(env.get("py_modules") or ()),
-            tuple(env.get("pip") or ()),
-        )
+        ) + runtime_env_key(self.runtime_env)
 
     def dependencies(self) -> List[Tuple[ObjectID, Tuple[str, int]]]:
         deps = []
@@ -203,3 +198,22 @@ class FunctionManager:
         with self._lock:
             self._cache[key] = func
         return func
+
+
+# Positional layout shared by the submitter's lease shape key and the
+# raylet's worker-pool key: [0] env_vars, [1] working_dir,
+# [2] py_modules, [3] pip, [4] python_env requirements. The raylet's
+# worker spawn reads index 4 — keep order append-only.
+ENV_KEY_PYTHON_ENV = 4
+
+
+def runtime_env_key(runtime_env) -> "Tuple":
+    env = runtime_env or {}
+    return (
+        tuple(sorted((env.get("env_vars") or {}).items())),
+        env.get("working_dir") or "",
+        tuple(env.get("py_modules") or ()),
+        tuple(env.get("pip") or ()),
+        tuple(sorted((env.get("python_env") or {})
+                     .get("requirements", ()))),
+    )

@@ -1,0 +1,210 @@
+"""Thin remote-driver client (reference: python/ray/util/client/ —
+`ray.init("ray://...")`; architecture doc util/client/ARCHITECTURE.md).
+
+`ray_tpu.client.connect("host:port")` attaches to a ClientServer running
+inside the cluster: no local raylet/GCS, every API call proxied over one
+RPC connection. Refs here are stubs; the server holds the real ones and
+releases them when the stub is garbage-collected or the session ends.
+
+    ctx = ray_tpu.client.connect("127.0.0.1:10001")
+
+    @ctx.remote
+    def f(x):
+        return x + 1
+
+    ref = f.remote(41)
+    assert ctx.get(ref) == 42
+    ctx.disconnect()
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+from .._internal import serialization
+
+__all__ = ["connect", "ClientContext"]
+
+
+class ClientObjectRef:
+    __slots__ = ("_stub", "_ctx_ref", "__weakref__")
+
+    def __init__(self, stub: str, ctx: "ClientContext"):
+        self._stub = stub
+        self._ctx_ref = weakref.ref(ctx)
+
+    def hex(self) -> str:
+        return self._stub
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._stub[:16]})"
+
+    def __del__(self):
+        ctx = self._ctx_ref()
+        if ctx is not None:
+            ctx._release(self._stub)
+
+
+class ClientActorHandle:
+    def __init__(self, stub: str, ctx: "ClientContext"):
+        self._stub = stub
+        self._ctx = ctx
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientMethod(self, name)
+
+
+class _ClientMethod:
+    def __init__(self, handle: ClientActorHandle, name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        ctx = self._handle._ctx
+        reply = ctx._call("actor_call", actor=self._handle._stub,
+                          method_name=self._name,
+                          data=ctx._pack_args(args, kwargs))
+        return ClientObjectRef(reply["ref"], ctx)
+
+
+class ClientRemoteFunction:
+    def __init__(self, ctx: "ClientContext", fn_id: str, num_returns: int):
+        self._ctx = ctx
+        self._fn_id = fn_id
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        ctx = self._ctx
+        reply = ctx._call("call", fn_id=self._fn_id,
+                          data=ctx._pack_args(args, kwargs),
+                          num_returns=self._num_returns)
+        refs = [ClientObjectRef(r, ctx) for r in reply["refs"]]
+        return refs[0] if reply["single"] else refs
+
+
+class ClientActorClass:
+    def __init__(self, ctx: "ClientContext", fn_id: str):
+        self._ctx = ctx
+        self._fn_id = fn_id
+
+    def remote(self, *args, **kwargs):
+        ctx = self._ctx
+        reply = ctx._call("create_actor", fn_id=self._fn_id,
+                          data=ctx._pack_args(args, kwargs))
+        return ClientActorHandle(reply["actor"], ctx)
+
+
+class ClientContext:
+    def __init__(self, address: str):
+        from .._internal.rpc import ClientPool
+
+        host, port = address.rsplit(":", 1)
+        self._pool = ClientPool()
+        self._client = self._pool.get((host, int(port)))
+        self._session_id = self._rpc("connect")["session_id"]
+        self._registered: set = set()
+        self._pending_release: List[str] = []
+        self._release_lock = threading.Lock()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _rpc(self, method: str, **kwargs):
+        reply = self._client.call_sync(f"client_{method}", timeout=120,
+                                       **kwargs)
+        return reply
+
+    def _call(self, method: str, **kwargs):
+        self._flush_releases()
+        return self._rpc(method, session_id=self._session_id, **kwargs)
+
+    def _release(self, stub: str):
+        with self._release_lock:
+            self._pending_release.append(stub)
+
+    def _flush_releases(self):
+        with self._release_lock:
+            if not self._pending_release:
+                return
+            refs, self._pending_release = self._pending_release, []
+        try:
+            self._rpc("release", session_id=self._session_id, refs=refs)
+        except Exception:
+            pass
+
+    def _pack_args(self, args: Tuple, kwargs: Dict) -> bytes:
+        """Hoist top-level ClientObjectRefs so the server substitutes the
+        real refs (matching the framework's own arg semantics)."""
+        ref_slots = []
+        plain_args = []
+        for i, a in enumerate(args):
+            if isinstance(a, ClientObjectRef):
+                ref_slots.append((("a", i), a.hex()))
+                plain_args.append(None)
+            else:
+                plain_args.append(a)
+        plain_kwargs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, ClientObjectRef):
+                ref_slots.append((("k", k), v.hex()))
+                plain_kwargs[k] = None
+            else:
+                plain_kwargs[k] = v
+        return serialization.dumps(
+            (tuple(plain_args), plain_kwargs, ref_slots))
+
+    # -- public api ------------------------------------------------------
+
+    def remote(self, _target=None, **options):
+        def wrap(target):
+            payload = serialization.dumps({
+                "fn": target, "options": options or None,
+                "is_actor": isinstance(target, type)})
+            fn_id = hashlib.sha1(payload).hexdigest()
+            if fn_id not in self._registered:
+                self._call("register_function", fn_id=fn_id, data=payload)
+                self._registered.add(fn_id)
+            if isinstance(target, type):
+                return ClientActorClass(self, fn_id)
+            return ClientRemoteFunction(
+                self, fn_id, options.get("num_returns", 1))
+        return wrap if _target is None else wrap(_target)
+
+    def put(self, value: Any) -> ClientObjectRef:
+        reply = self._call("put", data=serialization.dumps(value))
+        return ClientObjectRef(reply["ref"], self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        stub_list = [refs.hex()] if single else [r.hex() for r in refs]
+        reply = self._call("get", refs=stub_list, timeout_s=timeout)
+        if "error" in reply:
+            raise serialization.loads(reply["error"])
+        values = serialization.loads(reply["values"])
+        return values[0] if single else values
+
+    def wait(self, refs: List[ClientObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        by_stub = {r.hex(): r for r in refs}
+        reply = self._call("wait", refs=list(by_stub),
+                           num_returns=num_returns, timeout_s=timeout)
+        return ([by_stub[s] for s in reply["ready"]],
+                [by_stub[s] for s in reply["not_ready"]])
+
+    def kill(self, actor: ClientActorHandle):
+        self._call("kill_actor", actor=actor._stub)
+
+    def disconnect(self):
+        try:
+            self._flush_releases()
+            self._rpc("disconnect", session_id=self._session_id)
+        except Exception:
+            pass
+
+
+def connect(address: str) -> ClientContext:
+    return ClientContext(address)

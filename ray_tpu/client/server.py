@@ -1,0 +1,282 @@
+"""Client server: the cluster-side half of the remote-driver mode
+(reference: python/ray/util/client/ARCHITECTURE.md + server/ — a thin
+client proxies every API call to a server that owns the real refs).
+
+The server is a driver attached to the cluster; each connected client
+gets a session holding the REAL ObjectRefs/actor handles its stub ids
+map to, so client-side garbage collection translates into server-side
+releases, and a vanished client's refs are dropped with its session.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+SESSION_TTL_S = 120.0
+
+
+class _Session:
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        self.refs: Dict[str, Any] = {}        # stub id -> ObjectRef
+        self.actors: Dict[str, Any] = {}      # stub id -> actor handle
+        self.functions: Dict[str, Any] = {}   # fn id -> RemoteFunction
+        self.actor_classes: Dict[str, Any] = {}
+        self.last_seen = time.monotonic()
+
+
+class ClientServer:
+    """Serves thin clients over the framework RPC plane."""
+
+    def __init__(self):
+        self._sessions: Dict[str, _Session] = {}
+        self._lock = threading.Lock()
+        self._server = None
+        self.address: Optional[Tuple[str, int]] = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self, host: str = "127.0.0.1", port: int = 0):
+        from .._internal.rpc import EventLoopThread, RpcServer
+
+        self._server = RpcServer("client-server")
+        self._server.register_instance(self)  # methods: handle_client_*
+        loop = EventLoopThread.get()
+        self.address = loop.run_sync(self._server.start(host, port))
+        threading.Thread(target=self._reaper, daemon=True,
+                         name="rtpu-client-reaper").start()
+        return self.address
+
+    def stop(self):
+        from .._internal.rpc import EventLoopThread
+        if self._server is not None:
+            EventLoopThread.get().run_sync(self._server.stop(), 5)
+
+    def _reaper(self):
+        while True:
+            time.sleep(10.0)
+            now = time.monotonic()
+            with self._lock:
+                dead = [sid for sid, s in self._sessions.items()
+                        if now - s.last_seen > SESSION_TTL_S]
+                for sid in dead:
+                    logger.info("client session %s expired", sid[:8])
+                    self._sessions.pop(sid, None)
+
+    def _session(self, session_id: str) -> _Session:
+        with self._lock:
+            session = self._sessions.get(session_id)
+            if session is None:
+                raise RuntimeError(f"unknown client session {session_id!r}")
+            session.last_seen = time.monotonic()
+            return session
+
+    # -- rpc handlers (all named handle_client_*) ------------------------
+
+    async def handle_client_connect(self):
+        session_id = uuid.uuid4().hex
+        with self._lock:
+            self._sessions[session_id] = _Session(session_id)
+        return {"session_id": session_id}
+
+    async def handle_client_ping(self, session_id: str):
+        self._session(session_id)
+        return True
+
+    async def handle_client_disconnect(self, session_id: str):
+        with self._lock:
+            self._sessions.pop(session_id, None)
+        return True
+
+    def _sync_put(self, session_id: str, data: bytes):
+        import ray_tpu
+        from .._internal import serialization
+
+        session = self._session(session_id)
+        ref = ray_tpu.put(serialization.loads(data))
+        stub = ref.hex()
+        session.refs[stub] = ref
+        return {"ref": stub}
+
+    def _sync_get(self, session_id: str, refs: List[str],
+                  timeout_s: Optional[float] = None):
+        import ray_tpu
+        from .._internal import serialization
+
+        session = self._session(session_id)
+        real = [session.refs[r] for r in refs]
+        try:
+            values = ray_tpu.get(real, timeout=timeout_s)
+        except Exception as e:  # noqa: BLE001 — ship the real error
+            return {"error": serialization.dumps(e)}
+        return {"values": serialization.dumps(values)}
+
+    def _sync_wait(self, session_id: str, refs: List[str],
+                   num_returns: int,
+                   timeout_s: Optional[float] = None):
+        import ray_tpu
+
+        session = self._session(session_id)
+        real = {r: session.refs[r] for r in refs}
+        ready, not_ready = ray_tpu.wait(
+            list(real.values()), num_returns=num_returns,
+            timeout=timeout_s)
+        inv = {ref.hex(): stub for stub, ref in real.items()}
+        return {"ready": [inv[r.hex()] for r in ready],
+                "not_ready": [inv[r.hex()] for r in not_ready]}
+
+    async def handle_client_release(self, session_id: str,
+                                    refs: List[str]):
+        try:
+            session = self._session(session_id)
+        except RuntimeError:
+            return True
+        for r in refs:
+            session.refs.pop(r, None)
+        return True
+
+    def _sync_register_function(self, session_id: str,
+                                              fn_id: str, data: bytes):
+        import ray_tpu
+        from .._internal import serialization
+
+        session = self._session(session_id)
+        if fn_id not in session.functions:
+            payload = serialization.loads(data)
+            target = payload["fn"]
+            options = payload.get("options") or {}
+            if payload.get("is_actor"):
+                session.actor_classes[fn_id] = ray_tpu.remote(
+                    **options)(target) if options \
+                    else ray_tpu.remote(target)
+            else:
+                session.functions[fn_id] = ray_tpu.remote(
+                    **options)(target) if options \
+                    else ray_tpu.remote(target)
+        return True
+
+    def _resolve_args(self, session: _Session, data: bytes):
+        from .._internal import serialization
+
+        args, kwargs, ref_slots = serialization.loads(data)
+        args = list(args)
+        for path, stub in ref_slots:
+            kind, index = path
+            real = session.refs[stub]
+            if kind == "a":
+                args[index] = real
+            else:
+                kwargs[index] = real
+        return tuple(args), kwargs
+
+    def _sync_call(self, session_id: str, fn_id: str,
+                                 data: bytes, num_returns: int = 1):
+        session = self._session(session_id)
+        fn = session.functions[fn_id]
+        args, kwargs = self._resolve_args(session, data)
+        out = fn.remote(*args, **kwargs)
+        refs = out if isinstance(out, list) else [out]
+        stubs = []
+        for ref in refs:
+            session.refs[ref.hex()] = ref
+            stubs.append(ref.hex())
+        return {"refs": stubs, "single": not isinstance(out, list)}
+
+    def _sync_create_actor(self, session_id: str,
+                                         fn_id: str, data: bytes):
+        session = self._session(session_id)
+        cls = session.actor_classes[fn_id]
+        args, kwargs = self._resolve_args(session, data)
+        handle = cls.remote(*args, **kwargs)
+        actor_stub = uuid.uuid4().hex
+        session.actors[actor_stub] = handle
+        return {"actor": actor_stub}
+
+    def _sync_actor_call(self, session_id: str, actor: str,
+                         method_name: str, data: bytes):
+        session = self._session(session_id)
+        handle = session.actors[actor]
+        args, kwargs = self._resolve_args(session, data)
+        ref = getattr(handle, method_name).remote(*args, **kwargs)
+        session.refs[ref.hex()] = ref
+        return {"ref": ref.hex()}
+
+    def _sync_kill_actor(self, session_id: str, actor: str):
+        import ray_tpu
+
+        session = self._session(session_id)
+        handle = session.actors.pop(actor, None)
+        if handle is not None:
+            ray_tpu.kill(handle)
+        return True
+
+
+
+    # -- async wrappers: the blocking driver API must run off the io loop
+
+    async def handle_client_put(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_put, **kwargs))
+
+    async def handle_client_get(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_get, **kwargs))
+
+    async def handle_client_wait(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_wait, **kwargs))
+
+    async def handle_client_register_function(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_register_function, **kwargs))
+
+    async def handle_client_call(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_call, **kwargs))
+
+    async def handle_client_create_actor(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_create_actor, **kwargs))
+
+    async def handle_client_actor_call(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_actor_call, **kwargs))
+
+    async def handle_client_kill_actor(self, **kwargs):
+        import asyncio
+        import functools
+        return await asyncio.get_running_loop().run_in_executor(
+            None, functools.partial(self._sync_kill_actor, **kwargs))
+
+def serve_forever(gcs_address: str, host: str = "127.0.0.1",
+                  port: int = 10001):
+    """Entry for `ray_tpu client-server`: attach to the cluster and serve
+    thin clients until killed."""
+    import ray_tpu
+
+    ray_tpu.init(address=gcs_address)
+    server = ClientServer()
+    addr = server.start(host, port)
+    print(f"client server listening on {addr[0]}:{addr[1]}", flush=True)
+    while True:
+        time.sleep(3600)

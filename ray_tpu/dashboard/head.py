@@ -146,11 +146,41 @@ class DashboardHead:
             return self._json(st.list_workers())
         if path == "/api/timeline":
             return self._json(st.timeline())
+        if path == "/api/profile":
+            return self._route_profile(query)
 
         job_match = re.fullmatch(r"/api/jobs/([^/]*)(/logs|/stop)?", path)
         if path == "/api/jobs/" or job_match:
             return self._route_jobs(method, job_match, body)
         return (404, b"not found", "text/plain")
+
+    def _route_profile(self, query: Dict[str, str]):
+        """GET /api/profile?pid=&node_id=&kind=pystack|jax&duration=1
+        (reference: dashboard/modules/reporter/profile_manager.py:82 —
+        on-demand worker profiling; TPU analog = jax xplane capture)."""
+        from .._internal.core_worker import get_core_worker
+
+        pid = query.get("pid")
+        if not pid:
+            return self._json({"error": "pid query param required"}, 400)
+        worker = get_core_worker()
+        node_id = query.get("node_id") or worker.node_id
+        nodes = worker.gcs.call_sync("get_all_nodes", timeout=10)
+        addr = next((tuple(n["address"]) for n in nodes
+                     if n["node_id"] == node_id), None)
+        if addr is None:
+            return self._json({"error": f"unknown node {node_id}"}, 404)
+        raylet = worker.clients.get(addr)
+        reply = raylet.call_sync(
+            "profile_worker", pid=int(pid),
+            kind=query.get("kind", "pystack"),
+            duration_s=float(query.get("duration", 1.0)),
+            timeout=float(query.get("duration", 1.0)) + 90)
+        if reply.get("error"):
+            return self._json(reply, 404)
+        ctype = "application/zip" if reply.get("kind") == "jax" \
+            else "text/plain"
+        return (200, reply["data"], ctype)
 
     def _route_jobs(self, method: str, match, body: bytes):
         from ..job_submission import JobManager

@@ -1,0 +1,35 @@
+"""Experimental APIs (reference: python/ray/experimental/)."""
+
+from typing import List, Optional
+
+
+def push_object(ref, node_ids: Optional[List[str]] = None,
+                timeout: float = 600.0) -> int:
+    """Owner-initiated broadcast of a plasma object to other nodes
+    (reference: src/ray/object_manager/push_manager.cc). The source
+    raylet streams chunks down a binary forwarding tree, so source
+    egress stays O(2 x object size) regardless of receiver count and
+    tree levels transfer in parallel. Returns the number of receivers.
+
+    `node_ids=None` pushes to every other alive node. Subsequent
+    `ray.get` of the ref on those nodes hits the local store.
+    """
+    from .._internal.core_worker import get_core_worker
+
+    worker = get_core_worker()
+    oid = ref.id()
+    entry = worker.memory_store.get_entry(oid)
+    if entry is not None and not entry.in_plasma:
+        raise ValueError(
+            "push_object requires a plasma (shared-memory) object; this "
+            "ref resolves to a small in-process value")
+    raylet = worker.clients.get(worker.raylet_address)
+    reply = raylet.call_sync("push_object", object_hex=oid.hex(),
+                             target_node_ids=node_ids, timeout=timeout)
+    if not reply.get("ok"):
+        raise RuntimeError(f"push_object failed: {reply.get('error')}")
+    return reply.get("receivers", 0)
+
+
+from .device_objects import (DeviceObjectDescriptor, device_get,  # noqa: E402,F401
+                             device_put_ref)

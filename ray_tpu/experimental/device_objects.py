@@ -1,0 +1,183 @@
+"""Device-resident objects: jax.Arrays that stay in accelerator memory
+with only a control-plane descriptor crossing the object store.
+
+Role of the reference's GPU objects
+(python/ray/experimental/gpu_object_manager/gpu_object_manager.py:61 —
+tensors live on-device, Ray carries refs; collective/NIXL transports move
+them device-to-device). TPU-native design:
+
+- `device_put_ref(array)` in the producing actor pins the array in a
+  process-local store and returns an ObjectRef OWNED BY THE PRODUCER
+  whose control-plane value is a tiny `DeviceObjectDescriptor`. The
+  array itself never leaves HBM and never touches /dev/shm.
+- `device_get(ref)` anywhere resolves the descriptor (normal object
+  path: bytes-sized), then pulls the array runtime-to-runtime through
+  `jax.experimental.transfer` (PJRT cross-host DMA — ICI/DCN on TPU) —
+  or returns the pinned array directly when the consumer IS the
+  producer process.
+- Lifetime rides the existing borrower protocol: consumers hold borrows
+  of the producer-owned descriptor; when the last ref drops, the
+  producer's `_free_owned_object` fires `on_free` and the pin is
+  released.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+from .._internal.ids import ObjectID
+from .._internal.object_ref import ObjectRef
+
+_lock = threading.Lock()
+_pinned: Dict[ObjectID, Any] = {}          # oid -> jax.Array (producer)
+_server = None                             # this process's TransferServer
+_server_addr: Optional[str] = None
+_next_uuid = [1]
+_conns: Dict[str, Any] = {}                # addr -> TransferConnection
+
+
+@dataclass
+class DeviceObjectDescriptor:
+    object_hex: str
+    transfer_addr: str          # producer's TransferServer address
+    producer_rpc_addr: Tuple[str, int]
+    shape: Tuple[int, ...]
+    dtype: str
+    nbytes: int
+
+
+def _ensure_server():
+    global _server, _server_addr
+    with _lock:
+        if _server is None:
+            import jax
+            from jax.experimental import transfer
+            client = jax.devices()[0].client
+            # A bulk-transport address is REQUIRED for cross-process
+            # pulls (the default server only short-circuits locally).
+            host = os.environ.get("RTPU_TRANSFER_HOST", "127.0.0.1")
+            _server = transfer.start_transfer_server(
+                client, f"{host}:0", [f"{host}:0"])
+            _server_addr = _server.address()
+        return _server
+
+
+def device_put_ref(array) -> ObjectRef:
+    """Pin `array` on-device in this process and return a control-plane
+    ref to it. Call inside the producing actor; return the ref (or a
+    structure containing it) to consumers."""
+    import numpy as np
+
+    from .._internal.core_worker import get_core_worker
+
+    _ensure_server()
+    worker = get_core_worker()
+    oid = ObjectID.from_random()
+    with _lock:
+        _pinned[oid] = array
+    desc = DeviceObjectDescriptor(
+        object_hex=oid.hex(), transfer_addr=_server_addr,
+        producer_rpc_addr=tuple(worker.rpc_address),
+        shape=tuple(array.shape), dtype=str(np.dtype(array.dtype)),
+        nbytes=int(array.nbytes))
+    worker.reference_counter.add_owned(oid)
+    worker.memory_store.put(oid, desc)
+    _register_free_hook()
+    return ObjectRef(oid, worker.rpc_address)
+
+
+def device_get(ref: ObjectRef):
+    """Resolve a device-object ref to a jax.Array in THIS process's
+    runtime. Same-process: the pinned array itself (zero copy). Remote:
+    a runtime-to-runtime pull via jax.experimental.transfer — no host
+    shared-memory file is ever written."""
+    import ray_tpu
+
+    oid = ref.id()
+    with _lock:
+        local = _pinned.get(oid)
+    if local is not None:
+        return local
+    desc = ray_tpu.get(ref)
+    if not isinstance(desc, DeviceObjectDescriptor):
+        raise TypeError(f"{ref} is not a device object (got "
+                        f"{type(desc).__name__})")
+    return _pull(desc)
+
+
+def _pull(desc: DeviceObjectDescriptor):
+    import jax
+    import numpy as np
+
+    from .._internal.core_worker import get_core_worker
+
+    server = _ensure_server()
+    worker = get_core_worker()
+    # Ask the producer to stage the array for one pull under a fresh
+    # uuid (await_pull is single-shot; N consumers = N stagings).
+    client = worker.clients.get(tuple(desc.producer_rpc_addr))
+    reply = client.call_sync("device_object_stage",
+                             object_hex=desc.object_hex, timeout=120)
+    if not reply.get("ok"):
+        raise RuntimeError(
+            f"device object {desc.object_hex[:12]} unavailable: "
+            f"{reply.get('error')}")
+    uuid = reply["uuid"]
+    with _lock:
+        conn = _conns.get(desc.transfer_addr)
+        if conn is None:
+            conn = server.connect(desc.transfer_addr)
+            _conns[desc.transfer_addr] = conn
+    spec = jax.ShapeDtypeStruct(
+        desc.shape, np.dtype(desc.dtype),
+        sharding=jax.sharding.SingleDeviceSharding(jax.devices()[0]))
+    out = conn.pull(uuid, [spec])
+    return out[0]
+
+
+# -- producer-side plumbing -------------------------------------------------
+
+def _stage_for_pull(object_hex: str) -> Dict[str, Any]:
+    """RPC handler body: stage one pull of a pinned array."""
+    oid = ObjectID.from_hex(object_hex)
+    with _lock:
+        array = _pinned.get(oid)
+        if array is None:
+            return {"ok": False, "error": "not pinned in this process"}
+        uuid = _next_uuid[0]
+        _next_uuid[0] += 1
+    _ensure_server().await_pull(uuid, [array])
+    return {"ok": True, "uuid": uuid}
+
+
+_hook_installed = False
+
+
+def _register_free_hook():
+    """Install the RPC handler + free callback on this process's worker."""
+    global _hook_installed
+    if _hook_installed:
+        return
+    from .._internal.core_worker import get_core_worker
+
+    worker = get_core_worker()
+
+    async def handle_device_object_stage(object_hex: str):
+        return _stage_for_pull(object_hex)
+
+    worker.server.register("device_object_stage", handle_device_object_stage)
+    worker.device_object_free_hooks.append(on_free)
+    _hook_installed = True
+
+
+def on_free(object_id: ObjectID):
+    with _lock:
+        _pinned.pop(object_id, None)
+
+
+def num_pinned() -> int:
+    with _lock:
+        return len(_pinned)

@@ -9,7 +9,9 @@ continuous-batching decode loop over slot-structured KV caches, jitted
 once per shape bucket, deployed behind ray_tpu.serve."""
 
 from .engine import EngineConfig, GenerationRequest, LLMEngine
+from .paged import PagedEngineConfig, PagedLLMEngine
 from .serving import build_llm_deployment
 
 __all__ = ["EngineConfig", "GenerationRequest", "LLMEngine",
+           "PagedEngineConfig", "PagedLLMEngine",
            "build_llm_deployment"]

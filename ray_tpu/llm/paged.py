@@ -1,0 +1,422 @@
+"""Paged-KV continuous-batching engine with prefix page sharing
+(reference: vLLM's PagedAttention as delegated by
+python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py, and
+the prefix-aware machinery in serve/request_router/; re-designed
+TPU-native: page pools in the Pallas paged-attention kernel's layout,
+one jitted decode step for the whole active batch).
+
+vs the slot engine (`engine.py`): HBM scales with tokens-in-flight
+(`num_pages x page_size`), not `max_batch x max_len`; full prompt pages
+shared byte-identically across requests via a prefix hash (system
+prompts stored once); admission blocks on page budget, not slot shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
+from .engine import GenerationRequest
+
+
+@dataclasses.dataclass
+class PagedEngineConfig:
+    model: LlamaConfig
+    max_batch: int = 4            # concurrent decode rows
+    max_len: int = 512            # per-request logical cap
+    page_size: int = 16
+    num_pages: int = 256          # pool capacity = num_pages * page_size
+    prefill_buckets: Tuple[int, ...] = (32, 64, 128, 256)
+    temperature: float = 0.0
+    eos_token: Optional[int] = None
+    seed: int = 0
+
+    @property
+    def pages_per_seq(self) -> int:
+        return -(-self.max_len // self.page_size)
+
+
+class PagePool:
+    """Physical page allocator with refcounts (shared prefix pages)."""
+
+    def __init__(self, num_pages: int):
+        self._free = list(range(num_pages - 1, 0, -1))
+        # page 0 is the null page block tables pad with; never allocated
+        self.refs = np.zeros(num_pages, np.int32)
+        self.refs[0] = 1
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        page = self._free.pop()
+        self.refs[page] = 1
+        return page
+
+    def incref(self, page: int):
+        self.refs[page] += 1
+
+    def decref(self, page: int):
+        self.refs[page] -= 1
+        if self.refs[page] == 0:
+            self._free.append(page)
+
+    def num_free(self) -> int:
+        return len(self._free)
+
+
+@dataclasses.dataclass
+class _Seq:
+    request: Optional[GenerationRequest] = None
+    pages: List[int] = dataclasses.field(default_factory=list)
+    own_from: int = 0            # pages[:own_from] are shared (prefix)
+    length: int = 0              # cached tokens
+    generated: List[int] = dataclasses.field(default_factory=list)
+    last_token: int = 0
+    cancelled: bool = False
+
+
+class PagedLLMEngine:
+    """Same external surface as LLMEngine (submit/step/generate/stats)
+    plus cancel() and per-token streaming callbacks."""
+
+    def __init__(self, config: PagedEngineConfig,
+                 params: Optional[Any] = None):
+        self.config = config
+        cfg = config.model
+        self.model = LlamaModel(cfg)
+        rng = jax.random.PRNGKey(config.seed)
+        if params is None:
+            from ..parallel.mesh import unbox
+            params = unbox(self.model.init(
+                rng, jnp.zeros((1, 8), jnp.int32))["params"])
+        self.params = params
+        self._rng = rng
+        kvh, hd = cfg.num_kv_heads, cfg.head_dim_
+        P, ps = config.num_pages, config.page_size
+        # kernel layout: [kv_heads, num_pages, page_size, head_dim]
+        self.k_pages = [jnp.zeros((kvh, P, ps, hd), cfg.dtype)
+                        for _ in range(cfg.num_layers)]
+        self.v_pages = [jnp.zeros((kvh, P, ps, hd), cfg.dtype)
+                        for _ in range(cfg.num_layers)]
+        self.pool = PagePool(P)
+        # prefix cache: hash(token-prefix through page k) -> per-layer page
+        self.prefix_pages: Dict[Tuple, List[int]] = {}
+        self._prefix_lru: List[Tuple] = []
+        self.seqs: List[_Seq] = [_Seq() for _ in range(config.max_batch)]
+        self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._by_id: Dict[str, _Seq] = {}
+        self._steps = 0
+        self._tokens_generated = 0
+        model = self.model
+
+        def decode_step(params, k_pages, v_pages, block_tables, lengths,
+                        tokens, rng, temperature):
+            caches = [
+                {"k": k_pages[i], "v": v_pages[i],
+                 "block_tables": block_tables, "lengths": lengths}
+                for i in range(cfg.num_layers)
+            ]
+            logits, new_caches = model.apply(
+                {"params": params}, tokens, positions=lengths[:, None],
+                kv_caches=caches, cache_index=None)
+            last = logits[:, -1, :].astype(jnp.float32)
+            greedy = jnp.argmax(last, axis=-1)
+            sampled = jax.random.categorical(
+                rng, last / jnp.maximum(temperature, 1e-6)[:, None])
+            out = jnp.where(temperature > 0, sampled, greedy)
+            nk = [c["k"] for c in new_caches]
+            nv = [c["v"] for c in new_caches]
+            return out.astype(jnp.int32), nk, nv
+
+        self._decode = jax.jit(decode_step, donate_argnums=(1, 2))
+
+        def prefill(params, tokens, positions):
+            # rounded up to whole pages so page scatter never overruns
+            caches = init_kv_caches(
+                cfg, 1, config.pages_per_seq * config.page_size)
+            logits, new_caches = model.apply(
+                {"params": params}, tokens, positions=positions,
+                kv_caches=caches, cache_index=0)
+            return logits.astype(jnp.float32), new_caches
+
+        self._prefill = jax.jit(prefill)
+
+        def write_pages(k_pages, v_pages, dense_caches, page_ids,
+                        start_tok):
+            """Scatter pages of a [1, kvh, L, hd] dense prefill cache
+            into the pools at physical ids `page_ids`, starting at token
+            offset `start_tok` (traced: no recompile per prefix hit)."""
+            ps_ = config.page_size
+            nk, nv = [], []
+            for (kp, vp, (dk, dv)) in zip(k_pages, v_pages, dense_caches):
+                # [1, kvh, L, hd] -> [n, kvh, ps, hd] page-major rows
+                seg_k = jax.lax.dynamic_slice_in_dim(
+                    dk[0], start_tok, page_ids.shape[0] * ps_, axis=1)
+                seg_v = jax.lax.dynamic_slice_in_dim(
+                    dv[0], start_tok, page_ids.shape[0] * ps_, axis=1)
+                kvh_ = seg_k.shape[0]
+                seg_k = seg_k.reshape(kvh_, page_ids.shape[0], ps_, -1)
+                seg_v = seg_v.reshape(kvh_, page_ids.shape[0], ps_, -1)
+                nk.append(kp.at[:, page_ids].set(seg_k.astype(kp.dtype)))
+                nv.append(vp.at[:, page_ids].set(seg_v.astype(vp.dtype)))
+            return nk, nv
+
+        self._write_pages = jax.jit(write_pages, donate_argnums=(0, 1),
+                                    static_argnums=())
+
+    # -- submission / cancel ---------------------------------------------
+
+    def submit(self, request: GenerationRequest,
+               done_callback: Optional[Callable] = None,
+               token_callback: Optional[Callable] = None):
+        n = len(request.prompt_tokens)
+        if n >= self.config.max_len:
+            raise ValueError("prompt longer than max_len")
+        if n > self.config.prefill_buckets[-1]:
+            raise ValueError("prompt exceeds the largest prefill bucket")
+        request._done_callback = done_callback  # type: ignore
+        request._token_callback = token_callback  # type: ignore
+        self._pending.put(request)
+
+    def cancel(self, request_id: str) -> bool:
+        """Abort a request: frees its slot+pages on the next tick if
+        running, or drops it from the queue."""
+        seq = self._by_id.get(request_id)
+        if seq is not None and seq.request is not None:
+            seq.cancelled = True
+            return True
+        # queued: rebuild the queue without it
+        kept, found = [], False
+        try:
+            while True:
+                r = self._pending.get_nowait()
+                if r.request_id == request_id and not found:
+                    found = True
+                    continue
+                kept.append(r)
+        except queue.Empty:
+            pass
+        for r in kept:
+            self._pending.put(r)
+        return found
+
+    def has_work(self) -> bool:
+        return (not self._pending.empty()) or \
+            any(s.request is not None for s in self.seqs)
+
+    # -- scheduler tick ----------------------------------------------------
+
+    def step(self) -> List[Tuple[GenerationRequest, Any]]:
+        self._admit()
+        finished = []
+        active = [i for i, s in enumerate(self.seqs)
+                  if s.request is not None]
+        if active:
+            finished.extend(self._decode_tick(active))
+        self._steps += 1
+        return finished
+
+    def _pages_needed(self, request: GenerationRequest) -> int:
+        total = len(request.prompt_tokens) + request.max_new_tokens
+        return -(-min(total + 1, self.config.max_len)
+                 // self.config.page_size)
+
+    def _admit(self):
+        for index, seq in enumerate(self.seqs):
+            if seq.request is not None:
+                continue
+            try:
+                request = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if self.pool.num_free() < self._pages_needed(request):
+                # page budget exhausted: requeue and stop admitting —
+                # decode completions will free pages
+                self._pending.put(request)
+                return
+            try:
+                self._prefill_into(index, request)
+            except Exception as e:  # noqa: BLE001
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, e)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError("prompt too long")
+
+    def _prefill_into(self, index: int, request: GenerationRequest):
+        cfg = self.config
+        prompt = request.prompt_tokens
+        ps = cfg.page_size
+        # 1. prefix reuse: full pages whose token prefix is already pooled
+        shared: List[int] = []
+        n_full = len(prompt) // ps
+        for k in range(n_full, 0, -1):
+            key = tuple(prompt[:k * ps])
+            hit = self.prefix_pages.get(key)
+            if hit is not None:
+                # incref every layer-0 page id (ids shared across layers)
+                for page in hit:
+                    self.pool.incref(page)
+                shared = list(hit)
+                break
+        # 2. dense prefill of the whole prompt (compute), paged storage
+        bucket = self._bucket(len(prompt))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(prompt)] = prompt
+        positions = np.arange(bucket, dtype=np.int32)[None, :]
+        logits, dense_caches = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions))
+        n_pages = self._pages_needed(request)
+        new_ids = []
+        for _ in range(n_pages - len(shared)):
+            page = self.pool.alloc()
+            assert page is not None, "admission checked the budget"
+            new_ids.append(page)
+        # write only the non-shared tail pages (shared ones are
+        # byte-identical by construction)
+        if new_ids:
+            self.k_pages, self.v_pages = self._write_pages(
+                self.k_pages, self.v_pages, dense_caches,
+                jnp.asarray(new_ids, jnp.int32),
+                jnp.asarray(len(shared) * ps, jnp.int32))
+        pages = shared + new_ids
+        # 3. register newly-complete full-page prefixes for reuse
+        for k in range(1, n_full + 1):
+            key = tuple(prompt[:k * ps])
+            if key not in self.prefix_pages:
+                for page in pages[:k]:
+                    self.pool.incref(page)
+                self.prefix_pages[key] = pages[:k]
+                self._prefix_lru.append(key)
+        self._evict_prefixes()
+        # 4. first token from the prefill logits
+        last_logits = np.asarray(logits[0, len(prompt) - 1], np.float64)
+        first_token = int(np.argmax(last_logits))
+        seq = self.seqs[index]
+        seq.request = request
+        seq.pages = pages
+        seq.own_from = len(shared)
+        seq.length = len(prompt)
+        seq.generated = [first_token]
+        seq.last_token = first_token
+        seq.cancelled = False
+        self._by_id[request.request_id] = seq
+        self._tokens_generated += 1
+        self._emit_token(seq, first_token)
+
+    def _evict_prefixes(self, max_entries: int = 128):
+        while len(self._prefix_lru) > max_entries:
+            key = self._prefix_lru.pop(0)
+            pages = self.prefix_pages.pop(key, None)
+            if pages:
+                for page in pages:
+                    self.pool.decref(page)
+
+    def _emit_token(self, seq: _Seq, token: int):
+        callback = getattr(seq.request, "_token_callback", None)
+        if callback is not None:
+            callback(seq.request, token)
+
+    def _release(self, seq: _Seq):
+        for page in seq.pages:
+            self.pool.decref(page)
+        self._by_id.pop(seq.request.request_id, None)
+
+    def _decode_tick(self, active: List[int]):
+        cfg = self.config
+        B = cfg.max_batch
+        # cancelled sequences release before the step
+        finished = []
+        for i in list(active):
+            seq = self.seqs[i]
+            if seq.cancelled:
+                request = seq.request
+                self._release(seq)
+                self.seqs[i] = _Seq()
+                active.remove(i)
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, None)  # None = cancelled
+        if not active:
+            return finished
+        block_tables = np.zeros((B, cfg.pages_per_seq), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        tokens = np.zeros((B, 1), np.int32)
+        temps = np.zeros((B,), np.float32)
+        for i in active:
+            seq = self.seqs[i]
+            block_tables[i, :len(seq.pages)] = seq.pages
+            lengths[i] = seq.length
+            tokens[i, 0] = seq.last_token
+            temp = seq.request.temperature
+            temps[i] = temp if temp is not None else cfg.temperature
+        self._rng, key = jax.random.split(self._rng)
+        out, self.k_pages, self.v_pages = self._decode(
+            self.params, self.k_pages, self.v_pages,
+            jnp.asarray(block_tables), jnp.asarray(lengths),
+            jnp.asarray(tokens), key, jnp.asarray(temps))
+        out = np.asarray(out)
+        for i in active:
+            seq = self.seqs[i]
+            token = int(out[i])
+            seq.generated.append(token)
+            seq.last_token = token
+            seq.length += 1
+            self._tokens_generated += 1
+            self._emit_token(seq, token)
+            request = seq.request
+            hit_eos = (cfg.eos_token is not None
+                       and token == cfg.eos_token)
+            capacity = len(seq.pages) * cfg.page_size
+            if hit_eos or len(seq.generated) >= request.max_new_tokens \
+                    or seq.length + 1 >= capacity \
+                    or seq.length >= cfg.max_len - 1:
+                finished.append((request, list(seq.generated)))
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, list(seq.generated))
+                self._release(seq)
+                self.seqs[i] = _Seq()
+        return finished
+
+    # -- conveniences ------------------------------------------------------
+
+    def generate(self, prompts: List[List[int]],
+                 max_new_tokens: int = 32,
+                 timeout_s: float = 300.0) -> List[List[int]]:
+        results: Dict[int, List[int]] = {}
+        for i, prompt in enumerate(prompts):
+            self.submit(GenerationRequest(
+                prompt_tokens=prompt, max_new_tokens=max_new_tokens,
+                request_id=str(i)))
+        deadline = time.monotonic() + timeout_s
+        while len(results) < len(prompts):
+            if time.monotonic() > deadline:
+                raise TimeoutError("generation timed out")
+            for request, tokens in self.step():
+                results[int(request.request_id)] = tokens
+        return [results[i] for i in range(len(prompts))]
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "steps": self._steps,
+            "tokens_generated": self._tokens_generated,
+            "active": sum(1 for s in self.seqs if s.request is not None),
+            "pending": self._pending.qsize(),
+            "free_pages": self.pool.num_free(),
+            "prefix_entries": len(self.prefix_pages),
+            "hbm_cache_bytes": 2 * self.config.model.num_layers *
+            int(np.prod(self.k_pages[0].shape)) *
+            self.k_pages[0].dtype.itemsize,
+        }

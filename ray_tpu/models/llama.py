@@ -163,7 +163,64 @@ class Attention(nn.Module):
         k = apply_rope(k, cos, sin, positions)
 
         new_cache = None
-        if kv_cache is not None:
+        if isinstance(kv_cache, dict):
+            # Paged decode (q_len == 1): the cache is a page pool
+            #   k/v: [kv_heads, num_pages, page_size, head_dim]
+            #   block_tables: [B, pages_per_seq] physical page ids
+            #   lengths: [B] tokens already cached (this token's position)
+            # Write lands at (table[len//ps], len%ps); attention runs the
+            # Pallas paged kernel on TPU (jax.experimental.pallas.ops.tpu.
+            # paged_attention) or a gather fallback elsewhere.
+            kp, vp = kv_cache["k"], kv_cache["v"]
+            block_tables = kv_cache["block_tables"]
+            lengths = kv_cache["lengths"]
+            page_size = kp.shape[2]
+            B = q.shape[0]
+            rows = jnp.arange(B)
+            page_of = block_tables[rows, lengths // page_size]
+            offset = lengths % page_size
+            # k,v are [B, kvh, 1, hd] -> write [kvh, B, hd] rows
+            k_rows = jnp.transpose(k[:, :, 0, :], (1, 0, 2)).astype(kp.dtype)
+            v_rows = jnp.transpose(v[:, :, 0, :], (1, 0, 2)).astype(vp.dtype)
+            kp = kp.at[:, page_of, offset, :].set(k_rows)
+            vp = vp.at[:, page_of, offset, :].set(v_rows)
+            new_cache = dict(kv_cache, k=kp, v=vp)
+            q1 = q[:, :, 0, :]  # [B, heads, hd]
+            if jax.default_backend() == "tpu":
+                from jax.experimental.pallas.ops.tpu.paged_attention \
+                    .paged_attention_kernel import paged_attention
+                n_pages = block_tables.shape[1]
+                # kernel requires pages_per_sequence % block == 0
+                ppcb = next(d for d in range(min(8, n_pages), 0, -1)
+                            if n_pages % d == 0)
+                out1 = paged_attention(
+                    (q1 * hd ** -0.5).astype(kp.dtype), kp, vp,
+                    lengths + 1, block_tables,
+                    pages_per_compute_block=ppcb)
+            else:
+                # Gather fallback: materialize each row's pages densely.
+                # [B, pages_per_seq, kvh, ps, hd] -> [B, kvh, L, hd]
+                gk = jnp.transpose(kp, (1, 0, 2, 3))[block_tables]
+                gv = jnp.transpose(vp, (1, 0, 2, 3))[block_tables]
+                L = block_tables.shape[1] * page_size
+                gk = jnp.transpose(gk, (0, 2, 1, 3, 4)).reshape(
+                    B, kp.shape[0], L, hd)
+                gv = jnp.transpose(gv, (0, 2, 1, 3, 4)).reshape(
+                    B, vp.shape[0], L, hd)
+                groups = cfg.num_heads // cfg.num_kv_heads
+                gk = jnp.repeat(gk, groups, axis=1)
+                gv = jnp.repeat(gv, groups, axis=1)
+                logits = jnp.einsum(
+                    "bhd,bhkd->bhk", q1.astype(jnp.float32),
+                    gk.astype(jnp.float32)) * (hd ** -0.5)
+                kv_pos = jnp.arange(L)[None, :]
+                mask = kv_pos <= lengths[:, None]
+                logits = jnp.where(mask[:, None, :], logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1)
+                out1 = jnp.einsum("bhk,bhkd->bhd", probs,
+                                  gv.astype(jnp.float32))
+            out = out1[:, :, None, :].astype(cfg.dtype)
+        elif kv_cache is not None:
             # Decode: write new K/V at cache_index, attend over the cache.
             # cache_index may be a scalar (whole batch at one position —
             # single-sequence decode / prefill) or a [batch] vector (each

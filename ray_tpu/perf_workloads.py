@@ -1,0 +1,168 @@
+"""ML-workload benchmarks covering the five BASELINE.json configs
+(VERDICT r2 item 3): RLlib PPO / IMPALA sampling+learning rates, Serve
+HTTP throughput + latency, Data pipeline throughput, and LLM engine
+decode throughput. The Train number comes from bench.py on the TPU.
+
+Run: python -m ray_tpu.perf_workloads [--which all|ppo|impala|serve|data|llm]
+Prints one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _report(metric: str, value: float, unit: str, **extra):
+    print(json.dumps({"metric": metric, "value": round(value, 2),
+                      "unit": unit, **extra}), flush=True)
+
+
+def bench_ppo(iters: int = 12):
+    from ray_tpu.rllib import PPOConfig
+    algo = (PPOConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=128)
+            .training(lr=1e-3, num_epochs=10, minibatch_size=256)
+            .build())
+    algo.train()  # warm/compile
+    t0 = time.perf_counter()
+    steps = 0
+    learner_rates = []
+    for _ in range(iters):
+        result = algo.train()
+        steps += result["num_env_steps_sampled"]
+        learner_rates.append(result["learner_samples_per_s"])
+    wall = time.perf_counter() - t0
+    algo.stop()
+    _report("ppo_env_steps_per_s", steps / wall, "steps/s")
+    _report("ppo_learner_samples_per_s",
+            sum(learner_rates) / len(learner_rates), "samples/s")
+
+
+def bench_impala(iters: int = 20):
+    from ray_tpu.rllib import ImpalaConfig
+    algo = (ImpalaConfig().environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=32,
+                         rollout_fragment_length=32)
+            .training(lr=1e-3, train_batch_slots=64, num_epochs=2)
+            .build())
+    algo.train()
+    t0 = time.perf_counter()
+    trained = 0
+    for _ in range(iters):
+        result = algo.train()
+        trained += result["num_env_steps_trained_this_iter"]
+    wall = time.perf_counter() - t0
+    algo.stop()
+    _report("impala_env_steps_trained_per_s", trained / wall, "steps/s")
+
+
+def bench_serve(seconds: float = 10.0, concurrency: int = 8):
+    import threading
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def echo(request):
+        return {"ok": True}
+
+    serve.run(echo.bind(), name="bench", route_prefix="/bench")
+    base = f"{serve.api.get_http_address()}/bench"
+    # warm
+    for _ in range(5):
+        urllib.request.urlopen(base, timeout=10).read()
+    latencies = []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + seconds
+
+    def pound():
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            urllib.request.urlopen(base, timeout=30).read()
+            dt = time.perf_counter() - t0
+            with lock:
+                latencies.append(dt)
+    threads = [threading.Thread(target=pound) for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    latencies.sort()
+    n = len(latencies)
+    _report("serve_requests_per_s", n / wall, "req/s")
+    _report("serve_p50_ms", latencies[n // 2] * 1000, "ms")
+    _report("serve_p95_ms", latencies[int(n * 0.95)] * 1000, "ms")
+    serve.shutdown()
+
+
+def bench_data(rows: int = 200_000):
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    t0 = time.perf_counter()
+    ds = rd.range(rows).map_batches(
+        lambda b: {"x": np.asarray(b["id"]) * 2},
+        batch_size=8192)
+    total = 0
+    for batch in ds.iter_batches(batch_size=8192):
+        total += len(batch["x"])
+    wall = time.perf_counter() - t0
+    assert total == rows
+    _report("data_rows_per_s", rows / wall, "rows/s")
+
+
+def bench_llm(steps: int = 40):
+    import numpy as np
+
+    from ray_tpu.llm import PagedEngineConfig, PagedLLMEngine
+    from ray_tpu.models.llama import LlamaConfig
+
+    model = LlamaConfig(vocab_size=1024, hidden_size=256,
+                        intermediate_size=512, num_layers=4, num_heads=8,
+                        num_kv_heads=8, max_seq_len=512, remat=False,
+                        use_flash=False, attention_impl="reference")
+    engine = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=8, max_len=256, page_size=16,
+        num_pages=256, prefill_buckets=(32,)))
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 1024, size=16)) for _ in range(8)]
+    engine.generate(prompts, max_new_tokens=4)  # compile
+    t0 = time.perf_counter()
+    engine.generate(prompts, max_new_tokens=steps)
+    wall = time.perf_counter() - t0
+    _report("llm_decode_tokens_per_s", 8 * steps / wall, "tok/s",
+            note="tiny CPU model; engine-overhead measurement, "
+                 "HBM-bound decode is the TPU bench")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--which", default="all")
+    args = parser.parse_args()
+    import ray_tpu
+    ray_tpu.init(num_cpus=8, object_store_memory=1 << 30)
+    which = args.which
+    try:
+        if which in ("all", "ppo"):
+            bench_ppo()
+        if which in ("all", "impala"):
+            bench_impala()
+        if which in ("all", "data"):
+            bench_data()
+        if which in ("all", "llm"):
+            bench_llm()
+        if which in ("all", "serve"):
+            bench_serve()
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,8 @@ data-parallelism inside the jitted update."""
 
 from .algorithm import PPO, PPOConfig
 from .env_runner import SingleAgentEnvRunner
+from .impala import Impala, ImpalaConfig, ImpalaLearner
 from .learner import PPOLearner
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner"]
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
+           "Impala", "ImpalaConfig", "ImpalaLearner"]

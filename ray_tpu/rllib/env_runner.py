@@ -111,5 +111,8 @@ class SingleAgentEnvRunner:
             "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "bootstrap_value": np.asarray(last_value, np.float32),
+            # off-policy learners (IMPALA v-trace) bootstrap from the
+            # final obs under their CURRENT params, not our stale value
+            "last_obs": self._obs.copy(),
             "episode_returns": np.asarray(returns, np.float64),
         }

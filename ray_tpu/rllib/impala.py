@@ -1,0 +1,383 @@
+"""IMPALA: importance-weighted actor-learner architecture
+(reference: rllib/algorithms/impala/impala.py — config :66, async
+training_step :516, AggregatorActor batching :729, learner-group update
+:869; v-trace from the IMPALA paper, re-derived as a jitted lax.scan).
+
+Design (TPU-first):
+- Env-runner actors sample CONTINUOUSLY: the driver keeps a window of
+  in-flight sample() calls per runner and never blocks sampling on the
+  learner (the off-policy gap is what v-trace corrects).
+- Aggregator actors concatenate fragments into fixed-size train batches
+  off the driver (reference :729's stateless AggregatorActors) so
+  neither sampling nor learning waits on batch assembly.
+- The learner's whole update — forward, v-trace targets (reverse scan),
+  losses, Adam — is ONE jitted program in [T, B] layout; on a
+  multi-device mesh the batch axis shards and GSPMD inserts the
+  gradient allreduce.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Aggregation (reference: impala.py:729 AggregatorActor)
+# ---------------------------------------------------------------------------
+
+class AggregatorActor:
+    """Accumulates [T, N] fragments; emits [T, B] train batches."""
+
+    def __init__(self, batch_n: int):
+        self._batch_n = batch_n  # env-slots per emitted batch (B)
+        self._frags: List[Dict[str, np.ndarray]] = []
+        self._slots = 0
+
+    def add(self, fragment: Dict[str, np.ndarray]) -> Optional[
+            Dict[str, np.ndarray]]:
+        """Add one fragment; returns a train batch when full, else None."""
+        self._frags.append(fragment)
+        self._slots += fragment["obs"].shape[1]
+        if self._slots < self._batch_n:
+            return None
+        frags, self._frags, self._slots = self._frags, [], 0
+        batch = {
+            key: np.concatenate([f[key] for f in frags], axis=1)
+            for key in ("obs", "actions", "logp", "rewards", "dones")
+        }
+        batch["last_obs"] = np.concatenate(
+            [f["last_obs"] for f in frags], axis=0)
+        batch["episode_returns"] = np.concatenate(
+            [f["episode_returns"] for f in frags])
+        return batch
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+class ImpalaConfig:
+    """Builder-style config (reference: impala.py IMPALAConfig :66)."""
+
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 32
+        self.num_aggregators = 1
+        self.train_batch_slots = 32      # B of the [T, B] train batch
+        self.sample_window = 2           # in-flight sample() per runner
+        self.lr = 6e-4
+        self.gamma = 0.99
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rho_bar = 1.0
+        self.c_bar = 1.0
+        self.normalize_advantages = True
+        self.vtrace_lambda = 0.95
+        self.num_epochs = 1
+        self.grad_clip = 40.0
+        self.broadcast_interval = 1      # learner steps between syncs
+        self.model = {"hidden": (64, 64)}
+        self.seed = 0
+
+    def environment(self, env: str) -> "ImpalaConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "ImpalaConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "ImpalaConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "Impala":
+        return Impala(self)
+
+
+# ---------------------------------------------------------------------------
+# Learner (v-trace)
+# ---------------------------------------------------------------------------
+
+class ImpalaLearner:
+    """Jitted v-trace update in [T, B] layout."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 lr: float = 6e-4, gamma: float = 0.99,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 rho_bar: float = 1.0, c_bar: float = 1.0,
+                 grad_clip: float = 40.0, seed: int = 0,
+                 normalize_advantages: bool = True,
+                 vtrace_lambda: float = 0.95):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .models import ActorCriticMLP
+
+        model_config = model_config or {}
+        self.model = ActorCriticMLP(
+            num_actions=num_actions,
+            hidden=tuple(model_config.get("hidden", (64, 64))))
+        sample_obs = jnp.zeros((1,) + tuple(obs_shape), jnp.float32)
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), sample_obs)["params"]
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+
+        def vtrace(target_logp, behavior_logp, values, bootstrap,
+                   rewards, dones):
+            """v-trace targets (IMPALA paper eq. 1): reverse scan over T.
+            All inputs [T, B]; bootstrap [B]."""
+            rhos = jnp.exp(target_logp - behavior_logp)
+            clipped_rho = jnp.minimum(rho_bar, rhos)
+            # lambda discounts the trace cut (IMPALA paper appendix C /
+            # rllib vtrace lambda_): variance control for long horizons
+            clipped_c = vtrace_lambda * jnp.minimum(c_bar, rhos)
+            nonterminal = 1.0 - dones
+            next_values = jnp.concatenate(
+                [values[1:], bootstrap[None]], axis=0)
+            deltas = clipped_rho * (
+                rewards + gamma * nonterminal * next_values - values)
+
+            def step(carry, xs):
+                delta, c, nt, v, nv = xs
+                acc = delta + gamma * nt * c * carry
+                return acc, acc
+
+            _, vs_minus_v = jax.lax.scan(
+                step, jnp.zeros_like(bootstrap),
+                (deltas, clipped_c, nonterminal, values, next_values),
+                reverse=True)
+            vs = values + vs_minus_v
+            next_vs = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+            pg_adv = clipped_rho * (
+                rewards + gamma * nonterminal * next_vs - values)
+            return vs, pg_adv
+
+        def _update(params, opt_state, batch):
+            def loss_fn(p):
+                T, B = batch["actions"].shape
+                flat_obs = batch["obs"].reshape((T * B,) +
+                                                batch["obs"].shape[2:])
+                logits, values = self.model.apply({"params": p}, flat_obs)
+                logits = logits.reshape(T, B, -1)
+                values = values.reshape(T, B)
+                _lb, boot_values = self.model.apply(
+                    {"params": p}, batch["last_obs"])
+                logp_all = jax.nn.log_softmax(logits)
+                target_logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+                vs, pg_adv = vtrace(
+                    jax.lax.stop_gradient(target_logp), batch["logp"],
+                    jax.lax.stop_gradient(values),
+                    jax.lax.stop_gradient(boot_values),
+                    batch["rewards"], batch["dones"])
+                if normalize_advantages:
+                    # v-trace advantages are lambda=1 returns-minus-V:
+                    # on long-horizon dense-reward envs their scale (tens)
+                    # swamps the entropy/value terms — normalize per batch
+                    # (the paper's Atari setup instead clips rewards to
+                    # [-1,1], which serves the same purpose).
+                    pg_adv = (pg_adv - pg_adv.mean()) / (pg_adv.std()
+                                                         + 1e-8)
+                policy_loss = -jnp.mean(
+                    target_logp * jax.lax.stop_gradient(pg_adv))
+                vf_loss = 0.5 * jnp.mean(
+                    (values - jax.lax.stop_gradient(vs)) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+                total = policy_loss + vf_coeff * vf_loss \
+                    - entropy_coeff * entropy
+                return total, (policy_loss, vf_loss, entropy)
+
+            (total, (pl, vl, ent)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "total_loss": total, "policy_loss": pl, "vf_loss": vl,
+                "entropy": ent}
+        self._update = jax.jit(_update)
+
+    def update(self, batch: Dict[str, np.ndarray],
+               num_epochs: int = 1) -> Dict[str, float]:
+        """Up to `num_epochs` v-trace passes over one batch (reference:
+        impala.py:747 — num_epochs; the recorded behavior logp stays
+        fixed, so later passes are just more off-policy and the
+        importance clipping absorbs it)."""
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        metrics = {}
+        for _ in range(num_epochs):
+            self.params, self.opt_state, metrics = self._update(
+                self.params, self.opt_state, jb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params)
+
+
+# ---------------------------------------------------------------------------
+# Algorithm (reference: impala.py:516 async training_step)
+# ---------------------------------------------------------------------------
+
+class Impala:
+    def __init__(self, config: ImpalaConfig):
+        import gymnasium as gym
+
+        import ray_tpu
+
+        from .env_runner import SingleAgentEnvRunner
+
+        self.config = config
+        runner_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self._runners = [
+            runner_cls.options(num_cpus=0.5).remote(
+                config.env_name, config.num_envs_per_env_runner,
+                config.rollout_fragment_length, dict(config.model),
+                seed=config.seed + 1000 * (i + 1), gamma=config.gamma)
+            for i in range(config.num_env_runners)
+        ]
+        agg_cls = ray_tpu.remote(AggregatorActor)
+        self._aggregators = [
+            agg_cls.options(num_cpus=0.5).remote(config.train_batch_slots)
+            for _ in range(config.num_aggregators)
+        ]
+        obs_shape = ray_tpu.get(
+            self._runners[0].observation_shape.remote(), timeout=120)
+        probe = gym.make(config.env_name)
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        self._learner = ImpalaLearner(
+            obs_shape=obs_shape, num_actions=num_actions,
+            model_config=dict(config.model), lr=config.lr,
+            gamma=config.gamma, vf_coeff=config.vf_coeff,
+            entropy_coeff=config.entropy_coeff, rho_bar=config.rho_bar,
+            c_bar=config.c_bar, grad_clip=config.grad_clip,
+            seed=config.seed,
+            normalize_advantages=config.normalize_advantages,
+            vtrace_lambda=config.vtrace_lambda)
+        self._broadcast_weights()
+        # continuous sampling pipeline: sample ref -> owning runner
+        self._inflight: Dict[Any, Any] = {}
+        for runner in self._runners:
+            for _ in range(config.sample_window):
+                self._inflight[runner.sample.remote()] = runner
+        self._agg_rr = 0            # round-robin aggregator cursor
+        self._pending_batches: List = []  # refs of aggregator outputs
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+        self._env_steps = 0
+
+    def _broadcast_weights(self):
+        import ray_tpu
+        weights = self._learner.get_weights()
+        # fire-and-forget: samplers stay async (reference: async_training)
+        self._weight_refs = [r.set_weights.remote(weights)
+                             for r in self._runners]
+        ray_tpu.wait(self._weight_refs, num_returns=len(self._weight_refs),
+                     timeout=60)
+
+    def _pump_samples(self, timeout: float):
+        """Move completed fragments into aggregators; refill the sample
+        window; collect any completed train batches."""
+        import ray_tpu
+        ready, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1, timeout=timeout)
+        for ref in ready:
+            runner = self._inflight.pop(ref)
+            agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+            self._agg_rr += 1
+            self._pending_batches.append(agg.add.remote(ref))
+            self._inflight[runner.sample.remote()] = runner
+
+    def train(self) -> Dict[str, Any]:
+        """One learner iteration: wait for an aggregated batch while
+        sampling continues, then v-trace update + weight broadcast."""
+        import ray_tpu
+
+        config = self.config
+        t0 = time.perf_counter()
+        batch = None
+        dropped = 0
+        while batch is None:
+            self._pump_samples(timeout=10.0)
+            ready_batches = []
+            still_pending = []
+            for ref in self._pending_batches:
+                done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0.001)
+                if done:
+                    out = ray_tpu.get(ref)
+                    if out is not None:
+                        ready_batches.append(out)
+                else:
+                    still_pending.append(ref)
+            self._pending_batches = still_pending
+            if ready_batches:
+                # Train on the FRESHEST batch; older ready batches are
+                # dropped (reference: impala's learner-queue semantics —
+                # bounded staleness beats bonus throughput; stale
+                # multi-epoch updates are what collapse the policy).
+                batch = ready_batches[-1]
+                dropped = len(ready_batches) - 1
+                for extra in ready_batches[:-1]:
+                    self._recent_returns.extend(
+                        extra["episode_returns"].tolist())
+            if time.perf_counter() - t0 > 300:
+                raise TimeoutError("no train batch within 300s")
+        sample_time = time.perf_counter() - t0
+        self._dropped_batches = getattr(self, "_dropped_batches", 0) \
+            + dropped
+
+        self._recent_returns.extend(batch["episode_returns"].tolist())
+        t1 = time.perf_counter()
+        metrics = self._learner.update(batch,
+                                       num_epochs=config.num_epochs)
+        learn_time = time.perf_counter() - t1
+        self._iteration += 1
+        if self._iteration % config.broadcast_interval == 0:
+            self._broadcast_weights()
+
+        T, B = batch["actions"].shape
+        self._env_steps += T * B
+        self._recent_returns = self._recent_returns[-100:]
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled": self._env_steps,
+            "num_env_steps_trained_this_iter": T * B,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else float("nan"),
+            "sample_wait_s": sample_time,
+            "learn_time_s": learn_time,
+            "learner_samples_per_s": T * B / max(learn_time, 1e-9),
+            **metrics,
+        }
+
+    def stop(self):
+        import ray_tpu
+        for actor in self._runners + self._aggregators:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass

