@@ -126,6 +126,18 @@ def _attach_log_stream(worker):
     import sys
 
     async def _on_logs(message):
+        # Per-job routing: print only this driver's workers. Fail OPEN —
+        # messages with no job (worker boot output before its first
+        # lease) or the nil job (workers leased by system actors before
+        # they adopt a job) pass through so crash tracebacks and stack
+        # dumps always surface somewhere.
+        from .ids import JobID
+        job = message.get("job")
+        my_job = getattr(worker, "job_id", None)
+        if (job is not None and my_job is not None
+                and job != my_job.hex()
+                and job != JobID.from_int(0).hex()):
+            return
         stream = sys.stderr if message.get("stream") == "stderr" \
             else sys.stdout
         pid = message.get("pid")

@@ -48,6 +48,15 @@ _DEFAULTS: Dict[str, Any] = {
     # normal_task_submitter.h max_tasks_in_flight_per_worker). The worker
     # executes serially; >1 hides push/reply latency behind execution.
     "max_tasks_in_flight_per_lease": 8,
+    # --- device objects ---
+    # HBM bytes the process may hold pinned for device-resident objects
+    # (device_put_ref pins + DeviceChannel staging). 0 = unlimited.
+    # Past the budget, producers BLOCK briefly for frees and then spill
+    # to the host object store (reference: gpu_object_manager.py:61
+    # tracks the same producer/consumer imbalance).
+    "device_object_hbm_budget": 0,
+    # How long device_put_ref blocks for frees before spilling to host.
+    "device_object_backpressure_timeout_s": 10.0,
     # --- workers ---
     "worker_start_timeout_s": 60.0,
     "num_prestart_workers": 0,

@@ -31,6 +31,8 @@ import threading
 import time
 import traceback
 from dataclasses import dataclass, field
+
+import numpy as np
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from .config import CONFIG
@@ -244,27 +246,39 @@ class TaskEventBuffer:
     def record(self, spec: "TaskSpec", event: str, **extra):
         if not CONFIG.enable_task_events or not spec.enable_task_events:
             return
-        ev = {
-            "task_id": spec.task_id.hex(),
-            "attempt": spec.attempt_number,
-            "name": spec.name or spec.function.display_name(),
-            "job_id": spec.job_id.hex(),
-            "type": spec.task_type,
-            "actor_id": spec.actor_id.hex() if spec.actor_id else None,
-            "event": event,
-            "ts": time.time(),
-            "worker_id": self._cw.worker_id.hex()
-            if isinstance(self._cw.worker_id, bytes) else None,
-            "node_index": self._cw.node_index,
-        }
-        ev.update(extra)
+        # Hot path snapshots the MUTABLE spec fields (attempt/name flip
+        # on retries and cancellation tombstones) but defers the hex/dict
+        # rendering to the once-a-second flush (~20us/event saved on
+        # call floods).
+        item = (spec.task_id, spec.attempt_number,
+                spec.name or spec.function.display_name(), spec.job_id,
+                spec.task_type, spec.actor_id, event, time.time(), extra)
         with self._lock:
-            self._events.append(ev)
+            self._events.append(item)
             if len(self._events) > 10_000:  # drop oldest under pressure
                 del self._events[:5_000]
             if not self._flusher_started:
                 self._flusher_started = True
                 self._cw.loop_call(self._flush_loop())
+
+    def _render(self, item) -> Dict[str, Any]:
+        (task_id, attempt, name, job_id, task_type, actor_id, event,
+         ts, extra) = item
+        ev = {
+            "task_id": task_id.hex(),
+            "attempt": attempt,
+            "name": name,
+            "job_id": job_id.hex(),
+            "type": task_type,
+            "actor_id": actor_id.hex() if actor_id else None,
+            "event": event,
+            "ts": ts,
+            "worker_id": self._cw.worker_id.hex()
+            if isinstance(self._cw.worker_id, bytes) else None,
+            "node_index": self._cw.node_index,
+        }
+        ev.update(extra)
+        return ev
 
     async def _flush_loop(self):
         while True:
@@ -273,7 +287,9 @@ class TaskEventBuffer:
                 batch, self._events = self._events, []
             if batch:
                 try:
-                    await self._cw.gcs.call("add_task_events", events=batch)
+                    await self._cw.gcs.call(
+                        "add_task_events",
+                        events=[self._render(i) for i in batch])
                 except Exception:  # noqa: BLE001 — observability best-effort
                     pass
 
@@ -468,6 +484,11 @@ class Lease:
     # Set by _drop_lease: other pipelined tasks finishing on this lease
     # must not recycle it back into the idle pool.
     dead: bool = False
+    # The (possibly spread-salted) pool key this lease was acquired
+    # under. Return/drop MUST use it — returning under a different key
+    # would park one lease in two idle lists and break the
+    # one-list-per-lease invariant the cleaner relies on.
+    key: Optional[Tuple] = None
 
 
 class NormalTaskSubmitter:
@@ -505,7 +526,7 @@ class NormalTaskSubmitter:
         if lease is None:
             return  # cancelled while queued; returns already resolved
         if self._cw.task_manager._take_cancelled(spec.task_id):
-            self._return_lease(spec.shape_key(), lease)
+            self._return_lease(lease.key, lease)
             return
         worker = self._cw.clients.get(lease.worker_address)
         self._running[spec.task_id] = lease
@@ -522,7 +543,7 @@ class NormalTaskSubmitter:
             return
         finally:
             self._running.pop(spec.task_id, None)
-        self._return_lease(spec.shape_key(), lease)
+        self._return_lease(lease.key, lease)
         error = reply.get("error")
         if error is not None:
             self._cw.task_manager.on_failed(
@@ -575,6 +596,13 @@ class NormalTaskSubmitter:
         flight. Without the handoff, returned leases sit idle (resources
         still charged at the raylet) while queued requests starve."""
         key = spec.shape_key()
+        if spec.scheduling_strategy.kind == "SPREAD":
+            # SPREAD must not pipeline onto a cached lease — each task
+            # goes through its own lease request so the raylet's
+            # round-robin redirect actually lands tasks on distinct
+            # nodes (reference: spread policy is per lease request).
+            self._spread_salt = getattr(self, "_spread_salt", 0) + 1
+            key = key + ("spread", self._spread_salt)
         idle = self._idle.get(key)
         if idle:
             # Least-loaded lease first so bursts spread across workers
@@ -641,6 +669,7 @@ class NormalTaskSubmitter:
         """Hand the lease's free pipeline slots to waiters; park whatever
         capacity remains on the idle list (invariant: `_idle[key]` holds
         exactly the leases with spare capacity, no duplicates)."""
+        lease.key = key
         cap = CONFIG.max_tasks_in_flight_per_lease
         waiters = self._waiters.get(key)
         while waiters and lease.inflight < cap:
@@ -669,6 +698,11 @@ class NormalTaskSubmitter:
         strategy = spec.scheduling_strategy
         if strategy.kind == "placement_group":
             meta["pg"] = (strategy.placement_group_id, strategy.bundle_index)
+        elif strategy.kind == "SPREAD":
+            # the raylet round-robins SPREAD leases across the cluster
+            # view instead of granting locally (reference:
+            # scheduling/policy/spread_scheduling_policy)
+            meta["strategy"] = "SPREAD"
         raylet_addr = self._cw.raylet_address
         if strategy.kind == "node_affinity" and strategy.node_id:
             addr = await self._cw.node_address(strategy.node_id)
@@ -683,6 +717,9 @@ class NormalTaskSubmitter:
                 return None  # dropped at the raylet; caller re-issues
             if reply.get("spillback_to"):
                 raylet_addr = tuple(reply["spillback_to"][1])
+                # A SPREAD redirect already chose the node: the target
+                # must grant/queue locally, not re-spread (ping-pong).
+                meta.pop("strategy", None)
                 continue
             if reply.get("rejected"):
                 if reply.get("permanent"):
@@ -705,6 +742,22 @@ class NormalTaskSubmitter:
         lease.inflight -= 1
         if lease.dead:
             return
+        if key is not None and "spread" in key:
+            # One-shot SPREAD lease: never recycled driver-side (reuse
+            # would undo the round-robin placement) — the lease returns
+            # to its raylet (worker stays in the raylet's idle pool) and
+            # the salted per-task key's bookkeeping is reaped so a
+            # long-running driver's _waiters/_inflight_requests don't
+            # grow with task count.
+            if lease.inflight <= 0:
+                lease.dead = True
+                self._cw.fire_and_forget(lease.raylet_address,
+                                         "return_worker",
+                                         lease_id=lease.lease_id)
+                self._idle.pop(key, None)
+                self._waiters.pop(key, None)
+                self._inflight_requests.pop(key, None)
+            return
         self._deliver_lease(key, lease)
 
     def _drop_lease(self, lease: Lease):
@@ -714,11 +767,18 @@ class NormalTaskSubmitter:
         self._cw.fire_and_forget(lease.raylet_address, "return_worker",
                                  lease_id=lease.lease_id, dispose=True)
         # With pipelining a failed lease may still be advertised as having
-        # capacity — stop handing it out.
-        for leases in self._idle.values():
-            if lease in leases:
-                leases.remove(lease)
-                break
+        # capacity — stop handing it out. The lease lives in at most ONE
+        # idle list, the one for its acquisition key.
+        leases = self._idle.get(lease.key)
+        if leases and lease in leases:
+            leases.remove(lease)
+        if lease.key is not None and "spread" in lease.key:
+            # unique per-task key: reap the bookkeeping
+            if not self._idle.get(lease.key):
+                self._idle.pop(lease.key, None)
+            if not self._waiters.get(lease.key):
+                self._waiters.pop(lease.key, None)
+            self._inflight_requests.pop(lease.key, None)
 
     async def _idle_lease_cleaner(self):
         while True:
@@ -760,6 +820,17 @@ class ActorClientState:
     # a single push_actor_tasks message.
     sendq: List[TaskSpec] = field(default_factory=list)
     flush_scheduled: bool = False
+    # Guards seq/sendq/flush_scheduled across submitting threads and the
+    # io loop: steady-state submits run on the CALLER's thread (no per-call
+    # coroutine), so the enqueue + seq assignment must be atomic vs the
+    # loop-side flush swap (reference: actor_task_submitter.cc holds
+    # mu_ across the submit queue the same way).
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    # Submissions routed through the loop-side slow path that have not yet
+    # been assigned a sequence number. While nonzero the fast path must
+    # stand down, or a later call could take a lower seq than an earlier
+    # one still waiting in the loop queue (ordering violation).
+    slow_pending: int = 0
 
 
 class ActorTaskSubmitter:
@@ -784,8 +855,10 @@ class ActorTaskSubmitter:
     def state_for(self, actor_id: ActorID) -> ActorClientState:
         st = self._actors.get(actor_id)
         if st is None:
-            st = ActorClientState(actor_id=actor_id)
-            self._actors[actor_id] = st
+            # setdefault: submit() now calls this from arbitrary caller
+            # threads, racing the io loop — both must agree on one state
+            st = self._actors.setdefault(
+                actor_id, ActorClientState(actor_id=actor_id))
         return st
 
     async def ensure_subscribed(self):
@@ -794,7 +867,49 @@ class ActorTaskSubmitter:
             await self._cw.gcs.subscribe("ACTOR", self._on_actor_update)
 
     def submit(self, spec: TaskSpec):
-        self._cw.loop_post(self._submit(spec))
+        # Fast path: actor known-ALIVE -> enqueue from the caller's thread
+        # with no per-call coroutine; one posted flush drains the burst.
+        # Anything uncertain (first call, restarting, dead) takes the
+        # loop-side slow path which resolves state via the GCS.
+        st = self.state_for(spec.actor_id)
+        enqueued = need_flush = False
+        if (not os.environ.get("RTPU_NO_SUBMIT_FASTPATH")
+                and self._subscribed and st.state == "ALIVE"
+                and st.address is not None and not st.reconciling
+                and not st.queued):
+            with st.lock:
+                # Re-check under the lock: state transitions drain the
+                # queues holding this same lock, so an ALIVE observed here
+                # cannot flip mid-enqueue; slow_pending == 0 means no
+                # earlier call is still waiting for its seq on the loop.
+                if st.state == "ALIVE" and st.address is not None \
+                        and not st.queued and st.slow_pending == 0:
+                    if self._cw.task_manager.is_cancelled(spec.task_id):
+                        spec.method_name = "__rtpu_cancelled__"
+                    spec.sequence_number = st.seq
+                    st.seq += 1
+                    st.inflight[spec.sequence_number] = spec
+                    self._awaiting[spec.task_id] = (st, spec)
+                    self._push_time[spec.task_id] = time.monotonic()
+                    st.sendq.append(spec)
+                    enqueued = True
+                    need_flush = not st.flush_scheduled
+                    if need_flush:
+                        st.flush_scheduled = True
+        if enqueued:
+            if need_flush:
+                self._cw.loop_post(self._flush(st))
+            return
+        with st.lock:
+            st.slow_pending += 1
+        self._cw.loop_post(self._submit_slow(spec, st))
+
+    async def _submit_slow(self, spec: TaskSpec, st: ActorClientState):
+        try:
+            await self._submit(spec)
+        finally:
+            with st.lock:
+                st.slow_pending -= 1
 
     async def _submit(self, spec: TaskSpec):
         await self.ensure_subscribed()
@@ -815,11 +930,12 @@ class ActorTaskSubmitter:
                 st.death_cause = info.get("death_cause", "actor dead")
                 self._fail(spec, st.death_cause)
                 return
-        spec.sequence_number = st.seq
-        st.seq += 1
-        if st.state != "ALIVE":
-            st.queued.append(spec)
-            return
+        with st.lock:
+            spec.sequence_number = st.seq
+            st.seq += 1
+            if st.state != "ALIVE":
+                st.queued.append(spec)
+                return
         await self._push(st, spec)
 
     async def _push(self, st: ActorClientState, spec: TaskSpec):
@@ -828,12 +944,15 @@ class ActorTaskSubmitter:
             # the actor (its ordered queues advance per-seq), so push a
             # tombstone the executor completes without running user code.
             spec.method_name = "__rtpu_cancelled__"
-        st.inflight[spec.sequence_number] = spec
-        self._awaiting[spec.task_id] = (st, spec)
-        self._push_time[spec.task_id] = time.monotonic()
-        st.sendq.append(spec)
-        if not st.flush_scheduled:
-            st.flush_scheduled = True
+        with st.lock:
+            st.inflight[spec.sequence_number] = spec
+            self._awaiting[spec.task_id] = (st, spec)
+            self._push_time[spec.task_id] = time.monotonic()
+            st.sendq.append(spec)
+            need_flush = not st.flush_scheduled
+            if need_flush:
+                st.flush_scheduled = True
+        if need_flush:
             asyncio.get_running_loop().call_soon(
                 lambda: asyncio.ensure_future(self._flush(st)))
         if not self._sweeper_started:
@@ -841,29 +960,31 @@ class ActorTaskSubmitter:
             asyncio.ensure_future(self._straggler_sweep())
 
     async def _flush(self, st: ActorClientState):
-        st.flush_scheduled = False
-        if not st.sendq:
+        with st.lock:
+            st.flush_scheduled = False
+            specs, st.sendq = st.sendq, []
+        if not specs:
             return
         if st.state != "ALIVE" or st.address is None:
             # Address lost between enqueue and flush: park in queued; the
             # next ALIVE update re-pushes. Only specs still awaiting are
             # ours to park (an actor-state update may have reclaimed them).
-            for spec in st.sendq:
-                if self._awaiting.pop(spec.task_id, None) is not None:
-                    st.inflight.pop(spec.sequence_number, None)
-                    st.queued.append(spec)
-            st.sendq = []
+            with st.lock:
+                for spec in specs:
+                    if self._awaiting.pop(spec.task_id, None) is not None:
+                        st.inflight.pop(spec.sequence_number, None)
+                        st.queued.append(spec)
             return
-        specs, st.sendq = st.sendq, []
         worker = self._cw.clients.get(st.address)
         try:
             await worker.oneway("push_actor_tasks", specs=specs,
                                 done_to=self._cw.rpc_address)
         except Exception:
-            for spec in specs:
-                if self._awaiting.pop(spec.task_id, None) is not None:
-                    st.inflight.pop(spec.sequence_number, None)
-                    st.queued.append(spec)
+            with st.lock:
+                for spec in specs:
+                    if self._awaiting.pop(spec.task_id, None) is not None:
+                        st.inflight.pop(spec.sequence_number, None)
+                        st.queued.append(spec)
             # Either the actor is dying/restarting (the GCS will publish an
             # update that drains the queue) or this was a transient transport
             # failure with the actor still healthy — reconcile with the GCS
@@ -970,9 +1091,12 @@ class ActorTaskSubmitter:
         itself is already failed locally; the tombstone's done report
         finds no _awaiting entry and is ignored."""
         spec.method_name = "__rtpu_cancelled__"
-        st.sendq.append(spec)
-        if not st.flush_scheduled:
-            st.flush_scheduled = True
+        with st.lock:
+            st.sendq.append(spec)
+            need_flush = not st.flush_scheduled
+            if need_flush:
+                st.flush_scheduled = True
+        if need_flush:
             asyncio.get_running_loop().call_soon(
                 lambda: asyncio.ensure_future(self._flush(st)))
 
@@ -1017,37 +1141,42 @@ class ActorTaskSubmitter:
             return
         state = message["state"]
         if state == "ALIVE":
-            restarted = message.get("num_restarts", 0) != st.num_restarts
-            st.num_restarts = message.get("num_restarts", 0)
-            st.state = "ALIVE"
-            st.address = tuple(message["address"])
-            pending = sorted(st.queued + list(st.inflight.values()),
-                             key=lambda s: s.sequence_number)
-            st.queued = []
-            st.inflight = {}
-            st.sendq = []  # unsent specs are in inflight, hence in pending
-            for spec in pending:
-                self._awaiting.pop(spec.task_id, None)
-            if restarted:
-                # New actor instance: renumber surviving tasks from 0.
-                st.seq = 0
+            with st.lock:
+                restarted = \
+                    message.get("num_restarts", 0) != st.num_restarts
+                st.num_restarts = message.get("num_restarts", 0)
+                st.state = "ALIVE"
+                st.address = tuple(message["address"])
+                pending = sorted(st.queued + list(st.inflight.values()),
+                                 key=lambda s: s.sequence_number)
+                st.queued = []
+                st.inflight = {}
+                st.sendq = []  # unsent specs are in inflight -> pending
                 for spec in pending:
-                    spec.sequence_number = st.seq
-                    st.seq += 1
+                    self._awaiting.pop(spec.task_id, None)
+                if restarted:
+                    # New actor instance: renumber surviving tasks from 0.
+                    st.seq = 0
+                    for spec in pending:
+                        spec.sequence_number = st.seq
+                        st.seq += 1
             for spec in pending:
                 asyncio.ensure_future(self._push(st, spec))
         elif state == "RESTARTING":
-            st.state = "RESTARTING"
-            st.address = None
+            with st.lock:
+                st.state = "RESTARTING"
+                st.address = None
         elif state == "DEAD":
-            st.state = "DEAD"
-            st.death_cause = message.get("death_cause", "actor died")
-            pending = st.queued + list(st.inflight.values())
-            st.queued = []
-            st.inflight = {}
-            st.sendq = []
+            with st.lock:
+                st.state = "DEAD"
+                st.death_cause = message.get("death_cause", "actor died")
+                pending = st.queued + list(st.inflight.values())
+                st.queued = []
+                st.inflight = {}
+                st.sendq = []
+                for spec in pending:
+                    self._awaiting.pop(spec.task_id, None)
             for spec in pending:
-                self._awaiting.pop(spec.task_id, None)
                 self._fail(spec, st.death_cause)
 
 
@@ -1055,6 +1184,18 @@ class ActorTaskSubmitter:
 # Execution (reference: src/ray/core_worker/task_execution/ +
 # python/ray/_raylet.pyx task_execution_handler/execute_task)
 # ---------------------------------------------------------------------------
+
+def _is_small_result(result) -> bool:
+    """Cheap static check for results whose serialization is microseconds
+    — packaging those inline beats a thread-pool round trip."""
+    if result is None or isinstance(result, (bool, int, float)):
+        return True
+    if isinstance(result, (str, bytes)):
+        return len(result) < 32768
+    if isinstance(result, np.ndarray):
+        return result.nbytes < 32768
+    return False
+
 
 class _RuntimeContext(threading.local):
     def __init__(self):
@@ -1075,6 +1216,8 @@ class TaskExecutor:
         self._actor_pools: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
         self._actor_async_sem: Optional[asyncio.Semaphore] = None
         self._is_asyncio = False
+        # method-name -> iscoroutinefunction (inspect costs ~10us/call)
+        self._coro_cache: Dict[str, bool] = {}
         # Ordered execution is per *caller*: each submitting worker numbers
         # its own stream (reference: per-client actor scheduling queues).
         self._next_seq: Dict[bytes, int] = {}
@@ -1123,30 +1266,41 @@ class TaskExecutor:
             lambda: fut.set_result(result) if not fut.done() else None)
 
     async def _execute_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
-        # Enforce per-caller submission order by sequence number.
-        loop = asyncio.get_running_loop()
+        return await asyncio.shield(self.submit_actor_task(spec))
+
+    def submit_actor_task(self, spec: TaskSpec) -> "asyncio.Future":
+        """Ordered, dedup'd actor-task submission — plain function (no
+        wrapper coroutine/Task per call: the push-stream hot path attaches
+        a done-callback to the returned future instead). Must run on the
+        io loop. Enforces per-caller submission order by sequence number.
+        """
+        loop = asyncio.get_event_loop()
         caller = spec.owner_worker_id
         seq = spec.sequence_number
         if seq < self._next_seq.get(caller, 0):
-            # Duplicate push (caller lost our reply): serve the cached reply
-            # instead of re-executing (at-most-once execution per seq). A
-            # still-running original has no cached reply yet — piggyback on
-            # its future (shielded: this RPC's cancellation must not cancel
-            # the real execution).
+            # Duplicate push (caller lost our reply): serve the cached
+            # reply instead of re-executing (at-most-once per seq). A
+            # still-running original has no cached reply yet — hand back
+            # its future (callers never cancel these).
             cached = self._reply_cache.get(caller, {}).get(seq)
             if cached is not None:
-                return cached
+                fut = loop.create_future()
+                fut.set_result(cached)
+                return fut
             inflight = self._inflight.get(caller, {}).get(seq)
             if inflight is not None:
-                return await asyncio.shield(inflight)
-            return {"error": TaskError(
-                spec.method_name, "duplicate actor task with evicted reply")}
+                return inflight
+            fut = loop.create_future()
+            fut.set_result({"error": TaskError(
+                spec.method_name,
+                "duplicate actor task with evicted reply")})
+            return fut
         buffered = self._seq_buffer.get(caller, {}).get(seq)
         if buffered is not None:
             # Re-push of a still-buffered seq (caller reconnected before
             # the original dispatched): piggyback on the original future —
             # replacing it would orphan the first handler forever.
-            return await asyncio.shield(buffered[1])
+            return buffered[1]
         fut = loop.create_future()
         self._seq_buffer.setdefault(caller, {})[seq] = (spec, fut)
         self._inflight.setdefault(caller, {})[seq] = fut
@@ -1162,10 +1316,10 @@ class TaskExecutor:
             while len(cache) > 64:
                 cache.pop(next(iter(cache)))
         fut.add_done_callback(_finish)
-        await self._drain_ready(caller)
-        return await asyncio.shield(fut)
+        self._drain_ready(caller)
+        return fut
 
-    async def _drain_ready(self, caller: bytes):
+    def _drain_ready(self, caller: bytes):
         buffer = self._seq_buffer.get(caller, {})
         self._next_seq.setdefault(caller, 0)
         while self._next_seq[caller] in buffer:
@@ -1276,6 +1430,14 @@ class TaskExecutor:
         RUNTIME_CTX.actor_id = spec.actor_id
         self._running_sync.add(spec.task_id)
         self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
+        # Continue the caller's trace: user code in this task opening
+        # trace_span() nests under the submitting span (reference:
+        # tracing_helper extracts the injected context the same way).
+        # ALWAYS set — a stale context from the previous task on this
+        # thread must not leak into an untraced call.
+        from ..util.tracing import set_trace_context
+        set_trace_context(tuple(spec.trace_context)
+                          if spec.trace_context is not None else None)
         try:
             if spec.task_type == ACTOR_TASK \
                     and spec.method_name == "__rtpu_terminate__":
@@ -1326,19 +1488,35 @@ class TaskExecutor:
         EventLoopThread.get().loop.call_later(0.1, os._exit, 0)
         return self._package_returns(spec, None)
 
+    def _is_coroutine_method(self, name: str, method) -> bool:
+        cached = self._coro_cache.get(name)
+        if cached is None:
+            import inspect
+            cached = inspect.iscoroutinefunction(method)
+            self._coro_cache[name] = cached
+        return cached
+
     async def _run_task_async(self, spec: TaskSpec) -> Dict[str, Any]:
         try:
             if spec.method_name == "__rtpu_cancelled__":
                 return {"cancelled": True}
             if spec.method_name == "__rtpu_terminate__":
                 return self._graceful_exit(spec)
+            from ..util.tracing import set_trace_context
+            set_trace_context(tuple(spec.trace_context)
+                              if spec.trace_context is not None else None)
+            # Small ref-free args deserialize in microseconds — the
+            # executor hop costs more than it saves. Offload only when
+            # an arg must be fetched (blocking get) or the bundle is big.
             loop = asyncio.get_running_loop()
-            args, kwargs = await loop.run_in_executor(
-                None, self._load_args, spec)
+            if len(spec.args) == 1 and len(spec.args[0].data) < 65536:
+                args, kwargs = self._load_args(spec)
+            else:
+                args, kwargs = await loop.run_in_executor(
+                    None, self._load_args, spec)
             self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
             method = getattr(self._actor_instance, spec.method_name)
-            import inspect
-            if inspect.iscoroutinefunction(method):
+            if self._is_coroutine_method(spec.method_name, method):
                 RUNTIME_CTX.task_spec = spec
                 RUNTIME_CTX.actor_id = spec.actor_id
                 try:
@@ -1360,6 +1538,8 @@ class TaskExecutor:
                 result = await loop.run_in_executor(None, _call)
                 if asyncio.iscoroutine(result):
                     result = await result
+            if _is_small_result(result):
+                return self._package_returns(spec, result)
             return await loop.run_in_executor(
                 None, self._package_returns, spec, result)
         except Exception as e:  # noqa: BLE001
@@ -1367,6 +1547,9 @@ class TaskExecutor:
                                        traceback.format_exc(), cause=e)}
 
     def _setup_actor(self, spec: TaskSpec):
+        # adopt the creating job: background asyncio work this actor
+        # spawns (outside any task context) must submit/log under it
+        self._cw.job_id = spec.job_id
         self._is_asyncio = spec.is_asyncio
         if spec.is_asyncio:
             self._actor_async_sem = asyncio.Semaphore(
@@ -1445,7 +1628,10 @@ class CoreWorker:
     def current_job_id(self) -> JobID:
         """The job of the task being executed, else this process's job —
         nested submissions stay inside the driver's job without mutating
-        shared worker state."""
+        shared worker state. A worker adopts the first job it executes
+        for (reference: workers are pooled per job), so background
+        asyncio tasks inside actors (serve reconcile loops) submit under
+        the right job instead of the nil job."""
         spec = RUNTIME_CTX.task_spec
         return spec.job_id if spec is not None else self.job_id
 
@@ -1478,6 +1664,8 @@ class CoreWorker:
         by the next task instead of being cached."""
         done = self._job_envs.get(job_id)
         if done is not None:
+            if done.done():  # steady state: no await, no loop yield
+                return
             await done
             return
         fut = asyncio.get_running_loop().create_future()
@@ -1793,22 +1981,40 @@ class CoreWorker:
         executes under the actor's sequence ordering; completions flow
         back on the batched `actor_tasks_done` stream to `done_to`."""
         done_to = tuple(done_to)
+        seen_jobs = set()
         for spec in specs:
-            asyncio.ensure_future(self._exec_and_report(spec, done_to))
+            if spec.job_id not in seen_jobs:
+                seen_jobs.add(spec.job_id)
+                # once per job per batch (was per task inside execute())
+                await self.ensure_job_env(spec.job_id)
+            try:
+                fut = self.executor.submit_actor_task(spec)
+            except BaseException as e:  # noqa: BLE001 — must report
+                self._report_actor_done(
+                    spec, done_to,
+                    {"system_error": f"executor failed: {e!r}"})
+                continue
+            fut.add_done_callback(
+                lambda f, spec=spec: self._on_actor_task_future(
+                    spec, done_to, f))
 
-    async def _exec_and_report(self, spec: TaskSpec, done_to: Address):
-        try:
-            reply = await self.executor.execute(spec)
-        except asyncio.CancelledError:
+    def _on_actor_task_future(self, spec: TaskSpec, done_to: Address, fut):
+        if fut.cancelled():
             return  # shutdown/kill: owner recovers via pubsub or sweep
-        except BaseException as e:  # noqa: BLE001 — must report something
+        e = fut.exception()
+        if e is not None:
             # Infrastructure failure (env setup, dispatch) — NOT an
             # application error: the owner requeues instead of failing.
             reply = {"system_error": f"executor failed: {e!r}"}
+        else:
+            reply = fut.result()
+        self._report_actor_done(spec, done_to, reply)
+
+    def _report_actor_done(self, spec: TaskSpec, done_to: Address, reply):
         q = self._done_batches.setdefault(done_to, [])
         q.append((spec.task_id.hex(), reply))
         if len(q) == 1:
-            asyncio.get_running_loop().call_soon(
+            asyncio.get_event_loop().call_soon(
                 lambda: asyncio.ensure_future(self._flush_done(done_to)))
 
     async def _flush_done(self, done_to: Address):

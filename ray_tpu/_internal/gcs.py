@@ -673,7 +673,11 @@ class GcsServer:
                         "is_actor": True,
                         "job": spec.job_id.hex(),
                     },
-                    timeout=CONFIG.worker_start_timeout_s)
+                    # Generous: the raylet's bounded spawn pipeline may
+                    # queue this grant behind hundreds of other spawns in
+                    # an actor storm; a dead raylet still fails fast via
+                    # the transport, and rejections are immediate.
+                    timeout=max(600.0, CONFIG.worker_start_timeout_s))
             except Exception as e:
                 logger.warning("actor lease request to %s failed: %s",
                                node_id[:12], e)

@@ -116,6 +116,7 @@ class Raylet:
         self.spill_storage = storage_from_config()
         self.node_addresses: Dict[str, Address] = {}
         self._next_lease_id = 0
+        self._spawn_sem: Optional[asyncio.Semaphore] = None
         self._tasks: List[asyncio.Task] = []
         self._pulls: Dict[str, asyncio.Future] = {}
         self._stopped = False
@@ -241,9 +242,14 @@ class Raylet:
             "RTPU_GCS_ADDR": f"{self.gcs_address[0]}:{self.gcs_address[1]}",
         })
         # Workers must not inherit the driver's TPU chip lock unless the
-        # lease assigns chips (set later via runtime env / accelerator hook).
-        env.setdefault("JAX_PLATFORMS", env.get("RTPU_WORKER_JAX_PLATFORMS",
-                                                "cpu"))
+        # lease assigns chips (runtime-env env_vars / accelerator hook).
+        # FORCE cpu — setdefault is not enough: on TPU hosts the ambient
+        # environment itself carries JAX_PLATFORMS=tpu/axon, and a worker
+        # inheriting it would grab the host chip AND run TPU kernels on
+        # shapes meant for the CPU fallback.
+        if not any(k == "JAX_PLATFORMS" for k, _ in env_key[0]):
+            env["JAX_PLATFORMS"] = env.get("RTPU_WORKER_JAX_PLATFORMS",
+                                           "cpu")
         platforms = env["JAX_PLATFORMS"] or \
             env.get("RTPU_WORKER_JAX_PLATFORMS", "")
         if platforms and "tpu" not in platforms and "axon" not in platforms:
@@ -270,11 +276,19 @@ class Raylet:
                 if len(env_key) > ENV_KEY_PYTHON_ENV else ()
             if pyenv_reqs:
                 # isolated venv interpreter (reference: conda/uv plugins)
+                from .errors import RuntimeEnvSetupError
                 from .runtime_env import ensure_python_env
-                interpreter = ensure_python_env(
-                    list(pyenv_reqs),
-                    os.path.join("/tmp", "rtpu",
-                                 f"session_{self.session_name}", "pyenvs"))
+                try:
+                    interpreter = ensure_python_env(
+                        list(pyenv_reqs),
+                        os.path.join("/tmp", "rtpu",
+                                     f"session_{self.session_name}",
+                                     "pyenvs"))
+                except Exception as e:
+                    # Deterministic: the same requirements will fail the
+                    # same way on every node — callers must not retry.
+                    raise RuntimeEnvSetupError(
+                        f"python_env setup failed: {e}") from e
             if CONFIG.log_to_driver:
                 out_target = err_target = subprocess.PIPE
             else:
@@ -292,13 +306,21 @@ class Raylet:
                 logger.warning("worker spawn failed: %s", e)
                 self.workers.pop(worker_id, None)
                 if not handle.registered.done():
-                    handle.registered.set_exception(
-                        RuntimeError(f"worker spawn failed: {e}"))
+                    # Preserve the exception type: RuntimeEnvSetupError is
+                    # deterministic (permanent rejection); a Popen/OS error
+                    # (ENOMEM/EAGAIN under spawn bursts) is transient and
+                    # must stay retryable.
+                    from .errors import RuntimeEnvSetupError
+                    if isinstance(e, RuntimeEnvSetupError):
+                        handle.registered.set_exception(e)
+                    else:
+                        handle.registered.set_exception(
+                            RuntimeError(f"worker spawn failed: {e}"))
                 return
             handle.proc = proc
             handle.pid = proc.pid
             if CONFIG.log_to_driver:
-                self._start_log_forwarders(proc)
+                self._start_log_forwarders(proc, handle)
             if handle.state == "DEAD":
                 # killed while the fork was in flight — don't leak it
                 try:
@@ -309,7 +331,8 @@ class Raylet:
         spawn_fut.add_done_callback(_attach)
         return handle
 
-    def _start_log_forwarders(self, proc: subprocess.Popen):
+    def _start_log_forwarders(self, proc: subprocess.Popen,
+                              handle: "WorkerHandle" = None):
         """Tail the worker's stdout/stderr pipes and publish line batches
         to the WORKER_LOGS pubsub channel (reference:
         _private/log_monitor.py -> driver prints them)."""
@@ -329,10 +352,14 @@ class Raylet:
                     return
                 lines, batch = batch, []
                 last_flush = time.monotonic()
+                # job read at flush time: the lease that binds this worker
+                # to a job lands after spawn; drivers filter on it so one
+                # job's output doesn't print on every driver
+                job = handle.job_hex if handle is not None else None
                 EventLoopThread.get().post(gcs.call(
                     "publish", channel="WORKER_LOGS",
                     message={"pid": proc.pid, "node_id": self.node_id,
-                             "stream": name, "lines": lines},
+                             "stream": name, "job": job, "lines": lines},
                     timeout=10))
             import select
             try:
@@ -510,6 +537,18 @@ class Raylet:
             spec_meta=spec_meta,
             future=asyncio.get_running_loop().create_future(),
             pg=spec_meta.get("pg"))
+        if spec_meta.get("strategy") == "SPREAD":
+            # Round-robin across schedulable nodes BEFORE considering a
+            # local grant (reference: spread_scheduling_policy — default
+            # hybrid prefers local, SPREAD must not).
+            self._spread_clock = getattr(self, "_spread_clock", 0) + 1
+            target = scheduling_policy.pick_spread(
+                self.cluster_view, req.demand, self._spread_clock,
+                spec_meta.get("label_selector") or None)
+            if target is not None and target != self.node_id:
+                addr = self.node_addresses.get(target)
+                if addr is not None:
+                    return {"spillback_to": (target, addr)}
         grant = self._try_grant(req)
         if grant is not None:
             return await grant
@@ -578,21 +617,45 @@ class Raylet:
             (w for w in self.workers.values()
              if w.state == "IDLE" and w.env_key == env_key), None)
         if handle is None:
-            handle = self._spawn_worker(env_key)
-            try:
-                await asyncio.wait_for(handle.registered,
-                                       CONFIG.worker_start_timeout_s)
-            except asyncio.TimeoutError:
-                self._kill_worker(handle)
-                self._refund(req.demand, None if charge_node else req.pg)
-                return {"rejected": True,
-                        "error": "worker failed to start in time"}
-            except Exception as e:  # spawn failure (bad runtime env...)
-                self._kill_worker(handle)
-                self._refund(req.demand, None if charge_node else req.pg)
-                # Deterministic failures must not retry forever.
-                return {"rejected": True, "permanent": True,
-                        "error": str(e)}
+            # Bounded spawn pipeline (reference: worker_pool.cc
+            # maximum_startup_concurrency): a 1,000-actor burst must not
+            # fork 1,000 interpreters at once on one box — spawns run
+            # `maximum_startup_concurrency` at a time and the start
+            # timeout covers only the spawn itself, not the queue wait.
+            if self._spawn_sem is None:
+                self._spawn_sem = asyncio.Semaphore(
+                    max(1, CONFIG.maximum_startup_concurrency))
+            async with self._spawn_sem:
+                # a worker may have gone idle while we queued
+                handle = next(
+                    (w for w in self.workers.values()
+                     if w.state == "IDLE" and w.env_key == env_key), None)
+                if handle is None:
+                    handle = self._spawn_worker(env_key)
+                    try:
+                        await asyncio.wait_for(
+                            handle.registered,
+                            CONFIG.worker_start_timeout_s)
+                    except asyncio.TimeoutError:
+                        self._kill_worker(handle)
+                        self._refund(req.demand,
+                                     None if charge_node else req.pg)
+                        return {"rejected": True,
+                                "error": "worker failed to start in time"}
+                    except Exception as e:
+                        self._kill_worker(handle)
+                        self._refund(req.demand,
+                                     None if charge_node else req.pg)
+                        # Only deterministic runtime-env failures are
+                        # permanent; transient OS errors (fork ENOMEM/
+                        # EAGAIN during spawn bursts) stay retryable like
+                        # the start-timeout path.
+                        from .errors import RuntimeEnvSetupError
+                        permanent = isinstance(e, RuntimeEnvSetupError)
+                        reply = {"rejected": True, "error": str(e)}
+                        if permanent:
+                            reply["permanent"] = True
+                        return reply
         handle.state = "LEASED"
         handle.lease_id = req.lease_id
         handle.is_actor_worker = bool(req.spec_meta.get("is_actor"))

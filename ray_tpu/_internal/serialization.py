@@ -91,16 +91,34 @@ class SerializedObject:
 
 
 def serialize(value: Any) -> SerializedObject:
+    # Plain pickle first (same split as dumps() below): ~4x cheaper than
+    # cloudpickle for the common arg shapes (numbers/strings/arrays/
+    # framework dataclasses). _StrictPickler refuses anything that would
+    # pickle by-reference into `__main__`, so the fallback is safe.
     buffers: List[pickle.PickleBuffer] = []
+    data = None
     with _ContextScope() as ctx:
-        data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        try:
+            bio = io.BytesIO()
+            _StrictPickler(bio, protocol=5,
+                           buffer_callback=buffers.append).dump(value)
+            data = bio.getvalue()
+        except Exception:  # noqa: BLE001 — cloudpickle fallback
+            data = None
+        refs = ctx.contained_refs
+    if data is None:
+        buffers = []
+        with _ContextScope() as ctx:
+            data = cloudpickle.dumps(value, protocol=5,
+                                     buffer_callback=buffers.append)
+            refs = ctx.contained_refs
     views = []
     for pb in buffers:
         try:
             views.append(pb.raw())
         except BufferError:
             views.append(memoryview(bytes(pb)))  # non-contiguous: copy once
-    return SerializedObject(data, views, ctx.contained_refs)
+    return SerializedObject(data, views, refs)
 
 
 def deserialize_from_buffer(buf: memoryview) -> Any:

@@ -95,6 +95,11 @@ class TaskSpec:
     is_detached: bool = False
     generator_backpressure: int = -1
     enable_task_events: bool = True
+    # (trace_id, parent_span_id) from the submitting context — the
+    # executing worker opens a child span under it (reference:
+    # util/tracing/tracing_helper.py:54-88 injects otel context the
+    # same way)
+    trace_context: Optional[Tuple[str, str]] = None
 
     def is_generator(self) -> bool:
         return self.num_returns in ("dynamic", "streaming")

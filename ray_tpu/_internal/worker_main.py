@@ -20,6 +20,15 @@ def main():
     logging.basicConfig(
         level=logging.INFO,
         format="[worker %(process)d] %(levelname)s %(name)s: %(message)s")
+    # `kill -USR2 <pid>` dumps every thread's stack to stderr (reference:
+    # the dashboard's on-demand py-spy; this is the dependency-free
+    # always-on variant for debugging wedged workers).
+    import faulthandler
+    import signal
+    try:
+        faulthandler.register(signal.SIGUSR2, all_threads=True)
+    except (AttributeError, ValueError):
+        pass
     if os.environ.get("RTPU_WORKER_PROFILE"):
         # Dev/profiling hook: dump the io-loop thread's cProfile stats on
         # SIGUSR1 to RTPU_WORKER_PROFILE/<pid>.prof.

@@ -20,7 +20,7 @@ from ._internal.options import (normalize_strategy, resources_from_options,
 from ._internal.runtime_env import upload_packages
 from ._internal.task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK,
                                   FunctionDescriptor, TaskSpec)
-from .remote_function import pack_args
+from .remote_function import _trace_ctx, pack_args
 
 
 def method(**options):
@@ -67,6 +67,8 @@ class ActorHandle:
         self._class_name = class_name
         self._method_options = method_options
         self._max_task_retries = max_task_retries
+        # constant across calls — built once, not per _submit_method
+        self._descriptor = FunctionDescriptor("", class_name, "")
 
     @property
     def actor_id(self) -> ActorID:
@@ -93,7 +95,7 @@ class ActorHandle:
             task_id=TaskID.of(job_id),
             job_id=job_id,
             task_type=ACTOR_TASK,
-            function=FunctionDescriptor("", self._class_name, ""),
+            function=self._descriptor,
             args=pack_args(args, kwargs),
             num_returns=num_returns,
             resources={},
@@ -104,6 +106,7 @@ class ActorHandle:
             method_name=method_name,
             max_retries=options.get("max_task_retries",
                                     self._max_task_retries),
+            trace_context=_trace_ctx(),
         )
         refs = worker.submit_task(spec)
         if num_returns == "streaming":

@@ -164,6 +164,14 @@ class Autoscaler:
                 continue
             instance_id = node_to_instance.get(node_id)
             if instance_id is None:
+                # Cloud providers can't see raylet ids at launch time;
+                # their nodes join carrying an rtpu-instance-id label
+                # (gke_provider startup script) — match on that.
+                labeled = (info.get("labels") or {}).get(
+                    "rtpu-instance-id")
+                if labeled in instances:
+                    instance_id = labeled
+            if instance_id is None:
                 continue  # not ours (e.g. the head node)
             node_type = instances[instance_id]["node_type"]
             nt = next((t for t in self.config.node_types

@@ -110,6 +110,21 @@ class ClientContext:
         self._registered: set = set()
         self._pending_release: List[str] = []
         self._release_lock = threading.Lock()
+        # Keepalive: the server reaps sessions idle > its TTL (120 s), and
+        # an interactive driver routinely sits idle longer than that — ping
+        # in the background so its refs/actors survive (reference: the Ray
+        # client maintains a heartbeat for exactly this reason).
+        self._ping_stop = threading.Event()
+        self._ping_thread = threading.Thread(
+            target=self._keepalive, daemon=True, name="rtpu-client-ping")
+        self._ping_thread.start()
+
+    def _keepalive(self):
+        while not self._ping_stop.wait(30.0):
+            try:
+                self._rpc("ping", session_id=self._session_id)
+            except Exception:
+                pass
 
     # -- plumbing --------------------------------------------------------
 
@@ -199,6 +214,7 @@ class ClientContext:
         self._call("kill_actor", actor=actor._stub)
 
     def disconnect(self):
+        self._ping_stop.set()
         try:
             self._flush_releases()
             self._rpc("disconnect", session_id=self._session_id)

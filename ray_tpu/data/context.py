@@ -13,6 +13,11 @@ class DataContext:
     target_min_block_size: int = 1 * 1024 * 1024
     max_tasks_in_flight: int = 16
     read_parallelism: int = 8
+    # Pipeline-wide CPU budget for the streaming executor's resource
+    # manager (None = the cluster's CPU total). Map operators share it
+    # fairly instead of each claiming a fixed in-flight window
+    # (reference: execution/resource_manager.py).
+    execution_cpu_budget: Optional[int] = None
     shuffle_strategy: str = "push"
     # Streaming executor buffers (in blocks): per-operator edge buffer and
     # the consumer-facing output queue — both bound memory and carry the

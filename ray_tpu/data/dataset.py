@@ -24,28 +24,47 @@ import numpy as np
 
 from .block import Block, BlockAccessor
 from .context import DataContext
+from .logical import ALL_TO_ALL, MAP, LogicalOp, Optimizer
 
-# A stage is ("map", block_fn) — fusable — or ("allToAll", plan_fn).
+# Legacy stage shape ("map", block_fn[, opts]) / ("allToAll", plan_fn[,
+# name]) still accepted by _with_stage; internally stages are LogicalOp
+# nodes (see logical.py) so the optimizer rules can reason about them.
 Stage = Tuple[str, Callable]
+
+
+def _coerce_stage(stage) -> LogicalOp:
+    if isinstance(stage, LogicalOp):
+        return stage
+    kind = stage[0]
+    if kind == MAP:
+        opts = stage[2] if len(stage) > 2 else {}
+        return LogicalOp(MAP, stage[1], name="map", opts=opts or {})
+    name = stage[2] if len(stage) > 2 else "exchange"
+    return LogicalOp(ALL_TO_ALL, stage[1], name=name,
+                     meta={"exchange": name})
 
 
 class Dataset:
     def __init__(self, source_fn: Callable[[], List],
-                 stages: Optional[List[Stage]] = None,
-                 name: str = "dataset"):
-        # source_fn: () -> list of ObjectRef[Block]
+                 stages: Optional[List] = None,
+                 name: str = "dataset", source=None):
+        # source_fn: () -> list of ObjectRef[Block]; `source` optionally
+        # carries a rule-rewritable datasource descriptor (read_api)
         self._source_fn = source_fn
-        self._stages = stages or []
+        self._stages: List[LogicalOp] = \
+            [_coerce_stage(s) for s in (stages or [])]
         self._name = name
+        self._source = source
         self._materialized: Optional[List] = None
 
     # ------------------------------------------------------------------
     # transformations (lazy)
     # ------------------------------------------------------------------
 
-    def _with_stage(self, stage: Stage, name: str) -> "Dataset":
-        ds = Dataset(self._source_fn, self._stages + [stage],
-                     name=f"{self._name}->{name}")
+    def _with_stage(self, stage, name: str) -> "Dataset":
+        ds = Dataset(self._source_fn,
+                     self._stages + [_coerce_stage(stage)],
+                     name=f"{self._name}->{name}", source=self._source)
         ds._materialized = self._materialized
         return ds
 
@@ -56,8 +75,25 @@ class Dataset:
                     concurrency: Optional[int] = None) -> "Dataset":
         """compute="actors" runs this stage on a pool of `concurrency`
         actors (reference: ActorPoolMapOperator) instead of per-block
-        tasks — for fns with expensive setup (models, tokenizers)."""
+        tasks — for fns with expensive setup (models, tokenizers).
+        `fn` may be a CLASS (reference: stateful map_batches UDFs):
+        instantiated once per pool worker, then called per batch —
+        requires compute="actors"."""
         fn_kwargs = fn_kwargs or {}
+        if isinstance(fn, type):
+            if compute != "actors":
+                raise ValueError(
+                    "map_batches with a class UDF requires "
+                    "compute='actors' (one instance per pool worker)")
+            holder: Dict[str, Any] = {}
+            cls = fn
+
+            def fn(batch, _holder=holder, **kw):  # noqa: F811
+                inst = _holder.get("inst")
+                if inst is None:
+                    inst = cls()
+                    _holder["inst"] = inst
+                return inst(batch, **kw)
 
         def stage(block: Block) -> Block:
             acc = BlockAccessor(block)
@@ -75,42 +111,62 @@ class Dataset:
 
         opts = {"compute": compute, "concurrency": concurrency} \
             if compute or concurrency else {}
-        return self._with_stage(("map", stage, opts), "map_batches")
+        return self._with_stage(
+            LogicalOp(MAP, stage, name="map_batches", opts=opts),
+            "map_batches")
 
     def map(self, fn: Callable) -> "Dataset":
         def stage(block: Block) -> Block:
             rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
             return _rows_to_block(rows)
-        return self._with_stage(("map", stage), "map")
+        return self._with_stage(
+            LogicalOp(MAP, stage, name="map", preserves_rows=True), "map")
 
     def flat_map(self, fn: Callable) -> "Dataset":
         def stage(block: Block) -> Block:
             rows = [o for r in BlockAccessor(block).iter_rows()
                     for o in fn(r)]
             return _rows_to_block(rows)
-        return self._with_stage(("map", stage), "flat_map")
+        return self._with_stage(
+            LogicalOp(MAP, stage, name="flat_map"), "flat_map")
 
     def filter(self, fn: Callable) -> "Dataset":
         def stage(block: Block) -> Block:
             rows = [r for r in BlockAccessor(block).iter_rows() if fn(r)]
             return _rows_to_block(rows)
-        return self._with_stage(("map", stage), "filter")
+        return self._with_stage(
+            LogicalOp(MAP, stage, name="filter"), "filter")
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         def add(batch):
             batch[name] = fn(batch)
             return batch
-        return self.map_batches(add)
+
+        def stage(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            return BlockAccessor.batch_to_block(add(acc.to_batch("numpy")))
+        return self._with_stage(
+            LogicalOp(MAP, stage, name=f"add_column[{name}]",
+                      preserves_rows=True), "add_column")
 
     def drop_columns(self, cols: List[str]) -> "Dataset":
-        def drop(batch):
-            return {k: v for k, v in batch.items() if k not in cols}
-        return self.map_batches(drop)
+        def stage(block: Block) -> Block:
+            batch = BlockAccessor(block).to_batch("numpy")
+            return BlockAccessor.batch_to_block(
+                {k: v for k, v in batch.items() if k not in cols})
+        return self._with_stage(
+            LogicalOp(MAP, stage, name=f"drop_columns{cols}",
+                      preserves_rows=True), "drop_columns")
 
     def select_columns(self, cols: List[str]) -> "Dataset":
-        def select(batch):
-            return {k: batch[k] for k in cols}
-        return self.map_batches(select)
+        def stage(block: Block) -> Block:
+            batch = BlockAccessor(block).to_batch("numpy")
+            return BlockAccessor.batch_to_block(
+                {k: batch[k] for k in cols})
+        return self._with_stage(
+            LogicalOp(MAP, stage, name=f"select_columns{cols}",
+                      preserves_rows=True, meta={"columns": list(cols)}),
+            "select_columns")
 
     def limit(self, n: int) -> "Dataset":
         def plan_fn(block_refs: List) -> List:
@@ -129,7 +185,9 @@ class Dataset:
                     out.append(ray_tpu.put(sliced))
                     taken = n
             return out
-        return self._with_stage(("allToAll", plan_fn), f"limit[{n}]")
+        return self._with_stage(
+            LogicalOp(ALL_TO_ALL, plan_fn, name=f"limit[{n}]",
+                      meta={"limit": n}), f"limit[{n}]")
 
     def repartition(self, num_blocks: int) -> "Dataset":
         from .exchange import repartition_exchange
@@ -187,17 +245,49 @@ class Dataset:
         from .grouped import GroupedData
         return GroupedData(self, key)
 
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: Optional[int] = None,
+             right_suffix: str = "_right") -> "Dataset":
+        """Distributed hash join (reference: Dataset.join backed by
+        execution/operators/hash_shuffle.py:392). `how` is one of
+        inner/left/right/outer; overlapping non-key columns from `other`
+        get `right_suffix`."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        from .exchange import hash_join_exchange
+        left, right = self, other
+
+        def source():
+            return hash_join_exchange(
+                left._execute(), right._execute(), on, how=how,
+                num_partitions=num_partitions,
+                right_suffix=right_suffix)
+        return Dataset(source, [], name=f"join[{how}]")
+
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
 
+    def _optimized(self):
+        """Run the rule-based optimizer over the logical plan."""
+        return Optimizer().optimize(list(self._stages), self._source)
+
+    def explain(self) -> List[str]:
+        """Names of the physical stages after optimization (tests assert
+        rule effects — fusion by stage count, pushdowns by order)."""
+        ops, source = self._optimized()
+        out = [f"source[{getattr(source, 'describe', lambda: 'fn')()}]"
+               if source is not None else "source[fn]"]
+        out.extend(f"{op.kind}:{op.name}" for op in ops)
+        return out
+
     def _make_executor(self):
-        """Lower stages into a streaming-operator topology."""
-        from .context import DataContext
+        """Lower the optimized logical plan into a streaming topology."""
         from .streaming import StreamingExecutor, build_ops
-        ctx = DataContext.get_current()
-        ops = build_ops(list(self._stages), ctx.max_tasks_in_flight)
-        return StreamingExecutor(self._source_fn, ops, name=self._name)
+        logical_ops, source = self._optimized()
+        source_fn = source.fn if source is not None else self._source_fn
+        ops = build_ops(logical_ops)
+        return StreamingExecutor(source_fn, ops, name=self._name)
 
     def iter_block_refs(self) -> Iterator:
         """Stream block refs as the plan produces them (backpressured);

@@ -11,7 +11,7 @@ routes refs — block payloads never pass through it.
 from __future__ import annotations
 
 import random as _random
-from typing import Callable, List, Optional, Union
+from typing import Callable, List, Optional, Tuple, Union
 
 from .block import BlockAccessor
 
@@ -173,6 +173,163 @@ def groupby_exchange(refs: List, key: str, agg_fn: Callable,
     if n_out == 1:
         return [group_agg.remote(*parts)]
     return [group_agg.remote(*[parts[i][j] for i in range(len(refs))])
+            for j in range(n_out)]
+
+
+def hash_join_exchange(left_refs: List, right_refs: List, on: str,
+                       how: str = "inner",
+                       num_partitions: Optional[int] = None,
+                       right_suffix: str = "_right") -> List:
+    """Distributed hash join (reference:
+    data/_internal/execution/operators/hash_shuffle.py:392,1034 — the
+    partition-actor hash join/aggregate family; here the same two-phase
+    plan as the other exchanges: hash-partition both sides by key, then
+    one build+probe task per partition). Supports inner/left/right/outer.
+    """
+    import ray_tpu
+    if num_partitions is None:
+        num_partitions = max(1, min(max(len(left_refs), len(right_refs)),
+                                    8))
+    n_out = num_partitions
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def hash_partition(block):
+        acc = BlockAccessor(block)
+        buckets: List[List] = [[] for _ in range(n_out)]
+        for row in acc.iter_rows():
+            buckets[_stable_hash(row[on]) % n_out].append(row)
+        parts = tuple(BlockAccessor.from_rows(b) for b in buckets)
+        return parts if n_out > 1 else parts[0]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def join_partition(n_left, *blocks):
+        left_rows = [r for b in blocks[:n_left]
+                     for r in BlockAccessor(b).iter_rows()]
+        right_rows = [r for b in blocks[n_left:]
+                      for r in BlockAccessor(b).iter_rows()]
+        # Column sets up front: unmatched rows must carry the OTHER
+        # side's columns explicitly as None — block construction takes
+        # the first row's keys, and ragged rows would silently drop the
+        # missing columns (pandas-merge NaN semantics).
+        left_cols = list(dict.fromkeys(
+            k for r in left_rows for k in r))
+        right_cols_raw = list(dict.fromkeys(
+            k for r in right_rows for k in r if k != on))
+        right_out = {k: (k if k not in left_cols
+                         else f"{k}{right_suffix}")
+                     for k in right_cols_raw}
+        # build on the smaller side, probe with the larger
+        build: dict = {}
+        for row in right_rows:
+            build.setdefault(row[on], []).append(row)
+        out = []
+        matched_right = set()
+        for row in left_rows:
+            hits = build.get(row[on])
+            if hits:
+                matched_right.add(row[on])
+                for other in hits:
+                    merged = dict(row)
+                    for k, v in other.items():
+                        if k != on:
+                            merged[right_out[k]] = v
+                    out.append(merged)
+            elif how in ("left", "outer"):
+                merged = dict(row)
+                for k in right_out.values():
+                    merged[k] = None
+                out.append(merged)
+        if how in ("right", "outer"):
+            for row in right_rows:
+                if row[on] not in matched_right:
+                    merged = {c: None for c in left_cols}
+                    merged[on] = row[on]
+                    for k, v in row.items():
+                        if k != on:
+                            merged[right_out[k]] = v
+                    out.append(merged)
+        out.sort(key=lambda r: _sort_token(r[on]))
+        return BlockAccessor.from_rows(out)
+
+    lparts = [hash_partition.remote(r) for r in left_refs]
+    rparts = [hash_partition.remote(r) for r in right_refs]
+    if n_out == 1:
+        return [join_partition.remote(len(lparts), *lparts, *rparts)]
+    return [join_partition.remote(
+        len(lparts),
+        *[lparts[i][j] for i in range(len(left_refs))],
+        *[rparts[i][j] for i in range(len(right_refs))])
+        for j in range(n_out)]
+
+
+#: (partial_fn, merge_fn, finalize_fn) per aggregation kind — the
+#: decomposition that makes per-block PARTIAL aggregation possible (the
+#: hash-aggregate structural win over gather-then-aggregate: only
+#: (key, partial-state) pairs cross the wire, reference:
+#: hash_shuffle.py:1034 hash aggregate).
+_AGG_KINDS = {
+    "count": (lambda vs: len(vs), sum, lambda s: s),
+    "sum": (lambda vs: float(sum(vs)), sum, lambda s: s),
+    "min": (min, min, lambda s: s),
+    "max": (max, max, lambda s: s),
+    "mean": (lambda vs: (float(sum(vs)), len(vs)),
+             lambda ss: (sum(a for a, _ in ss), sum(b for _, b in ss)),
+             lambda s: s[0] / s[1] if s[1] else None),
+}
+
+
+def hash_aggregate_exchange(refs: List, key: str,
+                            aggs: List[Tuple[str, Optional[str]]]) -> List:
+    """Multi-aggregation hash aggregate: per-block partial aggregation,
+    hash-partition of the (key, partials) rows, per-partition merge +
+    finalize. `aggs` = [(kind, column-or-None), ...]."""
+    import ray_tpu
+    if not refs:
+        return refs
+    n_out = min(len(refs), 8)
+    specs = [(kind, col, f"{kind}({col})" if col else f"{kind}()")
+             for kind, col in aggs]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def partial_agg(block):
+        acc = BlockAccessor(block)
+        groups: dict = {}
+        for row in acc.iter_rows():
+            groups.setdefault(row[key], []).append(row)
+        partial_rows: List[List] = [[] for _ in range(n_out)]
+        for k, rows in groups.items():
+            partials = {}
+            for kind, col, out_name in specs:
+                partial_fn = _AGG_KINDS[kind][0]
+                values = [r[col] for r in rows] if col else rows
+                partials[out_name] = partial_fn(values)
+            partial_rows[_stable_hash(k) % n_out].append(
+                {key: k, "__partials__": partials})
+        parts = tuple(BlockAccessor.from_rows(b) for b in partial_rows)
+        return parts if n_out > 1 else parts[0]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge_finalize(*blocks):
+        merged: dict = {}
+        for block in blocks:
+            for row in BlockAccessor(block).iter_rows():
+                merged.setdefault(row[key], []).append(row["__partials__"])
+        out = []
+        for k in sorted(merged, key=_sort_token):
+            partial_list = merged[k]
+            result = {key: k}
+            for kind, _col, out_name in specs:
+                _, merge_fn, finalize = _AGG_KINDS[kind]
+                result[out_name] = finalize(
+                    merge_fn([p[out_name] for p in partial_list]))
+            out.append(result)
+        return BlockAccessor.from_rows(out)
+
+    parts = [partial_agg.remote(r) for r in refs]
+    if n_out == 1:
+        return [merge_finalize.remote(*parts)]
+    return [merge_finalize.remote(*[parts[i][j]
+                                    for i in range(len(refs))])
             for j in range(n_out)]
 
 

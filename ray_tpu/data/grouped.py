@@ -51,6 +51,26 @@ class GroupedData:
         return self._agg(lambda v: float(np.std(v, ddof=1)), on,
                          f"std({on})")
 
+    def aggregate(self, *aggs: "tuple") -> Any:
+        """Multi-aggregation in ONE hash-aggregate exchange: per-block
+        partial aggregation, then merge+finalize per hash partition
+        (reference: hash_shuffle.py:1034). Each agg is (kind, column) or
+        (kind, None) for row aggs; kinds: count/sum/min/max/mean.
+
+        >>> ds.groupby("k").aggregate(("count", None), ("mean", "v"))
+        """
+        from .exchange import hash_aggregate_exchange
+        key = self._key
+        agg_list = [tuple(a) for a in aggs]
+
+        def plan_fn(refs: List) -> List:
+            return hash_aggregate_exchange(refs, key, agg_list)
+
+        ds = self._dataset._with_stage(
+            ("allToAll", plan_fn, "hash_aggregate"),
+            f"groupby({key}).aggregate")
+        return ds.sort(key)
+
     def map_groups(self, fn: Callable):
         from .dataset import Dataset, _rows_to_block
         groups: Dict[Any, List[Any]] = {}

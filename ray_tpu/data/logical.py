@@ -1,0 +1,151 @@
+"""Logical plan + rule-based optimizer
+(reference: python/ray/data/_internal/logical/interfaces/logical_plan.py:10,
+optimizer.py:24, rules in _internal/logical/rules/ — the reference lowers
+Dataset transformations into LogicalOperator nodes, runs rewrite rules to a
+fixpoint, then plans physical operators).
+
+Here a Dataset's stages are `LogicalOp` nodes carrying enough structure for
+the rules to reason about: row-preservation (limit pushdown), column sets
+(projection pushdown/merging), compute settings (fusion boundaries)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+MAP = "map"
+ALL_TO_ALL = "allToAll"
+
+
+@dataclasses.dataclass
+class LogicalOp:
+    kind: str                       # MAP | ALL_TO_ALL
+    fn: Callable                    # block fn (map) / plan fn (allToAll)
+    name: str = ""
+    opts: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # True when the op maps rows 1:1 (map / add_column / select / drop):
+    # a downstream limit may hop over it (LimitPushdown).
+    preserves_rows: bool = False
+    # Structured facts rules understand:
+    #   {"limit": n}            — this op is limit(n)
+    #   {"columns": [...]}      — this op is select_columns(cols)
+    #   {"exchange": "sort"|...} — all-to-all flavor
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def is_limit(self) -> bool:
+        return "limit" in self.meta
+
+    def is_projection(self) -> bool:
+        return "columns" in self.meta
+
+
+class Rule:
+    """One rewrite: returns (ops, source, changed)."""
+
+    def apply(self, ops: List[LogicalOp], source):
+        raise NotImplementedError
+
+
+class LimitPushdown(Rule):
+    """Move limit(n) before row-preserving map ops so upstream stages
+    process only the blocks the limit will keep (reference:
+    logical/rules/limit_pushdown.py)."""
+
+    def apply(self, ops, source):
+        changed = False
+        out = list(ops)
+        i = 1
+        while i < len(out):
+            op = out[i]
+            prev = out[i - 1]
+            if op.is_limit() and prev.kind == MAP and prev.preserves_rows:
+                out[i - 1], out[i] = op, prev
+                changed = True
+                i = max(1, i - 1)
+            else:
+                i += 1
+        return out, source, changed
+
+
+class ProjectionPushdown(Rule):
+    """Merge consecutive select_columns and push the leading projection
+    into a column-aware datasource (parquet reads only the named columns
+    — reference: logical/rules/ projection pushdown into ReadParquet)."""
+
+    def apply(self, ops, source):
+        changed = False
+        out: List[LogicalOp] = []
+        for op in ops:
+            if (op.is_projection() and out and out[-1].is_projection()
+                    and set(op.meta["columns"]) <=
+                    set(out[-1].meta["columns"])):
+                # select(a).select(b) == select(b) ONLY when b ⊆ a; the
+                # narrower (later) projection wins. A non-subset second
+                # select must stay put so it fails at runtime exactly
+                # like the unoptimized plan would (the rewrite must not
+                # resurrect dropped columns).
+                out[-1] = op
+                changed = True
+            else:
+                out.append(op)
+        if (out and out[0].is_projection() and source is not None
+                and getattr(source, "supports_columns", False)
+                and source.columns is None):
+            source = source.with_columns(out[0].meta["columns"])
+            out = out[1:]
+            changed = True
+        return out, source, changed
+
+
+class MapFusion(Rule):
+    """Fuse adjacent map ops with identical compute settings into one
+    physical stage (reference: logical/rules/operator_fusion.py). After
+    the optimizer runs, physical ops are built 1:1 from logical ops, so
+    the fused stage count is directly assertable."""
+
+    def apply(self, ops, source):
+        changed = False
+        out: List[LogicalOp] = []
+        for op in ops:
+            if (op.kind == MAP and out and out[-1].kind == MAP
+                    and _compute_key(out[-1]) == _compute_key(op)):
+                prev = out[-1]
+                prev_fns = prev.meta.get("fused_fns", [prev.fn])
+                fns = prev_fns + op.meta.get("fused_fns", [op.fn])
+
+                def fused(block, _fns=tuple(fns)):
+                    for f in _fns:
+                        block = f(block)
+                    return block
+
+                out[-1] = LogicalOp(
+                    MAP, fused, name=f"{prev.name}+{op.name}",
+                    opts=prev.opts,
+                    preserves_rows=prev.preserves_rows and
+                    op.preserves_rows,
+                    meta={"fused_fns": fns})
+                changed = True
+            else:
+                out.append(op)
+        return out, source, changed
+
+
+def _compute_key(op: LogicalOp) -> Tuple:
+    return (op.opts.get("compute"), op.opts.get("concurrency"))
+
+
+class Optimizer:
+    """Run rules to a fixpoint (reference: optimizer.py:24 — each pass
+    applies every rule until none fires)."""
+
+    RULES = (LimitPushdown(), ProjectionPushdown(), MapFusion())
+
+    def optimize(self, ops: List[LogicalOp], source=None):
+        for _ in range(16):  # fixpoint bound; rules strictly shrink/shift
+            any_changed = False
+            for rule in self.RULES:
+                ops, source, changed = rule.apply(ops, source)
+                any_changed = any_changed or changed
+            if not any_changed:
+                break
+        return ops, source

@@ -88,18 +88,41 @@ def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
     return out
 
 
-def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    files = _expand_paths(paths, ".parquet")
+class ParquetSource:
+    """Column-aware datasource descriptor: the optimizer's
+    ProjectionPushdown rewrites `columns` so parquet reads materialize
+    only the projected columns (reference: logical/rules projection
+    pushdown into the ReadParquet operator)."""
 
-    def source():
+    supports_columns = True
+
+    def __init__(self, files: List[str],
+                 columns: Optional[List[str]] = None):
+        self.files = files
+        self.columns = columns
+
+    def with_columns(self, columns: List[str]) -> "ParquetSource":
+        return ParquetSource(self.files, list(columns))
+
+    def describe(self) -> str:
+        cols = f" columns={self.columns}" if self.columns else ""
+        return f"parquet[{len(self.files)} files{cols}]"
+
+    def fn(self):
         import ray_tpu
+        columns = self.columns
 
         @ray_tpu.remote(num_cpus=1)
         def _read(path, columns=columns):
             import pyarrow.parquet as pq
             return pq.read_table(path, columns=columns)
-        return [_read.remote(f) for f in files]
-    return Dataset(source, [], name="read_parquet")
+        return [_read.remote(f) for f in self.files]
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+    source = ParquetSource(files, columns)
+    return Dataset(source.fn, [], name="read_parquet", source=source)
 
 
 def read_csv(paths) -> Dataset:

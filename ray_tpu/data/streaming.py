@@ -79,7 +79,10 @@ class MapOp(Op):
         super().__init__(name)
         self.fns = fns
         self.compute = compute
+        self.concurrency = concurrency
         ctx = DataContext.get_current()
+        # Static fallback; the executor's ResourceManager overrides this
+        # per tick with the op's fair share of the pipeline budget.
         self.window = concurrency or ctx.max_tasks_in_flight
         self.in_flight: List = []
         self._remote_fn = None
@@ -115,9 +118,12 @@ class MapOp(Op):
     def num_in_flight(self) -> int:
         return len(self.in_flight)
 
-    def schedule(self, output_room: int) -> bool:
+    def schedule(self, output_room: int,
+                 window: Optional[int] = None) -> bool:
         import ray_tpu
         progress = False
+        if window is not None:
+            self.window = window
         # Launch: bounded by the task window AND downstream room (the
         # backpressure signal — never produce more than the consumer and
         # output buffer can hold).
@@ -175,6 +181,41 @@ class AllToAllOp(Op):
             self.output_done = True
             progress = True
         return progress
+
+
+class ResourceManager:
+    """Per-pipeline resource budget (reference:
+    data/_internal/execution/resource_manager.py + backpressure_policy/).
+
+    Map operators share one CPU budget fairly instead of each claiming a
+    fixed window: with k active map ops on a pipeline budget of B task
+    slots, each op may keep ~B/k tasks in flight (an op with explicit
+    `concurrency` is additionally capped by it). Ops that finish release
+    their share to the survivors, so a single straggler stage ramps up to
+    the whole budget instead of starving behind a fixed window."""
+
+    def __init__(self, ops: List[Op]):
+        ctx = DataContext.get_current()
+        budget = ctx.execution_cpu_budget
+        if budget is None:
+            try:
+                import ray_tpu
+                budget = int(ray_tpu.cluster_resources().get("CPU", 0))
+            except Exception:  # noqa: BLE001 — no cluster yet
+                budget = 0
+        self.budget = max(1, budget or ctx.max_tasks_in_flight)
+        self._map_ops = [op for op in ops if isinstance(op, MapOp)]
+
+    def window_for(self, op: "MapOp") -> int:
+        active = [o for o in self._map_ops if not o.output_done]
+        share = max(1, self.budget // max(1, len(active)))
+        if op.concurrency:
+            return min(share, op.concurrency) if op.compute != "actors" \
+                else op.concurrency
+        return share
+
+    def usage(self) -> Dict[str, int]:
+        return {op.name: len(op.in_flight) for op in self._map_ops}
 
 
 class StreamingExecutor:
@@ -241,6 +282,7 @@ class StreamingExecutor:
                     if not self._emit(ref):
                         return
                 return
+            resource_manager = ResourceManager(self.ops)
             idle_backoff = 0.001
             while not self._stop.is_set():
                 progress = False
@@ -252,7 +294,12 @@ class StreamingExecutor:
                         room = max(
                             1, self.out_queue.maxsize - self.out_queue.qsize()
                             + op.num_in_flight())
-                    if op.schedule(room):
+                    if isinstance(op, MapOp):
+                        scheduled = op.schedule(
+                            room, window=resource_manager.window_for(op))
+                    else:
+                        scheduled = op.schedule(room)
+                    if scheduled:
                         progress = True
                     # Move outputs downstream / to the consumer.
                     if i + 1 < len(self.ops):
@@ -304,27 +351,16 @@ class StreamingExecutor:
                 continue
 
 
-def build_ops(stages: List, default_window: int) -> List[Op]:
-    """Lower ("map", fn[, opts]) / ("allToAll", plan_fn) stages into ops,
-    fusing adjacent map stages with identical compute settings."""
+def build_ops(logical_ops: List) -> List[Op]:
+    """Lower an OPTIMIZED logical plan into physical ops, one per node —
+    map fusion already ran as an optimizer rule (logical.py MapFusion),
+    so the physical stage count equals the logical node count."""
     ops: List[Op] = []
-    i = 0
-    while i < len(stages):
-        kind = stages[i][0]
-        if kind == "map":
-            fns = []
-            opts: Dict[str, Any] = stages[i][2] if len(stages[i]) > 2 else {}
-            key = (opts.get("compute"), opts.get("concurrency"))
-            while i < len(stages) and stages[i][0] == "map":
-                nxt_opts = stages[i][2] if len(stages[i]) > 2 else {}
-                if (nxt_opts.get("compute"),
-                        nxt_opts.get("concurrency")) != key:
-                    break
-                fns.append(stages[i][1])
-                i += 1
-            ops.append(MapOp("map", fns, compute=key[0], concurrency=key[1]))
+    for node in logical_ops:
+        if node.kind == "map":
+            ops.append(MapOp(node.name or "map", [node.fn],
+                             compute=node.opts.get("compute"),
+                             concurrency=node.opts.get("concurrency")))
         else:
-            ops.append(AllToAllOp(stages[i][2] if len(stages[i]) > 2
-                                  else "exchange", stages[i][1]))
-            i += 1
+            ops.append(AllToAllOp(node.name or "exchange", node.fn))
     return ops

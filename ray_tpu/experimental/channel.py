@@ -215,11 +215,21 @@ class DeviceChannel:
     def put(self, array, timeout: Optional[float] = 10.0):
         from . import device_objects as dobj
         server = dobj._ensure_server()
+        # Staged arrays hold HBM until overwritten: account them against
+        # the same process budget as device_put_ref pins so a fast writer
+        # backpressures instead of silently growing the keep-alive window
+        # (reference: gpu_object_manager's producer/consumer accounting).
+        nbytes = int(array.nbytes)
+        if not dobj.reserve_bytes(nbytes, timeout):
+            raise TimeoutError(
+                "DeviceChannel.put blocked on the device-object HBM "
+                f"budget for {timeout}s (pinned={dobj.pinned_bytes()}B)")
         self._uuid += 1
         server.await_pull(self._uuid, [array])
-        self._staged.append((self._uuid, array))
+        self._staged.append((self._uuid, array, nbytes))
         if len(self._staged) > self._PIN_DEPTH:
-            self._staged.pop(0)
+            _, _, old_bytes = self._staged.pop(0)
+            dobj.release_bytes(old_bytes)
         self._ctrl.put((dobj._server_addr, self._uuid,
                         tuple(array.shape), str(array.dtype)), timeout)
 
@@ -241,6 +251,10 @@ class DeviceChannel:
         self._ctrl.close()
 
     def destroy(self):
+        if self._staged:
+            from . import device_objects as dobj
+            for _, _, nbytes in self._staged:
+                dobj.release_bytes(nbytes)
         self._staged.clear()
         self._ctrl.destroy()
 

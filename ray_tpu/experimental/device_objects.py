@@ -32,11 +32,66 @@ from .._internal.ids import ObjectID
 from .._internal.object_ref import ObjectRef
 
 _lock = threading.Lock()
+_cond = threading.Condition(_lock)
 _pinned: Dict[ObjectID, Any] = {}          # oid -> jax.Array (producer)
+_pinned_nbytes: Dict[ObjectID, int] = {}
+_accounted_bytes = [0]                     # pins + channel staging
 _server = None                             # this process's TransferServer
 _server_addr: Optional[str] = None
 _next_uuid = [1]
 _conns: Dict[str, Any] = {}                # addr -> TransferConnection
+_gauge = None
+
+
+def _update_gauge():
+    global _gauge
+    try:
+        if _gauge is None:
+            from ..util.metrics import Gauge
+            _gauge = Gauge("device_object_pinned_bytes",
+                           "HBM bytes pinned for device-resident objects "
+                           "(device_put_ref + DeviceChannel staging)")
+        _gauge.set(float(_accounted_bytes[0]))
+    except Exception:  # noqa: BLE001 — metrics best-effort
+        pass
+
+
+def pinned_bytes() -> int:
+    """HBM bytes currently accounted (pins + channel staging)."""
+    with _lock:
+        return _accounted_bytes[0]
+
+
+def reserve_bytes(nbytes: int, timeout_s: Optional[float] = None) -> bool:
+    """Backpressure gate: block until `nbytes` fits under the HBM budget
+    (CONFIG.device_object_hbm_budget; 0 = unlimited). Returns False on
+    timeout — callers then spill to host instead of OOMing HBM."""
+    from .._internal.config import CONFIG
+    budget = CONFIG.device_object_hbm_budget
+    if timeout_s is None:
+        timeout_s = CONFIG.device_object_backpressure_timeout_s
+    with _cond:
+        if not budget:
+            _accounted_bytes[0] += nbytes
+            _update_gauge()
+            return True
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        while _accounted_bytes[0] + nbytes > budget:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0 or nbytes > budget:
+                return False
+            _cond.wait(remaining)
+        _accounted_bytes[0] += nbytes
+        _update_gauge()
+        return True
+
+
+def release_bytes(nbytes: int):
+    with _cond:
+        _accounted_bytes[0] = max(0, _accounted_bytes[0] - nbytes)
+        _update_gauge()
+        _cond.notify_all()
 
 
 @dataclass
@@ -65,24 +120,38 @@ def _ensure_server():
         return _server
 
 
-def device_put_ref(array) -> ObjectRef:
+def device_put_ref(array, *, timeout_s: Optional[float] = None
+                   ) -> ObjectRef:
     """Pin `array` on-device in this process and return a control-plane
     ref to it. Call inside the producing actor; return the ref (or a
-    structure containing it) to consumers."""
+    structure containing it) to consumers.
+
+    HBM accounting: pins count against
+    CONFIG.device_object_hbm_budget. When producers outrun consumers the
+    call BLOCKS (up to device_object_backpressure_timeout_s) for frees,
+    then falls back to spilling the array to the host object store — the
+    returned ref then resolves through the normal object path and
+    device_get re-devices it (reference: gpu_object_manager.py:61)."""
     import numpy as np
 
     from .._internal.core_worker import get_core_worker
 
-    _ensure_server()
     worker = get_core_worker()
+    nbytes = int(array.nbytes)
+    if not reserve_bytes(nbytes, timeout_s):
+        # Budget exhausted: spill to host instead of risking HBM OOM.
+        import ray_tpu
+        return ray_tpu.put(np.asarray(array))
+    _ensure_server()
     oid = ObjectID.from_random()
     with _lock:
         _pinned[oid] = array
+        _pinned_nbytes[oid] = nbytes
     desc = DeviceObjectDescriptor(
         object_hex=oid.hex(), transfer_addr=_server_addr,
         producer_rpc_addr=tuple(worker.rpc_address),
         shape=tuple(array.shape), dtype=str(np.dtype(array.dtype)),
-        nbytes=int(array.nbytes))
+        nbytes=nbytes)
     worker.reference_counter.add_owned(oid)
     worker.memory_store.put(oid, desc)
     _register_free_hook()
@@ -103,6 +172,11 @@ def device_get(ref: ObjectRef):
         return local
     desc = ray_tpu.get(ref)
     if not isinstance(desc, DeviceObjectDescriptor):
+        import numpy as np
+        if isinstance(desc, np.ndarray):
+            # producer spilled to host under HBM backpressure — re-device
+            import jax.numpy as jnp
+            return jnp.asarray(desc)
         raise TypeError(f"{ref} is not a device object (got "
                         f"{type(desc).__name__})")
     return _pull(desc)
@@ -176,6 +250,9 @@ def _register_free_hook():
 def on_free(object_id: ObjectID):
     with _lock:
         _pinned.pop(object_id, None)
+        nbytes = _pinned_nbytes.pop(object_id, 0)
+    if nbytes:
+        release_bytes(nbytes)
 
 
 def num_pinned() -> int:

@@ -8,10 +8,14 @@ for TPU, so this package IS the engine (SURVEY §7 step 8): a
 continuous-batching decode loop over slot-structured KV caches, jitted
 once per shape bucket, deployed behind ray_tpu.serve."""
 
+from .disagg import (PDDecodeServer, PrefillServer, build_pd_disagg_app)
 from .engine import EngineConfig, GenerationRequest, LLMEngine
+from .openai import ByteTokenizer, OpenAIServer, build_openai_app
 from .paged import PagedEngineConfig, PagedLLMEngine
-from .serving import build_llm_deployment
+from .serving import LLMServer, build_llm_deployment
 
 __all__ = ["EngineConfig", "GenerationRequest", "LLMEngine",
-           "PagedEngineConfig", "PagedLLMEngine",
-           "build_llm_deployment"]
+           "PagedEngineConfig", "PagedLLMEngine", "LLMServer",
+           "build_llm_deployment", "OpenAIServer", "build_openai_app",
+           "ByteTokenizer", "PrefillServer", "PDDecodeServer",
+           "build_pd_disagg_app"]

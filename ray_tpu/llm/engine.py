@@ -139,6 +139,26 @@ class LLMEngine:
         return (not self._pending.empty()) or \
             any(s.request is not None for s in self.slots)
 
+    def fail_all(self, error: Exception):
+        """Resolve every active and queued request with `error` (see
+        PagedLLMEngine.fail_all — callers must see step() failures)."""
+        import queue as _queue
+        for slot in self.slots:
+            if slot.request is None:
+                continue
+            request, slot.request = slot.request, None
+            callback = getattr(request, "_done_callback", None)
+            if callback is not None:
+                callback(request, error)
+        try:
+            while True:
+                request = self._pending.get_nowait()
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, error)
+        except _queue.Empty:
+            pass
+
     # -- the scheduler tick ------------------------------------------------
 
     def step(self) -> List[Tuple[GenerationRequest, List[int]]]:

@@ -137,16 +137,31 @@ class PagedLLMEngine:
 
         self._decode = jax.jit(decode_step, donate_argnums=(1, 2))
 
-        def prefill(params, tokens, positions):
-            # rounded up to whole pages so page scatter never overruns
-            caches = init_kv_caches(
-                cfg, 1, config.pages_per_seq * config.page_size)
+        def chunk_prefill(params, tokens, positions, dense_caches, offset):
+            """One prefill chunk: write K/V for `tokens` into the dense
+            caches at `offset`, attend causally over everything cached so
+            far. Chunked prefill lifts the prompt cap to max_len — any
+            prompt runs as ceil(n/bucket) chunks of one compiled shape
+            per bucket (reference: vLLM chunked prefill, delegated by
+            llm/_internal/serve/deployments/llm/vllm/)."""
             logits, new_caches = model.apply(
                 {"params": params}, tokens, positions=positions,
-                kv_caches=caches, cache_index=0)
+                kv_caches=dense_caches, cache_index=offset)
             return logits.astype(jnp.float32), new_caches
 
-        self._prefill = jax.jit(prefill)
+        self._chunk_prefill = jax.jit(chunk_prefill, donate_argnums=(3,))
+
+        def _dense_zero_caches():
+            # Length covers the worst chunked-prefill write: the last
+            # chunk is bucket-rounded, so a prompt ending near max_len
+            # writes up to (largest_bucket - 1) tokens of padding past
+            # it. Without the slack, dynamic_update_slice would CLAMP
+            # the start index and silently corrupt earlier positions.
+            slack = config.prefill_buckets[-1]
+            return init_kv_caches(
+                cfg, 1, config.pages_per_seq * config.page_size + slack)
+
+        self._dense_zero_caches = jax.jit(_dense_zero_caches)
 
         def write_pages(k_pages, v_pages, dense_caches, page_ids,
                         start_tok):
@@ -179,11 +194,25 @@ class PagedLLMEngine:
         n = len(request.prompt_tokens)
         if n >= self.config.max_len:
             raise ValueError("prompt longer than max_len")
-        if n > self.config.prefill_buckets[-1]:
-            raise ValueError("prompt exceeds the largest prefill bucket")
         request._done_callback = done_callback  # type: ignore
         request._token_callback = token_callback  # type: ignore
         self._pending.put(request)
+
+    def submit_prefilled(self, request: GenerationRequest, dense_caches,
+                         last_logits,
+                         done_callback: Optional[Callable] = None,
+                         token_callback: Optional[Callable] = None):
+        """Submit a request whose prefill ran on ANOTHER engine
+        (prefill/decode disaggregation): `dense_caches` are per-layer
+        (k, v) arrays trimmed to the prompt's pages, `last_logits` the
+        prompt's final-position logits. Admission (page budget, prefix
+        sharing) happens on the normal scheduler tick."""
+        n = len(request.prompt_tokens)
+        if n >= self.config.max_len:
+            raise ValueError("prompt longer than max_len")
+        request._done_callback = done_callback  # type: ignore
+        request._token_callback = token_callback  # type: ignore
+        self._pending.put((request, dense_caches, last_logits))
 
     def cancel(self, request_id: str) -> bool:
         """Abort a request: frees its slot+pages on the next tick if
@@ -194,22 +223,53 @@ class PagedLLMEngine:
             return True
         # queued: rebuild the queue without it
         kept, found = [], False
+        dropped = None
         try:
             while True:
-                r = self._pending.get_nowait()
+                entry = self._pending.get_nowait()
+                r = entry[0] if isinstance(entry, tuple) else entry
                 if r.request_id == request_id and not found:
                     found = True
+                    dropped = r
                     continue
-                kept.append(r)
+                kept.append(entry)
         except queue.Empty:
             pass
         for r in kept:
             self._pending.put(r)
+        if dropped is not None:
+            # queued cancellations must still resolve their waiters
+            callback = getattr(dropped, "_done_callback", None)
+            if callback is not None:
+                callback(dropped, None)  # None = cancelled
         return found
 
     def has_work(self) -> bool:
         return (not self._pending.empty()) or \
             any(s.request is not None for s in self.seqs)
+
+    def fail_all(self, error: Exception):
+        """Resolve every active and queued request with `error` (the
+        serving drive loop calls this when step() raises — callers must
+        see the failure, not hang on a silently-spinning engine)."""
+        for i, seq in enumerate(self.seqs):
+            if seq.request is None:
+                continue
+            request = seq.request
+            self._release(seq)
+            self.seqs[i] = _Seq()
+            callback = getattr(request, "_done_callback", None)
+            if callback is not None:
+                callback(request, error)
+        try:
+            while True:
+                entry = self._pending.get_nowait()
+                r = entry[0] if isinstance(entry, tuple) else entry
+                callback = getattr(r, "_done_callback", None)
+                if callback is not None:
+                    callback(r, error)
+        except queue.Empty:
+            pass
 
     # -- scheduler tick ----------------------------------------------------
 
@@ -233,16 +293,24 @@ class PagedLLMEngine:
             if seq.request is not None:
                 continue
             try:
-                request = self._pending.get_nowait()
+                entry = self._pending.get_nowait()
             except queue.Empty:
                 return
+            # plain request (local prefill) or (request, caches, logits)
+            # from submit_prefilled (disaggregated prefill)
+            prefilled = isinstance(entry, tuple)
+            request = entry[0] if prefilled else entry
             if self.pool.num_free() < self._pages_needed(request):
                 # page budget exhausted: requeue and stop admitting —
                 # decode completions will free pages
-                self._pending.put(request)
+                self._pending.put(entry)
                 return
             try:
-                self._prefill_into(index, request)
+                if prefilled:
+                    self._admit_prefilled(index, request, entry[1],
+                                          entry[2])
+                else:
+                    self._prefill_into(index, request)
             except Exception as e:  # noqa: BLE001
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
@@ -252,12 +320,68 @@ class PagedLLMEngine:
         for b in self.config.prefill_buckets:
             if n <= b:
                 return b
-        raise ValueError("prompt too long")
+        return self.config.prefill_buckets[-1]
+
+    def _run_chunked_prefill(self, prompt: List[int]):
+        """Prefill the whole prompt in bucket-sized chunks against a dense
+        per-request cache; returns (last_token_logits, dense_caches). One
+        compiled program per bucket size, regardless of prompt length."""
+        caches = self._dense_zero_caches()
+        largest = self.config.prefill_buckets[-1]
+        off = 0
+        last_logits = None
+        while off < len(prompt):
+            rem = len(prompt) - off
+            chunk = self._bucket(min(rem, largest))
+            take = min(rem, chunk)
+            tokens = np.zeros((1, chunk), np.int32)
+            tokens[0, :take] = prompt[off:off + take]
+            # pad positions clamp to the rope table; their garbage K/V
+            # lands past the prompt and is never copied to pages
+            positions = np.minimum(
+                np.arange(off, off + chunk, dtype=np.int32),
+                self.config.model.max_seq_len - 1)[None, :]
+            logits, caches = self._chunk_prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                caches, jnp.asarray(off, jnp.int32))
+            if off + take == len(prompt):
+                last_logits = np.asarray(
+                    logits[0, take - 1], np.float64)
+            off += take
+        return last_logits, caches
+
+    def prefill_only(self, prompt: List[int]):
+        """Run chunked prefill WITHOUT admitting a sequence: returns
+        (last_token_logits, per-layer dense (k, v) numpy pairs) trimmed to
+        whole pages. This is the prefill half of prefill/decode
+        disaggregation (reference:
+        llm/_internal/serve/deployments/prefill_decode_disagg/) — the KV
+        ships to a decode engine's `submit_prefilled`."""
+        last_logits, caches = self._run_chunked_prefill(prompt)
+        n_tok = -(-len(prompt) // self.config.page_size) * \
+            self.config.page_size
+        out = [(np.asarray(k[:, :, :n_tok]), np.asarray(v[:, :, :n_tok]))
+               for (k, v) in caches]
+        return last_logits, out
 
     def _prefill_into(self, index: int, request: GenerationRequest):
+        # chunked dense prefill of the whole prompt (compute), paged
+        # storage — prompts run to max_len, not the largest bucket
+        last_logits, dense_caches = self._run_chunked_prefill(
+            request.prompt_tokens)
+        self._admit_prefilled(index, request, dense_caches, last_logits)
+
+    def _admit_prefilled(self, index: int, request: GenerationRequest,
+                         dense_caches, last_logits):
+        """Install an already-prefilled request: page allocation, prefix
+        sharing/registration, first-token pick, sequence setup.
+        `dense_caches` may be numpy (shipped from a prefill server) or
+        on-device arrays (local prefill)."""
         cfg = self.config
         prompt = request.prompt_tokens
         ps = cfg.page_size
+        dense_caches = [(jnp.asarray(k), jnp.asarray(v))
+                        for (k, v) in dense_caches]
         # 1. prefix reuse: full pages whose token prefix is already pooled
         shared: List[int] = []
         n_full = len(prompt) // ps
@@ -270,25 +394,22 @@ class PagedLLMEngine:
                     self.pool.incref(page)
                 shared = list(hit)
                 break
-        # 2. dense prefill of the whole prompt (compute), paged storage
-        bucket = self._bucket(len(prompt))
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :len(prompt)] = prompt
-        positions = np.arange(bucket, dtype=np.int32)[None, :]
-        logits, dense_caches = self._prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions))
         n_pages = self._pages_needed(request)
         new_ids = []
         for _ in range(n_pages - len(shared)):
             page = self.pool.alloc()
             assert page is not None, "admission checked the budget"
             new_ids.append(page)
-        # write only the non-shared tail pages (shared ones are
-        # byte-identical by construction)
-        if new_ids:
+        # write only non-shared pages holding PROMPT tokens (shared ones
+        # are byte-identical by construction; generation-room pages are
+        # filled token-by-token at decode — and a disaggregated prefill
+        # ships a cache trimmed to exactly the prompt pages)
+        n_prompt_pages = -(-len(prompt) // ps)
+        write_ids = new_ids[:max(0, n_prompt_pages - len(shared))]
+        if write_ids:
             self.k_pages, self.v_pages = self._write_pages(
                 self.k_pages, self.v_pages, dense_caches,
-                jnp.asarray(new_ids, jnp.int32),
+                jnp.asarray(write_ids, jnp.int32),
                 jnp.asarray(len(shared) * ps, jnp.int32))
         pages = shared + new_ids
         # 3. register newly-complete full-page prefixes for reuse
@@ -300,9 +421,21 @@ class PagedLLMEngine:
                 self.prefix_pages[key] = pages[:k]
                 self._prefix_lru.append(key)
         self._evict_prefixes()
-        # 4. first token from the prefill logits
-        last_logits = np.asarray(logits[0, len(prompt) - 1], np.float64)
-        first_token = int(np.argmax(last_logits))
+        # 4. first token from the prefill logits (sampled when the request
+        # asks for temperature > 0, mirroring the slot engine's branch —
+        # engine.py:195-204 — so the two engines agree beyond greedy)
+        temp = request.temperature if request.temperature is not None \
+            else self.config.temperature
+        if temp > 0:
+            self._rng, key = jax.random.split(self._rng)
+            scaled = last_logits / max(temp, 1e-6)
+            probs = np.exp(scaled - scaled.max())
+            probs /= probs.sum()
+            first_token = int(np.random.default_rng(
+                int(jax.random.randint(key, (), 0, 2**31 - 1))
+            ).choice(len(probs), p=probs))
+        else:
+            first_token = int(np.argmax(last_logits))
         seq = self.seqs[index]
         seq.request = request
         seq.pages = pages

@@ -1,30 +1,67 @@
 """LLM serving deployment
 (reference: llm/_internal/serve/deployments/llm/ — the vLLM server class;
-builders serve/llm/__init__.py:92 build_llm_deployment. Here the engine
-is in-process and TPU-native instead of a vLLM subprocess.)
+builders llm/_internal/serve/builders/application_builders.py:19,60 →
+public serve/llm/__init__.py:92 build_llm_deployment, :168 build_openai_app.
+Here the engine is in-process and TPU-native instead of a vLLM subprocess.)
 
 The deployment's asyncio loop drives the engine: requests enqueue into
 the engine's scheduler and await completion futures; one background task
 steps the engine whenever work is pending — iteration-level (continuous)
-batching across concurrent HTTP/handle requests."""
+batching across concurrent HTTP/handle requests.
+
+Streaming: tokens are pushed from the engine's token callbacks into
+per-request stream buffers; the HTTP proxy long-polls `stream_next` on
+the SAME replica and relays chunked HTTP (reference streams via ASGI
+from the replica; the long-poll hop keeps the data plane on the actor
+RPC plane with batched token delivery)."""
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import uuid
 from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
 
+class _Stream:
+    __slots__ = ("tokens", "event", "done", "error", "request_id")
+
+    def __init__(self, request_id: str):
+        self.tokens: List[int] = []
+        self.event = asyncio.Event()
+        self.done = False
+        self.error: Optional[str] = None
+        self.request_id = request_id
+
+
 class LLMServer:
-    """The replica callable (wrapped by serve.deployment)."""
+    """The replica callable (wrapped by serve.deployment).
+
+    `engine_config` picks the engine: a `PagedEngineConfig` runs the
+    paged-KV continuous-batching engine (the default TPU serving path —
+    prefix page sharing, chunked prefill to max_len); an `EngineConfig`
+    runs the static-slot engine."""
 
     def __init__(self, engine_config, params=None):
-        from .engine import LLMEngine
-        self._engine = LLMEngine(engine_config, params=params)
+        from .engine import EngineConfig, LLMEngine
+        from .paged import PagedEngineConfig, PagedLLMEngine
+        if isinstance(engine_config, PagedEngineConfig):
+            self._engine = PagedLLMEngine(engine_config, params=params)
+            self._paged = True
+        elif isinstance(engine_config, EngineConfig):
+            self._engine = LLMEngine(engine_config, params=params)
+            self._paged = False
+        else:
+            raise TypeError(
+                f"engine_config must be PagedEngineConfig or EngineConfig, "
+                f"got {type(engine_config).__name__}")
         self._loop_task: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None
+        self._streams: Dict[str, _Stream] = {}
+
+    # -- engine drive ------------------------------------------------------
 
     def _ensure_loop(self):
         if self._loop_task is None or self._loop_task.done():
@@ -40,14 +77,35 @@ class LLMServer:
             # One engine tick off-loop (it blocks on device compute).
             try:
                 await loop.run_in_executor(None, self._engine.step)
-            except Exception:  # noqa: BLE001 — keep serving other requests
+            except Exception as e:  # noqa: BLE001 — keep the loop alive
+                # Fail the in-flight requests LOUDLY: a deterministic step
+                # failure (bad kernel shape, OOM) would otherwise spin
+                # here forever while callers hang on their futures.
                 logger.exception("engine step failed")
+                try:
+                    self._engine.fail_all(e)
+                except Exception:  # noqa: BLE001
+                    pass
                 await asyncio.sleep(0.1)
 
-    async def generate(self, prompt_tokens: List[int],
-                       max_new_tokens: int = 32) -> Dict[str, Any]:
-        from .engine import GenerationRequest
+    async def _submit(self, request, done_callback, token_callback=None):
+        # async so subclasses can do remote work first (PD-disagg fetches
+        # the prefilled KV from the prefill deployment here)
         self._ensure_loop()
+        if self._paged:
+            self._engine.submit(request, done_callback=done_callback,
+                                token_callback=token_callback)
+        else:
+            self._engine.submit(request, done_callback=done_callback)
+        self._wake.set()
+
+    # -- one-shot generation ----------------------------------------------
+
+    async def generate(self, prompt_tokens: List[int],
+                       max_new_tokens: int = 32,
+                       temperature: Optional[float] = None,
+                       request_id: Optional[str] = None) -> Dict[str, Any]:
+        from .engine import GenerationRequest
         loop = asyncio.get_running_loop()
         future = loop.create_future()
 
@@ -57,22 +115,117 @@ class LLMServer:
                     return
                 if isinstance(tokens, Exception):
                     future.set_exception(tokens)
+                elif tokens is None:  # cancelled
+                    future.set_result(None)
                 else:
                     future.set_result(tokens)
             loop.call_soon_threadsafe(_resolve)
 
-        request = GenerationRequest(prompt_tokens=list(prompt_tokens),
-                                    max_new_tokens=max_new_tokens)
-        self._engine.submit(request, done_callback=on_done)
-        self._wake.set()
+        request = GenerationRequest(
+            prompt_tokens=list(prompt_tokens),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature,
+            request_id=request_id or uuid.uuid4().hex)
+        await self._submit(request, on_done)
         tokens = await future
+        if tokens is None:
+            return {"tokens": [], "num_generated": 0, "cancelled": True}
         return {"tokens": tokens, "num_generated": len(tokens)}
+
+    # -- streaming ---------------------------------------------------------
+
+    async def generate_stream_start(
+            self, prompt_tokens: List[int], max_new_tokens: int = 32,
+            temperature: Optional[float] = None,
+            request_id: Optional[str] = None) -> str:
+        """Begin a streamed generation; returns a stream id the caller
+        polls with `stream_next` (the proxy relays it as chunked HTTP)."""
+        from .engine import GenerationRequest
+        if not self._paged:
+            raise RuntimeError("streaming requires the paged engine")
+        loop = asyncio.get_running_loop()
+        request_id = request_id or uuid.uuid4().hex
+        stream_id = uuid.uuid4().hex
+        stream = _Stream(request_id)
+        self._streams[stream_id] = stream
+
+        def on_token(request, token):
+            def _push():
+                stream.tokens.append(int(token))
+                stream.event.set()
+            loop.call_soon_threadsafe(_push)
+
+        def on_done(request, tokens):
+            def _finish():
+                if isinstance(tokens, Exception):
+                    stream.error = str(tokens)
+                stream.done = True
+                stream.event.set()
+            loop.call_soon_threadsafe(_finish)
+
+        request = GenerationRequest(
+            prompt_tokens=list(prompt_tokens),
+            max_new_tokens=max_new_tokens,
+            temperature=temperature, request_id=request_id)
+        await self._submit(request, on_done, token_callback=on_token)
+        return stream_id
+
+    async def stream_next(self, stream_id: str,
+                          timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Long-poll: the next batch of tokens (whatever has accumulated
+        since the last call), plus the done flag. Empty batch on timeout."""
+        stream = self._streams.get(stream_id)
+        if stream is None:
+            return {"tokens": [], "done": True, "error": "unknown stream"}
+        if not stream.tokens and not stream.done:
+            stream.event.clear()
+            try:
+                await asyncio.wait_for(stream.event.wait(), timeout_s)
+            except asyncio.TimeoutError:
+                pass
+        tokens, stream.tokens = stream.tokens, []
+        done = stream.done and not stream.tokens
+        out = {"tokens": tokens, "done": done}
+        if stream.error:
+            out["error"] = stream.error
+        if done:
+            self._streams.pop(stream_id, None)
+        return out
+
+    async def cancel_stream(self, stream_id: str) -> bool:
+        stream = self._streams.pop(stream_id, None)
+        if stream is None:
+            return False
+        return await self.cancel(stream.request_id)
+
+    async def cancel(self, request_id: str) -> bool:
+        """Abort a running or queued request (paged engine only)."""
+        if not self._paged:
+            return False
+        ok = self._engine.cancel(request_id)
+        if self._wake is not None:
+            self._wake.set()
+        return ok
+
+    # -- HTTP entry --------------------------------------------------------
 
     async def __call__(self, http_request) -> Dict[str, Any]:
         body = http_request.json()
+        prompt = body.get("prompt_tokens")
+        if prompt is None:
+            raise ValueError("body must contain prompt_tokens")
+        max_new = int(body.get("max_new_tokens", 32))
+        temp = body.get("temperature")
+        if body.get("stream"):
+            stream_id = await self.generate_stream_start(
+                prompt, max_new_tokens=max_new, temperature=temp,
+                request_id=body.get("request_id"))
+            # The proxy recognises this marker and relays stream_next
+            # batches as chunked HTTP on the same replica.
+            return {"__rtpu_stream__": stream_id}
         return await self.generate(
-            body["prompt_tokens"],
-            max_new_tokens=int(body.get("max_new_tokens", 32)))
+            prompt, max_new_tokens=max_new, temperature=temp,
+            request_id=body.get("request_id"))
 
     def engine_stats(self) -> Dict[str, Any]:
         return self._engine.stats()

@@ -22,6 +22,7 @@ import numpy as np
 BASELINES = {
     "tasks_sync_per_s": 901.0,
     "tasks_async_per_s": 7_419.0,
+    "tasks_async_multi_client_per_s": 19_295.0,
     "actor_calls_sync_per_s": 1_826.0,
     "actor_calls_async_per_s": 7_926.0,
     "actor_calls_async_nn_per_s": 24_809.0,
@@ -30,6 +31,84 @@ BASELINES = {
     "put_gib_per_s": 20.35,
     "pg_create_remove_per_s": 751.0,
 }
+
+_CLIENT_SCRIPT = r"""
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import ray_tpu
+
+idx = int(sys.argv[1]); n = int(sys.argv[2]); out = sys.argv[3]
+ray_tpu.init(address={addr!r}, log_to_driver=False)
+
+@ray_tpu.remote
+def noop():
+    return None
+
+ray_tpu.get([noop.remote() for _ in range(100)])  # warm a worker lease
+ready = out + ".ready"
+open(ready, "w").close()
+go = os.path.join(os.path.dirname(out), "go")
+while not os.path.exists(go):
+    time.sleep(0.02)
+t0 = time.perf_counter()
+ray_tpu.get([noop.remote() for _ in range(n)])
+t1 = time.perf_counter()
+with open(out, "w") as f:
+    json.dump({{"t0": t0, "t1": t1, "n": n}}, f)
+ray_tpu.shutdown()
+"""
+
+
+def multi_client_bench(n_clients: int = 4, n_per: int = 1000,
+                       results: Optional[Dict[str, float]] = None):
+    """Aggregate async task throughput from N separate DRIVER PROCESSES
+    against one cluster (reference: ray_perf.py 'tasks async (multi
+    client)'; baseline 19,295/s). Assumes a cluster is already up in this
+    process (main() calls it after the single-client suite)."""
+    import glob
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from ray_tpu._internal.core_worker import get_core_worker
+    host, port = get_core_worker().gcs.address
+    addr = f"{host}:{port}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    workdir = tempfile.mkdtemp(prefix="rtpu-mc-")
+    script = os.path.join(workdir, "client.py")
+    with open(script, "w") as f:
+        f.write(_CLIENT_SCRIPT.format(repo=repo, addr=addr))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    procs = []
+    outs = []
+    for i in range(n_clients):
+        out = os.path.join(workdir, f"client-{i}.json")
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, script, str(i), str(n_per), out], env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+    deadline = time.monotonic() + 120
+    while len(glob.glob(os.path.join(workdir, "*.ready"))) < n_clients:
+        if time.monotonic() > deadline:
+            raise TimeoutError("multi-client workers failed to connect")
+        time.sleep(0.05)
+    open(os.path.join(workdir, "go"), "w").close()
+    for p in procs:
+        p.wait(timeout=300)
+    spans = []
+    for out in outs:
+        with open(out) as f:
+            spans.append(json.load(f))
+    total = sum(s["n"] for s in spans)
+    # Clients share a monotonic-ish clock (same machine): aggregate rate
+    # over the union window.
+    wall = max(s["t1"] for s in spans) - min(s["t0"] for s in spans)
+    rate = total / wall
+    if results is not None:
+        results["tasks_async_multi_client_per_s"] = rate
+    _report("tasks_async_multi_client_per_s", rate, "tasks/s")
+    return rate
 
 
 def _rate(n: int, fn: Callable[[], None]) -> float:
@@ -114,6 +193,13 @@ def main(quick: bool = False) -> Dict[str, float]:
     results["actor_calls_async_nn_per_s"] = _rate(4 * n_per, _nn)
     _report("actor_calls_async_nn_per_s",
             results["actor_calls_async_nn_per_s"], "calls/s")
+
+    try:
+        multi_client_bench(n_clients=2 if quick else 4,
+                           n_per=500 * scale, results=results)
+    except Exception as e:  # noqa: BLE001 — keep the rest of the suite
+        print(json.dumps({"metric": "tasks_async_multi_client_per_s",
+                          "error": str(e)}), flush=True)
 
     small = np.zeros(8, np.int64)
     n = 1000 * scale

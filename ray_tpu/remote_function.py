@@ -27,8 +27,27 @@ from ._internal.task_spec import (NORMAL_TASK, TaskArg, TaskSpec, _CallBundle,
                                   _RefPlaceholder)
 
 
+_EMPTY_ARGS_DATA = None
+
+
+def _trace_ctx():
+    """Active span context for submit-time propagation (cheap: one
+    contextvar read; None when tracing isn't in use)."""
+    from .util.tracing import child_context_for_submit
+    return child_context_for_submit()
+
+
 def pack_args(args: Tuple, kwargs: Dict) -> List[TaskArg]:
     """Bundle (args, kwargs) into TaskArgs: one inline bundle + ref deps."""
+    global _EMPTY_ARGS_DATA
+    if not args and not kwargs:
+        # No-arg calls (actor pings, pollers) dominate control-plane
+        # floods; their bundle bytes are constant — pickle once.
+        if _EMPTY_ARGS_DATA is None:
+            _EMPTY_ARGS_DATA = serialization.serialize(
+                _CallBundle((), {})).to_bytes()
+        return [TaskArg(is_ref=False, data=_EMPTY_ARGS_DATA,
+                        contained_ref_ids=[])]
     refs: List[ObjectRef] = []
 
     def hoist(value):
@@ -100,6 +119,7 @@ class RemoteFunction:
                                         worker.gcs),
             label_selector=opts.get("label_selector") or {},
             enable_task_events=opts.get("enable_task_events", True),
+            trace_context=_trace_ctx(),
         )
         refs = worker.submit_task(spec)
         if num_returns == "streaming":

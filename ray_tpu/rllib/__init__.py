@@ -13,9 +13,13 @@ iteration. The reference's torch DDP learner-group maps here to mesh
 data-parallelism inside the jitted update."""
 
 from .algorithm import PPO, PPOConfig
+from .dqn import DQN, DQNConfig, DQNLearner, ReplayBufferActor
 from .env_runner import SingleAgentEnvRunner
 from .impala import Impala, ImpalaConfig, ImpalaLearner
 from .learner import PPOLearner
+from .offline import BC, BCConfig, record_episodes
 
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
-           "Impala", "ImpalaConfig", "ImpalaLearner"]
+           "Impala", "ImpalaConfig", "ImpalaLearner",
+           "DQN", "DQNConfig", "DQNLearner", "ReplayBufferActor",
+           "BC", "BCConfig", "record_episodes"]

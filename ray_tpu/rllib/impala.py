@@ -74,6 +74,13 @@ class ImpalaConfig:
         self.gamma = 0.99
         self.vf_coeff = 0.5
         self.entropy_coeff = 0.01
+        # linear entropy decay: coeff anneals to `entropy_coeff_final`
+        # over `entropy_decay_iters` learner iterations (None = constant).
+        # Late-training entropy pressure is what caps CartPole ~360: the
+        # optimal policy is near-deterministic, and a constant bonus
+        # keeps prying it open.
+        self.entropy_coeff_final: Optional[float] = None
+        self.entropy_decay_iters = 0
         self.rho_bar = 1.0
         self.c_bar = 1.0
         self.normalize_advantages = True
@@ -173,7 +180,7 @@ class ImpalaLearner:
                 rewards + gamma * nonterminal * next_vs - values)
             return vs, pg_adv
 
-        def _update(params, opt_state, batch):
+        def _update(params, opt_state, batch, ent_coeff):
             def loss_fn(p):
                 T, B = batch["actions"].shape
                 flat_obs = batch["obs"].reshape((T * B,) +
@@ -206,7 +213,7 @@ class ImpalaLearner:
                 entropy = -jnp.mean(
                     jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
                 total = policy_loss + vf_coeff * vf_loss \
-                    - entropy_coeff * entropy
+                    - ent_coeff * entropy
                 return total, (policy_loss, vf_loss, entropy)
 
             (total, (pl, vl, ent)), grads = \
@@ -217,20 +224,26 @@ class ImpalaLearner:
                 "total_loss": total, "policy_loss": pl, "vf_loss": vl,
                 "entropy": ent}
         self._update = jax.jit(_update)
+        self._entropy_coeff = entropy_coeff
 
     def update(self, batch: Dict[str, np.ndarray],
-               num_epochs: int = 1) -> Dict[str, float]:
+               num_epochs: int = 1,
+               entropy_coeff: Optional[float] = None) -> Dict[str, float]:
         """Up to `num_epochs` v-trace passes over one batch (reference:
         impala.py:747 — num_epochs; the recorded behavior logp stays
         fixed, so later passes are just more off-policy and the
-        importance clipping absorbs it)."""
+        importance clipping absorbs it). `entropy_coeff` overrides the
+        configured coefficient (decay schedules — it's a traced scalar,
+        no recompilation)."""
         import jax.numpy as jnp
         jb = {k: jnp.asarray(v) for k, v in batch.items()
               if k != "episode_returns"}
+        coeff = jnp.float32(self._entropy_coeff if entropy_coeff is None
+                            else entropy_coeff)
         metrics = {}
         for _ in range(num_epochs):
             self.params, self.opt_state, metrics = self._update(
-                self.params, self.opt_state, jb)
+                self.params, self.opt_state, jb, coeff)
         return {k: float(v) for k, v in metrics.items()}
 
     def get_weights(self):
@@ -352,8 +365,15 @@ class Impala:
 
         self._recent_returns.extend(batch["episode_returns"].tolist())
         t1 = time.perf_counter()
+        ent = None
+        if config.entropy_coeff_final is not None and \
+                config.entropy_decay_iters > 0:
+            frac = min(1.0, self._iteration / config.entropy_decay_iters)
+            ent = config.entropy_coeff + frac * (
+                config.entropy_coeff_final - config.entropy_coeff)
         metrics = self._learner.update(batch,
-                                       num_epochs=config.num_epochs)
+                                       num_epochs=config.num_epochs,
+                                       entropy_coeff=ent)
         learn_time = time.perf_counter() - t1
         self._iteration += 1
         if self._iteration % config.broadcast_interval == 0:

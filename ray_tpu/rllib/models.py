@@ -29,6 +29,22 @@ class ActorCriticMLP(nn.Module):
         return logits, jnp.squeeze(value, -1)
 
 
+class QMLP(nn.Module):
+    """Q-network for DQN (reference: dqn's default fcnet head): ReLU MLP
+    torso, one Q value per action."""
+    num_actions: int
+    hidden: Sequence[int] = (128, 128)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+        return nn.Dense(self.num_actions, dtype=self.dtype,
+                        kernel_init=nn.initializers.orthogonal(1.0))(x)
+
+
 def sample_action(params, model, obs, rng):
     logits, value = model.apply({"params": params}, obs)
     action = jax.random.categorical(rng, logits)

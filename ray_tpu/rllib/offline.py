@@ -1,0 +1,166 @@
+"""Offline RL: episode recording via ray_tpu.data + behavior cloning
+(reference: rllib/offline/ — offline data I/O feeding offline algorithms;
+rllib/algorithms/bc/ — BC as the minimal offline learner).
+
+Episodes are recorded into a Dataset (the Data↔RLlib bridge the
+reference builds with offline_data.py over ray.data), and BC trains a
+categorical policy by supervised cross-entropy over (obs, action) — the
+acceptance test recovers a scripted expert from its own demonstrations."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def record_episodes(env_name: str, policy_fn: Callable[[np.ndarray], int],
+                    num_episodes: int = 20, seed: int = 0,
+                    parallelism: int = 2):
+    """Roll out `policy_fn` and return a Dataset of transitions
+    ({obs, action, reward, done, episode}); recording runs as remote
+    tasks (reference: offline single-agent episode recording to
+    ray.data)."""
+    import ray_tpu
+    from ray_tpu import data as rd
+
+    @ray_tpu.remote(num_cpus=1)
+    def rollout(ep_start: int, n: int):
+        import gymnasium as gym
+        env = gym.make(env_name)
+        rows = []
+        for e in range(ep_start, ep_start + n):
+            obs, _ = env.reset(seed=seed + e)
+            done = False
+            while not done:
+                action = int(policy_fn(np.asarray(obs, np.float32)))
+                next_obs, reward, terminated, truncated, _ = \
+                    env.step(action)
+                rows.append({"obs": np.asarray(obs, np.float32),
+                             "action": action,
+                             "reward": float(reward),
+                             "done": bool(terminated or truncated),
+                             "episode": e})
+                obs = next_obs
+                done = terminated or truncated
+        env.close()
+        return rows
+
+    per = max(1, -(-num_episodes // parallelism))
+    refs = [rollout.remote(i * per, min(per, num_episodes - i * per))
+            for i in range(parallelism) if i * per < num_episodes]
+    all_rows: List[dict] = []
+    for rows in ray_tpu.get(refs, timeout=600):
+        all_rows.extend(rows)
+    return rd.from_items(all_rows)
+
+
+class BCConfig:
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.lr = 1e-3
+        self.batch_size = 256
+        self.num_epochs = 20
+        self.model = {"hidden": (64, 64)}
+        self.seed = 0
+
+    def environment(self, env: str) -> "BCConfig":
+        self.env_name = env
+        return self
+
+    def training(self, **kwargs) -> "BCConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "BC":
+        return BC(self)
+
+
+class BC:
+    """Behavior cloning over a transitions Dataset (reference:
+    rllib/algorithms/bc/bc.py — the policy head of the RLModule trained
+    with negative log-likelihood of the dataset actions)."""
+
+    def __init__(self, config: BCConfig):
+        self.config = config
+        self._params = None
+        self._model = None
+
+    def fit(self, dataset) -> Dict[str, Any]:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .models import ActorCriticMLP
+
+        c = self.config
+        probe = gym.make(c.env_name)
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        rows = dataset.take_all()
+        obs = jnp.asarray(np.stack([np.asarray(r["obs"], np.float32)
+                                    for r in rows]))
+        actions = jnp.asarray(np.asarray([r["action"] for r in rows],
+                                         np.int32))
+        model = ActorCriticMLP(num_actions=num_actions,
+                               hidden=tuple(c.model.get("hidden",
+                                                        (64, 64))))
+        rng = jax.random.PRNGKey(c.seed)
+        params = model.init(rng, obs[:1])["params"]
+        tx = optax.adam(c.lr)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, batch_obs, batch_actions):
+            def loss_fn(p):
+                logits, _ = model.apply({"params": p}, batch_obs)
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(
+                    logp, batch_actions[:, None], axis=-1)[:, 0]
+                return nll.mean()
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        n = obs.shape[0]
+        key = jax.random.PRNGKey(c.seed + 1)
+        loss = jnp.inf
+        for _epoch in range(c.num_epochs):
+            key, sub = jax.random.split(key)
+            perm = jax.random.permutation(sub, n)
+            for start in range(0, n - c.batch_size + 1, c.batch_size):
+                idx = perm[start:start + c.batch_size]
+                params, opt_state, loss = step(
+                    params, opt_state, obs[idx], actions[idx])
+        self._params = params
+        self._model = model
+        return {"final_loss": float(loss), "num_transitions": int(n)}
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        assert self._params is not None, "fit() first"
+        env = gym.make(self.config.env_name)
+        model, params = self._model, self._params
+
+        @jax.jit
+        def act(obs):
+            logits, _ = model.apply({"params": params}, obs[None])
+            return jnp.argmax(logits, axis=-1)[0]
+
+        total = 0.0
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=20_000 + ep)
+            done = False
+            while not done:
+                action = int(act(jnp.asarray(obs, jnp.float32)))
+                obs, reward, terminated, truncated, _ = env.step(action)
+                total += reward
+                done = terminated or truncated
+        env.close()
+        return total / num_episodes

@@ -13,15 +13,17 @@ programs and KV caches on device, and scale-out follows mesh placement rather
 than process-per-request concurrency."""
 
 from .api import (Application, Deployment, delete, deployment,
-                  get_app_handle, get_deployment_handle, run, shutdown,
-                  start, status)
+                  get_app_handle, get_deployment_handle, get_grpc_address,
+                  get_http_address, run, shutdown, start, status)
 from .batching import batch
 from .config import AutoscalingConfig, HTTPOptions
 from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentHandle",
     "DeploymentResponse", "HTTPOptions", "batch", "delete", "deployment",
-    "get_app_handle", "get_deployment_handle", "run", "shutdown", "start",
-    "status",
+    "get_app_handle", "get_deployment_handle", "get_grpc_address",
+    "get_http_address", "get_multiplexed_model_id", "multiplexed", "run",
+    "shutdown", "start", "status",
 ]

@@ -46,7 +46,8 @@ class ServeController:
 
     async def deploy_application(self, app_name: str, route_prefix: str,
                                  ingress_key: str,
-                                 deployments: List[dict]) -> bool:
+                                 deployments: List[dict],
+                                 router: str = "pow2") -> bool:
         """deployments: [{key, definition, init_args, init_kwargs, config,
         version}]. The whole app deploys atomically (reference:
         deploy_applications → DeploymentStateManager.deploy :3220)."""
@@ -64,9 +65,11 @@ class ServeController:
                 spec.get("version") or uuid.uuid4().hex[:8])
         old = self.apps.get(app_name)
         self.apps[app_name] = {"route_prefix": route_prefix,
-                               "ingress": ingress_key}
+                               "ingress": ingress_key,
+                               "router": router}
         if old is None or old.get("route_prefix") != route_prefix or \
-                old.get("ingress") != ingress_key:
+                old.get("ingress") != ingress_key or \
+                old.get("router") != router:
             self._route_version += 1
             self._signal("routes")
         return True
@@ -94,12 +97,14 @@ class ServeController:
             for state in self.deployments.values():
                 await state.reconcile()
             await asyncio.sleep(0.05)
-        if self._proxy_handle is not None:
+        for handle in (self._proxy_handle,
+                       getattr(self, "_grpc_proxy_handle", None)):
+            if handle is None:
+                continue
             import ray_tpu
-            handle = self._proxy_handle
             try:
                 await asyncio.get_running_loop().run_in_executor(
-                    None, lambda: ray_tpu.kill(handle))
+                    None, lambda h=handle: ray_tpu.kill(h))
             except Exception:  # noqa: BLE001
                 pass
         return True
@@ -134,6 +139,37 @@ class ServeController:
             self._http_host, self._http_port = host, port
         return self._http_host, self._http_port
 
+    async def ensure_grpc_proxy(self, port: int = 0) -> Tuple[str, int]:
+        """Start (once) the gRPC ingress proxy actor (reference:
+        proxy.py:530 gRPCProxy)."""
+        self._ensure_loop()
+        if getattr(self, "_grpc_proxy_handle", None) is None:
+            host = self._http_host
+
+            def _create():
+                import ray_tpu
+                from .common import CONTROLLER_NAME
+                from .grpc_proxy import GrpcProxyActor
+                try:
+                    return ray_tpu.get_actor("SERVE_GRPC_PROXY",
+                                             namespace=SERVE_NAMESPACE)
+                except ValueError:
+                    controller = ray_tpu.get_actor(
+                        CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+                    proxy_cls = ray_tpu.remote(GrpcProxyActor)
+                    return proxy_cls.options(
+                        name="SERVE_GRPC_PROXY",
+                        namespace=SERVE_NAMESPACE, lifetime="detached",
+                        num_cpus=0, get_if_exists=True,
+                        max_concurrency=1000).remote(
+                            controller, host, port)
+            loop = asyncio.get_running_loop()
+            self._grpc_proxy_handle = await loop.run_in_executor(
+                None, _create)
+            addr = await self._grpc_proxy_handle.ready.remote()
+            self._grpc_addr = tuple(addr)
+        return self._grpc_addr
+
     # -- router/proxy-facing -----------------------------------------------
 
     async def get_replica_set(self, key: str) -> Tuple[int, List[dict]]:
@@ -143,10 +179,12 @@ class ServeController:
         version = self._replica_set_version.get(key, 0)
         return (version, state.running_replica_infos())
 
-    async def get_routes(self) -> Tuple[int, Dict[str, str]]:
-        """route_prefix -> ingress deployment key."""
+    async def get_routes(self) -> Tuple[int, Dict[str, Dict[str, str]]]:
+        """route_prefix -> {key: ingress deployment key, router: kind}."""
         return (self._route_version,
-                {info["route_prefix"]: info["ingress"]
+                {info["route_prefix"]: {
+                    "key": info["ingress"],
+                    "router": info.get("router", "pow2")}
                  for info in self.apps.values()})
 
     async def listen_for_change(self, topic: str, known_version: int,

@@ -1,0 +1,139 @@
+"""gRPC ingress proxy (reference: serve/_private/proxy.py:530 gRPCProxy —
+a grpc.aio server routing user-defined service methods to deployments).
+
+A GENERIC aio handler accepts any `/package.Service/Method` path, so no
+generated servicer classes are required proxy-side: the deployment method
+named after the final path segment receives the raw request bytes and
+returns bytes (protobuf-using deployments parse/serialize with their own
+generated classes — the same division of labor as the reference, where
+serve injects user-defined servicer functions). Routing metadata:
+
+- `application`: which app to route to (required; reference uses the
+  same metadata key)
+- `serve_multiplexed_model_id`: model-affinity hint + per-request model
+  id for @serve.multiplexed deployments
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Optional, Tuple
+
+from .router import PowerOfTwoChoicesRouter, make_router
+
+logger = logging.getLogger(__name__)
+
+
+class GrpcProxyActor:
+    """Async actor running a grpc.aio server with a generic handler."""
+
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0):
+        self._controller = controller
+        self._host = host
+        self._port = port
+        self._server = None
+        self._routes: Dict[str, str] = {}      # app name -> deployment key
+        self._route_kinds: Dict[str, str] = {}
+        self._routes_version = -1
+        self._routers: Dict[str, PowerOfTwoChoicesRouter] = {}
+        self._poll_task = None
+
+    async def ready(self) -> Tuple[str, int]:
+        if self._server is None:
+            import grpc
+
+            proxy = self
+
+            class _Generic(grpc.GenericRpcHandler):
+                def service(self, handler_call_details):
+                    method = handler_call_details.method
+
+                    async def behavior(request, context, _m=method):
+                        # must be a real coroutine FUNCTION — grpc.aio
+                        # dispatches sync behaviors to a thread pool and
+                        # would hand the serializer our coroutine object
+                        return await proxy._handle(_m, request, context)
+
+                    return grpc.unary_unary_rpc_method_handler(
+                        behavior,
+                        request_deserializer=None,   # raw bytes through
+                        response_serializer=None)
+
+            self._server = grpc.aio.server()
+            self._server.add_generic_rpc_handlers((_Generic(),))
+            self._port = self._server.add_insecure_port(
+                f"{self._host}:{self._port}")
+            await self._server.start()
+            self._poll_task = asyncio.ensure_future(self._poll_routes())
+        return (self._host, self._port)
+
+    async def _poll_routes(self):
+        while True:
+            try:
+                version, snapshot = await self._controller.\
+                    listen_for_change.remote("routes", self._routes_version)
+                if snapshot is not None:
+                    self._routes_version = version
+                    routes, kinds = {}, {}
+                    for _prefix, entry in snapshot.items():
+                        if isinstance(entry, dict):
+                            key = entry["key"]
+                            kinds[key] = entry.get("router", "pow2")
+                        else:
+                            key = entry
+                        app = key.split("#", 1)[0]
+                        routes[app] = key
+                    self._routes = routes
+                    self._route_kinds = kinds
+                    live = set(routes.values())
+                    self._routers = {k: v for k, v in self._routers.items()
+                                     if k in live}
+            except Exception:  # noqa: BLE001 — controller restarting
+                await asyncio.sleep(0.5)
+
+    def _router_for(self, key: str) -> PowerOfTwoChoicesRouter:
+        router = self._routers.get(key)
+        if router is None:
+            router = make_router(self._route_kinds.get(key, "pow2"),
+                                 key, self._controller,
+                                 refresh_ttl_s=0.25)
+            self._routers[key] = router
+        return router
+
+    async def _handle(self, method: str, request: bytes, context):
+        import grpc
+        meta = dict(context.invocation_metadata() or ())
+        app = meta.get("application")
+        if app is None and len(self._routes) == 1:
+            app = next(iter(self._routes))
+        key = self._routes.get(app or "")
+        if key is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no application {app!r}")
+        router = self._router_for(key)
+        model_id = meta.get("serve_multiplexed_model_id")
+        hint = hash(model_id) if model_id else None
+        tracked = await router.choose_async(hint)
+        if tracked is None:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "no replicas")
+        method_name = method.rsplit("/", 1)[-1]
+        kwargs = {}
+        if model_id:
+            from ..multiplex import MODEL_ID_KWARG
+            kwargs[MODEL_ID_KWARG] = model_id
+        router._inc(tracked.actor_name)
+        try:
+            result = await tracked.handle.handle_request.remote(
+                method_name, (bytes(request),), kwargs)
+        except Exception as e:  # noqa: BLE001
+            router.evict(tracked.actor_name)
+            await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            router._dec(tracked.actor_name)
+        if isinstance(result, bytes):
+            return result
+        if isinstance(result, str):
+            return result.encode()
+        from ..._internal import serialization
+        return serialization.dumps(result)

@@ -17,7 +17,7 @@ import urllib.parse
 from typing import Any, Dict, Optional, Tuple
 
 from .common import ReplicaInfo, SERVE_NAMESPACE
-from .router import PowerOfTwoChoicesRouter
+from .router import PowerOfTwoChoicesRouter, make_router
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +57,7 @@ class ProxyActor:
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._routes: Dict[str, str] = {}  # prefix -> deployment key
+        self._route_kinds: Dict[str, str] = {}  # key -> router kind
         self._routes_version = -1
         self._routers: Dict[str, PowerOfTwoChoicesRouter] = {}
         self._poll_task: Optional[asyncio.Task] = None
@@ -79,7 +80,16 @@ class ProxyActor:
                     listen_for_change.remote("routes", self._routes_version)
                 if snapshot is not None:
                     self._routes_version = version
-                    self._routes = dict(snapshot)
+                    routes, kinds = {}, {}
+                    for prefix, entry in snapshot.items():
+                        if isinstance(entry, dict):
+                            routes[prefix] = entry["key"]
+                            kinds[entry["key"]] = entry.get(
+                                "router", "pow2")
+                        else:
+                            routes[prefix] = entry
+                    self._routes = routes
+                    self._route_kinds = kinds
                     live = set(self._routes.values())
                     self._routers = {k: v for k, v in self._routers.items()
                                      if k in live}
@@ -89,8 +99,9 @@ class ProxyActor:
     def _router_for(self, key: str) -> PowerOfTwoChoicesRouter:
         router = self._routers.get(key)
         if router is None:
-            router = PowerOfTwoChoicesRouter(key, self._controller,
-                                             refresh_ttl_s=0.25)
+            router = make_router(self._route_kinds.get(key, "pow2"),
+                                 key, self._controller,
+                                 refresh_ttl_s=0.25)
             self._routers[key] = router
         return router
 
@@ -159,23 +170,97 @@ class ProxyActor:
             await self._respond(writer, 404, b"no route", "text/plain")
             return
         router = self._router_for(key)
-        tracked = await router.choose_async()
+        from ..multiplex import MODEL_ID_HEADER, MODEL_ID_KWARG
+        model_id = request.headers.get(MODEL_ID_HEADER)
+        hint = None
+        if model_id:
+            # model affinity: same-model requests stick to a replica that
+            # already loaded it (reference: multiplex-aware routing)
+            hint = hash(model_id)
+        elif self._route_kinds.get(key) == "prefix":
+            hint = _prefix_hint(request)
+        tracked = await router.choose_async(hint)
         if tracked is None:
             await self._respond(writer, 503, b"no replicas", "text/plain")
             return
+        kwargs = {MODEL_ID_KWARG: model_id} if model_id else {}
         router._inc(tracked.actor_name)
+        streamed = False
         try:
             result = await tracked.handle.handle_request.remote(
-                "__call__", (request,), {})
+                "__call__", (request,), kwargs)
+            if isinstance(result, dict) and "__rtpu_stream__" in result:
+                streamed = True
+                await self._relay_stream(
+                    writer, tracked, result["__rtpu_stream__"])
+                return
         except Exception as e:  # noqa: BLE001
             router.evict(tracked.actor_name)
             logger.warning("replica %s failed: %s", tracked.actor_name, e)
-            await self._respond(writer, 500, str(e).encode(), "text/plain")
+            if not streamed:
+                await self._respond(writer, 500, str(e).encode(),
+                                    "text/plain")
             return
         finally:
             router._dec(tracked.actor_name)
         status, payload, ctype = _encode_response(result)
         await self._respond(writer, status, payload, ctype)
+
+    async def _relay_stream(self, writer: asyncio.StreamWriter, tracked,
+                            stream_id: str):
+        """Relay a replica token stream as chunked HTTP: long-poll
+        `stream_next` on the SAME replica (its engine owns the stream
+        buffer) and write each batch as one chunk of JSON lines. A client
+        disconnect cancels the generation on the replica."""
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/x-ndjson\r\n"
+                     b"Transfer-Encoding: chunked\r\n\r\n")
+        try:
+            while True:
+                batch = await tracked.handle.handle_request.remote(
+                    "stream_next", (stream_id,), {})
+                if "data" in batch:
+                    # replica pre-formatted the wire bytes (e.g. SSE
+                    # `data:` events from the OpenAI-compat server)
+                    payload = batch["data"].encode()
+                elif batch.get("tokens") or batch.get("error"):
+                    payload = json.dumps(batch).encode() + b"\n"
+                else:
+                    payload = b""
+                if payload:
+                    writer.write(
+                        f"{len(payload):x}\r\n".encode() + payload +
+                        b"\r\n")
+                    await writer.drain()
+                if batch["done"]:
+                    break
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            # client went away mid-stream: abort the generation so its
+            # pages free immediately (reference: vLLM abort on disconnect).
+            # Swallowed — a dropped CLIENT must not evict a healthy
+            # replica; the outer loop closes the dead socket.
+            try:
+                await tracked.handle.handle_request.remote(
+                    "cancel_stream", (stream_id,), {})
+            except Exception:  # noqa: BLE001
+                pass
+        except Exception:
+            # REPLICA failed mid-stream: the chunked body can't be
+            # completed and a 500 can't follow a 200 — close the socket
+            # so the client sees truncation instead of hanging, and
+            # re-raise so _dispatch evicts the replica.
+            try:
+                await tracked.handle.handle_request.remote(
+                    "cancel_stream", (stream_id,), {})
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            raise
 
     def _match_route(self, path: str) -> Optional[str]:
         best = None
@@ -197,6 +282,26 @@ class ProxyActor:
             f"Content-Length: {len(body)}\r\n"
             f"\r\n".encode("latin1") + body)
         await writer.drain()
+
+
+def _prefix_hint(request: Request) -> Optional[int]:
+    """Hash of the prompt's leading tokens/chars for prefix-affinity
+    routing (reference: llm request_router computes prefix-tree matches;
+    a leading-window hash is the cheap proxy-side equivalent)."""
+    try:
+        body = request.json()
+    except Exception:  # noqa: BLE001
+        return None
+    prompt = body.get("prompt_tokens") or body.get("prompt")
+    if prompt is None and isinstance(body.get("messages"), list):
+        # OpenAI chat shape: first (system) message carries the prefix
+        first = body["messages"][0] if body["messages"] else {}
+        prompt = first.get("content")
+    if isinstance(prompt, list):
+        return hash(tuple(prompt[:64]))
+    if isinstance(prompt, str):
+        return hash(prompt[:256])
+    return None
 
 
 def _encode_response(result: Any) -> Tuple[int, bytes, str]:

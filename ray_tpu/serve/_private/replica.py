@@ -63,6 +63,13 @@ class Replica:
         if pending is not None:
             self._pending_reconfigure = None
             await pending
+        # model-multiplexed requests smuggle their model id in a reserved
+        # kwarg; expose it via the contextvar get_multiplexed_model_id()
+        # reads (reference: serve/multiplex.py request context)
+        from ..multiplex import MODEL_ID_KWARG, _set_current_model_id
+        model_id = kwargs.pop(MODEL_ID_KWARG, None)
+        if model_id is not None:
+            _set_current_model_id(model_id)
         self._ongoing += 1
         try:
             target = self._resolve(method_name)

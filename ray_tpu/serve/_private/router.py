@@ -89,25 +89,26 @@ class PowerOfTwoChoicesRouter:
 
     # -- choice -----------------------------------------------------------
 
-    def choose(self) -> Optional[object]:
+    def choose(self, hint: Optional[int] = None) -> Optional[object]:
         """Return a tracked replica handle, or None if the deployment
         currently has no running replicas."""
         self._maybe_refresh()
-        picked = self._pick()
+        picked = self._pick(hint)
         if picked is None:
             self._maybe_refresh(force=True)
-            picked = self._pick()
+            picked = self._pick(hint)
         return picked
 
-    async def choose_async(self) -> Optional[object]:
+    async def choose_async(self, hint: Optional[int] = None
+                           ) -> Optional[object]:
         await self._maybe_refresh_async()
-        picked = self._pick()
+        picked = self._pick(hint)
         if picked is None:
             await self._maybe_refresh_async(force=True)
-            picked = self._pick()
+            picked = self._pick(hint)
         return picked
 
-    def _pick(self) -> Optional["_Tracked"]:
+    def _pick(self, hint: Optional[int] = None) -> Optional["_Tracked"]:
         with self._lock:
             candidates = list(self._replicas)
         if not candidates:
@@ -149,6 +150,63 @@ class PowerOfTwoChoicesRouter:
                               if r.actor_name != actor_name]
             self._handles.pop(actor_name, None)
             self._last_refresh = 0.0
+
+
+class PrefixAwareRouter(PowerOfTwoChoicesRouter):
+    """Prompt-prefix affinity router (reference:
+    llm/_internal/serve/request_router/ prefix-aware request router).
+
+    Requests carrying the same prompt prefix land on the same replica so
+    its paged-KV prefix cache keeps hitting (shared system prompts are
+    stored once per replica, not once per replica-per-request). The hint
+    is a hash of the prompt's leading tokens; affinity yields to load —
+    a hinted replica more than `slack` requests busier than the least
+    loaded one is rerouted (and the map repointed) so one hot prefix
+    cannot starve the pool."""
+
+    AFFINITY_CAP = 4096
+    SLACK = 4
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._affinity: Dict[int, str] = {}
+
+    def _pick(self, hint: Optional[int] = None) -> Optional["_Tracked"]:
+        if hint is None:
+            return super()._pick()
+        with self._lock:
+            candidates = list(self._replicas)
+            if not candidates:
+                return None
+            live = {r.actor_name for r in candidates}
+            target = self._affinity.get(hint)
+            if target is not None and target in live:
+                least = min(self._inflight.get(r.actor_name, 0)
+                            for r in candidates)
+                if self._inflight.get(target, 0) <= least + self.SLACK:
+                    info = next(r for r in candidates
+                                if r.actor_name == target)
+                    pick = info
+                else:
+                    target = None
+            if target is None or target not in live:
+                pick = min(candidates,
+                           key=lambda r: self._inflight.get(
+                               r.actor_name, 0))
+                self._affinity[hint] = pick.actor_name
+                if len(self._affinity) > self.AFFINITY_CAP:
+                    # drop ~oldest half (insertion-ordered dict)
+                    for k in list(self._affinity)[
+                            :self.AFFINITY_CAP // 2]:
+                        self._affinity.pop(k, None)
+        return self._handle_for(pick)
+
+
+def make_router(kind: str, deployment_key: str, controller_handle,
+                **kwargs) -> PowerOfTwoChoicesRouter:
+    cls = PrefixAwareRouter if kind == "prefix" \
+        else PowerOfTwoChoicesRouter
+    return cls(deployment_key, controller_handle, **kwargs)
 
 
 class _Tracked:

@@ -157,16 +157,21 @@ def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/",
         http_options: Optional[HTTPOptions] = None,
         wait_for_ready_timeout_s: float = 60.0,
+        request_router: str = "pow2",
         _blocking: bool = True) -> DeploymentHandle:
     """Deploy an application and wait until healthy
-    (reference: serve.run api.py:685)."""
+    (reference: serve.run api.py:685). `request_router` picks the proxy's
+    replica-choice policy for the app: "pow2" (default) or "prefix"
+    (prompt-prefix affinity for LLM apps, reference:
+    llm/_internal/serve/request_router/)."""
     import ray_tpu
     controller = start(http_options)
     specs, visit = _collect_graph(app)
     visit(app, name)
     ingress_key = f"{name}#{app.deployment.name}"
     ray_tpu.get(controller.deploy_application.remote(
-        name, route_prefix or "/", ingress_key, specs), timeout=60)
+        name, route_prefix or "/", ingress_key, specs,
+        router=request_router), timeout=60)
     if route_prefix is not None:
         ray_tpu.get(controller.ensure_proxy.remote(), timeout=60)
     if _blocking:
@@ -234,7 +239,8 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     controller = _get_controller()
     _version, routes = ray_tpu.get(controller.get_routes.remote(),
                                    timeout=30)
-    for _prefix, key in routes.items():
+    for _prefix, entry in routes.items():
+        key = entry["key"] if isinstance(entry, dict) else entry
         app, dep = key.split("#", 1)
         if app == name:
             return DeploymentHandle(dep, app)
@@ -247,3 +253,13 @@ def get_http_address() -> str:
     controller = _get_controller()
     host, port = ray_tpu.get(controller.ensure_proxy.remote(), timeout=60)
     return f"http://{host}:{port}"
+
+
+def get_grpc_address() -> str:
+    """host:port of the gRPC ingress proxy, starting it if needed
+    (reference: gRPCProxy, serve/_private/proxy.py:530)."""
+    import ray_tpu
+    controller = _get_controller()
+    host, port = ray_tpu.get(controller.ensure_grpc_proxy.remote(),
+                             timeout=60)
+    return f"{host}:{port}"

@@ -33,17 +33,21 @@ class DeploymentResponse:
 
     # -- sync path ---------------------------------------------------------
 
+    def _hint(self):
+        model_id = self._handle._multiplexed_model_id
+        return hash(model_id) if model_id else None
+
     def _dispatch_sync(self, timeout_s: float):
         router = self._handle._get_router()
         deadline = time.monotonic() + timeout_s
-        tracked = router.choose()
+        tracked = router.choose(self._hint())
         while tracked is None:
             if time.monotonic() > deadline:
                 raise RuntimeError(
                     f"deployment {self._handle.deployment_name!r} has no "
                     "running replicas")
             time.sleep(0.2)
-            tracked = router.choose()
+            tracked = router.choose(self._hint())
         self._issue(tracked)
 
     def _issue(self, tracked):
@@ -80,14 +84,14 @@ class DeploymentResponse:
         if self._ref is None:
             router = await self._handle._get_router_async()
             deadline = time.monotonic() + 60.0
-            tracked = await router.choose_async()
+            tracked = await router.choose_async(self._hint())
             while tracked is None:
                 if time.monotonic() > deadline:
                     raise RuntimeError(
                         f"deployment {self._handle.deployment_name!r} has "
                         "no running replicas")
                 await asyncio.sleep(0.2)
-                tracked = await router.choose_async()
+                tracked = await router.choose_async(self._hint())
             self._issue(tracked)
         try:
             return await self._ref
@@ -104,10 +108,12 @@ class DeploymentResponse:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: Optional[str] = None):
+                 method_name: Optional[str] = None,
+                 multiplexed_model_id: Optional[str] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
+        self._multiplexed_model_id = multiplexed_model_id
         self._router: Optional[PowerOfTwoChoicesRouter] = None
 
     # -- plumbing ----------------------------------------------------------
@@ -140,27 +146,36 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self._method_name))
+                (self.deployment_name, self.app_name, self._method_name,
+                 self._multiplexed_model_id))
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
-        handle = DeploymentHandle(self.deployment_name, self.app_name,
-                                  method_name=name)
+        handle = DeploymentHandle(
+            self.deployment_name, self.app_name, method_name=name,
+            multiplexed_model_id=self._multiplexed_model_id)
         handle._router = self._router
         return handle
 
-    def options(self, method_name: Optional[str] = None
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
                 ) -> "DeploymentHandle":
         handle = DeploymentHandle(
             self.deployment_name, self.app_name,
-            method_name=method_name or self._method_name)
+            method_name=method_name or self._method_name,
+            multiplexed_model_id=multiplexed_model_id
+            or self._multiplexed_model_id)
         handle._router = self._router
         return handle
 
     # -- calls -------------------------------------------------------------
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        if self._multiplexed_model_id:
+            from .multiplex import MODEL_ID_KWARG
+            kwargs = dict(kwargs)
+            kwargs[MODEL_ID_KWARG] = self._multiplexed_model_id
         response = DeploymentResponse(
             self, self._method_name or "__call__", args, kwargs)
         # Sync callers (drivers/threads) dispatch eagerly so N remote()
@@ -171,7 +186,7 @@ class DeploymentHandle:
         try:
             asyncio.get_running_loop()
         except RuntimeError:
-            tracked = self._get_router().choose()
+            tracked = self._get_router().choose(response._hint())
             if tracked is not None:
                 response._issue(tracked)
         return response

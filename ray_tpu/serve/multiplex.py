@@ -1,0 +1,126 @@
+"""Model multiplexing: many models per replica pool with LRU swap
+(reference: serve/multiplex.py _ModelMultiplexWrapper +
+serve/api.py @serve.multiplexed / serve.get_multiplexed_model_id).
+
+A replica decorated with @serve.multiplexed loads models on demand,
+keeps up to `max_num_models_per_replica` resident (LRU eviction), and
+requests carry their model id out-of-band (HTTP header
+`serve_multiplexed_model_id`, or `handle.options(multiplexed_model_id=)`).
+The router pins same-model requests to the same replica via the same
+affinity machinery as prefix routing, so a hot model stays loaded."""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rtpu_serve_multiplexed_model_id", default="")
+
+#: reserved kwarg smuggling the model id through handle_request
+MODEL_ID_KWARG = "__rtpu_model_id__"
+#: HTTP header carrying the model id (same name as the reference)
+MODEL_ID_HEADER = "serve_multiplexed_model_id"
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id of the request being handled (reference:
+    serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_current_model_id(model_id: str):
+    _current_model_id.set(model_id)
+
+
+class _ModelMultiplexWrapper:
+    """Per-replica LRU cache of loaded models."""
+
+    def __init__(self, load_fn: Callable, owner: Any,
+                 max_models: int):
+        self._load_fn = load_fn
+        self._owner = owner
+        self._max = max_models
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._loading: dict = {}  # model_id -> asyncio.Future
+
+    async def load_model(self, model_id: str) -> Any:
+        if model_id in self._models:
+            self._models.move_to_end(model_id)
+            return self._models[model_id]
+        pending = self._loading.get(model_id)
+        if pending is not None:
+            return await pending
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._loading[model_id] = fut
+        try:
+            if self._owner is not None:
+                out = self._load_fn(self._owner, model_id)
+            else:
+                out = self._load_fn(model_id)
+            if inspect.isawaitable(out):
+                out = await out
+            self._models[model_id] = out
+            while len(self._models) > self._max:
+                evicted_id, evicted = self._models.popitem(last=False)
+                await self._release(evicted)
+            fut.set_result(out)
+            return out
+        except Exception as e:
+            fut.set_exception(e)
+            raise
+        finally:
+            self._loading.pop(model_id, None)
+            if not fut.done():
+                fut.cancel()
+
+    async def _release(self, model):
+        # models may define __del__ or an async release hook
+        release = getattr(model, "release", None)
+        if release is not None:
+            out = release()
+            if inspect.isawaitable(out):
+                await out
+
+    def model_ids(self):
+        return list(self._models)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for the replica's model-loader method (reference:
+    serve/api.py multiplexed). The decorated coroutine receives a
+    model_id and returns the loaded model; calls are cached per replica
+    with LRU eviction.
+
+        class Server:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get_model(self, model_id: str): ...
+            async def __call__(self, request):
+                model = await self.get_model(
+                    serve.get_multiplexed_model_id())
+    """
+    def wrap(fn):
+        attr = f"__rtpu_multiplex_{fn.__name__}"
+
+        async def wrapper(self, model_id: Optional[str] = None):
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            mux = getattr(self, attr, None)
+            if mux is None:
+                mux = _ModelMultiplexWrapper(
+                    fn, self, max_num_models_per_replica)
+                setattr(self, attr, mux)
+            return await mux.load_model(model_id)
+
+        wrapper.__rtpu_multiplexed__ = True  # type: ignore
+        wrapper.__wrapped__ = fn  # type: ignore
+        return wrapper
+
+    if func is not None:
+        return wrap(func)
+    return wrap

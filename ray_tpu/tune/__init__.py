@@ -17,12 +17,14 @@ from .sample import (choice, grid_search, loguniform, qrandint, quniform,
 from .schedulers import (AsyncHyperBandScheduler, ASHAScheduler,
                          FIFOScheduler, PopulationBasedTraining)
 from .search import BasicVariantGenerator
+from .suggest import TPESearcher
 from .tune_context import get_checkpoint, get_context, report
 from .tuner import TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
     "FIFOScheduler", "PopulationBasedTraining", "Result", "ResultGrid",
+    "TPESearcher",
     "TuneConfig", "Tuner", "choice", "get_checkpoint", "get_context",
     "grid_search", "loguniform", "qrandint", "quniform", "randint", "randn",
     "report", "uniform",

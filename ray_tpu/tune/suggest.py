@@ -1,0 +1,163 @@
+"""Model-based search: a native TPE searcher
+(reference: tune/search/ — optuna/hyperopt/bayesopt adapters; the
+reference delegates to external libraries, none of which fit a
+zero-dependency TPU image, so this implements the TPE algorithm
+[Bergstra et al. 2011, the same one hyperopt/optuna default to]
+directly: split observations into good/bad quantiles, model each with a
+kernel density, and propose the candidate maximizing l(x)/g(x)).
+
+Sequential protocol (Tuner.fit drives it lazily):
+    config = searcher.suggest(param_space)
+    ...run trial...
+    searcher.observe(config, score)
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from .sample import (Categorical, Domain, LogUniform, QRandint, QUniform,
+                     Randint, Randn, Uniform)
+from .search import _find_special, _set_path, _deepcopy_space
+
+
+class TPESearcher:
+    """Tree-structured Parzen Estimator over the tune search space.
+
+    mode: "max" (default) treats higher scores as better.
+    n_initial: random startup trials before the model kicks in.
+    gamma: fraction of observations modeled as "good".
+    n_candidates: samples drawn from l(x) per suggestion."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, explore_prob: float = 0.15,
+                 seed: int = 0):
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        # prior-exploration rate: TPE's good-set KDE is self-reinforcing
+        # (it proposes where it already sampled); mixing in prior draws
+        # keeps it from locking onto an early local basin — the same role
+        # as hyperopt's prior-weighted KDE component
+        self.explore_prob = explore_prob
+        self._rng = random.Random(seed)
+        # path -> list[(value, score)]
+        self._obs: Dict[Tuple[str, ...], List[Tuple[Any, float]]] = {}
+        self._num_observed = 0
+
+    # -- protocol ----------------------------------------------------------
+
+    def suggest(self, param_space: Dict[str, Any]) -> Dict[str, Any]:
+        config = _deepcopy_space(param_space)
+        for path, spec in list(_find_special(param_space)):
+            if isinstance(spec, dict):  # grid_search inside TPE: sample
+                value = self._rng.choice(spec["grid_search"])
+            elif isinstance(spec, Domain):
+                value = self._suggest_dim(path, spec)
+            else:
+                continue
+            _set_path(config, path, value)
+        return config
+
+    def observe(self, config: Dict[str, Any], score: float):
+        if score != score:  # NaN
+            return
+        if self.mode == "min":
+            score = -score
+        self._num_observed += 1
+        for path in self._paths_of(config):
+            node = config
+            for key in path:
+                node = node[key]
+            self._obs.setdefault(path, []).append((node, score))
+
+    def _paths_of(self, config, path=()):
+        out = []
+        for key, value in config.items():
+            p = path + (key,)
+            if isinstance(value, dict):
+                out.extend(self._paths_of(value, p))
+            else:
+                out.append(p)
+        return out
+
+    # -- per-dimension TPE -------------------------------------------------
+
+    def _suggest_dim(self, path: Tuple[str, ...], domain: Domain):
+        obs = self._obs.get(path, [])
+        if self._num_observed < self.n_initial or len(obs) < 4 or \
+                self._rng.random() < self.explore_prob:
+            return domain.sample(self._rng)
+        ranked = sorted(obs, key=lambda vs: vs[1], reverse=True)
+        n_good = max(2, int(math.ceil(self.gamma * len(ranked))))
+        good = [v for v, _s in ranked[:n_good]]
+        bad = [v for v, _s in ranked[n_good:]] or good
+        if isinstance(domain, Categorical):
+            return self._categorical(domain, good)
+        return self._numeric(domain, good, bad)
+
+    def _categorical(self, domain: Categorical, good: List[Any]):
+        # smoothed counts over the good set
+        weights = []
+        for cat in domain.categories:
+            weights.append(1.0 + sum(1 for g in good if g == cat))
+        total = sum(weights)
+        r = self._rng.random() * total
+        acc = 0.0
+        for cat, w in zip(domain.categories, weights):
+            acc += w
+            if r <= acc:
+                return cat
+        return domain.categories[-1]
+
+    def _transform(self, domain: Domain, value: float) -> float:
+        if isinstance(domain, LogUniform):
+            return math.log(value)
+        return float(value)
+
+    def _untransform(self, domain: Domain, x: float):
+        if isinstance(domain, LogUniform):
+            value = math.exp(x)
+            lo, hi = math.exp(domain.log_low), math.exp(domain.log_high)
+            return min(max(value, lo), hi)
+        if isinstance(domain, Uniform):
+            return min(max(x, domain.low), domain.high)
+        if isinstance(domain, QUniform):
+            x = min(max(x, domain.low), domain.high)
+            return round(x / domain.q) * domain.q
+        if isinstance(domain, Randint):
+            return int(min(max(round(x), domain.low), domain.high - 1))
+        if isinstance(domain, QRandint):
+            x = min(max(x, domain.low), domain.high - 1)
+            return int((int(x) // domain.q) * domain.q)
+        if isinstance(domain, Randn):
+            return x
+        return x
+
+    def _numeric(self, domain: Domain, good: List[Any], bad: List[Any]):
+        xs_good = [self._transform(domain, v) for v in good]
+        xs_bad = [self._transform(domain, v) for v in bad]
+        spread = max(xs_good + xs_bad) - min(xs_good + xs_bad) or 1.0
+        bw_good = max(spread / max(len(xs_good), 1), 1e-12)
+        bw_bad = max(spread / max(len(xs_bad), 1), 1e-12)
+
+        def kde(x, centers, bw):
+            total = 0.0
+            for c in centers:
+                z = (x - c) / bw
+                total += math.exp(-0.5 * z * z)
+            return total / (len(centers) * bw) + 1e-12
+
+        best_x, best_ratio = None, -1.0
+        for _ in range(self.n_candidates):
+            center = self._rng.choice(xs_good)
+            x = self._rng.gauss(center, bw_good)
+            ratio = kde(x, xs_good, bw_good) / kde(x, xs_bad, bw_bad)
+            if ratio > best_ratio:
+                best_ratio, best_x = ratio, x
+        return self._untransform(domain, best_x)

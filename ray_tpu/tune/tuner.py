@@ -123,6 +123,12 @@ class Tuner:
                         t.reports.append(rec["last_result"])
                 t.last_checkpoint = rec.get("checkpoint")
                 trials.append(t)
+        elif hasattr(searcher, "suggest"):
+            # Sequential model-based searcher (TPESearcher): trials are
+            # suggested lazily as capacity frees and results feed back
+            # via searcher.observe (reference: SearchGenerator wrapping
+            # optuna/hyperopt-style suggesters).
+            trials = []
         else:
             configs = searcher.generate(self.param_space, tc.num_samples)
             trials = [_Trial(f"trial_{i:05d}", config)
@@ -157,15 +163,35 @@ class Tuner:
                     pass
                 trial.actor = None
             scheduler.on_trial_complete(trial.id)
+            # feed model-based searchers (TPE) the final score
+            if (status == TERMINATED and hasattr(searcher, "observe")
+                    and trial.reports and tc.metric):
+                score = trial.reports[-1].get(tc.metric)
+                if isinstance(score, (int, float)):
+                    searcher.observe(trial.config, float(score))
+
+        sequential = hasattr(searcher, "suggest") and \
+            self._restored_trials is None
+        if sequential:
+            max_concurrent = tc.max_concurrent_trials or 2
 
         # ---- event loop (reference: TuneController.step :666) ----
         while True:
             running = [t for t in trials if t.status == RUNNING]
             pending = [t for t in trials if t.status == PENDING]
+            if sequential:
+                while (len(trials) < tc.num_samples and
+                       len(running) + len(pending) < max_concurrent):
+                    trial = _Trial(f"trial_{len(trials):05d}",
+                                   searcher.suggest(self.param_space))
+                    trials.append(trial)
+                    pending.append(trial)
             for trial in pending[:max(0, max_concurrent - len(running))]:
                 start_trial(trial)
             running = [t for t in trials if t.status == RUNNING]
-            if not running and not pending:
+            pending = [t for t in trials if t.status == PENDING]
+            if not running and not pending and \
+                    (not sequential or len(trials) >= tc.num_samples):
                 break
             if deadline and time.monotonic() > deadline:
                 for t in running:

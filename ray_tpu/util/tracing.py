@@ -1,0 +1,79 @@
+"""Distributed trace propagation around task/actor calls
+(reference: python/ray/util/tracing/tracing_helper.py:54-88 — opt-in
+otel wrappers injecting span context into remote calls; here the span
+context is a first-class TaskSpec field and spans land in the task-event
+plane, so the GCS timeline assembles cross-process traces without an
+otel dependency — export adapters can translate).
+
+Usage:
+    with trace_span("ingest"):
+        ref = f.remote(x)          # child span crosses the process hop
+Inside f, get_trace_context() returns (trace_id, span_id) and further
+remote calls keep extending the same trace."""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Iterator, Optional, Tuple
+
+_current: contextvars.ContextVar[Optional[Tuple[str, str]]] = \
+    contextvars.ContextVar("rtpu_trace_ctx", default=None)
+
+
+def _new_id(nbytes: int = 8) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def get_trace_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the active span, or None."""
+    return _current.get()
+
+
+def set_trace_context(ctx: Optional[Tuple[str, str]]):
+    _current.set(ctx)
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[Tuple[str, str]]:
+    """Open a span: child of the active one, or a new trace root.
+    Remote calls made inside propagate the context to the executing
+    worker (spec.trace_context -> worker-side set_trace_context)."""
+    parent = _current.get()
+    trace_id = parent[0] if parent else _new_id(16)
+    span_id = _new_id()
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    try:
+        yield (trace_id, span_id)
+    finally:
+        _current.reset(token)
+        _record(name, trace_id, span_id,
+                parent[1] if parent else None, start, time.time())
+
+
+def _record(name: str, trace_id: str, span_id: str,
+            parent_span: Optional[str], start: float, end: float):
+    """Span -> task-event plane (best-effort; traces are observability)."""
+    try:
+        from .._internal.core_worker import try_get_core_worker
+        worker = try_get_core_worker()
+        if worker is None:
+            return
+        worker.loop_post(worker.gcs.call(
+            "add_task_events", events=[{
+                "event": "SPAN", "name": name, "trace_id": trace_id,
+                "span_id": span_id, "parent_span_id": parent_span,
+                "ts": start, "duration_s": end - start,
+                "pid": os.getpid(),
+            }]))
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def child_context_for_submit() -> Optional[Tuple[str, str]]:
+    """Context to stamp on an outgoing TaskSpec (the worker executing the
+    task becomes a child span of the caller's active span)."""
+    return _current.get()

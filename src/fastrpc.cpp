@@ -69,6 +69,7 @@ struct Conn {
   // read side (epoll thread only)
   std::string in;
   size_t in_off = 0;
+  bool parked = false;  // EPOLLIN deregistered: inq over high-water
   bool closed = false;
 };
 
@@ -94,7 +95,8 @@ struct Core {
   std::deque<InEvent> inq;
   size_t inq_bytes = 0;
   bool notified = false;
-  bool paused = false;  // EPOLLIN parked due to inq high-water
+  std::atomic<bool> any_parked{false};  // some conns have EPOLLIN parked
+  std::atomic<bool> resume{false};      // python drained below low-water
 };
 
 Core* g_core = nullptr;
@@ -129,7 +131,8 @@ void push_event(Core* c, int64_t conn, uint8_t kind, std::string data) {
 
 void epoll_mod(Core* c, Conn* conn) {
   epoll_event ev{};
-  ev.events = EPOLLIN | (conn->want_write ? EPOLLOUT : 0);
+  ev.events = (conn->parked ? 0 : EPOLLIN) |
+              (conn->want_write ? EPOLLOUT : 0);
   ev.data.u64 = static_cast<uint64_t>(conn->id);
   epoll_ctl(c->epfd, EPOLL_CTL_MOD, conn->fd, &ev);
 }
@@ -305,6 +308,21 @@ void io_loop(Core* c) {
           c->dirty.clear();
         }
         for (Conn* conn : flush) handle_write(c, conn);
+        if (c->resume.exchange(false)) {
+          // Rearm every parked conn; level-triggered EPOLLIN re-fires
+          // immediately for any data that arrived while parked.
+          std::vector<Conn*> parked;
+          {
+            std::lock_guard<std::mutex> lk(c->mu);
+            for (auto& kv : c->conns)
+              if (kv.second->parked) parked.push_back(kv.second);
+          }
+          for (Conn* conn : parked) {
+            conn->parked = false;
+            epoll_mod(c, conn);
+          }
+          c->any_parked.store(false);
+        }
         continue;
       }
       Conn* conn = nullptr;
@@ -323,7 +341,37 @@ void io_loop(Core* c) {
         continue;
       }
       if (evs[i].events & EPOLLOUT) handle_write(c, conn);
-      if (evs[i].events & EPOLLIN) handle_read(c, conn);
+      if (evs[i].events & EPOLLIN) {
+        bool over;
+        {
+          std::lock_guard<std::mutex> lk(c->in_mu);
+          over = c->inq_bytes > kInHighWater;
+        }
+        if (over) {
+          // Park this conn's read side instead of growing the inbound
+          // queue without bound: level-triggered epoll re-arms it the
+          // moment Python drains below low-water (frpc_recv sets
+          // `resume`, handled at the wakefd branch above).
+          conn->parked = true;
+          c->any_parked.store(true);
+          epoll_mod(c, conn);
+          // Re-check: if Python drained past low-water between the
+          // check and the park (it couldn't see any_parked yet), no
+          // resume will ever fire — unpark immediately.
+          bool drained;
+          {
+            std::lock_guard<std::mutex> lk(c->in_mu);
+            drained = c->inq_bytes < kInHighWater / 2;
+          }
+          if (drained) {
+            conn->parked = false;
+            epoll_mod(c, conn);
+            handle_read(c, conn);
+          }
+        } else {
+          handle_read(c, conn);
+        }
+      }
     }
   }
 }
@@ -493,6 +541,13 @@ int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
     c->notified = false;
     uint64_t buf;
     ssize_t r = read(c->notifyfd, &buf, 8);
+    (void)r;
+  }
+  if (c->any_parked.load() && c->inq_bytes < kInHighWater / 2 &&
+      !c->resume.load()) {
+    c->resume.store(true);
+    uint64_t one = 1;
+    ssize_t r = write(c->wakefd, &one, 8);
     (void)r;
   }
   return n;

@@ -127,3 +127,38 @@ def test_strict_spread_pg(cluster):
     entry = next(p for p in table if p["pg_id"] == pg.id)
     nodes = entry["bundle_nodes"]
     assert len(set(nodes)) == 3
+
+
+@pytest.mark.timeout_s(420)
+def test_eight_raylet_cluster(cluster):
+    """An 8-raylet cluster (reference: release/benchmarks run 64+ nodes;
+    multi-node semantics on one machine via cluster_utils): the view
+    holds 8 healthy raylets, SPREAD tasks land across nodes, and
+    node-pinned actors answer from every non-head raylet."""
+    cluster.connect()
+    for i in range(7):  # + head raylet = 8
+        cluster.add_node(num_cpus=1, resources={f"n{i}": 4})
+    cluster.wait_for_nodes()
+    alive = [n for n in ray_tpu.nodes() if n["state"] == "ALIVE"]
+    assert len(alive) == 8, [n["state"] for n in ray_tpu.nodes()]
+
+    @ray_tpu.remote(num_cpus=0.1)
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    refs = [where.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(64)]
+    seen = set(ray_tpu.get(refs, timeout=300))
+    assert len(seen) >= 6, f"only {len(seen)} distinct nodes ran tasks"
+
+    @ray_tpu.remote(num_cpus=0.1)
+    class Pin:
+        def node(self):
+            return ray_tpu.get_runtime_context().node_id
+
+    actors = [Pin.options(resources={f"n{i}": 1}).remote()
+              for i in range(7)]
+    homes = ray_tpu.get([a.node.remote() for a in actors], timeout=300)
+    assert len(set(homes)) == 7
+    for a in actors:
+        ray_tpu.kill(a)

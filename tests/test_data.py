@@ -130,3 +130,106 @@ def test_union_and_zip(data_cluster):
     assert a.union(b).count() == 3
     z = rd.from_items([{"l": 1}]).zip(rd.from_items([{"r": 2}]))
     assert z.take_all() == [{"l": 1, "r": 2}]
+
+
+# ---------------------------------------------------------------------------
+# logical-plan optimizer + join/aggregate (reference:
+# _internal/logical/interfaces/optimizer.py:24 rules,
+# execution/operators/hash_shuffle.py:392,1034 join/aggregate,
+# execution/resource_manager.py budget)
+# ---------------------------------------------------------------------------
+
+def test_optimizer_map_fusion(data_cluster):
+    """Three chained map-like stages fuse into ONE physical stage."""
+    ds = (rd.range(50)
+          .map(lambda r: {"id": r["id"], "x": r["id"] * 2})
+          .map(lambda r: {**r, "y": r["x"] + 1})
+          .filter(lambda r: r["id"] % 2 == 0))
+    plan = ds.explain()
+    assert sum(1 for p in plan if p.startswith("map:")) == 1, plan
+    assert ds.count() == 25
+
+
+def test_optimizer_fusion_respects_compute_boundary(data_cluster):
+    """An actor-pool stage must NOT fuse with task-pool neighbors."""
+    ds = (rd.range(20)
+          .map(lambda r: r)
+          .map_batches(lambda b: b, compute="actors", concurrency=1)
+          .map(lambda r: r))
+    plan = ds.explain()
+    assert sum(1 for p in plan if p.startswith("map:")) == 3, plan
+
+
+def test_optimizer_limit_pushdown(data_cluster):
+    """limit(n) hops over row-preserving maps (but not over filter)."""
+    plan = rd.range(100).map(lambda r: r).limit(5).explain()
+    assert plan[1].startswith("allToAll:limit"), plan
+    # filter changes row counts: limit must stay downstream of it
+    plan2 = rd.range(100).filter(lambda r: True).limit(5).explain()
+    assert plan2[1].startswith("map:"), plan2
+    assert len(rd.range(100).map(lambda r: r).limit(5).take_all()) == 5
+
+
+def test_optimizer_projection_pushdown_parquet(data_cluster, tmp_path):
+    import pandas as pd
+    pd.DataFrame({"a": range(8), "b": range(8), "c": range(8)}).to_parquet(
+        str(tmp_path / "t.parquet"))
+    ds = rd.read_parquet(str(tmp_path)).select_columns(["a", "b"])
+    plan = ds.explain()
+    assert "columns=['a', 'b']" in plan[0], plan  # pushed into the read
+    rows = ds.take_all()
+    assert set(rows[0].keys()) == {"a", "b"}
+
+
+def test_hash_join_matches_pandas_oracle(data_cluster):
+    import pandas as pd
+    left = pd.DataFrame({"k": [1, 2, 2, 3, 5], "lv": [10, 20, 21, 30, 50]})
+    right = pd.DataFrame({"k": [2, 3, 3, 4], "rv": [200, 300, 301, 400]})
+    for how in ("inner", "left", "right", "outer"):
+        got = (rd.from_pandas(left)
+               .join(rd.from_pandas(right), on="k", how=how).to_pandas())
+        want = left.merge(right, on="k", how=how)
+        assert len(got) == len(want), (how, got, want)
+        got_rows = sorted(
+            str(sorted((k, v) for k, v in r.items() if v == v))
+            for r in got.to_dict("records"))
+        want_rows = sorted(
+            str(sorted((k, v) for k, v in r.items() if v == v))
+            for r in want.to_dict("records"))
+        assert got_rows == want_rows, how
+
+
+def test_hash_aggregate_multi(data_cluster):
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(30)])
+    out = ds.groupby("k").aggregate(
+        ("count", None), ("sum", "v"), ("mean", "v"), ("max", "v"))
+    rows = out.take_all()
+    assert [r["k"] for r in rows] == [0, 1, 2]
+    for row in rows:
+        vals = [float(i) for i in range(30) if i % 3 == row["k"]]
+        assert row["count()"] == len(vals)
+        assert abs(row["sum(v)"] - sum(vals)) < 1e-9
+        assert abs(row["mean(v)"] - sum(vals) / len(vals)) < 1e-9
+        assert row["max(v)"] == max(vals)
+
+
+def test_resource_manager_budget_shared(data_cluster):
+    """Map ops share the pipeline CPU budget fairly instead of fixed
+    windows; explicit concurrency still caps its op."""
+    from ray_tpu.data.context import DataContext
+    from ray_tpu.data.streaming import MapOp, ResourceManager
+
+    ctx = DataContext.get_current()
+    old = ctx.execution_cpu_budget
+    ctx.execution_cpu_budget = 8
+    try:
+        a, b = MapOp("a", []), MapOp("b", [])
+        rm = ResourceManager([a, b])
+        assert rm.window_for(a) == 4 and rm.window_for(b) == 4
+        b.output_done = True  # finished op releases its share
+        assert rm.window_for(a) == 8
+        c = MapOp("c", [], concurrency=2)
+        rm2 = ResourceManager([a, c])
+        assert rm2.window_for(c) == 2  # explicit cap wins
+    finally:
+        ctx.execution_cpu_budget = old

@@ -277,3 +277,27 @@ def test_profile_capture_endpoints(obs_cluster):
     zf = zipfile.ZipFile(_io.BytesIO(reply["data"]))
     assert len(zf.namelist()) >= 1
     ray_tpu.get(spin_ref, timeout=120)
+
+
+def test_trace_context_propagates_to_tasks(obs_cluster):
+    """Span context crosses the submit boundary: a task launched inside
+    trace_span() sees the caller's (trace_id, span_id) and its own
+    nested spans share the trace id (reference:
+    util/tracing/tracing_helper.py:54-88)."""
+    from ray_tpu.util.tracing import get_trace_context, trace_span
+
+    @ray_tpu.remote
+    def probe():
+        from ray_tpu.util.tracing import (get_trace_context as g,
+                                          trace_span as ts)
+        inherited = g()
+        with ts("inner") as (tid, sid):
+            return {"inherited": inherited, "inner": (tid, sid)}
+
+    with trace_span("outer") as (trace_id, span_id):
+        out = ray_tpu.get(probe.remote(), timeout=120)
+    assert tuple(out["inherited"]) == (trace_id, span_id)
+    assert out["inner"][0] == trace_id        # same trace
+    assert out["inner"][1] != span_id         # its own span
+    # outside the span nothing leaks
+    assert ray_tpu.get(probe.remote(), timeout=120)["inherited"] is None

@@ -183,7 +183,12 @@ def test_impala_cartpole_learns(rl_cluster):
             .env_runners(num_env_runners=2, num_envs_per_env_runner=32,
                          rollout_fragment_length=32)
             .training(lr=1e-3, entropy_coeff=0.01, vf_coeff=0.25,
-                      train_batch_slots=64, num_epochs=2)
+                      train_batch_slots=64, num_epochs=2,
+                      # anneal exploration pressure away once the policy
+                      # is basically learned — constant entropy capped
+                      # the full run ~360 (see PERF.md)
+                      entropy_coeff_final=0.0005,
+                      entropy_decay_iters=1200)
             .build())
     best = 0.0
     hit = False

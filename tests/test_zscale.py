@@ -20,7 +20,7 @@ import ray_tpu
 
 FULL = bool(os.environ.get("RTPU_SCALE_FULL"))
 
-N_TASKS = 200_000 if FULL else 50_000
+N_TASKS = 500_000 if FULL else 50_000
 N_ACTORS = 1_000 if FULL else 150
 N_WAIT = 10_000
 
@@ -141,3 +141,8 @@ def test_wait_returns_in_completion_order_bulk(cluster):
     ready, not_ready = ray_tpu.wait(refs, num_returns=64, timeout=60)
     assert len(ready) == 64
     assert len(not_ready) == 1
+
+
+# The 8-raylet cluster-scale test lives in test_cluster.py
+# (test_eight_raylet_cluster) — it needs its own cluster fixture, not
+# this module's single-node one.
