@@ -48,6 +48,20 @@ _DEFAULTS: Dict[str, Any] = {
     # normal_task_submitter.h max_tasks_in_flight_per_worker). The worker
     # executes serially; >1 hides push/reply latency behind execution.
     "max_tasks_in_flight_per_lease": 8,
+    # Cooperative lease fairness: a driver flooding tasks returns each
+    # lease to the raylet after holding it this long (the worker stays
+    # warm in the raylet's idle pool), so other drivers' queued lease
+    # requests get a turn instead of starving behind indefinitely-held
+    # leases (multi-client flood fairness; reference: the raylet asks
+    # for unused leased workers back, release_unused_workers).
+    "lease_fair_rotation_s": 1.0,
+    # Self-heal for lost pushes/replies WITHOUT bounding task duration
+    # (tasks may legitimately run for hours): while a push_task call is
+    # outstanding, the submitter probes the worker every period; if the
+    # worker doesn't know the task for `threshold` consecutive probes,
+    # the push (or its reply) was lost — drop the lease and retry.
+    "push_probe_period_s": 15.0,
+    "push_probe_unknown_threshold": 2,
     # --- device objects ---
     # HBM bytes the process may hold pinned for device-resident objects
     # (device_put_ref pins + DeviceChannel staging). 0 = unlimited.

@@ -367,18 +367,10 @@ class TaskManager:
         if self._take_cancelled(spec.task_id):
             return  # late reply for a cancelled task: returns already failed
         self._cw.task_events.record(spec, "FINISHED")
-        with self._lock:
-            pending = self.pending.pop(spec.task_id, None)
-            # Retain lineage so lost plasma returns can be reconstructed.
-            if spec.task_type == NORMAL_TASK and spec.max_retries != 0:
-                self.lineage[spec.task_id] = spec
-                self._lineage_bytes += 256  # spec bookkeeping estimate
-                if self._lineage_bytes > CONFIG.max_lineage_bytes:
-                    # Evict oldest lineage entries.
-                    while self._lineage_bytes > CONFIG.max_lineage_bytes // 2 \
-                            and self.lineage:
-                        self.lineage.pop(next(iter(self.lineage)))
-                        self._lineage_bytes -= 256
+        # Returns land in the memory store BEFORE the task leaves the
+        # pending table: a concurrent get() observing not-pending +
+        # not-in-store concludes the result was LOST and spuriously
+        # reconstructs (deleting/resubmitting a task that just finished).
         returns = reply.get("returns", [])
         for i, ret in enumerate(returns):
             oid = ObjectID.for_task_return(spec.task_id, ret.get("index", i))
@@ -408,6 +400,18 @@ class TaskManager:
             self._cw.memory_store.put(
                 ObjectID.for_task_return(spec.task_id, 0),
                 ObjectRefGenerator(refs=item_refs))
+        with self._lock:
+            pending = self.pending.pop(spec.task_id, None)
+            # Retain lineage so lost plasma returns can be reconstructed.
+            if spec.task_type == NORMAL_TASK and spec.max_retries != 0:
+                self.lineage[spec.task_id] = spec
+                self._lineage_bytes += 256  # spec bookkeeping estimate
+                if self._lineage_bytes > CONFIG.max_lineage_bytes:
+                    # Evict oldest lineage entries.
+                    while self._lineage_bytes > CONFIG.max_lineage_bytes // 2 \
+                            and self.lineage:
+                        self.lineage.pop(next(iter(self.lineage)))
+                        self._lineage_bytes -= 256
         self._release_deps(pending)
 
     def on_failed(self, spec: TaskSpec, error: Exception,
@@ -489,6 +493,10 @@ class Lease:
     # would park one lease in two idle lists and break the
     # one-list-per-lease invariant the cleaner relies on.
     key: Optional[Tuple] = None
+    granted_at: float = field(default_factory=time.monotonic)
+    # Fairness rotation: an overheld lease stops taking new tasks and
+    # returns to the raylet once its pipeline drains.
+    retiring: bool = False
 
 
 class NormalTaskSubmitter:
@@ -498,6 +506,7 @@ class NormalTaskSubmitter:
         self._running: Dict[TaskID, Lease] = {}  # pushed, awaiting reply
         self._waiters: Dict[Tuple, collections.deque] = {}
         self._inflight_requests: Dict[Tuple, int] = {}
+        self._shape_specs: Dict[Tuple, TaskSpec] = {}
         self._request_tasks: set = set()
         self._cleaner_started = False
 
@@ -531,8 +540,12 @@ class NormalTaskSubmitter:
         worker = self._cw.clients.get(lease.worker_address)
         self._running[spec.task_id] = lease
         try:
-            reply = await worker.call("push_task", spec=spec,
-                                      lease_id=lease.lease_id, timeout=None)
+            # No deadline on execution itself (tasks run arbitrarily
+            # long), but a LOST push/reply must not pin lease.inflight
+            # forever (leaks the raylet CPU — observed under 4-driver
+            # floods): probe the worker periodically; if it doesn't know
+            # the task repeatedly, the push or its reply vanished.
+            reply = await self._push_with_probe(worker, spec, lease)
         except Exception as e:
             # Worker died or became unreachable — a system failure.
             self._drop_lease(lease)
@@ -550,6 +563,51 @@ class NormalTaskSubmitter:
                 spec, error, is_application_error=True)
         else:
             self._cw.task_manager.on_completed(spec, reply)
+
+    async def _push_with_probe(self, worker, spec: TaskSpec,
+                               lease: Lease) -> Dict[str, Any]:
+        """push_task with liveness probing instead of a duration bound
+        (reference: lease liveness is connection-tied in the raylet; here
+        the probe asks the worker whether it still knows the task)."""
+        push = asyncio.ensure_future(worker.call(
+            "push_task", spec=spec, lease_id=lease.lease_id,
+            timeout=None))
+        unknown = 0
+        running = 0
+        while True:
+            done, _ = await asyncio.wait(
+                {push}, timeout=CONFIG.push_probe_period_s)
+            if done:
+                return push.result()
+            try:
+                state = await worker.call(
+                    "task_probe", task_hex=spec.task_id.hex(), timeout=15)
+            except Exception:
+                # unreachable worker: the push's own connection error
+                # usually lands first; treat like unknown
+                state = "unreachable"
+            if state == "running":
+                unknown = 0
+                running += 1
+                if running == 6:
+                    # "running" for ~90s on a tiny task: capture the
+                    # worker's stacks for postmortem (file survives the
+                    # processes)
+                    try:
+                        await worker.call(
+                            "dump_stacks",
+                            path=f"/tmp/rtpu-stuck-{spec.task_id.hex()[:8]}"
+                                 ".txt",
+                            timeout=15)
+                    except Exception:  # noqa: BLE001
+                        pass
+                continue
+            unknown += 1
+            if unknown >= CONFIG.push_probe_unknown_threshold:
+                push.cancel()
+                raise WorkerCrashedError(
+                    f"worker {lease.worker_address} lost task "
+                    f"{spec.task_id.hex()[:12]} (probe: {state})")
 
     async def _resolve_dependencies(self, spec: TaskSpec):
         """Wait until owned args exist; inline small plain values
@@ -596,6 +654,12 @@ class NormalTaskSubmitter:
         flight. Without the handoff, returned leases sit idle (resources
         still charged at the raylet) while queued requests starve."""
         key = spec.shape_key()
+        # latest spec per shape: re-issuing lease requests after a
+        # fairness rotation needs a representative spec. STRIPPED of
+        # args — keys are long-lived and a full spec would pin up to
+        # inline_arg_max_bytes of payload per distinct shape forever.
+        import dataclasses as _dc
+        self._shape_specs[key] = _dc.replace(spec, args=[])
         if spec.scheduling_strategy.kind == "SPREAD":
             # SPREAD must not pipeline onto a cached lease — each task
             # goes through its own lease request so the raylet's
@@ -752,11 +816,36 @@ class NormalTaskSubmitter:
             if lease.inflight <= 0:
                 lease.dead = True
                 self._cw.fire_and_forget(lease.raylet_address,
-                                         "return_worker",
+                                         "return_worker", _retries=CONFIG.rpc_max_retries,
                                          lease_id=lease.lease_id)
                 self._idle.pop(key, None)
                 self._waiters.pop(key, None)
                 self._inflight_requests.pop(key, None)
+            return
+        if not lease.retiring and \
+                time.monotonic() - lease.granted_at > \
+                CONFIG.lease_fair_rotation_s:
+            # Fairness rotation: an overheld lease stops taking new
+            # tasks (under sustained pipelining its in-flight count
+            # never reaches 0 otherwise) and goes back to the raylet
+            # once drained — the worker stays warm in the raylet's idle
+            # pool, and OTHER drivers' queued lease requests get a turn
+            # instead of starving behind a flooding driver. Our own
+            # queued demand re-requests and joins the raylet's FIFO.
+            lease.retiring = True
+            idle = self._idle.get(key)
+            if idle and lease in idle:
+                idle.remove(lease)
+        if lease.retiring:
+            if lease.inflight <= 0:
+                lease.dead = True
+                self._cw.fire_and_forget(lease.raylet_address,
+                                         "return_worker", _retries=CONFIG.rpc_max_retries,
+                                         lease_id=lease.lease_id)
+                if self._waiters.get(key):
+                    spec = self._shape_specs.get(key)
+                    if spec is not None:
+                        self._maybe_request_lease(key, spec)
             return
         self._deliver_lease(key, lease)
 
@@ -765,6 +854,7 @@ class NormalTaskSubmitter:
             return
         lease.dead = True
         self._cw.fire_and_forget(lease.raylet_address, "return_worker",
+                                 _retries=CONFIG.rpc_max_retries,
                                  lease_id=lease.lease_id, dispose=True)
         # With pipelining a failed lease may still be advertised as having
         # capacity — stop handing it out. The lease lives in at most ONE
@@ -791,6 +881,7 @@ class NormalTaskSubmitter:
                             now - lease.last_used > CONFIG.lease_idle_timeout_s:
                         self._cw.fire_and_forget(
                             lease.raylet_address, "return_worker",
+                            _retries=CONFIG.rpc_max_retries,
                             lease_id=lease.lease_id)
                     else:
                         keep.append(lease)
@@ -1601,6 +1692,9 @@ class CoreWorker:
         self._pending_frees: List[str] = []
         self._free_lock = threading.Lock()
         self._done_batches: Dict[Address, List] = {}
+        # normal-task pushes currently known to this worker (arrival ->
+        # reply), served to owner-side push probes
+        self._received_pushes: Set[TaskID] = set()
         # Called with the ObjectID whenever an owned object is freed
         # (device-resident object pins, experimental/device_objects.py).
         self.device_object_free_hooks: List = []
@@ -1647,14 +1741,21 @@ class CoreWorker:
     def run_sync(self, coro, timeout=None):
         return EventLoopThread.get().run_sync(coro, timeout)
 
-    def fire_and_forget(self, address: Address, method: str, **kwargs):
+    def fire_and_forget(self, address: Address, method: str,
+                        _retries: int = 0, **kwargs):
+        """Best-effort call. Pass _retries ONLY for IDEMPOTENT methods
+        (return_worker: releasing a lease twice is a no-op) — retries
+        re-execute on a lost reply, which would double-apply counter
+        mutations like borrow_addref/decref."""
         client = self.clients.get(address)
 
         async def _go():
             try:
-                await client.call(method, timeout=10, **kwargs)
+                await client.call(method, timeout=60, retries=_retries,
+                                  **kwargs)
             except Exception:
-                pass
+                logger.warning("fire_and_forget %s to %s dropped",
+                               method, address)
         self.loop_post(_go())
 
     async def ensure_job_env(self, job_id: JobID):
@@ -1973,7 +2074,50 @@ class CoreWorker:
                                lease_id: Optional[int] = None):
         if lease_id is not None:
             self.current_lease_id = lease_id
-        return await self.executor.execute(spec)
+        # known to this worker from arrival until WELL AFTER the reply —
+        # the owner's push probe distinguishes a slow task from a lost
+        # push. Discarding at reply time would race reply transmission
+        # on a congested link: the probe would see "unknown" for a task
+        # that just completed and kill a healthy worker.
+        self._received_pushes.add(spec.task_id)
+        try:
+            return await self.executor.execute(spec)
+        finally:
+            asyncio.get_event_loop().call_later(
+                120.0, self._received_pushes.discard, spec.task_id)
+
+    async def handle_dump_stacks(self, path: str = "") -> bool:
+        """Debug: dump all thread stacks (+ asyncio tasks) to `path` or
+        stderr (reference: the dashboard's on-demand py-spy capture)."""
+        import faulthandler
+        out = open(path, "w") if path else sys.stderr
+        try:
+            faulthandler.dump_traceback(file=out, all_threads=True)
+            try:
+                for t in asyncio.all_tasks():
+                    frames = t.get_stack(limit=5)
+                    where = " <- ".join(
+                        f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{f.f_code.co_name}:{f.f_lineno}"
+                        for f in frames)
+                    out.write(f"\nTASK {t.get_coro().__qualname__} @ "
+                              f"{where}")
+                out.write("\n")
+            except Exception:  # noqa: BLE001
+                pass
+        finally:
+            if path:
+                out.close()
+        return True
+
+    async def handle_task_probe(self, task_hex: str) -> str:
+        """Owner-side push probe (see _push_with_probe): is this task
+        known here — received/queued/running?"""
+        task_id = TaskID.from_hex(task_hex)
+        if task_id in self._received_pushes or \
+                self.executor.is_running(task_id):
+            return "running"
+        return "unknown"
 
     async def handle_push_actor_tasks(self, specs: List[TaskSpec],
                                       done_to):

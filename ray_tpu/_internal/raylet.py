@@ -295,9 +295,22 @@ class Raylet:
                 # stderr stays inherited: crash tracebacks must surface
                 # somewhere even with log streaming disabled
                 out_target, err_target = subprocess.DEVNULL, None
+            argv = [interpreter, "-m", "ray_tpu._internal.worker_main"]
+            from .task_spec import ENV_KEY_IMAGE_URI
+            image_uri = env_key[ENV_KEY_IMAGE_URI] \
+                if len(env_key) > ENV_KEY_IMAGE_URI else ""
+            if image_uri:
+                from .runtime_env import build_container_argv
+                # the IMAGE's python, not the host interpreter path
+                # (host venv paths don't exist inside the container);
+                # ray_tpu resolves via the mounted pkg_root + the
+                # forwarded PYTHONPATH
+                argv = ["python", "-m", "ray_tpu._internal.worker_main"]
+                argv = build_container_argv(
+                    image_uri, argv, env, pkg_root,
+                    extra_env_keys=[k for k, _ in env_key[0]])
             return subprocess.Popen(
-                [interpreter, "-m", "ray_tpu._internal.worker_main"],
-                env=env, stdout=out_target, stderr=err_target)
+                argv, env=env, stdout=out_target, stderr=err_target)
 
         def _attach(fut):
             try:
@@ -361,26 +374,43 @@ class Raylet:
                     message={"pid": proc.pid, "node_id": self.node_id,
                              "stream": name, "job": job, "lines": lines},
                     timeout=10))
+            # Raw nonblocking fd reads with our own line splitting.
+            # select + BufferedReader.readline() is WRONG here: readline
+            # slurps a whole chunk into the Python buffer and returns one
+            # line — the rest sit buffered while select watches an empty
+            # fd, so a burst (a stack dump, a traceback) surfaces one
+            # line per future write.
+            import fcntl
             import select
+            fd = stream.fileno()
+            flags = fcntl.fcntl(fd, fcntl.F_GETFL)
+            fcntl.fcntl(fd, fcntl.F_SETFL, flags | os.O_NONBLOCK)
+            pending = b""
             try:
                 while True:
-                    # select-bounded reads: a quiet stream still flushes
-                    # whatever is batched within ~100ms
-                    ready, _, _ = select.select([stream], [], [], 0.1)
+                    ready, _, _ = select.select([fd], [], [], 0.1)
                     if not ready:
                         flush()
                         continue
-                    raw = stream.readline()
-                    if not raw:
+                    try:
+                        chunk = os.read(fd, 65536)
+                    except BlockingIOError:
+                        continue
+                    if not chunk:
                         break
-                    batch.append(raw.decode("utf-8", "replace")
-                                 .rstrip("\n"))
+                    pending += chunk
+                    *lines, pending = pending.split(b"\n")
+                    for raw in lines:
+                        batch.append(raw.decode("utf-8", "replace"))
                     if len(batch) >= 100 or \
                             time.monotonic() - last_flush > 0.1:
                         flush()
             except Exception:
-                pass
+                logger.exception("worker log pump failed (pid %s)",
+                                 proc.pid)
             finally:
+                if pending:
+                    batch.append(pending.decode("utf-8", "replace"))
                 flush()
         for stream, name in ((proc.stdout, "stdout"),
                              (proc.stderr, "stderr")):
@@ -551,7 +581,12 @@ class Raylet:
                     return {"spillback_to": (target, addr)}
         grant = self._try_grant(req)
         if grant is not None:
-            return await grant
+            try:
+                return await grant
+            except Exception as e:  # noqa: BLE001 — never hang the caller
+                logger.exception("lease grant failed")
+                self._refund(req.demand, req.pg)
+                return {"rejected": True, "error": f"grant failed: {e!r}"}
         if spec_meta.get("grant_or_reject"):
             return {"rejected": True}
         # Spillback: is some other node better placed right now?
@@ -686,7 +721,17 @@ class Raylet:
             grant = self._try_grant(req)
             if grant is not None:
                 async def _complete(req=req, grant=grant):
-                    reply = await grant
+                    try:
+                        reply = await grant
+                    except Exception as e:  # noqa: BLE001 — a raised
+                        # grant must NOT leave the queued request's
+                        # future unresolved (the driver would wait on the
+                        # lease RPC forever and every task behind that
+                        # waiter wedges)
+                        logger.exception("queued lease grant failed")
+                        self._refund(req.demand, req.pg)
+                        reply = {"rejected": True,
+                                 "error": f"grant failed: {e!r}"}
                     if not req.future.done():
                         req.future.set_result(reply)
                 asyncio.ensure_future(_complete())
@@ -704,6 +749,49 @@ class Raylet:
                 continue
             still_queued.append(req)
         self.queued = still_queued
+
+    async def handle_agent_stats(self) -> Dict[str, Any]:
+        """Per-node agent surface (reference: dashboard/agent.py +
+        modules/reporter/reporter_agent.py — each node reports its own
+        cpu/mem and per-worker process stats; the dashboard head proxies
+        /api/nodes/<id>/stats here instead of running a separate agent
+        process — the raylet IS the node agent)."""
+        stats: Dict[str, Any] = {"node_id": self.node_id,
+                                 "node_index": self.node_index}
+        try:
+            with open("/proc/loadavg") as f:
+                stats["loadavg"] = [float(x)
+                                    for x in f.read().split()[:3]]
+        except OSError:
+            pass
+        try:
+            mem = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, rest = line.partition(":")
+                    if k in ("MemTotal", "MemAvailable"):
+                        mem[k] = int(rest.split()[0]) * 1024
+            stats["mem_total_bytes"] = mem.get("MemTotal")
+            stats["mem_available_bytes"] = mem.get("MemAvailable")
+        except OSError:
+            pass
+        workers = []
+        for handle in self.workers.values():
+            entry = {"worker_id": handle.worker_id.hex(),
+                     "pid": handle.pid, "state": handle.state,
+                     "job": handle.job_hex}
+            try:
+                with open(f"/proc/{handle.pid}/statm") as f:
+                    pages = int(f.read().split()[1])
+                entry["rss_bytes"] = pages * os.sysconf("SC_PAGESIZE")
+            except (OSError, ValueError, IndexError):
+                pass
+            workers.append(entry)
+        stats["workers"] = workers
+        stats["num_leases"] = len(self.leases)
+        stats["resources_total"] = self.resources.total.to_dict()
+        stats["resources_available"] = self.resources.available.to_dict()
+        return stats
 
     async def handle_return_worker(self, lease_id: int,
                                    dispose: bool = False):

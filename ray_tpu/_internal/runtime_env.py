@@ -270,3 +270,55 @@ def ensure_python_env(requirements: List[str], root: str) -> str:
             os.unlink(lock_path)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Container runtime env (reference: _private/runtime_env/container/ —
+# image_uri runs the worker inside a container; podman in the reference,
+# any docker-compatible runtime here)
+# ---------------------------------------------------------------------------
+
+def find_container_runtime() -> Optional[str]:
+    """First available container runtime. `RTPU_CONTAINER_RUNTIME`
+    overrides (tests point it at a shim; production at podman/docker)."""
+    import shutil
+    override = os.environ.get("RTPU_CONTAINER_RUNTIME")
+    if override:
+        return override
+    for candidate in ("podman", "docker"):
+        path = shutil.which(candidate)
+        if path:
+            return path
+    return None
+
+
+def build_container_argv(image_uri: str, argv: List[str],
+                         env: Dict[str, str], pkg_root: str,
+                         extra_env_keys: Optional[List[str]] = None
+                         ) -> List[str]:
+    """Wrap a worker command to run inside `image_uri` (reference:
+    container/container_manager.py assembles the same shape: host
+    networking so the worker's RPC server is reachable, the framework
+    source and session tmp mounted through, RTPU_*/JAX_* env forwarded).
+    Raises RuntimeEnvSetupError when no container runtime exists —
+    deterministic, so the lease is rejected permanently."""
+    from .errors import RuntimeEnvSetupError
+    runtime = find_container_runtime()
+    if runtime is None:
+        raise RuntimeEnvSetupError(
+            f"runtime_env image_uri={image_uri!r} requires a container "
+            "runtime (podman/docker) on the node; none found")
+    out = [runtime, "run", "--rm", "--network=host",
+           "-v", f"{pkg_root}:{pkg_root}:ro",
+           "-v", "/tmp:/tmp",
+           "-v", "/dev/shm:/dev/shm"]
+    extra = set(extra_env_keys or ())
+    for key, value in env.items():
+        # framework env + the USER's runtime_env env_vars (extra) — the
+        # latter would otherwise silently vanish inside the container
+        if key in extra or key.startswith(("RTPU_", "JAX_", "PALLAS_",
+                                           "XLA_", "PYTHON")):
+            out += ["-e", f"{key}={value}"]
+    out.append(image_uri)
+    out += argv
+    return out

@@ -207,9 +207,11 @@ class FunctionManager:
 
 # Positional layout shared by the submitter's lease shape key and the
 # raylet's worker-pool key: [0] env_vars, [1] working_dir,
-# [2] py_modules, [3] pip, [4] python_env requirements. The raylet's
-# worker spawn reads index 4 — keep order append-only.
+# [2] py_modules, [3] pip, [4] python_env requirements, [5] image_uri.
+# The raylet's worker spawn reads indices 4 and 5 — keep order
+# append-only.
 ENV_KEY_PYTHON_ENV = 4
+ENV_KEY_IMAGE_URI = 5
 
 
 def runtime_env_key(runtime_env) -> "Tuple":
@@ -221,4 +223,5 @@ def runtime_env_key(runtime_env) -> "Tuple":
         tuple(env.get("pip") or ()),
         tuple(sorted((env.get("python_env") or {})
                      .get("requirements", ()))),
+        env.get("image_uri") or "",
     )

@@ -132,6 +132,21 @@ class DashboardHead:
                                "resources_available": available})
         if path == "/api/nodes":
             return self._json(st.list_nodes())
+        node_match = re.fullmatch(r"/api/nodes/([0-9a-f]+)/stats", path)
+        if node_match:
+            # per-node agent stats, proxied to that node's raylet
+            # (reference: dashboard/agent.py + reporter_agent.py — the
+            # raylet serves the agent surface here)
+            from .._internal.core_worker import get_core_worker
+            node_hex = node_match.group(1)
+            node = next((n for n in st.list_nodes()
+                         if n["node_id"].startswith(node_hex)), None)
+            if node is None:
+                return (404, b"unknown node", "text/plain")
+            client = get_core_worker().clients.get(
+                tuple(node["address"]))
+            return self._json(client.call_sync("agent_stats",
+                                               timeout=30))
         if path == "/api/actors":
             return self._json(st.list_actors())
         if path == "/api/tasks":

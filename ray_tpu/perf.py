@@ -33,8 +33,10 @@ BASELINES = {
 }
 
 _CLIENT_SCRIPT = r"""
-import json, os, sys, time
+import faulthandler, json, os, sys, time
 sys.path.insert(0, {repo!r})
+# a wedged client must dump its stack and die, not hang the bench
+faulthandler.dump_traceback_later(120, exit=True)
 import ray_tpu
 
 idx = int(sys.argv[1]); n = int(sys.argv[2]); out = sys.argv[3]
@@ -50,12 +52,18 @@ open(ready, "w").close()
 go = os.path.join(os.path.dirname(out), "go")
 while not os.path.exists(go):
     time.sleep(0.02)
+# re-arm: the first timer bounded connect+warmup; the flood on a
+# contended box legitimately takes minutes
+faulthandler.cancel_dump_traceback_later()
+faulthandler.dump_traceback_later(600, exit=True)
 t0 = time.perf_counter()
 ray_tpu.get([noop.remote() for _ in range(n)])
 t1 = time.perf_counter()
 with open(out, "w") as f:
     json.dump({{"t0": t0, "t1": t1, "n": n}}, f)
-ray_tpu.shutdown()
+# results are on disk; a slow/hung disconnect must not stall the bench
+faulthandler.cancel_dump_traceback_later()
+os._exit(0)
 """
 
 
@@ -85,13 +93,21 @@ def multi_client_bench(n_clients: int = 4, n_per: int = 1000,
     for i in range(n_clients):
         out = os.path.join(workdir, f"client-{i}.json")
         outs.append(out)
-        procs.append(subprocess.Popen(
-            [sys.executable, script, str(i), str(n_per), out], env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
-    deadline = time.monotonic() + 120
+        with open(os.path.join(workdir, f"client-{i}.err"), "w") as err:
+            procs.append(subprocess.Popen(
+                [sys.executable, script, str(i), str(n_per), out],
+                env=env, stdout=subprocess.DEVNULL, stderr=err))
+        # the child holds its own inherited fd; ours closes immediately
+    deadline = time.monotonic() + 150
     while len(glob.glob(os.path.join(workdir, "*.ready"))) < n_clients:
         if time.monotonic() > deadline:
-            raise TimeoutError("multi-client workers failed to connect")
+            chunks = []
+            for p in glob.glob(os.path.join(workdir, "*.err")):
+                with open(p) as f:
+                    chunks.append(f.read()[-2000:])
+            raise TimeoutError(
+                "multi-client workers failed to connect; client stderr:"
+                "\n" + "\n".join(chunks))
         time.sleep(0.05)
     open(os.path.join(workdir, "go"), "w").close()
     for p in procs:

@@ -108,7 +108,18 @@ class PowerOfTwoChoicesRouter:
             picked = self._pick(hint)
         return picked
 
+    #: affinity map bounds shared by hint-based picks (prefix + model id)
+    AFFINITY_CAP = 4096
+    SLACK = 4
+
     def _pick(self, hint: Optional[int] = None) -> Optional["_Tracked"]:
+        # A hint (prompt-prefix hash OR multiplexed model id) pins the
+        # request to the replica that served it before — the replica's
+        # prefix/model cache keeps hitting — unless that replica is
+        # `SLACK` requests busier than the least loaded (affinity yields
+        # to load). Hintless requests use power-of-two-choices.
+        if hint is not None:
+            return self._pick_affine(hint)
         with self._lock:
             candidates = list(self._replicas)
         if not candidates:
@@ -119,6 +130,33 @@ class PowerOfTwoChoicesRouter:
             a, b = random.sample(candidates, 2)
             pick = a if self._inflight.get(a.actor_name, 0) <= \
                 self._inflight.get(b.actor_name, 0) else b
+        return self._handle_for(pick)
+
+    def _pick_affine(self, hint: int) -> Optional["_Tracked"]:
+        with self._lock:
+            if not hasattr(self, "_affinity"):
+                self._affinity: Dict[int, str] = {}
+            candidates = list(self._replicas)
+            if not candidates:
+                return None
+            live = {r.actor_name for r in candidates}
+            target = self._affinity.get(hint)
+            pick = None
+            if target is not None and target in live:
+                least = min(self._inflight.get(r.actor_name, 0)
+                            for r in candidates)
+                if self._inflight.get(target, 0) <= least + self.SLACK:
+                    pick = next(r for r in candidates
+                                if r.actor_name == target)
+            if pick is None:
+                pick = min(candidates,
+                           key=lambda r: self._inflight.get(
+                               r.actor_name, 0))
+                self._affinity[hint] = pick.actor_name
+                if len(self._affinity) > self.AFFINITY_CAP:
+                    for k in list(self._affinity)[
+                            :self.AFFINITY_CAP // 2]:
+                        self._affinity.pop(k, None)
         return self._handle_for(pick)
 
     def _handle_for(self, info: ReplicaInfo):
@@ -153,53 +191,12 @@ class PowerOfTwoChoicesRouter:
 
 
 class PrefixAwareRouter(PowerOfTwoChoicesRouter):
-    """Prompt-prefix affinity router (reference:
-    llm/_internal/serve/request_router/ prefix-aware request router).
-
-    Requests carrying the same prompt prefix land on the same replica so
-    its paged-KV prefix cache keeps hitting (shared system prompts are
-    stored once per replica, not once per replica-per-request). The hint
-    is a hash of the prompt's leading tokens; affinity yields to load —
-    a hinted replica more than `slack` requests busier than the least
-    loaded one is rerouted (and the map repointed) so one hot prefix
-    cannot starve the pool."""
-
-    AFFINITY_CAP = 4096
-    SLACK = 4
-
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-        self._affinity: Dict[int, str] = {}
-
-    def _pick(self, hint: Optional[int] = None) -> Optional["_Tracked"]:
-        if hint is None:
-            return super()._pick()
-        with self._lock:
-            candidates = list(self._replicas)
-            if not candidates:
-                return None
-            live = {r.actor_name for r in candidates}
-            target = self._affinity.get(hint)
-            if target is not None and target in live:
-                least = min(self._inflight.get(r.actor_name, 0)
-                            for r in candidates)
-                if self._inflight.get(target, 0) <= least + self.SLACK:
-                    info = next(r for r in candidates
-                                if r.actor_name == target)
-                    pick = info
-                else:
-                    target = None
-            if target is None or target not in live:
-                pick = min(candidates,
-                           key=lambda r: self._inflight.get(
-                               r.actor_name, 0))
-                self._affinity[hint] = pick.actor_name
-                if len(self._affinity) > self.AFFINITY_CAP:
-                    # drop ~oldest half (insertion-ordered dict)
-                    for k in list(self._affinity)[
-                            :self.AFFINITY_CAP // 2]:
-                        self._affinity.pop(k, None)
-        return self._handle_for(pick)
+    """Marker subclass selected by request_router="prefix" (reference:
+    llm/_internal/serve/request_router/): the HTTP proxy computes a
+    prompt-prefix hash hint for apps routed this way. The affinity
+    mechanics live in the base router (`_pick_affine`) so
+    multiplexed-model hints get the same treatment under the default
+    pow2 router."""
 
 
 def make_router(kind: str, deployment_key: str, controller_handle,

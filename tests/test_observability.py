@@ -301,3 +301,29 @@ def test_trace_context_propagates_to_tasks(obs_cluster):
     assert out["inner"][1] != span_id         # its own span
     # outside the span nothing leaks
     assert ray_tpu.get(probe.remote(), timeout=120)["inherited"] is None
+
+
+def test_node_agent_stats_route(obs_cluster):
+    """Per-node agent stats via the head (reference: dashboard/agent.py
+    + reporter_agent.py): /api/nodes/<id>/stats proxies to that node's
+    raylet and reports host memory, load, and per-worker RSS."""
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util import state as st
+
+    address = start_dashboard()
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+    ray_tpu.get(warm.remote())  # ensure at least one worker exists
+
+    node_id = st.list_nodes()[0]["node_id"]
+    _s, body = _get(f"{address}/api/nodes/{node_id}/stats")
+    stats = json.loads(body)
+    assert stats["node_id"] == node_id
+    assert stats["mem_total_bytes"] > 0
+    assert len(stats["loadavg"]) == 3
+    assert stats["resources_total"].get("CPU", 0) >= 4
+    workers = stats["workers"]
+    assert workers and any(w.get("rss_bytes", 0) > 0 for w in workers)
+    assert all({"worker_id", "pid", "state"} <= set(w) for w in workers)
