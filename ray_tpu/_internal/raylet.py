@@ -405,6 +405,13 @@ class Raylet:
                     if len(batch) >= 100 or \
                             time.monotonic() - last_flush > 0.1:
                         flush()
+            except (ValueError, OSError) as e:
+                # fd closed at worker teardown is a clean exit; a read
+                # failure while the worker LIVES still deserves a line
+                if proc.poll() is None:
+                    logger.warning(
+                        "worker log pump read failed (pid %s): %s",
+                        proc.pid, e)
             except Exception:
                 logger.exception("worker log pump failed (pid %s)",
                                  proc.pid)
