@@ -62,6 +62,7 @@ _DEFAULTS: Dict[str, Any] = {
     # the push (or its reply) was lost — drop the lease and retry.
     "push_probe_period_s": 15.0,
     "push_probe_unknown_threshold": 2,
+    "push_probe_unreachable_threshold": 8,
     # --- device objects ---
     # HBM bytes the process may hold pinned for device-resident objects
     # (device_put_ref pins + DeviceChannel staging). 0 = unlimited.

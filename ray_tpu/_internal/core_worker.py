@@ -56,6 +56,28 @@ _global_worker: Optional["CoreWorker"] = None
 _global_lock = threading.Lock()
 
 
+class _SpreadMark:
+    """Unique sentinel marking one-shot SPREAD lease keys. A class (not
+    object()) so the mark survives pickling of key tuples; identity
+    is restored via __reduce__ returning the singleton."""
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __reduce__(self):
+        return (_SpreadMark, ())
+
+
+_SPREAD = _SpreadMark()
+
+
+def _is_spread_key(key) -> bool:
+    return key is not None and len(key) >= 2 and key[-2] is _SPREAD
+
+
 def get_core_worker() -> "CoreWorker":
     if _global_worker is None:
         raise RuntimeError(
@@ -573,6 +595,7 @@ class NormalTaskSubmitter:
             "push_task", spec=spec, lease_id=lease.lease_id,
             timeout=None))
         unknown = 0
+        unreachable = 0
         running = 0
         while True:
             done, _ = await asyncio.wait(
@@ -583,9 +606,20 @@ class NormalTaskSubmitter:
                 state = await worker.call(
                     "task_probe", task_hex=spec.task_id.hex(), timeout=15)
             except Exception:
-                # unreachable worker: the push's own connection error
-                # usually lands first; treat like unknown
-                state = "unreachable"
+                # Probe timeout / transport error: the worker may just be
+                # congested (single-core multi-driver floods). A dead
+                # worker's push fails with its own connection error first,
+                # so give these a separate, much larger budget instead of
+                # counting them as "worker lost the task".
+                unreachable += 1
+                if unreachable >= CONFIG.push_probe_unreachable_threshold:
+                    push.cancel()
+                    raise WorkerCrashedError(
+                        f"worker {lease.worker_address} unreachable for "
+                        f"{unreachable} probes on task "
+                        f"{spec.task_id.hex()[:12]}")
+                continue
+            unreachable = 0
             if state == "running":
                 unknown = 0
                 running += 1
@@ -666,7 +700,7 @@ class NormalTaskSubmitter:
             # round-robin redirect actually lands tasks on distinct
             # nodes (reference: spread policy is per lease request).
             self._spread_salt = getattr(self, "_spread_salt", 0) + 1
-            key = key + ("spread", self._spread_salt)
+            key = key + (_SPREAD, self._spread_salt)
         idle = self._idle.get(key)
         if idle:
             # Least-loaded lease first so bursts spread across workers
@@ -806,7 +840,7 @@ class NormalTaskSubmitter:
         lease.inflight -= 1
         if lease.dead:
             return
-        if key is not None and "spread" in key:
+        if _is_spread_key(key):
             # One-shot SPREAD lease: never recycled driver-side (reuse
             # would undo the round-robin placement) — the lease returns
             # to its raylet (worker stays in the raylet's idle pool) and
@@ -862,7 +896,7 @@ class NormalTaskSubmitter:
         leases = self._idle.get(lease.key)
         if leases and lease in leases:
             leases.remove(lease)
-        if lease.key is not None and "spread" in lease.key:
+        if _is_spread_key(lease.key):
             # unique per-task key: reap the bookkeeping
             if not self._idle.get(lease.key):
                 self._idle.pop(lease.key, None)

@@ -386,10 +386,15 @@ class DQN:
             adds.append(buf.add_batch.remote(
                 frag["obs"], frag["actions"], frag["rewards"],
                 frag["next_obs"], frag["dones"], frag["discounts"]))
-        buffer_size = sum(ray_tpu.get(adds, timeout=120)) \
-            if len(self._buffers) == 1 else \
-            sum(ray_tpu.get([b.size.remote() for b in self._buffers],
-                            timeout=120))
+        if len(self._buffers) == 1:
+            # Adds are ordered actor calls on one buffer, each returning
+            # the cumulative size — the last one is the true total
+            # (summing would double-count earlier fragments).
+            buffer_size = ray_tpu.get(adds, timeout=120)[-1] if adds else 0
+        else:
+            ray_tpu.get(adds, timeout=120)
+            buffer_size = sum(ray_tpu.get(
+                [b.size.remote() for b in self._buffers], timeout=120))
         self._env_steps += sampled
         sample_time = time.perf_counter() - t0
 

@@ -129,12 +129,18 @@ class TPESearcher:
             return min(max(x, domain.low), domain.high)
         if isinstance(domain, QUniform):
             x = min(max(x, domain.low), domain.high)
-            return round(x / domain.q) * domain.q
+            # Clamp again after quantization: round(x/q)*q can exceed
+            # high when high is not a multiple of q.
+            return min(max(round(x / domain.q) * domain.q, domain.low),
+                       domain.high)
         if isinstance(domain, Randint):
             return int(min(max(round(x), domain.low), domain.high - 1))
         if isinstance(domain, QRandint):
             x = min(max(x, domain.low), domain.high - 1)
-            return int((int(x) // domain.q) * domain.q)
+            # Flooring to a q-multiple can drop below low (e.g. low=3,
+            # q=5, x=4 -> 0): clamp the quantized result too.
+            return int(min(max((int(x) // domain.q) * domain.q,
+                               domain.low), domain.high - 1))
         if isinstance(domain, Randn):
             return x
         return x
