@@ -13,6 +13,7 @@ prompts stored once); admission blocks on page budget, not slot shape.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import time
@@ -84,15 +85,59 @@ class _Seq:
 
 class PagedLLMEngine:
     """Same external surface as LLMEngine (submit/step/generate/stats)
-    plus cancel() and per-token streaming callbacks."""
+    plus cancel() and per-token streaming callbacks.
+
+    Tensor parallelism: pass `mesh` (a jax Mesh with a `tensor` axis) and
+    params + KV pages are sharded over it — params by their flax logical
+    axes (heads/kv_heads/mlp/vocab -> tensor), pages on the kv_heads dim
+    — so models larger than one chip's HBM serve across chips. The page
+    table and scheduler stay host-side and see only logical page ids
+    (reference: TP×PP engine-worker placement in
+    llm/_internal/serve/deployments/llm/vllm/vllm_models.py:169-178,251;
+    here TP is a mesh axis and GSPMD/shard_map insert the collectives)."""
 
     def __init__(self, config: PagedEngineConfig,
-                 params: Optional[Any] = None):
+                 params: Optional[Any] = None, mesh=None):
         self.config = config
         cfg = config.model
         self.model = LlamaModel(cfg)
+        self.mesh = mesh
+        self._tp = int(mesh.shape.get("tensor", 1)) if mesh is not None \
+            else 1
+        if self._tp > 1:
+            if cfg.num_kv_heads % self._tp or cfg.num_heads % self._tp:
+                raise ValueError(
+                    f"num_heads={cfg.num_heads}/num_kv_heads="
+                    f"{cfg.num_kv_heads} not divisible by tensor axis "
+                    f"size {self._tp}")
         rng = jax.random.PRNGKey(config.seed)
-        if params is None:
+        self._page_sharding = None
+        self._dense_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as PSpec
+            from ..parallel.mesh import DEFAULT_LOGICAL_AXIS_RULES, unbox
+            from ..parallel.spmd import logical_names_tree, shardings_tree
+            rules = dict(DEFAULT_LOGICAL_AXIS_RULES)
+            sample = jnp.zeros((1, 8), jnp.int32)
+            names = logical_names_tree(self.model, rng, sample)
+            pshard = shardings_tree(names, mesh, rules)
+            if params is None:
+                def _init(r):
+                    p = unbox(self.model.init(r, sample)["params"])
+                    return jax.tree_util.tree_map(
+                        jax.lax.with_sharding_constraint, p, pshard)
+                with mesh:
+                    params = jax.jit(_init)(rng)
+            else:
+                # Params from a single-device engine or a checkpoint:
+                # scatter to the mesh layout.
+                params = jax.device_put(params, pshard)
+            # pages: [kv_heads, pages, page_size, hd] sharded on kv_heads
+            self._page_sharding = NamedSharding(mesh, PSpec("tensor"))
+            # dense prefill caches: [1, kv_heads, L, hd]
+            self._dense_sharding = NamedSharding(mesh, PSpec(None, "tensor"))
+        elif params is None:
             from ..parallel.mesh import unbox
             params = unbox(self.model.init(
                 rng, jnp.zeros((1, 8), jnp.int32))["params"])
@@ -101,10 +146,13 @@ class PagedLLMEngine:
         kvh, hd = cfg.num_kv_heads, cfg.head_dim_
         P, ps = config.num_pages, config.page_size
         # kernel layout: [kv_heads, num_pages, page_size, head_dim]
-        self.k_pages = [jnp.zeros((kvh, P, ps, hd), cfg.dtype)
-                        for _ in range(cfg.num_layers)]
-        self.v_pages = [jnp.zeros((kvh, P, ps, hd), cfg.dtype)
-                        for _ in range(cfg.num_layers)]
+        def _zero_pages():
+            z = jnp.zeros((kvh, P, ps, hd), cfg.dtype)
+            if self._page_sharding is not None:
+                z = jax.device_put(z, self._page_sharding)
+            return z
+        self.k_pages = [_zero_pages() for _ in range(cfg.num_layers)]
+        self.v_pages = [_zero_pages() for _ in range(cfg.num_layers)]
         self.pool = PagePool(P)
         # prefix cache: hash(token-prefix through page k) -> per-layer page
         self.prefix_pages: Dict[Tuple, List[int]] = {}
@@ -115,6 +163,7 @@ class PagedLLMEngine:
         self._steps = 0
         self._tokens_generated = 0
         model = self.model
+        page_sharding = self._page_sharding
 
         def decode_step(params, k_pages, v_pages, block_tables, lengths,
                         tokens, rng, temperature):
@@ -133,6 +182,13 @@ class PagedLLMEngine:
             out = jnp.where(temperature > 0, sampled, greedy)
             nk = [c["k"] for c in new_caches]
             nv = [c["v"] for c in new_caches]
+            if page_sharding is not None:
+                # pin the updated pools to the kv-head sharding so the
+                # donated-buffer layout is stable across steps
+                nk = [jax.lax.with_sharding_constraint(a, page_sharding)
+                      for a in nk]
+                nv = [jax.lax.with_sharding_constraint(a, page_sharding)
+                      for a in nv]
             return out.astype(jnp.int32), nk, nv
 
         self._decode = jax.jit(decode_step, donate_argnums=(1, 2))
@@ -161,7 +217,9 @@ class PagedLLMEngine:
             return init_kv_caches(
                 cfg, 1, config.pages_per_seq * config.page_size + slack)
 
-        self._dense_zero_caches = jax.jit(_dense_zero_caches)
+        self._dense_zero_caches = jax.jit(
+            _dense_zero_caches,
+            out_shardings=self._dense_sharding)  # None = default
 
         def write_pages(k_pages, v_pages, dense_caches, page_ids,
                         start_tok):
@@ -179,12 +237,26 @@ class PagedLLMEngine:
                 kvh_ = seg_k.shape[0]
                 seg_k = seg_k.reshape(kvh_, page_ids.shape[0], ps_, -1)
                 seg_v = seg_v.reshape(kvh_, page_ids.shape[0], ps_, -1)
-                nk.append(kp.at[:, page_ids].set(seg_k.astype(kp.dtype)))
-                nv.append(vp.at[:, page_ids].set(seg_v.astype(vp.dtype)))
+                uk = kp.at[:, page_ids].set(seg_k.astype(kp.dtype))
+                uv = vp.at[:, page_ids].set(seg_v.astype(vp.dtype))
+                if page_sharding is not None:
+                    uk = jax.lax.with_sharding_constraint(uk, page_sharding)
+                    uv = jax.lax.with_sharding_constraint(uv, page_sharding)
+                nk.append(uk)
+                nv.append(uv)
             return nk, nv
 
         self._write_pages = jax.jit(write_pages, donate_argnums=(0, 1),
                                     static_argnums=())
+
+    def _mesh_scope(self):
+        """Context for jit calls: marks the serving mesh active so the
+        model's attention detects the tensor axis at trace time
+        (shard_map over the Pallas/gather kernel)."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from ..parallel.mesh import serving_mesh
+        return serving_mesh(self.mesh)
 
     # -- submission / cancel ---------------------------------------------
 
@@ -326,29 +398,31 @@ class PagedLLMEngine:
         """Prefill the whole prompt in bucket-sized chunks against a dense
         per-request cache; returns (last_token_logits, dense_caches). One
         compiled program per bucket size, regardless of prompt length."""
-        caches = self._dense_zero_caches()
-        largest = self.config.prefill_buckets[-1]
-        off = 0
-        last_logits = None
-        while off < len(prompt):
-            rem = len(prompt) - off
-            chunk = self._bucket(min(rem, largest))
-            take = min(rem, chunk)
-            tokens = np.zeros((1, chunk), np.int32)
-            tokens[0, :take] = prompt[off:off + take]
-            # pad positions clamp to the rope table; their garbage K/V
-            # lands past the prompt and is never copied to pages
-            positions = np.minimum(
-                np.arange(off, off + chunk, dtype=np.int32),
-                self.config.model.max_seq_len - 1)[None, :]
-            logits, caches = self._chunk_prefill(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                caches, jnp.asarray(off, jnp.int32))
-            if off + take == len(prompt):
-                last_logits = np.asarray(
-                    logits[0, take - 1], np.float64)
-            off += take
-        return last_logits, caches
+        with self._mesh_scope():
+            caches = self._dense_zero_caches()
+            largest = self.config.prefill_buckets[-1]
+            off = 0
+            last_logits = None
+            while off < len(prompt):
+                rem = len(prompt) - off
+                chunk = self._bucket(min(rem, largest))
+                take = min(rem, chunk)
+                tokens = np.zeros((1, chunk), np.int32)
+                tokens[0, :take] = prompt[off:off + take]
+                # pad positions clamp to the rope table; their garbage K/V
+                # lands past the prompt and is never copied to pages
+                positions = np.minimum(
+                    np.arange(off, off + chunk, dtype=np.int32),
+                    self.config.model.max_seq_len - 1)[None, :]
+                logits, caches = self._chunk_prefill(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(positions), caches,
+                    jnp.asarray(off, jnp.int32))
+                if off + take == len(prompt):
+                    last_logits = np.asarray(
+                        logits[0, take - 1], np.float64)
+                off += take
+            return last_logits, caches
 
     def prefill_only(self, prompt: List[int]):
         """Run chunked prefill WITHOUT admitting a sequence: returns
@@ -407,10 +481,11 @@ class PagedLLMEngine:
         n_prompt_pages = -(-len(prompt) // ps)
         write_ids = new_ids[:max(0, n_prompt_pages - len(shared))]
         if write_ids:
-            self.k_pages, self.v_pages = self._write_pages(
-                self.k_pages, self.v_pages, dense_caches,
-                jnp.asarray(write_ids, jnp.int32),
-                jnp.asarray(len(shared) * ps, jnp.int32))
+            with self._mesh_scope():
+                self.k_pages, self.v_pages = self._write_pages(
+                    self.k_pages, self.v_pages, dense_caches,
+                    jnp.asarray(write_ids, jnp.int32),
+                    jnp.asarray(len(shared) * ps, jnp.int32))
         pages = shared + new_ids
         # 3. register newly-complete full-page prefixes for reuse
         for k in range(1, n_full + 1):
@@ -495,10 +570,11 @@ class PagedLLMEngine:
             temp = seq.request.temperature
             temps[i] = temp if temp is not None else cfg.temperature
         self._rng, key = jax.random.split(self._rng)
-        out, self.k_pages, self.v_pages = self._decode(
-            self.params, self.k_pages, self.v_pages,
-            jnp.asarray(block_tables), jnp.asarray(lengths),
-            jnp.asarray(tokens), key, jnp.asarray(temps))
+        with self._mesh_scope():
+            out, self.k_pages, self.v_pages = self._decode(
+                self.params, self.k_pages, self.v_pages,
+                jnp.asarray(block_tables), jnp.asarray(lengths),
+                jnp.asarray(tokens), key, jnp.asarray(temps))
         out = np.asarray(out)
         for i in active:
             seq = self.seqs[i]
@@ -542,6 +618,12 @@ class PagedLLMEngine:
         return [results[i] for i in range(len(prompts))]
 
     def stats(self) -> Dict[str, Any]:
+        cache_bytes = (2 * self.config.model.num_layers *
+                       int(np.prod(self.k_pages[0].shape)) *
+                       self.k_pages[0].dtype.itemsize)
+        param_bytes = sum(
+            int(np.prod(p.shape)) * p.dtype.itemsize
+            for p in jax.tree_util.tree_leaves(self.params))
         return {
             "steps": self._steps,
             "tokens_generated": self._tokens_generated,
@@ -549,7 +631,24 @@ class PagedLLMEngine:
             "pending": self._pending.qsize(),
             "free_pages": self.pool.num_free(),
             "prefix_entries": len(self.prefix_pages),
-            "hbm_cache_bytes": 2 * self.config.model.num_layers *
-            int(np.prod(self.k_pages[0].shape)) *
-            self.k_pages[0].dtype.itemsize,
+            "tp": self._tp,
+            "hbm_cache_bytes": cache_bytes,
+            # per-chip residency: pages shard on kv_heads, params on
+            # their logical axes — both divide by the tensor degree (the
+            # fsdp/replicated leaves make this a ceiling for params)
+            "hbm_cache_bytes_per_device": cache_bytes // self._tp,
+            "hbm_param_bytes": param_bytes,
+            "hbm_param_bytes_per_device": self._param_bytes_per_device(),
         }
+
+    def _param_bytes_per_device(self) -> int:
+        """Actual per-device parameter residency: sums each leaf's
+        addressable shard size on device 0 (exact, not estimated)."""
+        total = 0
+        for p in jax.tree_util.tree_leaves(self.params):
+            if hasattr(p, "sharding") and hasattr(p, "addressable_shards"):
+                shard = p.addressable_shards[0]
+                total += int(np.prod(shard.data.shape)) * p.dtype.itemsize
+            else:
+                total += int(np.prod(p.shape)) * p.dtype.itemsize
+        return total

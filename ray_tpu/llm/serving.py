@@ -42,13 +42,27 @@ class LLMServer:
     `engine_config` picks the engine: a `PagedEngineConfig` runs the
     paged-KV continuous-batching engine (the default TPU serving path —
     prefix page sharing, chunked prefill to max_len); an `EngineConfig`
-    runs the static-slot engine."""
+    runs the static-slot engine.
 
-    def __init__(self, engine_config, params=None):
+    `mesh_config` (a `parallel.MeshConfig`, e.g. tensor=4) shards the
+    paged engine's params + KV pages over the replica's chips — the
+    tensor-parallel analog of the reference's TP×PP engine-worker
+    bundles (vllm_models.py:169-178,251)."""
+
+    def __init__(self, engine_config, params=None, mesh_config=None):
         from .engine import EngineConfig, LLMEngine
         from .paged import PagedEngineConfig, PagedLLMEngine
+        mesh = None
+        if mesh_config is not None:
+            if not isinstance(engine_config, PagedEngineConfig):
+                raise ValueError(
+                    "mesh_config requires the paged engine "
+                    "(PagedEngineConfig) — the static-slot engine does "
+                    "not shard")
+            mesh = self._build_mesh(mesh_config)
         if isinstance(engine_config, PagedEngineConfig):
-            self._engine = PagedLLMEngine(engine_config, params=params)
+            self._engine = PagedLLMEngine(engine_config, params=params,
+                                          mesh=mesh)
             self._paged = True
         elif isinstance(engine_config, EngineConfig):
             self._engine = LLMEngine(engine_config, params=params)
@@ -60,6 +74,37 @@ class LLMServer:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake: Optional[asyncio.Event] = None
         self._streams: Dict[str, _Stream] = {}
+
+    @staticmethod
+    def _build_mesh(mesh_config):
+        """Build the replica's device mesh: exactly the devices the
+        config's fixed axes need (a replica may own a subset of the
+        host's chips). A wildcard axis (the MeshConfig default data=-1)
+        is pinned to 1 — an engine replica must not silently absorb
+        every visible chip into a data axis it would only replicate
+        over; scale-out across chips-beyond-TP belongs to
+        num_replicas."""
+        import dataclasses as _dc
+        import math as _math
+        import jax
+        sizes = {"data": mesh_config.data, "fsdp": mesh_config.fsdp,
+                 "tensor": mesh_config.tensor,
+                 "sequence": mesh_config.sequence,
+                 "pipeline": mesh_config.pipeline,
+                 "expert": mesh_config.expert}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if wild:
+            mesh_config = _dc.replace(mesh_config,
+                                      **{k: 1 for k in wild})
+            for k in wild:
+                sizes[k] = 1
+        needed = _math.prod(sizes.values())
+        devices = jax.devices()
+        if len(devices) < needed:
+            raise ValueError(
+                f"mesh needs {needed} devices, replica sees "
+                f"{len(devices)}")
+        return mesh_config.build(devices[:needed])
 
     # -- engine drive ------------------------------------------------------
 
@@ -233,11 +278,12 @@ class LLMServer:
 
 def build_llm_deployment(engine_config, *, name: str = "LLMServer",
                          num_replicas: int = 1, params=None,
-                         max_ongoing_requests: int = 64):
+                         max_ongoing_requests: int = 64,
+                         mesh_config=None):
     """Serve application for the engine
     (reference: serve/llm/__init__.py:92 build_llm_deployment)."""
     from .. import serve
     deployment = serve.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests)
-    return deployment.bind(engine_config, params)
+    return deployment.bind(engine_config, params, mesh_config)

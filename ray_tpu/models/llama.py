@@ -186,39 +186,66 @@ class Attention(nn.Module):
             vp = vp.at[:, page_of, offset, :].set(v_rows)
             new_cache = dict(kv_cache, k=kp, v=vp)
             q1 = q[:, :, 0, :]  # [B, heads, hd]
-            if jax.default_backend() == "tpu":
-                from jax.experimental.pallas.ops.tpu.paged_attention \
-                    .paged_attention_kernel import paged_attention
-                n_pages = block_tables.shape[1]
-                # kernel requires pages_per_sequence % block == 0
-                ppcb = next(d for d in range(min(8, n_pages), 0, -1)
-                            if n_pages % d == 0)
-                out1 = paged_attention(
-                    (q1 * hd ** -0.5).astype(kp.dtype), kp, vp,
-                    lengths + 1, block_tables,
-                    pages_per_compute_block=ppcb)
-            else:
+
+            def paged_kernel(q_, kp_, vp_, lengths_, tables_):
+                """Per-shard paged attention: q_ holds LOCAL heads,
+                kp_/vp_ LOCAL kv heads (head-parallel — no collectives
+                needed). Runs unsharded when there is no tensor axis."""
+                if jax.default_backend() == "tpu":
+                    from jax.experimental.pallas.ops.tpu.paged_attention \
+                        .paged_attention_kernel import paged_attention
+                    n_pages = tables_.shape[1]
+                    # kernel requires pages_per_sequence % block == 0
+                    ppcb = next(d for d in range(min(8, n_pages), 0, -1)
+                                if n_pages % d == 0)
+                    return paged_attention(
+                        (q_ * hd ** -0.5).astype(kp_.dtype), kp_, vp_,
+                        lengths_ + 1, tables_,
+                        pages_per_compute_block=ppcb)
                 # Gather fallback: materialize each row's pages densely.
                 # [B, pages_per_seq, kvh, ps, hd] -> [B, kvh, L, hd]
-                gk = jnp.transpose(kp, (1, 0, 2, 3))[block_tables]
-                gv = jnp.transpose(vp, (1, 0, 2, 3))[block_tables]
-                L = block_tables.shape[1] * page_size
+                B_ = q_.shape[0]
+                gk = jnp.transpose(kp_, (1, 0, 2, 3))[tables_]
+                gv = jnp.transpose(vp_, (1, 0, 2, 3))[tables_]
+                L = tables_.shape[1] * page_size
                 gk = jnp.transpose(gk, (0, 2, 1, 3, 4)).reshape(
-                    B, kp.shape[0], L, hd)
+                    B_, kp_.shape[0], L, hd)
                 gv = jnp.transpose(gv, (0, 2, 1, 3, 4)).reshape(
-                    B, vp.shape[0], L, hd)
-                groups = cfg.num_heads // cfg.num_kv_heads
-                gk = jnp.repeat(gk, groups, axis=1)
-                gv = jnp.repeat(gv, groups, axis=1)
+                    B_, vp_.shape[0], L, hd)
+                groups_ = q_.shape[1] // kp_.shape[0]
+                gk = jnp.repeat(gk, groups_, axis=1)
+                gv = jnp.repeat(gv, groups_, axis=1)
                 logits = jnp.einsum(
-                    "bhd,bhkd->bhk", q1.astype(jnp.float32),
+                    "bhd,bhkd->bhk", q_.astype(jnp.float32),
                     gk.astype(jnp.float32)) * (hd ** -0.5)
                 kv_pos = jnp.arange(L)[None, :]
-                mask = kv_pos <= lengths[:, None]
+                mask = kv_pos <= lengths_[:, None]
                 logits = jnp.where(mask[:, None, :], logits, -1e30)
                 probs = jax.nn.softmax(logits, axis=-1)
-                out1 = jnp.einsum("bhk,bhkd->bhd", probs,
+                return jnp.einsum("bhk,bhkd->bhd", probs,
                                   gv.astype(jnp.float32))
+
+            # Tensor-parallel serving: when tracing under a serving mesh
+            # whose `tensor` axis is >1, run the kernel per-shard via
+            # shard_map (heads/kv_heads sharded, attention is
+            # head-parallel so no collectives). GSPMD cannot partition
+            # the Pallas custom call itself, hence the explicit map
+            # (reference places TP engine workers via
+            # vllm_models.py:169-178; here TP is a mesh axis).
+            from ..parallel.mesh import current_serving_mesh
+            pm = current_serving_mesh()
+            tp = int(pm.shape.get("tensor", 1)) if pm is not None else 1
+            if tp > 1:
+                from jax.sharding import PartitionSpec as _P
+                from ..parallel._compat import shard_map as _shard_map
+                out1 = _shard_map(
+                    paged_kernel, mesh=pm,
+                    in_specs=(_P(None, "tensor", None), _P("tensor"),
+                              _P("tensor"), _P(None), _P(None, None)),
+                    out_specs=_P(None, "tensor", None))(
+                        q1, kp, vp, lengths, block_tables)
+            else:
+                out1 = paged_kernel(q1, kp, vp, lengths, block_tables)
             out = out1[:, :, None, :].astype(cfg.dtype)
         elif kv_cache is not None:
             # Decode: write new K/V at cache_index, attend over the cache.

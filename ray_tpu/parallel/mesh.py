@@ -21,6 +21,8 @@ LOGICAL_AXIS_RULES (t5x-style), overridable per MeshConfig.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 import math
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -28,6 +30,28 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# The mesh a serving engine is currently tracing/executing under.
+# Model code (e.g. the paged-attention kernel dispatch) reads this to
+# decide whether to shard_map over a tensor axis — our own channel, no
+# dependency on jax's legacy thread-resources internals.
+_SERVING_MESH: contextvars.ContextVar[Optional[Mesh]] = \
+    contextvars.ContextVar("rtpu_serving_mesh", default=None)
+
+
+@contextlib.contextmanager
+def serving_mesh(mesh: Optional[Mesh]):
+    """Mark `mesh` active for model-side sharding decisions (trace-time:
+    wrap every jit call whose trace should see it)."""
+    token = _SERVING_MESH.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _SERVING_MESH.reset(token)
+
+
+def current_serving_mesh() -> Optional[Mesh]:
+    return _SERVING_MESH.get()
 
 AXIS_ORDER = ("data", "fsdp", "expert", "pipeline", "sequence", "tensor")
 
