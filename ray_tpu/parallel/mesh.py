@@ -87,9 +87,13 @@ class MeshConfig:
     sequence: int = 1
     pipeline: int = 1
     expert: int = 1
-    # Axes that cross slice boundaries ride DCN, not ICI; list them here so
-    # multi-slice topologies lay out correctly (reference for the concept:
-    # jax multi-slice `dcn_mesh_shape`).
+    # Axes that cross slice boundaries ride DCN, not ICI; list them here
+    # so multi-slice topologies lay out correctly: `build()` places each
+    # DCN axis ACROSS slices (device groups) and every other axis within
+    # one slice, the layout `jax.experimental.mesh_utils.
+    # create_hybrid_device_mesh` produces (reference analog: multi-host
+    # topology in train/v2/api/config.py:114-123). The product of the
+    # DCN axes' sizes must equal the slice count.
     dcn_axes: Tuple[str, ...] = ()
     logical_axis_rules: Tuple[Tuple[str, object], ...] = \
         DEFAULT_LOGICAL_AXIS_RULES
@@ -115,15 +119,90 @@ class MeshConfig:
                 f"mesh {sizes} needs {fixed} devices, have {num_devices}")
         return sizes
 
-    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+    def build(self, devices: Optional[Sequence] = None,
+              num_slices: Optional[int] = None) -> Mesh:
         devices = list(devices if devices is not None else jax.devices())
         sizes = self.axis_sizes(len(devices))
-        shape = tuple(sizes[a] for a in AXIS_ORDER)
-        dev_array = np.asarray(devices).reshape(shape)
-        return Mesh(dev_array, AXIS_ORDER)
+        if not self.dcn_axes:
+            shape = tuple(sizes[a] for a in AXIS_ORDER)
+            dev_array = np.asarray(devices).reshape(shape)
+            return Mesh(dev_array, AXIS_ORDER)
+        return self._build_hybrid(devices, sizes, num_slices)
+
+    def _sliced_devices(self, devices: List, sizes: Dict[str, int],
+                        num_slices: Optional[int]) -> Tuple[List, int]:
+        """Validate + order devices for a hybrid layout: detect real
+        slices via `device.slice_index` (sorted by it) or emulate
+        contiguous virtual slices; check the DCN-axes product matches
+        the slice count and divides the device count. Returns the
+        ordered devices and the slice count."""
+        for axis in self.dcn_axes:
+            if axis not in sizes:
+                raise ValueError(f"unknown dcn axis {axis!r}")
+        dcn_total = math.prod(sizes[a] for a in self.dcn_axes)
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        if len(slice_ids) > 1:
+            if num_slices is not None and num_slices != len(slice_ids):
+                raise ValueError(
+                    f"num_slices={num_slices} but devices span "
+                    f"{len(slice_ids)} slices")
+            num_slices = len(slice_ids)
+            devices = sorted(
+                devices, key=lambda d: (getattr(d, "slice_index", 0),
+                                        getattr(d, "id", 0)))
+        elif num_slices is None:
+            num_slices = dcn_total
+        if dcn_total != num_slices:
+            raise ValueError(
+                f"dcn axes {self.dcn_axes} have total size {dcn_total} "
+                f"but the topology has {num_slices} slices")
+        if len(devices) % num_slices:
+            raise ValueError(
+                f"{len(devices)} devices not divisible into "
+                f"{num_slices} slices")
+        return devices, num_slices
+
+    def _build_hybrid(self, devices: List, sizes: Dict[str, int],
+                      num_slices: Optional[int]) -> Mesh:
+        """Hybrid ICI×DCN mesh: DCN axes vary across slices, ICI axes
+        within one. Real TPU slices are detected via `device.slice_index`
+        (devices grouped and ordered by it); hosts without slice ids
+        (CPU dryruns, single slice) emulate slices as contiguous device
+        groups — pass `num_slices` or let it default to the DCN-axes
+        product."""
+        devices, num_slices = self._sliced_devices(devices, sizes,
+                                                   num_slices)
+        dcn_shape = tuple(sizes[a] if a in self.dcn_axes else 1
+                          for a in AXIS_ORDER)
+        ici_shape = tuple(1 if a in self.dcn_axes else sizes[a]
+                          for a in AXIS_ORDER)
+        # [dcn..., ici...] then interleave per axis: each final axis is
+        # dcn_i * ici_i (one factor is 1), DCN major — so stepping a DCN
+        # axis crosses a slice boundary, stepping an ICI axis stays in
+        # the same contiguous slice group.
+        arr = np.asarray(devices).reshape(dcn_shape + ici_shape)
+        n = len(AXIS_ORDER)
+        arr = arr.transpose([x for i in range(n) for x in (i, n + i)])
+        arr = arr.reshape(tuple(sizes[a] for a in AXIS_ORDER))
+        return Mesh(arr, AXIS_ORDER)
 
     def rules_dict(self) -> Dict[str, object]:
         return dict(self.logical_axis_rules)
+
+    def slice_groups(self, devices: Optional[Sequence] = None,
+                     num_slices: Optional[int] = None) -> List[List]:
+        """Device groups per slice, in DCN-axis order — the unit for
+        host-plane (out-of-program) cross-slice collectives: one leader
+        per group talks over the `util.collective` ring while
+        in-program collectives stay on ICI within a group."""
+        devices = list(devices if devices is not None else jax.devices())
+        if not self.dcn_axes:
+            return [devices]
+        sizes = self.axis_sizes(len(devices))
+        devices, num_slices = self._sliced_devices(devices, sizes,
+                                                   num_slices)
+        per = len(devices) // num_slices
+        return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
 
 
 def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],

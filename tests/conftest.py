@@ -56,3 +56,38 @@ def ray_start_cluster():
     cluster = Cluster()
     yield cluster
     cluster.shutdown()
+
+
+@pytest.fixture
+def llm_cluster():
+    """Cluster for LLM serving tests (serve shut down before the node)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=300 * 1024 * 1024)
+    yield
+    try:
+        from ray_tpu import serve
+        serve.shutdown()
+    except Exception:
+        pass
+    ray_tpu.shutdown()
+
+
+def raw_http(host, port, method, path, body):
+    """One HTTP/1.1 request over a raw socket; returns (head, raw_body).
+    Raw so chunked-streaming framing stays visible to assertions."""
+    import json as _json
+    import socket as _socket
+    payload = _json.dumps(body).encode()
+    s = _socket.create_connection((host, int(port)), timeout=240)
+    s.sendall((f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+               f"Content-Length: {len(payload)}\r\n"
+               "Connection: close\r\n\r\n").encode() + payload)
+    data = b""
+    while True:
+        chunk = s.recv(65536)
+        if not chunk:
+            break
+        data += chunk
+    s.close()
+    head, _, rest = data.partition(b"\r\n\r\n")
+    return head.decode("latin1"), rest

@@ -7,16 +7,16 @@ here TP is a jax mesh axis; GSPMD shards the matmuls, shard_map runs the
 paged-attention kernel head-parallel)."""
 
 import json
-import socket
 
 import jax
 import numpy as np
 import pytest
 
-import ray_tpu
 from ray_tpu.llm.paged import PagedEngineConfig, PagedLLMEngine
 from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.parallel.mesh import MeshConfig
+
+from conftest import raw_http as _raw_http  # noqa: E402 — shared helper
 
 
 def tp_model():
@@ -76,35 +76,6 @@ def test_tp_prefix_sharing_under_sharding():
     assert eng.stats()["prefix_entries"] > 0
     second = eng.generate([list(prompt)], max_new_tokens=8)
     assert second == first
-
-
-@pytest.fixture
-def llm_cluster():
-    ray_tpu.init(num_cpus=4, object_store_memory=300 * 1024 * 1024)
-    yield
-    try:
-        from ray_tpu import serve
-        serve.shutdown()
-    except Exception:
-        pass
-    ray_tpu.shutdown()
-
-
-def _raw_http(host, port, method, path, body):
-    payload = json.dumps(body).encode()
-    s = socket.create_connection((host, port), timeout=240)
-    s.sendall((f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
-               f"Content-Length: {len(payload)}\r\n"
-               "Connection: close\r\n\r\n").encode() + payload)
-    data = b""
-    while True:
-        chunk = s.recv(65536)
-        if not chunk:
-            break
-        data += chunk
-    s.close()
-    head, _, rest = data.partition(b"\r\n\r\n")
-    return head.decode("latin1"), rest
 
 
 @pytest.mark.timeout_s(600)

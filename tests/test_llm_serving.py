@@ -5,7 +5,6 @@ deployments/prefill_decode_disagg/, request_router/)."""
 
 import asyncio
 import json
-import socket
 
 import numpy as np
 import pytest
@@ -209,33 +208,7 @@ def test_openai_shapes_direct():
 # cluster-level: HTTP streaming through the proxy
 # ---------------------------------------------------------------------------
 
-@pytest.fixture
-def llm_cluster():
-    ray_tpu.init(num_cpus=4, object_store_memory=300 * 1024 * 1024)
-    yield
-    try:
-        from ray_tpu import serve
-        serve.shutdown()
-    except Exception:
-        pass
-    ray_tpu.shutdown()
-
-
-def _raw_http(host, port, method, path, body):
-    payload = json.dumps(body).encode()
-    s = socket.create_connection((host, port), timeout=240)
-    s.sendall((f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
-               f"Content-Length: {len(payload)}\r\n"
-               "Connection: close\r\n\r\n").encode() + payload)
-    data = b""
-    while True:
-        chunk = s.recv(65536)
-        if not chunk:
-            break
-        data += chunk
-    s.close()
-    head, _, rest = data.partition(b"\r\n\r\n")
-    return head.decode("latin1"), rest
+from conftest import raw_http as _raw_http  # noqa: E402 — shared helper
 
 
 @pytest.mark.timeout_s(600)
