@@ -205,6 +205,27 @@ def cmd_perf(args):
     perf.main(quick=args.quick)
 
 
+def cmd_serve(args):
+    """`serve deploy/status/shutdown` (reference: serve/scripts.py —
+    the config-file production deploy path)."""
+    import ray_tpu
+    ray_tpu.init(address=_resolve_address(getattr(args, "address", None)))
+    from ray_tpu import serve as serve_api
+    if args.action == "deploy":
+        if not args.config:
+            raise SystemExit("serve deploy requires a config file path")
+        from ray_tpu.serve.config_file import deploy_config
+        names = deploy_config(args.config)
+        print(f"deployed applications: {', '.join(names)}")
+        print(f"http: {serve_api.get_http_address()}")
+    elif args.action == "status":
+        import json as _json
+        print(_json.dumps(serve_api.status(), indent=2, default=str))
+    elif args.action == "shutdown":
+        serve_api.shutdown()
+        print("serve shut down")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -256,6 +277,12 @@ def main(argv=None):
     p = sub.add_parser("perf")
     p.add_argument("--quick", action="store_true")
     p.set_defaults(fn=cmd_perf)
+
+    p = sub.add_parser("serve")
+    p.add_argument("action", choices=["deploy", "status", "shutdown"])
+    p.add_argument("config", nargs="?")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_serve)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -14,6 +14,11 @@ Routes:
   GET  /api/timeline                  chrome-trace JSON of task spans
   GET  /metrics                       Prometheus exposition
   GET  /-/healthz
+  GET  /                              web frontend (single-page app,
+                                      client/index.html — the analog of
+                                      the reference's React frontend in
+                                      dashboard/client/src/, rebuilt
+                                      dependency-free over these routes)
 """
 
 from __future__ import annotations
@@ -112,6 +117,12 @@ class DashboardHead:
 
         if path == "/-/healthz":
             return (200, b"ok", "text/plain")
+        if path in ("/", "/index.html"):
+            import os
+            page = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "client", "index.html")
+            with open(page, "rb") as f:
+                return (200, f.read(), "text/html; charset=utf-8")
         if path == "/metrics":
             from .._internal.core_worker import get_core_worker
             from ..util.metrics import (collect_cluster_metrics,

@@ -18,6 +18,13 @@ class DataContext:
     # fairly instead of each claiming a fixed in-flight window
     # (reference: execution/resource_manager.py).
     execution_cpu_budget: Optional[int] = None
+    # Pipeline-wide object-store byte budget: when the bytes buffered in
+    # operator queues (+ the consumer queue) exceed it, map operators
+    # stop launching tasks until the consumer drains — a wide-row
+    # pipeline cannot OOM the store while CPU-idle (reference:
+    # execution/resource_manager.py object-store budgets +
+    # backpressure_policy/). None = unlimited.
+    execution_object_store_byte_budget: Optional[int] = None
     shuffle_strategy: str = "push"
     # Streaming executor buffers (in blocks): per-operator edge buffer and
     # the consumer-facing output queue — both bound memory and carry the

@@ -477,6 +477,22 @@ class Dataset:
                 pa.table(BlockAccessor(block).to_numpy_batch())
             pacsv.write_csv(table, os.path.join(path, f"part-{i:05d}.csv"))
 
+    def write_tfrecords(self, path: str):
+        """tf.train.Example TFRecord shards, one file per block
+        (reference: Dataset.write_tfrecords — encoded without a
+        tensorflow dependency; see read_api's Example codec)."""
+        import os
+
+        import ray_tpu
+        from .read_api import _row_to_example, _tfrecord_write
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._execute()):
+            block = ray_tpu.get(ref)
+            rows = BlockAccessor(block).to_pylist()
+            _tfrecord_write(
+                os.path.join(path, f"part-{i:05d}.tfrecords"),
+                (_row_to_example(_jsonable(r)) for r in rows))
+
     def write_json(self, path: str):
         import json as _json
         import os

@@ -186,3 +186,267 @@ def read_binary_files(paths) -> Dataset:
                 return [{"path": path, "bytes": f.read()}]
         return [_read.remote(f) for f in files]
     return Dataset(source, [], name="read_binary_files")
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: Optional[str] = None,
+                include_paths: bool = False) -> Dataset:
+    """Decode image files into {"image": HxWxC uint8 array} rows
+    (reference: data/_internal/datasource/image_datasource.py — PIL
+    decode, optional resize/mode, include_paths)."""
+    files = _expand_paths(paths)
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path, size=size, mode=mode,
+                  include_paths=include_paths):
+            from PIL import Image
+            img = Image.open(path)
+            if size is not None:
+                img = img.resize((size[1], size[0]))  # (h, w) -> PIL wh
+            if mode is not None:
+                img = img.convert(mode)
+            row: Dict[str, Any] = {"image": np.asarray(img)}
+            if include_paths:
+                row["path"] = path
+            return [row]
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_images")
+
+
+# -- TFRecord wire format (reference: datasource/tfrecords_datasource.py;
+# record framing: u64 length, u32 masked-crc(length), payload,
+# u32 masked-crc(payload), crc = crc32c with the TF mask rotation) -----
+
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in _builtin_range(256):
+            crc = i
+            for _ in _builtin_range(8):
+                crc = (crc >> 1) ^ (0x82F63B78 if crc & 1 else 0)
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc = _CRC32C_TABLE[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _tfrecord_iter(path: str):
+    import struct
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,), (_len_crc,) = (struct.unpack("<Q", header[:8]),
+                                      struct.unpack("<I", header[8:]))
+            payload = f.read(length)
+            f.read(4)  # payload crc (verification optional, like TF)
+            yield payload
+
+
+def _tfrecord_write(path: str, payloads) -> int:
+    import struct
+    n = 0
+    with open(path, "wb") as f:
+        for payload in payloads:
+            header = struct.pack("<Q", len(payload))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(payload)
+            f.write(struct.pack("<I", _masked_crc(payload)))
+            n += 1
+    return n
+
+
+def _example_to_row(payload: bytes) -> Dict[str, Any]:
+    """Decode a tf.train.Example proto without tensorflow: hand-rolled
+    protobuf walk of Features -> feature map -> {bytes,float,int64}
+    lists (the three TF feature types)."""
+    row: Dict[str, Any] = {}
+
+    def varint(buf, pos):
+        shift = result = 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result, pos
+            shift += 7
+
+    def fields(buf):
+        pos = 0
+        while pos < len(buf):
+            tag, pos = varint(buf, pos)
+            number, wire = tag >> 3, tag & 7
+            if wire == 2:
+                size, pos = varint(buf, pos)
+                yield number, buf[pos:pos + size]
+                pos += size
+            elif wire == 0:
+                value, pos = varint(buf, pos)
+                yield number, value
+            elif wire == 5:
+                yield number, buf[pos:pos + 4]
+                pos += 4
+            elif wire == 1:
+                yield number, buf[pos:pos + 8]
+                pos += 8
+            else:
+                raise ValueError(f"unsupported wire type {wire}")
+
+    import struct
+    for num, features in fields(payload):
+        if num != 1:
+            continue
+        for fnum, entry in fields(features):
+            if fnum != 1:
+                continue
+            key = value = None
+            for enum, edata in fields(entry):
+                if enum == 1:
+                    key = edata.decode()
+                elif enum == 2:
+                    for vnum, vdata in fields(edata):
+                        if vnum == 1:      # BytesList
+                            value = [b for _n, b in fields(vdata)]
+                        elif vnum == 2:    # FloatList
+                            value = []
+                            for _in, data in fields(vdata):
+                                if isinstance(data, bytes):  # packed
+                                    value.extend(struct.unpack(
+                                        f"<{len(data) // 4}f", data))
+                        elif vnum == 3:    # Int64List
+                            def signed(v):
+                                # two's-complement decode of the
+                                # unsigned varint (TF writes -1 as ten
+                                # 0xFF.. bytes)
+                                return v - (1 << 64) if v >= (1 << 63) \
+                                    else v
+                            value = []
+                            for _in, data in fields(vdata):
+                                if isinstance(data, bytes):  # packed
+                                    pos = 0
+                                    while pos < len(data):
+                                        v, pos = varint(data, pos)
+                                        value.append(signed(v))
+                                else:      # unpacked varint
+                                    value.append(signed(data))
+            if key is not None and value is not None:
+                row[key] = value[0] if len(value) == 1 else value
+    return row
+
+
+def _row_to_example(row: Dict[str, Any]) -> bytes:
+    """Encode a row as a tf.train.Example proto (inverse of
+    _example_to_row; enough of protobuf to round-trip with TF)."""
+    import struct
+
+    def varint(n: int) -> bytes:
+        # protobuf varints are unsigned: negatives go as 64-bit two's
+        # complement (ten bytes), like TF writes them
+        n &= (1 << 64) - 1
+        out = b""
+        while True:
+            bits = n & 0x7F
+            n >>= 7
+            if n:
+                out += bytes([bits | 0x80])
+            else:
+                return out + bytes([bits])
+
+    def field(number: int, wire: int, payload: bytes) -> bytes:
+        return varint((number << 3) | wire) + payload
+
+    def ld(number: int, payload: bytes) -> bytes:
+        return field(number, 2, varint(len(payload)) + payload)
+
+    features = b""
+    for key, value in row.items():
+        values = value if isinstance(value, (list, tuple)) \
+            else [value]
+        if all(isinstance(v, (bytes, str)) for v in values):
+            blist = b"".join(
+                ld(1, v.encode() if isinstance(v, str) else v)
+                for v in values)
+            feature = ld(1, blist)
+        elif all(isinstance(v, (int, np.integer)) for v in values):
+            packed = b"".join(varint(int(v)) for v in values)
+            feature = ld(3, field(1, 2, varint(len(packed)) + packed))
+        else:
+            packed = struct.pack(f"<{len(values)}f",
+                                 *[float(v) for v in values])
+            feature = ld(2, field(1, 2, varint(len(packed)) + packed))
+        features += ld(1, ld(1, key.encode()) + ld(2, feature))
+    return ld(1, features)
+
+
+def read_tfrecords(paths) -> Dataset:
+    """tf.train.Example TFRecord files -> rows (reference:
+    datasource/tfrecords_datasource.py — no tensorflow import; the
+    record framing and Example proto are decoded directly)."""
+    files = _expand_paths(paths)
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            rows = [_example_to_row(p) for p in _tfrecord_iter(path)]
+            return _rows_to_block(rows)
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_tfrecords")
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = 1) -> Dataset:
+    """Rows from a DBAPI query (reference:
+    datasource/sql_datasource.py — connection_factory is a zero-arg
+    callable returning a DBAPI connection, e.g. a sqlite3/psycopg
+    connector; the query runs once per shard with OFFSET/LIMIT when
+    parallelism > 1)."""
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(shard, shards):
+            conn = connection_factory()
+            try:
+                cursor = conn.cursor()
+                query = sql
+                if shards > 1:
+                    # per-shard pagination; assumes a stable ordering in
+                    # the query (the reference documents the same). The
+                    # subquery alias is required by PostgreSQL and
+                    # harmless on sqlite/mysql.
+                    count = conn.cursor()
+                    count.execute(
+                        f"SELECT COUNT(*) FROM ({sql}) AS _rtpu_sub")
+                    total = count.fetchone()[0]
+                    per = -(-total // shards)
+                    query = (f"SELECT * FROM ({sql}) AS _rtpu_sub "
+                             f"LIMIT {per} OFFSET {shard * per}")
+                cursor.execute(query)
+                columns = [d[0] for d in cursor.description]
+                rows = [dict(zip(columns, r)) for r in cursor.fetchall()]
+                return _rows_to_block(rows)
+            finally:
+                conn.close()
+        return [_read.remote(i, parallelism)
+                for i in _builtin_range(parallelism)]
+    return Dataset(source, [], name="read_sql")

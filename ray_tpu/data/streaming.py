@@ -75,10 +75,19 @@ class MapOp(Op):
 
     def __init__(self, name: str, fns: List[Callable],
                  compute: Optional[str] = None,
-                 concurrency: Optional[int] = None):
+                 concurrency: Optional[Any] = None):
         super().__init__(name)
         self.fns = fns
         self.compute = compute
+        # `concurrency` for actor pools may be a (min, max) tuple: the
+        # pool autoscales between the bounds on queue depth (reference:
+        # data/_internal/execution/autoscaler/ — the actor-pool
+        # autoscaler; a plain int is a fixed-size pool).
+        if isinstance(concurrency, (tuple, list)):
+            self.min_actors, self.max_actors = concurrency
+            concurrency = int(self.max_actors)
+        else:
+            self.min_actors = self.max_actors = concurrency
         self.concurrency = concurrency
         ctx = DataContext.get_current()
         # Static fallback; the executor's ResourceManager overrides this
@@ -88,13 +97,20 @@ class MapOp(Op):
         self._remote_fn = None
         self._actors: List = []
         self._actor_rr = 0
+        self._actor_cls = None
+        self._idle_since: Optional[float] = None
+        self._scale_down_after_s = 1.0
 
     def start(self):
         import ray_tpu
         if self.compute == "actors":
-            actor_cls = ray_tpu.remote(_MapWorker)
-            self._actors = [actor_cls.remote(self.fns)
-                            for _ in range(max(1, self.window))]
+            self._actor_cls = ray_tpu.remote(_MapWorker)
+            # no concurrency given: a fixed pool sized by the default
+            # task window (the pre-autoscaler behavior)
+            initial = self.min_actors if self.min_actors is not None \
+                else self.window
+            self._actors = [self._actor_cls.remote(self.fns)
+                            for _ in range(max(1, initial))]
         else:
             fns = self.fns
 
@@ -118,12 +134,50 @@ class MapOp(Op):
     def num_in_flight(self) -> int:
         return len(self.in_flight)
 
+    def _autoscale_actors(self):
+        """Grow the pool when the backlog saturates every worker; shrink
+        to min after a sustained idle window (reference:
+        execution/autoscaler/default_autoscaler.py — queue-depth-driven
+        actor-pool scaling)."""
+        import time as _time
+
+        import ray_tpu
+        if self._actor_cls is None or \
+                self.min_actors == self.max_actors:
+            return
+        busy = len(self.in_flight) >= len(self._actors)
+        backlog = len(self.input)
+        if busy and backlog > 0 and \
+                len(self._actors) < int(self.max_actors):
+            self._actors.append(self._actor_cls.remote(self.fns))
+            self._idle_since = None
+            return
+        if backlog == 0 and not self.in_flight:
+            now = _time.monotonic()
+            if self._idle_since is None:
+                self._idle_since = now
+            elif now - self._idle_since > self._scale_down_after_s and \
+                    len(self._actors) > max(1, int(self.min_actors or 1)):
+                doomed = self._actors.pop()
+                try:
+                    ray_tpu.kill(doomed)
+                except Exception:  # noqa: BLE001
+                    pass
+        else:
+            self._idle_since = None
+
     def schedule(self, output_room: int,
                  window: Optional[int] = None) -> bool:
         import ray_tpu
         progress = False
         if window is not None:
             self.window = window
+        if self._actors:
+            self._autoscale_actors()
+            # actor pools are bounded by pool size (byte backpressure
+            # still applies via window=0)
+            if self.window:
+                self.window = len(self._actors) * 2
         # Launch: bounded by the task window AND downstream room (the
         # backpressure signal — never produce more than the consumer and
         # output buffer can hold).
@@ -204,9 +258,77 @@ class ResourceManager:
             except Exception:  # noqa: BLE001 — no cluster yet
                 budget = 0
         self.budget = max(1, budget or ctx.max_tasks_in_flight)
+        self.byte_budget = ctx.execution_object_store_byte_budget
         self._map_ops = [op for op in ops if isinstance(op, MapOp)]
+        self._ops = ops
+        self._size_cache: Dict[str, int] = {}
+        self._default_size = ctx.target_min_block_size
+        self.buffered_bytes = 0
+        self._over_bytes = False
+
+    def _ref_size(self, ref) -> int:
+        """Local size of a buffered block (memory store / plasma);
+        cached per ref — queue membership changes, sizes don't."""
+        key = ref.hex()
+        size = self._size_cache.get(key)
+        if size is not None:
+            return size
+        size = self._default_size
+        try:
+            from .._internal.core_worker import get_core_worker
+            cw = get_core_worker()
+            oid = ref.id()
+            entry = cw.memory_store.get_entry(oid)
+            raw = getattr(entry, "raw", None) if entry is not None \
+                else None
+            if raw is not None:
+                size = len(raw)
+            elif cw.plasma.contains(oid):
+                size = cw.plasma.size_of(oid)
+        except Exception:  # noqa: BLE001 — size is advisory
+            pass
+        self._size_cache[key] = size
+        if len(self._size_cache) > 4096:
+            self._size_cache.clear()
+        return size
+
+    def update_byte_usage(self, out_queue=None):
+        """Recompute bytes of PRODUCED blocks still buffered — operator
+        outputs, downstream inputs, and the consumer queue; sets the
+        over-budget flag the windows consult. The source op's own input
+        refs are excluded: those bytes can only shrink by LAUNCHING
+        tasks, so gating launches on them would livelock (they are the
+        reference's 'reserved' budget, not the throttleable part)."""
+        if self.byte_budget is None:
+            return
+        total = 0
+        for i, op in enumerate(self._ops):
+            refs = list(op.out)
+            if i > 0:
+                refs += list(op.input)
+            for ref in refs:
+                total += self._ref_size(ref)
+        if out_queue is not None:
+            for ref in list(out_queue.queue):
+                if ref is not _SENTINEL:
+                    total += self._ref_size(ref)
+        self.buffered_bytes = total
+        self._over_bytes = total >= self.byte_budget
 
     def window_for(self, op: "MapOp") -> int:
+        if self._over_bytes:
+            # Byte backpressure: stop LAUNCHING; in-flight tasks finish
+            # and buffered blocks drain to the consumer. Liveness: if
+            # nothing is in flight anywhere, admit ONE task on the
+            # first unfinished op so a budget smaller than a single
+            # block still makes progress.
+            if not any(o.in_flight for o in self._map_ops):
+                first_active = next(
+                    (o for o in self._map_ops if not o.output_done),
+                    None)
+                if op is first_active:
+                    return 1
+            return 0
         active = [o for o in self._map_ops if not o.output_done]
         share = max(1, self.budget // max(1, len(active)))
         if op.concurrency:
@@ -283,9 +405,11 @@ class StreamingExecutor:
                         return
                 return
             resource_manager = ResourceManager(self.ops)
+            self.resource_manager = resource_manager
             idle_backoff = 0.001
             while not self._stop.is_set():
                 progress = False
+                resource_manager.update_byte_usage(self.out_queue)
                 for i, op in enumerate(self.ops):
                     if i + 1 < len(self.ops):
                         room = per_op_buffer

@@ -17,9 +17,16 @@ from .dqn import DQN, DQNConfig, DQNLearner, ReplayBufferActor
 from .env_runner import SingleAgentEnvRunner
 from .impala import Impala, ImpalaConfig, ImpalaLearner
 from .learner import PPOLearner
+from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
+                          MultiAgentPPO, MultiAgentPPOConfig,
+                          make_multi_agent)
 from .offline import BC, BCConfig, record_episodes
+from .sac import SAC, SACConfig, SACLearner
 
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
            "Impala", "ImpalaConfig", "ImpalaLearner",
            "DQN", "DQNConfig", "DQNLearner", "ReplayBufferActor",
+           "SAC", "SACConfig", "SACLearner",
+           "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
+           "MultiAgentPPOConfig", "make_multi_agent",
            "BC", "BCConfig", "record_episodes"]

@@ -82,11 +82,16 @@ class ReplayBufferActor:
     capacity scales with cluster memory, and N shards parallelize the
     sample path)."""
 
-    def __init__(self, capacity: int, obs_shape, seed: int = 0):
+    def __init__(self, capacity: int, obs_shape, seed: int = 0,
+                 action_shape=(), action_dtype="int32"):
         self._capacity = capacity
         self._obs = np.zeros((capacity,) + tuple(obs_shape), np.float32)
         self._next_obs = np.zeros_like(self._obs)
-        self._actions = np.zeros(capacity, np.int32)
+        # () int32 for discrete control; (act_dim,) float32 for
+        # continuous (SAC reuses these shards — reference builds SAC on
+        # DQN's replay machinery, sac.py:560)
+        self._actions = np.zeros((capacity,) + tuple(action_shape),
+                                 np.dtype(action_dtype))
         self._rewards = np.zeros(capacity, np.float32)
         self._dones = np.zeros(capacity, np.float32)
         # per-transition bootstrap discount gamma^k (n-step targets may

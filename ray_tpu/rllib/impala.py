@@ -81,6 +81,17 @@ class ImpalaConfig:
         # keeps prying it open.
         self.entropy_coeff_final: Optional[float] = None
         self.entropy_decay_iters = 0
+        # linear lr decay to `lr_final` over `lr_decay_iters` learner
+        # iterations (None = constant). The late-training plateau just
+        # under the CartPole bar (best 440 @ 8M steps, round-4 artifact)
+        # is lr-oscillation: a converged near-deterministic policy keeps
+        # getting kicked off the optimum by full-size Adam steps.
+        self.lr_final: Optional[float] = None
+        self.lr_decay_iters = 0
+        # iterations at full lr before the decay starts (the policy
+        # needs the large steps to reach the 475-basin first; decaying
+        # from iter 0 froze a run at ~394)
+        self.lr_decay_begin_iters = 0
         self.rho_bar = 1.0
         self.c_bar = 1.0
         self.normalize_advantages = True
@@ -132,7 +143,10 @@ class ImpalaLearner:
                  rho_bar: float = 1.0, c_bar: float = 1.0,
                  grad_clip: float = 40.0, seed: int = 0,
                  normalize_advantages: bool = True,
-                 vtrace_lambda: float = 0.95):
+                 vtrace_lambda: float = 0.95,
+                 lr_final: Optional[float] = None,
+                 lr_decay_steps: int = 0,
+                 lr_decay_begin: int = 0):
         import jax
         import jax.numpy as jnp
         import optax
@@ -146,6 +160,11 @@ class ImpalaLearner:
         sample_obs = jnp.zeros((1,) + tuple(obs_shape), jnp.float32)
         self.params = self.model.init(
             jax.random.PRNGKey(seed), sample_obs)["params"]
+        if lr_final is not None and lr_decay_steps > 0:
+            lr = optax.linear_schedule(
+                init_value=lr, end_value=lr_final,
+                transition_steps=lr_decay_steps,
+                transition_begin=lr_decay_begin)
         self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
                               optax.adam(lr))
         self.opt_state = self.tx.init(self.params)
@@ -290,7 +309,12 @@ class Impala:
             c_bar=config.c_bar, grad_clip=config.grad_clip,
             seed=config.seed,
             normalize_advantages=config.normalize_advantages,
-            vtrace_lambda=config.vtrace_lambda)
+            vtrace_lambda=config.vtrace_lambda,
+            lr_final=config.lr_final,
+            # the schedule counts optimizer steps: num_epochs per iter
+            lr_decay_steps=config.lr_decay_iters * config.num_epochs,
+            lr_decay_begin=config.lr_decay_begin_iters *
+            config.num_epochs)
         self._broadcast_weights()
         # continuous sampling pipeline: sample ref -> owning runner
         self._inflight: Dict[Any, Any] = {}

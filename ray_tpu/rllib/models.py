@@ -51,3 +51,61 @@ def sample_action(params, model, obs, rng):
     logp = jax.nn.log_softmax(logits)[
         jnp.arange(logits.shape[0]), action]
     return action, logp, value
+
+
+class SquashedGaussianPolicy(nn.Module):
+    """Continuous-control policy: ReLU torso -> (mean, log_std), actions
+    tanh-squashed to [-1, 1] (reference: SAC's default policy head in
+    rllib/algorithms/sac/ — torch SACTorchModel; env-side scaling to the
+    action bounds happens in the runner)."""
+    act_dim: int
+    hidden: Sequence[int] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(self.dtype)
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+        mean = nn.Dense(self.act_dim, dtype=self.dtype,
+                        kernel_init=nn.initializers.orthogonal(0.01))(x)
+        log_std = nn.Dense(
+            self.act_dim, dtype=self.dtype,
+            kernel_init=nn.initializers.orthogonal(0.01))(x)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        return mean, log_std
+
+
+def squashed_sample(mean, log_std, rng):
+    """Reparameterized tanh-Gaussian sample with its log-prob (the
+    change-of-variables correction summed over action dims)."""
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    action = jnp.tanh(pre)
+    logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            ).sum(-1)
+    # log det of tanh: sum log(1 - tanh^2), in the numerically stable
+    # 2*(log2 - x - softplus(-2x)) form
+    logp -= (2.0 * (jnp.log(2.0) - pre -
+                    jax.nn.softplus(-2.0 * pre))).sum(-1)
+    return action, logp
+
+
+class ContinuousQMLP(nn.Module):
+    """Q(s, a) for continuous actions: ReLU MLP over the concatenation
+    (reference: SAC's twin Q heads)."""
+    hidden: Sequence[int] = (256, 256)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs, action):
+        x = jnp.concatenate(
+            [obs.astype(self.dtype), action.astype(self.dtype)], axis=-1)
+        for width in self.hidden:
+            x = nn.relu(nn.Dense(width, dtype=self.dtype)(x))
+        q = nn.Dense(1, dtype=self.dtype,
+                     kernel_init=nn.initializers.orthogonal(1.0))(x)
+        return jnp.squeeze(q, -1)

@@ -1,0 +1,417 @@
+"""Multi-agent RL: env API, runner, and PPO trainer
+(reference: rllib/env/multi_agent_env.py — MultiAgentEnv + make_multi_agent
+:379; rllib/env/multi_agent_env_runner.py:68 MultiAgentEnvRunner;
+policy mapping via config.multi_agent(policy_mapping_fn=...)).
+
+TPU-first shape: each runner steps N independent copies of the
+multi-agent env and flattens (env, agent) slots into ONE batched policy
+forward per POLICY (shared-policy agents ride the same jitted call);
+fragments come back keyed by policy id so each policy's PPOLearner does
+its usual GAE + clipped-surrogate update."""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class MultiAgentEnv:
+    """Dict-keyed env protocol (reference: multi_agent_env.py).
+
+    reset() -> (obs_dict, info_dict)
+    step(action_dict) -> (obs, rewards, terminateds, truncateds, infos)
+    with per-agent keys; terminateds/truncateds carry "__all__"."""
+
+    agents: List[str] = []
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+def make_multi_agent(env_name: str, num_agents: int = 2):
+    """N independent copies of a gym env as one MultiAgentEnv, agents
+    "agent_0".."agent_{N-1}" (reference: make_multi_agent :379 — the
+    standard way to lift a single-agent env for multi-agent tests).
+    Sub-envs auto-reset individually on done (same-step semantics: the
+    done step carries the real final reward; the returned obs is the
+    reset obs)."""
+    import gymnasium as gym
+
+    class _IndependentMultiAgent(MultiAgentEnv):
+        def __init__(self, seed: int = 0):
+            self.agents = [f"agent_{i}" for i in range(num_agents)]
+            self._envs = {a: gym.make(env_name) for a in self.agents}
+            self._seed = seed
+
+        @property
+        def observation_space(self):
+            return next(iter(self._envs.values())).observation_space
+
+        @property
+        def action_space(self):
+            return next(iter(self._envs.values())).action_space
+
+        def reset(self, seed: Optional[int] = None):
+            seed = self._seed if seed is None else seed
+            obs, infos = {}, {}
+            for i, (agent, env) in enumerate(self._envs.items()):
+                obs[agent], infos[agent] = env.reset(seed=seed + i)
+            return obs, infos
+
+        def step(self, action_dict):
+            obs, rewards, terms, truncs, infos = {}, {}, {}, {}, {}
+            for agent, env in self._envs.items():
+                o, r, te, tr, info = env.step(action_dict[agent])
+                if te or tr:
+                    info = dict(info, final_obs=o)
+                    o, _ = env.reset()
+                obs[agent] = o
+                rewards[agent] = r
+                terms[agent] = te
+                truncs[agent] = tr
+                infos[agent] = info
+            terms["__all__"] = all(terms[a] for a in self.agents)
+            truncs["__all__"] = all(truncs[a] for a in self.agents)
+            return obs, rewards, terms, truncs, infos
+
+    return _IndependentMultiAgent
+
+
+class MultiAgentEnvRunner:
+    """Samples PPO fragments from N copies of a multi-agent env, one
+    batched policy forward per policy id per step (reference:
+    multi_agent_env_runner.py:68; connector-style slot flattening)."""
+
+    def __init__(self, env_maker: Callable[..., MultiAgentEnv],
+                 num_envs: int, fragment_len: int,
+                 policy_mapping: Dict[str, str],
+                 model_configs: Dict[str, Dict[str, Any]],
+                 num_actions: int, seed: int = 0, gamma: float = 0.99):
+        import jax
+
+        from .models import ActorCriticMLP, sample_action
+
+        self._envs = [env_maker(seed=seed + 97 * i)
+                      for i in range(num_envs)]
+        self._T = fragment_len
+        self._gamma = gamma
+        self._mapping = dict(policy_mapping)
+        agents = self._envs[0].agents
+        self._agents = list(agents)
+        # slot = (env_idx, agent); grouped per policy for batched forwards
+        self._slots: Dict[str, List[Tuple[int, str]]] = {}
+        for e in range(num_envs):
+            for agent in agents:
+                pid = self._mapping[agent]
+                self._slots.setdefault(pid, []).append((e, agent))
+        self._models = {
+            pid: ActorCriticMLP(
+                num_actions=num_actions,
+                hidden=tuple(cfg.get("hidden", (64, 64))))
+            for pid, cfg in model_configs.items()}
+        self._sample_fns = {
+            pid: jax.jit(lambda p, obs, rng, m=model:
+                         sample_action(p, m, obs, rng))
+            for pid, model in self._models.items()}
+        self._rng = jax.random.PRNGKey(seed)
+        self._params: Dict[str, Any] = {}
+        self._obs: Dict[Tuple[int, str], np.ndarray] = {}
+        for e, env in enumerate(self._envs):
+            obs, _ = env.reset(seed=seed + 31 * e)
+            for agent, o in obs.items():
+                self._obs[(e, agent)] = np.asarray(o, np.float32)
+        self._episode_returns = {k: 0.0 for k in self._obs}
+        self._completed: Dict[str, List[float]] = \
+            {pid: [] for pid in self._slots}
+
+    def observation_shape(self):
+        return next(iter(self._obs.values())).shape
+
+    def set_weights(self, params_by_policy: Dict[str, Any]) -> bool:
+        self._params.update(params_by_policy)
+        return True
+
+    def _forward(self, pid: str, obs: np.ndarray):
+        import jax
+        self._rng, key = jax.random.split(self._rng)
+        action, logp, value = self._sample_fns[pid](
+            self._params[pid], obs, key)
+        return (np.asarray(action), np.asarray(logp),
+                np.asarray(value))
+
+    def sample(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Per-policy PPO fragments: {policy_id: {obs [T, M, ...],
+        actions, logp, values, rewards, dones [T, M],
+        bootstrap_value [M], episode_returns}}."""
+        assert self._params, "set_weights first"
+        T = self._T
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        buffers = {}
+        for pid, slots in self._slots.items():
+            M = len(slots)
+            obs_shape = self.observation_shape()
+            buffers[pid] = {
+                "obs": np.empty((T, M) + obs_shape, np.float32),
+                "actions": np.empty((T, M), np.int32),
+                "logp": np.empty((T, M), np.float32),
+                "values": np.empty((T, M), np.float32),
+                "rewards": np.empty((T, M), np.float32),
+                "dones": np.empty((T, M), np.float32),
+            }
+        for t in range(T):
+            actions_by_env: Dict[int, Dict[str, Any]] = {}
+            per_policy = {}
+            for pid, slots in self._slots.items():
+                obs = np.stack([self._obs[s] for s in slots])
+                action, logp, value = self._forward(pid, obs)
+                per_policy[pid] = (obs, action, logp, value)
+                for j, (e, agent) in enumerate(slots):
+                    actions_by_env.setdefault(e, {})[agent] = \
+                        int(action[j])
+            step_results = {}
+            for e, env in enumerate(self._envs):
+                step_results[e] = env.step(actions_by_env[e])
+                terms, truncs = step_results[e][2], step_results[e][3]
+                if terms.get("__all__") or truncs.get("__all__"):
+                    # Episode over for the whole env: reset it so the
+                    # next step never advances a finished episode (a
+                    # protocol env need not auto-reset; make_multi_agent
+                    # sub-envs do, and re-resetting them is just a
+                    # fresh episode).
+                    fresh, _ = env.reset()
+                    nobs = dict(step_results[e][0])
+                    nobs.update({a: fresh[a] for a in fresh})
+                    step_results[e] = (nobs,) + step_results[e][1:]
+            for pid, slots in self._slots.items():
+                obs, action, logp, value = per_policy[pid]
+                buf = buffers[pid]
+                buf["obs"][t] = obs
+                buf["actions"][t] = action
+                buf["logp"][t] = logp
+                buf["values"][t] = value
+                for j, (e, agent) in enumerate(slots):
+                    nobs, rewards, terms, truncs, infos = step_results[e]
+                    reward = float(rewards[agent])
+                    done = bool(terms[agent] or truncs[agent])
+                    if truncs[agent] and not terms[agent]:
+                        # bootstrap time-limit truncations with
+                        # V(final_obs) (mirrors the single-agent runner)
+                        final = infos[agent].get("final_obs")
+                        if final is not None:
+                            _a, _l, fval = self._forward(
+                                pid, np.asarray(final, np.float32)[None])
+                            reward += self._gamma * float(fval[0])
+                    buf["rewards"][t, j] = reward
+                    buf["dones"][t, j] = float(done)
+                    self._episode_returns[(e, agent)] += float(
+                        rewards[agent])
+                    if done:
+                        self._completed[pid].append(
+                            self._episode_returns[(e, agent)])
+                        self._episode_returns[(e, agent)] = 0.0
+                    self._obs[(e, agent)] = np.asarray(
+                        nobs[agent], np.float32)
+        for pid, slots in self._slots.items():
+            obs = np.stack([self._obs[s] for s in slots])
+            _a, _l, boot = self._forward(pid, obs)
+            returns = self._completed[pid]
+            self._completed[pid] = []
+            out[pid] = dict(buffers[pid],
+                            bootstrap_value=np.asarray(boot, np.float32),
+                            episode_returns=np.asarray(returns,
+                                                       np.float64))
+        return out
+
+
+class MultiAgentPPOConfig:
+    """Builder config for multi-agent PPO (reference: AlgorithmConfig
+    .multi_agent(policies=..., policy_mapping_fn=...))."""
+
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.num_agents = 2
+        self.num_env_runners = 2
+        self.num_envs_per_env_runner = 4
+        self.rollout_fragment_length = 64
+        # policy_id -> model config; agents map via policy_mapping
+        self.policies: Dict[str, Dict[str, Any]] = \
+            {"shared": {"hidden": (64, 64)}}
+        self.policy_mapping: Optional[Dict[str, str]] = None  # all->shared
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_epochs = 6
+        self.minibatch_size = 512
+        self.grad_clip = 0.5
+        self.seed = 0
+
+    def environment(self, env: str) -> "MultiAgentPPOConfig":
+        self.env_name = env
+        return self
+
+    def multi_agent(self, num_agents: Optional[int] = None,
+                    policies: Optional[Dict[str, Dict]] = None,
+                    policy_mapping: Optional[Dict[str, str]] = None
+                    ) -> "MultiAgentPPOConfig":
+        if num_agents is not None:
+            self.num_agents = num_agents
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping is not None:
+            self.policy_mapping = policy_mapping
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "MultiAgentPPOConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "MultiAgentPPOConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """One PPOLearner per policy over multi-agent fragments (reference:
+    the learner-group keyed by module id in multi-agent setups)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import gymnasium as gym
+
+        import ray_tpu
+
+        from .learner import PPOLearner
+
+        self.config = config
+        agents = [f"agent_{i}" for i in range(config.num_agents)]
+        mapping = config.policy_mapping or \
+            {a: next(iter(config.policies)) for a in agents}
+        self._mapping = mapping
+        probe = gym.make(config.env_name)
+        num_actions = int(probe.action_space.n)
+        obs_shape = tuple(probe.observation_space.shape)
+        probe.close()
+        maker = make_multi_agent(config.env_name, config.num_agents)
+        runner_cls = ray_tpu.remote(MultiAgentEnvRunner)
+        self._runners = [
+            runner_cls.options(num_cpus=1).remote(
+                maker, config.num_envs_per_env_runner,
+                config.rollout_fragment_length, mapping,
+                dict(config.policies), num_actions,
+                seed=config.seed + 1000 * (i + 1), gamma=config.gamma)
+            for i in range(config.num_env_runners)]
+        self._learners = {
+            pid: PPOLearner(
+                obs_shape=obs_shape, num_actions=num_actions,
+                model_config=dict(model_config), lr=config.lr,
+                clip_param=config.clip_param, vf_coeff=config.vf_coeff,
+                entropy_coeff=config.entropy_coeff,
+                grad_clip=config.grad_clip,
+                # stable per-policy seed: hash() is randomized per
+                # process (PYTHONHASHSEED) and would break seeded repro
+                seed=config.seed + zlib.crc32(pid.encode()) % 1000)
+            for pid, model_config in config.policies.items()}
+        self._broadcast_weights()
+        self._iteration = 0
+        self._recent: Dict[str, List[float]] = \
+            {pid: [] for pid in self._learners}
+
+    def _broadcast_weights(self):
+        import ray_tpu
+        weights = {pid: learner.get_weights()
+                   for pid, learner in self._learners.items()}
+        ray_tpu.get([r.set_weights.remote(weights)
+                     for r in self._runners], timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        from .learner import compute_gae
+
+        config = self.config
+        t0 = time.perf_counter()
+        fragments = ray_tpu.get(
+            [r.sample.remote() for r in self._runners], timeout=300)
+        sample_time = time.perf_counter() - t0
+        metrics: Dict[str, Any] = {}
+        steps = 0
+        t1 = time.perf_counter()
+        for pid, learner in self._learners.items():
+            obs, actions, logp, adv, rets = [], [], [], [], []
+            for frags in fragments:
+                frag = frags.get(pid)
+                if frag is None:
+                    continue
+                a, r = compute_gae(
+                    frag["rewards"], frag["values"], frag["dones"],
+                    frag["bootstrap_value"], config.gamma,
+                    config.lambda_)
+                obs.append(frag["obs"].reshape(
+                    -1, *frag["obs"].shape[2:]))
+                actions.append(frag["actions"].reshape(-1))
+                logp.append(frag["logp"].reshape(-1))
+                adv.append(a.reshape(-1))
+                rets.append(r.reshape(-1))
+                self._recent[pid].extend(
+                    frag["episode_returns"].tolist())
+            if not obs:
+                continue
+            batch = {"obs": np.concatenate(obs),
+                     "actions": np.concatenate(actions),
+                     "logp_old": np.concatenate(logp),
+                     "advantages": np.concatenate(adv),
+                     "returns": np.concatenate(rets)}
+            steps += len(batch["obs"])
+            learner_metrics = learner.update(
+                batch, num_epochs=config.num_epochs,
+                minibatch_size=config.minibatch_size,
+                seed=config.seed + self._iteration)
+            self._recent[pid] = self._recent[pid][-100:]
+            metrics[f"{pid}/episode_return_mean"] = float(
+                np.mean(self._recent[pid])) if self._recent[pid] \
+                else float("nan")
+            for key, value in learner_metrics.items():
+                metrics[f"{pid}/{key}"] = value
+        learn_time = time.perf_counter() - t1
+        self._broadcast_weights()
+        self._iteration += 1
+        all_returns = [r for rs in self._recent.values() for r in rs]
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled": steps,
+            "episode_return_mean": float(np.mean(all_returns))
+            if all_returns else float("nan"),
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+            **metrics,
+        }
+
+    def stop(self):
+        import ray_tpu
+        for runner in self._runners:
+            try:
+                ray_tpu.kill(runner)
+            except Exception:  # noqa: BLE001
+                pass

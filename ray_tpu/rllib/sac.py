@@ -1,0 +1,464 @@
+"""SAC: soft actor-critic for continuous control
+(reference: rllib/algorithms/sac/sac.py — SACConfig :60, built on DQN's
+replay machinery :560; twin Q + target nets, tanh-Gaussian policy,
+auto-tuned entropy temperature).
+
+Reuses the DQN vertical's ReplayBufferActor shards (continuous action
+layout) and its sample-ratio control; the whole SAC update — twin-Q
+targets, reparameterized policy gradient, alpha adaptation, polyak —
+is ONE jitted XLA program."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .dqn import ReplayBufferActor
+
+
+class SACConfig:
+    """Builder-style config (reference: sac.py SACConfig :60)."""
+
+    def __init__(self):
+        self.env_name = "Pendulum-v1"
+        self.num_env_runners = 1
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 16
+        self.buffer_capacity = 100_000
+        self.num_replay_shards = 1
+        self.learning_starts = 1_500
+        self.batch_size = 256
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005                  # polyak coefficient
+        self.initial_alpha = 1.0
+        self.target_entropy: Optional[float] = None  # None = -act_dim
+        self.n_step = 1
+        # trained transitions per sampled transition: 256 at batch 256
+        # = one update per env step, the SAC paper's regime
+        self.training_intensity = 256.0
+        self.grad_clip = 40.0
+        self.model = {"hidden": (256, 256)}
+        self.seed = 0
+
+    def environment(self, env: str) -> "SACConfig":
+        self.env_name = env
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "SACConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "SACConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SACEnvRunner:
+    """Stochastic-policy fragment sampler for continuous action spaces
+    (reference: single_agent_env_runner with the SAC exploration —
+    sampling from the squashed Gaussian IS the exploration)."""
+
+    def __init__(self, env_name: str, num_envs: int, fragment_len: int,
+                 model_config: Dict[str, Any], seed: int = 0):
+        import gymnasium as gym
+        import jax
+
+        from .models import SquashedGaussianPolicy, squashed_sample
+
+        env_fns = [lambda: gym.make(env_name) for _ in range(num_envs)]
+        try:
+            self._envs = gym.vector.SyncVectorEnv(
+                env_fns, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+        except (AttributeError, TypeError):
+            self._envs = gym.vector.SyncVectorEnv(env_fns)
+        self._num_envs = num_envs
+        self._T = fragment_len
+        space = self._envs.single_action_space
+        self._act_dim = int(np.prod(space.shape))
+        self._act_low = np.asarray(space.low, np.float32)
+        self._act_high = np.asarray(space.high, np.float32)
+        self._model = SquashedGaussianPolicy(
+            act_dim=self._act_dim,
+            hidden=tuple(model_config.get("hidden", (256, 256))))
+        self._rng = jax.random.PRNGKey(seed)
+        self._params = None
+
+        def policy_sample(params, obs, rng):
+            mean, log_std = self._model.apply({"params": params}, obs)
+            action, _ = squashed_sample(mean, log_std, rng)
+            return action
+
+        self._sample_fn = jax.jit(policy_sample)
+        obs, _ = self._envs.reset(seed=seed)
+        self._obs = obs.astype(np.float32)
+        self._episode_returns = np.zeros(num_envs, np.float64)
+        self._completed: List[float] = []
+
+    def observation_shape(self):
+        return tuple(self._envs.single_observation_space.shape)
+
+    def action_dim(self) -> int:
+        return self._act_dim
+
+    def set_weights(self, params) -> bool:
+        self._params = params
+        return True
+
+    def _scale(self, squashed: np.ndarray) -> np.ndarray:
+        """[-1, 1] policy output -> env action bounds."""
+        return (self._act_low + (squashed + 1.0) * 0.5 *
+                (self._act_high - self._act_low))
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        import jax
+        assert self._params is not None, "set_weights first"
+        T, N = self._T, self._num_envs
+        obs_buf = np.empty((T, N) + self._obs.shape[1:], np.float32)
+        next_buf = np.empty_like(obs_buf)
+        act_buf = np.empty((T, N, self._act_dim), np.float32)
+        rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), bool)
+        for t in range(T):
+            self._rng, key = jax.random.split(self._rng)
+            squashed = np.asarray(
+                self._sample_fn(self._params, self._obs, key), np.float32)
+            next_obs, reward, terminated, truncated, _infos = \
+                self._envs.step(self._scale(squashed))
+            obs_buf[t] = self._obs
+            act_buf[t] = squashed  # store the [-1,1] action the learner
+            # evaluates; bounds scaling is env-side only
+            rew_buf[t] = reward
+            next_buf[t] = next_obs.astype(np.float32)
+            # truncation still bootstraps (matches DQN's handling)
+            term_buf[t] = terminated
+            self._episode_returns += reward
+            for i in np.nonzero(np.logical_or(terminated, truncated))[0]:
+                self._completed.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+            self._obs = next_obs.astype(np.float32)
+        returns, self._completed = self._completed, []
+        flat = lambda a: a.reshape((T * N,) + a.shape[2:])  # noqa: E731
+        return {"obs": flat(obs_buf), "actions": flat(act_buf),
+                "rewards": flat(rew_buf), "next_obs": flat(next_buf),
+                "dones": flat(term_buf.astype(np.float32)),
+                "episode_returns": np.asarray(returns, np.float64)}
+
+
+class SACLearner:
+    """Jitted SAC update: twin-Q TD with entropy-regularized targets,
+    reparameterized actor gradient, temperature adaptation, polyak —
+    one XLA program (reference: sac torch learner split across
+    compute_gradients/update; here fused)."""
+
+    def __init__(self, obs_shape, act_dim: int,
+                 model_config: Dict[str, Any], actor_lr: float,
+                 critic_lr: float, alpha_lr: float, gamma: float,
+                 tau: float, initial_alpha: float,
+                 target_entropy: Optional[float], grad_clip: float,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .models import (ContinuousQMLP, SquashedGaussianPolicy,
+                             squashed_sample)
+
+        hidden = tuple(model_config.get("hidden", (256, 256)))
+        self._policy = SquashedGaussianPolicy(act_dim=act_dim,
+                                              hidden=hidden)
+        self._q = ContinuousQMLP(hidden=hidden)
+        rng = jax.random.PRNGKey(seed)
+        k_pi, k_q1, k_q2, self._rng = jax.random.split(rng, 4)
+        dummy_obs = jnp.zeros((1,) + tuple(obs_shape), jnp.float32)
+        dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+        self.pi_params = self._policy.init(k_pi, dummy_obs)["params"]
+        self.q1_params = self._q.init(k_q1, dummy_obs, dummy_act)["params"]
+        self.q2_params = self._q.init(k_q2, dummy_obs, dummy_act)["params"]
+        copy = lambda t: jax.tree_util.tree_map(lambda x: x, t)  # noqa: E731
+        self.q1_target = copy(self.q1_params)
+        self.q2_target = copy(self.q2_params)
+        self.log_alpha = jnp.asarray(np.log(initial_alpha), jnp.float32)
+        if target_entropy is None:
+            target_entropy = -float(act_dim)
+        self._pi_tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                                  optax.adam(actor_lr))
+        self._q_tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                                 optax.adam(critic_lr))
+        self._alpha_tx = optax.adam(alpha_lr)
+        self.pi_opt = self._pi_tx.init(self.pi_params)
+        self.q_opt = self._q_tx.init((self.q1_params, self.q2_params))
+        self.alpha_opt = self._alpha_tx.init(self.log_alpha)
+        policy, q = self._policy, self._q
+        pi_tx, q_tx, alpha_tx = self._pi_tx, self._q_tx, self._alpha_tx
+
+        def update(state, batch, rng):
+            (pi_params, q1_params, q2_params, q1_tgt, q2_tgt, log_alpha,
+             pi_opt, q_opt, alpha_opt) = state
+            k_next, k_pi = jax.random.split(rng)
+            alpha = jnp.exp(log_alpha)
+
+            # -- critic: y = r + gamma^k (1-d) [min Q_tgt(s',a') - a*logp]
+            mean_n, log_std_n = policy.apply(
+                {"params": pi_params}, batch["next_obs"])
+            next_act, next_logp = squashed_sample(mean_n, log_std_n,
+                                                  k_next)
+            q1_next = q.apply({"params": q1_tgt}, batch["next_obs"],
+                              next_act)
+            q2_next = q.apply({"params": q2_tgt}, batch["next_obs"],
+                              next_act)
+            q_next = jnp.minimum(q1_next, q2_next) - alpha * next_logp
+            target = batch["rewards"] + (1.0 - batch["dones"]) * \
+                batch["discounts"] * q_next
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(q_params):
+                q1p, q2p = q_params
+                q1 = q.apply({"params": q1p}, batch["obs"],
+                             batch["actions"])
+                q2 = q.apply({"params": q2p}, batch["obs"],
+                             batch["actions"])
+                return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+            c_loss, q_grads = jax.value_and_grad(critic_loss)(
+                (q1_params, q2_params))
+            q_updates, q_opt = q_tx.update(q_grads, q_opt,
+                                           (q1_params, q2_params))
+            q1_params, q2_params = optax.apply_updates(
+                (q1_params, q2_params), q_updates)
+
+            # -- actor: maximize E[min Q(s, a~) - alpha logp(a~|s)]
+            def actor_loss(p):
+                mean, log_std = policy.apply({"params": p}, batch["obs"])
+                act, logp = squashed_sample(mean, log_std, k_pi)
+                q1 = q.apply({"params": q1_params}, batch["obs"], act)
+                q2 = q.apply({"params": q2_params}, batch["obs"], act)
+                loss = (alpha * logp - jnp.minimum(q1, q2)).mean()
+                return loss, logp
+
+            (a_loss, logp), pi_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(pi_params)
+            pi_updates, pi_opt = pi_tx.update(pi_grads, pi_opt, pi_params)
+            pi_params = optax.apply_updates(pi_params, pi_updates)
+
+            # -- temperature: drive policy entropy toward the target
+            def alpha_loss(la):
+                return -(la * jax.lax.stop_gradient(
+                    logp + target_entropy)).mean()
+
+            al_loss, a_grad = jax.value_and_grad(alpha_loss)(log_alpha)
+            a_update, alpha_opt = alpha_tx.update(a_grad, alpha_opt,
+                                                  log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, a_update)
+
+            # -- polyak target update
+            q1_tgt = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o, q1_tgt, q1_params)
+            q2_tgt = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o, q2_tgt, q2_params)
+            new_state = (pi_params, q1_params, q2_params, q1_tgt, q2_tgt,
+                         log_alpha, pi_opt, q_opt, alpha_opt)
+            metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "alpha_loss": al_loss, "alpha": alpha,
+                       "entropy": -logp.mean()}
+            return new_state, metrics
+
+        self._update = jax.jit(update)
+
+        def update_many(state, batches, rng):
+            """k updates in ONE compiled program: lax.scan over stacked
+            [k, B, ...] minibatches — the TPU-first replay burst (per-
+            update Python dispatch is what makes update-per-env-step
+            intensities CPU-bound otherwise)."""
+            def step(carry, xs):
+                batch_k, key = xs
+                new_state, metrics = update(carry, batch_k, key)
+                return new_state, metrics
+
+            keys = jax.random.split(rng, batches["rewards"].shape[0])
+            state, metrics = jax.lax.scan(step, state, (batches, keys))
+            return state, jax.tree_util.tree_map(lambda m: m[-1],
+                                                 metrics)
+
+        self._update_many = jax.jit(update_many)
+
+    def _state(self):
+        return (self.pi_params, self.q1_params, self.q2_params,
+                self.q1_target, self.q2_target, self.log_alpha,
+                self.pi_opt, self.q_opt, self.alpha_opt)
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        if "discounts" not in dev:
+            dev["discounts"] = jnp.full_like(dev["rewards"], 0.99)
+        self._rng, key = jax.random.split(self._rng)
+        state, metrics = self._update(self._state(), dev, key)
+        (self.pi_params, self.q1_params, self.q2_params, self.q1_target,
+         self.q2_target, self.log_alpha, self.pi_opt, self.q_opt,
+         self.alpha_opt) = state
+        return {k: float(v) for k, v in metrics.items()}
+
+    def update_burst(self, flat: Dict[str, np.ndarray],
+                     k: int) -> Dict[str, float]:
+        """Split a [k*B, ...] sample into k minibatches and run them as
+        one jitted scan (k fixed shapes -> one compilation per k)."""
+        import jax
+        import jax.numpy as jnp
+        stacked = {
+            key: jnp.asarray(value).reshape(
+                (k, value.shape[0] // k) + value.shape[1:])
+            for key, value in flat.items()}
+        if "discounts" not in stacked:
+            stacked["discounts"] = jnp.full_like(stacked["rewards"],
+                                                 0.99)
+        self._rng, key = jax.random.split(self._rng)
+        state, metrics = self._update_many(self._state(), stacked, key)
+        (self.pi_params, self.q1_params, self.q2_params, self.q1_target,
+         self.q2_target, self.log_alpha, self.pi_opt, self.q_opt,
+         self.alpha_opt) = state
+        return {k2: float(v) for k2, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.pi_params)
+
+
+class SAC:
+    """Algorithm driver: mirrors DQN's training_step (sample → replay →
+    update at training_intensity) with SAC's learner and stochastic
+    exploration (reference: sac.py:560 — SAC extends DQN)."""
+
+    def __init__(self, config: SACConfig):
+        import ray_tpu
+
+        self.config = config
+        runner_cls = ray_tpu.remote(SACEnvRunner)
+        self._runners = [
+            runner_cls.options(num_cpus=1).remote(
+                config.env_name, config.num_envs_per_env_runner,
+                config.rollout_fragment_length, dict(config.model),
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_env_runners)]
+        obs_shape = ray_tpu.get(
+            self._runners[0].observation_shape.remote(), timeout=120)
+        act_dim = ray_tpu.get(
+            self._runners[0].action_dim.remote(), timeout=120)
+        buffer_cls = ray_tpu.remote(ReplayBufferActor)
+        per_shard = config.buffer_capacity // config.num_replay_shards
+        self._buffers = [
+            buffer_cls.options(num_cpus=0.5).remote(
+                per_shard, obs_shape, seed=config.seed + i,
+                action_shape=(act_dim,), action_dtype="float32")
+            for i in range(config.num_replay_shards)]
+        self._learner = SACLearner(
+            obs_shape, act_dim, dict(config.model), config.actor_lr,
+            config.critic_lr, config.alpha_lr, config.gamma, config.tau,
+            config.initial_alpha, config.target_entropy,
+            config.grad_clip, seed=config.seed)
+        self._broadcast_weights()
+        self._env_steps = 0
+        self._updates = 0
+        self._trained_transitions = 0
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+        self._rr = 0
+
+    def _broadcast_weights(self):
+        import ray_tpu
+        weights = self._learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights)
+                     for r in self._runners], timeout=120)
+
+    def train(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        t0 = time.perf_counter()
+        fragments = ray_tpu.get(
+            [r.sample.remote() for r in self._runners], timeout=300)
+        adds = []
+        sampled = 0
+        gamma = c.gamma
+        for frag in fragments:
+            sampled += len(frag["actions"])
+            self._recent_returns.extend(frag["episode_returns"].tolist())
+            buf = self._buffers[self._rr % len(self._buffers)]
+            self._rr += 1
+            adds.append(buf.add_batch.remote(
+                frag["obs"], frag["actions"], frag["rewards"],
+                frag["next_obs"], frag["dones"],
+                np.full_like(frag["rewards"], gamma)))
+        if len(self._buffers) == 1:
+            buffer_size = ray_tpu.get(adds, timeout=120)[-1] if adds \
+                else 0
+        else:
+            ray_tpu.get(adds, timeout=120)
+            buffer_size = sum(ray_tpu.get(
+                [b.size.remote() for b in self._buffers], timeout=120))
+        self._env_steps += sampled
+        sample_time = time.perf_counter() - t0
+
+        metrics: Dict[str, float] = {}
+        t1 = time.perf_counter()
+        if buffer_size >= c.learning_starts:
+            target_trained = self._env_steps * c.training_intensity
+            while self._trained_transitions < target_trained:
+                remaining = int((target_trained -
+                                 self._trained_transitions)
+                                // c.batch_size)
+                # fixed burst sizes keep the scan at three compiled
+                # shapes total
+                k = 64 if remaining >= 64 else (8 if remaining >= 8
+                                                else 1)
+                buf = self._buffers[self._updates % len(self._buffers)]
+                flat = ray_tpu.get(
+                    buf.sample_many.remote(c.batch_size, k), timeout=120)
+                metrics = self._learner.update_burst(flat, k)
+                self._updates += k
+                self._trained_transitions += k * c.batch_size
+            # Runners only sample between train() calls, so one sync at
+            # the end of the update burst is as fresh as per-update
+            # broadcasting — without the per-update RPC round trips.
+            self._broadcast_weights()
+        learn_time = time.perf_counter() - t1
+        self._iteration += 1
+        self._recent_returns = self._recent_returns[-100:]
+        return {
+            "training_iteration": self._iteration,
+            "num_env_steps_sampled": self._env_steps,
+            "num_updates": self._updates,
+            "replay_buffer_size": buffer_size,
+            "episode_return_mean": float(np.mean(self._recent_returns))
+            if self._recent_returns else float("nan"),
+            "sample_time_s": sample_time,
+            "learn_time_s": learn_time,
+            **metrics,
+        }
+
+    def stop(self):
+        import ray_tpu
+        for actor in self._runners + self._buffers:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass

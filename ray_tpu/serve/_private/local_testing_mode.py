@@ -1,0 +1,127 @@
+"""Local testing mode: run a Serve application fully in-process
+(reference: serve/_private/local_testing_mode.py:49 — serve.run(...,
+_local_testing_mode=True) constructs deployments without any cluster,
+so unit tests exercise handles/composition in milliseconds).
+
+Replicas here are plain objects; their async methods run on one shared
+background event loop thread, so sync callers use `.result()` and
+async code (engine drive loops, batching) works unchanged."""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import threading
+from typing import Any, Dict, Optional
+
+
+class _LocalLoop:
+    """One background asyncio loop shared by all local replicas."""
+
+    _instance: Optional["_LocalLoop"] = None
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-local-loop")
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "_LocalLoop":
+        if cls._instance is None or not cls._instance._thread.is_alive():
+            cls._instance = _LocalLoop()
+        return cls._instance
+
+
+class LocalDeploymentResponse:
+    """Future-like result mirroring DeploymentResponse: `.result()`
+    for sync callers, awaitable for async ones."""
+
+    def __init__(self, future: concurrent.futures.Future):
+        self._future = future
+
+    def result(self, timeout_s: Optional[float] = 60.0) -> Any:
+        return self._future.result(timeout=timeout_s)
+
+    def __await__(self):
+        return asyncio.wrap_future(self._future).__await__()
+
+
+class LocalDeploymentHandle:
+    """In-process analog of DeploymentHandle: `.method.remote(...)`
+    invokes the instance directly (async methods on the shared loop)."""
+
+    def __init__(self, instance: Any, deployment_name: str,
+                 method_name: Optional[str] = None):
+        self._instance = instance
+        self.deployment_name = deployment_name
+        self._method_name = method_name
+        self.is_local = True
+
+    def __getattr__(self, name: str) -> "LocalDeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return LocalDeploymentHandle(self._instance,
+                                     self.deployment_name,
+                                     method_name=name)
+
+    def options(self, method_name: Optional[str] = None,
+                **_ignored) -> "LocalDeploymentHandle":
+        return LocalDeploymentHandle(
+            self._instance, self.deployment_name,
+            method_name=method_name or self._method_name)
+
+    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        method_name = self._method_name or "__call__"
+        target = self._instance if method_name == "__call__" and \
+            not hasattr(self._instance, "__call__") else None
+        fn = getattr(self._instance, method_name) if target is None \
+            else target
+        loop = _LocalLoop.get().loop
+
+        if asyncio.iscoroutinefunction(fn):
+            future = asyncio.run_coroutine_threadsafe(
+                fn(*args, **kwargs), loop)
+        else:
+            # run sync methods on the loop thread too: serializes access
+            # like a max_concurrency=1 replica and keeps loop-affine
+            # state (engine wakeups) consistent
+            async def _call():
+                return fn(*args, **kwargs)
+            future = asyncio.run_coroutine_threadsafe(_call(), loop)
+        return LocalDeploymentResponse(future)
+
+
+def run_local(app, name: str = "default"):
+    """Instantiate a bound application graph in-process and return a
+    LocalDeploymentHandle to the ingress (reference:
+    local_testing_mode.py:49 make_local_deployment_handle)."""
+    from ..api import Application
+
+    instances: Dict[int, LocalDeploymentHandle] = {}
+
+    def visit(node: Application) -> LocalDeploymentHandle:
+        if id(node) in instances:
+            return instances[id(node)]
+        args = tuple(visit(a) if isinstance(a, Application) else a
+                     for a in node.init_args)
+        kwargs = {k: visit(v) if isinstance(v, Application) else v
+                  for k, v in node.init_kwargs.items()}
+        definition = node.deployment.definition
+        if isinstance(definition, type):
+            instance = definition(*args, **kwargs)
+        else:
+            # function deployment: the "instance" is the function with
+            # bound args applied at call time
+            def instance(*call_args, __fn=definition, __args=args,
+                         **call_kwargs):
+                return __fn(*__args, *call_args, **call_kwargs)
+        handle = LocalDeploymentHandle(instance, node.deployment.name)
+        instances[id(node)] = handle
+        return handle
+
+    return visit(app)

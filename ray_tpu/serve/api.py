@@ -158,12 +158,21 @@ def run(app: Application, *, name: str = "default",
         http_options: Optional[HTTPOptions] = None,
         wait_for_ready_timeout_s: float = 60.0,
         request_router: str = "pow2",
-        _blocking: bool = True) -> DeploymentHandle:
+        _blocking: bool = True,
+        _local_testing: bool = False) -> DeploymentHandle:
     """Deploy an application and wait until healthy
     (reference: serve.run api.py:685). `request_router` picks the proxy's
     replica-choice policy for the app: "pow2" (default) or "prefix"
     (prompt-prefix affinity for LLM apps, reference:
-    llm/_internal/serve/request_router/)."""
+    llm/_internal/serve/request_router/).
+
+    `_local_testing=True` skips the cluster entirely: deployments are
+    instantiated in-process and the returned handle calls them directly
+    (reference: serve/_private/local_testing_mode.py:49) — unit tests
+    of handle composition run in milliseconds."""
+    if _local_testing:
+        from ._private.local_testing_mode import run_local
+        return run_local(app, name)
     import ray_tpu
     controller = start(http_options)
     specs, visit = _collect_graph(app)

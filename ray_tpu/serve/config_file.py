@@ -1,0 +1,97 @@
+"""Declarative Serve deploy from a YAML config file
+(reference: serve/schema.py ServeDeploySchema + `serve deploy` CLI in
+serve/scripts.py — config-file-driven production deploys).
+
+Schema (a trimmed ServeDeploySchema):
+
+    applications:
+      - name: text_app
+        route_prefix: /text
+        import_path: my_module:app        # Application or builder fn
+        args: {max_len: 128}              # kwargs for a builder fn
+        request_router: pow2              # optional
+        deployments:                      # optional per-deployment
+          - name: LLMServer               #   config overrides
+            num_replicas: 2
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, Optional
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+    with open(path) as f:
+        config = yaml.safe_load(f)
+    if not isinstance(config, dict) or "applications" not in config:
+        raise ValueError(
+            f"{path}: expected a mapping with an 'applications' list")
+    for app in config["applications"]:
+        if "import_path" not in app:
+            raise ValueError(
+                f"application {app.get('name', '?')!r} needs import_path")
+        if ":" not in app["import_path"]:
+            raise ValueError(
+                f"import_path {app['import_path']!r} must be "
+                f"'module:attribute'")
+    return config
+
+
+def _resolve(import_path: str, args: Optional[Dict[str, Any]]):
+    """module:attr -> Application (calling builders with args)."""
+    from .api import Application
+    module_name, _, attr = import_path.partition(":")
+    module = importlib.import_module(module_name)
+    target = getattr(module, attr)
+    if isinstance(target, Application):
+        if args:
+            raise ValueError(
+                f"{import_path} is a bound Application; 'args' only "
+                f"apply to builder functions")
+        return target
+    app = target(**(args or {}))
+    if not isinstance(app, Application):
+        raise TypeError(
+            f"{import_path} returned {type(app).__name__}, expected a "
+            f"bound Application")
+    return app
+
+
+def _apply_overrides(app, overrides: List[Dict[str, Any]]):
+    """Per-deployment config overrides: the ingress deployment can be
+    re-optioned; nested deployments match by name."""
+    from .api import Application
+    by_name = {o["name"]: o for o in overrides}
+
+    def visit(node: Application):
+        override = by_name.get(node.deployment.name)
+        if override:
+            options = {k: v for k, v in override.items() if k != "name"}
+            node.deployment = node.deployment.options(**options)
+        for a in list(node.init_args) + list(node.init_kwargs.values()):
+            if isinstance(a, Application):
+                visit(a)
+
+    visit(app)
+    return app
+
+
+def deploy_config(path: str, wait_for_ready_timeout_s: float = 240.0
+                  ) -> List[str]:
+    """Deploy every application in the config file; returns their
+    names (reference: `serve deploy` → client deploy_apps)."""
+    from . import api
+    deployed = []
+    for spec in load_config(path)["applications"]:
+        app = _resolve(spec["import_path"], spec.get("args"))
+        if spec.get("deployments"):
+            app = _apply_overrides(app, spec["deployments"])
+        name = spec.get("name", "default")
+        api.run(app, name=name,
+                route_prefix=spec.get("route_prefix", f"/{name}"),
+                request_router=spec.get("request_router", "pow2"),
+                wait_for_ready_timeout_s=wait_for_ready_timeout_s)
+        deployed.append(name)
+    return deployed

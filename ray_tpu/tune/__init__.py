@@ -11,11 +11,12 @@ and lets the scheduler (ASHA / PBT) stop, or exploit/explore them. Train's
 JaxTrainer integrates as a trainable, so one tuned trial can itself be a
 gang-scheduled multi-host SPMD run."""
 
+from .bayesopt import BayesOptSearcher
 from .result_grid import Result, ResultGrid
 from .sample import (choice, grid_search, loguniform, qrandint, quniform,
                      randint, randn, uniform)
 from .schedulers import (AsyncHyperBandScheduler, ASHAScheduler,
-                         FIFOScheduler, PopulationBasedTraining)
+                         FIFOScheduler, PB2, PopulationBasedTraining)
 from .search import BasicVariantGenerator
 from .suggest import TPESearcher
 from .tune_context import get_checkpoint, get_context, report
@@ -23,8 +24,9 @@ from .tuner import TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
-    "FIFOScheduler", "PopulationBasedTraining", "Result", "ResultGrid",
-    "TPESearcher",
+    "BayesOptSearcher",
+    "FIFOScheduler", "PB2", "PopulationBasedTraining", "Result",
+    "ResultGrid", "TPESearcher",
     "TuneConfig", "Tuner", "choice", "get_checkpoint", "get_context",
     "grid_search", "loguniform", "qrandint", "quniform", "randint", "randn",
     "report", "uniform",

@@ -1,0 +1,205 @@
+"""Native Gaussian-process Bayesian optimization
+(reference: tune/search/bayesopt/bayesopt_search.py:41 — the reference
+wraps the external `bayesian-optimization` package; none of the HPO
+libraries fit a zero-dependency TPU image, so this implements the GP +
+expected-improvement loop directly: RBF kernel on [0,1]^d-normalized
+numeric dimensions, lengthscale picked by marginal likelihood, EI
+maximized over random + locally-perturbed candidates).
+
+Also hosts the GP core PB2 (schedulers.py) uses for its bandit explore
+step."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .sample import (Categorical, Domain, LogUniform, QRandint, QUniform,
+                     Randint, Randn, Uniform)
+from .search import _deepcopy_space, _find_special, _set_path
+
+
+class GaussianProcess:
+    """Zero-mean GP with an isotropic RBF kernel on standardized
+    targets. Small-n exact inference (Cholesky), which is the HPO
+    regime — tens of observations."""
+
+    def __init__(self, lengthscales: Tuple[float, ...] = (0.1, 0.25, 0.5),
+                 noise: float = 1e-4):
+        self._lengthscales = lengthscales
+        self._noise = noise
+        self._x: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+        self._y_mean = 0.0
+        self._y_std = 1.0
+        self.lengthscale = lengthscales[0]
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray,
+                lengthscale: float) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (lengthscale ** 2))
+
+    def fit(self, x: np.ndarray, y: np.ndarray):
+        x = np.asarray(x, np.float64)
+        y = np.asarray(y, np.float64)
+        self._y_mean = float(y.mean())
+        self._y_std = float(y.std()) or 1.0
+        z = (y - self._y_mean) / self._y_std
+        best_ll, best = -np.inf, None
+        for ls in self._lengthscales:
+            k = self._kernel(x, x, ls) + self._noise * np.eye(len(x))
+            try:
+                chol = np.linalg.cholesky(k)
+            except np.linalg.LinAlgError:
+                continue
+            alpha = np.linalg.solve(
+                chol.T, np.linalg.solve(chol, z))
+            # log marginal likelihood (up to constants)
+            ll = (-0.5 * float(z @ alpha)
+                  - np.log(np.diag(chol)).sum())
+            if ll > best_ll:
+                best_ll, best = ll, (ls, chol, alpha)
+        if best is None:  # all factorizations failed: inflate noise
+            k = self._kernel(x, x, self._lengthscales[-1]) + \
+                1e-2 * np.eye(len(x))
+            chol = np.linalg.cholesky(k)
+            alpha = np.linalg.solve(chol.T, np.linalg.solve(chol, z))
+            best = (self._lengthscales[-1], chol, alpha)
+        self.lengthscale, self._chol, self._alpha = best
+        self._x = x
+        return self
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior mean and stddev at x (de-standardized)."""
+        x = np.asarray(x, np.float64)
+        ks = self._kernel(x, self._x, self.lengthscale)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.clip(1.0 + self._noise - (v ** 2).sum(0), 1e-12, None)
+        return (mu * self._y_std + self._y_mean,
+                np.sqrt(var) * self._y_std)
+
+
+def expected_improvement(mu: np.ndarray, sigma: np.ndarray,
+                         best: float, xi: float = 0.01) -> np.ndarray:
+    z = (mu - best - xi) / sigma
+    phi = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+    cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+    return (mu - best - xi) * cdf + sigma * phi
+
+
+class BayesOptSearcher:
+    """GP-EI sequential searcher over the tune search space (same
+    suggest/observe protocol as TPESearcher; the Tuner drives it
+    lazily). Numeric dimensions ride the GP in normalized [0,1]^d;
+    categorical dimensions fall back to uniform sampling (the reference
+    adapter is float-only too, bayesopt_search.py:41)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 n_initial: int = 6, n_candidates: int = 256,
+                 xi: float = 0.01, seed: int = 0):
+        self.metric = metric
+        self.mode = mode
+        self.n_initial = n_initial
+        self.n_candidates = n_candidates
+        self.xi = xi
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.default_rng(seed)
+        self._xs: List[np.ndarray] = []
+        self._ys: List[float] = []
+        self._dims: Optional[List[Tuple[Tuple[str, ...], Domain]]] = None
+
+    # -- normalization -----------------------------------------------------
+
+    def _numeric_dims(self, param_space) -> List[Tuple[Tuple[str, ...],
+                                                       Domain]]:
+        dims = []
+        for path, spec in _find_special(param_space):
+            if isinstance(spec, Domain) and not isinstance(
+                    spec, (Categorical, Randn)):
+                dims.append((path, spec))
+        return dims
+
+    def _to_unit(self, domain: Domain, value: float) -> float:
+        if isinstance(domain, LogUniform):
+            lo, hi = domain.log_low, domain.log_high
+            return (math.log(value) - lo) / max(hi - lo, 1e-12)
+        if isinstance(domain, (Randint, QRandint)):
+            return (value - domain.low) / max(domain.high - 1 -
+                                              domain.low, 1e-12)
+        return (value - domain.low) / max(domain.high - domain.low,
+                                          1e-12)
+
+    def _from_unit(self, domain: Domain, u: float):
+        u = min(max(u, 0.0), 1.0)
+        if isinstance(domain, LogUniform):
+            return math.exp(domain.log_low +
+                            u * (domain.log_high - domain.log_low))
+        if isinstance(domain, QUniform):
+            x = domain.low + u * (domain.high - domain.low)
+            return min(max(round(x / domain.q) * domain.q, domain.low),
+                       domain.high)
+        if isinstance(domain, QRandint):
+            x = domain.low + u * (domain.high - 1 - domain.low)
+            return int(min(max((int(x) // domain.q) * domain.q,
+                               domain.low), domain.high - 1))
+        if isinstance(domain, Randint):
+            return int(round(domain.low +
+                             u * (domain.high - 1 - domain.low)))
+        return domain.low + u * (domain.high - domain.low)
+
+    # -- protocol ----------------------------------------------------------
+
+    def suggest(self, param_space: Dict[str, Any]) -> Dict[str, Any]:
+        if self._dims is None:
+            self._dims = self._numeric_dims(param_space)
+        config = _deepcopy_space(param_space)
+        # non-GP dimensions: sample
+        for path, spec in list(_find_special(param_space)):
+            if isinstance(spec, dict):
+                _set_path(config, path, self._rng.choice(
+                    spec["grid_search"]))
+            elif isinstance(spec, (Categorical, Randn)):
+                _set_path(config, path, spec.sample(self._rng))
+        if not self._dims:
+            return config
+        d = len(self._dims)
+        if len(self._ys) < self.n_initial:
+            u = self._np_rng.random(d)
+        else:
+            gp = GaussianProcess().fit(np.stack(self._xs),
+                                       np.asarray(self._ys))
+            best = max(self._ys)
+            n = self.n_candidates
+            candidates = self._np_rng.random((n, d))
+            # half the pool: local perturbations of the incumbent
+            incumbent = self._xs[int(np.argmax(self._ys))]
+            local = incumbent[None, :] + \
+                self._np_rng.normal(0.0, gp.lengthscale / 2, (n // 2, d))
+            candidates[:n // 2] = np.clip(local, 0.0, 1.0)
+            mu, sigma = gp.predict(candidates)
+            u = candidates[int(np.argmax(
+                expected_improvement(mu, sigma, best, self.xi)))]
+        for (path, domain), ui in zip(self._dims, u):
+            _set_path(config, path, self._from_unit(domain, float(ui)))
+        return config
+
+    def observe(self, config: Dict[str, Any], score: float):
+        if score != score:  # NaN
+            return
+        if self.mode == "min":
+            score = -score
+        if self._dims is None:
+            return
+        vec = np.empty(len(self._dims))
+        for i, (path, domain) in enumerate(self._dims):
+            node = config
+            for key in path:
+                node = node[key]
+            vec[i] = self._to_unit(domain, float(node))
+        self._xs.append(vec)
+        self._ys.append(float(score))

@@ -144,6 +144,11 @@ class Tuner:
                         config: Optional[Dict[str, Any]] = None):
             if config is not None:
                 trial.config = config
+            # config-aware schedulers (PB2's GP bandit) observe every
+            # (trial, config) pairing, including post-exploit restarts
+            hook = getattr(scheduler, "on_trial_config", None)
+            if hook is not None:
+                hook(trial.id, trial.config)
             trial.actor = runner_cls.options(
                 num_cpus=resources.get("CPU", 1),
                 resources={k: v for k, v in resources.items()

