@@ -604,7 +604,8 @@ class NormalTaskSubmitter:
                 return push.result()
             try:
                 state = await worker.call(
-                    "task_probe", task_hex=spec.task_id.hex(), timeout=15)
+                    "task_probe", task_hex=spec.task_id.hex(),
+                    attempt=spec.attempt_number, timeout=15)
             except Exception:
                 # Probe timeout / transport error: the worker may just be
                 # congested (single-core multi-driver floods). A dead
@@ -620,6 +621,12 @@ class NormalTaskSubmitter:
                         f"{spec.task_id.hex()[:12]}")
                 continue
             unreachable = 0
+            if isinstance(state, dict) and state.get("state") == "done":
+                # The task finished but its reply frame was lost en
+                # route: recover the cached reply via the probe channel
+                # instead of dropping the lease and re-executing.
+                push.cancel()
+                return state["reply"]
             if state == "running":
                 unknown = 0
                 running += 1
@@ -1331,6 +1338,16 @@ class _RuntimeContext(threading.local):
 RUNTIME_CTX = _RuntimeContext()
 
 
+def _reply_nbytes(reply: Dict[str, Any]) -> int:
+    """Approximate retained size of a push reply (inline return bytes)."""
+    total = 64
+    for ret in reply.get("returns", ()):
+        data = ret.get("data") if isinstance(ret, dict) else None
+        if data is not None:
+            total += len(data)
+    return total
+
+
 class TaskExecutor:
     def __init__(self, core_worker: "CoreWorker"):
         self._cw = core_worker
@@ -1729,6 +1746,18 @@ class CoreWorker:
         # normal-task pushes currently known to this worker (arrival ->
         # reply), served to owner-side push probes
         self._received_pushes: Set[TaskID] = set()
+        # Completed push replies retained briefly: if the push's reply
+        # frame is lost on a congested link, the owner's probe fetches
+        # the cached reply instead of dropping the lease and
+        # RE-EXECUTING the task (duplicate side effects). Reference
+        # analog: task replies ride gRPC, which resends at the
+        # transport level; this wire has no transport resend, so the
+        # probe doubles as the ack/retry channel. Keyed by (task_id,
+        # attempt): INTENTIONAL re-executions (error retries, lineage
+        # reconstruction) bump attempt_number and must miss this cache.
+        self._completed_push_replies: Dict[Tuple[TaskID, int],
+                                           Dict[str, Any]] = {}
+        self._completed_push_bytes = 0
         # Called with the ObjectID whenever an owned object is freed
         # (device-resident object pins, experimental/device_objects.py).
         self.device_object_free_hooks: List = []
@@ -2108,6 +2137,14 @@ class CoreWorker:
                                lease_id: Optional[int] = None):
         if lease_id is not None:
             self.current_lease_id = lease_id
+        # Duplicate push of the SAME attempt (owner re-sent after losing
+        # our reply and re-leasing this same worker): serve the cached
+        # reply, never re-execute. A bumped attempt_number (retry /
+        # reconstruction) misses and runs for real.
+        push_key = (spec.task_id, spec.attempt_number)
+        cached = self._completed_push_replies.get(push_key)
+        if cached is not None:
+            return cached
         # known to this worker from arrival until WELL AFTER the reply —
         # the owner's push probe distinguishes a slow task from a lost
         # push. Discarding at reply time would race reply transmission
@@ -2115,10 +2152,32 @@ class CoreWorker:
         # that just completed and kill a healthy worker.
         self._received_pushes.add(spec.task_id)
         try:
-            return await self.executor.execute(spec)
-        finally:
+            reply = await self.executor.execute(spec)
+        except BaseException:
             asyncio.get_event_loop().call_later(
                 120.0, self._received_pushes.discard, spec.task_id)
+            raise
+        # Cache BEFORE the reply frame is written: a probe racing the
+        # reply sees "done" rather than "unknown".
+        self._completed_push_replies[push_key] = reply
+        self._completed_push_bytes += _reply_nbytes(reply)
+        # Bound by entries AND bytes between TTL sweeps (large inline
+        # returns would otherwise pin GBs for 120 s at high throughput).
+        while self._completed_push_replies and (
+                len(self._completed_push_replies) > 2048 or
+                self._completed_push_bytes > 64 * 1024 * 1024):
+            _k, _v = next(iter(self._completed_push_replies.items()))
+            del self._completed_push_replies[_k]
+            self._completed_push_bytes -= _reply_nbytes(_v)
+        asyncio.get_event_loop().call_later(
+            120.0, self._discard_push_record, push_key)
+        return reply
+
+    def _discard_push_record(self, push_key: Tuple[TaskID, int]):
+        self._received_pushes.discard(push_key[0])
+        reply = self._completed_push_replies.pop(push_key, None)
+        if reply is not None:
+            self._completed_push_bytes -= _reply_nbytes(reply)
 
     async def handle_dump_stacks(self, path: str = "") -> bool:
         """Debug: dump all thread stacks (+ asyncio tasks) to `path` or
@@ -2144,10 +2203,14 @@ class CoreWorker:
                 out.close()
         return True
 
-    async def handle_task_probe(self, task_hex: str) -> str:
+    async def handle_task_probe(self, task_hex: str, attempt: int = 0):
         """Owner-side push probe (see _push_with_probe): is this task
-        known here — received/queued/running?"""
+        known here — received/queued/running — and if it already
+        finished, hand back the cached reply (lost-reply recovery)."""
         task_id = TaskID.from_hex(task_hex)
+        reply = self._completed_push_replies.get((task_id, attempt))
+        if reply is not None:
+            return {"state": "done", "reply": reply}
         if task_id in self._received_pushes or \
                 self.executor.is_running(task_id):
             return "running"

@@ -182,6 +182,40 @@ def get_loop() -> asyncio.AbstractEventLoop:
     return EventLoopThread.get().loop
 
 
+def _resolve_future(fut: "asyncio.Future", result, exc: Exception = None):
+    """Resolve `fut` safely even when it belongs to a DIFFERENT event
+    loop than the one delivering the event (a process with two live
+    loops: the eventfd reader drains on one, a caller awaited on the
+    other). Plain set_result from a foreign thread appends to the other
+    loop's ready queue without waking its selector — the caller hangs
+    until an unrelated wakeup."""
+    try:
+        owner = fut.get_loop()
+    except Exception:
+        owner = None
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    if owner is not None and owner is not running:
+        def _set():
+            if fut.done():
+                return
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+        try:
+            owner.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass  # owner loop closed: caller is gone
+        return
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(result)
+
+
 # --------------------------------------------------------------------------
 # Chaos / fault injection
 # --------------------------------------------------------------------------
@@ -567,7 +601,7 @@ class RpcClient:
         msg_id, flags, _method, payload = unpack_body(body)
         fut = self._pending.pop(msg_id, None)
         if fut is not None and not fut.done():
-            fut.set_result((flags, payload))
+            _resolve_future(fut, (flags, payload))
 
     async def _read_loop(self, reader: asyncio.StreamReader):
         frames = FrameReader()
@@ -596,7 +630,7 @@ class RpcClient:
         pending, self._pending = self._pending, {}
         for fut in pending.values():
             if not fut.done():
-                fut.set_exception(err)
+                _resolve_future(fut, None, exc=err)
 
     async def call(self, method: str, timeout: Optional[float] = DEFAULT_TIMEOUT,
                    retries: int = 0, **kwargs) -> Any:

@@ -100,10 +100,23 @@ class NativeIO:
     # -- loop integration ------------------------------------------------
 
     def attach(self, loop):
-        """Watch the notify eventfd on `loop`; must run on the loop."""
+        """Watch the notify eventfd on `loop`; must run on the loop.
+
+        First-wins: once attached to a live loop, later attach attempts
+        from OTHER loops are ignored — moving the reader would strand
+        every connection whose sink/futures live on the first loop
+        (frames would drain on the wrong thread and replies silently
+        vanish). Re-attach only if the original loop is closed."""
         if self._attached_loop is loop:
             return
         if self._attached_loop is not None:
+            if (not self._attached_loop.is_closed()
+                    and self._attached_loop.is_running()):
+                logger.warning(
+                    "NativeIO.attach ignored: already attached to a live "
+                    "loop; refusing to move the eventfd reader")
+                return
+            # stopped or closed loop: the reader would never fire — move it
             try:
                 self._attached_loop.remove_reader(self._notify_fd)
             except Exception:
