@@ -614,7 +614,7 @@ class RpcClient:
                     msg_id, flags, _method, payload = unpack_body(body)
                     fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
-                        fut.set_result((flags, payload))
+                        _resolve_future(fut, (flags, payload))
         except Exception as e:
             self._fail_pending(RpcError(f"connection to {self.address} lost: {e}"))
             return

@@ -15,19 +15,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from .serving import LLMServer
-
-
-class ByteTokenizer:
-    """Dependency-free fallback tokenizer: UTF-8 bytes as token ids.
-    Real deployments pass a `transformers` tokenizer (or anything with
-    encode/decode); models with vocab >= 256 work out of the box."""
-
-    def encode(self, text: str) -> List[int]:
-        return list(text.encode("utf-8"))
-
-    def decode(self, tokens: List[int]) -> str:
-        return bytes(t for t in tokens if 0 <= t < 256).decode(
-            "utf-8", "replace")
+from .tokenizer import ByteTokenizer, get_tokenizer  # noqa: F401 — re-export
 
 
 def _chat_prompt(messages: List[Dict[str, str]]) -> str:
@@ -47,7 +35,9 @@ class OpenAIServer(LLMServer):
                  model_id: str = "ray-tpu-llm", tokenizer=None):
         super().__init__(engine_config, params=params)
         self.model_id = model_id
-        self.tokenizer = tokenizer or ByteTokenizer()
+        # str → load tokenizer.json (native BPE) / checkpoint dir;
+        # None → byte fallback; object → duck-typed encode/decode.
+        self.tokenizer = get_tokenizer(tokenizer)
         # stream_id -> SSE formatting state
         self._sse: Dict[str, Dict[str, Any]] = {}
 

@@ -191,7 +191,13 @@ class Attention(nn.Module):
                 """Per-shard paged attention: q_ holds LOCAL heads,
                 kp_/vp_ LOCAL kv heads (head-parallel — no collectives
                 needed). Runs unsharded when there is no tensor axis."""
-                if jax.default_backend() == "tpu":
+                # Pallas kernel only when asked for (attention_impl)
+                # AND the shapes meet its tiling floor — tiny test/CI
+                # configs (head_dim < 128) must take the gather path
+                # even on real TPU hardware.
+                if (jax.default_backend() == "tpu"
+                        and cfg.attention_impl != "reference"
+                        and hd % 128 == 0):
                     from jax.experimental.pallas.ops.tpu.paged_attention \
                         .paged_attention_kernel import paged_attention
                     n_pages = tables_.shape[1]

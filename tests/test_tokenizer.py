@@ -1,0 +1,193 @@
+"""Native BPE tokenizer vs the HF `tokenizers` runtime (exact-match
+oracle), metaspace/byte-fallback behavior, and tokenizer.json loading.
+
+Reference analog: the reference leans on HF tokenizers inside vLLM;
+ray_tpu ships its own BPE (llm/tokenizer.py) so real checkpoints serve
+without that runtime. The HF library (present in this image) is used
+here only as the correctness oracle.
+"""
+
+import json
+
+import pytest
+
+from ray_tpu.llm.tokenizer import BPETokenizer, ByteTokenizer, get_tokenizer
+
+CORPUS = [
+    "hello world",
+    "The quick brown fox jumps over the lazy dog.",
+    "def f(x):\n    return x + 1\n",
+    "Tokenizers are fun! Aren't they? 12345 67.89",
+    "  leading spaces and   runs   of spaces",
+    "unicode: café naïve über straße",
+    "punct_uation-and_underscores __init__",
+]
+
+
+@pytest.fixture(scope="module")
+def hf_byte_level(tmp_path_factory):
+    """Train a small byte-level BPE with the HF runtime; return
+    (native_tokenizer, hf_tokenizer)."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, \
+        trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400, special_tokens=["<|endoftext|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(CORPUS * 4, trainer)
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tok.save(str(path))
+    return BPETokenizer.from_file(str(path)), tok
+
+
+def test_byte_level_matches_hf_exactly(hf_byte_level):
+    native, hf = hf_byte_level
+    for text in CORPUS + ["unseen!! text ééé 42"]:
+        assert native.encode(text) == hf.encode(text).ids, text
+
+
+def test_byte_level_roundtrip(hf_byte_level):
+    native, _ = hf_byte_level
+    for text in CORPUS:
+        assert native.decode(native.encode(text)) == text
+
+
+def test_special_tokens_split(hf_byte_level):
+    native, _ = hf_byte_level
+    eot = native.special["<|endoftext|>"]
+    ids = native.encode("hello<|endoftext|>world")
+    assert eot in ids
+    # special id maps straight through, no BPE over the marker text
+    assert ids.count(eot) == 1
+    assert native.decode(ids) == "helloworld"  # specials skipped
+    assert native.decode(ids, skip_special_tokens=False) == \
+        "hello<|endoftext|>world"
+
+
+def _metaspace_tokenizer():
+    """Hand-built SentencePiece-style vocab: pieces carry ▁, unknown
+    chars fall back to <0xNN> byte tokens."""
+    pieces = ["<unk>", "<s>", "</s>"]
+    pieces += [f"<0x{i:02X}>" for i in range(256)]
+    pieces += ["▁the", "▁cat", "▁sat", "▁on", "▁mat",
+               "▁t", "▁th", "▁c", "▁ca", "▁s", "▁sa", "▁o", "▁m",
+               "▁ma",
+               "▁", "t", "h", "e", "c", "a", "s", "o", "n", "m", "."]
+    vocab = {p: i for i, p in enumerate(pieces)}
+    merges = [["▁", "t"], ["▁t", "h"], ["▁th", "e"],
+              ["▁", "c"], ["▁c", "a"], ["▁ca", "t"],
+              ["▁", "s"], ["▁s", "a"], ["▁sa", "t"],
+              ["▁", "o"], ["▁o", "n"],
+              ["▁", "m"], ["▁m", "a"], ["▁ma", "t"]]
+    spec = {
+        "model": {"type": "BPE", "vocab": vocab, "merges": merges,
+                  "unk_token": "<unk>"},
+        "pre_tokenizer": {"type": "Metaspace",
+                          "prepend_scheme": "always"},
+        "added_tokens": [{"id": 1, "content": "<s>"},
+                         {"id": 2, "content": "</s>"}],
+    }
+    return spec, vocab
+
+
+def test_metaspace_scheme(tmp_path):
+    spec, vocab = _metaspace_tokenizer()
+    path = tmp_path / "tokenizer.json"
+    path.write_text(json.dumps(spec))
+    tok = BPETokenizer.from_file(str(path))
+    assert tok.scheme == "metaspace"
+    ids = tok.encode("the cat sat")
+    assert ids == [vocab["▁the"], vocab["▁cat"],
+                   vocab["▁sat"]]
+    assert tok.decode(ids) == "the cat sat"
+    assert tok.bos_token_id == 1 and tok.eos_token_id == 2
+
+
+def test_metaspace_byte_fallback(tmp_path):
+    spec, vocab = _metaspace_tokenizer()
+    path = tmp_path / "tokenizer.json"
+    path.write_text(json.dumps(spec))
+    tok = BPETokenizer.from_file(str(path))
+    # "café" — é is not in the vocab; its UTF-8 bytes are
+    ids = tok.encode("the café")
+    assert vocab["▁the"] in ids
+    assert vocab["<0xC3>"] in ids and vocab["<0xA9>"] in ids
+    assert tok.decode(ids) == "the café"
+
+
+def test_get_tokenizer_dispatch(tmp_path, hf_byte_level):
+    assert isinstance(get_tokenizer(None), ByteTokenizer)
+    native, hf = hf_byte_level
+    # path to a json file
+    spec, _ = _metaspace_tokenizer()
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    assert get_tokenizer(str(p)).scheme == "metaspace"
+    # checkpoint dir containing tokenizer.json
+    assert get_tokenizer(str(tmp_path)).scheme == "metaspace"
+    # raw HF tokenizer object gets adapted (encode returns Encoding)
+    wrapped = get_tokenizer(hf)
+    text = "hello world"
+    assert wrapped.encode(text) == native.encode(text)
+    # duck-typed object passes through
+    bt = ByteTokenizer()
+    assert get_tokenizer(bt) is bt
+
+
+def test_legacy_llama2_layout_sniffed_as_metaspace(tmp_path):
+    """Legacy sentencepiece conversions have NO pre_tokenizer — the ▁
+    machinery lives in a normalizer Sequence of Prepend + Replace."""
+    spec, vocab = _metaspace_tokenizer()
+    del spec["pre_tokenizer"]
+    spec["normalizer"] = {
+        "type": "Sequence",
+        "normalizers": [
+            {"type": "Prepend", "prepend": "▁"},
+            {"type": "Replace", "pattern": {"String": " "},
+             "content": "▁"},
+        ],
+    }
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    tok = BPETokenizer.from_file(str(p))
+    assert tok.scheme == "metaspace"
+    assert tok.prepend_scheme == "first"
+    ids = tok.encode("the cat")
+    assert ids == [vocab["▁the"], vocab["▁cat"]]
+    assert tok.decode(ids) == "the cat"
+
+
+def test_non_special_added_tokens_survive_decode(tmp_path):
+    spec, vocab = _metaspace_tokenizer()
+    nid = 600
+    spec["added_tokens"].append(
+        {"id": nid, "content": "<domain>", "special": False})
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    tok = BPETokenizer.from_file(str(p))
+    ids = tok.encode("the <domain>")
+    assert nid in ids
+    # special:false content is model-visible text: decode keeps it
+    assert "<domain>" in tok.decode(ids)
+    # true specials are still skipped
+    assert tok.decode([1] + ids) == tok.decode(ids)
+
+
+def test_prepend_scheme_first_vs_always(tmp_path):
+    spec, vocab = _metaspace_tokenizer()
+    spec["pre_tokenizer"]["prepend_scheme"] = "first"
+    p = tmp_path / "tokenizer.json"
+    p.write_text(json.dumps(spec))
+    tok = BPETokenizer.from_file(str(p))
+    # after a mid-text special token, NO spurious ▁ is injected: "cat"
+    # (no leading space) must tokenize from bare chars, not as ▁cat
+    ids = tok.encode("the</s>cat")
+    eos = vocab["</s>"]
+    i = ids.index(eos)
+    assert ids[:i] == [vocab["▁the"]]
+    assert ids[i + 1:] != [vocab["▁cat"]]
+    assert vocab["c"] in ids[i + 1:]
