@@ -81,6 +81,10 @@ _DEFAULTS: Dict[str, Any] = {
     "health_check_period_s": 1.0,
     "health_check_timeout_s": 5.0,
     "health_check_failure_threshold": 5,
+    # driver (job) liveness: a crashed/os._exit'd driver's leases,
+    # actors and PGs are reclaimed once its ping fails this many sweeps
+    "driver_health_check_period_s": 3.0,
+    "driver_health_check_failure_threshold": 3,
     "worker_liveness_check_period_s": 1.0,
     # --- gcs ---
     "gcs_storage": "memory",  # or a file path for persistence

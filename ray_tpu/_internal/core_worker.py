@@ -139,6 +139,23 @@ class ReferenceCounter:
             entry.in_plasma = entry.in_plasma or in_plasma
             entry.lineage_task = lineage_task
 
+    def new_owned_ref(self, object_id: ObjectID, owner_address: Address,
+                      lineage_task: Optional[TaskID] = None) -> ObjectRef:
+        """add_owned + the ObjectRef's add_local_ref in ONE lock
+        acquisition — the submit hot path creates one owned ref per
+        return and the two separate locked calls showed up in n:n
+        profiles."""
+        ref = ObjectRef(object_id, owner_address, _register=False)
+        with self._lock:
+            entry = self._entry(object_id)
+            entry.is_owner = True
+            entry.lineage_task = lineage_task
+            entry.local += 1
+            if entry.owner_address is None:
+                entry.owner_address = owner_address
+        ref._registered = True
+        return ref
+
     def mark_in_plasma(self, object_id: ObjectID):
         with self._lock:
             self._entry(object_id).in_plasma = True
@@ -189,6 +206,7 @@ class ReferenceCounter:
     def _decrement(self, object_id: ObjectID, kind: str):
         free = False
         notify_owner = None
+        in_plasma = False
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
@@ -196,12 +214,13 @@ class ReferenceCounter:
             setattr(entry, kind, max(0, getattr(entry, kind) - 1))
             if entry.total() == 0:
                 del self._entries[object_id]
+                in_plasma = entry.in_plasma
                 if entry.is_owner:
                     free = True
                 elif entry.owner_address is not None:
                     notify_owner = entry.owner_address
         if free:
-            self._cw._free_owned_object(object_id)
+            self._cw._free_owned_object(object_id, in_plasma=in_plasma)
         elif notify_owner is not None:
             self._cw.fire_and_forget(notify_owner, "borrow_decref",
                                      object_hex=object_id.hex())
@@ -258,12 +277,17 @@ class ReferenceCounter:
 # feeding the state API / timeline)
 # ---------------------------------------------------------------------------
 
+_UNSET = object()
+
+
 class TaskEventBuffer:
     def __init__(self, core_worker: "CoreWorker"):
         self._cw = core_worker
         self._lock = threading.Lock()
         self._events: List[Dict[str, Any]] = []
         self._flusher_started = False
+        self._worker_hex = _UNSET  # lazy: worker_id may be set post-init
+        self._hex_cache: Dict[Any, str] = {}  # job/actor ids repeat
 
     def record(self, spec: "TaskSpec", event: str, **extra):
         if not CONFIG.enable_task_events or not spec.enable_task_events:
@@ -286,17 +310,31 @@ class TaskEventBuffer:
     def _render(self, item) -> Dict[str, Any]:
         (task_id, attempt, name, job_id, task_type, actor_id, event,
          ts, extra) = item
+        wid = self._worker_hex
+        if wid is _UNSET:
+            wid = self._worker_hex = (
+                self._cw.worker_id.hex()
+                if isinstance(self._cw.worker_id, bytes) else None)
+        if len(self._hex_cache) > 4096:
+            self._hex_cache.clear()
+        jid = self._hex_cache.get(job_id)
+        if jid is None:
+            jid = self._hex_cache[job_id] = job_id.hex()
+        aid = None
+        if actor_id:
+            aid = self._hex_cache.get(actor_id)
+            if aid is None:
+                aid = self._hex_cache[actor_id] = actor_id.hex()
         ev = {
             "task_id": task_id.hex(),
             "attempt": attempt,
             "name": name,
-            "job_id": job_id.hex(),
+            "job_id": jid,
             "type": task_type,
-            "actor_id": actor_id.hex() if actor_id else None,
+            "actor_id": aid,
             "event": event,
             "ts": ts,
-            "worker_id": self._cw.worker_id.hex()
-            if isinstance(self._cw.worker_id, bytes) else None,
+            "worker_id": wid,
             "node_index": self._cw.node_index,
         }
         ev.update(extra)
@@ -340,13 +378,18 @@ class TaskManager:
         self.cancelled: Set[TaskID] = set()
         self._lineage_bytes = 0
 
-    def add_pending(self, spec: TaskSpec):
+    def add_pending(self, spec: TaskSpec,
+                    dep_ids: Optional[List[ObjectID]] = None,
+                    contained_ids: Optional[List[ObjectID]] = None):
+        if dep_ids is None:
+            dep_ids = [oid for oid, _ in spec.dependencies()]
+        if contained_ids is None:
+            contained_ids = [c for a in spec.args
+                             for c in a.contained_ref_ids]
         with self._lock:
             self.pending[spec.task_id] = PendingTask(
                 spec=spec, retries_left=spec.max_retries,
-                dep_ids=[oid for oid, _ in spec.dependencies()],
-                contained_ids=[c for a in spec.args
-                               for c in a.contained_ref_ids])
+                dep_ids=dep_ids, contained_ids=contained_ids)
         self._cw.task_events.record(spec, "SUBMITTED")
 
     def is_pending(self, task_id: TaskID) -> bool:
@@ -375,8 +418,11 @@ class TaskManager:
         return spec
 
     def is_cancelled(self, task_id: TaskID) -> bool:
-        with self._lock:
-            return task_id in self.cancelled
+        # Lock-free read: set membership is atomic under the GIL and
+        # cancellation racing a submit is resolved by the cancel path's
+        # own tombstone protocol — taking the lock here cost ~2us on
+        # every hot-path submit for a almost-always-False check.
+        return task_id in self.cancelled
 
     def _take_cancelled(self, task_id: TaskID) -> bool:
         with self._lock:
@@ -482,8 +528,9 @@ class TaskManager:
     def _release_deps(self, pending: Optional[PendingTask]):
         if pending is None:
             return
-        self._cw.reference_counter.remove_submitted(
-            pending.dep_ids + pending.contained_ids)
+        if pending.dep_ids or pending.contained_ids:
+            self._cw.reference_counter.remove_submitted(
+                pending.dep_ids + pending.contained_ids)
 
     def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
         with self._lock:
@@ -965,6 +1012,10 @@ class ActorClientState:
     slow_pending: int = 0
 
 
+# read once: os.environ.get costs ~1us and sat on every hot-path submit
+_NO_SUBMIT_FASTPATH = bool(os.environ.get("RTPU_NO_SUBMIT_FASTPATH"))
+
+
 class ActorTaskSubmitter:
     """Actor task stream (reference: actor_task_submitter.cc PushActorTask).
 
@@ -1005,7 +1056,7 @@ class ActorTaskSubmitter:
         # loop-side slow path which resolves state via the GCS.
         st = self.state_for(spec.actor_id)
         enqueued = need_flush = False
-        if (not os.environ.get("RTPU_NO_SUBMIT_FASTPATH")
+        if (not _NO_SUBMIT_FASTPATH
                 and self._subscribed and st.state == "ALIVE"
                 and st.address is not None and not st.reconciling
                 and not st.queued):
@@ -1338,6 +1389,27 @@ class _RuntimeContext(threading.local):
 RUNTIME_CTX = _RuntimeContext()
 
 
+_EMPTY_ARGS_CACHE = None
+_NONE_DATA_CACHE = None
+
+
+def _empty_args_data() -> bytes:
+    """The driver's constant empty-args bundle bytes (remote_function
+    pickles it once; the worker compares against the same constant)."""
+    global _EMPTY_ARGS_CACHE
+    if _EMPTY_ARGS_CACHE is None:
+        from ..remote_function import pack_args
+        _EMPTY_ARGS_CACHE = pack_args((), {})[0].data
+    return _EMPTY_ARGS_CACHE
+
+
+def _none_data() -> bytes:
+    global _NONE_DATA_CACHE
+    if _NONE_DATA_CACHE is None:
+        _NONE_DATA_CACHE = serialization.serialize(None).to_bytes()
+    return _NONE_DATA_CACHE
+
+
 def _reply_nbytes(reply: Dict[str, Any]) -> int:
     """Approximate retained size of a push reply (inline return bytes)."""
     total = 64
@@ -1495,7 +1567,12 @@ class TaskExecutor:
     # -- shared execution helpers ---------------------------------------
 
     def _load_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
-        bundle = serialization.deserialize(spec.args[0].data)
+        data = spec.args[0].data
+        if data == _empty_args_data() and len(spec.args) == 1:
+            # No-arg calls dominate control floods; the driver pickles
+            # this constant bundle once — skip the symmetric unpickle.
+            return (), {}
+        bundle = serialization.deserialize(data)
         ref_values = []
         for arg in spec.args[1:]:
             if arg.is_ref:
@@ -1523,6 +1600,11 @@ class TaskExecutor:
                 f"{len(values)} values")
         returns = []
         for index, value in enumerate(values):
+            if value is None:
+                # None returns dominate control-plane methods; their
+                # serialized form is a constant.
+                returns.append({"data": _none_data()})
+                continue
             sobj = serialization.serialize(value)
             self._cw.reference_counter.pin_for_transit(sobj.contained_refs)
             oid = ObjectID.for_task_return(spec.task_id, index)
@@ -1758,6 +1840,8 @@ class CoreWorker:
         self._completed_push_replies: Dict[Tuple[TaskID, int],
                                            Dict[str, Any]] = {}
         self._completed_push_bytes = 0
+        self._push_record_ttl: collections.deque = collections.deque()
+        self._push_sweeper_on = False
         # Called with the ObjectID whenever an owned object is freed
         # (device-resident object pins, experimental/device_objects.py).
         self.device_object_free_hooks: List = []
@@ -2087,13 +2171,19 @@ class CoreWorker:
         for ref in refs:
             self._free_owned_object(ref.id())
 
-    def _free_owned_object(self, object_id: ObjectID):
+    def _free_owned_object(self, object_id: ObjectID,
+                           in_plasma: bool = True):
         for hook in self.device_object_free_hooks:
             try:
                 hook(object_id)
             except Exception:
                 pass
         self.memory_store.delete([object_id])
+        if not in_plasma:
+            # Memory-store-only object: the GCS directory never heard of
+            # it — skip the hex render + free RPC (the dominant free-path
+            # cost on call floods, where every return is inline).
+            return
         # Batch the directory-free notifications: a burst of ref releases
         # (e.g. a list of ObjectRefs going out of scope) becomes one GCS RPC.
         with self._free_lock:
@@ -2116,15 +2206,14 @@ class CoreWorker:
     # -- task submission -------------------------------------------------
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        self.task_manager.add_pending(spec)
         dep_ids = [oid for oid, _ in spec.dependencies()]
         contained = [c for a in spec.args for c in a.contained_ref_ids]
-        self.reference_counter.add_submitted(dep_ids + contained)
-        refs = []
-        for oid in spec.return_ids():
-            self.reference_counter.add_owned(
-                oid, lineage_task=spec.task_id)
-            refs.append(ObjectRef(oid, self.rpc_address))
+        self.task_manager.add_pending(spec, dep_ids, contained)
+        if dep_ids or contained:
+            self.reference_counter.add_submitted(dep_ids + contained)
+        refs = [self.reference_counter.new_owned_ref(
+                    oid, self.rpc_address, lineage_task=spec.task_id)
+                for oid in spec.return_ids()]
         if spec.task_type == ACTOR_TASK:
             self.actor_submitter.submit(spec)
         else:
@@ -2154,8 +2243,7 @@ class CoreWorker:
         try:
             reply = await self.executor.execute(spec)
         except BaseException:
-            asyncio.get_event_loop().call_later(
-                120.0, self._received_pushes.discard, spec.task_id)
+            self._expire_push_record((spec.task_id, None))
             raise
         # Cache BEFORE the reply frame is written: a probe racing the
         # reply sees "done" rather than "unknown".
@@ -2169,15 +2257,32 @@ class CoreWorker:
             _k, _v = next(iter(self._completed_push_replies.items()))
             del self._completed_push_replies[_k]
             self._completed_push_bytes -= _reply_nbytes(_v)
-        asyncio.get_event_loop().call_later(
-            120.0, self._discard_push_record, push_key)
+        self._expire_push_record(push_key)
         return reply
 
-    def _discard_push_record(self, push_key: Tuple[TaskID, int]):
-        self._received_pushes.discard(push_key[0])
-        reply = self._completed_push_replies.pop(push_key, None)
-        if reply is not None:
-            self._completed_push_bytes -= _reply_nbytes(reply)
+    def _expire_push_record(self, push_key):
+        """TTL the push record via ONE periodic sweeper instead of a
+        TimerHandle per task (1M queued tasks would mean 2M live
+        timers). Records expire 120-180 s after completion."""
+        self._push_record_ttl.append((time.monotonic() + 120.0, push_key))
+        if not self._push_sweeper_on:
+            self._push_sweeper_on = True
+            asyncio.get_event_loop().call_later(60.0, self._sweep_push_records)
+
+    def _sweep_push_records(self):
+        now = time.monotonic()
+        q = self._push_record_ttl
+        while q and q[0][0] <= now:
+            _deadline, push_key = q.popleft()
+            self._received_pushes.discard(push_key[0])
+            reply = self._completed_push_replies.pop(push_key, None)
+            if reply is not None:
+                self._completed_push_bytes -= _reply_nbytes(reply)
+        if q:
+            asyncio.get_event_loop().call_later(
+                60.0, self._sweep_push_records)
+        else:
+            self._push_sweeper_on = False
 
     async def handle_dump_stacks(self, path: str = "") -> bool:
         """Debug: dump all thread stacks (+ asyncio tasks) to `path` or
@@ -2253,7 +2358,9 @@ class CoreWorker:
 
     def _report_actor_done(self, spec: TaskSpec, done_to: Address, reply):
         q = self._done_batches.setdefault(done_to, [])
-        q.append((spec.task_id.hex(), reply))
+        # raw id bytes: a hex() here + from_hex() on the owner showed up
+        # at ~3us/call on n:n floods
+        q.append((spec.task_id.binary(), reply))
         if len(q) == 1:
             asyncio.get_event_loop().call_soon(
                 lambda: asyncio.ensure_future(self._flush_done(done_to)))
@@ -2269,8 +2376,10 @@ class CoreWorker:
             pass  # owner unreachable; actor-state pubsub recovers the rest
 
     async def handle_actor_tasks_done(self, results):
-        for task_hex, reply in results:
-            self.actor_submitter.on_done(TaskID.from_hex(task_hex), reply)
+        for task_key, reply in results:
+            task_id = TaskID(task_key) if isinstance(task_key, bytes) \
+                else TaskID.from_hex(task_key)
+            self.actor_submitter.on_done(task_id, reply)
 
     async def handle_actor_task_status(self, queries):
         """Straggler probe from an owner: for each (caller_hex, seq,

@@ -102,6 +102,7 @@ class JobRecord:
     start_time: float = 0.0
     end_time: float = 0.0
     metadata: Dict[str, str] = field(default_factory=dict)
+    missed_pings: int = 0
 
 
 class GcsServer:
@@ -142,6 +143,11 @@ class GcsServer:
         self._next_node_index = 1
         self._health_task = None
         self._started = False
+        # finished/dead jobs, hex -> monotonic finish time: raylets learn
+        # of them via heartbeat replies and reap the job's worker leases
+        # (reference: node_manager HandleJobFinished kills job workers)
+        self._finished_jobs: Dict[str, float] = {}
+        self._last_driver_sweep = 0.0
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -322,8 +328,18 @@ class GcsServer:
         # version (reference: ray_syncer.h's versioned resource broadcast —
         # a stable cluster exchanges no per-node payload at all, vs the
         # O(nodes^2) traffic of full snapshots every interval).
-        return {"dead": False,
-                "view": self.view_delta(known_ver, known_epoch)}
+        reply = {"dead": False,
+                 "view": self.view_delta(known_ver, known_epoch)}
+        if self._finished_jobs:
+            # prune here too: without it the last job ever finished
+            # would be rebroadcast (and re-reaped) every heartbeat forever
+            now = time.monotonic()
+            self._finished_jobs = {h: ts for h, ts
+                                   in self._finished_jobs.items()
+                                   if now - ts <= 600}
+            if self._finished_jobs:
+                reply["finished_jobs"] = list(self._finished_jobs)
+        return reply
 
     async def handle_get_cluster_demand(self):
         """Aggregate unmet demand for the autoscaler: queued lease shapes
@@ -440,10 +456,52 @@ class GcsServer:
                     if rec.missed_health_checks >= \
                             CONFIG.health_check_failure_threshold:
                         await self._on_node_death(rec.node_id, "health check failed")
+                if now - self._last_driver_sweep >= \
+                        CONFIG.driver_health_check_period_s:
+                    self._last_driver_sweep = now
+                    await self._sweep_dead_drivers()
             except asyncio.CancelledError:
                 return
             except Exception:
                 logger.exception("gcs health check loop error")
+
+    async def _sweep_dead_drivers(self):
+        """Drivers that exit without disconnecting (crash, os._exit) must
+        not strand their leases/actors/PGs forever: ping each RUNNING
+        job's driver; repeated failures finish the job (reference:
+        gcs_job_manager.cc marks jobs dead when the driver's RPC channel
+        drops — this wire has no channel ownership, so an active probe)."""
+        async def probe(rec):
+            try:
+                await self.clients.get(tuple(rec.driver_address)).call(
+                    "ping", timeout=CONFIG.health_check_timeout_s)
+                rec.missed_pings = 0
+            except (ConnectionError, ConnectionRefusedError) as e:
+                # Refused/closed connection = the process is GONE (a
+                # dead port refuses instantly). Timeouts are NOT strikes:
+                # a flooding driver's io thread can be GIL-starved for
+                # many seconds on a contended box, and killing its leases
+                # mid-flood devastated the multi-client bench.
+                rec.missed_pings = getattr(rec, "missed_pings", 0) + 1
+                if rec.missed_pings >= \
+                        CONFIG.driver_health_check_failure_threshold:
+                    logger.warning("driver for job %s unreachable (%s) %d "
+                                   "times; finishing job",
+                                   rec.job_id.hex()[:8], e, rec.missed_pings)
+                    await self._finish_job(rec.job_id)
+            except Exception:
+                pass  # timeout/other: congested, not provably dead
+        running = [rec for rec in self.jobs.values()
+                   if rec.state == "RUNNING" and rec.driver_address]
+        if running:
+            # concurrent, with an overall bound: K stalled drivers must
+            # not serialize into a K*timeout stall of the health loop
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(probe(r) for r in running)),
+                    CONFIG.health_check_timeout_s * 2)
+            except asyncio.TimeoutError:
+                pass
 
     async def _on_node_death(self, node_id: str, cause: str):
         rec = self.nodes.get(node_id)
@@ -494,10 +552,22 @@ class GcsServer:
         return job_id
 
     async def handle_mark_job_finished(self, job_id: JobID):
+        await self._finish_job(job_id)
+        return True
+
+    async def _finish_job(self, job_id: JobID):
         rec = self.jobs.get(job_id)
         if rec:
+            if rec.state == "FINISHED":
+                return
             rec.state = "FINISHED"
             rec.end_time = time.time()
+        # Raylets reap the job's worker leases on their next heartbeat.
+        now = time.monotonic()
+        self._finished_jobs[job_id.hex()] = now
+        for hex_, ts in list(self._finished_jobs.items()):
+            if now - ts > 600:
+                del self._finished_jobs[hex_]
         # Clean up non-detached actors owned by the job.
         for actor in list(self.actors.values()):
             if actor.spec.job_id == job_id and not actor.is_detached \
@@ -508,7 +578,6 @@ class GcsServer:
                     and pg.state != "REMOVED":
                 await self.handle_remove_placement_group(pg.pg_id)
         self._persist()
-        return True
 
     async def handle_get_all_jobs(self):
         return [

@@ -44,6 +44,11 @@ _NIL = b""
 class BaseID:
     SIZE = 28
     __slots__ = ("_bytes", "_hash")
+    _SALT = 0
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        cls._SALT = hash(cls.__name__)
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -51,7 +56,10 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
             )
         self._bytes = binary
-        self._hash = hash((type(self).__name__, binary))
+        # xor with a per-class salt: same cross-type separation as
+        # hash((classname, bytes)) without building a tuple per id
+        # (ids are constructed twice per task on the hot path)
+        self._hash = hash(binary) ^ self._SALT
 
     @classmethod
     def from_random(cls):

@@ -172,6 +172,9 @@ class Raylet:
                                    self.node_id[:12])
                     return
                 self._update_view(reply.get("view", {}))
+                fj = reply.get("finished_jobs")
+                if fj:
+                    self._reap_job_leases(fj)
             except asyncio.CancelledError:
                 return
             except Exception:
@@ -467,6 +470,26 @@ class Raylet:
                 return
             except Exception:
                 logger.exception("worker liveness loop error")
+
+    def _reap_job_leases(self, finished_jobs: List[str]):
+        """Kill workers leased to finished/dead jobs and refund their
+        resources; drop the jobs' queued lease requests (reference:
+        node_manager.cc HandleJobFinished). Idempotent — the GCS resends
+        recently finished jobs on every heartbeat."""
+        jobs = set(finished_jobs)
+        for handle in list(self.workers.values()):
+            if handle.job_hex in jobs and handle.lease_id is not None \
+                    and handle.state != "DEAD":
+                logger.info("reaping worker %s leased to finished job %s",
+                            handle.worker_id.hex()[:12], handle.job_hex[:8])
+                lease_id = handle.lease_id
+                self._kill_worker(handle)
+                self._release_lease(lease_id)
+        for req in list(self.queued):
+            if req.spec_meta.get("job") in jobs:
+                self.queued.remove(req)
+                if not req.future.done():
+                    req.future.set_result({"canceled": True})
 
     async def _on_worker_death(self, handle: WorkerHandle):
         # Actor workers routinely die on purpose (ray.kill / job teardown

@@ -28,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import os
 import random
 import struct
 import threading
@@ -113,6 +114,13 @@ class EventLoopThread:
 
     def __init__(self):
         self.loop = asyncio.new_event_loop()
+        # Eager tasks (3.12): a coroutine spawned via ensure_future runs
+        # inline to its first true suspension — RPC handlers and actor
+        # dispatch that complete synchronously never pay a Task schedule
+        # round-trip (~25us/call on the n:n flood path).
+        if hasattr(asyncio, "eager_task_factory") and \
+                not os.environ.get("RTPU_NO_EAGER_TASKS"):
+            self.loop.set_task_factory(asyncio.eager_task_factory)
         self._post_q: collections.deque = collections.deque()
         self._post_lock = threading.Lock()
         self._post_scheduled = False

@@ -259,7 +259,8 @@ class BPETokenizer:
             if sid is not None:
                 ids.append(sid)
             elif self.scheme == "byte_level":
-                self._encode_byte_level(chunk, ids)
+                self._encode_byte_level(chunk, ids, first_text_chunk)
+                first_text_chunk = False
             else:
                 # prepend_scheme "first": only the first text chunk of
                 # the whole input gets the ▁ prefix; "always": every
@@ -270,7 +271,13 @@ class BPETokenizer:
                 first_text_chunk = False
         return ids
 
-    def _encode_byte_level(self, text: str, ids: List[int]):
+    def _encode_byte_level(self, text: str, ids: List[int],
+                           first_chunk: bool = True):
+        if self.add_prefix_space and first_chunk and text and \
+                not text[0].isspace():
+            # ByteLevel(add_prefix_space=true) checkpoints (RoBERTa/BART
+            # conversions) tokenize " hello" for a leading "hello"
+            text = " " + text
         for word in _BYTE_LEVEL_PAT.findall(text):
             mapped = "".join(_BYTE_ENC[b] for b in word.encode("utf-8"))
             self._symbol_ids(self._bpe(mapped), ids)

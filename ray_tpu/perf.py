@@ -240,6 +240,21 @@ def main(quick: bool = False) -> Dict[str, float]:
     results["put_gib_per_s"] = reps * 25 / 1024 / dt
     _report("put_gib_per_s", results["put_gib_per_s"], "GiB/s")
 
+    # The multi-client bench leaves 4 dead drivers whose leases the
+    # GCS driver-liveness sweep reclaims (~10 s). Wait for the CPUs to
+    # come back so the PG bench measures PG throughput, not
+    # dead-driver reclamation latency.
+    from ray_tpu.util.state.api import list_nodes
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        nodes = list_nodes()
+        free = sum(n_["resources_available"].get("CPU", 0)
+                   for n_ in nodes)
+        total = sum(n_["resources_total"].get("CPU", 0) for n_ in nodes)
+        if free >= total:
+            break
+        time.sleep(1.0)
+
     from ray_tpu.util.placement_group import (placement_group,
                                               remove_placement_group)
     n = 50 * scale

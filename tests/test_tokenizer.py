@@ -191,3 +191,22 @@ def test_prepend_scheme_first_vs_always(tmp_path):
     assert ids[:i] == [vocab["▁the"]]
     assert ids[i + 1:] != [vocab["▁cat"]]
     assert vocab["c"] in ids[i + 1:]
+
+
+def test_byte_level_add_prefix_space_matches_hf(tmp_path):
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, \
+        trainers
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=True)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=350, special_tokens=[],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(CORPUS * 4, trainer)
+    path = tmp_path / "tokenizer.json"
+    tok.save(str(path))
+    native = BPETokenizer.from_file(str(path))
+    assert native.add_prefix_space
+    for text in ["hello world", "The fox.", " already spaced"]:
+        assert native.encode(text) == tok.encode(text).ids, text
