@@ -30,6 +30,7 @@ import sys
 import threading
 import time
 import traceback
+import dataclasses
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -568,6 +569,20 @@ class Lease:
     retiring: bool = False
 
 
+@dataclass
+class _ProbeState:
+    push: "asyncio.Future"
+    worker: Any
+    spec: TaskSpec
+    lease: "Lease"
+    started: float
+    unknown: int = 0
+    unreachable: int = 0
+    running: int = 0
+    recovered: Optional[Dict[str, Any]] = None  # reply fetched via probe
+    crashed: Optional[str] = None               # verdict: worker lost it
+
+
 class NormalTaskSubmitter:
     def __init__(self, core_worker: "CoreWorker"):
         self._cw = core_worker
@@ -578,6 +593,8 @@ class NormalTaskSubmitter:
         self._shape_specs: Dict[Tuple, TaskSpec] = {}
         self._request_tasks: set = set()
         self._cleaner_started = False
+        self._probed: Dict[TaskID, _ProbeState] = {}
+        self._probe_sweeper_on = False
 
     async def cancel_pending_requests(self):
         """Cancel lease requests still queued at raylets (shutdown path)."""
@@ -637,65 +654,106 @@ class NormalTaskSubmitter:
                                lease: Lease) -> Dict[str, Any]:
         """push_task with liveness probing instead of a duration bound
         (reference: lease liveness is connection-tied in the raylet; here
-        the probe asks the worker whether it still knows the task)."""
+        the probe asks the worker whether it still knows the task).
+
+        The probing itself runs in ONE sweeper over all outstanding
+        pushes: a per-task `asyncio.wait(timeout=...)` costs a
+        TimerHandle + wait bookkeeping per call, which dominated the
+        1M-queued-task profile. The hot path is a plain await; the
+        sweeper resolves stuck pushes by cancelling them after stashing
+        a verdict in `_ProbeState`."""
         push = asyncio.ensure_future(worker.call(
             "push_task", spec=spec, lease_id=lease.lease_id,
             timeout=None))
-        unknown = 0
-        unreachable = 0
-        running = 0
+        ps = _ProbeState(push=push, worker=worker, spec=spec, lease=lease,
+                         started=time.monotonic())
+        self._probed[spec.task_id] = ps
+        if not self._probe_sweeper_on:
+            self._probe_sweeper_on = True
+            asyncio.ensure_future(self._probe_sweeper())
+        try:
+            return await push
+        except asyncio.CancelledError:
+            # the sweeper cancelled us with a verdict
+            if ps.recovered is not None:
+                return ps.recovered
+            if ps.crashed is not None:
+                raise WorkerCrashedError(ps.crashed) from None
+            raise
+        finally:
+            self._probed.pop(spec.task_id, None)
+
+    async def _probe_sweeper(self):
+        """One loop probing ALL outstanding pushes older than a probe
+        period (replaces per-task probe loops)."""
+        period = CONFIG.push_probe_period_s
         while True:
-            done, _ = await asyncio.wait(
-                {push}, timeout=CONFIG.push_probe_period_s)
-            if done:
-                return push.result()
-            try:
-                state = await worker.call(
-                    "task_probe", task_hex=spec.task_id.hex(),
-                    attempt=spec.attempt_number, timeout=15)
-            except Exception:
-                # Probe timeout / transport error: the worker may just be
-                # congested (single-core multi-driver floods). A dead
-                # worker's push fails with its own connection error first,
-                # so give these a separate, much larger budget instead of
-                # counting them as "worker lost the task".
-                unreachable += 1
-                if unreachable >= CONFIG.push_probe_unreachable_threshold:
-                    push.cancel()
-                    raise WorkerCrashedError(
-                        f"worker {lease.worker_address} unreachable for "
-                        f"{unreachable} probes on task "
-                        f"{spec.task_id.hex()[:12]}")
-                continue
-            unreachable = 0
-            if isinstance(state, dict) and state.get("state") == "done":
-                # The task finished but its reply frame was lost en
-                # route: recover the cached reply via the probe channel
-                # instead of dropping the lease and re-executing.
-                push.cancel()
-                return state["reply"]
-            if state == "running":
-                unknown = 0
-                running += 1
-                if running == 6:
-                    # "running" for ~90s on a tiny task: capture the
-                    # worker's stacks for postmortem (file survives the
-                    # processes)
-                    try:
-                        await worker.call(
-                            "dump_stacks",
-                            path=f"/tmp/rtpu-stuck-{spec.task_id.hex()[:8]}"
-                                 ".txt",
-                            timeout=15)
-                    except Exception:  # noqa: BLE001
-                        pass
-                continue
-            unknown += 1
-            if unknown >= CONFIG.push_probe_unknown_threshold:
-                push.cancel()
-                raise WorkerCrashedError(
-                    f"worker {lease.worker_address} lost task "
-                    f"{spec.task_id.hex()[:12]} (probe: {state})")
+            await asyncio.sleep(period)
+            if not self._probed:
+                self._probe_sweeper_on = False
+                return
+            now = time.monotonic()
+            due = [ps for ps in self._probed.values()
+                   if not ps.push.done() and now - ps.started >= period]
+            if due:
+                # concurrent: K stuck workers must not serialize into
+                # K x 15s sweeps
+                await asyncio.gather(
+                    *(self._probe_one(ps) for ps in due),
+                    return_exceptions=True)
+
+    async def _probe_one(self, ps: "_ProbeState"):
+        spec, lease = ps.spec, ps.lease
+        try:
+            state = await ps.worker.call(
+                "task_probe", task_hex=spec.task_id.hex(),
+                attempt=spec.attempt_number, timeout=15)
+        except Exception:
+            # Probe timeout / transport error: the worker may just be
+            # congested (single-core multi-driver floods). A dead
+            # worker's push fails with its own connection error first,
+            # so give these a separate, much larger budget instead of
+            # counting them as "worker lost the task".
+            ps.unreachable += 1
+            if ps.unreachable >= CONFIG.push_probe_unreachable_threshold:
+                ps.crashed = (
+                    f"worker {lease.worker_address} unreachable for "
+                    f"{ps.unreachable} probes on task "
+                    f"{spec.task_id.hex()[:12]}")
+                ps.push.cancel()
+            return
+        ps.unreachable = 0
+        if ps.push.done():
+            return  # reply landed while we probed
+        if isinstance(state, dict) and state.get("state") == "done":
+            # The task finished but its reply frame was lost en route:
+            # recover the cached reply via the probe channel instead of
+            # dropping the lease and re-executing.
+            ps.recovered = state["reply"]
+            ps.push.cancel()
+            return
+        if state == "running":
+            ps.unknown = 0
+            ps.running += 1
+            if ps.running == 6:
+                # "running" for ~90s on a tiny task: capture the
+                # worker's stacks for postmortem (file survives the
+                # processes)
+                try:
+                    await ps.worker.call(
+                        "dump_stacks",
+                        path=f"/tmp/rtpu-stuck-{spec.task_id.hex()[:8]}"
+                             ".txt",
+                        timeout=15)
+                except Exception:  # noqa: BLE001
+                    pass
+            return
+        ps.unknown += 1
+        if ps.unknown >= CONFIG.push_probe_unknown_threshold:
+            ps.crashed = (
+                f"worker {lease.worker_address} lost task "
+                f"{spec.task_id.hex()[:12]} (probe: {state})")
+            ps.push.cancel()
 
     async def _resolve_dependencies(self, spec: TaskSpec):
         """Wait until owned args exist; inline small plain values
@@ -742,12 +800,14 @@ class NormalTaskSubmitter:
         flight. Without the handoff, returned leases sit idle (resources
         still charged at the raylet) while queued requests starve."""
         key = spec.shape_key()
-        # latest spec per shape: re-issuing lease requests after a
-        # fairness rotation needs a representative spec. STRIPPED of
-        # args — keys are long-lived and a full spec would pin up to
+        # one representative spec per shape: re-issuing lease requests
+        # after a fairness rotation needs one. STRIPPED of args — keys
+        # are long-lived and a full spec would pin up to
         # inline_arg_max_bytes of payload per distinct shape forever.
-        import dataclasses as _dc
-        self._shape_specs[key] = _dc.replace(spec, args=[])
+        # (Stored once: a dataclasses.replace per submit cost ~8us on
+        # the 1M-queued-task path.)
+        if key not in self._shape_specs:
+            self._shape_specs[key] = dataclasses.replace(spec, args=[])
         if spec.scheduling_strategy.kind == "SPREAD":
             # SPREAD must not pipeline onto a cached lease — each task
             # goes through its own lease request so the raylet's

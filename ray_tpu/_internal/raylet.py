@@ -273,25 +273,39 @@ class Raylet:
             # lease/heartbeat handling (1-core boxes stall for seconds).
             # With log_to_driver, worker output is piped and streamed to
             # the driver via GCS pubsub (reference: _private/log_monitor.py).
-            from .task_spec import ENV_KEY_PYTHON_ENV
+            from .task_spec import (ENV_KEY_CONDA, ENV_KEY_PYTHON_ENV,
+                                    ENV_KEY_UV)
             interpreter = sys.executable
             pyenv_reqs = env_key[ENV_KEY_PYTHON_ENV] \
                 if len(env_key) > ENV_KEY_PYTHON_ENV else ()
-            if pyenv_reqs:
-                # isolated venv interpreter (reference: conda/uv plugins)
+            conda_entry = env_key[ENV_KEY_CONDA] \
+                if len(env_key) > ENV_KEY_CONDA else ""
+            uv_pkgs = env_key[ENV_KEY_UV] \
+                if len(env_key) > ENV_KEY_UV else ""
+            if pyenv_reqs or conda_entry or uv_pkgs:
+                # isolated interpreter (reference: conda/uv/pip plugins)
                 from .errors import RuntimeEnvSetupError
-                from .runtime_env import ensure_python_env
+                from .runtime_env import (ensure_conda_env_entry,
+                                          ensure_python_env,
+                                          ensure_uv_env)
+                pyenv_root = os.path.join(
+                    "/tmp", "rtpu", f"session_{self.session_name}",
+                    "pyenvs")
                 try:
-                    interpreter = ensure_python_env(
-                        list(pyenv_reqs),
-                        os.path.join("/tmp", "rtpu",
-                                     f"session_{self.session_name}",
-                                     "pyenvs"))
+                    if conda_entry:
+                        interpreter = ensure_conda_env_entry(
+                            conda_entry, pyenv_root)
+                    elif uv_pkgs:
+                        interpreter = ensure_uv_env(
+                            list(uv_pkgs), pyenv_root)
+                    else:
+                        interpreter = ensure_python_env(
+                            list(pyenv_reqs), pyenv_root)
                 except Exception as e:
                     # Deterministic: the same requirements will fail the
                     # same way on every node — callers must not retry.
                     raise RuntimeEnvSetupError(
-                        f"python_env setup failed: {e}") from e
+                        f"python env setup failed: {e}") from e
             if CONFIG.log_to_driver:
                 out_target = err_target = subprocess.PIPE
             else:

@@ -17,7 +17,9 @@ import hashlib
 import io
 import logging
 import os
+import re
 import threading
+import sys
 import zipfile
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -110,6 +112,12 @@ def upload_packages(runtime_env: Optional[Dict[str, Any]], gcs
         # Zero-egress environments cannot create venvs; the contract here
         # is "verify importable, else fail fast" (documented limitation).
         out["pip"] = list(pip)
+    conda = out.get("conda")
+    if isinstance(conda, str) and conda.endswith((".yml", ".yaml")):
+        # environment.yml exists on the DRIVER's disk only: inline it
+        # now so raylets on other nodes (where runtime_env_key re-runs)
+        # never need the file.
+        out["conda"] = _load_yaml(conda)
     return out
 
 
@@ -195,20 +203,19 @@ def python_env_key(requirements: List[str]) -> str:
     return f"pyenv-{digest}"
 
 
-def ensure_python_env(requirements: List[str], root: str) -> str:
-    """Create (once) an isolated venv for `requirements`; returns its
-    python executable. Safe under concurrent callers via sentinel+wait.
-    """
-    import subprocess
-    import sys
+def _locked_build(env_dir: str, build_fn) -> None:
+    """Run `build_fn()` exactly once per env_dir across processes AND
+    threads: marker short-circuits, a lockfile elects one builder
+    (stale locks from SIGKILLed builders are reclaimed), losers wait
+    for the marker. Partial builds from a crashed builder are cleared
+    before rebuilding (conda/uv error on existing prefixes)."""
+    import shutil
     import time as _time
 
-    env_dir = os.path.join(root, python_env_key(requirements))
-    py = os.path.join(env_dir, "bin", "python")
     marker = os.path.join(env_dir, ".rtpu-ready")
     if os.path.exists(marker):
-        return py
-    os.makedirs(root, exist_ok=True)
+        return
+    os.makedirs(os.path.dirname(env_dir), exist_ok=True)
     lock_path = env_dir + ".lock"
     try:
         try:
@@ -226,10 +233,35 @@ def ensure_python_env(requirements: List[str], root: str) -> str:
         while not os.path.exists(marker):
             if _time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"python_env {env_dir} build did not finish")
+                    f"python env {env_dir} build did not finish")
             _time.sleep(0.25)
-        return py
+        return
     try:
+        if os.path.exists(marker):
+            return
+        if os.path.isdir(env_dir):  # crashed builder's partial env
+            shutil.rmtree(env_dir, ignore_errors=True)
+        build_fn()
+        with open(marker, "w") as f:
+            f.write("ok")
+    finally:
+        try:
+            os.unlink(lock_path)
+        except OSError:
+            pass
+
+
+def ensure_python_env(requirements: List[str], root: str) -> str:
+    """Create (once) an isolated venv for `requirements`; returns its
+    python executable. Safe under concurrent callers via _locked_build.
+    """
+    import subprocess
+    import sys
+
+    env_dir = os.path.join(root, python_env_key(requirements))
+    py = os.path.join(env_dir, "bin", "python")
+
+    def build():
         import venv
         venv.create(env_dir, system_site_packages=True, with_pip=True,
                     clear=True)
@@ -262,14 +294,230 @@ def ensure_python_env(requirements: List[str], root: str) -> str:
                 raise RuntimeError(
                     "python_env requirements not satisfiable offline:\n"
                     + proc.stderr.decode()[-2000:])
-        with open(marker, "w") as f:
-            f.write("ok")
+
+    _locked_build(env_dir, build)
+    return py
+
+
+# ---------------------------------------------------------------------------
+# conda / uv environments (reference: _private/runtime_env/conda.py and
+# uv.py — per-requirements interpreter environments managed by the named
+# tool). TPU-native deployment note: production TPU images are
+# zero-egress and usually lack conda; when the tool binary is absent,
+# python-level dependencies fall back to the same offline overlay-venv
+# as `pip` (validate against baked packages), and binary/channel deps
+# fail loudly.
+# ---------------------------------------------------------------------------
+
+def parse_conda_spec(conda: Any) -> Tuple[Optional[str], List[str]]:
+    """Normalize the `conda` runtime_env field -> (env_name, pip_deps).
+
+    Accepts the reference's three shapes (conda.py:get_conda_dict): a
+    named existing env (str), a path to environment.yml (str ending
+    .yml/.yaml), or an inline environment dict. Inline/file deps are
+    flattened to pip-style requirements: "numpy=1.26" -> "numpy==1.26",
+    nested {"pip": [...]} lists pass through."""
+    if isinstance(conda, str):
+        if conda.endswith((".yml", ".yaml")):
+            spec = _load_yaml(conda)
+        else:
+            return conda, []
+    elif isinstance(conda, dict):
+        spec = conda
+    else:
+        raise ValueError(f"runtime_env conda must be str|dict, got {conda!r}")
+    deps: List[str] = []
+    for dep in spec.get("dependencies", []):
+        if isinstance(dep, dict):
+            deps.extend(dep.get("pip", []))
+        elif isinstance(dep, str):
+            req = dep.strip()
+            name = re.split(r"[=<>~!]", req, 1)[0].strip()
+            if name in ("python", "pip"):
+                continue  # interpreter/tool pins are the env's business
+            # conda's single-= pin becomes pip's ==; real specifiers
+            # (>=, <=, ~=, ==, !=) pass through untouched
+            req = re.sub(r"(?<![=<>~!])=(?![=<>~!])", "==", req, count=1)
+            deps.append(req)
+    return None, deps
+
+
+def _load_yaml(path: str) -> Dict[str, Any]:
+    try:
+        import yaml
+        with open(path) as f:
+            return yaml.safe_load(f) or {}
+    except ImportError as e:
+        raise RuntimeError(
+            f"conda environment file {path!r} needs pyyaml") from e
+
+
+def _find_conda_env_python(name: str) -> Optional[str]:
+    """Interpreter of an EXISTING conda env by name (no conda needed at
+    runtime if the env is already materialized on disk)."""
+    roots = []
+    exe = os.environ.get("CONDA_EXE")
+    if exe:
+        roots.append(os.path.join(os.path.dirname(os.path.dirname(exe)),
+                                  "envs"))
+    prefix = os.environ.get("CONDA_PREFIX")
+    if prefix:
+        base = os.path.dirname(prefix) if os.path.basename(
+            os.path.dirname(prefix)) == "envs" else prefix
+        roots.append(os.path.join(base, "envs"))
+    home = os.path.expanduser("~")
+    roots += [os.path.join(home, d, "envs")
+              for d in ("miniconda3", "anaconda3", "mambaforge",
+                        ".conda")]
+    for root in roots:
+        py = os.path.join(root, name, "bin", "python")
+        if os.path.exists(py):
+            return py
+    return None
+
+
+def ensure_conda_env(conda: Any, root: str) -> str:
+    """Interpreter for a conda runtime env (original spec form)."""
+    name, deps = parse_conda_spec(conda)
+    entry = ("env", name) if name else ("deps",) + tuple(deps)
+    return ensure_conda_env_entry(entry, root)
+
+
+def ensure_conda_env_entry(entry: Tuple, root: str) -> str:
+    """Interpreter for a normalized conda key entry (("env", name) or
+    ("deps", *pip_style_deps) — see task_spec._conda_entry). Named env
+    -> its python (must already exist). Deps -> `conda env create` when
+    conda is installed; otherwise the offline overlay venv over the
+    spec's python-level deps."""
+    import shutil
+    import subprocess
+    name = entry[1] if entry[0] == "env" else None
+    deps = list(entry[1:]) if entry[0] == "deps" else []
+    if name is not None:
+        py = _find_conda_env_python(name)
+        if py is not None:
+            return py
+        raise RuntimeError(
+            f"conda env {name!r} not found on this node (looked under "
+            "CONDA_EXE/CONDA_PREFIX/~/*conda*/envs)")
+    conda_bin = shutil.which("conda") or shutil.which("mamba")
+    if conda_bin:
+        digest = hashlib.sha256(
+            repr(sorted(deps)).encode()).hexdigest()[:16]
+        env_dir = os.path.join(root, f"conda-{digest}")
+        py = os.path.join(env_dir, "bin", "python")
+
+        def build():
+            spec_path = os.path.join(root, f"conda-{digest}.yml")
+            with open(spec_path, "w") as f:
+                f.write("dependencies:\n- python\n- pip\n- pip:\n")
+                for d in deps:
+                    f.write(f"  - {d}\n")
+            proc = subprocess.run(
+                [conda_bin, "env", "create", "-q", "-p", env_dir,
+                 "-f", spec_path],
+                capture_output=True, timeout=1800)
+            if proc.returncode != 0:
+                raise RuntimeError("conda env create failed:\n"
+                                   + proc.stderr.decode()[-2000:])
+
+        _locked_build(env_dir, build)
         return py
-    finally:
-        try:
-            os.unlink(lock_path)
-        except OSError:
-            pass
+    # zero-egress / conda-less node: same offline contract as `pip`
+    return ensure_python_env(deps, root)
+
+
+def normalize_uv(uv: Any) -> List[str]:
+    """`uv` runtime_env field -> package list (reference uv.py accepts
+    a list or {"packages": [...]})."""
+    if isinstance(uv, dict):
+        uv = uv.get("packages", [])
+    if not isinstance(uv, (list, tuple)):
+        raise ValueError(f"runtime_env uv must be list|dict, got {uv!r}")
+    return list(uv)
+
+
+def _unsatisfied_in_env(py: str, packages: List[str]) -> List[str]:
+    """Requirements from `packages` NOT already importable/installed in
+    the interpreter `py` (== pins checked exactly; other specifiers
+    satisfied-if-present, matching the pip overlay's offline contract)."""
+    import subprocess
+    probe = (
+        "import importlib.metadata as md, sys\n"
+        "for line in sys.stdin.read().splitlines():\n"
+        "    req = line.strip()\n"
+        "    name = req\n"
+        "    pin = None\n"
+        "    for sep in ('==', '>=', '<=', '~=', '>', '<'):\n"
+        "        if sep in req:\n"
+        "            name, _, rest = req.partition(sep)\n"
+        "            pin = rest if sep == '==' else None\n"
+        "            break\n"
+        "    name = name.strip().split('[')[0]\n"
+        "    try:\n"
+        "        ver = md.version(name)\n"
+        "    except md.PackageNotFoundError:\n"
+        "        print(req)\n"
+        "        continue\n"
+        "    if pin is not None and ver != pin.strip():\n"
+        "        print(req)\n")
+    proc = subprocess.run([py, "-c", probe], input="\n".join(packages),
+                          capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        return list(packages)
+    return [line for line in proc.stdout.splitlines() if line.strip()]
+
+
+def ensure_uv_env(packages: List[str], root: str) -> str:
+    """Interpreter for a uv runtime env: `uv venv` + offline
+    `uv pip install` when uv is installed, else the overlay venv."""
+    import shutil
+    import subprocess
+    uv_bin = shutil.which("uv")
+    if not uv_bin:
+        return ensure_python_env(list(packages), root)
+    digest = hashlib.sha256(
+        "\n".join(sorted(packages)).encode()).hexdigest()[:16]
+    env_dir = os.path.join(root, f"uv-{digest}")
+    py = os.path.join(env_dir, "bin", "python")
+
+    def build():
+        proc = subprocess.run(
+            [uv_bin, "venv", "--python", sys.executable,
+             "--system-site-packages", env_dir],
+            capture_output=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError("uv venv failed:\n"
+                               + proc.stderr.decode()[-2000:])
+        # "system site" resolves to the BASE interpreter's site — when
+        # the launcher is itself a venv (this image), its packages
+        # wouldn't be visible. Link them in, same as ensure_python_env.
+        import glob as _glob
+        import site as _site
+        parent_sites = [p for p in _site.getsitepackages()
+                        if os.path.isdir(p)]
+        for env_site in _glob.glob(os.path.join(
+                env_dir, "lib", "python*", "site-packages")):
+            with open(os.path.join(env_site, "_rtpu_parent.pth"),
+                      "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        missing = _unsatisfied_in_env(py, packages) if packages else []
+        if missing:
+            # Only genuinely-missing packages go through uv's resolver
+            # — its offline mode does not consult the system site
+            # overlay, so baked packages must be filtered out first.
+            proc = subprocess.run(
+                [uv_bin, "pip", "install", "--python", py, "--offline",
+                 *missing],
+                capture_output=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    "uv pip install (offline) failed — zero-egress "
+                    "images must bake packages:\n"
+                    + proc.stderr.decode()[-2000:])
+
+    _locked_build(env_dir, build)
+    return py
 
 
 # ---------------------------------------------------------------------------

@@ -207,15 +207,39 @@ class FunctionManager:
 
 # Positional layout shared by the submitter's lease shape key and the
 # raylet's worker-pool key: [0] env_vars, [1] working_dir,
-# [2] py_modules, [3] pip, [4] python_env requirements, [5] image_uri.
-# The raylet's worker spawn reads indices 4 and 5 — keep order
-# append-only.
+# [2] py_modules, [3] pip, [4] python_env requirements, [5] image_uri,
+# [6] conda, [7] uv. The raylet's worker spawn reads indices 4-7 — keep
+# order append-only.
 ENV_KEY_PYTHON_ENV = 4
 ENV_KEY_IMAGE_URI = 5
+ENV_KEY_CONDA = 6
+ENV_KEY_UV = 7
+
+
+# conda specs normalize through parse_conda_spec (yaml load for file
+# paths) — memoized: runtime_env_key runs per task submission.
+_conda_key_cache: dict = {}
+
+
+def _conda_entry(conda) -> "Tuple":
+    key = repr(conda)
+    entry = _conda_key_cache.get(key)
+    if entry is None:
+        from .runtime_env import parse_conda_spec
+        name, deps = parse_conda_spec(conda)
+        entry = ("env", name) if name else ("deps",) + tuple(deps)
+        if len(_conda_key_cache) > 256:
+            _conda_key_cache.clear()
+        _conda_key_cache[key] = entry
+    return entry
 
 
 def runtime_env_key(runtime_env) -> "Tuple":
     env = runtime_env or {}
+    uv = env.get("uv")
+    if uv is not None:
+        from .runtime_env import normalize_uv
+        uv = tuple(normalize_uv(uv))
     return (
         tuple(sorted((env.get("env_vars") or {}).items())),
         env.get("working_dir") or "",
@@ -224,4 +248,6 @@ def runtime_env_key(runtime_env) -> "Tuple":
         tuple(sorted((env.get("python_env") or {})
                      .get("requirements", ()))),
         env.get("image_uri") or "",
+        _conda_entry(env["conda"]) if env.get("conda") else "",
+        uv or "",
     )

@@ -200,8 +200,14 @@ class NativeIO:
 
     def connect(self, host: str, port: int, timeout_ms: int) -> Optional[int]:
         """Raw connect (blocking; call off the loop). The caller must then
-        register(conn, sink) ON the loop before using the conn."""
+        register(conn, sink) ON the loop before using the conn.
+        Returns the conn id, None on hard failure (refused/unreachable),
+        or raises TimeoutError on a connect timeout — the distinction
+        matters for liveness decisions (refused proves the process is
+        gone; a timeout proves nothing)."""
         conn = self._lib.frpc_connect(host.encode(), port, timeout_ms)
+        if conn == -2:
+            raise TimeoutError(f"connect to {host}:{port} timed out")
         return None if conn < 0 else conn
 
     def register(self, conn_id: int, sink: Callable[[int, memoryview], None]):

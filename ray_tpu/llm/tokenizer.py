@@ -274,9 +274,11 @@ class BPETokenizer:
     def _encode_byte_level(self, text: str, ids: List[int],
                            first_chunk: bool = True):
         if self.add_prefix_space and first_chunk and text and \
-                not text[0].isspace():
+                text[0] != " ":
             # ByteLevel(add_prefix_space=true) checkpoints (RoBERTa/BART
-            # conversions) tokenize " hello" for a leading "hello"
+            # conversions) tokenize " hello" for a leading "hello".
+            # HF checks for the exact space char — "\thello" still gets
+            # the prefix.
             text = " " + text
         for word in _BYTE_LEVEL_PAT.findall(text):
             mapped = "".join(_BYTE_ENC[b] for b in word.encode("utf-8"))

@@ -450,7 +450,15 @@ int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
   timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
   setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
   if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    int err = errno;
     close(fd);
+    // -2 = timed out (peer MAY be alive but congested); -1 = hard
+    // failure (refused/unreachable). Callers use the distinction for
+    // liveness decisions — a refused port proves the process is gone,
+    // a timeout proves nothing.
+    if (err == EINPROGRESS || err == EWOULDBLOCK || err == EAGAIN ||
+        err == ETIMEDOUT || err == EALREADY)
+      return -2;
     return -1;
   }
   set_nonblock(fd);

@@ -208,5 +208,6 @@ def test_byte_level_add_prefix_space_matches_hf(tmp_path):
     tok.save(str(path))
     native = BPETokenizer.from_file(str(path))
     assert native.add_prefix_space
-    for text in ["hello world", "The fox.", " already spaced"]:
-        assert native.encode(text) == tok.encode(text).ids, text
+    for text in ["hello world", "The fox.", " already spaced",
+                 "\thello", "\nfoo bar"]:
+        assert native.encode(text) == tok.encode(text).ids, repr(text)
