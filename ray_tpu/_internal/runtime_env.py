@@ -203,12 +203,18 @@ def python_env_key(requirements: List[str]) -> str:
     return f"pyenv-{digest}"
 
 
-def _locked_build(env_dir: str, build_fn) -> None:
+def _locked_build(env_dir: str, build_fn,
+                  build_timeout_s: float = 300.0) -> None:
     """Run `build_fn()` exactly once per env_dir across processes AND
     threads: marker short-circuits, a lockfile elects one builder
     (stale locks from SIGKILLed builders are reclaimed), losers wait
     for the marker. Partial builds from a crashed builder are cleared
-    before rebuilding (conda/uv error on existing prefixes)."""
+    before rebuilding (conda/uv error on existing prefixes).
+
+    `build_timeout_s` must cover the slowest legitimate build for this
+    env kind (conda env create can take many minutes): the waiter
+    deadline and the stale-lock threshold both derive from it, so a
+    long-but-healthy build is never treated as a crashed builder."""
     import shutil
     import time as _time
 
@@ -219,17 +225,23 @@ def _locked_build(env_dir: str, build_fn) -> None:
     lock_path = env_dir + ".lock"
     try:
         try:
-            # a lock older than any plausible build is from a builder
-            # that died mid-build (SIGKILL) — reclaim it
-            if _time.time() - os.path.getmtime(lock_path) > 360:
-                os.unlink(lock_path)
+            # A lock older than any plausible build is from a builder
+            # that died mid-build (SIGKILL). Reclaim by ATOMIC rename:
+            # exactly one contender wins the rename (the loser's rename
+            # raises ENOENT), so no contender can ever unlink the fresh
+            # lock another reclaimer just created.
+            if (_time.time() - os.path.getmtime(lock_path)
+                    > build_timeout_s + 60):
+                tomb = f"{lock_path}.reclaimed-{os.getpid()}"
+                os.rename(lock_path, tomb)
+                os.unlink(tomb)
         except OSError:
             pass
         fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         os.close(fd)
     except FileExistsError:
         # another process is building it: wait for the marker
-        deadline = _time.monotonic() + 300
+        deadline = _time.monotonic() + build_timeout_s + 90
         while not os.path.exists(marker):
             if _time.monotonic() > deadline:
                 raise TimeoutError(
@@ -295,7 +307,7 @@ def ensure_python_env(requirements: List[str], root: str) -> str:
                     "python_env requirements not satisfiable offline:\n"
                     + proc.stderr.decode()[-2000:])
 
-    _locked_build(env_dir, build)
+    _locked_build(env_dir, build, build_timeout_s=600.0)
     return py
 
 
@@ -376,13 +388,6 @@ def _find_conda_env_python(name: str) -> Optional[str]:
     return None
 
 
-def ensure_conda_env(conda: Any, root: str) -> str:
-    """Interpreter for a conda runtime env (original spec form)."""
-    name, deps = parse_conda_spec(conda)
-    entry = ("env", name) if name else ("deps",) + tuple(deps)
-    return ensure_conda_env_entry(entry, root)
-
-
 def ensure_conda_env_entry(entry: Tuple, root: str) -> str:
     """Interpreter for a normalized conda key entry (("env", name) or
     ("deps", *pip_style_deps) — see task_spec._conda_entry). Named env
@@ -421,7 +426,7 @@ def ensure_conda_env_entry(entry: Tuple, root: str) -> str:
                 raise RuntimeError("conda env create failed:\n"
                                    + proc.stderr.decode()[-2000:])
 
-        _locked_build(env_dir, build)
+        _locked_build(env_dir, build, build_timeout_s=1800.0)
         return py
     # zero-egress / conda-less node: same offline contract as `pip`
     return ensure_python_env(deps, root)
@@ -516,7 +521,7 @@ def ensure_uv_env(packages: List[str], root: str) -> str:
                     "images must bake packages:\n"
                     + proc.stderr.decode()[-2000:])
 
-    _locked_build(env_dir, build)
+    _locked_build(env_dir, build, build_timeout_s=600.0)
     return py
 
 

@@ -25,7 +25,14 @@ class DataContext:
     # execution/resource_manager.py object-store budgets +
     # backpressure_policy/). None = unlimited.
     execution_object_store_byte_budget: Optional[int] = None
+    # "push": all-to-all exchanges consume map outputs in rounds of
+    # push_shuffle_merge_factor, folding each round into one partial per
+    # output partition as soon as it lands (merges pipeline with the next
+    # round's maps; reduce fan-in is ceil(M/factor) instead of M).
+    # "pull": one-shot plan — every reduce takes all M map parts directly
+    # (reference: push_based_shuffle_task_scheduler.py:460).
     shuffle_strategy: str = "push"
+    push_shuffle_merge_factor: int = 8
     # Streaming executor buffers (in blocks): per-operator edge buffer and
     # the consumer-facing output queue — both bound memory and carry the
     # backpressure signal upstream.

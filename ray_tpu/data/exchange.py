@@ -16,6 +16,16 @@ from typing import Callable, List, Optional, Tuple, Union
 from .block import BlockAccessor
 
 
+def _concat_remote():
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge_parts(*blocks):
+        return BlockAccessor.concat(list(blocks))
+
+    return merge_parts
+
+
 def _split_remote(n_out: int):
     import ray_tpu
 
@@ -38,10 +48,7 @@ def repartition_exchange(refs: List, n_out: int) -> List:
         return [ray_tpu.put(BlockAccessor.empty()) for _ in range(n_out)]
 
     split_block = _split_remote(n_out)
-
-    @ray_tpu.remote(num_cpus=1, max_retries=2)
-    def merge(*blocks):
-        return BlockAccessor.concat(list(blocks))
+    merge = _concat_remote()
 
     parts = [split_block.remote(r) for r in refs]
     if n_out == 1:
@@ -75,13 +82,56 @@ def shuffle_exchange(refs: List, seed: Optional[int]) -> List:
         rng.shuffle(rows)
         return BlockAccessor.from_rows(rows)
 
+    merge_parts = _concat_remote()
     base = seed if seed is not None else _random.randrange(1 << 30)
     parts = [scatter.remote(r, base + i) for i, r in enumerate(refs)]
     if n_out == 1:
         return [gather.remote(base + 7, *parts)]
+    factor = _merge_factor()
+    if factor and len(refs) > factor:
+        merged = push_merge_rounds(parts, n_out, merge_parts, factor)
+        return [gather.remote(base + 7 + j, *merged[j])
+                for j in range(n_out)]
     return [gather.remote(base + 7 + j,
                           *[parts[i][j] for i in range(len(refs))])
             for j in range(n_out)]
+
+
+def _merge_factor() -> int:
+    from .context import DataContext
+    ctx = DataContext.get_current()
+    if ctx.shuffle_strategy != "push":
+        return 0
+    return max(2, ctx.push_shuffle_merge_factor)
+
+
+def push_merge_rounds(parts: List, n_out: int, merge_remote,
+                      merge_factor: int) -> List[List]:
+    """The push-based shuffle scheduler (reference:
+    data/_internal/planner/exchange/push_based_shuffle_task_scheduler.py:460).
+
+    `parts[i][j]` is input i's slice for output partition j. Rather than
+    handing every reduce task all M inputs at once (M x N refs in flight,
+    reduce fan-in M), inputs are consumed in rounds of `merge_factor`:
+    as soon as a round's map tasks finish, one merge task per partition
+    folds that round's slices into a single partial — merges of round k
+    overlap the maps of round k+1, and the final reduce sees only
+    ceil(M / merge_factor) partials. Merges CONCATENATE IN INPUT ORDER,
+    so downstream reduces observe the same row sequence as the one-shot
+    plan — push vs pull is a scheduling choice, not a semantics change.
+
+    Returns per-partition lists of partial refs (each list has
+    ceil(M / merge_factor) entries)."""
+    merged: List[List] = [[] for _ in range(n_out)]
+    for start in range(0, len(parts), merge_factor):
+        chunk = parts[start:start + merge_factor]
+        for j in range(n_out):
+            inputs = [p[j] for p in chunk]
+            if len(inputs) == 1:
+                merged[j].append(inputs[0])
+            else:
+                merged[j].append(merge_remote.remote(*inputs))
+    return merged
 
 
 def sort_exchange(refs: List, key: Union[str, Callable],
@@ -134,8 +184,17 @@ def sort_exchange(refs: List, key: Union[str, Callable],
         return BlockAccessor(merged).sort_by(key, descending)
 
     parts = [partition.remote(r) for r in refs]
-    out = [merge_sorted.remote(*[parts[i][j] for i in range(len(refs))])
-           for j in range(n_out)]
+    factor = _merge_factor()
+    if factor and len(refs) > factor:
+        # Partial merge-sorts are themselves sorted runs; the final
+        # merge_sorted over them equals the one-shot sort (stable sort +
+        # in-order concat => identical row order).
+        merged = push_merge_rounds(parts, n_out, merge_sorted, factor)
+        out = [merge_sorted.remote(*merged[j]) for j in range(n_out)]
+    else:
+        out = [merge_sorted.remote(*[parts[i][j]
+                                     for i in range(len(refs))])
+               for j in range(n_out)]
     return list(reversed(out)) if descending else out
 
 
@@ -251,10 +310,17 @@ def hash_join_exchange(left_refs: List, right_refs: List, on: str,
         out.sort(key=lambda r: _sort_token(r[on]))
         return BlockAccessor.from_rows(out)
 
+    merge_parts = _concat_remote()
     lparts = [hash_partition.remote(r) for r in left_refs]
     rparts = [hash_partition.remote(r) for r in right_refs]
     if n_out == 1:
         return [join_partition.remote(len(lparts), *lparts, *rparts)]
+    factor = _merge_factor()
+    if factor and max(len(lparts), len(rparts)) > factor:
+        lm = push_merge_rounds(lparts, n_out, merge_parts, factor)
+        rm = push_merge_rounds(rparts, n_out, merge_parts, factor)
+        return [join_partition.remote(len(lm[j]), *lm[j], *rm[j])
+                for j in range(n_out)]
     return [join_partition.remote(
         len(lparts),
         *[lparts[i][j] for i in range(len(left_refs))],
@@ -325,9 +391,33 @@ def hash_aggregate_exchange(refs: List, key: str,
             out.append(result)
         return BlockAccessor.from_rows(out)
 
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge_partials(*blocks):
+        # Intermediate merge: fold partial states per key WITHOUT
+        # finalizing — every _AGG_KINDS merge_fn is associative, so
+        # merge-of-merges equals the one-shot merge.
+        merged: dict = {}
+        for block in blocks:
+            for row in BlockAccessor(block).iter_rows():
+                merged.setdefault(row[key], []).append(row["__partials__"])
+        out = []
+        for k in sorted(merged, key=_sort_token):
+            plist = merged[k]
+            combined = {}
+            for kind, _col, out_name in specs:
+                _, merge_fn, _fin = _AGG_KINDS[kind]
+                combined[out_name] = merge_fn(
+                    [p[out_name] for p in plist])
+            out.append({key: k, "__partials__": combined})
+        return BlockAccessor.from_rows(out)
+
     parts = [partial_agg.remote(r) for r in refs]
     if n_out == 1:
         return [merge_finalize.remote(*parts)]
+    factor = _merge_factor()
+    if factor and len(refs) > factor:
+        merged = push_merge_rounds(parts, n_out, merge_partials, factor)
+        return [merge_finalize.remote(*merged[j]) for j in range(n_out)]
     return [merge_finalize.remote(*[parts[i][j]
                                     for i in range(len(refs))])
             for j in range(n_out)]
