@@ -92,7 +92,7 @@ def main():
         logits = model.apply({"params": params}, batch_data["tokens"])
         return cross_entropy_loss(logits[:, :-1], batch_data["tokens"][:, 1:])
 
-    train_step = make_train_step(loss_fn, mesh)
+    train_step = make_train_step(loss_fn, mesh, state=state)
     rng = jax.random.PRNGKey(1)
     data = {"tokens": jax.random.randint(rng, (batch, seq), 0,
                                          config.vocab_size)}
@@ -173,7 +173,7 @@ def dryrun_7b(n_devices: int = 8, run_step: bool = True):
         return cross_entropy_loss(logits[:, :-1],
                                   batch_data["tokens"][:, 1:])
 
-    train_step = make_train_step(loss_fn, mesh)
+    train_step = make_train_step(loss_fn, mesh, state=state)
     data = {"tokens": jax.random.randint(
         jax.random.PRNGKey(1), (batch, seq), 0, config.vocab_size)}
     with mesh:

@@ -213,8 +213,14 @@ def _locked_build(env_dir: str, build_fn,
 
     `build_timeout_s` must cover the slowest legitimate build for this
     env kind (conda env create can take many minutes): the waiter
-    deadline and the stale-lock threshold both derive from it, so a
-    long-but-healthy build is never treated as a crashed builder."""
+    deadline derives from it.
+
+    Builder election is an flock(LOCK_EX|LOCK_NB) on a shared lock
+    file: the kernel releases the lock when the holder dies (any way,
+    including SIGKILL), so there is NO staleness heuristic and no
+    reclaim race — a waiter that later wins the flock and still sees no
+    marker simply becomes the next builder of the crashed build."""
+    import fcntl
     import shutil
     import time as _time
 
@@ -223,44 +229,37 @@ def _locked_build(env_dir: str, build_fn,
         return
     os.makedirs(os.path.dirname(env_dir), exist_ok=True)
     lock_path = env_dir + ".lock"
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
     try:
-        try:
-            # A lock older than any plausible build is from a builder
-            # that died mid-build (SIGKILL). Reclaim by ATOMIC rename:
-            # exactly one contender wins the rename (the loser's rename
-            # raises ENOENT), so no contender can ever unlink the fresh
-            # lock another reclaimer just created.
-            if (_time.time() - os.path.getmtime(lock_path)
-                    > build_timeout_s + 60):
-                tomb = f"{lock_path}.reclaimed-{os.getpid()}"
-                os.rename(lock_path, tomb)
-                os.unlink(tomb)
-        except OSError:
-            pass
-        fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-        os.close(fd)
-    except FileExistsError:
-        # another process is building it: wait for the marker
-        deadline = _time.monotonic() + build_timeout_s + 90
-        while not os.path.exists(marker):
-            if _time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"python env {env_dir} build did not finish")
-            _time.sleep(0.25)
-        return
-    try:
-        if os.path.exists(marker):
+        # Sized for TWO sequential builds: if the first builder dies
+        # mid-build, a waiter takes over and rebuilds from scratch —
+        # the deadline only ever fires while some OTHER process holds
+        # the flock (a waiter that wins the lock builds regardless).
+        deadline = _time.monotonic() + 2 * build_timeout_s + 120
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                # a live builder holds the lock: wait for its marker
+                if os.path.exists(marker):
+                    return
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"python env {env_dir} build did not finish")
+                _time.sleep(0.25)
+                continue
+            # we hold the lock — either first builder, or the previous
+            # builder died (kernel released) / finished (marker set)
+            if os.path.exists(marker):
+                return
+            if os.path.isdir(env_dir):  # crashed builder's partial env
+                shutil.rmtree(env_dir, ignore_errors=True)
+            build_fn()
+            with open(marker, "w") as f:
+                f.write("ok")
             return
-        if os.path.isdir(env_dir):  # crashed builder's partial env
-            shutil.rmtree(env_dir, ignore_errors=True)
-        build_fn()
-        with open(marker, "w") as f:
-            f.write("ok")
     finally:
-        try:
-            os.unlink(lock_path)
-        except OSError:
-            pass
+        os.close(fd)  # releases the flock if held
 
 
 def ensure_python_env(requirements: List[str], root: str) -> str:

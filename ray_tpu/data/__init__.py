@@ -3,13 +3,15 @@ from .context import DataContext
 from .dataset import Dataset
 from .iterator import DataIterator
 from .read_api import (from_arrow, from_items, from_numpy, from_pandas,
-                       range, read_binary_files, read_csv, read_images,
-                       read_json, read_parquet, read_sql, read_text,
-                       read_tfrecords)
+                       from_torch, range, read_binary_files, read_csv,
+                       read_images, read_json, read_numpy, read_parquet,
+                       read_sql, read_text, read_tfrecords,
+                       read_webdataset)
 
 __all__ = [
     "Dataset", "DataIterator", "DataContext", "Block", "BlockAccessor",
     "range", "from_items", "from_pandas", "from_numpy", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files", "read_images", "read_sql", "read_tfrecords",
+    "read_numpy", "read_webdataset", "from_torch",
 ]

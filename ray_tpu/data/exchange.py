@@ -374,28 +374,10 @@ def hash_aggregate_exchange(refs: List, key: str,
         parts = tuple(BlockAccessor.from_rows(b) for b in partial_rows)
         return parts if n_out > 1 else parts[0]
 
-    @ray_tpu.remote(num_cpus=1, max_retries=2)
-    def merge_finalize(*blocks):
-        merged: dict = {}
-        for block in blocks:
-            for row in BlockAccessor(block).iter_rows():
-                merged.setdefault(row[key], []).append(row["__partials__"])
-        out = []
-        for k in sorted(merged, key=_sort_token):
-            partial_list = merged[k]
-            result = {key: k}
-            for kind, _col, out_name in specs:
-                _, merge_fn, finalize = _AGG_KINDS[kind]
-                result[out_name] = finalize(
-                    merge_fn([p[out_name] for p in partial_list]))
-            out.append(result)
-        return BlockAccessor.from_rows(out)
-
-    @ray_tpu.remote(num_cpus=1, max_retries=2)
-    def merge_partials(*blocks):
-        # Intermediate merge: fold partial states per key WITHOUT
-        # finalizing — every _AGG_KINDS merge_fn is associative, so
-        # merge-of-merges equals the one-shot merge.
+    def _fold_partials(blocks, do_finalize: bool):
+        """Group (key, __partials__) rows and fold each key's partial
+        states with the kind's associative merge_fn; finalize only at
+        the LAST level (intermediate push-merge rounds keep folding)."""
         merged: dict = {}
         for block in blocks:
             for row in BlockAccessor(block).iter_rows():
@@ -403,13 +385,27 @@ def hash_aggregate_exchange(refs: List, key: str,
         out = []
         for k in sorted(merged, key=_sort_token):
             plist = merged[k]
-            combined = {}
+            folded = {}
             for kind, _col, out_name in specs:
-                _, merge_fn, _fin = _AGG_KINDS[kind]
-                combined[out_name] = merge_fn(
-                    [p[out_name] for p in plist])
-            out.append({key: k, "__partials__": combined})
+                _, merge_fn, finalize = _AGG_KINDS[kind]
+                state = merge_fn([p[out_name] for p in plist])
+                folded[out_name] = finalize(state) if do_finalize \
+                    else state
+            if do_finalize:
+                out.append({key: k, **folded})
+            else:
+                out.append({key: k, "__partials__": folded})
         return BlockAccessor.from_rows(out)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge_finalize(*blocks):
+        return _fold_partials(blocks, do_finalize=True)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def merge_partials(*blocks):
+        # Intermediate push-merge round: fold WITHOUT finalizing —
+        # merge_fn associativity makes merge-of-merges == one-shot merge.
+        return _fold_partials(blocks, do_finalize=False)
 
     parts = [partial_agg.remote(r) for r in refs]
     if n_out == 1:

@@ -450,3 +450,66 @@ def read_sql(sql: str, connection_factory, *,
         return [_read.remote(i, parallelism)
                 for i in _builtin_range(parallelism)]
     return Dataset(source, [], name="read_sql")
+
+
+def read_numpy(paths) -> Dataset:
+    """.npy files -> {"data": row} rows, the file's leading axis as the
+    row axis (reference: datasource/numpy_datasource.py)."""
+    files = _expand_paths(paths, ".npy")
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            arr = np.load(path, allow_pickle=False)
+            return [{"data": arr[i]} for i in _builtin_range(len(arr))]
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_numpy")
+
+
+def read_webdataset(paths) -> Dataset:
+    """WebDataset tar shards -> one row per sample (reference:
+    datasource/webdataset_datasource.py): members sharing a basename
+    stem form a sample; each extension becomes a bytes field plus the
+    "__key__" stem. Pure tarfile — no webdataset import."""
+    files = _expand_paths(paths, ".tar")
+
+    def source():
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=1)
+        def _read(path):
+            import tarfile
+            rows: List[Dict[str, Any]] = []
+            current: Dict[str, Any] = {}
+            with tarfile.open(path) as tar:
+                for member in tar:
+                    if not member.isfile():
+                        continue
+                    # key = FULL path up to the first dot of the
+                    # basename (webdataset semantics): same-named files
+                    # in different directories are different samples
+                    head, _, base = member.name.rpartition("/")
+                    stem, _, ext = base.partition(".")
+                    key = f"{head}/{stem}" if head else stem
+                    if current.get("__key__") not in (None, key):
+                        rows.append(current)
+                        current = {}
+                    current["__key__"] = key
+                    current[ext] = tar.extractfile(member).read()
+            if current:
+                rows.append(current)
+            return rows
+        return [_read.remote(f) for f in files]
+    return Dataset(source, [], name="read_webdataset")
+
+
+def from_torch(torch_dataset, *, parallelism: int = 1) -> Dataset:
+    """A torch map-style Dataset -> {"item": sample} rows (reference:
+    read_api.from_torch). Materializes on the DRIVER (torch datasets
+    are rarely picklable-to-workers; the reference does the same for
+    map-style datasets)."""
+    items = [{"item": torch_dataset[i]}
+             for i in _builtin_range(len(torch_dataset))]
+    return from_items(items, parallelism=parallelism)

@@ -13,6 +13,8 @@ iteration. The reference's torch DDP learner-group maps here to mesh
 data-parallelism inside the jitted update."""
 
 from .algorithm import PPO, PPOConfig
+from .appo import Appo, AppoConfig, AppoLearner
+from .cql import CQL, CQLConfig
 from .dqn import DQN, DQNConfig, DQNLearner, ReplayBufferActor
 from .env_runner import SingleAgentEnvRunner
 from .impala import Impala, ImpalaConfig, ImpalaLearner
@@ -25,6 +27,7 @@ from .sac import SAC, SACConfig, SACLearner
 
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
            "Impala", "ImpalaConfig", "ImpalaLearner",
+           "Appo", "AppoConfig", "AppoLearner", "CQL", "CQLConfig",
            "DQN", "DQNConfig", "DQNLearner", "ReplayBufferActor",
            "SAC", "SACConfig", "SACLearner",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",

@@ -1,0 +1,196 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner pipeline
+(reference: rllib/algorithms/appo/appo.py — APPOConfig :59 with
+clip_param / use_kl_loss / kl_coeff / target_network_update_freq,
+training_step :268 reusing IMPALA's async sampling; loss in
+appo_learner — PPO clipped surrogate over v-trace advantages computed
+against a slow-moving TARGET policy).
+
+Why a target network at all: the async pipeline trains on fragments that
+are several weight-broadcasts stale. Pure IMPALA corrects the
+distribution gap with per-step importance clipping; APPO instead anchors
+the v-trace targets and the trust region to a policy that only moves
+every `target_network_update_freq` learner steps, then takes PPO-style
+clipped steps against it — bounded-size updates no matter how stale the
+behavior data.
+
+TPU notes: the whole update (current + target forward, v-trace reverse
+scan, surrogate, Adam) is ONE jitted program in [T, B] layout; the
+target refresh is a host-side params copy every N steps, not a traced
+branch."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .impala import Impala, ImpalaConfig, make_vtrace
+
+
+class AppoConfig(ImpalaConfig):
+    """Builder config (reference: appo.py APPOConfig :59)."""
+
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.2
+        self.use_kl_loss = True
+        self.kl_coeff = 0.2
+        self.target_network_update_freq = 4   # learner steps
+        self.lr = 3e-4
+        self.num_epochs = 2                   # PPO reuses each batch
+
+    def build(self) -> "Appo":
+        return Appo(self)
+
+
+class AppoLearner:
+    """Jitted APPO update in [T, B] layout.
+
+    v-trace advantages/targets come from the TARGET policy (its logp as
+    the numerator of the correction ratio, its values for bootstrap);
+    the policy step is the PPO clipped surrogate of the CURRENT policy
+    against the recorded behavior logp, optionally with a KL(target ||
+    current) penalty (reference: appo_learner loss)."""
+
+    def __init__(self, obs_shape: Tuple[int, ...], num_actions: int,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 lr: float = 3e-4, gamma: float = 0.99,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 rho_bar: float = 1.0, c_bar: float = 1.0,
+                 grad_clip: float = 40.0, seed: int = 0,
+                 normalize_advantages: bool = True,
+                 vtrace_lambda: float = 0.95,
+                 clip_param: float = 0.2,
+                 use_kl_loss: bool = True, kl_coeff: float = 0.2,
+                 target_network_update_freq: int = 4,
+                 lr_final: Optional[float] = None,
+                 lr_decay_steps: int = 0,
+                 lr_decay_begin: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .models import ActorCriticMLP
+
+        model_config = model_config or {}
+        self.model = ActorCriticMLP(
+            num_actions=num_actions,
+            hidden=tuple(model_config.get("hidden", (64, 64))))
+        sample_obs = jnp.zeros((1,) + tuple(obs_shape), jnp.float32)
+        self.params = self.model.init(
+            jax.random.PRNGKey(seed), sample_obs)["params"]
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        if lr_final is not None and lr_decay_steps > 0:
+            lr = optax.linear_schedule(
+                init_value=lr, end_value=lr_final,
+                transition_steps=lr_decay_steps,
+                transition_begin=lr_decay_begin)
+        self.tx = optax.chain(optax.clip_by_global_norm(grad_clip),
+                              optax.adam(lr))
+        self.opt_state = self.tx.init(self.params)
+        self._step = 0
+        self._target_freq = max(1, target_network_update_freq)
+        self._entropy_coeff = entropy_coeff
+
+        vtrace = make_vtrace(gamma, rho_bar, c_bar, vtrace_lambda)
+
+        def _update(params, target_params, opt_state, batch, ent_coeff):
+            T, B = batch["actions"].shape
+            flat_obs = batch["obs"].reshape((T * B,) +
+                                            batch["obs"].shape[2:])
+            # Target-policy pass: anchors v-trace and the trust region.
+            t_logits, t_values = self.model.apply(
+                {"params": target_params}, flat_obs)
+            t_logits = t_logits.reshape(T, B, -1)
+            t_values = t_values.reshape(T, B)
+            _lb, t_boot = self.model.apply(
+                {"params": target_params}, batch["last_obs"])
+            t_logp_all = jax.nn.log_softmax(t_logits)
+            t_logp = jnp.take_along_axis(
+                t_logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(t_logp, batch["logp"], t_values, t_boot,
+                                batch["rewards"], batch["dones"])
+            if normalize_advantages:
+                pg_adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+            def loss_fn(p):
+                logits, values = self.model.apply({"params": p}, flat_obs)
+                logits = logits.reshape(T, B, -1)
+                values = values.reshape(T, B)
+                logp_all = jax.nn.log_softmax(logits)
+                curr_logp = jnp.take_along_axis(
+                    logp_all, batch["actions"][..., None],
+                    axis=-1)[..., 0]
+                ratio = jnp.exp(curr_logp - batch["logp"])
+                clipped = jnp.clip(ratio, 1.0 - clip_param,
+                                   1.0 + clip_param)
+                surrogate = -jnp.mean(
+                    jnp.minimum(ratio * pg_adv, clipped * pg_adv))
+                vf_loss = 0.5 * jnp.mean((values - vs) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+                kl = jnp.mean(jnp.sum(
+                    jnp.exp(t_logp_all) * (t_logp_all - logp_all),
+                    axis=-1))
+                total = surrogate + vf_coeff * vf_loss \
+                    - ent_coeff * entropy
+                if use_kl_loss:
+                    total = total + kl_coeff * kl
+                return total, (surrogate, vf_loss, entropy, kl)
+
+            (total, (pl, vl, ent, kl)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, {
+                "total_loss": total, "policy_loss": pl, "vf_loss": vl,
+                "entropy": ent, "kl": kl}
+
+        self._update_fn = jax.jit(_update)
+
+    def update(self, batch: Dict[str, np.ndarray], num_epochs: int = 1,
+               entropy_coeff: Optional[float] = None) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "episode_returns"}
+        coeff = jnp.float32(self._entropy_coeff if entropy_coeff is None
+                            else entropy_coeff)
+        metrics = {}
+        for _ in range(num_epochs):
+            self.params, self.opt_state, metrics = self._update_fn(
+                self.params, self.target_params, self.opt_state, jb,
+                coeff)
+            self._step += 1
+            if self._step % self._target_freq == 0:
+                self.target_params = jax.tree.map(lambda x: x,
+                                                  self.params)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params)
+
+
+class Appo(Impala):
+    """IMPALA's async sampling pipeline + the APPO learner (reference:
+    appo.py training_step :268 — 'inherits from IMPALA')."""
+
+    def _make_learner(self, obs_shape, num_actions):
+        config = self.config
+        return AppoLearner(
+            obs_shape=obs_shape, num_actions=num_actions,
+            model_config=dict(config.model), lr=config.lr,
+            gamma=config.gamma, vf_coeff=config.vf_coeff,
+            entropy_coeff=config.entropy_coeff, rho_bar=config.rho_bar,
+            c_bar=config.c_bar, grad_clip=config.grad_clip,
+            seed=config.seed,
+            normalize_advantages=config.normalize_advantages,
+            vtrace_lambda=config.vtrace_lambda,
+            clip_param=config.clip_param,
+            use_kl_loss=config.use_kl_loss, kl_coeff=config.kl_coeff,
+            target_network_update_freq=config.target_network_update_freq,
+            lr_final=config.lr_final,
+            lr_decay_steps=config.lr_decay_iters * config.num_epochs,
+            lr_decay_begin=config.lr_decay_begin_iters *
+            config.num_epochs)

@@ -1,0 +1,200 @@
+"""CQL: conservative Q-learning from offline data
+(reference: rllib/algorithms/cql/cql.py — CQLConfig :51 with
+bc_iters/temperature/min_q_weight, built on SAC's Q machinery;
+cql_learner adds the conservative penalty to the critic loss. The CQL
+paper's discrete form is exact: logsumexp over the action set needs no
+sampled-action approximation).
+
+The critic update is the repo's double-Q TD step (rllib/dqn.py) plus the
+conservative term  E_s[ log Σ_a exp(Q(s,a)/τ)·τ − Q(s, a_data) ]: it
+pushes down Q on out-of-distribution actions while holding it up on
+dataset actions, which is what keeps a greedy policy from exploiting
+extrapolation error the dataset can't refute. Whole update is one jitted
+program; data comes from a ray_tpu.data Dataset of recorded transitions
+(the Data↔RLlib offline bridge, offline.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class CQLConfig:
+    """Builder config (reference: cql.py CQLConfig :51)."""
+
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.batch_size = 256
+        self.num_steps = 3000
+        self.target_update_freq = 100        # gradient steps
+        self.min_q_weight = 1.0              # alpha on the CQL penalty
+        self.temperature = 1.0               # tau in the logsumexp
+        self.model = {"hidden": (128, 128)}
+        self.seed = 0
+
+    def environment(self, env: str) -> "CQLConfig":
+        self.env_name = env
+        return self
+
+    def training(self, **kwargs) -> "CQLConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "CQL":
+        return CQL(self)
+
+
+def _transitions_from_dataset(dataset) -> Dict[str, np.ndarray]:
+    """Reconstruct (obs, action, reward, next_obs, done) from the
+    row-per-step episodes that offline.record_episodes writes: within an
+    episode rows are in step order, so next_obs is the next row's obs;
+    terminal steps get a zero next_obs masked by done."""
+    rows = dataset.take_all()
+    by_ep: Dict[int, list] = {}
+    for r in rows:
+        by_ep.setdefault(int(r["episode"]), []).append(r)
+    obs, actions, rewards, next_obs, dones = [], [], [], [], []
+    for ep_rows in by_ep.values():
+        for i, r in enumerate(ep_rows):
+            done = bool(r["done"])
+            last = i + 1 == len(ep_rows)
+            if last and not done:
+                # truncated recording (step budget, not a terminal):
+                # there is no real next_obs to bootstrap from, and
+                # done=0 would bootstrap from a fabricated state —
+                # drop the transition (the standard truncation fix)
+                continue
+            o = np.asarray(r["obs"], np.float32)
+            obs.append(o)
+            actions.append(int(r["action"]))
+            rewards.append(float(r["reward"]))
+            dones.append(done)
+            next_obs.append(np.zeros_like(o) if done
+                            else np.asarray(ep_rows[i + 1]["obs"],
+                                            np.float32))
+    return {
+        "obs": np.stack(obs),
+        "actions": np.asarray(actions, np.int32),
+        "rewards": np.asarray(rewards, np.float32),
+        "next_obs": np.stack(next_obs),
+        "dones": np.asarray(dones, np.float32),
+    }
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        self._params = None
+        self._model = None
+
+    def fit(self, dataset) -> Dict[str, Any]:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from .models import QMLP
+
+        c = self.config
+        probe = gym.make(c.env_name)
+        num_actions = int(probe.action_space.n)
+        probe.close()
+
+        data = _transitions_from_dataset(dataset)
+        n = data["obs"].shape[0]
+        jd = {k: jnp.asarray(v) for k, v in data.items()}
+
+        model = QMLP(num_actions=num_actions,
+                     hidden=tuple(c.model.get("hidden", (128, 128))))
+        rng = jax.random.PRNGKey(c.seed)
+        params = model.init(rng, jd["obs"][:1])["params"]
+        target_params = jax.tree.map(lambda x: x, params)
+        tx = optax.adam(c.lr)
+        opt_state = tx.init(params)
+        tau = c.temperature
+
+        @jax.jit
+        def step(params, target_params, opt_state, idx):
+            b_obs = jd["obs"][idx]
+            b_act = jd["actions"][idx]
+            b_rew = jd["rewards"][idx]
+            b_next = jd["next_obs"][idx]
+            b_done = jd["dones"][idx]
+
+            # double-Q target: argmax under online net, value under target
+            next_online = model.apply({"params": params}, b_next)
+            next_a = jnp.argmax(next_online, axis=-1)
+            next_target = model.apply({"params": target_params}, b_next)
+            next_q = jnp.take_along_axis(
+                next_target, next_a[:, None], axis=-1)[:, 0]
+            td_target = b_rew + c.gamma * (1.0 - b_done) * next_q
+
+            def loss_fn(p):
+                q_all = model.apply({"params": p}, b_obs)
+                q_data = jnp.take_along_axis(
+                    q_all, b_act[:, None], axis=-1)[:, 0]
+                td_loss = jnp.mean(
+                    (q_data - jax.lax.stop_gradient(td_target)) ** 2)
+                # discrete CQL: exact logsumexp over actions
+                lse = tau * jax.scipy.special.logsumexp(
+                    q_all / tau, axis=-1)
+                cql_penalty = jnp.mean(lse - q_data)
+                return td_loss + c.min_q_weight * cql_penalty, \
+                    (td_loss, cql_penalty)
+
+            (total, (td, pen)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, total, td, pen
+
+        key = jax.random.PRNGKey(c.seed + 1)
+        total = td = pen = jnp.float32(0)
+        first_pen = None
+        for i in range(c.num_steps):
+            key, sub = jax.random.split(key)
+            idx = jax.random.randint(sub, (c.batch_size,), 0, n)
+            params, opt_state, total, td, pen = step(
+                params, target_params, opt_state, idx)
+            if first_pen is None:
+                first_pen = float(pen)
+            if (i + 1) % c.target_update_freq == 0:
+                target_params = jax.tree.map(lambda x: x, params)
+
+        self._params = params
+        self._model = model
+        return {"final_loss": float(total), "td_loss": float(td),
+                "cql_penalty": float(pen),
+                "cql_penalty_initial": first_pen,
+                "num_transitions": int(n)}
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        assert self._params is not None, "fit() first"
+        env = gym.make(self.config.env_name)
+        model, params = self._model, self._params
+
+        @jax.jit
+        def act(obs):
+            q = model.apply({"params": params}, obs[None])
+            return jnp.argmax(q, axis=-1)[0]
+
+        total = 0.0
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=30_000 + ep)
+            done = False
+            while not done:
+                action = int(act(jnp.asarray(obs, jnp.float32)))
+                obs, reward, terminated, truncated, _ = env.step(action)
+                total += reward
+                done = terminated or truncated
+        env.close()
+        return total / num_episodes

@@ -56,6 +56,48 @@ class AggregatorActor:
 
 
 # ---------------------------------------------------------------------------
+# v-trace (IMPALA paper eq. 1; shared by the IMPALA and APPO learners)
+# ---------------------------------------------------------------------------
+
+def make_vtrace(gamma: float, rho_bar: float, c_bar: float,
+                lam: float):
+    """Returns vtrace(correction_logp, behavior_logp, values, bootstrap,
+    rewards, dones) -> (vs, pg_adv). All inputs [T, B]; bootstrap [B].
+    `correction_logp` is the numerator policy of the importance ratio
+    (IMPALA: the current policy; APPO: the target policy). `lam`
+    discounts the trace cut (paper appendix C / rllib vtrace lambda_)."""
+    import jax
+    import jax.numpy as jnp
+
+    def vtrace(correction_logp, behavior_logp, values, bootstrap,
+               rewards, dones):
+        rhos = jnp.exp(correction_logp - behavior_logp)
+        clipped_rho = jnp.minimum(rho_bar, rhos)
+        clipped_c = lam * jnp.minimum(c_bar, rhos)
+        nonterminal = 1.0 - dones
+        next_values = jnp.concatenate(
+            [values[1:], bootstrap[None]], axis=0)
+        deltas = clipped_rho * (
+            rewards + gamma * nonterminal * next_values - values)
+
+        def step(carry, xs):
+            delta, c, nt = xs
+            acc = delta + gamma * nt * c * carry
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            step, jnp.zeros_like(bootstrap),
+            (deltas, clipped_c, nonterminal), reverse=True)
+        vs = values + vs_minus_v
+        next_vs = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
+        pg_adv = clipped_rho * (
+            rewards + gamma * nonterminal * next_vs - values)
+        return vs, pg_adv
+
+    return vtrace
+
+
+# ---------------------------------------------------------------------------
 # Config
 # ---------------------------------------------------------------------------
 
@@ -169,35 +211,7 @@ class ImpalaLearner:
                               optax.adam(lr))
         self.opt_state = self.tx.init(self.params)
 
-        def vtrace(target_logp, behavior_logp, values, bootstrap,
-                   rewards, dones):
-            """v-trace targets (IMPALA paper eq. 1): reverse scan over T.
-            All inputs [T, B]; bootstrap [B]."""
-            rhos = jnp.exp(target_logp - behavior_logp)
-            clipped_rho = jnp.minimum(rho_bar, rhos)
-            # lambda discounts the trace cut (IMPALA paper appendix C /
-            # rllib vtrace lambda_): variance control for long horizons
-            clipped_c = vtrace_lambda * jnp.minimum(c_bar, rhos)
-            nonterminal = 1.0 - dones
-            next_values = jnp.concatenate(
-                [values[1:], bootstrap[None]], axis=0)
-            deltas = clipped_rho * (
-                rewards + gamma * nonterminal * next_values - values)
-
-            def step(carry, xs):
-                delta, c, nt, v, nv = xs
-                acc = delta + gamma * nt * c * carry
-                return acc, acc
-
-            _, vs_minus_v = jax.lax.scan(
-                step, jnp.zeros_like(bootstrap),
-                (deltas, clipped_c, nonterminal, values, next_values),
-                reverse=True)
-            vs = values + vs_minus_v
-            next_vs = jnp.concatenate([vs[1:], bootstrap[None]], axis=0)
-            pg_adv = clipped_rho * (
-                rewards + gamma * nonterminal * next_vs - values)
-            return vs, pg_adv
+        vtrace = make_vtrace(gamma, rho_bar, c_bar, vtrace_lambda)
 
         def _update(params, opt_state, batch, ent_coeff):
             def loss_fn(p):
@@ -301,7 +315,24 @@ class Impala:
         probe = gym.make(config.env_name)
         num_actions = int(probe.action_space.n)
         probe.close()
-        self._learner = ImpalaLearner(
+        self._learner = self._make_learner(obs_shape, num_actions)
+        self._broadcast_weights()
+        # continuous sampling pipeline: sample ref -> owning runner
+        self._inflight: Dict[Any, Any] = {}
+        for runner in self._runners:
+            for _ in range(config.sample_window):
+                self._inflight[runner.sample.remote()] = runner
+        self._agg_rr = 0            # round-robin aggregator cursor
+        self._pending_batches: List = []  # refs of aggregator outputs
+        self._iteration = 0
+        self._recent_returns: List[float] = []
+        self._env_steps = 0
+
+    def _make_learner(self, obs_shape, num_actions):
+        """Overridable learner factory (APPO swaps in its clipped-
+        surrogate learner while reusing the whole async pipeline)."""
+        config = self.config
+        return ImpalaLearner(
             obs_shape=obs_shape, num_actions=num_actions,
             model_config=dict(config.model), lr=config.lr,
             gamma=config.gamma, vf_coeff=config.vf_coeff,
@@ -315,17 +346,6 @@ class Impala:
             lr_decay_steps=config.lr_decay_iters * config.num_epochs,
             lr_decay_begin=config.lr_decay_begin_iters *
             config.num_epochs)
-        self._broadcast_weights()
-        # continuous sampling pipeline: sample ref -> owning runner
-        self._inflight: Dict[Any, Any] = {}
-        for runner in self._runners:
-            for _ in range(config.sample_window):
-                self._inflight[runner.sample.remote()] = runner
-        self._agg_rr = 0            # round-robin aggregator cursor
-        self._pending_batches: List = []  # refs of aggregator outputs
-        self._iteration = 0
-        self._recent_returns: List[float] = []
-        self._env_steps = 0
 
     def _broadcast_weights(self):
         import ray_tpu

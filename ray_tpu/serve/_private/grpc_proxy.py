@@ -103,6 +103,27 @@ class GrpcProxyActor:
 
     async def _handle(self, method: str, request: bytes, context):
         import grpc
+        # Built-in typed API service (reference: serve.proto
+        # RayServeAPIService; grpc_util.py holds the method table): real
+        # protobuf request/response, callable from any language that
+        # compiled protos/serve.proto.
+        from ..generated import serve_pb2
+        from ..grpc_util import RAY_SERVE_API_SERVICE
+        service = method.rsplit("/", 2)[-2] if method.count("/") >= 2 \
+            else ""
+        if service == RAY_SERVE_API_SERVICE:
+            name = method.rsplit("/", 1)[-1]
+            if name == "ListApplications":
+                serve_pb2.ListApplicationsRequest.FromString(request)
+                return serve_pb2.ListApplicationsResponse(
+                    application_names=sorted(self._routes)
+                ).SerializeToString()
+            if name == "Healthz":
+                serve_pb2.HealthzRequest.FromString(request)
+                return serve_pb2.HealthzResponse(
+                    message="success").SerializeToString()
+            await context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                                f"unknown API method {name!r}")
         meta = dict(context.invocation_metadata() or ())
         app = meta.get("application")
         if app is None and len(self._routes) == 1:

@@ -4,10 +4,12 @@ from .config import (CheckpointConfig, FailureConfig, RunConfig,
                      ScalingConfig)
 from .context import get_checkpoint, get_context, get_dataset_shard, report
 from .result import Result
+from .torch import TorchConfig, TorchTrainer
 from .trainer import JaxTrainer
 
 __all__ = [
-    "JaxTrainer", "ScalingConfig", "RunConfig", "FailureConfig",
+    "JaxTrainer", "TorchTrainer", "TorchConfig",
+    "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "Result", "report", "get_checkpoint",
     "get_context", "get_dataset_shard", "barrier",
     "broadcast_from_rank_zero", "save_pytree", "load_pytree",

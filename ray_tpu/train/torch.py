@@ -1,0 +1,124 @@
+"""TorchTrainer: torch-DDP data parallelism on the same controller /
+worker-group machinery as JaxTrainer
+(reference: train/v2/api/data_parallel_trainer.py:118 + torch backend
+`TorchConfig` — python/ray/train/torch/config.py: process-group setup on
+each worker before the train loop; prepare_model/prepare_data_loader in
+python/ray/train/torch/train_loop_utils.py).
+
+Rendezvous rides the framework's own control-plane collective
+(`broadcast_from_rank_zero`, the analog of the reference's named-actor
+ncclUniqueId rendezvous — SURVEY §2d): rank 0 binds a free port and
+broadcasts `host:port`; every worker then joins the gloo TCP store. On
+this runtime torch is CPU-only by scope (README: TPU compute runs
+through JAX/XLA) — the point of TorchTrainer is API parity for torch
+train loops, with gloo allreduce as the DDP data plane."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Callable, Dict, Optional
+
+from .config import RunConfig, ScalingConfig
+from .context import get_context
+from .trainer import JaxTrainer
+
+
+class TorchConfig:
+    """(reference: train/torch/config.py TorchConfig — backend +
+    init timeout)."""
+
+    def __init__(self, backend: str = "gloo",
+                 timeout_s: float = 120.0):
+        self.backend = backend
+        self.timeout_s = timeout_s
+
+
+def _wrap_torch_loop(user_loop: Callable, torch_config: TorchConfig):
+    """Returns a train loop that brings up torch.distributed, runs the
+    user loop, and always tears the process group down."""
+
+    def torch_loop(config):
+        import datetime
+
+        import torch.distributed as dist
+
+        from .collectives import broadcast_from_rank_zero
+
+        ctx = get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        addr = None
+        if rank == 0:
+            sock = socket.socket()
+            sock.bind(("127.0.0.1", 0))
+            port = sock.getsockname()[1]
+            sock.close()  # gloo's TCPStore rebinds it immediately
+            addr = f"127.0.0.1:{port}"
+        addr = broadcast_from_rank_zero(addr, name="torch-rendezvous")
+        dist.init_process_group(
+            torch_config.backend, init_method=f"tcp://{addr}",
+            rank=rank, world_size=world,
+            timeout=datetime.timedelta(seconds=torch_config.timeout_s))
+        try:
+            return user_loop(config) if _wants_config(user_loop) \
+                else user_loop()
+        finally:
+            dist.destroy_process_group()
+
+    return torch_loop
+
+
+def _wants_config(fn: Callable) -> bool:
+    import inspect
+    try:
+        return len(inspect.signature(fn).parameters) > 0
+    except (TypeError, ValueError):
+        return True
+
+
+class TorchTrainer(JaxTrainer):
+    """(reference: python/ray/train/torch/torch_trainer.py TorchTrainer
+    — a DataParallelTrainer whose backend is TorchConfig)."""
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint=None):
+        super().__init__(
+            _wrap_torch_loop(train_loop_per_worker,
+                             torch_config or TorchConfig()),
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config, run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)
+
+
+def prepare_model(model):
+    """Wrap in DDP when world_size > 1 (reference:
+    train_loop_utils.py prepare_model — device move + DDP wrap; CPU/gloo
+    here, so no device move)."""
+    ctx = get_context()
+    if ctx.get_world_size() <= 1:
+        return model
+    from torch.nn.parallel import DistributedDataParallel
+    return DistributedDataParallel(model)
+
+
+def prepare_data_loader(data_loader):
+    """Re-build the DataLoader with a DistributedSampler so each rank
+    sees a disjoint shard (reference: train_loop_utils.py
+    prepare_data_loader)."""
+    import torch.utils.data as tud
+    ctx = get_context()
+    if ctx.get_world_size() <= 1:
+        return data_loader
+    sampler = tud.distributed.DistributedSampler(
+        data_loader.dataset, num_replicas=ctx.get_world_size(),
+        rank=ctx.get_world_rank())
+    return tud.DataLoader(
+        data_loader.dataset, batch_size=data_loader.batch_size,
+        sampler=sampler, num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last)
