@@ -5,7 +5,10 @@ ICloudInstanceProvider node_provider.py:149, fake provider for tests
 _private/fake_multi_node/node_provider.py)."""
 
 from .autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from .cluster_config import (ClusterHandle, load_cluster_config, up,
+                             validate_cluster_config)
 from .node_provider import FakeNodeProvider, NodeProvider
 
 __all__ = ["Autoscaler", "AutoscalerConfig", "FakeNodeProvider",
-           "NodeProvider", "NodeTypeConfig"]
+           "NodeProvider", "NodeTypeConfig", "ClusterHandle",
+           "load_cluster_config", "validate_cluster_config", "up"]

@@ -1,0 +1,150 @@
+"""Declarative cluster YAML: `up`/`down` from a config file
+(reference: `ray up cluster.yaml` — autoscaler/_private/commands.py
+create_or_update_cluster/teardown_cluster; YAML schema
+autoscaler/ray-schema.json: cluster_name / provider /
+available_node_types{resources,min_workers,max_workers} /
+head_node_type / idle_timeout_minutes).
+
+The config resolves to: a head node, a NodeProvider built from
+`provider.type`, and an Autoscaler + Monitor reconciling worker counts
+between each type's min/max against live GCS demand. `up()` returns a
+handle whose `.down()` tears the whole thing back down (reference:
+teardown_cluster)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from .autoscaler import (Autoscaler, AutoscalerConfig, Monitor,
+                         NodeTypeConfig)
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    """Parse + validate a cluster YAML; returns the normalized dict."""
+    import yaml
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    return validate_cluster_config(raw)
+
+
+def validate_cluster_config(raw: Dict[str, Any]) -> Dict[str, Any]:
+    if not isinstance(raw, dict):
+        raise ValueError("cluster config must be a mapping")
+    for field in ("cluster_name", "provider", "available_node_types",
+                  "head_node_type"):
+        if field not in raw:
+            raise ValueError(f"cluster config missing {field!r}")
+    types = raw["available_node_types"]
+    if not isinstance(types, dict) or not types:
+        raise ValueError("available_node_types must be a non-empty map")
+    head_type = raw["head_node_type"]
+    if head_type not in types:
+        raise ValueError(
+            f"head_node_type {head_type!r} not in available_node_types")
+    for name, spec in types.items():
+        if "resources" not in spec:
+            raise ValueError(f"node type {name!r} missing resources")
+        if int(spec.get("min_workers", 0)) > \
+                int(spec.get("max_workers", 0)) and name != head_type:
+            raise ValueError(
+                f"node type {name!r}: min_workers > max_workers")
+    provider = raw["provider"]
+    if "type" not in provider:
+        raise ValueError("provider.type is required")
+    if provider["type"] not in ("fake", "gke_tpu"):
+        raise ValueError(
+            f"unknown provider.type {provider['type']!r} "
+            "(supported: fake, gke_tpu)")
+    return raw
+
+
+def _build_provider(config: Dict[str, Any], cluster):
+    kind = config["provider"]["type"]
+    if kind == "fake":
+        from .node_provider import FakeNodeProvider
+        return FakeNodeProvider(cluster)
+    from .gke_provider import GkeTpuNodeProvider
+    opts = {k: v for k, v in config["provider"].items() if k != "type"}
+    return GkeTpuNodeProvider(cluster_name=config["cluster_name"],
+                              **opts)
+
+
+def _worker_node_types(config: Dict[str, Any]):
+    head_type = config["head_node_type"]
+    out = []
+    for name, spec in config["available_node_types"].items():
+        if name == head_type:
+            continue
+        out.append(NodeTypeConfig(
+            name=name,
+            resources={k: float(v)
+                       for k, v in spec["resources"].items()},
+            min_workers=int(spec.get("min_workers", 0)),
+            max_workers=int(spec.get("max_workers", 0)),
+            labels=dict(spec.get("labels") or {})))
+    return out
+
+
+@dataclasses.dataclass
+class ClusterHandle:
+    config: Dict[str, Any]
+    cluster: Any
+    provider: Any
+    autoscaler: Autoscaler
+    monitor: Monitor
+
+    def down(self, shutdown_cluster: bool = True):
+        """teardown_cluster: stop reconciling, terminate every provider
+        instance, then (optionally) the head."""
+        self.monitor.stop()
+        for instance_id in list(
+                self.provider.non_terminated_instances()):
+            try:
+                self.provider.terminate(instance_id)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        if shutdown_cluster:
+            self.cluster.shutdown()
+
+
+def up(config_or_path, *, cluster=None, connect: bool = True,
+       monitor_interval_s: float = 1.0) -> ClusterHandle:
+    """Bring the described cluster up. With the fake provider a head
+    Cluster is created in-process (pass `cluster=` to adopt one);
+    min_workers of every type are pre-provisioned, then the Monitor
+    keeps counts reconciled against demand."""
+    if isinstance(config_or_path, str):
+        config = load_cluster_config(config_or_path)
+    else:
+        config = validate_cluster_config(config_or_path)
+
+    if cluster is None:
+        from ..cluster_utils import Cluster
+        head_spec = config["available_node_types"][
+            config["head_node_type"]]
+        cluster = Cluster(head_node_args={
+            "resources": {k: float(v)
+                          for k, v in head_spec["resources"].items()}})
+        if connect:
+            cluster.connect()
+
+    provider = _build_provider(config, cluster)
+    idle_s = float(config.get("idle_timeout_minutes", 0.5)) * 60.0
+    as_config = AutoscalerConfig(
+        node_types=_worker_node_types(config),
+        idle_timeout_s=idle_s,
+        max_launch_batch=int(config.get("max_launch_batch", 5)))
+
+    # The reconciler talks to the HEAD's GCS directly (not the calling
+    # process's driver connection): up(connect=False) and adopted
+    # clusters must reconcile against the cluster the YAML described,
+    # not whatever this process happens to be connected to.
+    from .._internal.gcs_client import GcsClient
+    gcs = GcsClient(tuple(cluster.gcs_address))
+    autoscaler = Autoscaler(as_config, provider, gcs)
+    monitor = Monitor(autoscaler, interval_s=monitor_interval_s)
+    monitor.start()
+    return ClusterHandle(config=config, cluster=cluster,
+                         provider=provider, autoscaler=autoscaler,
+                         monitor=monitor)

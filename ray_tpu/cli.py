@@ -105,6 +105,52 @@ def _block_until_signal():
         time.sleep(0.5)
 
 
+def cmd_up(args):
+    """`ray up cluster.yaml` analog (reference:
+    autoscaler/_private/commands.py create_or_update_cluster): start a
+    head + autoscaler from a declarative YAML and reconcile until
+    interrupted."""
+    from ray_tpu.autoscaler.cluster_config import load_cluster_config, up
+
+    config = load_cluster_config(args.config)
+    workers = {name: (spec.get("min_workers", 0),
+                      spec.get("max_workers", 0))
+               for name, spec in config["available_node_types"].items()
+               if name != config["head_node_type"]}
+    print(f"cluster {config['cluster_name']!r}: provider "
+          f"{config['provider']['type']}, head "
+          f"{config['head_node_type']}, workers {workers}", flush=True)
+    if args.validate_only:
+        print("config valid", flush=True)
+        return
+    handle = up(config)
+    address = handle.cluster.address
+    _write_address(address)
+    print(f"head started; GCS at {address}; autoscaler reconciling "
+          "(Ctrl-C to tear down)", flush=True)
+    _block_until_signal()
+    handle.down()
+
+
+def cmd_down(args):
+    """`ray down` analog (reference: commands.py teardown_cluster):
+    terminate every provider instance named by the YAML."""
+    from ray_tpu.autoscaler.cluster_config import (_build_provider,
+                                                   load_cluster_config)
+
+    config = load_cluster_config(args.config)
+    if config["provider"]["type"] == "fake":
+        print("fake provider is in-process; nothing to tear down "
+              "(Ctrl-C the `up` process instead)", flush=True)
+        return
+    provider = _build_provider(config, cluster=None)
+    instances = provider.non_terminated_instances()
+    for instance_id in instances:
+        provider.terminate(instance_id)
+    print(f"terminated {len(instances)} instances of cluster "
+          f"{config['cluster_name']!r}", flush=True)
+
+
 def cmd_stop(_args):
     import subprocess
     patterns = ["ray_tpu._internal.raylet_main",
@@ -236,6 +282,15 @@ def main(argv=None):
     p.add_argument("--num-cpus", type=float, default=None)
     p.add_argument("--dashboard", action="store_true")
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("up")
+    p.add_argument("config")
+    p.add_argument("--validate-only", action="store_true")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("stop")
     p.set_defaults(fn=cmd_stop)

@@ -1,5 +1,8 @@
 from .llama import (LlamaConfig, LlamaModel, cross_entropy_loss,
                     init_kv_caches)
+from .lora import (lora_optimizer, merge_lora, num_lora_params,
+                   split_lora)
 
 __all__ = ["LlamaConfig", "LlamaModel", "cross_entropy_loss",
-           "init_kv_caches"]
+           "init_kv_caches", "lora_optimizer", "merge_lora",
+           "split_lora", "num_lora_params"]

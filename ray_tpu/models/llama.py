@@ -51,6 +51,16 @@ class LlamaConfig:
     # "flash" (pallas fwd + chunked bwd), "chunked", or "reference"
     # (full-logits, XLA-fused — fastest backward at moderate seq lengths).
     attention_impl: str = "flash"
+    # LoRA (Hu et al. 2021; reference workload: BASELINE config_3's
+    # Llama-2-7B LoRA fine-tune). rank 0 = disabled. Each target
+    # projection W gains (alpha/rank) * A @ B with B zero-initialized,
+    # so enabling LoRA never changes the initial forward. Train only
+    # the adapters with models.lora.lora_optimizer; fold them for
+    # serving with models.lora.merge_lora.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj",
+                                     "o_proj")
 
     @property
     def head_dim_(self) -> int:
@@ -102,6 +112,36 @@ def _partitioned(init, names):
     return nn.with_logical_partitioning(init, names)
 
 
+def _lora_delta(x, feats, in_names, out_names, name, cfg,
+                axis=-1):
+    """(x @ A) @ B * (alpha/rank): the LoRA low-rank path, computed
+    WITHOUT materializing the dense delta (the x@A bottleneck is [.., r]
+    — at rank 8-64 this is bandwidth-free next to the base matmul).
+    B is zero-init, so the adapted model starts exactly at the base
+    model. The 'lora' logical axis has no mesh rule -> adapters
+    replicate (they are KBs; the base weights stay sharded)."""
+    r = cfg.lora_rank
+    a = nn.DenseGeneral(
+        r, axis=axis, use_bias=False, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype, name=f"{name}_lora_a",
+        kernel_init=_partitioned(nn.initializers.lecun_normal(),
+                                 in_names + ("lora",)))(x)
+    b = nn.DenseGeneral(
+        feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype, name=f"{name}_lora_b",
+        kernel_init=_partitioned(nn.initializers.zeros_init(),
+                                 ("lora",) + out_names))(a)
+    return b * (cfg.lora_alpha / r)
+
+
+def _maybe_lora(x, y, feats, in_names, out_names, name, cfg, axis=-1):
+    """y = base_projection(x); adds the LoRA path when enabled."""
+    if cfg.lora_rank and name in cfg.lora_targets:
+        return y + _lora_delta(x, feats, in_names, out_names, name, cfg,
+                               axis=axis)
+    return y
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Dtype = jnp.bfloat16
@@ -150,10 +190,16 @@ class Attention(nn.Module):
                 nn.initializers.lecun_normal(), names))
         q = dense((cfg.num_heads, hd), ("embed", "heads", "head_dim"),
                   "q_proj")(x)
+        q = _maybe_lora(x, q, (cfg.num_heads, hd), ("embed",),
+                        ("heads", "head_dim"), "q_proj", cfg)
         k = dense((cfg.num_kv_heads, hd), ("embed", "kv_heads", "head_dim"),
                   "k_proj")(x)
+        k = _maybe_lora(x, k, (cfg.num_kv_heads, hd), ("embed",),
+                        ("kv_heads", "head_dim"), "k_proj", cfg)
         v = dense((cfg.num_kv_heads, hd), ("embed", "kv_heads", "head_dim"),
                   "v_proj")(x)
+        v = _maybe_lora(x, v, (cfg.num_kv_heads, hd), ("embed",),
+                        ("kv_heads", "head_dim"), "v_proj", cfg)
         # [b, s, h, d] -> [b, h, s, d]
         q = jnp.transpose(q, (0, 2, 1, 3))
         k = jnp.transpose(k, (0, 2, 1, 3))
@@ -297,12 +343,15 @@ class Attention(nn.Module):
             else:
                 out = flash_attention(q, k, v, True, None)
         out = jnp.transpose(out, (0, 2, 1, 3))  # [b, s, h, d]
-        out = nn.DenseGeneral(
+        proj = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="o_proj",
             kernel_init=_partitioned(nn.initializers.lecun_normal(),
                                      ("heads", "head_dim", "embed")))(out)
-        return out, new_cache
+        proj = _maybe_lora(out, proj, cfg.hidden_size,
+                           ("heads", "head_dim"), ("embed",), "o_proj",
+                           cfg, axis=(-2, -1))
+        return proj, new_cache
 
 
 class MLP(nn.Module):
@@ -316,17 +365,23 @@ class MLP(nn.Module):
             param_dtype=cfg.param_dtype, name="gate_proj",
             kernel_init=_partitioned(nn.initializers.lecun_normal(),
                                      ("embed", "mlp")))(x)
+        gate = _maybe_lora(x, gate, cfg.intermediate_size, ("embed",),
+                           ("mlp",), "gate_proj", cfg)
         up = nn.DenseGeneral(
             cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="up_proj",
             kernel_init=_partitioned(nn.initializers.lecun_normal(),
                                      ("embed", "mlp")))(x)
+        up = _maybe_lora(x, up, cfg.intermediate_size, ("embed",),
+                         ("mlp",), "up_proj", cfg)
         hidden = nn.silu(gate) * up
-        return nn.DenseGeneral(
+        down = nn.DenseGeneral(
             cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, name="down_proj",
             kernel_init=_partitioned(nn.initializers.lecun_normal(),
                                      ("mlp", "embed")))(hidden)
+        return _maybe_lora(hidden, down, cfg.hidden_size, ("mlp",),
+                           ("embed",), "down_proj", cfg)
 
 
 class DecoderBlock(nn.Module):

@@ -22,7 +22,8 @@ from .learner import PPOLearner
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                           MultiAgentPPO, MultiAgentPPOConfig,
                           make_multi_agent)
-from .offline import BC, BCConfig, record_episodes
+from .offline import (BC, BCConfig, MARWIL, MARWILConfig,
+                      record_episodes)
 from .sac import SAC, SACConfig, SACLearner
 
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
@@ -32,4 +33,4 @@ __all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
            "SAC", "SACConfig", "SACLearner",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",
            "MultiAgentPPOConfig", "make_multi_agent",
-           "BC", "BCConfig", "record_episodes"]
+           "BC", "BCConfig", "MARWIL", "MARWILConfig", "record_episodes"]

@@ -55,10 +55,8 @@ def _transitions_from_dataset(dataset) -> Dict[str, np.ndarray]:
     row-per-step episodes that offline.record_episodes writes: within an
     episode rows are in step order, so next_obs is the next row's obs;
     terminal steps get a zero next_obs masked by done."""
-    rows = dataset.take_all()
-    by_ep: Dict[int, list] = {}
-    for r in rows:
-        by_ep.setdefault(int(r["episode"]), []).append(r)
+    from .offline import group_episodes
+    by_ep = group_episodes(dataset.take_all())
     obs, actions, rewards, next_obs, dones = [], [], [], [], []
     for ep_rows in by_ep.values():
         for i, r in enumerate(ep_rows):
@@ -175,11 +173,9 @@ class CQL:
                 "num_transitions": int(n)}
 
     def evaluate(self, num_episodes: int = 5) -> float:
-        import gymnasium as gym
         import jax
         import jax.numpy as jnp
         assert self._params is not None, "fit() first"
-        env = gym.make(self.config.env_name)
         model, params = self._model, self._params
 
         @jax.jit
@@ -187,14 +183,6 @@ class CQL:
             q = model.apply({"params": params}, obs[None])
             return jnp.argmax(q, axis=-1)[0]
 
-        total = 0.0
-        for ep in range(num_episodes):
-            obs, _ = env.reset(seed=30_000 + ep)
-            done = False
-            while not done:
-                action = int(act(jnp.asarray(obs, jnp.float32)))
-                obs, reward, terminated, truncated, _ = env.step(action)
-                total += reward
-                done = terminated or truncated
-        env.close()
-        return total / num_episodes
+        from .offline import greedy_rollout_score
+        return greedy_rollout_score(self.config.env_name, act,
+                                    num_episodes, seed_base=30_000)

@@ -109,14 +109,19 @@ def prepare_model(model):
 def prepare_data_loader(data_loader):
     """Re-build the DataLoader with a DistributedSampler so each rank
     sees a disjoint shard (reference: train_loop_utils.py
-    prepare_data_loader)."""
+    prepare_data_loader). The original loader's shuffle intent is
+    PRESERVED: a sequential loader (eval) stays ordered within its
+    shard, a shuffling loader keeps shuffling — call
+    `loader.sampler.set_epoch(e)` per epoch to reshuffle, exactly as
+    with a hand-built DistributedSampler."""
     import torch.utils.data as tud
     ctx = get_context()
     if ctx.get_world_size() <= 1:
         return data_loader
+    shuffle = isinstance(data_loader.sampler, tud.RandomSampler)
     sampler = tud.distributed.DistributedSampler(
         data_loader.dataset, num_replicas=ctx.get_world_size(),
-        rank=ctx.get_world_rank())
+        rank=ctx.get_world_rank(), shuffle=shuffle)
     return tud.DataLoader(
         data_loader.dataset, batch_size=data_loader.batch_size,
         sampler=sampler, num_workers=0,

@@ -1,0 +1,94 @@
+"""Declarative cluster YAML up/down (reference: `ray up cluster.yaml` —
+autoscaler/_private/commands.py create_or_update_cluster /
+teardown_cluster, schema autoscaler/ray-schema.json)."""
+
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+YAML = """\
+cluster_name: test-cluster
+idle_timeout_minutes: 0.05
+provider:
+  type: fake
+available_node_types:
+  head:
+    resources: {CPU: 1}
+  worker-2cpu:
+    resources: {CPU: 2}
+    min_workers: 1
+    max_workers: 3
+head_node_type: head
+"""
+
+
+@pytest.fixture
+def config_path(tmp_path):
+    p = tmp_path / "cluster.yaml"
+    p.write_text(YAML)
+    return str(p)
+
+
+def test_validate_rejects_bad_configs(config_path):
+    from ray_tpu.autoscaler.cluster_config import (load_cluster_config,
+                                                   validate_cluster_config)
+
+    config = load_cluster_config(config_path)
+    assert config["cluster_name"] == "test-cluster"
+    with pytest.raises(ValueError, match="head_node_type"):
+        validate_cluster_config({**config, "head_node_type": "nope"})
+    with pytest.raises(ValueError, match="provider.type"):
+        validate_cluster_config({**config, "provider": {}})
+    with pytest.raises(ValueError, match="min_workers"):
+        bad = dict(config)
+        bad["available_node_types"] = {
+            "head": {"resources": {"CPU": 1}},
+            "w": {"resources": {"CPU": 1}, "min_workers": 5,
+                  "max_workers": 1}}
+        validate_cluster_config(bad)
+
+
+@pytest.mark.timeout_s(300)
+def test_up_provisions_min_scales_on_demand_and_downs(config_path):
+    from ray_tpu.autoscaler.cluster_config import up
+
+    handle = up(config_path, monitor_interval_s=0.5)
+    try:
+        # min_workers floor: one worker-2cpu appears without any demand
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            instances = handle.provider.non_terminated_instances()
+            if len(instances) >= 1:
+                break
+            time.sleep(0.5)
+        assert len(handle.provider.non_terminated_instances()) == 1
+
+        # unmet demand scales beyond the floor (head has 1 CPU; each
+        # task needs 2 => only new workers can run them)
+        @ray_tpu.remote(num_cpus=2)
+        def hold(i):
+            time.sleep(3)
+            return i
+
+        refs = [hold.remote(i) for i in range(3)]
+        out = ray_tpu.get(refs, timeout=120)
+        assert sorted(out) == [0, 1, 2]
+        assert handle.autoscaler.num_launches >= 2
+    finally:
+        handle.down()
+    assert handle.provider.non_terminated_instances() == {}
+    ray_tpu.shutdown()
+
+
+def test_cli_up_validate_only(config_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.cli", "up", config_path,
+         "--validate-only"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "config valid" in proc.stdout
+    assert "worker-2cpu" in proc.stdout

@@ -1,6 +1,7 @@
-"""APPO (async PPO on the IMPALA pipeline) + CQL (conservative offline
-Q-learning) — reference: rllib/algorithms/appo/appo.py:59,268 and
-rllib/algorithms/cql/cql.py:51 (VERDICT r4 missing #3)."""
+"""APPO (async PPO on the IMPALA pipeline), CQL (conservative offline
+Q-learning), and MARWIL (advantage-weighted imitation) — reference:
+rllib/algorithms/appo/appo.py:59,268, cql/cql.py:51,
+marwil/marwil.py:43 (VERDICT r4 missing #3)."""
 
 import os
 
@@ -148,3 +149,39 @@ def test_cql_penalty_depresses_ood_actions():
     q = np.asarray(q)
     assert np.mean(q[:, 0] > q[:, 1]) > 0.9, \
         "dataset action not preferred under CQL penalty"
+
+
+@pytest.mark.timeout_s(900)
+def test_marwil_prefers_good_trajectories(rl_cluster):
+    """MARWIL on MIXED-quality data (expert + random episodes): the
+    exp(beta*adv) weighting should recover near-expert play where the
+    data's average policy is mediocre (reference:
+    rllib/algorithms/marwil — beta=0 is plain BC)."""
+    from ray_tpu.rllib import MARWILConfig, record_episodes
+
+    rng = np.random.default_rng(1)
+
+    def expert(obs):
+        if rng.random() < 0.1:
+            return int(rng.integers(2))
+        return 1 if obs[2] + 0.5 * obs[3] > 0 else 0
+
+    def random_policy(_obs):
+        return int(rng.integers(2))
+
+    good = record_episodes("CartPole-v1", expert, num_episodes=12,
+                           seed=0)
+    # random episodes re-numbered after the expert's
+    bad_rows = [dict(r, episode=int(r["episode"]) + 10_000)
+                for r in record_episodes("CartPole-v1", random_policy,
+                                         num_episodes=12,
+                                         seed=100).take_all()]
+    from ray_tpu import data as rd
+    mixed = rd.from_items(good.take_all() + bad_rows)
+
+    algo = (MARWILConfig().environment("CartPole-v1")
+            .training(beta=1.0, num_epochs=30)).build()
+    metrics = algo.fit(mixed)
+    assert metrics["num_transitions"] > 1500
+    score = algo.evaluate(num_episodes=5)
+    assert score >= 300, f"MARWIL scored {score:.1f} on mixed data"
