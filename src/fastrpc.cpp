@@ -66,6 +66,12 @@ struct Conn {
   size_t out_off = 0;
   std::atomic<size_t> out_bytes{0};
   bool want_write = false;  // epoll thread only
+  // Short-lived pin held by frpc_send across its enqueue so the send
+  // path can drop the REGISTRY lock before taking out_mu (a conn mid-
+  // writev must not stall every other conn's sends through the global
+  // mutex). close_conn spins for pins==0 after unmapping the id.
+  std::atomic<int> pins{0};
+  std::atomic<bool> in_dirty{false};  // O(1) dirty dedup (see dirty_mu)
   // read side (epoll thread only)
   std::string in;
   size_t in_off = 0;
@@ -88,6 +94,9 @@ struct Core {
   std::unordered_map<int64_t, Conn*> conns;
   std::vector<Conn*> pending_add;
   std::vector<int64_t> pending_close;
+  // Dirty signaling rides its OWN tiny mutex (not the registry lock):
+  // the send hot path then touches c->mu only for the pin lookup.
+  std::mutex dirty_mu;
   std::vector<int64_t> dirty;  // conns with newly queued output
   std::atomic<int64_t> next_id{1};
   // inbound event queue
@@ -97,6 +106,9 @@ struct Core {
   bool notified = false;
   std::atomic<bool> any_parked{false};  // some conns have EPOLLIN parked
   std::atomic<bool> resume{false};      // python drained below low-water
+  // Closed conns still pinned by an in-flight frpc_send; io thread only.
+  // Reaped (deleted) once pins drain — the close path never spins.
+  std::vector<Conn*> reap;
 };
 
 Core* g_core = nullptr;
@@ -144,12 +156,20 @@ void close_conn(Core* c, Conn* conn, bool deliver_event) {
   close(conn->fd);
   if (deliver_event && !conn->listener)
     push_event(c, conn->id, 2, std::string());
-  std::lock_guard<std::mutex> lk(c->mu);
-  c->conns.erase(conn->id);
-  // Conn object intentionally leaked until process exit would be wasteful;
-  // but python threads may still hold the id for frpc_send, which now
-  // fails by lookup. Safe to delete: lookups go through the map.
-  delete conn;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    c->conns.erase(conn->id);
+  }
+  // frpc_send pins the conn under the registry lock before touching it;
+  // once unmapped no NEW pin can appear, so the delete is safe at
+  // pins==0. A still-pinned conn (send mid-enqueue on another thread)
+  // goes on the reap list instead of blocking the io thread — io_loop
+  // deletes it once the pin drains.
+  if (conn->pins.load(std::memory_order_acquire) == 0) {
+    delete conn;
+  } else {
+    c->reap.push_back(conn);
+  }
 }
 
 void handle_accept(Core* c, Conn* listener) {
@@ -228,7 +248,13 @@ void handle_write(Core* c, Conn* conn) {
       iov[n_iov].iov_base = const_cast<char*>(s.data()) + skip;
       iov[n_iov].iov_len = s.size() - skip;
     }
+    // writev runs UNLOCKED: producers may emplace_back concurrently
+    // (deque push_back never moves existing elements, and the string
+    // payloads the iovs point into are heap-stable); only this thread
+    // pops, so the snapshotted front entries stay valid.
+    lk.unlock();
     ssize_t written = writev(conn->fd, iov, static_cast<int>(n_iov));
+    lk.lock();
     if (written < 0) {
       if (errno == EAGAIN || errno == EWOULDBLOCK) break;
       lk.unlock();
@@ -265,6 +291,17 @@ void io_loop(Core* c) {
       if (errno == EINTR) continue;
       return;
     }
+    // Reap closed-but-pinned conns whose pins have drained.
+    if (!c->reap.empty()) {
+      size_t kept = 0;
+      for (Conn* dead : c->reap) {
+        if (dead->pins.load(std::memory_order_acquire) == 0)
+          delete dead;
+        else
+          c->reap[kept++] = dead;
+      }
+      c->reap.resize(kept);
+    }
     // Drain registration/close/wake requests.
     {
       std::vector<Conn*> add;
@@ -299,13 +336,19 @@ void io_loop(Core* c) {
         // Flush exactly the conns marked dirty by frpc_send.
         std::vector<Conn*> flush;
         {
-          std::lock_guard<std::mutex> lk(c->mu);
-          for (int64_t cid : c->dirty) {
-            auto it = c->conns.find(cid);
-            if (it != c->conns.end() && !it->second->listener)
-              flush.push_back(it->second);
+          std::vector<int64_t> ids;
+          {
+            std::lock_guard<std::mutex> dlk(c->dirty_mu);
+            ids.swap(c->dirty);
           }
-          c->dirty.clear();
+          std::lock_guard<std::mutex> lk(c->mu);
+          for (int64_t cid : ids) {
+            auto it = c->conns.find(cid);
+            if (it != c->conns.end() && !it->second->listener) {
+              it->second->in_dirty.store(false, std::memory_order_release);
+              flush.push_back(it->second);
+            }
+          }
         }
         for (Conn* conn : flush) handle_write(c, conn);
         if (c->resume.exchange(false)) {
@@ -340,7 +383,18 @@ void io_loop(Core* c) {
         close_conn(c, conn, true);
         continue;
       }
-      if (evs[i].events & EPOLLOUT) handle_write(c, conn);
+      if (evs[i].events & EPOLLOUT) {
+        handle_write(c, conn);
+        // handle_write may close_conn (writev ECONNRESET): the conn is
+        // then unmapped/freed — re-resolve before the EPOLLIN branch
+        // touches it. Deletion only happens on THIS thread, so a map
+        // hit proves liveness.
+        if (evs[i].events & EPOLLIN) {
+          std::lock_guard<std::mutex> lk(c->mu);
+          auto it = c->conns.find(static_cast<int64_t>(id));
+          if (it == c->conns.end()) continue;
+        }
+      }
       if (evs[i].events & EPOLLIN) {
         bool over;
         {
@@ -482,27 +536,34 @@ int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
 int frpc_send(int64_t conn_id, const void* buf, uint64_t len) {
   Core* c = g_core;
   if (!c) return -1;
-  bool wake;
+  Conn* conn = nullptr;
   {
-    // Hold the registry lock across the enqueue: close_conn deletes the
-    // Conn under this lock, so holding it here excludes use-after-free.
+    // Registry lock only to PIN the conn (excludes close_conn's
+    // delete); the enqueue itself runs outside it so a conn whose
+    // out_mu is held across a long writev cannot stall sends to OTHER
+    // conns through the global mutex.
     std::lock_guard<std::mutex> lk(c->mu);
     auto it = c->conns.find(conn_id);
     if (it == c->conns.end()) return -1;
-    Conn* conn = it->second;
-    {
-      std::lock_guard<std::mutex> olk(conn->out_mu);
-      conn->out.emplace_back(static_cast<const char*>(buf), len);
-      conn->out_bytes.fetch_add(len);
-    }
-    // Wake the io thread only on empty->dirty transition: a burst of
-    // sends to one conn costs one eventfd write + one flush pass.
-    wake = c->dirty.empty();
-    bool already = false;
-    for (int64_t d : c->dirty)
-      if (d == conn_id) { already = true; break; }
-    if (!already) c->dirty.push_back(conn_id);
+    conn = it->second;
+    conn->pins.fetch_add(1, std::memory_order_acquire);
   }
+  {
+    std::lock_guard<std::mutex> olk(conn->out_mu);
+    conn->out.emplace_back(static_cast<const char*>(buf), len);
+    conn->out_bytes.fetch_add(len);
+  }
+  bool wake = false;
+  // The conn may have been unmapped since the pin; the flush pass
+  // looks dirty ids up in the map and skips vanished ones.
+  if (!conn->in_dirty.exchange(true, std::memory_order_acq_rel)) {
+    std::lock_guard<std::mutex> lk(c->dirty_mu);
+    // Wake the io thread only on empty->dirty transition: a burst of
+    // sends costs one eventfd write + one flush pass.
+    wake = c->dirty.empty();
+    c->dirty.push_back(conn_id);
+  }
+  conn->pins.fetch_sub(1, std::memory_order_release);
   if (wake) {
     uint64_t one = 1;
     ssize_t r = write(c->wakefd, &one, 8);
