@@ -18,6 +18,7 @@ from .cql import CQL, CQLConfig
 from .dqn import DQN, DQNConfig, DQNLearner, ReplayBufferActor
 from .env_runner import SingleAgentEnvRunner
 from .impala import Impala, ImpalaConfig, ImpalaLearner
+from .iql import IQL, IQLConfig
 from .learner import PPOLearner
 from .multi_agent import (MultiAgentEnv, MultiAgentEnvRunner,
                           MultiAgentPPO, MultiAgentPPOConfig,
@@ -29,6 +30,7 @@ from .sac import SAC, SACConfig, SACLearner
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "SingleAgentEnvRunner",
            "Impala", "ImpalaConfig", "ImpalaLearner",
            "Appo", "AppoConfig", "AppoLearner", "CQL", "CQLConfig",
+           "IQL", "IQLConfig",
            "DQN", "DQNConfig", "DQNLearner", "ReplayBufferActor",
            "SAC", "SACConfig", "SACLearner",
            "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentPPO",

@@ -1,0 +1,175 @@
+"""IQL: implicit Q-learning from offline data
+(reference: rllib/algorithms — IQL sits in the offline family with
+BC/MARWIL/CQL; Kostrikov et al. 2021. Three jitted pieces:
+
+1. expectile value regression  V(s) <- argmin E[L2^tau(Q_target(s,a)-V(s))]
+   — the tau-expectile of the DATASET's action-value distribution, an
+   in-sample soft-max that never queries out-of-distribution actions;
+2. TD critic  Q(s,a) <- r + gamma * V(s')  (SARSA-style, no argmax over
+   actions the dataset can't refute — the anti-extrapolation property
+   CQL gets from its penalty, IQL gets for free from in-sample V);
+3. advantage-weighted extraction  pi <- argmax E[exp(beta*(Q-V)) log pi]
+   (AWR on the implicit advantage).
+
+Discrete-action form on the repo's offline transitions Dataset
+(offline.record_episodes / group_episodes)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class IQLConfig:
+    def __init__(self):
+        self.env_name = "CartPole-v1"
+        self.lr = 5e-4
+        self.gamma = 0.99
+        self.expectile = 0.8          # tau of the value regression
+        self.beta = 3.0               # AWR inverse temperature
+        self.adv_clip = 20.0          # exp-weight ceiling
+        self.batch_size = 256
+        self.num_steps = 3000
+        self.target_update_freq = 100
+        self.model = {"hidden": (128, 128)}
+        self.seed = 0
+
+    def environment(self, env: str) -> "IQLConfig":
+        self.env_name = env
+        return self
+
+    def training(self, **kwargs) -> "IQLConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise AttributeError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "IQL":
+        return IQL(self)
+
+
+class IQL:
+    def __init__(self, config: IQLConfig):
+        self.config = config
+        self._params = None
+        self._model = None
+
+    def fit(self, dataset) -> Dict[str, Any]:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import flax.linen as nn
+
+        from .cql import _transitions_from_dataset
+
+        c = self.config
+        probe = gym.make(c.env_name)
+        num_actions = int(probe.action_space.n)
+        probe.close()
+
+        data = _transitions_from_dataset(dataset)
+        n = data["obs"].shape[0]
+        jd = {k: jnp.asarray(v) for k, v in data.items()}
+
+        hidden = tuple(c.model.get("hidden", (128, 128)))
+
+        class IQLNet(nn.Module):
+            """Shared torso; Q head per action, scalar V head, policy
+            logits head."""
+
+            @nn.compact
+            def __call__(self, obs):
+                x = obs
+                for width in hidden:
+                    x = nn.relu(nn.Dense(width)(x))
+                q = nn.Dense(num_actions, name="q_head")(x)
+                v = jnp.squeeze(nn.Dense(1, name="v_head")(x), -1)
+                logits = nn.Dense(num_actions, name="pi_head")(x)
+                return q, v, logits
+
+        model = IQLNet()
+        params = model.init(jax.random.PRNGKey(c.seed),
+                            jd["obs"][:1])["params"]
+        target_params = jax.tree.map(lambda x: x, params)
+        tx = optax.adam(c.lr)
+        opt_state = tx.init(params)
+
+        def expectile_loss(diff):
+            weight = jnp.where(diff > 0, c.expectile, 1.0 - c.expectile)
+            return weight * diff ** 2
+
+        @jax.jit
+        def step(params, target_params, opt_state, idx):
+            b_obs = jd["obs"][idx]
+            b_act = jd["actions"][idx]
+            b_rew = jd["rewards"][idx]
+            b_next = jd["next_obs"][idx]
+            b_done = jd["dones"][idx]
+
+            tq, _tv, _tl = model.apply({"params": target_params}, b_obs)
+            tq_data = jnp.take_along_axis(tq, b_act[:, None],
+                                          axis=-1)[:, 0]
+            _nq, next_v, _nl = model.apply({"params": params}, b_next)
+            next_v = jax.lax.stop_gradient(next_v)
+
+            def loss_fn(p):
+                q, v, logits = model.apply({"params": p}, b_obs)
+                q_data = jnp.take_along_axis(q, b_act[:, None],
+                                             axis=-1)[:, 0]
+                # (1) expectile value regression toward target-Q
+                v_loss = jnp.mean(expectile_loss(
+                    jax.lax.stop_gradient(tq_data) - v))
+                # (2) SARSA-style TD: bootstrap from V(s'), never from a
+                # max over out-of-sample actions
+                td_target = b_rew + c.gamma * (1.0 - b_done) * next_v
+                q_loss = jnp.mean(
+                    (q_data - jax.lax.stop_gradient(td_target)) ** 2)
+                # (3) AWR extraction on the implicit advantage
+                adv = jax.lax.stop_gradient(tq_data) - \
+                    jax.lax.stop_gradient(v)
+                weight = jnp.minimum(jnp.exp(c.beta * adv), c.adv_clip)
+                logp = jax.nn.log_softmax(logits)
+                nll = -jnp.take_along_axis(logp, b_act[:, None],
+                                           axis=-1)[:, 0]
+                pi_loss = jnp.mean(jax.lax.stop_gradient(weight) * nll)
+                return v_loss + q_loss + pi_loss, (v_loss, q_loss,
+                                                   pi_loss)
+
+            (total, (vl, ql, pl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, total, vl, ql, pl
+
+        key = jax.random.PRNGKey(c.seed + 1)
+        total = vl = ql = pl = jnp.float32(0)
+        for i in range(c.num_steps):
+            key, sub = jax.random.split(key)
+            idx = jax.random.randint(sub, (c.batch_size,), 0, n)
+            params, opt_state, total, vl, ql, pl = step(
+                params, target_params, opt_state, idx)
+            if (i + 1) % c.target_update_freq == 0:
+                target_params = jax.tree.map(lambda x: x, params)
+
+        self._params = params
+        self._model = model
+        return {"final_loss": float(total), "v_loss": float(vl),
+                "q_loss": float(ql), "pi_loss": float(pl),
+                "num_transitions": int(n)}
+
+    def evaluate(self, num_episodes: int = 5) -> float:
+        import jax
+        import jax.numpy as jnp
+        assert self._params is not None, "fit() first"
+        model, params = self._model, self._params
+
+        @jax.jit
+        def act(obs):
+            _q, _v, logits = model.apply({"params": params}, obs[None])
+            return jnp.argmax(logits, axis=-1)[0]
+
+        from .offline import greedy_rollout_score
+        return greedy_rollout_score(self.config.env_name, act,
+                                    num_episodes, seed_base=50_000)

@@ -69,7 +69,9 @@ struct Conn {
   // Short-lived pin held by frpc_send across its enqueue so the send
   // path can drop the REGISTRY lock before taking out_mu (a conn mid-
   // writev must not stall every other conn's sends through the global
-  // mutex). close_conn spins for pins==0 after unmapping the id.
+  // mutex). close_conn unmaps the id, then deletes immediately when
+  // unpinned or parks the conn on Core::reap for the io loop to delete
+  // once the pin drains — the close path never blocks on a sender.
   std::atomic<int> pins{0};
   std::atomic<bool> in_dirty{false};  // O(1) dirty dedup (see dirty_mu)
   // read side (epoll thread only)
