@@ -93,6 +93,11 @@ _DEFAULTS: Dict[str, Any] = {
     # Bound on actor __init__: a wedged-but-alive worker must fail the
     # creation (and reschedule) rather than park it forever.
     "actor_creation_timeout_s": 600.0,
+    # Per-RPC bound on one actor lease request to a raylet. Generous by
+    # default: the raylet's bounded spawn pipeline legitimately queues a
+    # grant behind hundreds of spawns in an actor storm; retries after
+    # this timeout coalesce onto the SAME in-flight grant raylet-side.
+    "actor_lease_rpc_timeout_s": 600.0,
     # --- tasks ---
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,

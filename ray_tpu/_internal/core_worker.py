@@ -1731,11 +1731,26 @@ class TaskExecutor:
                                                    self._cw.gcs)
             packed_args, packed_kwargs = self._load_args(spec)
             if spec.task_type == ACTOR_CREATION_TASK:
+                # _actor_id is set BEFORE __init__ runs so the guard
+                # covers the whole creation window (a second push
+                # arriving mid-__init__ must not slip past).
+                if self._actor_id is not None and \
+                        self._actor_id != spec.actor_id:
+                    # This worker ALREADY hosts a different actor: a
+                    # double-granted lease (scheduler bug or a stale
+                    # grant racing its release) tried to bind a second
+                    # actor here. Silently re-running __init__ would
+                    # cross-wire BOTH actors' handles onto one instance
+                    # — refuse instead; the scheduler re-places cleanly.
+                    raise RuntimeError(
+                        f"worker already hosts actor "
+                        f"{self._actor_id.hex()}; refusing creation of "
+                        f"{spec.actor_id.hex()} (double-granted lease)")
                 cls = self._cw.function_manager.load(spec.job_id,
                                                      spec.function)
                 self._setup_actor(spec)
-                self._actor_instance = cls(*packed_args, **packed_kwargs)
                 self._actor_id = spec.actor_id
+                self._actor_instance = cls(*packed_args, **packed_kwargs)
                 return {"returns": []}
             if spec.task_type == ACTOR_TASK:
                 if spec.method_name == "__rtpu_dag_exec__":

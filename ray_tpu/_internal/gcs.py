@@ -714,10 +714,21 @@ class GcsServer:
         strategy = spec.scheduling_strategy
         deadline = time.monotonic() + 1e9  # actors wait indefinitely
         backoff = 0.05
+        # After a lease-RPC timeout the grant is (very likely) still in
+        # flight on THAT raylet; the retry must return to the same node
+        # so the idempotency key can coalesce — re-picking would strand
+        # the original grant as a leaked leased worker.
+        pinned_node: Optional[str] = None
         while record.state not in ("DEAD",) and record.sched_epoch == epoch:
-            async with self.actor_sched_lock:
-                node_id = self._pick_node(demand, strategy,
-                                          spec.label_selector)
+            if pinned_node is not None and \
+                    getattr(self.nodes.get(pinned_node), "state",
+                            "DEAD") != "DEAD":
+                node_id = pinned_node
+            else:
+                pinned_node = None
+                async with self.actor_sched_lock:
+                    node_id = self._pick_node(demand, strategy,
+                                              spec.label_selector)
             if node_id is None:
                 await asyncio.sleep(min(backoff, 1.0))
                 backoff *= 1.6
@@ -740,19 +751,29 @@ class GcsServer:
                         if strategy.kind == "placement_group" else None,
                         "grant_or_reject": True,
                         "is_actor": True,
+                        # idempotency key: a lease retry after an RPC
+                        # timeout coalesces onto the original in-flight
+                        # grant raylet-side (one worker per attempt).
+                        # The epoch is part of the key: a RESTART (new
+                        # epoch) must get a FRESH worker, not the dead
+                        # incarnation's cached grant.
+                        "actor_id": f"{spec.actor_id.hex()}:{epoch}",
                         "job": spec.job_id.hex(),
                     },
-                    # Generous: the raylet's bounded spawn pipeline may
-                    # queue this grant behind hundreds of other spawns in
-                    # an actor storm; a dead raylet still fails fast via
-                    # the transport, and rejections are immediate.
-                    timeout=max(600.0, CONFIG.worker_start_timeout_s))
+                    # Generous default: the raylet's bounded spawn
+                    # pipeline may queue this grant behind hundreds of
+                    # other spawns in an actor storm; a dead raylet still
+                    # fails fast via the transport, and a timed-out retry
+                    # coalesces onto the same grant raylet-side.
+                    timeout=CONFIG.actor_lease_rpc_timeout_s)
             except Exception as e:
                 logger.warning("actor lease request to %s failed: %s",
                                node_id[:12], e)
+                pinned_node = node_id  # retry where the grant may live
                 await asyncio.sleep(backoff)
                 backoff *= 1.6
                 continue
+            pinned_node = None
             if reply.get("rejected"):
                 if reply.get("permanent"):
                     # deterministic env failure: creating again would fail
@@ -800,6 +821,24 @@ class GcsServer:
                     timeout=10))
                 return
             if result.get("error") is not None:
+                if "double-granted lease" in str(result["error"]):
+                    # The worker refused because it already hosts another
+                    # actor — a scheduling artifact, not a user failure:
+                    # dispose this grant and re-place WITHOUT consuming
+                    # the actor's restart budget.
+                    logger.warning(
+                        "actor %s creation hit a double-granted worker "
+                        "on %s; rescheduling", spec.actor_id.hex()[:12],
+                        node_id[:12])
+                    asyncio.ensure_future(raylet.call(
+                        "return_worker", lease_id=lease_id, dispose=True,
+                        timeout=10))
+                    if record.sched_epoch == epoch and \
+                            record.state != "DEAD":
+                        record.sched_epoch += 1
+                        asyncio.ensure_future(
+                            self._schedule_actor(record))
+                    return
                 record.state = "DEAD"
                 record.death_cause = f"creation failed: {result['error']}"
                 self._publish_actor(record)

@@ -116,6 +116,13 @@ class Raylet:
         self.spill_storage = storage_from_config()
         self.node_addresses: Dict[str, Address] = {}
         self._next_lease_id = 0
+        # Actor-lease idempotency (one grant per actor id): a caller
+        # whose lease RPC timed out retries while the ORIGINAL request is
+        # still queued behind the spawn pipeline — without coalescing,
+        # both requests eventually grant and two creation pushes land on
+        # two (or worse, one reused) worker(s), cross-wiring actors.
+        self._actor_lease_tasks: Dict[str, asyncio.Task] = {}
+        self._lease_actor_keys: Dict[int, str] = {}
         self._spawn_sem: Optional[asyncio.Semaphore] = None
         self._tasks: List[asyncio.Task] = []
         self._pulls: Dict[str, asyncio.Future] = {}
@@ -397,15 +404,20 @@ class Raylet:
             # line — the rest sit buffered while select watches an empty
             # fd, so a burst (a stack dump, a traceback) surfaces one
             # line per future write.
+            # selectors (epoll), NOT select(): select() rejects fds
+            # >= FD_SETSIZE (1024), which a 1,000-actor fleet exceeds —
+            # the pump then dies and that worker's logs vanish.
             import fcntl
-            import select
+            import selectors
             fd = stream.fileno()
             flags = fcntl.fcntl(fd, fcntl.F_GETFL)
             fcntl.fcntl(fd, fcntl.F_SETFL, flags | os.O_NONBLOCK)
+            sel = selectors.DefaultSelector()
+            sel.register(fd, selectors.EVENT_READ)
             pending = b""
             try:
                 while True:
-                    ready, _, _ = select.select([fd], [], [], 0.1)
+                    ready = sel.select(timeout=0.1)
                     if not ready:
                         flush()
                         continue
@@ -433,6 +445,7 @@ class Raylet:
                 logger.exception("worker log pump failed (pid %s)",
                                  proc.pid)
             finally:
+                sel.close()
                 if pending:
                     batch.append(pending.decode("utf-8", "replace"))
                 flush()
@@ -451,10 +464,15 @@ class Raylet:
             return {"exit": True}
         handle.address = tuple(address)
         handle.pid = pid
-        handle.state = "IDLE"
-        handle.last_idle = time.monotonic()
         if handle.registered and not handle.registered.done():
+            # A spawning lease request is awaiting THIS worker: hold it
+            # in STARTING so the idle-pool scans cannot steal it between
+            # registration and the spawner's resume — the stolen-worker
+            # interleaving leased one process to two actor creations.
             handle.registered.set_result(True)
+        else:
+            handle.state = "IDLE"
+            handle.last_idle = time.monotonic()
         return {"exit": False, "node_id": self.node_id,
                 "node_index": self.node_index}
 
@@ -604,6 +622,33 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def handle_request_worker_lease(self, spec_meta: Dict[str, Any]):
+        actor_key = spec_meta.get("actor_id") \
+            if spec_meta.get("is_actor") else None
+        if actor_key is None:
+            return await self._lease_request(spec_meta)
+        task = self._actor_lease_tasks.get(actor_key)
+        if task is None:
+            task = asyncio.ensure_future(self._lease_request(spec_meta))
+            self._actor_lease_tasks[actor_key] = task
+        try:
+            # shield: a retry RPC joining late must not cancel the shared
+            # in-flight grant when its own transport drops
+            reply = await asyncio.shield(task)
+        except Exception:
+            self._actor_lease_tasks.pop(actor_key, None)
+            raise
+        lease_id = reply.get("lease_id")
+        if lease_id is None:
+            # rejection/spillback: no lease to coalesce on — clear so a
+            # later attempt can try fresh
+            self._actor_lease_tasks.pop(actor_key, None)
+        else:
+            # cache the grant until the lease dies (_release_lease), so
+            # any further retry of this actor reuses the SAME worker
+            self._lease_actor_keys[lease_id] = actor_key
+        return reply
+
+    async def _lease_request(self, spec_meta: Dict[str, Any]):
         self._next_lease_id += 1
         req = LeaseRequest(
             lease_id=self._next_lease_id,
@@ -694,7 +739,8 @@ class Raylet:
         env_key = self._env_key(req.spec_meta.get("runtime_env", {}))
         handle = next(
             (w for w in self.workers.values()
-             if w.state == "IDLE" and w.env_key == env_key), None)
+             if w.state == "IDLE" and w.env_key == env_key
+             and not w.is_actor_worker), None)
         if handle is None:
             # Bounded spawn pipeline (reference: worker_pool.cc
             # maximum_startup_concurrency): a 1,000-actor burst must not
@@ -708,7 +754,8 @@ class Raylet:
                 # a worker may have gone idle while we queued
                 handle = next(
                     (w for w in self.workers.values()
-                     if w.state == "IDLE" and w.env_key == env_key), None)
+                     if w.state == "IDLE" and w.env_key == env_key
+                     and not w.is_actor_worker), None)
                 if handle is None:
                     handle = self._spawn_worker(env_key)
                     try:
@@ -746,6 +793,9 @@ class Raylet:
                 "worker_id": handle.worker_id, "node_id": self.node_id}
 
     def _release_lease(self, lease_id: int):
+        actor_key = self._lease_actor_keys.pop(lease_id, None)
+        if actor_key is not None:
+            self._actor_lease_tasks.pop(actor_key, None)
         entry = self.leases.pop(lease_id, None)
         if entry is None:
             return
@@ -754,9 +804,30 @@ class Raylet:
             self._refund(demand, pg)
         handle = self.workers.get(worker_id)
         if handle is not None and handle.state == "LEASED":
-            handle.state = "IDLE"
-            handle.lease_id = None
-            handle.last_idle = time.monotonic()
+            if handle.is_actor_worker:
+                # Actor workers are SINGLE-USE (reference: dedicated
+                # actor workers die with their actor): re-entering the
+                # IDLE pool while the instance lives would let a later
+                # creation bind a second actor onto this process and
+                # cross-wire both handles. Whatever released the lease,
+                # the process goes down with it — and the death is
+                # REPORTED, so if a live actor was bound here the GCS
+                # restarts or fails it instead of leaving its callers
+                # hanging on a dead address.
+                logger.info("disposing actor worker %s on lease %d "
+                            "release", handle.worker_id.hex()[:12],
+                            lease_id)
+                self._kill_worker(handle)
+                asyncio.ensure_future(self.clients.get(
+                    self.gcs_address).call(
+                        "report_worker_death", node_id=self.node_id,
+                        worker_id=handle.worker_id,
+                        cause="actor worker disposed on lease release",
+                        timeout=10))
+            else:
+                handle.state = "IDLE"
+                handle.lease_id = None
+                handle.last_idle = time.monotonic()
         self._pump_queue()
 
     def _pump_queue(self):

@@ -415,6 +415,56 @@ class Dataset:
         yield from _batches_from_blocks(blocks(), batch_size, batch_format,
                                         drop_last)
 
+    def take_batch(self, batch_size: int = 20,
+                   *, batch_format: str = "numpy"):
+        """First `batch_size` rows as one batch (reference:
+        Dataset.take_batch)."""
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format):
+            return batch
+        raise ValueError("dataset is empty")
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None,
+                           drop_last: bool = False) -> Iterator[Any]:
+        """numpy batches converted to torch tensors (reference:
+        Dataset.iter_torch_batches / iterator.py torch conversion);
+        dict batches convert per-column, `dtypes` optionally maps
+        column -> torch dtype (or one dtype for all)."""
+        import torch
+
+        def to_tensor(arr, key=None):
+            t = torch.as_tensor(arr)
+            if dtypes is None:
+                return t
+            want = dtypes.get(key) if isinstance(dtypes, dict) else dtypes
+            return t.to(want) if want is not None else t
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            if isinstance(batch, dict):
+                yield {k: to_tensor(v, k) for k, v in batch.items()}
+            else:
+                yield to_tensor(batch)
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        """Split by global row indices into len(indices)+1 datasets
+        (reference: Dataset.split_at_indices). Materializes rows once;
+        splits are in-memory datasets."""
+        if any(b < a for a, b in zip(indices, indices[1:])):
+            raise ValueError("indices must be sorted")
+        if indices and indices[0] < 0:
+            raise ValueError("indices must be non-negative")
+        rows = self.take_all()
+        bounds = [0] + list(indices) + [len(rows)]
+        out = []
+        for i, (lo, hi) in enumerate(zip(bounds, bounds[1:])):
+            part = rows[lo:max(lo, hi)]
+            ds = from_items_rows(part, name=f"{self._name}-splitidx{i}")
+            out.append(ds)
+        return out
+
     def split(self, n: int, *, locality_hints=None) -> List["Dataset"]:
         refs = self.repartition(n)._execute()
         out = []
@@ -504,6 +554,15 @@ class Dataset:
 
     def __repr__(self):
         return f"Dataset(name={self._name}, stages={len(self._stages)})"
+
+
+def from_items_rows(rows: List[Any], name: str = "from_rows") -> "Dataset":
+    """In-memory Dataset over already-materialized rows (one block)."""
+    import ray_tpu
+    ref = ray_tpu.put(_rows_to_block(list(rows)))
+    ds = Dataset(lambda: [ref], [], name=name)
+    ds._materialized = [ref]
+    return ds
 
 
 def _rows_to_block(rows: List[Any]) -> Block:

@@ -1,0 +1,52 @@
+"""Actor-identity integrity under a lease-RPC storm (the failure the
+1,000-actor FULL run exposed: a lease retry after an RPC timeout must
+coalesce onto the SAME in-flight grant — never produce a second grant
+whose creation push lands on a worker already hosting another actor).
+
+Storm conditions are reproduced at CI scale by shrinking the lease-RPC
+timeout and chaos-dropping a fraction of request_worker_lease replies:
+every dropped reply forces the GCS retry path that big fleets hit
+naturally."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._internal.config import CONFIG
+
+
+@pytest.mark.timeout_s(600)
+def test_actor_identity_under_lease_retry_storm(monkeypatch):
+    # 40% of lease replies vanish; the caller times out in 2s and
+    # retries. Spawns are real worker processes, so identity crossing
+    # (two creations on one worker) would surface as a wrong idx.
+    monkeypatch.setenv("RTPU_TESTING_RPC_FAILURE",
+                       "request_worker_lease:0:0.4")
+    CONFIG.apply_system_config({"actor_lease_rpc_timeout_s": 2.0})
+    try:
+        ray_tpu.init(num_cpus=8, object_store_memory=200 * 1024 * 1024)
+
+        @ray_tpu.remote(num_cpus=0.001)
+        class Probe:
+            def __init__(self, idx):
+                self.idx = idx
+
+            def whoami(self):
+                return (os.getpid(), self.idx)
+
+        N = 60
+        actors = [Probe.remote(i) for i in range(N)]
+        infos = ray_tpu.get([a.whoami.remote() for a in actors],
+                            timeout=500)
+        assert [idx for _pid, idx in infos] == list(range(N))
+        # every actor lives in its OWN process (no worker double-binding)
+        pids = [pid for pid, _ in infos]
+        assert len(set(pids)) == N, \
+            f"{N - len(set(pids))} worker processes host 2+ actors"
+        for a in actors:
+            ray_tpu.kill(a)
+    finally:
+        CONFIG.apply_system_config({"actor_lease_rpc_timeout_s": 600.0})
+        ray_tpu.shutdown()

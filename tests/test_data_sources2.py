@@ -80,3 +80,31 @@ def test_from_torch(data_cluster):
     ds = data.from_torch(Squares())
     rows = [r["item"] for r in ds.iter_rows()]
     assert rows == [i * i for i in range(10)]
+
+
+@pytest.mark.timeout_s(240)
+def test_take_batch_split_at_indices_iter_torch(data_cluster):
+    """take_batch / split_at_indices / iter_torch_batches (reference:
+    Dataset.take_batch, split_at_indices, iter_torch_batches)."""
+    import torch
+
+    ds = data.from_items([{"x": float(i), "y": i % 3} for i in range(30)])
+
+    batch = ds.take_batch(8)
+    assert batch["x"].shape == (8,) and batch["x"][3] == 3.0
+
+    parts = ds.split_at_indices([10, 25])
+    sizes = [p.count() for p in parts]
+    assert sizes == [10, 15, 5]
+    assert [r["x"] for r in parts[2].iter_rows()] == [25.0, 26.0, 27.0,
+                                                      28.0, 29.0]
+    with pytest.raises(ValueError):
+        ds.split_at_indices([20, 10])
+
+    got = list(ds.iter_torch_batches(batch_size=16,
+                                     dtypes={"x": torch.float64}))
+    assert all(isinstance(b["x"], torch.Tensor) for b in got)
+    assert got[0]["x"].dtype == torch.float64
+    assert got[0]["y"].dtype in (torch.int64, torch.int32)
+    total = sum(int(b["x"].shape[0]) for b in got)
+    assert total == 30
