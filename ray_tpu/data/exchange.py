@@ -26,6 +26,23 @@ def _concat_remote():
     return merge_parts
 
 
+def _hash_partition_remote(n_out: int, key: str):
+    """Remote fn splitting a block into n_out buckets by _stable_hash of
+    row[key] — the map phase every hash exchange shares."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
+    def hash_partition(block):
+        acc = BlockAccessor(block)
+        buckets: List[List] = [[] for _ in range(n_out)]
+        for row in acc.iter_rows():
+            buckets[_stable_hash(row[key]) % n_out].append(row)
+        parts = tuple(BlockAccessor.from_rows(b) for b in buckets)
+        return parts if n_out > 1 else parts[0]
+
+    return hash_partition
+
+
 def _split_remote(n_out: int):
     import ray_tpu
 
@@ -201,38 +218,14 @@ def sort_exchange(refs: List, key: Union[str, Callable],
 def groupby_exchange(refs: List, key: str, agg_fn: Callable,
                      agg_name: str, value_col: Optional[str]) -> List:
     """Hash-partition by key, then per-partition group + aggregate
-    (reference: execution/operators/hash_shuffle.py hash aggregate)."""
-    import ray_tpu
-    if not refs:
-        return refs
-    n_out = min(len(refs), 8)
+    (reference: execution/operators/hash_shuffle.py hash aggregate) —
+    the single-aggregation special case of map_groups_exchange."""
 
-    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
-    def hash_partition(block):
-        acc = BlockAccessor(block)
-        buckets: List[List] = [[] for _ in range(n_out)]
-        for row in acc.iter_rows():
-            buckets[_stable_hash(row[key]) % n_out].append(row)
-        return tuple(BlockAccessor.from_rows(b) for b in buckets)
+    def agg_group(rows):
+        values = [r[value_col] for r in rows] if value_col else rows
+        return {key: rows[0][key], agg_name: agg_fn(values)}
 
-    @ray_tpu.remote(num_cpus=1, max_retries=2)
-    def group_agg(*blocks):
-        groups = {}
-        for block in blocks:
-            for row in BlockAccessor(block).iter_rows():
-                groups.setdefault(row[key], []).append(row)
-        out = []
-        for k in sorted(groups, key=_sort_token):
-            rows = groups[k]
-            values = [r[value_col] for r in rows] if value_col else rows
-            out.append({key: k, agg_name: agg_fn(values)})
-        return BlockAccessor.from_rows(out)
-
-    parts = [hash_partition.remote(r) for r in refs]
-    if n_out == 1:
-        return [group_agg.remote(*parts)]
-    return [group_agg.remote(*[parts[i][j] for i in range(len(refs))])
-            for j in range(n_out)]
+    return map_groups_exchange(refs, key, agg_group)
 
 
 def hash_join_exchange(left_refs: List, right_refs: List, on: str,
@@ -250,15 +243,7 @@ def hash_join_exchange(left_refs: List, right_refs: List, on: str,
         num_partitions = max(1, min(max(len(left_refs), len(right_refs)),
                                     8))
     n_out = num_partitions
-
-    @ray_tpu.remote(num_cpus=1, max_retries=2, num_returns=n_out)
-    def hash_partition(block):
-        acc = BlockAccessor(block)
-        buckets: List[List] = [[] for _ in range(n_out)]
-        for row in acc.iter_rows():
-            buckets[_stable_hash(row[on]) % n_out].append(row)
-        parts = tuple(BlockAccessor.from_rows(b) for b in buckets)
-        return parts if n_out > 1 else parts[0]
+    hash_partition = _hash_partition_remote(n_out, on)
 
     @ray_tpu.remote(num_cpus=1, max_retries=2)
     def join_partition(n_left, *blocks):
@@ -455,3 +440,41 @@ def _sort_token(value):
     if isinstance(value, str):
         return (1, "str", value)
     return (2, type(value).__name__, repr(value))
+
+
+def map_groups_exchange(refs: List, key: str, fn: Callable) -> List:
+    """Distributed map_groups (reference: grouped_data.py map_groups —
+    one task per hash partition applies `fn(rows)` to each complete
+    group): hash-partition by key, then per-partition group + apply.
+    Same two-phase plan as the other exchanges; push-merge rounds bound
+    reduce fan-in for many input blocks."""
+    import ray_tpu
+    if not refs:
+        return refs
+    n_out = min(len(refs), 8)
+
+    hash_partition = _hash_partition_remote(n_out, key)
+
+    @ray_tpu.remote(num_cpus=1, max_retries=2)
+    def apply_groups(*blocks):
+        groups: dict = {}
+        for block in blocks:
+            for row in BlockAccessor(block).iter_rows():
+                groups.setdefault(row[key], []).append(row)
+        out_rows: List = []
+        for k in sorted(groups, key=_sort_token):
+            result = fn(groups[k])
+            out_rows.extend(result if isinstance(result, list)
+                            else [result])
+        return BlockAccessor.from_rows(out_rows)
+
+    parts = [hash_partition.remote(r) for r in refs]
+    if n_out == 1:
+        return [apply_groups.remote(*parts)]
+    merge_parts = _concat_remote()
+    factor = _merge_factor()
+    if factor and len(refs) > factor:
+        merged = push_merge_rounds(parts, n_out, merge_parts, factor)
+        return [apply_groups.remote(*merged[j]) for j in range(n_out)]
+    return [apply_groups.remote(*[parts[i][j] for i in range(len(refs))])
+            for j in range(n_out)]

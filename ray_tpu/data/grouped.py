@@ -3,17 +3,16 @@
 Aggregations run as a distributed hash exchange (hash-partition by key,
 per-partition group+agg tasks — reference: hash_shuffle.py's aggregate
 path) followed by a distributed sort on the key so output order is
-deterministic. Only `map_groups` still gathers rows in the driver (its
-output shape is user-defined and typically small).
+deterministic. `map_groups` runs the same hash exchange with one
+user-fn apply task per partition — every group lands whole in exactly
+one task.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, List
 
 import numpy as np
-
-from .block import BlockAccessor
 
 
 class GroupedData:
@@ -72,16 +71,17 @@ class GroupedData:
         return ds.sort(key)
 
     def map_groups(self, fn: Callable):
-        from .dataset import Dataset, _rows_to_block
-        groups: Dict[Any, List[Any]] = {}
-        for row in self._dataset.take_all():
-            groups.setdefault(row[self._key], []).append(row)
-        out_rows: List[Any] = []
-        for _, rows in sorted(groups.items(), key=lambda kv: str(kv[0])):
-            result = fn(rows)
-            out_rows.extend(result if isinstance(result, list) else [result])
+        """Apply `fn(rows) -> row | list[row]` to every COMPLETE group,
+        distributed (reference: grouped_data.py map_groups): rows
+        hash-partition by key so each group lands wholly in one task;
+        one apply task per partition. Output order: groups sorted
+        within a partition; partitions in hash order."""
+        from .exchange import map_groups_exchange
+        key = self._key
 
-        def source():
-            import ray_tpu
-            return [ray_tpu.put(_rows_to_block(out_rows))]
-        return Dataset(source, [], name="map_groups")
+        def plan_fn(refs: List) -> List:
+            return map_groups_exchange(refs, key, fn)
+
+        return self._dataset._with_stage(
+            ("allToAll", plan_fn, "map_groups"),
+            f"groupby({key}).map_groups")

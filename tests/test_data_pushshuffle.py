@@ -117,3 +117,40 @@ def test_push_aggregate_and_join_match_pull(data_cluster, strategy):
     assert push_agg == pull_agg
     key = lambda r: (r["k"], r.get("a"), r.get("b"))
     assert sorted(push_join, key=key) == sorted(pull_join, key=key)
+
+
+@pytest.mark.timeout_s(240)
+def test_map_groups_distributed(data_cluster, strategy):
+    """map_groups applies fn to COMPLETE groups inside partition tasks
+    (reference: grouped_data.py map_groups) — results match a local
+    pandas-style groupby-apply, in push and pull modes."""
+    ctx = strategy
+    rows = [{"k": i % 11, "v": i} for i in range(400)]
+
+    def summarize(group_rows):
+        vs = [r["v"] for r in group_rows]
+        return {"k": group_rows[0]["k"], "n": len(vs),
+                "total": sum(vs)}
+
+    expect = {}
+    for r in rows:
+        e = expect.setdefault(r["k"], {"k": r["k"], "n": 0, "total": 0})
+        e["n"] += 1
+        e["total"] += r["v"]
+
+    for mode in ("pull", "push"):
+        ctx.shuffle_strategy = mode
+        ctx.push_shuffle_merge_factor = 4
+        got = list(data.from_items(rows).repartition(16)
+                   .groupby("k").map_groups(summarize).iter_rows())
+        assert sorted(got, key=lambda r: r["k"]) == \
+            sorted(expect.values(), key=lambda r: r["k"]), mode
+
+    # fn may EXPAND a group into multiple rows
+    def explode(group_rows):
+        return [{"k": group_rows[0]["k"], "i": j}
+                for j in range(min(2, len(group_rows)))]
+
+    got = list(data.from_items(rows).repartition(8)
+               .groupby("k").map_groups(explode).iter_rows())
+    assert len(got) == 22  # 11 groups x 2 rows
