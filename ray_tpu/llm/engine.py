@@ -49,6 +49,10 @@ class GenerationRequest:
     max_new_tokens: int = 32
     request_id: str = ""
     temperature: Optional[float] = None
+    # 0/None = no k filter; 1.0/None = no nucleus filter (vLLM-style
+    # SamplingParams; applied inside the jitted decode, sampling.py)
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -84,17 +88,15 @@ class LLMEngine:
         model = self.model
 
         def decode_step(params, caches, tokens, positions, rng,
-                        temperature):
-            # tokens [B,1]; positions [B]; temperature [B] (per slot —
-            # requests with different sampling settings share one batch).
+                        temperature, top_k, top_p):
+            # tokens [B,1]; positions [B]; sampling params [B] (per slot
+            # — requests with different settings share one batch).
             logits, new_caches = model.apply(
                 {"params": params}, tokens, positions=positions[:, None],
                 kv_caches=caches, cache_index=positions)
             last = logits[:, -1, :].astype(jnp.float32)
-            greedy = jnp.argmax(last, axis=-1)
-            sampled = jax.random.categorical(
-                rng, last / jnp.maximum(temperature, 1e-6)[:, None])
-            out = jnp.where(temperature > 0, sampled, greedy)
+            from .sampling import sample_tokens
+            out = sample_tokens(rng, last, temperature, top_k, top_p)
             return out.astype(jnp.int32), new_caches
 
         self._decode = jax.jit(decode_step, donate_argnums=(1,))
@@ -215,6 +217,10 @@ class LLMEngine:
         if temp > 0:
             self._rng, key = jax.random.split(self._rng)
             scaled = last_logits / max(temp, 1e-6)
+            from .sampling import filter_logits
+            scaled = filter_logits(
+                scaled, top_k=getattr(request, "top_k", None) or 0,
+                top_p=getattr(request, "top_p", None))
             probs = np.exp(scaled - scaled.max())
             probs /= probs.sum()
             first_token = int(np.random.default_rng(
@@ -238,14 +244,21 @@ class LLMEngine:
         tokens = np.zeros((B, 1), np.int32)
         positions = np.zeros((B,), np.int32)
         temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
         for i in active:
+            req = self.slots[i].request
             tokens[i, 0] = self.slots[i].last_token
             positions[i] = self.slots[i].position
-            temps[i] = self._temp_of(self.slots[i].request)
+            temps[i] = self._temp_of(req)
+            top_ks[i] = req.top_k if getattr(req, "top_k", None) else 0
+            top_ps[i] = req.top_p if getattr(req, "top_p", None) \
+                is not None else 1.0
         self._rng, key = jax.random.split(self._rng)
         out, self.kv_caches = self._decode(
             self.params, self.kv_caches, jnp.asarray(tokens),
-            jnp.asarray(positions), key, jnp.asarray(temps))
+            jnp.asarray(positions), key, jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps))
         out = np.asarray(out)
         finished = []
         for i in active:

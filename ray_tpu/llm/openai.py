@@ -63,18 +63,22 @@ class OpenAIServer(LLMServer):
         prompt_tokens = self.tokenizer.encode(prompt_text)
         max_new = int(body.get("max_tokens", 16))
         temperature = body.get("temperature")
+        top_k = body.get("top_k")
+        top_p = body.get("top_p")
         request_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         if body.get("stream"):
             stream_id = await self.generate_stream_start(
                 prompt_tokens, max_new_tokens=max_new,
-                temperature=temperature, request_id=request_id)
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                request_id=request_id)
             self._sse[stream_id] = {
                 "chat": chat, "id": request_id,
                 "created": int(time.time()), "first": True}
             return {"__rtpu_stream__": stream_id}
         out = await self.generate(
             prompt_tokens, max_new_tokens=max_new,
-            temperature=temperature, request_id=request_id)
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            request_id=request_id)
         text = self.tokenizer.decode(out["tokens"])
         created = int(time.time())
         usage = {"prompt_tokens": len(prompt_tokens),

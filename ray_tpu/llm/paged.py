@@ -166,7 +166,7 @@ class PagedLLMEngine:
         page_sharding = self._page_sharding
 
         def decode_step(params, k_pages, v_pages, block_tables, lengths,
-                        tokens, rng, temperature):
+                        tokens, rng, temperature, top_k, top_p):
             caches = [
                 {"k": k_pages[i], "v": v_pages[i],
                  "block_tables": block_tables, "lengths": lengths}
@@ -176,10 +176,8 @@ class PagedLLMEngine:
                 {"params": params}, tokens, positions=lengths[:, None],
                 kv_caches=caches, cache_index=None)
             last = logits[:, -1, :].astype(jnp.float32)
-            greedy = jnp.argmax(last, axis=-1)
-            sampled = jax.random.categorical(
-                rng, last / jnp.maximum(temperature, 1e-6)[:, None])
-            out = jnp.where(temperature > 0, sampled, greedy)
+            from .sampling import sample_tokens
+            out = sample_tokens(rng, last, temperature, top_k, top_p)
             nk = [c["k"] for c in new_caches]
             nv = [c["v"] for c in new_caches]
             if page_sharding is not None:
@@ -504,6 +502,12 @@ class PagedLLMEngine:
         if temp > 0:
             self._rng, key = jax.random.split(self._rng)
             scaled = last_logits / max(temp, 1e-6)
+            # shared host-side filter (sampling.filter_logits) so the
+            # FIRST token honors the request's top_k/top_p too
+            from .sampling import filter_logits
+            scaled = filter_logits(
+                scaled, top_k=getattr(request, "top_k", None) or 0,
+                top_p=getattr(request, "top_p", None))
             probs = np.exp(scaled - scaled.max())
             probs /= probs.sum()
             first_token = int(np.random.default_rng(
@@ -562,6 +566,8 @@ class PagedLLMEngine:
         lengths = np.zeros((B,), np.int32)
         tokens = np.zeros((B, 1), np.int32)
         temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
         for i in active:
             seq = self.seqs[i]
             block_tables[i, :len(seq.pages)] = seq.pages
@@ -569,12 +575,17 @@ class PagedLLMEngine:
             tokens[i, 0] = seq.last_token
             temp = seq.request.temperature
             temps[i] = temp if temp is not None else cfg.temperature
+            req_k = getattr(seq.request, "top_k", None)
+            top_ks[i] = req_k if req_k else 0
+            req_p = getattr(seq.request, "top_p", None)
+            top_ps[i] = req_p if req_p is not None else 1.0
         self._rng, key = jax.random.split(self._rng)
         with self._mesh_scope():
             out, self.k_pages, self.v_pages = self._decode(
                 self.params, self.k_pages, self.v_pages,
                 jnp.asarray(block_tables), jnp.asarray(lengths),
-                jnp.asarray(tokens), key, jnp.asarray(temps))
+                jnp.asarray(tokens), key, jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps))
         out = np.asarray(out)
         for i in active:
             seq = self.seqs[i]

@@ -1,0 +1,79 @@
+"""Token sampling: temperature + top-k + nucleus (top-p), fully inside
+jit (reference role: vLLM's sampler — the reference delegates serving
+to vLLM, whose Sampler applies temperature/top_k/top_p per sequence;
+here the same contract as ONE vectorized XLA program over the batch).
+
+TPU notes: per-slot parameters arrive as [B] arrays so one compiled
+program serves heterogeneous requests (no per-request recompiles).
+The top-p mask needs a descending sort of the vocab — O(V log V) on
+rows of 32k is microseconds on the VPU next to the decode matmuls."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(rng, logits, temperature, top_k, top_p):
+    """One token per row.
+
+    logits: [B, V] float32. temperature/top_k/top_p: [B] — per slot:
+    temperature <= 0 means greedy (top_k/top_p ignored); top_k <= 0
+    disables the k filter; top_p >= 1 disables the nucleus filter.
+    Filters compose the standard way: restrict to the top-k set, then
+    to the smallest prefix of the (sorted) distribution whose mass
+    reaches top_p, renormalize implicitly via categorical."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    V = scaled.shape[-1]
+
+    # top-k threshold: value of the k-th largest entry (k<=0 -> -inf)
+    k = jnp.clip(top_k.astype(jnp.int32), 0, V)
+    k_idx = jnp.maximum(k - 1, 0)
+    k_thresh = jnp.take_along_axis(sorted_desc, k_idx[:, None],
+                                   axis=-1)[:, 0]
+    k_thresh = jnp.where(k > 0, k_thresh, NEG_INF)
+
+    # top-p threshold: smallest sorted value still inside the nucleus.
+    # A position belongs to the nucleus while the mass of STRICTLY
+    # higher-ranked tokens is < p (so the token crossing p is included,
+    # matching the usual implementation).
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum_before = jnp.cumsum(probs_sorted, axis=-1) - probs_sorted
+    # clip away from 0: cum_before[0] == 0 < p keeps the top token even
+    # for top_p=0 (every standard sampler keeps at least one token)
+    in_nucleus = cum_before < jnp.clip(top_p, 1e-6, 1.0)[:, None]
+    p_thresh = jnp.min(jnp.where(in_nucleus, sorted_desc, jnp.inf),
+                       axis=-1)
+    p_thresh = jnp.where(top_p >= 1.0, NEG_INF, p_thresh)
+
+    thresh = jnp.maximum(k_thresh, p_thresh)
+    masked = jnp.where(scaled >= thresh[:, None], scaled, NEG_INF)
+    sampled = jax.random.categorical(rng, masked)
+    return jnp.where(temperature > 0, sampled, greedy)
+
+
+def filter_logits(logits, top_k=0, top_p=None):
+    """Host-side (numpy) mirror of sample_tokens' top-k/top-p filters —
+    the single implementation both engines' prefill first-token sampling
+    uses, so host and jit paths stay in lockstep. top_p <= 0 keeps the
+    top token (never an empty nucleus)."""
+    import numpy as np
+    scaled = np.asarray(logits, np.float64)
+    sorted_desc = np.sort(scaled)[::-1]
+    thresh = -np.inf
+    if top_k and top_k > 0:
+        thresh = max(thresh,
+                     sorted_desc[min(int(top_k), len(sorted_desc)) - 1])
+    if top_p is not None and top_p < 1.0:
+        p = max(float(top_p), 1e-6)
+        sp = np.exp(sorted_desc - sorted_desc.max())
+        sp /= sp.sum()
+        cum_before = np.cumsum(sp) - sp
+        nucleus = sorted_desc[cum_before < p]  # cum_before[0]=0 < p
+        thresh = max(thresh, nucleus[-1])
+    return np.where(scaled >= thresh, scaled, -1e30)

@@ -149,6 +149,8 @@ class LLMServer:
     async def generate(self, prompt_tokens: List[int],
                        max_new_tokens: int = 32,
                        temperature: Optional[float] = None,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None,
                        request_id: Optional[str] = None) -> Dict[str, Any]:
         from .engine import GenerationRequest
         loop = asyncio.get_running_loop()
@@ -169,7 +171,7 @@ class LLMServer:
         request = GenerationRequest(
             prompt_tokens=list(prompt_tokens),
             max_new_tokens=max_new_tokens,
-            temperature=temperature,
+            temperature=temperature, top_k=top_k, top_p=top_p,
             request_id=request_id or uuid.uuid4().hex)
         await self._submit(request, on_done)
         tokens = await future
@@ -182,6 +184,8 @@ class LLMServer:
     async def generate_stream_start(
             self, prompt_tokens: List[int], max_new_tokens: int = 32,
             temperature: Optional[float] = None,
+            top_k: Optional[int] = None,
+            top_p: Optional[float] = None,
             request_id: Optional[str] = None) -> str:
         """Begin a streamed generation; returns a stream id the caller
         polls with `stream_next` (the proxy relays it as chunked HTTP)."""
@@ -211,7 +215,8 @@ class LLMServer:
         request = GenerationRequest(
             prompt_tokens=list(prompt_tokens),
             max_new_tokens=max_new_tokens,
-            temperature=temperature, request_id=request_id)
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            request_id=request_id)
         await self._submit(request, on_done, token_callback=on_token)
         return stream_id
 

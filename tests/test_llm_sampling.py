@@ -1,0 +1,109 @@
+"""Temperature / top-k / nucleus sampling (reference role: vLLM's
+Sampler — SamplingParams temperature/top_k/top_p applied per sequence;
+here one vectorized jitted program, llm/sampling.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.llm.sampling import sample_tokens
+
+
+def _sample_many(logits_row, temperature, top_k, top_p, n=400):
+    logits = jnp.asarray(np.tile(logits_row, (n, 1)), jnp.float32)
+    B = logits.shape[0]
+    out = sample_tokens(
+        jax.random.PRNGKey(0), logits,
+        jnp.full((B,), temperature, jnp.float32),
+        jnp.full((B,), top_k, jnp.int32),
+        jnp.full((B,), top_p, jnp.float32))
+    return np.asarray(out)
+
+
+def test_greedy_and_top_k_one():
+    row = np.asarray([1.0, 3.0, 2.0, -1.0])
+    # temperature 0 = greedy regardless of filters
+    assert set(_sample_many(row, 0.0, 0, 1.0)) == {1}
+    # top_k=1 at ANY temperature is greedy
+    assert set(_sample_many(row, 5.0, 1, 1.0)) == {1}
+
+
+def test_top_k_restricts_support():
+    row = np.asarray([1.0, 3.0, 2.0, 0.5, -1.0])
+    drawn = set(_sample_many(row, 2.0, 2, 1.0))
+    assert drawn <= {1, 2} and len(drawn) == 2  # both top-2 appear
+
+
+def test_top_p_nucleus():
+    # probs ~ [0.64, 0.23, 0.086, ...]: p=0.5 keeps only the top token
+    # (it crosses the 0.5 mass alone); p=0.8 keeps the top two.
+    row = np.asarray([4.0, 3.0, 2.0, 1.0, 0.0])
+    assert set(_sample_many(row, 1.0, 0, 0.5)) == {0}
+    drawn = set(_sample_many(row, 1.0, 0, 0.8))
+    assert drawn <= {0, 1} and len(drawn) == 2
+    # p>=1 disables the filter: the tail can appear at high temperature
+    drawn_all = set(_sample_many(row, 50.0, 0, 1.0))
+    assert len(drawn_all) >= 4
+
+
+def test_per_row_params_are_independent():
+    row = np.asarray([1.0, 3.0, 2.0, -1.0])
+    logits = jnp.asarray(np.tile(row, (3, 1)), jnp.float32)
+    out = np.asarray(sample_tokens(
+        jax.random.PRNGKey(1), logits,
+        jnp.asarray([0.0, 8.0, 8.0], jnp.float32),   # greedy | hot | hot
+        jnp.asarray([0, 1, 0], jnp.int32),           # - | k=1 | off
+        jnp.asarray([1.0, 1.0, 1.0], jnp.float32)))
+    assert out[0] == 1 and out[1] == 1  # greedy rows pinned
+
+
+@pytest.mark.timeout_s(300)
+def test_paged_engine_top_k_one_matches_greedy():
+    """End-to-end: the paged engine with temperature>0 but top_k=1 must
+    reproduce the greedy generation exactly."""
+    import dataclasses
+
+    from ray_tpu.llm.engine import GenerationRequest
+    from ray_tpu.llm.paged import PagedEngineConfig, PagedLLMEngine
+    from ray_tpu.models import LlamaConfig
+
+    cfg = PagedEngineConfig(
+        model=dataclasses.replace(LlamaConfig.tiny_test(),
+                                  dtype=jnp.float32),
+        max_batch=2, max_len=64, page_size=8, num_pages=64)
+    engine = PagedLLMEngine(cfg)
+    prompt = [3, 14, 15, 9, 2, 6]
+    done = {}
+
+    def on_done(request, tokens):
+        done[request.request_id] = tokens
+
+    engine.submit(GenerationRequest(prompt_tokens=prompt,
+                                    max_new_tokens=12,
+                                    request_id="greedy"),
+                  done_callback=on_done)
+    engine.submit(GenerationRequest(prompt_tokens=prompt,
+                                    max_new_tokens=12,
+                                    temperature=3.0, top_k=1,
+                                    request_id="hot-k1"),
+                  done_callback=on_done)
+    for _ in range(60):
+        if not engine.has_work():
+            break
+        engine.step()
+    assert set(done) == {"greedy", "hot-k1"}
+    assert list(done["greedy"]) == list(done["hot-k1"])
+
+
+def test_top_p_zero_keeps_top_token():
+    """top_p<=0 must behave like top-1, never crash or go uniform —
+    both in the jitted sampler and the host-side filter."""
+    from ray_tpu.llm.sampling import filter_logits
+
+    row = np.asarray([1.0, 3.0, 2.0, -1.0])
+    assert set(_sample_many(row, 2.0, 0, 0.0)) == {1}
+    filtered = filter_logits(row, top_k=0, top_p=0.0)
+    assert np.argmax(filtered) == 1
+    assert np.sum(filtered > -1e29) == 1
