@@ -313,9 +313,14 @@ class TaskEventBuffer:
          ts, extra) = item
         wid = self._worker_hex
         if wid is _UNSET:
-            wid = self._worker_hex = (
-                self._cw.worker_id.hex()
-                if isinstance(self._cw.worker_id, bytes) else None)
+            # Cache ONLY once the worker id is real bytes: the first
+            # flush can precede worker-id assignment, and caching the
+            # None would strip worker attribution from every timeline
+            # event this process ever emits.
+            if isinstance(self._cw.worker_id, bytes):
+                wid = self._worker_hex = self._cw.worker_id.hex()
+            else:
+                wid = None
         if len(self._hex_cache) > 4096:
             self._hex_cache.clear()
         jid = self._hex_cache.get(job_id)
@@ -614,17 +619,28 @@ class NormalTaskSubmitter:
             return
         try:
             await self._resolve_dependencies(spec)
+            # timed AFTER dependency resolution: the histogram measures
+            # scheduling latency (queueing + raylet round trips), not
+            # however long an upstream task takes to produce its result
+            submit_t = time.monotonic()
             lease = await self._acquire_lease(spec)
         except Exception as e:
             self._cw.task_manager.on_failed(spec, e, is_application_error=False)
             return
         if lease is None:
             return  # cancelled while queued; returns already resolved
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        metrics.lease_wait.observe(time.monotonic() - submit_t)
+        metrics.pending_tasks.set(self._cw.task_manager.num_pending(),
+                                  tags={"pid": str(os.getpid())})
+        self._cw.task_events.record(spec, "LEASED", node_id=lease.node_id)
         if self._cw.task_manager._take_cancelled(spec.task_id):
             self._return_lease(lease.key, lease)
             return
         worker = self._cw.clients.get(lease.worker_address)
         self._running[spec.task_id] = lease
+        push_t = time.monotonic()
         try:
             # No deadline on execution itself (tasks run arbitrarily
             # long), but a LOST push/reply must not pin lease.inflight
@@ -642,6 +658,7 @@ class NormalTaskSubmitter:
             return
         finally:
             self._running.pop(spec.task_id, None)
+        metrics.push_roundtrip.observe(time.monotonic() - push_t)
         self._return_lease(lease.key, lease)
         error = reply.get("error")
         if error is not None:
@@ -649,6 +666,10 @@ class NormalTaskSubmitter:
                 spec, error, is_application_error=True)
         else:
             self._cw.task_manager.on_completed(spec, reply)
+        # refresh at completion too, or an idle driver's gauge freezes
+        # at the last lease-time reading (>= 1) forever
+        metrics.pending_tasks.set(self._cw.task_manager.num_pending(),
+                                  tags={"pid": str(os.getpid())})
 
     async def _push_with_probe(self, worker, spec: TaskSpec,
                                lease: Lease) -> Dict[str, Any]:
@@ -676,6 +697,8 @@ class NormalTaskSubmitter:
         except asyncio.CancelledError:
             # the sweeper cancelled us with a verdict
             if ps.recovered is not None:
+                from .runtime_metrics import runtime_metrics
+                runtime_metrics().push_recovered.inc()
                 return ps.recovered
             if ps.crashed is not None:
                 raise WorkerCrashedError(ps.crashed) from None
@@ -1722,6 +1745,12 @@ class TaskExecutor:
         from ..util.tracing import set_trace_context
         set_trace_context(tuple(spec.trace_context)
                           if spec.trace_context is not None else None)
+        # A traced call gets an execution span of its own: the worker-side
+        # child of the submitting span, so get_trace() sees the process
+        # hop even when the task body opens no spans itself. Recorded
+        # out-of-band — user code still inherits the CALLER's context.
+        span_start = time.time() if spec.trace_context is not None \
+            else None
         try:
             if spec.task_type == ACTOR_TASK \
                     and spec.method_name == "__rtpu_terminate__":
@@ -1771,6 +1800,12 @@ class TaskExecutor:
                                        spec.method_name,
                                        traceback.format_exc(), cause=e)}
         finally:
+            if span_start is not None:
+                from ..util.tracing import record_child_span
+                record_child_span(
+                    "task:" + (spec.name or spec.method_name
+                               or spec.function.display_name()),
+                    tuple(spec.trace_context), span_start, time.time())
             RUNTIME_CTX.task_spec = None
             RUNTIME_CTX.actor_id = None
             self._running_sync.discard(spec.task_id)
@@ -1796,6 +1831,7 @@ class TaskExecutor:
         return cached
 
     async def _run_task_async(self, spec: TaskSpec) -> Dict[str, Any]:
+        span_start = None
         try:
             if spec.method_name == "__rtpu_cancelled__":
                 return {"cancelled": True}
@@ -1804,6 +1840,8 @@ class TaskExecutor:
             from ..util.tracing import set_trace_context
             set_trace_context(tuple(spec.trace_context)
                               if spec.trace_context is not None else None)
+            if spec.trace_context is not None:
+                span_start = time.time()
             # Small ref-free args deserialize in microseconds — the
             # executor hop costs more than it saves. Offload only when
             # an arg must be fetched (blocking get) or the bundle is big.
@@ -1844,6 +1882,13 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001
             return {"error": TaskError(spec.method_name,
                                        traceback.format_exc(), cause=e)}
+        finally:
+            if span_start is not None:
+                from ..util.tracing import record_child_span
+                record_child_span(
+                    "task:" + (spec.name or spec.method_name
+                               or spec.function.display_name()),
+                    tuple(spec.trace_context), span_start, time.time())
 
     def _setup_actor(self, spec: TaskSpec):
         # adopt the creating job: background asyncio work this actor
@@ -2045,6 +2090,8 @@ class CoreWorker:
     def put_serialized_to_plasma(self, oid: ObjectID,
                                  sobj: serialization.SerializedObject,
                                  owner: Optional[Address]):
+        from .runtime_metrics import runtime_metrics
+        runtime_metrics().store_put_bytes.inc(sobj.total_bytes())
         self.plasma.put_serialized(oid, sobj)
         raylet = self.clients.get(self.raylet_address)
         raylet.call_sync("seal_object", object_hex=oid.hex(),
@@ -2308,6 +2355,8 @@ class CoreWorker:
         push_key = (spec.task_id, spec.attempt_number)
         cached = self._completed_push_replies.get(push_key)
         if cached is not None:
+            from .runtime_metrics import runtime_metrics
+            runtime_metrics().push_duplicates.inc()
             return cached
         # known to this worker from arrival until WELL AFTER the reply —
         # the owner's push probe distinguishes a slow task from a lost

@@ -163,8 +163,15 @@ class Raylet:
 
     async def _heartbeat_loop(self):
         gcs = self.clients.get(self.gcs_address)
+        next_metrics_flush = 0.0
         while not self._stopped:
             try:
+                self._update_metrics()
+                now = time.monotonic()
+                if now >= next_metrics_flush:
+                    next_metrics_flush = now + \
+                        CONFIG.metrics_report_interval_s
+                    self._flush_metrics(gcs)
                 reply = await gcs.call(
                     "heartbeat", node_id=self.node_id,
                     resources_available=self.resources.available.to_dict(),
@@ -187,6 +194,31 @@ class Raylet:
             except Exception:
                 pass
             await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+
+    def _update_metrics(self):
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        tags = {"node": str(self.node_index)}
+        metrics.raylet_lease_queue.set(len(self.queued), tags=tags)
+        metrics.raylet_store_bytes.set(self.store_used, tags=tags)
+        metrics.raylet_workers.set(len(self.workers), tags=tags)
+
+    def _flush_metrics(self, gcs):
+        """Push this process's registry into the metrics KV. Standalone
+        raylet processes have no CoreWorker (whose flusher would do it);
+        in local mode the driver's flusher owns the shared registry, so
+        flushing here too would double-count counters after the merge."""
+        from .core_worker import try_get_core_worker
+        if try_get_core_worker() is not None:
+            return
+        from ..util.metrics import METRICS_KV_NS, snapshot_all_json
+        fut = asyncio.ensure_future(gcs.call(
+            "kv_put", ns=METRICS_KV_NS, key=f"raylet-{self.node_id}",
+            value=snapshot_all_json(), overwrite=True, timeout=10))
+        # best-effort: consume a failed flush (GCS briefly unreachable)
+        # instead of spamming "Task exception was never retrieved"
+        fut.add_done_callback(
+            lambda f: f.cancelled() or f.exception())
 
     def _update_view(self, vd: Dict[str, Any]):
         """Merge a versioned view delta (stable cluster => empty payload;
@@ -635,13 +667,19 @@ class Raylet:
             # in-flight grant when its own transport drops
             reply = await asyncio.shield(task)
         except Exception:
-            self._actor_lease_tasks.pop(actor_key, None)
+            # Guard the pop: a LATE-waking awaiter of a finished (failed)
+            # task must not evict the NEWER in-flight task a fresh retry
+            # already installed under this key — popping it would let two
+            # concurrent grants coalesce onto nothing and double-lease.
+            if self._actor_lease_tasks.get(actor_key) is task:
+                self._actor_lease_tasks.pop(actor_key, None)
             raise
         lease_id = reply.get("lease_id")
         if lease_id is None:
             # rejection/spillback: no lease to coalesce on — clear so a
-            # later attempt can try fresh
-            self._actor_lease_tasks.pop(actor_key, None)
+            # later attempt can try fresh (same late-waker guard as above)
+            if self._actor_lease_tasks.get(actor_key) is task:
+                self._actor_lease_tasks.pop(actor_key, None)
         else:
             # cache the grant until the lease dies (_release_lease), so
             # any further retry of this actor reuses the SAME worker
@@ -786,6 +824,9 @@ class Raylet:
         handle.lease_id = req.lease_id
         handle.is_actor_worker = bool(req.spec_meta.get("is_actor"))
         handle.job_hex = req.spec_meta.get("job")
+        from .runtime_metrics import runtime_metrics
+        runtime_metrics().raylet_leases_granted.inc(
+            tags={"node": str(self.node_index)})
         self.leases[req.lease_id] = (
             handle.worker_id, req.demand, None if charge_node else req.pg)
         return {"rejected": False, "lease_id": req.lease_id,

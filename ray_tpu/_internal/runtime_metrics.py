@@ -1,0 +1,71 @@
+"""Core-runtime metric series (reference: src/ray/stats/metric_defs.cc —
+the scheduler/object-store/task series the C++ stats layer exports).
+
+Lazy singleton so importing core_worker/raylet has no side effects; the
+first observation registers the series and starts the process's metrics
+flusher. Every observation is a local dict update under an uncontended
+lock — cheap enough for the submit hot path."""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from ..util.metrics import LazyMetrics
+
+_LATENCY_BOUNDARIES = [
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+
+
+def _build() -> SimpleNamespace:
+    from ..util.metrics import Counter, Gauge, Histogram
+    return SimpleNamespace(
+        lease_wait=Histogram(
+            "rtpu_task_lease_wait_seconds",
+            "Normal-task submit to lease grant (queueing + "
+            "raylet round trips)",
+            boundaries=_LATENCY_BOUNDARIES),
+        push_roundtrip=Histogram(
+            "rtpu_task_push_roundtrip_seconds",
+            "Task push to reply on the leased worker "
+            "(includes execution)",
+            boundaries=_LATENCY_BOUNDARIES),
+        # pid tag: per-process gauge — the cross-process
+        # merge is last-write-wins per tag tuple, so an
+        # untagged gauge would show one arbitrary driver's
+        # backlog for the whole cluster
+        pending_tasks=Gauge(
+            "rtpu_tasks_pending",
+            "Tasks pending in this process's TaskManager",
+            tag_keys=("pid",)),
+        store_put_bytes=Counter(
+            "rtpu_object_store_put_bytes_total",
+            "Bytes sealed into plasma by this process"),
+        push_duplicates=Counter(
+            "rtpu_push_duplicate_replies_total",
+            "Duplicate task pushes answered from the "
+            "completed-reply cache (re-execution avoided)"),
+        push_recovered=Counter(
+            "rtpu_push_reply_recovered_total",
+            "Lost push replies recovered via the probe "
+            "channel"),
+        raylet_lease_queue=Gauge(
+            "rtpu_raylet_lease_queue_depth",
+            "Lease requests queued at the raylet",
+            tag_keys=("node",)),
+        raylet_leases_granted=Counter(
+            "rtpu_raylet_leases_granted_total",
+            "Worker leases granted by the raylet",
+            tag_keys=("node",)),
+        raylet_store_bytes=Gauge(
+            "rtpu_raylet_object_store_bytes",
+            "Bytes resident in the raylet's object store",
+            tag_keys=("node",)),
+        raylet_workers=Gauge(
+            "rtpu_raylet_workers",
+            "Worker processes in the raylet's pool",
+            tag_keys=("node",)),
+    )
+
+
+runtime_metrics = LazyMetrics(_build)

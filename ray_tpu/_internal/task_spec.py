@@ -223,14 +223,30 @@ _conda_key_cache: dict = {}
 
 def _conda_entry(conda) -> "Tuple":
     key = repr(conda)
-    entry = _conda_key_cache.get(key)
-    if entry is None:
-        from .runtime_env import parse_conda_spec
-        name, deps = parse_conda_spec(conda)
-        entry = ("env", name) if name else ("deps",) + tuple(deps)
-        if len(_conda_key_cache) > 256:
-            _conda_key_cache.clear()
-        _conda_key_cache[key] = entry
+    stat_key = None
+    if isinstance(conda, str) and conda.endswith((".yml", ".yaml")):
+        # Path-based specs: repr(path) alone would pin the FIRST parse
+        # forever — an edited environment file must produce a new env
+        # key. Cache entries are keyed by path and validated against the
+        # file's mtime/size (cheap stat per submission), so an edit
+        # REPLACES the stale entry instead of leaking it.
+        import os
+        try:
+            stat = os.stat(conda)
+            stat_key = (stat.st_mtime_ns, stat.st_size)
+        except OSError:
+            stat_key = ("missing",)
+        cached = _conda_key_cache.get(key)
+        if cached is not None and cached[0] == stat_key:
+            return cached[1]
+    elif key in _conda_key_cache:
+        return _conda_key_cache[key][1]
+    from .runtime_env import parse_conda_spec
+    name, deps = parse_conda_spec(conda)
+    entry = ("env", name) if name else ("deps",) + tuple(deps)
+    if len(_conda_key_cache) > 256:
+        _conda_key_cache.clear()
+    _conda_key_cache[key] = (stat_key, entry)
     return entry
 
 

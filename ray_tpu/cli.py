@@ -11,6 +11,7 @@ Usage: python -m ray_tpu.cli <command> ...
   status   [--address ...]                               cluster resources
   list     {nodes,actors,tasks,placement_groups,objects,workers,jobs}
   timeline [--output FILE]                               chrome trace
+  trace    [TRACE_ID] [--json]                           span tree / list
   dashboard                                              start + print URL
   submit   [--wait] -- ENTRYPOINT...                     submit a job
   job      {logs,stop,list} [ID]
@@ -211,6 +212,43 @@ def cmd_timeline(args):
     print(f"wrote {len(trace)} spans to {args.output}")
 
 
+def cmd_trace(args):
+    """Print one trace's span tree (or list recent traces with no id)."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    if not args.trace_id:
+        rows = st.list_traces(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=1, default=str))
+            return
+        for row in rows:
+            print(f"{row['trace_id']}  {row['name'] or '?':24s} "
+                  f"spans={row['num_spans']} "
+                  f"procs={row['num_processes']} "
+                  f"dur={row['duration_s']:.3f}s")
+        if not rows:
+            print("no traces recorded")
+        return
+    tree = st.get_trace(args.trace_id)
+    if args.json:
+        print(json.dumps(tree, indent=1, default=str))
+        return
+    if not tree["num_spans"]:
+        print(f"no spans recorded for trace {args.trace_id}")
+        raise SystemExit(1)
+    print(f"trace {tree['trace_id']}: {tree['num_spans']} spans across "
+          f"{tree['num_processes']} processes")
+
+    def _render(node, depth):
+        print(f"{'  ' * depth}- {node['name']}  "
+              f"[{node['duration_s'] * 1e3:.1f}ms pid={node['pid']} "
+              f"span={node['span_id'][:8]}]")
+        for child in node["children"]:
+            _render(child, depth + 1)
+    for root in tree["roots"]:
+        _render(root, 0)
+
+
 def cmd_dashboard(args):
     _connect(args)
     from ray_tpu.dashboard import start_dashboard
@@ -311,6 +349,13 @@ def main(argv=None):
     p.add_argument("--output", default="timeline.json")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("trace")
+    p.add_argument("trace_id", nargs="?")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--limit", type=int, default=20)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("dashboard")
     p.add_argument("--address")

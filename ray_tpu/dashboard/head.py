@@ -12,6 +12,8 @@ Routes:
   GET  /api/jobs/<id>/logs            {"logs": ...}
   POST /api/jobs/<id>/stop
   GET  /api/timeline                  chrome-trace JSON of task spans
+  GET  /api/traces                    recorded trace summaries
+  GET  /api/traces/<trace_id>         one trace's span tree
   GET  /metrics                       Prometheus exposition
   GET  /-/healthz
   GET  /                              web frontend (single-page app,
@@ -172,6 +174,15 @@ class DashboardHead:
             return self._json(st.list_workers())
         if path == "/api/timeline":
             return self._json(st.timeline())
+        if path == "/api/traces":
+            return self._json(st.list_traces(
+                limit=int(query.get("limit", 100))))
+        trace_match = re.fullmatch(r"/api/traces/([0-9a-f]+)", path)
+        if trace_match:
+            tree = st.get_trace(trace_match.group(1))
+            if not tree["num_spans"]:
+                return self._json({"error": "no such trace"}, 404)
+            return self._json(tree)
         if path == "/api/profile":
             return self._route_profile(query)
 

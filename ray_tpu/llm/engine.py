@@ -29,7 +29,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os
+
 from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
+from ._metrics import llm_metrics
+
+_TAGS = {"engine": "slot"}
+# gauges are per-process series (see _metrics.py on the merge semantics)
+_GAUGE_TAGS = {"engine": "slot", "pid": str(os.getpid())}
 
 
 @dataclasses.dataclass
@@ -135,7 +142,10 @@ class LLMEngine:
                 f"prompt of {n} tokens exceeds the largest prefill bucket "
                 f"{self.config.prefill_buckets[-1]}")
         request._done_callback = done_callback  # type: ignore[attr-defined]
+        request._submit_ts = time.monotonic()  # type: ignore[attr-defined]
         self._pending.put(request)
+        llm_metrics().queue_depth.set(self._pending.qsize(),
+                                      tags=_GAUGE_TAGS)
 
     def has_work(self) -> bool:
         return (not self._pending.empty()) or \
@@ -149,12 +159,16 @@ class LLMEngine:
             if slot.request is None:
                 continue
             request, slot.request = slot.request, None
+            llm_metrics().requests_finished.inc(
+                tags=dict(_TAGS, outcome="error"))
             callback = getattr(request, "_done_callback", None)
             if callback is not None:
                 callback(request, error)
         try:
             while True:
                 request = self._pending.get_nowait()
+                llm_metrics().requests_finished.inc(
+                    tags=dict(_TAGS, outcome="error"))
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, error)
@@ -174,6 +188,11 @@ class LLMEngine:
         if active:
             finished.extend(self._decode_tick(active))
         self._steps += 1
+        metrics = llm_metrics()
+        metrics.queue_depth.set(self._pending.qsize(), tags=_GAUGE_TAGS)
+        metrics.running.set(
+            sum(1 for s in self.slots if s.request is not None),
+            tags=_GAUGE_TAGS)
         return finished
 
     def _admit(self):
@@ -190,6 +209,8 @@ class LLMEngine:
                 # A bad request must neither kill the engine loop nor
                 # strand its submitter: deliver the error via the
                 # callback (tokens slot carries the exception).
+                llm_metrics().requests_finished.inc(
+                    tags=dict(_TAGS, outcome="error"))
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, e)
@@ -234,12 +255,18 @@ class LLMEngine:
         slot.generated = [first_token]
         slot.last_token = first_token
         self._tokens_generated += 1
+        metrics = llm_metrics()
+        metrics.prefill_tokens.inc(len(prompt), tags=_TAGS)
+        submit_ts = getattr(request, "_submit_ts", None)
+        if submit_ts is not None:
+            metrics.ttft.observe(time.monotonic() - submit_ts, tags=_TAGS)
 
     def _temp_of(self, request: GenerationRequest) -> float:
         return request.temperature if request.temperature is not None \
             else self.config.temperature
 
     def _decode_tick(self, active: List[int]):
+        tick_start = time.monotonic()
         B = self.config.max_batch
         tokens = np.zeros((B, 1), np.int32)
         positions = np.zeros((B,), np.int32)
@@ -279,6 +306,17 @@ class LLMEngine:
                 if callback is not None:
                     callback(request, list(slot.generated))
                 self.slots[i] = _Slot()
+        metrics = llm_metrics()
+        metrics.token_latency.observe(time.monotonic() - tick_start,
+                                      tags=_TAGS)
+        metrics.decode_tokens.inc(len(active), tags=_TAGS)
+        for request, _tokens in finished:
+            metrics.requests_finished.inc(
+                tags=dict(_TAGS, outcome="done"))
+            submit_ts = getattr(request, "_submit_ts", None)
+            if submit_ts is not None:
+                metrics.request_latency.observe(
+                    time.monotonic() - submit_ts, tags=_TAGS)
         return finished
 
     # -- conveniences ------------------------------------------------------

@@ -45,13 +45,21 @@ class OpenAIServer(LLMServer):
 
     async def __call__(self, http_request):
         path = http_request.path
+        from ._metrics import llm_metrics
+
+        def _count(route: str):
+            llm_metrics().openai_requests.inc(tags={"route": route})
+
         if path.endswith("/v1/models"):
+            _count("/v1/models")
             return {"object": "list",
                     "data": [{"id": self.model_id, "object": "model",
                               "owned_by": "ray_tpu"}]}
         if path.endswith("/v1/completions"):
+            _count("/v1/completions")
             return await self._completions(http_request.json(), chat=False)
         if path.endswith("/v1/chat/completions"):
+            _count("/v1/chat/completions")
             return await self._completions(http_request.json(), chat=True)
         return (404, {"error": f"no route {path}"})
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import os
 import queue
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -24,7 +25,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
+from ._metrics import llm_metrics
 from .engine import GenerationRequest
+
+_TAGS = {"engine": "paged"}
+# gauges are per-process series (see _metrics.py on the merge semantics)
+_GAUGE_TAGS = {"engine": "paged", "pid": str(os.getpid())}
 
 
 @dataclasses.dataclass
@@ -266,7 +272,10 @@ class PagedLLMEngine:
             raise ValueError("prompt longer than max_len")
         request._done_callback = done_callback  # type: ignore
         request._token_callback = token_callback  # type: ignore
+        request._submit_ts = time.monotonic()  # type: ignore
         self._pending.put(request)
+        llm_metrics().queue_depth.set(self._pending.qsize(),
+                                      tags=_GAUGE_TAGS)
 
     def submit_prefilled(self, request: GenerationRequest, dense_caches,
                          last_logits,
@@ -282,7 +291,10 @@ class PagedLLMEngine:
             raise ValueError("prompt longer than max_len")
         request._done_callback = done_callback  # type: ignore
         request._token_callback = token_callback  # type: ignore
+        request._submit_ts = time.monotonic()  # type: ignore
         self._pending.put((request, dense_caches, last_logits))
+        llm_metrics().queue_depth.set(self._pending.qsize(),
+                                      tags=_GAUGE_TAGS)
 
     def cancel(self, request_id: str) -> bool:
         """Abort a request: frees its slot+pages on the next tick if
@@ -309,6 +321,8 @@ class PagedLLMEngine:
             self._pending.put(r)
         if dropped is not None:
             # queued cancellations must still resolve their waiters
+            llm_metrics().requests_finished.inc(
+                tags=dict(_TAGS, outcome="cancelled"))
             callback = getattr(dropped, "_done_callback", None)
             if callback is not None:
                 callback(dropped, None)  # None = cancelled
@@ -328,6 +342,8 @@ class PagedLLMEngine:
             request = seq.request
             self._release(seq)
             self.seqs[i] = _Seq()
+            llm_metrics().requests_finished.inc(
+                tags=dict(_TAGS, outcome="error"))
             callback = getattr(request, "_done_callback", None)
             if callback is not None:
                 callback(request, error)
@@ -335,6 +351,8 @@ class PagedLLMEngine:
             while True:
                 entry = self._pending.get_nowait()
                 r = entry[0] if isinstance(entry, tuple) else entry
+                llm_metrics().requests_finished.inc(
+                    tags=dict(_TAGS, outcome="error"))
                 callback = getattr(r, "_done_callback", None)
                 if callback is not None:
                     callback(r, error)
@@ -351,6 +369,14 @@ class PagedLLMEngine:
         if active:
             finished.extend(self._decode_tick(active))
         self._steps += 1
+        metrics = llm_metrics()
+        metrics.queue_depth.set(self._pending.qsize(), tags=_GAUGE_TAGS)
+        metrics.running.set(
+            sum(1 for s in self.seqs if s.request is not None),
+            tags=_GAUGE_TAGS)
+        metrics.kv_utilization.set(
+            1.0 - self.pool.num_free() / max(1, self.config.num_pages),
+            tags=_GAUGE_TAGS)
         return finished
 
     def _pages_needed(self, request: GenerationRequest) -> int:
@@ -382,6 +408,8 @@ class PagedLLMEngine:
                 else:
                     self._prefill_into(index, request)
             except Exception as e:  # noqa: BLE001
+                llm_metrics().requests_finished.inc(
+                    tags=dict(_TAGS, outcome="error"))
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, e)
@@ -525,6 +553,11 @@ class PagedLLMEngine:
         seq.cancelled = False
         self._by_id[request.request_id] = seq
         self._tokens_generated += 1
+        metrics = llm_metrics()
+        metrics.prefill_tokens.inc(len(prompt), tags=_TAGS)
+        submit_ts = getattr(request, "_submit_ts", None)
+        if submit_ts is not None:
+            metrics.ttft.observe(time.monotonic() - submit_ts, tags=_TAGS)
         self._emit_token(seq, first_token)
 
     def _evict_prefixes(self, max_entries: int = 128):
@@ -546,6 +579,7 @@ class PagedLLMEngine:
         self._by_id.pop(seq.request.request_id, None)
 
     def _decode_tick(self, active: List[int]):
+        tick_start = time.monotonic()
         cfg = self.config
         B = cfg.max_batch
         # cancelled sequences release before the step
@@ -557,6 +591,8 @@ class PagedLLMEngine:
                 self._release(seq)
                 self.seqs[i] = _Seq()
                 active.remove(i)
+                llm_metrics().requests_finished.inc(
+                    tags=dict(_TAGS, outcome="cancelled"))
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, None)  # None = cancelled
@@ -608,6 +644,16 @@ class PagedLLMEngine:
                     callback(request, list(seq.generated))
                 self._release(seq)
                 self.seqs[i] = _Seq()
+        metrics = llm_metrics()
+        metrics.token_latency.observe(time.monotonic() - tick_start,
+                                      tags=_TAGS)
+        metrics.decode_tokens.inc(len(active), tags=_TAGS)
+        for request, _tokens in finished:
+            metrics.requests_finished.inc(tags=dict(_TAGS, outcome="done"))
+            submit_ts = getattr(request, "_submit_ts", None)
+            if submit_ts is not None:
+                metrics.request_latency.observe(
+                    time.monotonic() - submit_ts, tags=_TAGS)
         return finished
 
     # -- conveniences ------------------------------------------------------

@@ -173,8 +173,17 @@ class LLMServer:
             max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
             request_id=request_id or uuid.uuid4().hex)
+        from ._metrics import llm_metrics
         await self._submit(request, on_done)
-        tokens = await future
+        try:
+            tokens = await future
+        except Exception:
+            llm_metrics().server_requests.inc(
+                tags={"entry": "generate", "outcome": "error"})
+            raise
+        llm_metrics().server_requests.inc(
+            tags={"entry": "generate",
+                  "outcome": "cancelled" if tokens is None else "ok"})
         if tokens is None:
             return {"tokens": [], "num_generated": 0, "cancelled": True}
         return {"tokens": tokens, "num_generated": len(tokens)}
@@ -206,8 +215,18 @@ class LLMServer:
 
         def on_done(request, tokens):
             def _finish():
+                # outcome counted at COMPLETION, not submit — a stream
+                # that errors or is cancelled must not read as "ok"
+                from ._metrics import llm_metrics
                 if isinstance(tokens, Exception):
                     stream.error = str(tokens)
+                    outcome = "error"
+                elif tokens is None:
+                    outcome = "cancelled"
+                else:
+                    outcome = "ok"
+                llm_metrics().server_requests.inc(
+                    tags={"entry": "stream", "outcome": outcome})
                 stream.done = True
                 stream.event.set()
             loop.call_soon_threadsafe(_finish)

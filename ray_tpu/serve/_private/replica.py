@@ -11,9 +11,34 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import time
 from typing import Any, Dict, Optional
 
+from ...util.metrics import LazyMetrics
+
 logger = logging.getLogger(__name__)
+
+def _build_metrics():
+    from types import SimpleNamespace
+
+    from ...util.metrics import Counter, Gauge, Histogram
+    return SimpleNamespace(
+        latency=Histogram(
+            "rtpu_serve_replica_latency_seconds",
+            "Replica-side request handling latency",
+            tag_keys=("deployment",)),
+        requests=Counter(
+            "rtpu_serve_replica_requests_total",
+            "Requests handled by the replica, by outcome",
+            tag_keys=("deployment", "outcome")),
+        ongoing=Gauge(
+            "rtpu_serve_replica_ongoing",
+            "Requests currently executing on the replica",
+            tag_keys=("deployment", "replica")),
+    )
+
+
+_replica_metrics = LazyMetrics(_build_metrics)
 
 
 class Replica:
@@ -71,15 +96,28 @@ class Replica:
         if model_id is not None:
             _set_current_model_id(model_id)
         self._ongoing += 1
+        metrics = _replica_metrics()
+        tags = {"deployment": self.deployment_name}
+        metrics.ongoing.set(
+            self._ongoing,
+            tags=dict(tags, replica=self.replica_tag))
+        start = time.monotonic()
+        outcome = "error"
         try:
             target = self._resolve(method_name)
             out = target(*args, **kwargs)
             if inspect.isawaitable(out):
                 out = await out
             self._total_served += 1
+            outcome = "ok"
             return out
         finally:
             self._ongoing -= 1
+            metrics.latency.observe(time.monotonic() - start, tags=tags)
+            metrics.requests.inc(tags=dict(tags, outcome=outcome))
+            metrics.ongoing.set(
+                self._ongoing,
+                tags=dict(tags, replica=self.replica_tag))
 
     async def handle_request_streaming(self, method_name: Optional[str],
                                        args: tuple, kwargs: dict):
@@ -87,6 +125,12 @@ class Replica:
         num_returns='streaming'). The user target must return a (sync or
         async) generator."""
         self._ongoing += 1
+        metrics = _replica_metrics()
+        tags = {"deployment": self.deployment_name}
+        metrics.ongoing.set(
+            self._ongoing, tags=dict(tags, replica=self.replica_tag))
+        start = time.monotonic()
+        outcome = "error"
         try:
             target = self._resolve(method_name)
             out = target(*args, **kwargs)
@@ -99,8 +143,13 @@ class Replica:
                 for item in out:
                     yield item
             self._total_served += 1
+            outcome = "ok"
         finally:
             self._ongoing -= 1
+            metrics.latency.observe(time.monotonic() - start, tags=tags)
+            metrics.requests.inc(tags=dict(tags, outcome=outcome))
+            metrics.ongoing.set(
+                self._ongoing, tags=dict(tags, replica=self.replica_tag))
 
     def _resolve(self, method_name: Optional[str]):
         if self._is_function:

@@ -11,12 +11,36 @@ same signal without an extra RPC per request)."""
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
 from typing import Dict, List, Optional
 
+from ...util.metrics import LazyMetrics
 from .common import SERVE_NAMESPACE, ReplicaInfo
+
+
+def _build_metrics():
+    from types import SimpleNamespace
+
+    from ...util.metrics import Counter, Gauge
+    return SimpleNamespace(
+        routed=Counter(
+            "rtpu_serve_router_requests_total",
+            "Requests dispatched through the serve router",
+            tag_keys=("deployment",)),
+        # pid tag: the driver handle and the HTTP proxy each run their
+        # own router — per-process gauges must not shadow each other in
+        # the last-write-wins cross-process merge
+        inflight=Gauge(
+            "rtpu_serve_replica_inflight",
+            "Router-tracked in-flight requests per replica",
+            tag_keys=("deployment", "replica", "pid")),
+    )
+
+
+_router_metrics = LazyMetrics(_build_metrics)
 
 
 class PowerOfTwoChoicesRouter:
@@ -170,16 +194,30 @@ class PowerOfTwoChoicesRouter:
         return _Tracked(self, info.actor_name, handle)
 
     def _inc(self, actor_name: str):
+        metrics = _router_metrics()
+        # gauge set INSIDE the lock: two interleaved updates publishing
+        # out of order would pin a stale inflight value until the next
+        # request happens to hit this replica
         with self._lock:
-            self._inflight[actor_name] = self._inflight.get(actor_name, 0) + 1
+            n = self._inflight[actor_name] = \
+                self._inflight.get(actor_name, 0) + 1
+            metrics.inflight.set(
+                n, tags={"deployment": self._key, "replica": actor_name,
+                         "pid": str(os.getpid())})
+        metrics.routed.inc(tags={"deployment": self._key})
 
     def _dec(self, actor_name: str):
+        metrics = _router_metrics()
         with self._lock:
             n = self._inflight.get(actor_name, 1)
             if n <= 1:
+                n = 0
                 self._inflight.pop(actor_name, None)
             else:
-                self._inflight[actor_name] = n - 1
+                n = self._inflight[actor_name] = n - 1
+            metrics.inflight.set(
+                n, tags={"deployment": self._key, "replica": actor_name,
+                         "pid": str(os.getpid())})
 
     def evict(self, actor_name: str):
         """Drop a replica that failed a call; force refresh next choose."""

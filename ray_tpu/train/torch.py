@@ -48,11 +48,35 @@ def _wrap_torch_loop(user_loop: Callable, torch_config: TorchConfig):
         rank, world = ctx.get_world_rank(), ctx.get_world_size()
         addr = None
         if rank == 0:
+            # Advertise the worker's ROUTABLE address: on a multi-node
+            # group the other ranks must reach rank 0's TCPStore, and
+            # 127.0.0.1 only resolves to it when every rank shares this
+            # host. The worker's own rpc server binds loopback, so the
+            # routable address is discovered as the egress interface
+            # toward the GCS (UDP connect — no packet sent); a local
+            # cluster's GCS is itself loopback, so this degrades to
+            # 127.0.0.1 exactly when every rank shares the host.
+            host = "127.0.0.1"
+            try:
+                from .._internal.core_worker import try_get_core_worker
+                core_worker = try_get_core_worker()
+                if core_worker is not None:
+                    gcs_host, gcs_port = core_worker.gcs.address
+                    probe = socket.socket(socket.AF_INET,
+                                          socket.SOCK_DGRAM)
+                    try:
+                        probe.connect((gcs_host, gcs_port or 80))
+                        host = probe.getsockname()[0]
+                    finally:
+                        probe.close()
+            except Exception:  # noqa: BLE001 — rendezvous must not die
+                pass
             sock = socket.socket()
-            sock.bind(("127.0.0.1", 0))
+            # bind all interfaces so remote ranks connect via `host`
+            sock.bind(("", 0))
             port = sock.getsockname()[1]
             sock.close()  # gloo's TCPStore rebinds it immediately
-            addr = f"127.0.0.1:{port}"
+            addr = f"{host}:{port}"
         addr = broadcast_from_rank_zero(addr, name="torch-rendezvous")
         dist.init_process_group(
             torch_config.backend, init_method=f"tcp://{addr}",

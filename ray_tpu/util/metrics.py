@@ -56,8 +56,15 @@ class Metric:
         return tuple(merged.get(k, "") for k in self._tag_keys)
 
     def snapshot(self) -> Dict[str, Any]:
+        # Series are [tag_values, value] PAIRS, not a joined-string dict:
+        # ",".join corrupted any tag value containing a comma (the
+        # exposition side split it back apart at the wrong places).
         with self._lock:
-            series = {",".join(k): v for k, v in self._series.items()}
+            series = [
+                [list(k),
+                 dict(v, buckets=list(v["buckets"]))
+                 if isinstance(v, dict) else v]
+                for k, v in self._series.items()]
         return {"name": self._name, "kind": self.kind,
                 "description": self._description,
                 "tag_keys": list(self._tag_keys), "series": series}
@@ -112,6 +119,28 @@ class Histogram(Metric):
             state["count"] += 1
 
 
+class LazyMetrics:
+    """Lazy, thread-safe metric-namespace singleton: `LazyMetrics(build)`
+    calls `build()` exactly once, on first use. Rationale: importing an
+    instrumented module must not register series (or start the flusher
+    thread) in processes that never observe anything — and a racing
+    double construction would re-register the metrics, evicting the
+    first objects from the registry and silently dropping whatever they
+    had already recorded."""
+
+    def __init__(self, build):
+        self._build = build
+        self._lock = threading.Lock()
+        self._ns = None
+
+    def __call__(self):
+        if self._ns is None:
+            with self._lock:
+                if self._ns is None:
+                    self._ns = self._build()
+        return self._ns
+
+
 # ---------------------------------------------------------------------------
 # export plumbing
 # ---------------------------------------------------------------------------
@@ -130,24 +159,44 @@ def _ensure_flusher():
     t.start()
 
 
-def _flush_loop():
+def snapshot_all() -> List[Dict[str, Any]]:
+    """Snapshots of every metric registered in THIS process."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    return [m.snapshot() for m in metrics]
+
+
+def snapshot_all_json() -> bytes:
     import json
-    from .._internal.config import CONFIG
-    while True:
-        time.sleep(CONFIG.metrics_report_interval_s)
-        try:
+    return json.dumps(snapshot_all()).encode()
+
+
+def flush_now(gcs=None, key: Optional[str] = None) -> bool:
+    """Synchronously push this process's snapshots into the GCS KV
+    (what the background flusher does every metrics_report_interval_s).
+    Must be called from a user thread, not the io loop. Returns False
+    when no GCS is reachable — observability is best-effort."""
+    try:
+        if gcs is None or key is None:
             from .._internal.core_worker import try_get_core_worker
             worker = try_get_core_worker()
             if worker is None:
-                continue
-            with _registry_lock:
-                metrics = list(_registry.values())
-            payload = json.dumps([m.snapshot() for m in metrics])
-            wid = worker.worker_id.hex() if isinstance(
-                worker.worker_id, bytes) else str(worker.worker_id)
-            worker.gcs.put(METRICS_KV_NS, wid, payload.encode())
-        except Exception:  # noqa: BLE001 — observability is best-effort
-            pass
+                return False
+            gcs = gcs or worker.gcs
+            if key is None:
+                key = worker.worker_id.hex() if isinstance(
+                    worker.worker_id, bytes) else str(worker.worker_id)
+        gcs.put(METRICS_KV_NS, key, snapshot_all_json())
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _flush_loop():
+    from .._internal.config import CONFIG
+    while True:
+        time.sleep(CONFIG.metrics_report_interval_s)
+        flush_now()
 
 
 def collect_cluster_metrics(gcs) -> List[Dict[str, Any]]:
@@ -164,35 +213,94 @@ def collect_cluster_metrics(gcs) -> List[Dict[str, Any]]:
     return out
 
 
+def _escape_label_value(value: Any) -> str:
+    """Prometheus exposition escaping for label values: backslash,
+    double-quote, and newline must be escaped or the series line is
+    corrupt/unparseable."""
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _iter_series(snap: Dict[str, Any]):
+    """Yield (tag_values_tuple, value) from a snapshot. Supports the
+    current pair-list form and the legacy joined-string dict form (old
+    KV payloads may outlive a process upgrade within a session)."""
+    series = snap.get("series") or []
+    if isinstance(series, dict):  # legacy ",".join keys
+        keys = snap.get("tag_keys") or []
+        for tag_str, value in series.items():
+            yield (tuple(tag_str.split(",")) if keys else (), value)
+    else:
+        for tags, value in series:
+            yield tuple(tags), value
+
+
+def _merge_series(snaps: List[Dict[str, Any]], kind: str):
+    """Fold one metric's series from every process into one value per
+    tag tuple: counters SUM (each process counts its own events), gauges
+    last-write-wins, histograms merge bucket/sum/count when boundaries
+    agree. Without this, two processes emitting the same series produce
+    duplicate sample lines — invalid exposition that scrapers reject."""
+    merged: Dict[Tuple, Any] = {}
+    for snap in snaps:
+        for tags, value in _iter_series(snap):
+            have = merged.get(tags)
+            if have is None:
+                merged[tags] = value
+            elif kind == "counter":
+                merged[tags] = have + value
+            elif kind == "histogram":
+                # mismatched boundaries (mixed process versions): keep
+                # the first series rather than merging incompatibly
+                if have.get("boundaries") == value.get("boundaries"):
+                    merged[tags] = {
+                        "boundaries": have["boundaries"],
+                        "buckets": [a + b for a, b in
+                                    zip(have["buckets"], value["buckets"])],
+                        "sum": have["sum"] + value["sum"],
+                        "count": have["count"] + value["count"],
+                    }
+            else:  # gauge/untyped: last snapshot wins
+                merged[tags] = value
+    return merged
+
+
 def prometheus_text(snapshots: List[Dict[str, Any]]) -> str:
-    """Merge snapshots into Prometheus exposition format."""
+    """Merge per-process snapshots into one Prometheus text exposition:
+    stable # HELP/# TYPE per metric, escaped label values, cross-process
+    series merging, and empty metrics (e.g. a histogram declared but
+    never observed) rendered as their metadata lines alone."""
     by_name: Dict[str, List[Dict[str, Any]]] = {}
     for snap in snapshots:
         by_name.setdefault(snap["name"], []).append(snap)
     lines = []
     for name, snaps in sorted(by_name.items()):
         first = snaps[0]
-        if first["description"]:
-            lines.append(f"# HELP {name} {first['description']}")
         kind = first["kind"]
-        lines.append(f"# TYPE {name} "
-                     f"{kind if kind != 'histogram' else 'histogram'}")
-        for snap in snaps:
-            keys = snap["tag_keys"]
-            for tag_str, value in snap["series"].items():
-                tags = tag_str.split(",") if keys else []
-                label = ",".join(f'{k}="{v}"' for k, v in zip(keys, tags))
-                label = "{" + label + "}" if label else ""
-                if kind == "histogram":
-                    cum = 0
-                    bounds = value["boundaries"] + ["+Inf"]
-                    for b, n in zip(bounds, value["buckets"]):
-                        cum += n
-                        extra = (label[:-1] + "," if label else "{") + \
-                            f'le="{b}"' + "}"
-                        lines.append(f"{name}_bucket{extra} {cum}")
-                    lines.append(f"{name}_sum{label} {value['sum']}")
-                    lines.append(f"{name}_count{label} {value['count']}")
-                else:
-                    lines.append(f"{name}{label} {value}")
+        if first["description"]:
+            desc = first["description"].replace("\\", "\\\\") \
+                .replace("\n", "\\n")
+            lines.append(f"# HELP {name} {desc}")
+        lines.append(f"# TYPE {name} {kind}")
+        keys = first["tag_keys"]
+        merged = _merge_series(snaps, kind)
+        for tags in sorted(merged):
+            value = merged[tags]
+            label = ",".join(
+                f'{k}="{_escape_label_value(v)}"'
+                for k, v in zip(keys, tags))
+            label = "{" + label + "}" if label else ""
+            if kind == "histogram":
+                cum = 0
+                bounds = value.get("boundaries", []) + ["+Inf"]
+                for b, n in zip(bounds, value.get("buckets", [])):
+                    cum += n
+                    extra = (label[:-1] + "," if label else "{") + \
+                        f'le="{b}"' + "}"
+                    lines.append(f"{name}_bucket{extra} {cum}")
+                lines.append(f"{name}_sum{label} {value.get('sum', 0.0)}")
+                lines.append(f"{name}_count{label} "
+                             f"{value.get('count', 0)}")
+            else:
+                lines.append(f"{name}{label} {value}")
     return "\n".join(lines) + "\n"

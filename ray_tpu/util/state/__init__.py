@@ -1,12 +1,13 @@
 """State API (reference: python/ray/util/state — api.py list_actors/
 list_tasks/list_objects/list_nodes/..., common.py state schemas)."""
 
-from .api import (get_actor, get_node, list_actors, list_jobs, list_nodes,
-                  list_objects, list_placement_groups, list_tasks,
-                  list_workers, summarize_tasks, timeline)
+from .api import (get_actor, get_node, get_trace, list_actors, list_jobs,
+                  list_nodes, list_objects, list_placement_groups,
+                  list_tasks, list_traces, list_workers, summarize_tasks,
+                  timeline)
 
 __all__ = [
-    "get_actor", "get_node", "list_actors", "list_jobs", "list_nodes",
-    "list_objects", "list_placement_groups", "list_tasks", "list_workers",
-    "summarize_tasks", "timeline",
+    "get_actor", "get_node", "get_trace", "list_actors", "list_jobs",
+    "list_nodes", "list_objects", "list_placement_groups", "list_tasks",
+    "list_traces", "list_workers", "summarize_tasks", "timeline",
 ]

@@ -110,31 +110,52 @@ def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:
     return out[:limit]
 
 
+def _fetch_events(job_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    return _gcs().call_sync("get_task_events", job_id=job_id,
+                            limit=100_000)
+
+
 def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
-               detail: bool = False) -> List[Dict[str, Any]]:
+               detail: bool = False,
+               _events: Optional[List[Dict[str, Any]]] = None
+               ) -> List[Dict[str, Any]]:
     """Task rows folded from the task-event stream: one row per
-    (task_id, attempt) with its latest state + timings."""
-    events = _gcs().call_sync("get_task_events", job_id=job_id,
-                              limit=100_000)
+    (task_id, attempt) with its latest state + phase timings
+    (SUBMITTED→LEASED→RUNNING→FINISHED/FAILED)."""
+    events = _events if _events is not None else _fetch_events(job_id)
     rows: Dict[tuple, Dict[str, Any]] = {}
     for ev in events:
+        if ev.get("task_id") is None:
+            continue  # SPAN events share the stream; see get_trace()
         key = (ev["task_id"], ev.get("attempt", 0))
         row = rows.setdefault(key, {
             "task_id": ev["task_id"], "attempt": ev.get("attempt", 0),
             "name": ev.get("name"), "job_id": ev.get("job_id"),
             "type": ev.get("type"), "actor_id": ev.get("actor_id"),
-            "state": None, "submitted_at": None, "started_at": None,
-            "finished_at": None, "error": None, "node_index": None,
-            "pid": None,
+            "state": None, "submitted_at": None, "leased_at": None,
+            "started_at": None, "finished_at": None, "error": None,
+            "node_index": None, "node_id": None, "pid": None,
+            "worker_id": None, "phases": {},
         })
         kind = ev["event"]
+        if kind != "SPAN":
+            # keyed by kind, ordered later by timestamp: owner- and
+            # worker-side buffers flush independently, so arrival order
+            # is NOT causal order (FINISHED can land before RUNNING)
+            row["phases"][kind] = ev["ts"]
         if kind == "SUBMITTED":
             row["submitted_at"] = ev["ts"]
             row["state"] = row["state"] or "PENDING"
+        elif kind == "LEASED":
+            row["leased_at"] = ev["ts"]
+            row["node_id"] = ev.get("node_id")
+            if row["state"] in (None, "PENDING"):
+                row["state"] = "LEASED"
         elif kind == "RUNNING":
             row["started_at"] = ev["ts"]
             row["pid"] = ev.get("pid")
             row["node_index"] = ev.get("node_index")
+            row["worker_id"] = ev.get("worker_id")
             if row["state"] not in ("FINISHED", "FAILED"):
                 row["state"] = "RUNNING"
         elif kind == "FINISHED":
@@ -144,7 +165,13 @@ def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
             row["finished_at"] = ev["ts"]
             row["state"] = "FAILED"
             row["error"] = ev.get("error")
+    _phase_rank = {"SUBMITTED": 0, "LEASED": 1, "RUNNING": 2,
+                   "FINISHED": 3, "FAILED": 3}
     out = list(rows.values())
+    for row in out:
+        row["phases"] = [k for k in sorted(
+            row["phases"],
+            key=lambda k: (row["phases"][k], _phase_rank.get(k, 9)))]
     out.sort(key=lambda r: r.get("submitted_at") or 0)
     return out[-limit:]
 
@@ -167,26 +194,144 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 
 def timeline(filename: Optional[str] = None,
              job_id: Optional[str] = None) -> List[Dict[str, Any]]:
-    """Chrome-trace ('catapult') export of task execution spans
+    """Chrome-trace ('catapult') export of the task lifecycle
     (reference: ray.timeline → _private/state.py chrome_tracing_dump).
-    Load the output in chrome://tracing or Perfetto."""
+    Per-worker rows carry the execution slice plus its queue/lease
+    phases, and user `trace_span` spans render as their own rows — load
+    the output in chrome://tracing or Perfetto."""
+    # ONE event fetch serves both the task fold and the span rows (the
+    # stream caps at 100k dicts — fetching it twice doubled the
+    # dashboard hot path's serialization cost).
+    events = _fetch_events(job_id)
     trace = []
-    for row in list_tasks(job_id=job_id, limit=100_000):
-        if row["started_at"] is None:
+    for row in list_tasks(job_id=job_id, limit=100_000, _events=events):
+        args = {"task_id": row["task_id"], "state": row["state"],
+                "attempt": row["attempt"], "phases": row["phases"],
+                "worker_id": row["worker_id"]}
+        submitted = row["submitted_at"]
+        leased = row["leased_at"]
+        started = row["started_at"]
+        # Pre-execution phases live on the owner's lease-queue row (the
+        # task has no worker yet).
+        if submitted is not None:
+            queue_end = leased or started
+            if queue_end is not None:
+                trace.append({
+                    "name": f"{row['name']} [queued]",
+                    "cat": "task_phase", "ph": "X",
+                    "ts": submitted * 1e6,
+                    "dur": max(0.0, (queue_end - submitted) * 1e6),
+                    "pid": "owner", "tid": "lease-queue", "args": args,
+                })
+        if leased is not None and started is not None:
+            trace.append({
+                "name": f"{row['name']} [leased]",
+                "cat": "task_phase", "ph": "X",
+                "ts": leased * 1e6,
+                "dur": max(0.0, (started - leased) * 1e6),
+                "pid": "owner", "tid": "lease-wait", "args": args,
+            })
+        if started is None:
             continue
-        end = row["finished_at"] or row["started_at"]
+        end = row["finished_at"] or started
         trace.append({
             "name": row["name"],
             "cat": "task" if row["type"] != 2 else "actor_task",
             "ph": "X",
-            "ts": row["started_at"] * 1e6,
-            "dur": max(0.0, (end - row["started_at"]) * 1e6),
+            "ts": started * 1e6,
+            "dur": max(0.0, (end - started) * 1e6),
             "pid": f"node{row['node_index']}",
             "tid": f"worker-pid-{row['pid']}",
-            "args": {"task_id": row["task_id"], "state": row["state"],
-                     "attempt": row["attempt"]},
+            "args": args,
+        })
+    for ev in _span_events(events=events):
+        trace.append({
+            "name": ev.get("name"),
+            "cat": "span", "ph": "X",
+            "ts": ev["ts"] * 1e6,
+            "dur": max(0.0, ev.get("duration_s", 0.0) * 1e6),
+            "pid": f"pid-{ev.get('pid')}",
+            "tid": f"trace-{(ev.get('trace_id') or '')[:8]}",
+            "args": {"trace_id": ev.get("trace_id"),
+                     "span_id": ev.get("span_id"),
+                     "parent_span_id": ev.get("parent_span_id")},
         })
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+# ---------------------------------------------------------------------------
+# trace assembly (cross-process span trees)
+# ---------------------------------------------------------------------------
+
+def _span_events(trace_id: Optional[str] = None,
+                 job_id: Optional[str] = None,
+                 events: Optional[List[Dict[str, Any]]] = None
+                 ) -> List[Dict[str, Any]]:
+    if events is None:
+        events = _fetch_events(job_id)
+    out = []
+    for ev in events:
+        if ev.get("event") != "SPAN":
+            continue
+        if trace_id is not None and ev.get("trace_id") != trace_id:
+            continue
+        out.append(ev)
+    return out
+
+
+def list_traces(limit: int = 100) -> List[Dict[str, Any]]:
+    """Summaries of recently recorded traces, newest first."""
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in _span_events():
+        if ev.get("trace_id"):
+            by_trace.setdefault(ev["trace_id"], []).append(ev)
+    out = []
+    for trace_id, spans in by_trace.items():
+        spans.sort(key=lambda e: e.get("ts", 0))
+        root = next((s for s in spans if not s.get("parent_span_id")),
+                    spans[0])
+        start = spans[0].get("ts", 0)
+        end = max(s.get("ts", 0) + s.get("duration_s", 0) for s in spans)
+        out.append({
+            "trace_id": trace_id, "name": root.get("name"),
+            "num_spans": len(spans),
+            "num_processes": len({s.get("pid") for s in spans}),
+            "start": start, "duration_s": end - start,
+        })
+    out.sort(key=lambda t: t["start"], reverse=True)
+    return out[:limit]
+
+
+def get_trace(trace_id: str) -> Dict[str, Any]:
+    """Assemble one trace's spans into a parent/child tree. Spans from
+    different processes (the submitting driver, the executing workers)
+    link through the span context carried on the TaskSpec, so the tree
+    crosses process hops."""
+    nodes: Dict[str, Dict[str, Any]] = {}
+    for ev in _span_events(trace_id=trace_id):
+        sid = ev.get("span_id")
+        if sid is None:
+            continue
+        nodes[sid] = {
+            "span_id": sid, "name": ev.get("name"),
+            "parent_span_id": ev.get("parent_span_id"),
+            "start": ev.get("ts"),
+            "duration_s": ev.get("duration_s", 0.0),
+            "pid": ev.get("pid"), "children": [],
+        }
+    roots = []
+    for node in nodes.values():
+        parent = node["parent_span_id"]
+        if parent and parent in nodes:
+            nodes[parent]["children"].append(node)
+        else:
+            roots.append(node)
+    for node in nodes.values():
+        node["children"].sort(key=lambda n: n.get("start") or 0)
+    roots.sort(key=lambda n: n.get("start") or 0)
+    return {"trace_id": trace_id, "num_spans": len(nodes),
+            "num_processes": len({n["pid"] for n in nodes.values()}),
+            "roots": roots}

@@ -68,9 +68,24 @@ def _record(name: str, trace_id: str, span_id: str,
                 "span_id": span_id, "parent_span_id": parent_span,
                 "ts": start, "duration_s": end - start,
                 "pid": os.getpid(),
+                # job attribution so timeline(job_id=...) can scope
+                # span rows the same way it scopes task rows
+                "job_id": worker.current_job_id().hex(),
             }]))
     except Exception:  # noqa: BLE001
         pass
+
+
+def record_child_span(name: str, parent_ctx: Tuple[str, str],
+                      start: float, end: float):
+    """Record a completed span as a child of `parent_ctx` WITHOUT
+    touching the active context (the task executor uses this for the
+    execution span: user code must keep inheriting the caller's
+    (trace_id, span_id) unchanged — the documented propagation
+    contract)."""
+    if parent_ctx is None:
+        return
+    _record(name, parent_ctx[0], _new_id(), parent_ctx[1], start, end)
 
 
 def child_context_for_submit() -> Optional[Tuple[str, str]]:

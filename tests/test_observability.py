@@ -65,6 +65,72 @@ def test_metrics_registry_and_prometheus_text():
         c.inc(-1)
 
 
+def test_prometheus_text_label_escaping_roundtrip():
+    """Tag values carrying commas/quotes/newlines survive the snapshot →
+    exposition pipeline intact (the old ",".join series keys split them
+    apart at the wrong places)."""
+    from ray_tpu.util.metrics import Counter, prometheus_text
+    c = Counter("test_escape_total", "esc", tag_keys=("k",))
+    nasty = 'a,b"c\nd\\e'
+    c.inc(tags={"k": nasty})
+    c.inc(tags={"k": nasty})  # same series, not two
+    c.inc(tags={"k": "plain"})
+    text = prometheus_text([c.snapshot()])
+    assert 'test_escape_total{k="a,b\\"c\\nd\\\\e"} 2.0' in text
+    assert 'test_escape_total{k="plain"} 1.0' in text
+    # exactly one # TYPE line per metric, no duplicate series lines
+    assert text.count("# TYPE test_escape_total counter") == 1
+    assert text.count("test_escape_total{") == 2
+
+
+def test_prometheus_text_multiprocess_merge():
+    """Same series reported by several processes folds into ONE sample
+    line: counters sum, gauges last-write-wins, histograms merge
+    buckets/sum/count (duplicate sample lines are invalid exposition)."""
+    import copy
+
+    from ray_tpu.util.metrics import (Counter, Gauge, Histogram,
+                                      prometheus_text)
+    c = Counter("test_merge_total", "c", tag_keys=("k",))
+    c.inc(2, tags={"k": "x"})
+    g = Gauge("test_merge_gauge", "g")
+    g.set(5)
+    h = Histogram("test_merge_hist", "h", boundaries=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(20.0)
+    snap_c, snap_g, snap_h = c.snapshot(), g.snapshot(), h.snapshot()
+    other_c = copy.deepcopy(snap_c)
+    other_g = copy.deepcopy(snap_g)
+    other_g["series"][0][1] = 9.0
+    other_h = copy.deepcopy(snap_h)
+    text = prometheus_text(
+        [snap_c, snap_g, snap_h, other_c, other_g, other_h])
+    assert 'test_merge_total{k="x"} 4.0' in text
+    assert text.count("test_merge_total{") == 1
+    assert "test_merge_gauge 9.0" in text          # last snapshot wins
+    assert 'test_merge_hist_bucket{le="1.0"} 2' in text
+    assert 'test_merge_hist_bucket{le="+Inf"} 4' in text
+    assert "test_merge_hist_count 4" in text
+    assert "test_merge_hist_sum 41.0" in text
+
+
+def test_prometheus_text_empty_histogram():
+    """A histogram declared but never observed renders its metadata
+    lines alone (and never crashes the exposition)."""
+    from ray_tpu.util.metrics import Histogram, prometheus_text
+    h = Histogram("test_empty_hist", "never observed",
+                  boundaries=[1.0])
+    text = prometheus_text([h.snapshot()])
+    assert "# TYPE test_empty_hist histogram" in text
+    assert "# HELP test_empty_hist never observed" in text
+    assert "test_empty_hist_bucket" not in text
+    # legacy dict-form snapshots (older KV payloads) still render
+    legacy = {"name": "test_legacy_total", "kind": "counter",
+              "description": "", "tag_keys": ["k"],
+              "series": {"v": 3.0}}
+    assert 'test_legacy_total{k="v"} 3.0' in prometheus_text([legacy])
+
+
 # ---------------------------------------------------------------------------
 # dashboard REST + jobs
 # ---------------------------------------------------------------------------
@@ -327,6 +393,137 @@ def test_node_agent_stats_route(obs_cluster):
     workers = stats["workers"]
     assert workers and any(w.get("rss_bytes", 0) > 0 for w in workers)
     assert all({"worker_id", "pid", "state"} <= set(w) for w in workers)
+
+
+@pytest.mark.timeout_s(600)
+def test_llm_serving_flight_recorder(tmp_path, monkeypatch, capsys):
+    """End-to-end flight recorder over a real LLM serving request:
+    /metrics exposes populated TTFT + per-token-latency histograms with
+    correct label escaping, the timeline shows the task's
+    SUBMITTED→RUNNING→FINISHED phases, and get_trace() assembles a span
+    tree crossing the driver→replica process hop."""
+    # Replica worker processes inherit a fast flush so the scrape
+    # assertions don't wait out the 5 s default interval.
+    monkeypatch.setenv("RTPU_metrics_report_interval_s", "1.0")
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, object_store_memory=300 * 1024 * 1024)
+    try:
+        from ray_tpu import cli, serve
+        from ray_tpu.dashboard import start_dashboard
+        from ray_tpu.llm import build_llm_deployment
+        from ray_tpu.llm.paged import PagedEngineConfig
+        from ray_tpu.models.llama import LlamaConfig
+        from ray_tpu.util import metrics as metrics_mod
+        from ray_tpu.util import state as st
+        from ray_tpu.util.tracing import trace_span
+
+        model = LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=256,
+            remat=False, use_flash=False, attention_impl="reference")
+        cfg = PagedEngineConfig(model=model, max_batch=2, max_len=96,
+                                page_size=8, num_pages=64,
+                                prefill_buckets=(8, 16))
+        app = build_llm_deployment(cfg)
+        handle = serve.run(app, name="llm", route_prefix="/llm",
+                           wait_for_ready_timeout_s=240)
+
+        # One normal task too, so the timeline has a LEASED phase row.
+        @ray_tpu.remote
+        def warmup():
+            return 1
+        assert ray_tpu.get(warmup.remote(), timeout=120) == 1
+
+        with trace_span("client") as (trace_id, _span_id):
+            out = handle.generate.remote(
+                [1, 2, 3], max_new_tokens=4).result(timeout_s=240)
+        assert out["num_generated"] == 4
+
+        # -- /metrics: populated LLM histograms + label escaping -------
+        from ray_tpu.util.metrics import Counter
+        c = Counter("test_e2e_escape_total", "esc", tag_keys=("k",))
+        c.inc(tags={"k": 'multi,part"value'})
+        assert metrics_mod.flush_now()  # driver-side snapshots
+        address = start_dashboard()
+        deadline = time.monotonic() + 60
+        text = ""
+        while time.monotonic() < deadline:
+            _s, body = _get(f"{address}/metrics")
+            text = body.decode()
+            if "rtpu_llm_ttft_seconds_bucket" in text and \
+                    "rtpu_llm_token_latency_seconds_bucket" in text:
+                break
+            time.sleep(0.5)
+
+        def _count_of(metric):
+            for line in text.splitlines():
+                if line.startswith(metric + "_count"):
+                    return float(line.rsplit(" ", 1)[1])
+            return 0.0
+        assert _count_of("rtpu_llm_ttft_seconds") >= 1, text[:2000]
+        assert _count_of("rtpu_llm_token_latency_seconds") >= 1
+        assert 'engine="paged"' in text
+        assert 'test_e2e_escape_total{k="multi,part\\"value"} 1.0' in text
+        assert "# TYPE rtpu_llm_ttft_seconds histogram" in text
+
+        # -- timeline: SUBMITTED→RUNNING→FINISHED phase rows -----------
+        deadline = time.monotonic() + 30
+        rows = []
+        while time.monotonic() < deadline:
+            rows = [r for r in st.list_tasks(limit=100_000)
+                    if r["state"] == "FINISHED"
+                    and {"SUBMITTED", "RUNNING",
+                         "FINISHED"} <= set(r["phases"])]
+            if rows and any(r["name"] and "warmup" in r["name"]
+                            and "LEASED" in r["phases"] for r in rows):
+                break
+            time.sleep(0.5)
+        assert rows, "no finished task rows with full phase history"
+        warm = next(r for r in rows if "warmup" in (r["name"] or ""))
+        assert warm["phases"].index("SUBMITTED") < \
+            warm["phases"].index("RUNNING") < \
+            warm["phases"].index("FINISHED")
+        assert "LEASED" in warm["phases"] and warm["leased_at"] is not None
+        trace_events = st.timeline(str(tmp_path / "trace.json"))
+        names = {ev["name"] for ev in trace_events}
+        assert any("[queued]" in n for n in names if n)
+        run_rows = [ev for ev in trace_events
+                    if ev["args"].get("state") == "FINISHED"
+                    and ev["cat"] in ("task", "actor_task")]
+        assert run_rows and all(
+            ev["tid"].startswith("worker-pid-") for ev in run_rows)
+
+        # -- get_trace: span tree across the process hop ---------------
+        deadline = time.monotonic() + 30
+        tree = {}
+        while time.monotonic() < deadline:
+            tree = st.get_trace(trace_id)
+            if tree["num_spans"] >= 2 and tree["num_processes"] >= 2:
+                break
+            time.sleep(0.5)
+        assert tree["num_spans"] >= 2, tree
+        assert tree["num_processes"] >= 2, tree  # driver + replica pids
+        root = next(r for r in tree["roots"] if r["name"] == "client")
+        assert root["children"], tree  # the replica-side execution span
+        child_names = {c["name"] for c in root["children"]}
+        assert any(n.startswith("task:") for n in child_names), tree
+
+        # -- the CLI renders the same tree ----------------------------
+        class T:
+            address = None
+            json = False
+            limit = 20
+        T.trace_id = trace_id
+        cli.cmd_trace(T())
+        out = capsys.readouterr().out
+        assert "spans across" in out and "client" in out
+    finally:
+        try:
+            from ray_tpu import serve
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
 
 
 def test_dashboard_web_frontend_serves_spa(obs_cluster):
