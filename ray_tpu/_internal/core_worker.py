@@ -26,6 +26,7 @@ import collections
 import concurrent.futures
 import logging
 import os
+import struct
 import sys
 import threading
 import time
@@ -47,6 +48,7 @@ from .object_ref import ObjectRef
 from .plasma import PlasmaDir
 from .rpc import Address, ClientPool, EventLoopThread, RpcServer
 from . import serialization
+from . import task_spec as task_spec_codec
 from .task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK,
                         FunctionManager, TaskArg, TaskSpec, _CallBundle,
                         _RefPlaceholder)
@@ -177,8 +179,7 @@ class ReferenceCounter:
                 self._entry(oid).submitted += 1
 
     def remove_submitted(self, object_ids: List[ObjectID]):
-        for oid in object_ids:
-            self._decrement(oid, "submitted")
+        self._decrement_many(object_ids, "submitted")
 
     def add_contained(self, object_ids: List[ObjectID]):
         with self._lock:
@@ -186,8 +187,7 @@ class ReferenceCounter:
                 self._entry(oid).contained_in += 1
 
     def remove_contained(self, object_ids: List[ObjectID]):
-        for oid in object_ids:
-            self._decrement(oid, "contained_in")
+        self._decrement_many(object_ids, "contained_in")
 
     def add_borrower(self, object_id: ObjectID):
         with self._lock:
@@ -205,6 +205,8 @@ class ReferenceCounter:
                                  object_hex=ref.hex())
 
     def _decrement(self, object_id: ObjectID, kind: str):
+        # Single-object path kept tuple-free: remove_local_ref runs once
+        # per ObjectRef finalizer on call floods.
         free = False
         notify_owner = None
         in_plasma = False
@@ -224,6 +226,33 @@ class ReferenceCounter:
             self._cw._free_owned_object(object_id, in_plasma=in_plasma)
         elif notify_owner is not None:
             self._cw.fire_and_forget(notify_owner, "borrow_decref",
+                                     object_hex=object_id.hex())
+
+    def _decrement_many(self, object_ids, kind: str):
+        """Release a batch of refs of one kind under ONE lock acquisition
+        (a completing task's dep list used to take the lock per object —
+        measurable on call floods); the resulting frees / owner
+        notifications run outside the lock."""
+        if not object_ids:
+            return
+        frees: List[Tuple[ObjectID, bool]] = []
+        notify: List[Tuple[ObjectID, Address]] = []
+        with self._lock:
+            for object_id in object_ids:
+                entry = self._entries.get(object_id)
+                if entry is None:
+                    continue
+                setattr(entry, kind, max(0, getattr(entry, kind) - 1))
+                if entry.total() == 0:
+                    del self._entries[object_id]
+                    if entry.is_owner:
+                        frees.append((object_id, entry.in_plasma))
+                    elif entry.owner_address is not None:
+                        notify.append((object_id, entry.owner_address))
+        for object_id, in_plasma in frees:
+            self._cw._free_owned_object(object_id, in_plasma=in_plasma)
+        for object_id, owner in notify:
+            self._cw.fire_and_forget(owner, "borrow_decref",
                                      object_hex=object_id.hex())
 
     def is_owner(self, object_id: ObjectID) -> bool:
@@ -431,6 +460,12 @@ class TaskManager:
         return task_id in self.cancelled
 
     def _take_cancelled(self, task_id: TaskID) -> bool:
+        if not self.cancelled:
+            # Lock-free steady state: the cancelled set is almost always
+            # empty and reading it is GIL-atomic — this runs once per
+            # completion (plus once per submit), so skipping the lock
+            # saves two acquisitions per task on call floods.
+            return False
         with self._lock:
             if task_id in self.cancelled:
                 self.cancelled.discard(task_id)
@@ -596,6 +631,10 @@ class NormalTaskSubmitter:
         self._waiters: Dict[Tuple, collections.deque] = {}
         self._inflight_requests: Dict[Tuple, int] = {}
         self._shape_specs: Dict[Tuple, TaskSpec] = {}
+        # Pre-encoded lease-request meta per shape: the raylet receives
+        # an opaque blob it decodes once per request; spillback hops
+        # resend the same bytes without re-encoding.
+        self._meta_blobs: Dict[Tuple, bytes] = {}
         self._request_tasks: set = set()
         self._cleaner_started = False
         self._probed: Dict[TaskID, _ProbeState] = {}
@@ -648,6 +687,13 @@ class NormalTaskSubmitter:
             # floods): probe the worker periodically; if it doesn't know
             # the task repeatedly, the push or its reply vanished.
             reply = await self._push_with_probe(worker, spec, lease)
+            if reply.get("need_template"):
+                # Receiver lost the announced template (fresh process on
+                # a reused address / registry pressure): re-announce
+                # inline and push again.
+                self._cw._tmpl_sent.discard(
+                    (lease.worker_address, spec.flat_template.tid))
+                reply = await self._push_with_probe(worker, spec, lease)
         except Exception as e:
             # Worker died or became unreachable — a system failure.
             self._drop_lease(lease)
@@ -683,9 +729,32 @@ class NormalTaskSubmitter:
         1M-queued-task profile. The hot path is a plain await; the
         sweeper resolves stuck pushes by cancelling them after stashing
         a verdict in `_ProbeState`."""
-        push = asyncio.ensure_future(worker.call(
-            "push_task", spec=spec, lease_id=lease.lease_id,
-            timeout=None))
+        tmpl = spec.flat_template
+        if tmpl is not None and not task_spec_codec.delta_encodable(spec):
+            tmpl = None  # oversized args: pickle path handles any size
+        if tmpl is not None:
+            # Flat wire path: one raw frame (no pickler) — the template
+            # is announced once per destination, every push after ships
+            # only the struct-packed delta.
+            tmpl_data = None
+            sent = self._cw._tmpl_sent
+            sent_key = (lease.worker_address, tmpl.tid)
+            if sent_key not in sent:
+                if len(sent) > 8192:
+                    sent.clear()  # bound vs worker churn; re-announce heals
+                sent.add(sent_key)
+                tmpl_data = tmpl.data
+            payload = _pack_push_task(
+                tmpl.tid, lease.lease_id, tmpl_data,
+                task_spec_codec.encode_delta(spec, tmpl.method_name))
+            from .runtime_metrics import runtime_metrics
+            runtime_metrics().wire_task_bytes.inc(len(payload))
+            push = asyncio.ensure_future(worker.call_raw(
+                "push_task", payload, timeout=None))
+        else:
+            push = asyncio.ensure_future(worker.call(
+                "push_task", spec=spec, lease_id=lease.lease_id,
+                timeout=None))
         ps = _ProbeState(push=push, worker=worker, spec=spec, lease=lease,
                          started=time.monotonic())
         self._probed[spec.task_id] = ps
@@ -922,22 +991,31 @@ class NormalTaskSubmitter:
             idle.remove(lease)
 
     async def _request_new_lease(self, spec: TaskSpec) -> Optional[Lease]:
-        meta = {
-            "resources": spec.resources,
-            "shape_key": spec.shape_key(),
-            "runtime_env": spec.runtime_env,
-            "label_selector": spec.label_selector or None,
-            "task_hex": spec.task_id.hex(),  # lease cancellation key
-            "job": spec.job_id.hex(),        # log-stream routing
-        }
+        shape = spec.shape_key()
+        blob = self._meta_blobs.get(shape)
+        if blob is None:
+            meta = {
+                "resources": spec.resources,
+                "shape_key": shape,
+                "runtime_env": spec.runtime_env,
+                "label_selector": spec.label_selector or None,
+            }
+            strategy = spec.scheduling_strategy
+            if strategy.kind == "placement_group":
+                meta["pg"] = (strategy.placement_group_id,
+                              strategy.bundle_index)
+            # Strict dumps (not bare pickle): runtime_env is user data,
+            # and the blob encodes once per shape anyway.
+            blob = serialization.dumps(meta)
+            if len(self._meta_blobs) > 512:
+                self._meta_blobs.clear()
+            self._meta_blobs[shape] = blob
         strategy = spec.scheduling_strategy
-        if strategy.kind == "placement_group":
-            meta["pg"] = (strategy.placement_group_id, strategy.bundle_index)
-        elif strategy.kind == "SPREAD":
-            # the raylet round-robins SPREAD leases across the cluster
-            # view instead of granting locally (reference:
-            # scheduling/policy/spread_scheduling_policy)
-            meta["strategy"] = "SPREAD"
+        # SPREAD rides as a per-request overlay (not in the blob): the
+        # raylet round-robins SPREAD leases across the cluster view
+        # instead of granting locally (reference:
+        # scheduling/policy/spread_scheduling_policy)
+        spread = strategy.kind == "SPREAD"
         raylet_addr = self._cw.raylet_address
         if strategy.kind == "node_affinity" and strategy.node_id:
             addr = await self._cw.node_address(strategy.node_id)
@@ -945,7 +1023,11 @@ class NormalTaskSubmitter:
                 raylet_addr = addr
         for _hop in range(16):
             raylet = self._cw.clients.get(raylet_addr)
-            reply = await raylet.call("request_worker_lease", spec_meta=meta,
+            reply = await raylet.call("request_worker_lease",
+                                      meta_blob=blob,
+                                      task_hex=spec.task_id.hex(),
+                                      job=spec.job_id.hex(),
+                                      strategy="SPREAD" if spread else None,
                                       timeout=None,
                                       retries=CONFIG.rpc_max_retries)
             if reply.get("canceled"):
@@ -954,7 +1036,7 @@ class NormalTaskSubmitter:
                 raylet_addr = tuple(reply["spillback_to"][1])
                 # A SPREAD redirect already chose the node: the target
                 # must grant/queue locally, not re-spread (ping-pong).
-                meta.pop("strategy", None)
+                spread = False
                 continue
             if reply.get("rejected"):
                 if reply.get("permanent"):
@@ -1093,10 +1175,102 @@ class ActorClientState:
     # stand down, or a later call could take a lower seq than an earlier
     # one still waiting in the loop queue (ordering violation).
     slow_pending: int = 0
+    # In-flight state resolution (subscribe + get_actor_info), shared by
+    # every concurrent slow-path submit: one GCS round trip per cold
+    # actor, and — critically — waiters resume in FIFO order, so
+    # sequence numbers are assigned in SUBMISSION order. Without the
+    # coalescing, the first call sat alone behind the pubsub-subscribe
+    # await while later calls overtook it and took lower seqs (observed
+    # as call 0 executing last on a cold handle).
+    resolving: Optional["asyncio.Future"] = None
 
 
 # read once: os.environ.get costs ~1us and sat on every hot-path submit
 _NO_SUBMIT_FASTPATH = bool(os.environ.get("RTPU_NO_SUBMIT_FASTPATH"))
+
+# -- flat actor-stream framing ----------------------------------------------
+# One raw `push_actor_tasks` frame (rpc FLAG_RAW — no pickler on either
+# side): done_to address, the templates the receiver hasn't seen yet
+# (announce section, parsed BEFORE the deltas that need them), then one
+# delta per task.
+#   u16 host_len + host utf8 | u32 port
+#   u8 n_templates, per: 16s tid | u32 len | template bytes
+#   u16 n_frames,   per: 16s tid | u32 len | delta bytes
+_AB_U16 = struct.Struct("<H")
+_AB_U32 = struct.Struct("<I")
+_TID_LEN = task_spec_codec.TEMPLATE_ID_LEN
+
+
+def _pack_actor_batch(done_to: Address, tmpls, frames) -> bytes:
+    host = done_to[0].encode()
+    parts = [_AB_U16.pack(len(host)), host, _AB_U32.pack(done_to[1]),
+             bytes([len(tmpls)])]
+    for tid, data in tmpls:
+        parts.append(tid)
+        parts.append(_AB_U32.pack(len(data)))
+        parts.append(data)
+    parts.append(_AB_U16.pack(len(frames)))
+    for tid, delta in frames:
+        parts.append(tid)
+        parts.append(_AB_U32.pack(len(delta)))
+        parts.append(delta)
+    return b"".join(parts)
+
+
+# One raw `push_task` frame (normal-task lease push):
+#   u8 flags (bit0: template bytes present) | 16s tid | u64 lease_id
+#   [u32 len + template bytes] | delta (rest of payload)
+_PT_HEAD = struct.Struct("<B16sQ")
+
+
+def _pack_push_task(tid: bytes, lease_id: int, tmpl_data: Optional[bytes],
+                    delta: bytes) -> bytes:
+    if tmpl_data is None:
+        return _PT_HEAD.pack(0, tid, lease_id) + delta
+    return b"".join((_PT_HEAD.pack(1, tid, lease_id),
+                     _AB_U32.pack(len(tmpl_data)), tmpl_data, delta))
+
+
+def _unpack_push_task(payload):
+    flags, tid, lease_id = _PT_HEAD.unpack_from(payload, 0)
+    off = _PT_HEAD.size
+    tmpl_data = None
+    if flags & 1:
+        (dlen,) = _AB_U32.unpack_from(payload, off)
+        off += 4
+        tmpl_data = bytes(payload[off:off + dlen])
+        off += dlen
+    return tid, lease_id, tmpl_data, payload[off:]
+
+
+def _unpack_actor_batch(payload):
+    (hlen,) = _AB_U16.unpack_from(payload, 0)
+    off = 2
+    host = bytes(payload[off:off + hlen]).decode()
+    off += hlen
+    (port,) = _AB_U32.unpack_from(payload, off)
+    off += 4
+    n_tmpls = payload[off]
+    off += 1
+    tmpls = []
+    for _ in range(n_tmpls):
+        tid = bytes(payload[off:off + _TID_LEN])
+        off += _TID_LEN
+        (dlen,) = _AB_U32.unpack_from(payload, off)
+        off += 4
+        tmpls.append((tid, bytes(payload[off:off + dlen])))
+        off += dlen
+    (n_frames,) = _AB_U16.unpack_from(payload, off)
+    off += 2
+    frames = []
+    for _ in range(n_frames):
+        tid = bytes(payload[off:off + _TID_LEN])
+        off += _TID_LEN
+        (dlen,) = _AB_U32.unpack_from(payload, off)
+        off += 4
+        frames.append((tid, payload[off:off + dlen]))
+        off += dlen
+    return (host, port), tmpls, frames
 
 
 class ActorTaskSubmitter:
@@ -1117,6 +1291,7 @@ class ActorTaskSubmitter:
         self._push_time: Dict[TaskID, float] = {}
         self._subscribed = False
         self._sweeper_started = False
+        self._wire_bytes_acc = 0  # flushed to the counter every ~32KB
 
     def state_for(self, actor_id: ActorID) -> ActorClientState:
         st = self._actors.get(actor_id)
@@ -1178,24 +1353,12 @@ class ActorTaskSubmitter:
                 st.slow_pending -= 1
 
     async def _submit(self, spec: TaskSpec):
-        await self.ensure_subscribed()
         st = self.state_for(spec.actor_id)
+        if st.state != "ALIVE" or st.address is None:
+            await self._resolve_actor(st)
         if st.state == "DEAD":
             self._fail(spec, st.death_cause)
             return
-        if st.state != "ALIVE" or st.address is None:
-            # Resolve address lazily (handle may have been deserialized in a
-            # process that never saw the creation).
-            info = await self._cw.gcs.call("get_actor_info",
-                                          actor_id=spec.actor_id)
-            if info is not None and info["state"] == "ALIVE":
-                st.state = "ALIVE"
-                st.address = tuple(info["address"])
-            elif info is not None and info["state"] == "DEAD":
-                st.state = "DEAD"
-                st.death_cause = info.get("death_cause", "actor dead")
-                self._fail(spec, st.death_cause)
-                return
         with st.lock:
             spec.sequence_number = st.seq
             st.seq += 1
@@ -1203,6 +1366,30 @@ class ActorTaskSubmitter:
                 st.queued.append(spec)
                 return
         await self._push(st, spec)
+
+    async def _resolve_actor(self, st: ActorClientState):
+        """Resolve a cold/uncertain actor's state ONCE for all concurrent
+        submits (handle may have been deserialized in a process that
+        never saw the creation). The resolver subscribes + fetches; every
+        other submit awaits the same future and wakes in FIFO order."""
+        fut = st.resolving
+        if fut is not None:
+            await fut
+            return
+        fut = st.resolving = asyncio.get_running_loop().create_future()
+        try:
+            await self.ensure_subscribed()
+            info = await self._cw.gcs.call("get_actor_info",
+                                           actor_id=st.actor_id)
+            if info is not None and info["state"] == "ALIVE":
+                st.state = "ALIVE"
+                st.address = tuple(info["address"])
+            elif info is not None and info["state"] == "DEAD":
+                st.state = "DEAD"
+                st.death_cause = info.get("death_cause", "actor dead")
+        finally:
+            st.resolving = None
+            fut.set_result(None)
 
     async def _push(self, st: ActorClientState, spec: TaskSpec):
         if self._cw.task_manager.is_cancelled(spec.task_id):
@@ -1243,8 +1430,7 @@ class ActorTaskSubmitter:
             return
         worker = self._cw.clients.get(st.address)
         try:
-            await worker.oneway("push_actor_tasks", specs=specs,
-                                done_to=self._cw.rpc_address)
+            await self._send_batch(worker, st.address, specs)
         except Exception:
             with st.lock:
                 for spec in specs:
@@ -1256,6 +1442,55 @@ class ActorTaskSubmitter:
             # failure with the actor still healthy — reconcile with the GCS
             # rather than parking forever.
             asyncio.ensure_future(self._reconcile(st))
+
+    async def _send_batch(self, worker, address: Address,
+                          specs: List[TaskSpec]):
+        """Push one flushed batch: template-bearing specs go as one raw
+        flat frame (template announce + deltas, no pickler); anything
+        without a template rides the legacy pickled stream."""
+        frames = []
+        tmpls = []
+        legacy = []
+        sent = self._cw._tmpl_sent
+        encode = task_spec_codec.encode_delta
+        for spec in specs:
+            tmpl = spec.flat_template
+            if tmpl is None or not task_spec_codec.delta_encodable(spec):
+                legacy.append(spec)
+                continue
+            key = (address, tmpl.tid)
+            if key not in sent:
+                if len(sent) > 8192:
+                    # Bound against worker churn (dead addresses are
+                    # never pruned individually); a clear only costs a
+                    # proactive re-announce per live destination.
+                    sent.clear()
+                if len(tmpls) >= 255:
+                    # Announce section is full (u8 count): divert to the
+                    # pickled stream rather than knowingly shipping a
+                    # delta the receiver cannot decode (which would burn
+                    # a retry attempt per task).
+                    legacy.append(spec)
+                    continue
+                sent.add(key)
+                tmpls.append((tmpl.tid, tmpl.data))
+            frames.append((tmpl.tid, encode(spec, tmpl.method_name)))
+        # Chunked: the frame count is u16 on the wire, and a restart
+        # re-push can batch an arbitrary backlog in one flush.
+        for start in range(0, len(frames), 32768):
+            chunk = frames[start:start + 32768]
+            payload = _pack_actor_batch(self._cw.rpc_address,
+                                        tmpls if start == 0 else [], chunk)
+            # Counter inc'd every ~32KB, not per (possibly tiny) batch.
+            self._wire_bytes_acc += len(payload)
+            if self._wire_bytes_acc >= 32768:
+                acc, self._wire_bytes_acc = self._wire_bytes_acc, 0
+                from .runtime_metrics import runtime_metrics
+                runtime_metrics().wire_task_bytes.inc(acc)
+            await worker.oneway_raw("push_actor_tasks", payload)
+        if legacy:
+            await worker.oneway("push_actor_tasks", specs=legacy,
+                                done_to=self._cw.rpc_address)
 
     def on_done(self, task_id: TaskID, reply: Dict[str, Any]):
         """A completion from the actor's done stream (possibly duplicated
@@ -1274,6 +1509,13 @@ class ActorTaskSubmitter:
             # sequence number, so giving up leaves a hole the executor's
             # ordered queue would wait on forever — fill it with a
             # tombstone (same trick as cancellation) after failing.
+            if "unknown template" in str(sys_err) and \
+                    spec.flat_template is not None:
+                # Receiver lost the announced template (fresh process /
+                # registry pressure): clear the announce record so the
+                # re-push re-includes the template bytes.
+                self._cw._tmpl_sent.discard(
+                    (st.address, spec.flat_template.tid))
             if spec.attempt_number < 3:
                 spec.attempt_number += 1
                 asyncio.ensure_future(self._push(st, spec))
@@ -1945,6 +2187,9 @@ class CoreWorker:
         self._pending_frees: List[str] = []
         self._free_lock = threading.Lock()
         self._done_batches: Dict[Address, List] = {}
+        # (destination address, template id) pairs already announced on
+        # the flat wire path (io-loop-only; see SpecTemplate).
+        self._tmpl_sent: Set[Tuple[Address, bytes]] = set()
         # normal-task pushes currently known to this worker (arrival ->
         # reply), served to owner-side push probes
         self._received_pushes: Set[TaskID] = set()
@@ -1972,10 +2217,22 @@ class CoreWorker:
     def start(self):
         loop_thread = EventLoopThread.get()
         self.server.register_instance(self)
+        # Flat task paths: raw frames bypass the kwargs pickler.
+        self.server.register_raw("push_actor_tasks",
+                                 self._handle_push_actor_tasks_raw)
+        self.server.register_raw("push_task", self._handle_push_task_raw)
         self.rpc_address = loop_thread.run_sync(self.server.start())
 
     def shutdown(self):
         self._shutdown = True
+        acc = self.actor_submitter._wire_bytes_acc
+        if acc:
+            # Residual wire-bytes below the batching threshold would
+            # otherwise never reach the counter (short-lived drivers
+            # would report 0).
+            self.actor_submitter._wire_bytes_acc = 0
+            from .runtime_metrics import runtime_metrics
+            runtime_metrics().wire_task_bytes.inc(acc)
         try:
             EventLoopThread.get().run_sync(
                 self.submitter.cancel_pending_requests(), timeout=5)
@@ -2344,8 +2601,30 @@ class CoreWorker:
 
     # -- rpc handlers ----------------------------------------------------
 
-    async def handle_push_task(self, spec: TaskSpec,
-                               lease_id: Optional[int] = None):
+    async def _handle_push_task_raw(self, payload):
+        """Flat lease push (rpc FLAG_RAW): header + optional template
+        announce + delta, decoded straight into a freelist spec."""
+        tid, lease_id, tmpl_data, delta = _unpack_push_task(payload)
+        return await self.handle_push_task(
+            lease_id=lease_id, tmpl=tid, frame=delta, tmpl_data=tmpl_data)
+
+    async def handle_push_task(self, spec: Optional[TaskSpec] = None,
+                               lease_id: Optional[int] = None,
+                               tmpl: Optional[bytes] = None,
+                               frame: Optional[bytes] = None,
+                               tmpl_data: Optional[bytes] = None):
+        pooled = False
+        if frame is not None:
+            # Flat wire path: register any piggybacked template BEFORE
+            # decoding (same-message announce — ordered by construction),
+            # then decode the delta into a freelist spec.
+            if tmpl_data is not None:
+                task_spec_codec.register_template(tmpl, tmpl_data)
+            template = task_spec_codec.lookup_template(tmpl)
+            if template is None:
+                return {"need_template": True}
+            spec = task_spec_codec.decode_delta(frame, template)
+            pooled = True
         if lease_id is not None:
             self.current_lease_id = lease_id
         # Duplicate push of the SAME attempt (owner re-sent after losing
@@ -2357,6 +2636,8 @@ class CoreWorker:
         if cached is not None:
             from .runtime_metrics import runtime_metrics
             runtime_metrics().push_duplicates.inc()
+            if pooled:
+                task_spec_codec.release_spec(spec)
             return cached
         # known to this worker from arrival until WELL AFTER the reply —
         # the owner's push probe distinguishes a slow task from a lost
@@ -2373,6 +2654,8 @@ class CoreWorker:
         # reply sees "done" rather than "unknown".
         self._completed_push_replies[push_key] = reply
         self._completed_push_bytes += _reply_nbytes(reply)
+        if pooled:
+            task_spec_codec.release_spec(spec)
         # Bound by entries AND bytes between TTL sweeps (large inline
         # returns would otherwise pin GBs for 120 s at high throughput).
         while self._completed_push_replies and (
@@ -2445,6 +2728,30 @@ class CoreWorker:
             return "running"
         return "unknown"
 
+    async def _handle_push_actor_tasks_raw(self, payload):
+        """Flat actor stream (rpc FLAG_RAW): announce templates, decode
+        deltas into freelist specs, dispatch. A delta whose template is
+        unknown (lost announce / registry pressure) still reports per
+        task — the task id rides in the delta header — so the owner can
+        re-announce and resend."""
+        done_to, tmpls, frames = _unpack_actor_batch(payload)
+        for tid, data in tmpls:
+            task_spec_codec.register_template(tid, data)
+        specs = []
+        for tid, delta in frames:
+            template = task_spec_codec.lookup_template(tid)
+            if template is None:
+                q = self._done_batches.setdefault(done_to, [])
+                q.append((task_spec_codec.peek_task_id(delta),
+                          {"system_error": "unknown template"}))
+                if len(q) == 1:
+                    asyncio.get_event_loop().call_soon(
+                        lambda d=done_to: asyncio.ensure_future(
+                            self._flush_done(d)))
+                continue
+            specs.append(task_spec_codec.decode_delta(delta, template))
+        await self.handle_push_actor_tasks(specs, done_to)
+
     async def handle_push_actor_tasks(self, specs: List[TaskSpec],
                                       done_to):
         """One-way actor task stream (reference: PushActorTask). Each spec
@@ -2488,22 +2795,30 @@ class CoreWorker:
         if len(q) == 1:
             asyncio.get_event_loop().call_soon(
                 lambda: asyncio.ensure_future(self._flush_done(done_to)))
+        # codec-decoded specs go back to their freelist (no-op otherwise)
+        task_spec_codec.release_spec(spec)
 
     async def _flush_done(self, done_to: Address):
         results = self._done_batches.pop(done_to, [])
         if not results:
             return
         client = self.clients.get(done_to)
+        # Packed id array: one bytes blob for the whole batch instead of
+        # a tuple-of-bytes per completion (cheaper to pickle and to walk).
+        ids = b"".join(task_key for task_key, _reply in results)
+        replies = [reply for _task_key, reply in results]
         try:
-            await client.oneway("actor_tasks_done", results=results)
+            await client.oneway("actor_tasks_done", ids=ids, replies=replies)
         except Exception:
             pass  # owner unreachable; actor-state pubsub recovers the rest
 
-    async def handle_actor_tasks_done(self, results):
-        for task_key, reply in results:
-            task_id = TaskID(task_key) if isinstance(task_key, bytes) \
-                else TaskID.from_hex(task_key)
-            self.actor_submitter.on_done(task_id, reply)
+    async def handle_actor_tasks_done(self, ids: bytes, replies):
+        # Packed id array: one bytes blob for the batch, replies aligned
+        # by index (the only sender is _flush_done, same build).
+        n = TaskID.SIZE
+        for i, reply in enumerate(replies):
+            self.actor_submitter.on_done(
+                TaskID(ids[i * n:(i + 1) * n]), reply)
 
     async def handle_actor_task_status(self, queries):
         """Straggler probe from an owner: for each (caller_hex, seq,

@@ -653,7 +653,25 @@ class Raylet:
     # local_lease_manager.cc + cluster_lease_manager spillback)
     # ------------------------------------------------------------------
 
-    async def handle_request_worker_lease(self, spec_meta: Dict[str, Any]):
+    async def handle_request_worker_lease(
+            self, spec_meta: Optional[Dict[str, Any]] = None,
+            meta_blob: Optional[bytes] = None,
+            task_hex: Optional[str] = None, job: Optional[str] = None,
+            strategy: Optional[str] = None):
+        if meta_blob is not None:
+            # Flat-wire lease path: the submitter pre-encodes the shape-
+            # invariant meta ONCE per shape and ships the same opaque
+            # blob on every request (and every spillback hop) — only the
+            # tiny per-task overlay travels uncoded. Decode here, once,
+            # into the dict the scheduling pipeline already understands.
+            from . import serialization
+            spec_meta = serialization.loads(meta_blob)
+            if task_hex is not None:
+                spec_meta["task_hex"] = task_hex  # lease cancellation key
+            if job is not None:
+                spec_meta["job"] = job            # log-stream routing
+            if strategy is not None:
+                spec_meta["strategy"] = strategy
         actor_key = spec_meta.get("actor_id") \
             if spec_meta.get("is_actor") else None
         if actor_key is None:

@@ -20,7 +20,10 @@ the target environment rather than translated:
 Wire frames (both transports):
   u32le body_len | u64le msg_id | u8 flags | u16le method_len |
   method utf8 | payload (pickled kwargs / result)
-  flags: bit0 = response, bit1 = ok (responses only).
+  flags: bit0 = response, bit1 = ok (responses only),
+         bit2 = raw (payload is an opaque byte frame dispatched to a
+         raw handler with NO kwargs pickling — the flat task path's
+         template-announce + delta frames ride this type).
 """
 
 from __future__ import annotations
@@ -50,6 +53,7 @@ _BODY_HDR = struct.Struct("<QBH")
 _BODY_HDR_LEN = _BODY_HDR.size
 FLAG_RESP = 1
 FLAG_OK = 2
+FLAG_RAW = 4
 
 
 def pack_frame(msg_id: int, flags: int, method: bytes,
@@ -402,6 +406,7 @@ class RpcServer:
     def __init__(self, name: str):
         self.name = name
         self._handlers: Dict[str, Handler] = {}
+        self._raw_handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.address: Optional[Address] = None
         self._native = None            # NativeIO when serving natively
@@ -410,6 +415,11 @@ class RpcServer:
 
     def register(self, method: str, handler: Handler):
         self._handlers[method] = handler
+
+    def register_raw(self, method: str, handler: Handler):
+        """Handler for FLAG_RAW frames: called with the payload bytes
+        as-is — no kwargs pickling on either side of the wire."""
+        self._raw_handlers[method] = handler
 
     def register_instance(self, obj: Any, prefix: str = ""):
         """Register every `async def handle_<x>` method of obj as rpc `<x>`."""
@@ -472,10 +482,10 @@ class RpcServer:
             if kind == 2:  # closed
                 self._native_conns.discard(conn_id)
                 return
-            msg_id, _flags, method, payload = unpack_body(body)
+            msg_id, flags, method, payload = unpack_body(body)
             asyncio.ensure_future(
                 self._handle_request(method, payload, msg_id,
-                                     self._native_reply, coalescer))
+                                     self._native_reply, coalescer, flags))
         return sink
 
     def _native_reply(self, coalescer: "NativeCoalescer", frame: bytes):
@@ -500,10 +510,10 @@ class RpcServer:
                 if not chunk:
                     break
                 for body in frames.feed(chunk):
-                    msg_id, _flags, method, payload = unpack_body(body)
+                    msg_id, flags, method, payload = unpack_body(body)
                     asyncio.ensure_future(
                         self._handle_request(method, payload, msg_id,
-                                             reply, None))
+                                             reply, None, flags))
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -515,12 +525,19 @@ class RpcServer:
     # -- shared dispatch -------------------------------------------------
 
     async def _handle_request(self, method: str, payload: bytes,
-                              msg_id: int, reply, conn):
+                              msg_id: int, reply, conn, flags: int = 0):
         if CHAOS.drop_request(method):
             return
         try:
-            kwargs = serialization.loads(payload) if payload else {}
-            result = await self._dispatch(method, kwargs)
+            if flags & FLAG_RAW:
+                handler = self._raw_handlers.get(method)
+                if handler is None:
+                    raise RpcError(
+                        f"{self.name}: no raw handler for {method!r}")
+                result = await handler(payload)
+            else:
+                kwargs = serialization.loads(payload) if payload else {}
+                result = await self._dispatch(method, kwargs)
             ok, body = True, result
         except BaseException as e:  # noqa: BLE001 — errors cross the wire
             ok, body = False, e
@@ -628,6 +645,22 @@ class RpcClient:
             return
         self._fail_pending(RpcError(f"connection to {self.address} closed"))
 
+    async def _send_frame(self, frame: bytes):
+        """Shared transport write (native or asyncio) with drain-based
+        backpressure — the only difference between call/oneway and their
+        _raw variants is how the frame is built."""
+        if self._native_conn is not None:
+            conn = self._native_conn
+            if not self._native_cw.write(frame):
+                raise ConnectionError(f"send to {self.address} failed")
+            if self._native.out_bytes(conn) > _DRAIN_THRESHOLD:
+                await _native_drain_wait(self._native, conn)
+        else:
+            cw = self._cw
+            cw.write(frame)
+            if cw.needs_drain():
+                await cw.drain()
+
     def _fail_pending(self, err: Exception):
         self._writer = None
         self._cw = None
@@ -674,30 +707,27 @@ class RpcClient:
                 raise asyncio.TimeoutError()
             return await asyncio.wait_for(
                 local._dispatch(method, payload), timeout)
+        return await self._call_frame(
+            0, method, serialization.dumps(payload) if payload else b"",
+            timeout)
+
+    async def _call_frame(self, flags: int, method: str, payload: bytes,
+                          timeout: Optional[float]) -> Any:
+        """Shared request/response tail: pending-future bookkeeping, one
+        transport write, reply decode (pickled either way)."""
         await self._ensure_conn()
         self._next_id += 1
         msg_id = self._next_id
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        frame = pack_frame(msg_id, 0, method.encode(),
-                           serialization.dumps(payload) if payload else b"")
+        frame = pack_frame(msg_id, flags, method.encode(), payload)
         try:
-            if self._native_conn is not None:
-                conn = self._native_conn
-                if not self._native_cw.write(frame):
-                    raise ConnectionError(f"send to {self.address} failed")
-                if self._native.out_bytes(conn) > _DRAIN_THRESHOLD:
-                    await _native_drain_wait(self._native, conn)
-            else:
-                cw = self._cw
-                cw.write(frame)
-                if cw.needs_drain():
-                    await cw.drain()
-            flags, data = await asyncio.wait_for(fut, timeout)
+            await self._send_frame(frame)
+            rflags, data = await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(msg_id, None)
         body = serialization.loads(data)
-        if not (flags & FLAG_OK):
+        if not (rflags & FLAG_OK):
             raise body
         return body
 
@@ -712,19 +742,42 @@ class RpcClient:
                 asyncio.ensure_future(local._dispatch(method, kwargs))
             return
         await self._ensure_conn()
-        frame = pack_frame(0, 0, method.encode(),
-                           serialization.dumps(kwargs) if kwargs else b"")
-        if self._native_conn is not None:
-            conn = self._native_conn
-            if not self._native_cw.write(frame):
-                raise ConnectionError(f"send to {self.address} failed")
-            if self._native.out_bytes(conn) > _DRAIN_THRESHOLD:
-                await _native_drain_wait(self._native, conn)
-        else:
-            cw = self._cw
-            cw.write(frame)
-            if cw.needs_drain():
-                await cw.drain()
+        await self._send_frame(pack_frame(
+            0, 0, method.encode(),
+            serialization.dumps(kwargs) if kwargs else b""))
+
+    async def call_raw(self, method: str, payload: bytes,
+                       timeout: Optional[float] = DEFAULT_TIMEOUT) -> Any:
+        """Request/response over a FLAG_RAW frame: the request payload
+        crosses as-is into the server's raw handler (no kwargs pickling);
+        the reply travels the normal pickled-response path."""
+        if timeout is DEFAULT_TIMEOUT:
+            timeout = CONFIG.rpc_call_timeout_s
+        local = self._local()
+        if local is not None:
+            if CHAOS.drop_request(method) or CHAOS.drop_response(method):
+                raise asyncio.TimeoutError()
+            handler = local._raw_handlers.get(method)
+            if handler is None:
+                raise RpcError(f"no raw handler for {method!r}")
+            return await asyncio.wait_for(handler(payload), timeout)
+        return await self._call_frame(FLAG_RAW, method, payload, timeout)
+
+    async def oneway_raw(self, method: str, payload: bytes):
+        """One-way FLAG_RAW frame: `payload` crosses the wire as-is and
+        lands in the server's raw handler — no pickler on either side
+        (the flat task path's template+delta frames)."""
+        local = self._local()
+        if local is not None:
+            if not CHAOS.drop_request(method):
+                handler = local._raw_handlers.get(method)
+                if handler is None:
+                    raise RpcError(f"no raw handler for {method!r}")
+                asyncio.ensure_future(handler(payload))
+            return
+        await self._ensure_conn()
+        await self._send_frame(pack_frame(0, FLAG_RAW, method.encode(),
+                                          payload))
 
     def call_sync(self, method: str, timeout: Optional[float] = DEFAULT_TIMEOUT,
                   retries: int = 0, **kwargs) -> Any:

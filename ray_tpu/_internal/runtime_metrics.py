@@ -49,6 +49,11 @@ def _build() -> SimpleNamespace:
             "rtpu_push_reply_recovered_total",
             "Lost push replies recovered via the probe "
             "channel"),
+        wire_task_bytes=Counter(
+            "rtpu_task_wire_bytes_total",
+            "Bytes of flat task frames (template deltas + "
+            "actor-batch framing) shipped by this process; "
+            "divide by submitted tasks for bytes/task"),
         raylet_lease_queue=Gauge(
             "rtpu_raylet_lease_queue_depth",
             "Lease requests queued at the raylet",

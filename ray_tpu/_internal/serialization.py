@@ -13,6 +13,14 @@ Wire format of a serialized object:
 The store keeps payloads as a single contiguous buffer; deserialization maps
 buffer views back out-of-band, so a numpy array read from shared memory is a
 view over the store's mmap (no copy).
+
+Fast-path framing note: steady-state task pushes do NOT come through this
+module at all — the flat wire codec (task_spec.py: template announce +
+struct-packed deltas over rpc FLAG_RAW frames) carries them with no pickler
+in the loop. This module remains the codec for object VALUES (args bundles,
+returns, puts), for control payloads outside the per-call loop (templates
+and lease meta blobs encode once per shape via strict `dumps`), and for the
+pickle fallback that exotic specs ride.
 """
 
 from __future__ import annotations

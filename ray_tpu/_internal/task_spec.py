@@ -14,6 +14,8 @@ store keyed by a content hash; workers load and cache them on first use.
 from __future__ import annotations
 
 import hashlib
+import os
+import struct
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -27,7 +29,7 @@ ACTOR_CREATION_TASK = "actor_creation"
 ACTOR_TASK = "actor_task"
 
 
-@dataclass
+@dataclass(slots=True)
 class FunctionDescriptor:
     module: str
     qualname: str
@@ -37,7 +39,7 @@ class FunctionDescriptor:
         return f"{self.module}.{self.qualname}"
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskArg:
     """One argument: either an inline serialized value or an object ref."""
     is_ref: bool
@@ -48,7 +50,7 @@ class TaskArg:
     contained_ref_ids: List[ObjectID] = field(default_factory=list)
 
 
-@dataclass
+@dataclass(slots=True)
 class SchedulingStrategy:
     """Normalized scheduling strategy carried in the spec.
 
@@ -64,7 +66,11 @@ class SchedulingStrategy:
     label_selector: Dict[str, str] = field(default_factory=dict)
 
 
-@dataclass
+# Sender/receiver-local codec state on TaskSpec — never pickled.
+_CODEC_LOCAL_FIELDS = ("flat_template", "_shape_key", "_return_ids")
+
+
+@dataclass(slots=True)
 class TaskSpec:
     task_id: TaskID
     job_id: JobID
@@ -100,6 +106,33 @@ class TaskSpec:
     # util/tracing/tracing_helper.py:54-88 injects otel context the
     # same way)
     trace_context: Optional[Tuple[str, str]] = None
+    # Flat-wire codec handle: driver-side a SpecTemplate (encode path),
+    # worker-side the _Template a decoded spec came from (freelist
+    # routing). None -> the spec travels via the pickle fallback.
+    flat_template: Any = None
+    # Memoized derived values (submit hot path): the shape key sorts
+    # three dicts and return_ids builds an ObjectID list — both are
+    # invariant for a spec's lifetime (task_id/num_returns never change
+    # across retries; resources/env are fixed at construction).
+    _shape_key: Optional[Tuple] = None
+    _return_ids: Optional[List[ObjectID]] = None
+
+    def __getstate__(self):
+        # Codec-local fields stay out of pickles: a fallback-path push
+        # must not ship the memoized shape-key tuple / return-id list /
+        # template handle the old wire format never carried (they are
+        # sender-local caches; receivers rebuild lazily).
+        state = {name: getattr(self, name)
+                 for name in self.__dataclass_fields__}
+        for name in _CODEC_LOCAL_FIELDS:
+            state.pop(name, None)
+        return state
+
+    def __setstate__(self, state):
+        for name in _CODEC_LOCAL_FIELDS:
+            setattr(self, name, None)
+        for name, value in state.items():
+            setattr(self, name, value)
 
     def is_generator(self) -> bool:
         return self.num_returns in ("dynamic", "streaming")
@@ -108,10 +141,15 @@ class TaskSpec:
         # Generator tasks own one "generator ref" at index 0; the yielded
         # items land at indices 1..N once N is known (reference:
         # _raylet.pyx ObjectRefGenerator dynamic return ids).
-        if self.is_generator():
-            return [ObjectID.for_task_return(self.task_id, 0)]
-        return [ObjectID.for_task_return(self.task_id, i)
-                for i in range(self.num_returns)]
+        ids = self._return_ids
+        if ids is None:
+            if self.is_generator():
+                ids = [ObjectID.for_task_return(self.task_id, 0)]
+            else:
+                ids = [ObjectID.for_task_return(self.task_id, i)
+                       for i in range(self.num_returns)]
+            self._return_ids = ids
+        return ids
 
     def shape_key(self) -> Tuple:
         """Lease reuse key: tasks with the same shape share leased workers
@@ -119,14 +157,17 @@ class TaskSpec:
         the FULL runtime environment — the raylet dedicates workers per
         env (runtime_env_key) and lease handoff between different envs
         would bypass that isolation (stale sys.path/cwd/modules)."""
-        return (
-            tuple(sorted(self.resources.items())),
-            self.scheduling_strategy.kind,
-            self.scheduling_strategy.placement_group_id,
-            self.scheduling_strategy.bundle_index,
-            self.scheduling_strategy.node_id,
-            tuple(sorted(self.label_selector.items())),
-        ) + runtime_env_key(self.runtime_env)
+        key = self._shape_key
+        if key is None:
+            key = self._shape_key = (
+                tuple(sorted(self.resources.items())),
+                self.scheduling_strategy.kind,
+                self.scheduling_strategy.placement_group_id,
+                self.scheduling_strategy.bundle_index,
+                self.scheduling_strategy.node_id,
+                tuple(sorted(self.label_selector.items())),
+            ) + runtime_env_key(self.runtime_env)
+        return key
 
     def dependencies(self) -> List[Tuple[ObjectID, Tuple[str, int]]]:
         deps = []
@@ -248,6 +289,353 @@ def _conda_entry(conda) -> "Tuple":
         _conda_key_cache.clear()
     _conda_key_cache[key] = (stat_key, entry)
     return entry
+
+
+# ---------------------------------------------------------------------------
+# Flat wire codec (reference: the protobuf TaskSpecification in
+# src/ray/common/task/task_spec.h is built once and mutated per call —
+# this is the same amortization for a pickle-based runtime).
+#
+# Tasks sharing a shape (same function/method, resources, strategy,
+# runtime env, owner) encode their invariant fields ONCE into a
+# "template" (pickled, content-addressed by a 16-byte blake2b id) and
+# each call ships only a small struct-packed DELTA:
+#
+#   delta := u8 flags | 24s task_id | i64 sequence_number | u32 attempt
+#            [flags&2: u16 len + method_name utf8]            (tombstones)
+#            [flags&1: 2x (u16 len + utf8)]                   (trace ctx)
+#            u16 n_args, then per arg:
+#              0x00 inline: u32 len + data, u16 n_contained + n*28s oids
+#              0x01 ref (no owner): 28s object_id
+#              0x02 ref: 28s object_id, u16 len + host utf8, u32 port
+#
+# No pickler runs in the per-call loop on either side. The receiving
+# process decodes deltas into __slots__ TaskSpec objects drawn from a
+# per-template freelist (constant fields already populated — steady
+# state fills only the per-call slots) and returns them to the pool
+# once the reply has flushed. Exotic specs (dynamic/streaming returns,
+# pickled retry-exception lists) never get a template and transparently
+# ride the pickle path instead.
+# ---------------------------------------------------------------------------
+
+_TEMPLATE_VERSION = 1
+TEMPLATE_ID_LEN = 16
+
+_D_HEAD = struct.Struct("<B24sqI")   # flags, task_id, seq, attempt
+_D_U16 = struct.Struct("<H")
+_D_U32 = struct.Struct("<I")
+_OBJECT_ID_LEN = ObjectID.SIZE
+_TASK_ID_LEN = TaskID.SIZE
+
+_DF_TRACE = 1
+_DF_METHOD = 2
+
+# TaskSpec fields NOT carried by the template (per-call, or codec-local).
+_PER_CALL_FIELDS = ("task_id", "args", "attempt_number", "sequence_number",
+                    "trace_context") + _CODEC_LOCAL_FIELDS
+_TEMPLATE_FIELDS = tuple(
+    name for name in TaskSpec.__dataclass_fields__  # noqa: SLF001
+    if name not in _PER_CALL_FIELDS)
+
+
+# A/B kill switch: RTPU_NO_FLAT_WIRE=1 forces every spec onto the
+# pickle path (same-window codec comparisons; read once — hot path).
+_NO_FLAT_WIRE = bool(os.environ.get("RTPU_NO_FLAT_WIRE"))
+
+
+def flat_supported(spec: TaskSpec) -> bool:
+    """Fast-path eligibility. Anything else pickles (no behavior change)."""
+    if _NO_FLAT_WIRE:
+        return False
+    return (isinstance(spec.num_returns, int)
+            and (spec.retry_exceptions is None
+                 or isinstance(spec.retry_exceptions, bool)))
+
+
+class SpecTemplate:
+    """Driver-side handle: the announce bytes + content id for one shape."""
+
+    __slots__ = ("tid", "data", "method_name")
+
+    def __init__(self, tid: bytes, data: bytes, method_name: str):
+        self.tid = tid
+        self.data = data
+        self.method_name = method_name
+
+    def __reduce__(self):
+        return (SpecTemplate, (self.tid, self.data, self.method_name))
+
+
+def make_template(spec: TaskSpec) -> Optional[SpecTemplate]:
+    """Build the announce-once template for a spec's shape (None when the
+    spec must use the pickle fallback). Called once per handle, not per
+    submit."""
+    if not flat_supported(spec):
+        return None
+    # Strict dumps (cloudpickle fallback), not bare pickle: templates
+    # encode once per shape, and runtime_env contents are user-supplied —
+    # a __main__-defined object must not pickle by reference.
+    fields = {name: getattr(spec, name) for name in _TEMPLATE_FIELDS}
+    try:
+        data = bytes([_TEMPLATE_VERSION]) + serialization.dumps(fields)
+    except Exception:  # noqa: BLE001 — unpicklable env etc: fallback
+        return None
+    tid = hashlib.blake2b(data, digest_size=TEMPLATE_ID_LEN).digest()
+    return SpecTemplate(tid, data, spec.method_name)
+
+
+# The no-arg call bundle is one process-wide TaskArg singleton
+# (remote_function.pack_args registers it here); its encoded args
+# section is a constant — the dominant flood shape encodes as header +
+# one cached bytes append.
+_const_arg: Optional[TaskArg] = None
+_const_arg_section: Optional[bytes] = None
+
+
+def register_constant_arg(arg: TaskArg):
+    global _const_arg, _const_arg_section
+    _const_arg_section = _encode_args([arg])
+    _const_arg = arg
+
+
+def _encode_args(args: List[TaskArg]) -> bytes:
+    parts = [_D_U16.pack(len(args))]
+    for arg in args:
+        if not arg.is_ref:
+            data = arg.data
+            contained = arg.contained_ref_ids
+            parts.append(b"\x00")
+            parts.append(_D_U32.pack(len(data)))
+            parts.append(data)
+            parts.append(_D_U16.pack(len(contained)))
+            for oid in contained:
+                parts.append(oid.binary())
+        elif arg.owner_address is None:
+            parts.append(b"\x01")
+            parts.append(arg.object_id.binary())
+        else:
+            host, port = arg.owner_address
+            hb = host.encode()
+            parts.append(b"\x02")
+            parts.append(arg.object_id.binary())
+            parts.append(_D_U16.pack(len(hb)))
+            parts.append(hb)
+            parts.append(_D_U32.pack(port))
+    return b"".join(parts)
+
+
+def encode_delta(spec: TaskSpec, template_method: str) -> bytes:
+    """Struct-pack the per-call fields of `spec` (no pickler)."""
+    flags = 0
+    trace = spec.trace_context
+    if trace is not None:
+        flags |= _DF_TRACE
+    method = spec.method_name
+    override = method != template_method
+    if override:
+        flags |= _DF_METHOD
+    parts = [_D_HEAD.pack(flags, spec.task_id.binary(),
+                          spec.sequence_number, spec.attempt_number)]
+    if override:
+        mb = method.encode()
+        parts.append(_D_U16.pack(len(mb)))
+        parts.append(mb)
+    if trace is not None:
+        for s in (trace[0], trace[1]):
+            sb = s.encode()
+            parts.append(_D_U16.pack(len(sb)))
+            parts.append(sb)
+    args = spec.args
+    if len(args) == 1 and args[0] is _const_arg:
+        parts.append(_const_arg_section)
+    else:
+        parts.append(_encode_args(args))
+    return b"".join(parts)
+
+
+def delta_encodable(spec: TaskSpec) -> bool:
+    """Per-call bound check against the delta format's u16/u32 fields
+    (arg count, inline bytes, contained refs). Oversized calls — which
+    the pickle path handles fine — must fall back rather than raise
+    struct.error mid-push (that would masquerade as a worker failure)."""
+    args = spec.args
+    if len(args) == 1 and args[0] is _const_arg:
+        return True  # the dominant no-arg shape
+    if len(args) > 0xFFFF:
+        return False
+    for arg in args:
+        if not arg.is_ref and (len(arg.data) >= (1 << 32)
+                               or len(arg.contained_ref_ids) > 0xFFFF):
+            return False
+    return True
+
+
+def peek_task_id(delta: bytes) -> bytes:
+    """The raw task-id bytes of a delta — readable WITHOUT the template,
+    so an unknown-template failure can still be reported per task."""
+    return _D_HEAD.unpack_from(delta, 0)[1]
+
+
+class _Template:
+    """Receiver-side decoded template: prototype field values + the
+    freelist of spec objects whose constant slots are already filled."""
+
+    __slots__ = ("tid", "fields", "method_name", "pool",
+                 "last_args_raw", "last_args")
+
+    def __init__(self, tid: bytes, fields: Dict[str, Any]):
+        self.tid = tid
+        self.fields = fields
+        self.method_name = fields.get("method_name", "")
+        self.pool: List[TaskSpec] = []
+        # Memoized last-seen args section: floods repeat one args shape
+        # per template (usually the constant no-arg bundle), so decode
+        # is a bytes-compare + shared read-only list instead of a parse.
+        self.last_args_raw: Optional[bytes] = None
+        self.last_args: Optional[List[TaskArg]] = None
+
+    def acquire(self) -> TaskSpec:
+        if self.pool:
+            return self.pool.pop()
+        spec = TaskSpec(task_id=None, args=None, **self.fields)
+        spec.flat_template = self
+        return spec
+
+    def release(self, spec: TaskSpec):
+        if len(self.pool) >= 128:
+            return
+        # Per-call slots are overwritten on the next acquire; drop the
+        # heavy ones now so pooled specs don't pin arg payloads, and
+        # undo any tombstone method override.
+        spec.args = None
+        spec.trace_context = None
+        spec._shape_key = None
+        spec._return_ids = None
+        spec.method_name = self.method_name
+        self.pool.append(spec)
+
+
+_template_lock = threading.Lock()
+_templates: Dict[bytes, _Template] = {}
+# The host strings in ref-arg owner addresses repeat endlessly; intern.
+_host_cache: Dict[bytes, str] = {}
+
+
+def register_template(tid: bytes, data: bytes):
+    with _template_lock:
+        if tid in _templates:
+            return
+    if not data or data[0] != _TEMPLATE_VERSION:
+        raise ValueError(f"bad spec template version {data[:1]!r}")
+    fields = serialization.loads(data[1:])
+    tmpl = _Template(tid, fields)
+    with _template_lock:
+        if len(_templates) > 4096:
+            # Partial eviction (oldest half by insertion order): a full
+            # clear() would invalidate templates in active use by every
+            # other shape at once — each would then burn a
+            # need-template/unknown-template round trip, and re-announces
+            # would immediately re-trigger the clear (thrash).
+            for old in list(_templates)[:2048]:
+                del _templates[old]
+        _templates[tid] = tmpl
+
+
+def lookup_template(tid: bytes) -> Optional[_Template]:
+    return _templates.get(tid)
+
+
+def release_spec(spec: TaskSpec):
+    """Return a codec-decoded spec to its freelist (no-op for specs that
+    arrived via the pickle path)."""
+    tmpl = spec.flat_template
+    if type(tmpl) is _Template:
+        tmpl.release(spec)
+
+
+def _intern_host(hb: bytes) -> str:
+    host = _host_cache.get(hb)
+    if host is None:
+        if len(_host_cache) > 1024:
+            _host_cache.clear()
+        host = _host_cache[hb] = hb.decode()
+    return host
+
+
+def _decode_args(raw: bytes) -> List[TaskArg]:
+    (n_args,) = _D_U16.unpack_from(raw, 0)
+    off = 2
+    args: List[TaskArg] = []
+    for _ in range(n_args):
+        kind = raw[off]
+        off += 1
+        if kind == 0:
+            (dlen,) = _D_U32.unpack_from(raw, off)
+            off += 4
+            data = raw[off:off + dlen]
+            off += dlen
+            (n_cont,) = _D_U16.unpack_from(raw, off)
+            off += 2
+            contained = []
+            for _ in range(n_cont):
+                contained.append(ObjectID(raw[off:off + _OBJECT_ID_LEN]))
+                off += _OBJECT_ID_LEN
+            args.append(TaskArg(is_ref=False, data=data,
+                                contained_ref_ids=contained))
+        else:
+            oid = ObjectID(raw[off:off + _OBJECT_ID_LEN])
+            off += _OBJECT_ID_LEN
+            owner = None
+            if kind == 2:
+                (hlen,) = _D_U16.unpack_from(raw, off)
+                off += 2
+                host = _intern_host(raw[off:off + hlen])
+                off += hlen
+                (port,) = _D_U32.unpack_from(raw, off)
+                off += 4
+                owner = (host, port)
+            args.append(TaskArg(is_ref=True, object_id=oid,
+                                owner_address=owner, contained_ref_ids=[]))
+    return args
+
+
+def decode_delta(delta, tmpl: _Template) -> TaskSpec:
+    flags, tid_b, seq, attempt = _D_HEAD.unpack_from(delta, 0)
+    off = _D_HEAD.size
+    method = None
+    if flags & _DF_METHOD:
+        (n,) = _D_U16.unpack_from(delta, off)
+        off += 2
+        method = bytes(delta[off:off + n]).decode()
+        off += n
+    trace = None
+    if flags & _DF_TRACE:
+        (n,) = _D_U16.unpack_from(delta, off)
+        off += 2
+        t0 = bytes(delta[off:off + n]).decode()
+        off += n
+        (n,) = _D_U16.unpack_from(delta, off)
+        off += 2
+        trace = (t0, bytes(delta[off:off + n]).decode())
+        off += n
+    raw_args = bytes(delta[off:])
+    if raw_args == tmpl.last_args_raw:
+        # Receiver never mutates arg objects, so identical args bytes
+        # (the common flood shape) share one decoded read-only list.
+        args = tmpl.last_args
+    else:
+        args = _decode_args(raw_args)
+        tmpl.last_args_raw = raw_args
+        tmpl.last_args = args
+    spec = tmpl.acquire()
+    spec.task_id = TaskID(tid_b)
+    spec.sequence_number = seq
+    spec.attempt_number = attempt
+    spec.args = args
+    spec.trace_context = trace
+    if method is not None:
+        spec.method_name = method
+    return spec
 
 
 def runtime_env_key(runtime_env) -> "Tuple":

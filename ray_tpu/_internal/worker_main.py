@@ -5,6 +5,14 @@ Spawned by a raylet's worker pool. Registers back with the raylet, then
 serves `push_task` RPCs on its CoreWorker until killed, told to exit, or its
 raylet disappears (a dead raylet orphans the worker — exit so nodes die
 cleanly in fault-tolerance tests).
+
+Task frames arrive on the flat wire path (see task_spec's codec): the
+first push of each shape announces a template, every later push is a
+struct-packed delta decoded into a `__slots__` TaskSpec drawn from the
+template's freelist and returned to it once the reply has flushed — the
+steady-state execution loop runs with no pickler and no spec allocation.
+`RTPU_NO_FLAT_WIRE=1` (driver-side) forces the legacy pickled specs for
+A/B runs; this worker serves both forms.
 """
 
 from __future__ import annotations
@@ -44,6 +52,9 @@ def main():
 
     from .core_worker import CoreWorker, set_core_worker
     from .rpc import EventLoopThread
+    # Warm the flat-wire codec (struct tables + template registry) before
+    # the first push lands, keeping import cost off the first task.
+    from . import task_spec as _codec  # noqa: F401
 
     worker = CoreWorker(
         mode="worker", session_name=session, gcs_address=gcs_addr,

@@ -69,6 +69,11 @@ class ActorHandle:
         self._max_task_retries = max_task_retries
         # constant across calls — built once, not per _submit_method
         self._descriptor = FunctionDescriptor("", class_name, "")
+        # flat-wire templates per (method, num_returns, max_retries):
+        # value = (core_worker, job_id, SpecTemplate) — see task_spec
+        # make_template. ActorMethod objects are born per attribute
+        # access, so the cache must live on the handle.
+        self._tmpl_cache: Dict[Any, Any] = {}
 
     @property
     def actor_id(self) -> ActorID:
@@ -91,6 +96,8 @@ class ActorHandle:
         worker = get_core_worker()
         job_id = worker.current_job_id()
         num_returns = options.get("num_returns", 1)
+        max_retries = options.get("max_task_retries",
+                                  self._max_task_retries)
         spec = TaskSpec(
             task_id=TaskID.of(job_id),
             job_id=job_id,
@@ -104,10 +111,16 @@ class ActorHandle:
             name=f"{self._class_name}.{method_name}",
             actor_id=self._actor_id,
             method_name=method_name,
-            max_retries=options.get("max_task_retries",
-                                    self._max_task_retries),
+            max_retries=max_retries,
             trace_context=_trace_ctx(),
         )
+        cache_key = (method_name, num_returns, max_retries)
+        entry = self._tmpl_cache.get(cache_key)
+        if entry is None or entry[0] is not worker or entry[1] != job_id:
+            from ._internal.task_spec import make_template
+            entry = (worker, job_id, make_template(spec))
+            self._tmpl_cache[cache_key] = entry
+        spec.flat_template = entry[2]
         refs = worker.submit_task(spec)
         if num_returns == "streaming":
             from ._internal.object_ref import ObjectRefGenerator

@@ -127,6 +127,57 @@ def multi_client_bench(n_clients: int = 4, n_per: int = 1000,
     return rate
 
 
+def codec_bench(n: int = 20000, results: Optional[Dict[str, float]] = None
+                ) -> Dict[str, float]:
+    """Flat-wire codec vs pickle on a representative no-arg actor-call
+    spec: encode/decode ns per spec and wire bytes per task. Runs
+    in-process (no cluster) — this is the per-call CPU the submit and
+    execute hot paths actually pay."""
+    import pickle
+
+    from ray_tpu._internal import task_spec as ts
+    from ray_tpu._internal.ids import ActorID, JobID, TaskID
+    from ray_tpu.remote_function import pack_args
+
+    job = JobID.from_int(1)
+    spec = ts.TaskSpec(
+        task_id=TaskID.of(job), job_id=job, task_type=ts.ACTOR_TASK,
+        function=ts.FunctionDescriptor("bench", "Sink", ""),
+        args=pack_args((), {}), num_returns=1, resources={},
+        owner_address=("127.0.0.1", 50000), owner_worker_id=b"w" * 28,
+        name="Sink.ping", actor_id=ActorID.of(job), method_name="ping",
+        sequence_number=7)
+    tmpl = ts.make_template(spec)
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    ts.register_template(tmpl.tid, tmpl.data)
+    reg = ts.lookup_template(tmpl.tid)
+    pickled = pickle.dumps(spec, protocol=5)
+
+    def _ns(fn) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (time.perf_counter() - t0) / n * 1e9
+
+    out = {
+        "codec_flat_encode_ns": _ns(
+            lambda: ts.encode_delta(spec, tmpl.method_name)),
+        "codec_flat_decode_ns": _ns(
+            lambda: ts.release_spec(ts.decode_delta(delta, reg))),
+        "codec_pickle_encode_ns": _ns(
+            lambda: pickle.dumps(spec, protocol=5)),
+        "codec_pickle_decode_ns": _ns(lambda: pickle.loads(pickled)),
+        "codec_flat_bytes_per_task": float(len(delta)),
+        "codec_pickle_bytes_per_task": float(len(pickled)),
+    }
+    for metric, value in out.items():
+        _report(metric, value,
+                "bytes" if metric.endswith("per_task") else "ns")
+    if results is not None:
+        results.update(out)
+    return out
+
+
 def _rate(n: int, fn: Callable[[], None]) -> float:
     start = time.perf_counter()
     fn()
@@ -146,8 +197,9 @@ def main(quick: bool = False) -> Dict[str, float]:
     import ray_tpu
 
     scale = 1 if quick else 4
-    ray_tpu.init(num_cpus=8, object_store_memory=2 * 1024**3)
     results = {}
+    codec_bench(n=5000 if quick else 20000, results=results)
+    ray_tpu.init(num_cpus=8, object_store_memory=2 * 1024**3)
 
     @ray_tpu.remote
     def noop():
@@ -334,10 +386,14 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--collectives", action="store_true")
+    parser.add_argument("--codec", action="store_true",
+                        help="flat-codec microbench only (no cluster)")
     parser.add_argument("--world", type=int, default=8)
     parser.add_argument("--mb", type=int, default=64)
     args = parser.parse_args()
     if args.collectives:
         collectives_bench(world=args.world, mb=args.mb)
+    elif args.codec:
+        codec_bench()
     else:
         main(quick=args.quick)

@@ -28,6 +28,7 @@ from ._internal.task_spec import (NORMAL_TASK, TaskArg, TaskSpec, _CallBundle,
 
 
 _EMPTY_ARGS_DATA = None
+_EMPTY_ARGS_ARG = None
 
 
 def _trace_ctx():
@@ -39,15 +40,20 @@ def _trace_ctx():
 
 def pack_args(args: Tuple, kwargs: Dict) -> List[TaskArg]:
     """Bundle (args, kwargs) into TaskArgs: one inline bundle + ref deps."""
-    global _EMPTY_ARGS_DATA
+    global _EMPTY_ARGS_DATA, _EMPTY_ARGS_ARG
     if not args and not kwargs:
         # No-arg calls (actor pings, pollers) dominate control-plane
-        # floods; their bundle bytes are constant — pickle once.
-        if _EMPTY_ARGS_DATA is None:
+        # floods; their bundle bytes are constant — pickle once, and
+        # share ONE TaskArg template (nothing mutates inline args; only
+        # the per-spec args LIST must be fresh).
+        if _EMPTY_ARGS_ARG is None:
             _EMPTY_ARGS_DATA = serialization.serialize(
                 _CallBundle((), {})).to_bytes()
-        return [TaskArg(is_ref=False, data=_EMPTY_ARGS_DATA,
-                        contained_ref_ids=[])]
+            _EMPTY_ARGS_ARG = TaskArg(is_ref=False, data=_EMPTY_ARGS_DATA,
+                                      contained_ref_ids=[])
+            from ._internal.task_spec import register_constant_arg
+            register_constant_arg(_EMPTY_ARGS_ARG)
+        return [_EMPTY_ARGS_ARG]
     refs: List[ObjectRef] = []
 
     def hoist(value):
@@ -76,6 +82,11 @@ class RemoteFunction:
         functools.update_wrapper(self, function)
         self._descriptor = None
         self._descriptor_owner = None
+        # (worker, job_id, SpecTemplate, shape_key): the flat-wire
+        # template and the lease shape key are invariant per handle —
+        # computed on the first submit, reused until the core worker or
+        # job changes (init/shutdown cycles, nested submissions).
+        self._call_shape = None
 
     def options(self, **new_options) -> "RemoteFunction":
         merged = dict(self._options)
@@ -121,6 +132,20 @@ class RemoteFunction:
             enable_task_events=opts.get("enable_task_events", True),
             trace_context=_trace_ctx(),
         )
+        # Handle-level shape cache, invalidated on runtime_env CONTENT
+        # change: upload_packages re-hashes working_dir/py_modules per
+        # call, so an edited package shows up as a different env dict
+        # here — freezing on (worker, job) alone would pin the stale
+        # template/shape key (and the old package) forever.
+        shape = self._call_shape
+        if shape is None or shape[0] is not worker or shape[1] != job_id \
+                or shape[2] != spec.runtime_env:
+            from ._internal.task_spec import make_template
+            shape = (worker, job_id, spec.runtime_env,
+                     make_template(spec), spec.shape_key())
+            self._call_shape = shape
+        spec.flat_template = shape[3]
+        spec._shape_key = shape[4]
         refs = worker.submit_task(spec)
         if num_returns == "streaming":
             from ._internal.object_ref import ObjectRefGenerator

@@ -99,24 +99,49 @@ class Histogram(Metric):
                  tag_keys: Optional[Sequence[str]] = None):
         super().__init__(name, description, tag_keys)
         self._boundaries = list(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        # Batched observations: observe() is on task-submission hot
+        # paths, so it only appends (key, value) — GIL-atomic, no lock —
+        # and the bucket/sum/count fold runs once per flush/snapshot
+        # under ONE lock acquisition for the whole batch.
+        self._pending: list = []
 
     def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
-        key = self._key(tags)
+        self._pending.append((self._key(tags), value))
+        if len(self._pending) >= 4096:
+            self._fold()  # bound memory between flushes under floods
+
+    def _fold(self):
+        if not self._pending:
+            return
         with self._lock:
-            state = self._series.get(key)
-            if state is None:
-                state = {"buckets": [0] * (len(self._boundaries) + 1),
-                         "sum": 0.0, "count": 0,
-                         "boundaries": self._boundaries}
-                self._series[key] = state
-            for i, bound in enumerate(self._boundaries):
-                if value <= bound:
-                    state["buckets"][i] += 1
-                    break
-            else:
-                state["buckets"][-1] += 1
-            state["sum"] += value
-            state["count"] += 1
+            # Fold a length-snapshot prefix and delete it in place:
+            # concurrent lock-free appends land past the snapshot and
+            # survive the del — no observation is ever lost to the race.
+            pending_list = self._pending
+            n = len(pending_list)
+            pending = pending_list[:n]
+            series = self._series
+            boundaries = self._boundaries
+            for key, value in pending:
+                state = series.get(key)
+                if state is None:
+                    state = {"buckets": [0] * (len(boundaries) + 1),
+                             "sum": 0.0, "count": 0,
+                             "boundaries": boundaries}
+                    series[key] = state
+                for i, bound in enumerate(boundaries):
+                    if value <= bound:
+                        state["buckets"][i] += 1
+                        break
+                else:
+                    state["buckets"][-1] += 1
+                state["sum"] += value
+                state["count"] += 1
+            del pending_list[:n]
+
+    def snapshot(self) -> Dict[str, Any]:
+        self._fold()
+        return super().snapshot()
 
 
 class LazyMetrics:

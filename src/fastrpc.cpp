@@ -20,7 +20,12 @@
 //
 // Wire format (shared with the pure-Python asyncio fallback in rpc.py):
 //   u32le total_len, then `total_len` bytes of frame body. The body's
-//   layout (msg id, flags, method, payload) is parsed in Python.
+//   layout (msg id, flags, method, payload) is parsed in Python. The
+//   frame types ride in the body's flags byte and are OPAQUE here —
+//   including FLAG_RAW (bit2), the flat task path's template-announce +
+//   delta frames, whose payload is struct-packed rather than pickled.
+//   This core forwards those bodies untouched: no re-encoding, no flag
+//   interpretation, so new frame types never require a native rebuild.
 //
 // Event kinds delivered by frpc_recv:
 //   0 = frame (data = frame body)

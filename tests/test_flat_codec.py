@@ -1,0 +1,257 @@
+"""Flat-wire task codec: exhaustive round-trip vs the pickle path,
+fallback triggers for exotic specs, freelist behavior, and the
+no-pickler-on-the-submit-path regression guard."""
+
+import dataclasses
+import pickle
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal import task_spec as ts
+from ray_tpu._internal.core_worker import (_pack_actor_batch,
+                                           _pack_push_task,
+                                           _unpack_actor_batch,
+                                           _unpack_push_task)
+from ray_tpu._internal.ids import (ActorID, JobID, ObjectID,
+                                   PlacementGroupID, TaskID)
+
+# Codec-local fields excluded from wire comparisons (caches + pool link).
+_LOCAL_FIELDS = ("flat_template", "_shape_key", "_return_ids")
+
+
+def _full_spec(**overrides) -> ts.TaskSpec:
+    """A spec with EVERY field set to a non-default value."""
+    job = JobID.from_int(7)
+    actor_id = ActorID.of(job)
+    kwargs = dict(
+        task_id=TaskID.of(job),
+        job_id=job,
+        task_type=ts.ACTOR_TASK,
+        function=ts.FunctionDescriptor("mod", "Cls.fn", "abc123"),
+        args=[
+            ts.TaskArg(is_ref=False, data=b"\x01payload\x00bytes",
+                       contained_ref_ids=[ObjectID.from_random(),
+                                          ObjectID.from_random()]),
+            ts.TaskArg(is_ref=True, object_id=ObjectID.from_random(),
+                       owner_address=("10.0.0.7", 61234)),
+            ts.TaskArg(is_ref=True, object_id=ObjectID.from_random(),
+                       owner_address=None),
+        ],
+        num_returns=3,
+        resources={"CPU": 2.0, "TPU": 1.0},
+        owner_address=("127.0.0.1", 43210),
+        owner_worker_id=b"o" * 28,
+        name="Cls.fn-call",
+        scheduling_strategy=ts.SchedulingStrategy(
+            kind="placement_group",
+            placement_group_id=PlacementGroupID.of(job),
+            bundle_index=2, capture_child_tasks=True,
+            node_id="feed" * 14, soft=True,
+            label_selector={"zone": "us-central2-b"}),
+        max_retries=4,
+        retry_exceptions=True,
+        attempt_number=2,
+        runtime_env={"env_vars": {"A": "1"}, "working_dir": "/tmp/wd"},
+        label_selector={"accelerator": "v5e", "pool": "a,b\"c"},
+        actor_id=actor_id,
+        method_name="fn",
+        sequence_number=123456789,
+        max_restarts=5,
+        max_task_retries=6,
+        max_concurrency=9,
+        concurrency_groups={"io": 4, "compute": 2},
+        is_asyncio=True,
+        is_detached=True,
+        generator_backpressure=17,
+        enable_task_events=False,
+        trace_context=("trace-id-01", "span-id-02"),
+    )
+    kwargs.update(overrides)
+    return ts.TaskSpec(**kwargs)
+
+
+def _assert_specs_equal(a: ts.TaskSpec, b: ts.TaskSpec):
+    for f in dataclasses.fields(ts.TaskSpec):
+        if f.name in _LOCAL_FIELDS:
+            continue
+        assert getattr(a, f.name) == getattr(b, f.name), f.name
+
+
+def test_roundtrip_every_field():
+    spec = _full_spec()
+    tmpl = ts.make_template(spec)
+    assert tmpl is not None
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    ts.register_template(tmpl.tid, tmpl.data)
+    decoded = ts.decode_delta(delta, ts.lookup_template(tmpl.tid))
+    _assert_specs_equal(spec, decoded)
+    # ...and bit-exact agreement with what the pickle path would carry.
+    pickled = pickle.loads(pickle.dumps(spec, protocol=5))
+    _assert_specs_equal(pickled, decoded)
+
+
+@pytest.mark.parametrize("overrides", [
+    {"task_type": ts.NORMAL_TASK, "actor_id": None, "method_name": "",
+     "sequence_number": -1},
+    {"num_returns": 0},
+    {"trace_context": None},
+    {"args": []},
+    {"retry_exceptions": False},
+    {"retry_exceptions": None},
+    {"scheduling_strategy": ts.SchedulingStrategy()},
+    {"label_selector": {}, "concurrency_groups": {}, "runtime_env": {}},
+])
+def test_roundtrip_variants(overrides):
+    spec = _full_spec(**overrides)
+    tmpl = ts.make_template(spec)
+    assert tmpl is not None
+    ts.register_template(tmpl.tid, tmpl.data)
+    decoded = ts.decode_delta(ts.encode_delta(spec, tmpl.method_name),
+                              ts.lookup_template(tmpl.tid))
+    _assert_specs_equal(spec, decoded)
+
+
+@pytest.mark.parametrize("overrides", [
+    {"num_returns": "dynamic"},
+    {"num_returns": "streaming"},
+    {"retry_exceptions": [ValueError, KeyError]},
+])
+def test_fallback_triggers(overrides):
+    """Exotic specs never get a template — they ride the pickle path."""
+    spec = _full_spec(**overrides)
+    assert not ts.flat_supported(spec)
+    assert ts.make_template(spec) is None
+    # fallback specs still pickle fine (behavioral no-change)
+    clone = pickle.loads(pickle.dumps(spec, protocol=5))
+    _assert_specs_equal(spec, clone)
+
+
+def test_tombstone_method_override():
+    """Driver-side cancellation rewrites method_name AFTER the template
+    was built; the delta must carry the override."""
+    spec = _full_spec()
+    tmpl = ts.make_template(spec)
+    ts.register_template(tmpl.tid, tmpl.data)
+    spec.method_name = "__rtpu_cancelled__"
+    decoded = ts.decode_delta(ts.encode_delta(spec, tmpl.method_name),
+                              ts.lookup_template(tmpl.tid))
+    assert decoded.method_name == "__rtpu_cancelled__"
+
+
+def test_freelist_reuse_and_reset():
+    spec = _full_spec()
+    tmpl = ts.make_template(spec)
+    ts.register_template(tmpl.tid, tmpl.data)
+    reg = ts.lookup_template(tmpl.tid)
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    first = ts.decode_delta(delta, reg)
+    ts.release_spec(first)
+    second = ts.decode_delta(delta, reg)
+    assert second is first  # pooled object reused
+    _assert_specs_equal(spec, second)
+    # a tombstoned spec returned to the pool must decode clean again
+    spec.method_name = "__rtpu_cancelled__"
+    tomb = ts.encode_delta(spec, tmpl.method_name)
+    ts.release_spec(second)
+    third = ts.decode_delta(tomb, reg)
+    assert third.method_name == "__rtpu_cancelled__"
+    ts.release_spec(third)
+    fourth = ts.decode_delta(delta, reg)
+    assert fourth.method_name == "fn"  # override did not stick
+
+
+def test_pickle_excludes_codec_caches():
+    """Fallback-path pickles must not carry the memoized shape key /
+    return ids / template handle (sender-local caches the old wire
+    format never shipped)."""
+    spec = _full_spec()
+    spec.shape_key()
+    spec.return_ids()
+    spec.flat_template = object()  # unpicklable: proves it is dropped
+    clone = pickle.loads(pickle.dumps(spec, protocol=5))
+    assert clone.flat_template is None
+    assert clone._shape_key is None
+    assert clone._return_ids is None
+    _assert_specs_equal(spec, clone)
+
+
+def test_template_announce_is_content_addressed():
+    spec = _full_spec()
+    t1 = ts.make_template(spec)
+    # same shape, different per-call fields -> same id
+    job = JobID.from_int(7)
+    same_shape = _full_spec(
+        actor_id=spec.actor_id, scheduling_strategy=spec.scheduling_strategy,
+        task_id=TaskID.of(job), sequence_number=5, attempt_number=0,
+        args=[], trace_context=None)
+    t2 = ts.make_template(same_shape)
+    assert t1.tid == t2.tid
+    t3 = ts.make_template(_full_spec(
+        actor_id=spec.actor_id, scheduling_strategy=spec.scheduling_strategy,
+        method_name="other"))
+    assert t3.tid != t1.tid
+
+
+def test_push_frame_packing():
+    tid = b"t" * ts.TEMPLATE_ID_LEN
+    for tmpl_data in (None, b"template-bytes"):
+        payload = _pack_push_task(tid, 42, tmpl_data, b"delta-bytes")
+        got = _unpack_push_task(payload)
+        assert got[0] == tid and got[1] == 42 and got[2] == tmpl_data
+        assert bytes(got[3]) == b"delta-bytes"
+
+
+def test_actor_batch_packing():
+    tid = b"u" * ts.TEMPLATE_ID_LEN
+    payload = _pack_actor_batch(
+        ("127.0.0.1", 50123), [(tid, b"tmpl")],
+        [(tid, b"d0"), (tid, b"d1")])
+    done_to, tmpls, frames = _unpack_actor_batch(payload)
+    assert done_to == ("127.0.0.1", 50123)
+    assert tmpls == [(tid, b"tmpl")]
+    assert [(t, bytes(d)) for t, d in frames] == [(tid, b"d0"),
+                                                 (tid, b"d1")]
+
+
+def test_no_cloudpickle_on_steady_state_submit(ray_start_regular):
+    """Regression guard: the steady-state submit path for plain-args
+    tasks and actor calls must not invoke cloudpickle.dumps (patch and
+    count). Export/warm-up may; the loop may not."""
+    import cloudpickle
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self, x):
+            return x
+
+    sink = Sink.remote()
+    # Warm: function/class export (cloudpickle allowed here), template
+    # announce, lease acquisition.
+    ray_tpu.get([add.remote(1, 2) for _ in range(5)])
+    ray_tpu.get([sink.ping.remote(3) for _ in range(5)])
+
+    calls = []
+    real_dumps = cloudpickle.dumps
+
+    def counting_dumps(*args, **kwargs):
+        calls.append(args[0] if args else None)
+        return real_dumps(*args, **kwargs)
+
+    cloudpickle.dumps = counting_dumps
+    try:
+        refs = [add.remote(i, i) for i in range(40)]
+        refs += [sink.ping.remote(i) for i in range(40)]
+        results = ray_tpu.get(refs)
+    finally:
+        cloudpickle.dumps = real_dumps
+    assert results[:40] == [2 * i for i in range(40)]
+    assert results[40:] == list(range(40))
+    assert not calls, f"cloudpickle.dumps ran on the submit path: {calls!r}"
+    # and the flat wire path was actually exercised
+    from ray_tpu._internal.core_worker import get_core_worker
+    assert get_core_worker()._tmpl_sent
