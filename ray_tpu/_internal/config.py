@@ -106,6 +106,18 @@ _DEFAULTS: Dict[str, Any] = {
     # --- memory monitor ---
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,
+    # Watermark BELOW the kill threshold at which the raylet starts
+    # emitting MEMORY_PRESSURE events (reference: memory_monitor.h
+    # usage_threshold vs min_memory_free_bytes two-level policy).
+    "memory_monitor_watermark": 0.90,
+    # Policy hook: stop granting NEW worker leases while node memory
+    # sits above the watermark — requests queue (or spill back to a
+    # healthy node) and grant once pressure clears; grant_or_reject
+    # callers (actor scheduling) get a transient rejection instead.
+    # Existing leases run on.
+    "memory_pressure_refuse_leases": False,
+    # --- cluster event log ---
+    "event_log_max_entries": 10_000,
     # --- metrics ---
     "metrics_report_interval_s": 5.0,
     # --- task events (reference: RAY_task_events_* flags) ---

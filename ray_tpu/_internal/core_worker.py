@@ -102,6 +102,44 @@ def set_core_worker(worker: Optional["CoreWorker"]):
 # Reference counting (reference: src/ray/core_worker/reference_count.cc)
 # ---------------------------------------------------------------------------
 
+# Callsite capture for `ray memory`-style attribution. Read ONCE: an
+# os.environ.get per put()/submit would sit on the hot path.
+_NO_CALLSITES = bool(os.environ.get("RTPU_NO_CALLSITES"))
+# Trailing separator: a bare prefix would also swallow sibling dirs
+# like .../ray_tpu_addons and misattribute their frames.
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) \
+    + os.sep
+# (code object, lineno) -> rendered site; call sites repeat across loops
+# so the f-string render happens once per distinct site, not per call.
+_callsite_cache: Dict[Tuple[Any, int], str] = {}
+
+
+def _capture_callsite() -> Optional[str]:
+    """First stack frame outside the ray_tpu package, as
+    "file.py:lineno:function" (reference: CoreWorker ref creation
+    callsites feeding `ray memory`). ~1us warm; disabled entirely by
+    RTPU_NO_CALLSITES=1."""
+    if _NO_CALLSITES:
+        return None
+    frame = sys._getframe(1)
+    depth = 0
+    while frame is not None and depth < 16:
+        code = frame.f_code
+        if not code.co_filename.startswith(_PKG_DIR):
+            key = (code, frame.f_lineno)
+            site = _callsite_cache.get(key)
+            if site is None:
+                if len(_callsite_cache) > 4096:
+                    _callsite_cache.clear()
+                site = (f"{code.co_filename}:{frame.f_lineno}:"
+                        f"{code.co_name}")
+                _callsite_cache[key] = site
+            return site
+        frame = frame.f_back
+        depth += 1
+    return None
+
+
 @dataclass
 class RefEntry:
     local: int = 0
@@ -112,9 +150,27 @@ class RefEntry:
     in_plasma: bool = False
     owner_address: Optional[Address] = None
     lineage_task: Optional[TaskID] = None
+    size: int = 0             # serialized bytes (0 = unknown yet)
+    callsite: Optional[str] = None  # creation site (put()/task submit)
 
     def total(self) -> int:
         return self.local + self.submitted + self.borrowers + self.contained_in
+
+
+def classify_reference(entry: RefEntry) -> str:
+    """Reference-kind classification for memory reports (reference: the
+    ray memory row types out of reference_count.cc). Precedence: a ref
+    held by a pending task outranks mere store residency — the question
+    a leak hunt asks is "what is KEEPING this object alive"."""
+    if not entry.is_owner:
+        return "BORROWED"
+    if entry.submitted > 0:
+        return "USED_BY_PENDING_TASK"
+    if entry.contained_in > 0:
+        return "CAPTURED_IN_ACTOR"
+    if entry.in_plasma:
+        return "PINNED_IN_OBJECT_STORE"
+    return "LOCAL_REFERENCE"
 
 
 class ReferenceCounter:
@@ -135,15 +191,21 @@ class ReferenceCounter:
         return entry
 
     def add_owned(self, object_id: ObjectID, in_plasma: bool = False,
-                  lineage_task: Optional[TaskID] = None):
+                  lineage_task: Optional[TaskID] = None,
+                  size: int = 0, callsite: Optional[str] = None):
         with self._lock:
             entry = self._entry(object_id)
             entry.is_owner = True
             entry.in_plasma = entry.in_plasma or in_plasma
             entry.lineage_task = lineage_task
+            if size:
+                entry.size = size
+            if callsite is not None:
+                entry.callsite = callsite
 
     def new_owned_ref(self, object_id: ObjectID, owner_address: Address,
-                      lineage_task: Optional[TaskID] = None) -> ObjectRef:
+                      lineage_task: Optional[TaskID] = None,
+                      callsite: Optional[str] = None) -> ObjectRef:
         """add_owned + the ObjectRef's add_local_ref in ONE lock
         acquisition — the submit hot path creates one owned ref per
         return and the two separate locked calls showed up in n:n
@@ -154,10 +216,23 @@ class ReferenceCounter:
             entry.is_owner = True
             entry.lineage_task = lineage_task
             entry.local += 1
+            entry.callsite = callsite
             if entry.owner_address is None:
                 entry.owner_address = owner_address
         ref._registered = True
         return ref
+
+    def set_sizes(self, pairs: List[Tuple[ObjectID, int]]):
+        """Record serialized sizes for a completed task's returns under
+        ONE lock acquisition (mirrors the batched decrement discipline —
+        completions must not reintroduce per-object locking)."""
+        if not pairs:
+            return
+        with self._lock:
+            for object_id, size in pairs:
+                entry = self._entries.get(object_id)
+                if entry is not None:
+                    entry.size = size
 
     def mark_in_plasma(self, object_id: ObjectID):
         with self._lock:
@@ -299,6 +374,41 @@ class ReferenceCounter:
     def num_refs(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def memory_report(self, limit: int = 10_000) -> List[Dict[str, Any]]:
+        return self.memory_report_with_meta(limit)[0]
+
+    def memory_report_with_meta(self, limit: int = 10_000
+                                ) -> Tuple[List[Dict[str, Any]], bool]:
+        """Per-object introspection rows for `get_memory_report` / `ray
+        memory` (reference: reference_count.cc AddObjectRefStats), plus
+        a truncation flag derived from the SAME snapshot — comparing a
+        later num_refs() against len(rows) would race concurrent
+        puts/submits and spuriously read as truncation. ONE lock
+        acquisition snapshots the table; rendering runs outside it
+        (benign reads of mutable entries — observability tolerates a
+        racing decrement)."""
+        with self._lock:
+            items = list(self._entries.items())
+        rows = []
+        for oid, entry in items:
+            rows.append({
+                "object_id": oid.hex(),
+                "size": entry.size,
+                "kind": classify_reference(entry),
+                "callsite": entry.callsite,
+                "local": entry.local,
+                "submitted": entry.submitted,
+                "borrowers": entry.borrowers,
+                "contained_in": entry.contained_in,
+                "is_owner": entry.is_owner,
+                "in_plasma": entry.in_plasma,
+            })
+        truncated = len(rows) > limit
+        if truncated:
+            rows.sort(key=lambda r: -r["size"])
+            rows = rows[:limit]
+        return rows, truncated
 
 
 # ---------------------------------------------------------------------------
@@ -481,20 +591,25 @@ class TaskManager:
         # not-in-store concludes the result was LOST and spuriously
         # reconstructs (deleting/resubmitting a task that just finished).
         returns = reply.get("returns", [])
+        sizes: List[Tuple[ObjectID, int]] = []
         for i, ret in enumerate(returns):
             oid = ObjectID.for_task_return(spec.task_id, ret.get("index", i))
             if ret.get("plasma"):
+                sizes.append((oid, ret.get("size", 0)))
                 self._cw.reference_counter.mark_in_plasma(oid)
                 self._cw.memory_store.put(oid, None, in_plasma=True)
             elif ret.get("refs"):
                 # Contains ObjectRefs: deserialize now so borrows register
                 # inside the sender's transit-pin window.
+                sizes.append((oid, len(ret["data"])))
                 value = serialization.deserialize(ret["data"])
                 self._cw.memory_store.put(oid, value)
             else:
                 # Defer deserialization to the consuming thread (off the
                 # io loop; parallel across getters).
+                sizes.append((oid, len(ret["data"])))
                 self._cw.memory_store.put_raw(oid, ret["data"])
+        self._cw.reference_counter.set_sizes(sizes)
         num_dynamic = reply.get("num_dynamic")
         if num_dynamic is not None:
             # Generator task: materialize the handle at index 0, owning
@@ -2332,15 +2447,19 @@ class CoreWorker:
         oid = ObjectID.from_random()
         sobj = serialization.serialize(value)
         owner = _owner_address or self.rpc_address
+        callsite = _capture_callsite()
+        nbytes = sobj.total_bytes()
         if sobj.contained_refs:
             self.reference_counter.add_contained(
                 [r.id() for r in sobj.contained_refs])
-        if sobj.total_bytes() <= CONFIG.max_direct_call_object_size:
+        if nbytes <= CONFIG.max_direct_call_object_size:
             # Small puts stay in-process; borrowers fetch via get_object rpc.
-            self.reference_counter.add_owned(oid, in_plasma=False)
+            self.reference_counter.add_owned(oid, in_plasma=False,
+                                             size=nbytes, callsite=callsite)
             self.memory_store.put(oid, value)
         else:
-            self.reference_counter.add_owned(oid, in_plasma=True)
+            self.reference_counter.add_owned(oid, in_plasma=True,
+                                             size=nbytes, callsite=callsite)
             self.put_serialized_to_plasma(oid, sobj, owner=owner)
         return ObjectRef(oid, owner)
 
@@ -2590,8 +2709,10 @@ class CoreWorker:
         self.task_manager.add_pending(spec, dep_ids, contained)
         if dep_ids or contained:
             self.reference_counter.add_submitted(dep_ids + contained)
+        callsite = _capture_callsite()
         refs = [self.reference_counter.new_owned_ref(
-                    oid, self.rpc_address, lineage_task=spec.task_id)
+                    oid, self.rpc_address, lineage_task=spec.task_id,
+                    callsite=callsite)
                 for oid in spec.return_ids()]
         if spec.task_type == ACTOR_TASK:
             self.actor_submitter.submit(spec)
@@ -2840,6 +2961,34 @@ class CoreWorker:
             else:
                 out.append((task_hex, "unknown", None))
         return out
+
+    async def handle_get_memory_report(self, limit: int = 10_000):
+        """Owner-side memory introspection (reference: the per-worker
+        reference-table dump behind `ray memory` / memory_summary()):
+        every live reference with size, kind, creation callsite, and
+        borrower counts."""
+        objects, truncated = \
+            self.reference_counter.memory_report_with_meta(limit=limit)
+        total_refs = self.reference_counter.num_refs()
+        from .runtime_metrics import runtime_metrics
+        runtime_metrics().owned_refs.set(
+            total_refs, tags={"pid": str(os.getpid())})
+        wid = self.worker_id.hex() if isinstance(self.worker_id, bytes) \
+            else str(self.worker_id)
+        return {
+            "worker_id": wid,
+            "pid": os.getpid(),
+            "mode": self.mode,
+            "node_id": self.node_id,
+            "node_index": self.node_index,
+            "num_refs": total_refs,
+            # Rows were dropped: consumers (the leak heuristic) must not
+            # treat absence from `objects` as absence from the table.
+            "truncated": truncated,
+            "num_memory_store_objects": self.memory_store.size(),
+            "num_pending_tasks": self.task_manager.num_pending(),
+            "objects": objects,
+        }
 
     async def handle_get_object(self, object_hex: str):
         oid = ObjectID.from_hex(object_hex)

@@ -23,6 +23,8 @@ restart (the reference's Redis mode).
 from __future__ import annotations
 
 import asyncio
+import collections
+import itertools
 import logging
 import os
 import time
@@ -126,7 +128,16 @@ class GcsServer:
         self._pending_demand: Dict[str, List[Dict[str, float]]] = {}
         # pubsub: channel -> {subscriber addr}
         self.subscribers: Dict[str, Set[Address]] = {}
-        self.task_events: List[Dict[str, Any]] = []
+        # deque(maxlen): overflow drops the oldest entries in O(1) per
+        # append (the old list-based ring shifted 100k entries with
+        # del list[:n] on every overflow batch)
+        self.task_events: collections.deque = collections.deque(
+            maxlen=100_000)
+        # Persistent structured cluster event log (reference:
+        # src/ray/gcs/gcs_server/gcs_ray_event_converter + the event
+        # export API): bounded, snapshot-persisted, queryable.
+        self.events: collections.deque = collections.deque(
+            maxlen=CONFIG.event_log_max_entries)
         self.actor_sched_lock: Optional[asyncio.Lock] = None
 
         self._resource_views: Dict[str, NodeView] = {}
@@ -180,6 +191,7 @@ class GcsServer:
                 "named_actors": self.named_actors, "pgs": self.pgs,
                 "jobs": self.jobs, "kv": self.kv,
                 "job_counter": self._job_counter,
+                "events": list(self.events),
             })
             tmp = self.persist_path + ".tmp"
             with open(tmp, "wb") as f:
@@ -207,6 +219,8 @@ class GcsServer:
         self.jobs = snap["jobs"]
         self.kv = snap["kv"]
         self._job_counter = snap["job_counter"]
+        self.events = collections.deque(
+            snap.get("events", ()), maxlen=CONFIG.event_log_max_entries)
         # Nodes must re-register; mark everything stale until they do.
         for rec in self.nodes.values():
             rec.missed_health_checks = 0
@@ -293,6 +307,8 @@ class GcsServer:
         self._bump_view(node_id)
         self.publish("NODE", {"event": "ALIVE", "node_id": node_id,
                               "address": rec.address})
+        self.add_event("NODE_ALIVE", f"node {node_id[:12]} joined",
+                       node_id=node_id, is_head=is_head)
         self._persist()
         return {"node_index": rec.node_index, "session_name": self.session_name}
 
@@ -513,6 +529,8 @@ class GcsServer:
         self._record_view_removal(node_id)
         self.publish("NODE", {"event": "DEAD", "node_id": node_id,
                               "address": rec.address})
+        self.add_event("NODE_DEAD", f"node {node_id[:12]} dead: {cause}",
+                       severity="ERROR", node_id=node_id, cause=cause)
         # Drop object locations on the dead node; owners reconstruct on demand.
         for key, (owner, locations, size) in list(self.object_dir.items()):
             locations.discard(node_id)
@@ -548,6 +566,8 @@ class GcsServer:
             driver_address=tuple(driver_address) if driver_address else None,
             namespace=namespace, start_time=time.time(),
             metadata=metadata or {})
+        self.add_event("JOB_STARTED", f"job {job_id.hex()[:8]} started",
+                       job_id=job_id.hex())
         self._persist()
         return job_id
 
@@ -562,6 +582,9 @@ class GcsServer:
                 return
             rec.state = "FINISHED"
             rec.end_time = time.time()
+            self.add_event("JOB_FINISHED",
+                           f"job {job_id.hex()[:8]} finished",
+                           job_id=job_id.hex())
         # Raylets reap the job's worker leases on their next heartbeat.
         now = time.monotonic()
         self._finished_jobs[job_id.hex()] = now
@@ -583,7 +606,10 @@ class GcsServer:
         return [
             {"job_id": r.job_id.hex(), "state": r.state,
              "namespace": r.namespace, "start_time": r.start_time,
-             "end_time": r.end_time, "metadata": r.metadata}
+             "end_time": r.end_time, "metadata": r.metadata,
+             # memory_summary() queries each RUNNING driver's reference
+             # table through this address
+             "driver_address": r.driver_address}
             for r in self.jobs.values()
         ]
 
@@ -662,17 +688,92 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     async def handle_add_task_events(self, events: List[Dict[str, Any]]):
+        # deque(maxlen=100_000): append past capacity evicts the oldest
+        # entry in O(1) instead of the old O(n) list shift per overflow.
         self.task_events.extend(events)
-        if len(self.task_events) > 100_000:
-            del self.task_events[: len(self.task_events) - 100_000]
         return True
 
     async def handle_get_task_events(self, job_id: Optional[str] = None,
-                                     limit: int = 10_000):
+                                     limit: int = 10_000,
+                                     since: Optional[float] = None):
+        """Last `limit` task events, optionally filtered by job and by
+        `since` — dashboard pollers pass their high-water timestamp
+        instead of refetching the full 100k stream every poll. The
+        filter keeps a 5 s slack below `since`: per-process flush
+        batches land out of order across workers, and a strict cut
+        would permanently drop an event flushed late (its ts below a
+        high-water mark another worker already advanced). Pollers must
+        fold re-delivered events idempotently (the task fold is)."""
         events = self.task_events
+        if since is not None:
+            # Events arrive roughly time-ordered (1 s flush batches);
+            # scan from the right and stop once the old region looks
+            # solid instead of walking all 100k entries per poll. The
+            # stop needs a RUN of stale entries, not the first one: the
+            # deque is arrival-ordered and e.g. a SPAN event carries its
+            # span's START time, so one long-running span at the tail
+            # would otherwise wall off every newer event behind it.
+            cutoff = since - 5.0
+            stale_run = 0
+            out = []
+            for ev in reversed(events):
+                if ev.get("ts", 0.0) <= cutoff:
+                    stale_run += 1
+                    if stale_run >= 256:
+                        break
+                    continue
+                stale_run = 0
+                if not job_id or ev.get("job_id") == job_id:
+                    out.append(ev)
+                    if len(out) >= limit:
+                        break
+            out.reverse()
+            return out
         if job_id:
-            events = [e for e in events if e.get("job_id") == job_id]
-        return events[-limit:]
+            matched = [e for e in events if e.get("job_id") == job_id]
+            return matched[-limit:]
+        if len(events) <= limit:
+            return list(events)
+        return list(itertools.islice(events, len(events) - limit,
+                                     len(events)))
+
+    # ------------------------------------------------------------------
+    # cluster event log (reference: the GCS-backed event table behind
+    # `ray list cluster-events`; bounded, structured, persisted)
+    # ------------------------------------------------------------------
+
+    def add_event(self, event_type: str, message: str = "",
+                  severity: str = "INFO", **fields):
+        ev = {"ts": time.time(), "type": event_type,
+              "severity": severity, "message": message}
+        ev.update(fields)
+        self.events.append(ev)
+
+    async def handle_add_event(self, event_type: str, message: str = "",
+                               severity: str = "INFO",
+                               fields: Optional[Dict[str, Any]] = None):
+        """External publish point (raylets report spill/restore and
+        memory-pressure; workers could report their own)."""
+        self.add_event(event_type, message, severity, **(fields or {}))
+        return True
+
+    async def handle_get_events(self, event_type: Optional[str] = None,
+                                since: Optional[float] = None,
+                                severity: Optional[str] = None,
+                                limit: int = 1000):
+        out = []
+        for ev in reversed(self.events):
+            if since is not None and ev["ts"] <= since:
+                break
+            if event_type and ev["type"] != event_type:
+                continue
+            if severity and ev["severity"] != severity:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
 
     # ------------------------------------------------------------------
     # actors
@@ -877,6 +978,17 @@ class GcsServer:
         return node
 
     def _publish_actor(self, record: ActorRecord):
+        # The existing publish point doubles as the event-log feed:
+        # every externally visible actor state transition lands one row.
+        self.add_event(
+            f"ACTOR_{record.state}",
+            f"actor {record.actor_id.hex()[:12]} "
+            f"({record.spec.function.qualname}) -> {record.state}"
+            + (f": {record.death_cause}" if record.death_cause else ""),
+            severity="ERROR" if record.state == "DEAD" else "INFO",
+            actor_id=record.actor_id.hex(), node_id=record.node_id,
+            num_restarts=record.num_restarts,
+            death_cause=record.death_cause or None)
         self.publish("ACTOR", {
             "actor_id": record.actor_id,
             "state": record.state,
@@ -918,6 +1030,11 @@ class GcsServer:
     async def handle_report_worker_death(self, node_id: str, worker_id: bytes,
                                          cause: str):
         """Raylet tells us a worker process died; fail any actor on it."""
+        self.add_event("WORKER_DIED",
+                       f"worker {worker_id.hex()[:12]} on node "
+                       f"{node_id[:12]} died: {cause}",
+                       severity="WARNING", node_id=node_id,
+                       worker_id=worker_id.hex(), cause=cause)
         for record in list(self.actors.values()):
             if record.worker_id == worker_id and record.state == "ALIVE":
                 await self._handle_actor_failure(record, cause)

@@ -280,10 +280,13 @@ class PlasmaDir:
         try:
             return os.path.getsize(self._file(object_id))
         except FileNotFoundError:
-            data = self._arena_read(object_id)
-            if data is None:
-                raise
-            return len(data)
+            if self._arena is not None:
+                # Native size lookup: the old path copied the whole
+                # object out of the arena just to take len() of it.
+                size = self._arena.size_of(self._akey(object_id))
+                if size is not None:
+                    return size
+            raise
 
     def spill_to(self, object_id: ObjectID, spill_dir: str) -> str:
         """Move object to disk; returns the spilled path."""

@@ -107,6 +107,20 @@ class Raylet:
         self.bundles: Dict[Tuple[PlacementGroupID, int], BundleAccount] = {}
         self.objects: Dict[str, ObjectEntry] = {}
         self.store_used = 0
+        # Spill/restore accounting (reference: local_object_manager.cc
+        # spilled_bytes_total/restored_bytes_total + the pinned-bytes
+        # gauge): feeds runtime_metrics and get_memory_report.
+        self.spilled_objects: Dict[str, int] = {}  # hex -> size
+        self.spilled_bytes = 0
+        self.spilled_bytes_total = 0
+        self.restored_bytes_total = 0
+        self.spill_count = 0
+        self.restore_count = 0
+        # Memory watchdog state (reference: memory_monitor.h): above the
+        # watermark the node is "under pressure" — events are emitted and
+        # the lease policy hook may refuse new grants.
+        self._mem_pressure = False
+        self._last_pressure_event = 0.0
         self.cluster_view: Dict[str, NodeView] = {}
         self._view_ver = -1  # last merged GCS view version (-1 = none)
         self._view_epoch = 0  # GCS incarnation the version belongs to
@@ -202,6 +216,21 @@ class Raylet:
         metrics.raylet_lease_queue.set(len(self.queued), tags=tags)
         metrics.raylet_store_bytes.set(self.store_used, tags=tags)
         metrics.raylet_workers.set(len(self.workers), tags=tags)
+        metrics.store_capacity.set(self.capacity, tags=tags)
+        metrics.store_pinned_bytes.set(
+            sum(e.size for e in self.objects.values() if e.pinned > 0),
+            tags=tags)
+        metrics.store_spilled_bytes.set(self.spilled_bytes, tags=tags)
+
+    def _gcs_event(self, event_type: str, message: str,
+                   severity: str = "INFO", **fields):
+        """Best-effort structured event to the GCS event log."""
+        gcs = self.clients.get(self.gcs_address)
+        fut = asyncio.ensure_future(gcs.call(
+            "add_event", event_type=event_type, message=message,
+            severity=severity, fields=dict(fields, node_id=self.node_id),
+            timeout=10))
+        fut.add_done_callback(lambda f: f.cancelled() or f.exception())
 
     def _flush_metrics(self, gcs):
         """Push this process's registry into the metrics KV. Standalone
@@ -601,12 +630,37 @@ class Raylet:
 
     async def _memory_monitor_loop(self):
         period = CONFIG.memory_monitor_refresh_ms / 1000.0
+        from .runtime_metrics import runtime_metrics
+        tags = {"node": str(self.node_index)}
         while not self._stopped:
             try:
                 await asyncio.sleep(period)
                 usage_fn = (self._memory_usage_fn
                             or self._system_memory_usage_fraction)
                 usage = usage_fn()
+                runtime_metrics().node_mem_used_ratio.set(usage, tags=tags)
+                over_watermark = usage > CONFIG.memory_monitor_watermark
+                if over_watermark and not self._mem_pressure:
+                    logger.warning(
+                        "node memory %.1f%% above watermark %.1f%%",
+                        usage * 100, CONFIG.memory_monitor_watermark * 100)
+                pressure_cleared = self._mem_pressure and not over_watermark
+                self._mem_pressure = over_watermark
+                if pressure_cleared:
+                    # Requests parked while leases were refused must not
+                    # wait for an unrelated release/view change to grant.
+                    self._pump_queue()
+                now = time.monotonic()
+                if over_watermark and \
+                        now - self._last_pressure_event > 30.0:
+                    # Rate-limited: a node camped above the watermark
+                    # must not flood the event log every refresh tick.
+                    self._last_pressure_event = now
+                    self._gcs_event(
+                        "MEMORY_PRESSURE",
+                        f"node memory at {usage * 100:.1f}% (watermark "
+                        f"{CONFIG.memory_monitor_watermark * 100:.0f}%)",
+                        severity="WARNING", used_ratio=usage)
                 if usage > CONFIG.memory_usage_threshold:
                     self._kill_for_memory(usage)
             except asyncio.CancelledError:
@@ -733,7 +787,10 @@ class Raylet:
                 self._refund(req.demand, req.pg)
                 return {"rejected": True, "error": f"grant failed: {e!r}"}
         if spec_meta.get("grant_or_reject"):
-            return {"rejected": True}
+            reply = {"rejected": True}
+            if self._refuse_new_leases():
+                reply["error"] = "node under memory pressure"
+            return reply
         # Spillback: is some other node better placed right now?
         spill = self._pick_spillback(req)
         if spill is not None:
@@ -756,9 +813,18 @@ class Raylet:
                     return (target, addr)
         return None
 
+    def _refuse_new_leases(self) -> bool:
+        """Watchdog policy hook: above the memory watermark (with
+        memory_pressure_refuse_leases on) NEW leases stop granting —
+        requests queue (or spill back) and the monitor pumps the queue
+        when pressure clears; existing leases run on."""
+        return self._mem_pressure and CONFIG.memory_pressure_refuse_leases
+
     def _try_grant(self, req: LeaseRequest):
         """Attempt to allocate resources + a worker; returns awaitable reply
         or None if resources unavailable."""
+        if self._refuse_new_leases():
+            return None
         if req.pg is not None:
             pg_id, index = req.pg
             if index >= 0:
@@ -1052,10 +1118,14 @@ class Raylet:
             ((h, e) for h, e in self.objects.items() if e.pinned == 0),
             key=lambda kv: kv[1].last_access)
         gcs = self.clients.get(self.gcs_address)
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        tags = {"node": str(self.node_index)}
         for object_hex, entry in victims:
             if self.store_used <= target:
                 break
             try:
+                spill_t = time.monotonic()
                 oid = ObjectID.from_hex(object_hex)
                 if self.spill_storage is not None:
                     # Cloud spilling (reference: external_storage.py:398):
@@ -1071,6 +1141,17 @@ class Raylet:
                 entry.spilled_path = path
                 self.store_used -= entry.size
                 del self.objects[object_hex]
+                self.spilled_objects[object_hex] = entry.size
+                self.spilled_bytes += entry.size
+                self.spilled_bytes_total += entry.size
+                self.spill_count += 1
+                metrics.store_spilled_total.inc(entry.size, tags=tags)
+                metrics.store_spill_latency.observe(
+                    time.monotonic() - spill_t, tags=tags)
+                self._gcs_event(
+                    "SPILL",
+                    f"spilled {object_hex[:12]} ({entry.size} bytes)",
+                    object_id=object_hex, size=entry.size, path=path)
                 await gcs.call("add_spilled_location",
                                object_hex=object_hex, path=path, timeout=10)
                 await gcs.call("remove_object_location",
@@ -1125,6 +1206,7 @@ class Raylet:
                               timeout=10)
         spilled = info.get("spilled")
         if spilled and "://" in spilled and self.spill_storage is not None:
+            restore_t = time.monotonic()
             data = await asyncio.get_running_loop().run_in_executor(
                 None, self.spill_storage.get, spilled)
             if data is not None:
@@ -1133,6 +1215,8 @@ class Raylet:
                 self.objects[object_hex] = ObjectEntry(
                     size=size, last_access=time.monotonic())
                 self.store_used += size
+                self._record_restore(object_hex, size,
+                                     time.monotonic() - restore_t)
                 await gcs.call("add_object_location",
                                object_hex=object_hex,
                                node_id=self.node_id,
@@ -1140,11 +1224,14 @@ class Raylet:
                                owner_address=info.get("owner"), timeout=10)
                 return {"ok": True}
         if spilled and "://" not in spilled and os.path.exists(spilled):
+            restore_t = time.monotonic()
             self.plasma.restore_from(oid, spilled)
             size = self.plasma.size_of(oid)
             self.objects[object_hex] = ObjectEntry(
                 size=size, last_access=time.monotonic())
             self.store_used += size
+            self._record_restore(object_hex, size,
+                                 time.monotonic() - restore_t)
             await gcs.call("add_object_location", object_hex=object_hex,
                            node_id=self.node_id, size=info.get("size", size),
                            owner_address=info.get("owner"), timeout=10)
@@ -1224,6 +1311,23 @@ class Raylet:
         await gcs.call("add_object_location", object_hex=object_hex,
                        node_id=self.node_id, size=size,
                        owner_address=None, timeout=10)
+
+    def _record_restore(self, object_hex: str, size: int, latency_s: float):
+        """Fold one spill-restore into the accounting + metrics + event
+        log (both the cloud and the local-disk restore paths land here)."""
+        self.restored_bytes_total += size
+        self.restore_count += 1
+        spilled_size = self.spilled_objects.pop(object_hex, None)
+        if spilled_size is not None:
+            self.spilled_bytes -= spilled_size
+        from .runtime_metrics import runtime_metrics
+        tags = {"node": str(self.node_index)}
+        runtime_metrics().store_restored_total.inc(size, tags=tags)
+        runtime_metrics().store_restore_latency.observe(latency_s,
+                                                        tags=tags)
+        self._gcs_event("RESTORE",
+                        f"restored {object_hex[:12]} ({size} bytes)",
+                        object_id=object_hex, size=size)
 
     async def handle_object_info(self, object_hex: str):
         oid = ObjectID.from_hex(object_hex)
@@ -1383,6 +1487,9 @@ class Raylet:
             entry = self.objects.pop(object_hex, None)
             if entry is not None:
                 self.store_used -= entry.size
+            spilled_size = self.spilled_objects.pop(object_hex, None)
+            if spilled_size is not None:
+                self.spilled_bytes -= spilled_size
             self.plasma.delete(ObjectID.from_hex(object_hex))
         return True
 
@@ -1398,6 +1505,66 @@ class Raylet:
 
     async def handle_ping(self):
         return "pong"
+
+    async def handle_get_memory_report(self, limit: int = 10_000,
+                                       include_workers: bool = True):
+        """Node memory report: raylet store accounting (capacity,
+        resident/pinned/spilled bytes, per-object pin counts + LRU age)
+        plus every local worker's owner-side reference report, fetched
+        concurrently (reference: LocalObjectManager::RecordMetrics +
+        node_manager's FormatGlobalMemoryInfo fan-in)."""
+        now = time.monotonic()
+        rows = []
+        for object_hex, entry in self.objects.items():
+            rows.append({"object_id": object_hex, "size": entry.size,
+                         "pinned": entry.pinned,
+                         "age_s": now - entry.last_access,
+                         "spilled": False})
+            if len(rows) >= limit:
+                break
+        for object_hex, size in self.spilled_objects.items():
+            if len(rows) >= limit:
+                break
+            rows.append({"object_id": object_hex, "size": size,
+                         "pinned": 0, "age_s": None, "spilled": True})
+        report = {
+            "node_id": self.node_id,
+            "node_index": self.node_index,
+            "store": {
+                "capacity": self.capacity,
+                "used_bytes": self.store_used,
+                "pinned_bytes": sum(e.size for e in self.objects.values()
+                                    if e.pinned > 0),
+                "num_objects": len(self.objects),
+                "spilled_bytes": self.spilled_bytes,
+                "num_spilled": len(self.spilled_objects),
+                "spilled_bytes_total": self.spilled_bytes_total,
+                "restored_bytes_total": self.restored_bytes_total,
+                "spill_count": self.spill_count,
+                "restore_count": self.restore_count,
+            },
+            "mem_pressure": self._mem_pressure,
+            "objects": rows,
+            "workers": [],
+        }
+        if include_workers:
+            targets = [h for h in self.workers.values()
+                       if h.address is not None and h.state != "DEAD"]
+
+            async def _one(handle):
+                try:
+                    return await asyncio.wait_for(
+                        self.clients.get(handle.address).call(
+                            "get_memory_report", limit=limit,
+                            timeout=10), 15)
+                except Exception as e:  # noqa: BLE001 — report the gap
+                    return {"worker_id": handle.worker_id.hex(),
+                            "node_id": self.node_id, "pid": handle.pid,
+                            "error": str(e)}
+            if targets:
+                report["workers"] = list(await asyncio.gather(
+                    *(_one(h) for h in targets)))
+        return report
 
     async def handle_get_node_stats(self):
         return {

@@ -70,6 +70,48 @@ def _build() -> SimpleNamespace:
             "rtpu_raylet_workers",
             "Worker processes in the raylet's pool",
             tag_keys=("node",)),
+        # -- memory observability plane (reference: local_object_manager
+        # pin/spill accounting + memory_monitor.h node RSS watch) --
+        store_capacity=Gauge(
+            "rtpu_store_capacity_bytes",
+            "Configured object-store capacity on this node",
+            tag_keys=("node",)),
+        store_pinned_bytes=Gauge(
+            "rtpu_store_pinned_bytes",
+            "Bytes of store objects with a nonzero pin count",
+            tag_keys=("node",)),
+        store_spilled_bytes=Gauge(
+            "rtpu_store_spilled_bytes",
+            "Bytes currently spilled out of the store to "
+            "disk/cloud",
+            tag_keys=("node",)),
+        store_spilled_total=Counter(
+            "rtpu_store_spilled_bytes_total",
+            "Cumulative bytes spilled out of the object store",
+            tag_keys=("node",)),
+        store_restored_total=Counter(
+            "rtpu_store_restored_bytes_total",
+            "Cumulative bytes restored from spill storage",
+            tag_keys=("node",)),
+        store_spill_latency=Histogram(
+            "rtpu_store_spill_seconds",
+            "Per-object spill latency",
+            boundaries=_LATENCY_BOUNDARIES,
+            tag_keys=("node",)),
+        store_restore_latency=Histogram(
+            "rtpu_store_restore_seconds",
+            "Per-object restore latency",
+            boundaries=_LATENCY_BOUNDARIES,
+            tag_keys=("node",)),
+        node_mem_used_ratio=Gauge(
+            "rtpu_node_mem_used_ratio",
+            "Used fraction of node system memory "
+            "(/proc/meminfo, memory watchdog)",
+            tag_keys=("node",)),
+        owned_refs=Gauge(
+            "rtpu_worker_owned_refs",
+            "Entries in this process's reference table",
+            tag_keys=("pid",)),
     )
 
 

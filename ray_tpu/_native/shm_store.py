@@ -133,6 +133,17 @@ class ArenaStore:
     def release(self, object_id: bytes):
         self._lib.store_release(self._addr, object_id)
 
+    def size_of(self, object_id: bytes) -> Optional[int]:
+        """Size of a sealed object without copying it out (store_get
+        reports the size; the momentary pin is dropped immediately)."""
+        size = ctypes.c_uint64(0)
+        off = self._lib.store_get(self._addr, object_id,
+                                  ctypes.byref(size))
+        if off == 0:
+            return None
+        self._lib.store_release(self._addr, object_id)
+        return size.value
+
     def delete(self, object_id: bytes) -> bool:
         return self._lib.store_delete(self._addr, object_id) == 0
 

@@ -10,6 +10,8 @@ Usage: python -m ray_tpu.cli <command> ...
   stop                                                   stop local nodes
   status   [--address ...]                               cluster resources
   list     {nodes,actors,tasks,placement_groups,objects,workers,jobs}
+  memory   [--json] [--limit N]                          cluster memory report
+  events   [--type T] [--json] [--limit N]               cluster event log
   timeline [--output FILE]                               chrome trace
   trace    [TRACE_ID] [--json]                           span tree / list
   dashboard                                              start + print URL
@@ -205,6 +207,91 @@ def cmd_list(args):
     print(json.dumps(rows, indent=1, default=str))
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def cmd_memory(args):
+    """Cluster memory report (reference: `ray memory` — per-object rows
+    with owner, reference kind, and callsite, plus store accounting and
+    the pinned-but-unreferenced leak heuristic)."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    summary = st.memory_summary(limit=args.limit)
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+        return
+    for node in summary["nodes"]:
+        store = node["store"]
+        pressure = "  [MEMORY PRESSURE]" if node.get("mem_pressure") else ""
+        print(f"node {node['node_id'][:12]}  store "
+              f"{_fmt_bytes(store.get('used_bytes'))} / "
+              f"{_fmt_bytes(store.get('capacity'))} used, "
+              f"{_fmt_bytes(store.get('pinned_bytes'))} pinned, "
+              f"{_fmt_bytes(store.get('spilled_bytes'))} spilled "
+              f"({store.get('spill_count', 0)} spills, "
+              f"{store.get('restore_count', 0)} restores)"
+              f"{pressure}")
+    print(f"\n{len(summary['objects'])} object refs, "
+          f"{_fmt_bytes(summary['total_owned_bytes'])} owned")
+    header = (f"{'OBJECT ID':<18} {'NODE':<14} {'PID':<7} {'SIZE':>10} "
+              f"{'KIND':<24} {'BORROWERS':>9}  CALLSITE")
+    print(header)
+    print("-" * len(header))
+    for obj in summary["objects"][:args.limit]:
+        site = obj.get("callsite") or "-"
+        if len(site) > 60:
+            site = "..." + site[-57:]
+        print(f"{obj['object_id'][:16]:<18} "
+              f"{(obj.get('node_id') or '?')[:12]:<14} "
+              f"{obj.get('pid') or '?':<7} "
+              f"{_fmt_bytes(obj.get('size')):>10} "
+              f"{obj.get('kind', '?'):<24} "
+              f"{obj.get('borrowers', 0):>9}  {site}")
+    if summary["by_callsite"]:
+        print("\ntop owner callsites by bytes:")
+        for agg in summary["by_callsite"]:
+            print(f"  {_fmt_bytes(agg['total_bytes']):>10}  "
+                  f"x{agg['count']:<5} {agg['callsite']}")
+    if summary.get("leak_heuristic_skipped"):
+        print("\nleak heuristic skipped: some owner reports were "
+              "unreachable or truncated")
+    if summary["leaked"]:
+        print(f"\nPOSSIBLE LEAKS ({len(summary['leaked'])} store objects "
+              "with no owner reference):")
+        for obj in summary["leaked"][:20]:
+            print(f"  {obj['object_id'][:16]}  "
+                  f"{_fmt_bytes(obj.get('size'))}  "
+                  f"node {(obj.get('node_id') or '?')[:12]}"
+                  f"{'  (spilled)' if obj.get('spilled') else ''}")
+    if summary["errors"]:
+        errs = json.dumps(summary["errors"], default=str)
+        print(f"\nunreachable: {errs}")
+
+
+def cmd_events(args):
+    """Render the GCS cluster event log (node/actor/job transitions,
+    SPILL/RESTORE, MEMORY_PRESSURE)."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    events = st.list_events(event_type=args.type, limit=args.limit)
+    if args.json:
+        print(json.dumps(events, indent=1, default=str))
+        return
+    if not events:
+        print("no events recorded")
+        return
+    for ev in events:
+        stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
+        print(f"{stamp}  {ev['severity']:<7} {ev['type']:<18} "
+              f"{ev.get('message', '')}")
+
+
 def cmd_timeline(args):
     _connect(args)
     from ray_tpu.util import state as st
@@ -344,6 +431,19 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--address")
     p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("events")
+    p.add_argument("--type", default=None)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="timeline.json")

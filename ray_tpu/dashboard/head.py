@@ -12,6 +12,10 @@ Routes:
   GET  /api/jobs/<id>/logs            {"logs": ...}
   POST /api/jobs/<id>/stop
   GET  /api/timeline                  chrome-trace JSON of task spans
+                                      (?since= for incremental polls)
+  GET  /api/memory                    cluster memory summary (stores,
+                                      per-object refs, leak heuristic)
+  GET  /api/events                    GCS cluster event log
   GET  /api/traces                    recorded trace summaries
   GET  /api/traces/<trace_id>         one trace's span tree
   GET  /metrics                       Prometheus exposition
@@ -163,9 +167,11 @@ class DashboardHead:
         if path == "/api/actors":
             return self._json(st.list_actors())
         if path == "/api/tasks":
+            since = query.get("since")
             return self._json(st.list_tasks(
                 job_id=query.get("job_id"),
-                limit=int(query.get("limit", 1000))))
+                limit=int(query.get("limit", 1000)),
+                since=float(since) if since else None))
         if path == "/api/placement_groups":
             return self._json(st.list_placement_groups())
         if path == "/api/objects":
@@ -173,7 +179,19 @@ class DashboardHead:
         if path == "/api/workers":
             return self._json(st.list_workers())
         if path == "/api/timeline":
-            return self._json(st.timeline())
+            since = query.get("since")
+            return self._json(st.timeline(
+                job_id=query.get("job_id"),
+                since=float(since) if since else None))
+        if path == "/api/memory":
+            return self._json(st.memory_summary(
+                limit=int(query.get("limit", 1000))))
+        if path == "/api/events":
+            since = query.get("since")
+            return self._json(st.list_events(
+                event_type=query.get("type"),
+                since=float(since) if since else None,
+                limit=int(query.get("limit", 500))))
         if path == "/api/traces":
             return self._json(st.list_traces(
                 limit=int(query.get("limit", 100))))

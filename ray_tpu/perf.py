@@ -14,7 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import time
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
@@ -173,6 +173,50 @@ def codec_bench(n: int = 20000, results: Optional[Dict[str, float]] = None
     for metric, value in out.items():
         _report(metric, value,
                 "bytes" if metric.endswith("per_task") else "ns")
+    if results is not None:
+        results.update(out)
+    return out
+
+
+def callsite_bench(n: int = 200_000,
+                   results: Optional[Dict[str, float]] = None
+                   ) -> Dict[str, float]:
+    """Memory-observability callsite capture on the submit hot path:
+    ns per _capture_callsite() call (warm render cache), with the
+    RTPU_NO_CALLSITES=1 kill switch. The timed loop is compiled with a
+    NON-package co_filename — perf.py itself lives under ray_tpu/, so a
+    direct call here would classify every frame as a package frame and
+    benchmark the capture-miss walk instead of the real user-frame hit
+    path that put()/submit pays. Runs in-process (no cluster)."""
+    from ray_tpu._internal import core_worker as cw
+
+    src = ("def _user_bench(capture, count, perf_counter):\n"
+           "    t0 = perf_counter()\n"
+           "    for _ in range(count):\n"
+           "        capture()\n"
+           "    return (perf_counter() - t0) / count * 1e9\n")
+    ns: Dict[str, Any] = {}
+    exec(compile(src, "/bench/user_code.py", "exec"), ns)
+    _user_bench = ns["_user_bench"]
+
+    capture = cw._capture_callsite
+    _user_bench(capture, 100, time.perf_counter)  # warm the cache
+    warm = _user_bench(capture, n, time.perf_counter)
+    saved = cw._NO_CALLSITES
+    cw._NO_CALLSITES = True
+    try:
+        disabled = _user_bench(capture, n, time.perf_counter)
+    finally:
+        cw._NO_CALLSITES = saved
+    out = {
+        "callsite_capture_ns": warm,
+        "callsite_disabled_ns": disabled,
+        # fraction of a ~200us per-call driver submit budget (PERF.md)
+        "callsite_pct_of_submit": warm / 200_000.0 * 100.0,
+    }
+    for metric, value in out.items():
+        _report(metric, value,
+                "%" if metric.endswith("of_submit") else "ns")
     if results is not None:
         results.update(out)
     return out
@@ -388,6 +432,9 @@ if __name__ == "__main__":
     parser.add_argument("--collectives", action="store_true")
     parser.add_argument("--codec", action="store_true",
                         help="flat-codec microbench only (no cluster)")
+    parser.add_argument("--callsites", action="store_true",
+                        help="callsite-capture microbench only "
+                             "(no cluster)")
     parser.add_argument("--world", type=int, default=8)
     parser.add_argument("--mb", type=int, default=64)
     args = parser.parse_args()
@@ -395,5 +442,7 @@ if __name__ == "__main__":
         collectives_bench(world=args.world, mb=args.mb)
     elif args.codec:
         codec_bench()
+    elif args.callsites:
+        callsite_bench()
     else:
         main(quick=args.quick)

@@ -9,13 +9,39 @@ _private/state.py:1013 chrome_tracing_dump)."""
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def _gcs():
     from ..._internal.core_worker import get_core_worker
     return get_core_worker().gcs
+
+
+def _live_nodes() -> List[Dict[str, Any]]:
+    return [n for n in _gcs().call_sync("get_all_nodes")
+            if n.get("state") != "DEAD" and n.get("address")]
+
+
+def _fanout(nodes: List[Dict[str, Any]], fn
+            ) -> List[Tuple[Dict[str, Any], Any, Optional[str]]]:
+    """Call `fn(node)` for every node CONCURRENTLY; yields (node,
+    result, error) triples — an unreachable node becomes an error row
+    instead of being silently dropped (and a single slow node no longer
+    serializes the whole sweep behind its timeout)."""
+    if not nodes:
+        return []
+    out = []
+    with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(16, len(nodes))) as pool:
+        futs = [(node, pool.submit(fn, node)) for node in nodes]
+        for node, fut in futs:
+            try:
+                out.append((node, fut.result(), None))
+            except Exception as e:  # noqa: BLE001 — surfaced as a row
+                out.append((node, None, str(e)))
+    return out
 
 
 def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
@@ -93,36 +119,44 @@ def list_jobs(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:
-    """Per-node worker processes, from each raylet's node stats."""
+    """Per-node worker processes, from each raylet's node stats. Nodes
+    are queried concurrently; an unreachable node contributes a
+    `{"node_id", "error"}` row instead of vanishing from the listing."""
     from ..._internal.core_worker import get_core_worker
     cw = get_core_worker()
+
+    def _stats(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "get_node_stats", timeout=10)
+
     out = []
-    for node in _gcs().call_sync("get_all_nodes"):
-        if node.get("state") == "DEAD" or not node.get("address"):
-            continue
-        try:
-            stats = cw.clients.get(tuple(node["address"])).call_sync(
-                "get_node_stats", timeout=10)
-        except Exception:  # noqa: BLE001 — node may be going away
+    for node, stats, error in _fanout(_live_nodes(), _stats):
+        if error is not None:
+            out.append({"node_id": node["node_id"], "error": error})
             continue
         for worker in stats.get("workers", []):
             out.append(dict(worker, node_id=node["node_id"]))
     return out[:limit]
 
 
-def _fetch_events(job_id: Optional[str] = None) -> List[Dict[str, Any]]:
+def _fetch_events(job_id: Optional[str] = None,
+                  limit: int = 100_000,
+                  since: Optional[float] = None) -> List[Dict[str, Any]]:
     return _gcs().call_sync("get_task_events", job_id=job_id,
-                            limit=100_000)
+                            limit=limit, since=since)
 
 
 def list_tasks(job_id: Optional[str] = None, limit: int = 1000,
-               detail: bool = False,
+               detail: bool = False, since: Optional[float] = None,
                _events: Optional[List[Dict[str, Any]]] = None
                ) -> List[Dict[str, Any]]:
     """Task rows folded from the task-event stream: one row per
     (task_id, attempt) with its latest state + phase timings
-    (SUBMITTED→LEASED→RUNNING→FINISHED/FAILED)."""
-    events = _events if _events is not None else _fetch_events(job_id)
+    (SUBMITTED→LEASED→RUNNING→FINISHED/FAILED). `since` restricts the
+    fold to events newer than that timestamp (incremental pollers merge
+    the partial rows client-side instead of refetching 100k events)."""
+    events = _events if _events is not None \
+        else _fetch_events(job_id, since=since)
     rows: Dict[tuple, Dict[str, Any]] = {}
     for ev in events:
         if ev.get("task_id") is None:
@@ -193,7 +227,8 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def timeline(filename: Optional[str] = None,
-             job_id: Optional[str] = None) -> List[Dict[str, Any]]:
+             job_id: Optional[str] = None,
+             since: Optional[float] = None) -> List[Dict[str, Any]]:
     """Chrome-trace ('catapult') export of the task lifecycle
     (reference: ray.timeline → _private/state.py chrome_tracing_dump).
     Per-worker rows carry the execution slice plus its queue/lease
@@ -202,7 +237,7 @@ def timeline(filename: Optional[str] = None,
     # ONE event fetch serves both the task fold and the span rows (the
     # stream caps at 100k dicts — fetching it twice doubled the
     # dashboard hot path's serialization cost).
-    events = _fetch_events(job_id)
+    events = _fetch_events(job_id, since=since)
     trace = []
     for row in list_tasks(job_id=job_id, limit=100_000, _events=events):
         args = {"task_id": row["task_id"], "state": row["state"],
@@ -335,3 +370,171 @@ def get_trace(trace_id: str) -> Dict[str, Any]:
     return {"trace_id": trace_id, "num_spans": len(nodes),
             "num_processes": len({n["pid"] for n in nodes.values()}),
             "roots": roots}
+
+
+# ---------------------------------------------------------------------------
+# memory observability plane (reference: `ray memory` / memory_summary()
+# folding every worker's reference table + the raylet's store accounting)
+# ---------------------------------------------------------------------------
+
+def _collect_memory_reports(limit: int = 10_000) -> Dict[str, Any]:
+    """Raw material for memory_summary(): every node's raylet report
+    (store accounting + that node's worker reference tables, fetched by
+    the raylet concurrently), every RUNNING driver's reference table,
+    and the calling process's own — with error rows for unreachable
+    nodes/drivers instead of silent gaps."""
+    import os
+    from ..._internal.core_worker import get_core_worker
+    cw = get_core_worker()
+
+    def _node_report(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "get_memory_report", limit=limit, timeout=30)
+
+    node_reports, owner_reports, errors = [], [], []
+    for node, report, error in _fanout(_live_nodes(), _node_report):
+        if error is not None:
+            errors.append({"node_id": node["node_id"], "error": error})
+            continue
+        node_reports.append(report)
+        owner_reports.extend(
+            w for w in report.get("workers", ()) if "error" not in w)
+        errors.extend(
+            w for w in report.get("workers", ()) if "error" in w)
+    # The calling driver's own table (it owns most of what a leak hunt
+    # cares about), rendered in-process — no RPC to ourselves.
+    own_rows, own_truncated = \
+        cw.reference_counter.memory_report_with_meta(limit=limit)
+    owner_reports.append({
+        "worker_id": cw.worker_id.hex()
+        if isinstance(cw.worker_id, bytes) else str(cw.worker_id),
+        "pid": os.getpid(), "mode": cw.mode, "node_id": cw.node_id,
+        "node_index": cw.node_index,
+        "truncated": own_truncated,
+        "objects": own_rows,
+    })
+    # Other RUNNING drivers, via the job table's driver addresses.
+    own_addr = tuple(cw.rpc_address) if cw.rpc_address else None
+    drivers = [j for j in _gcs().call_sync("get_all_jobs")
+               if j.get("state") == "RUNNING" and j.get("driver_address")
+               and tuple(j["driver_address"]) != own_addr]
+
+    def _driver_report(job):
+        return cw.clients.get(tuple(job["driver_address"])).call_sync(
+            "get_memory_report", limit=limit, timeout=15)
+
+    for job, report, error in _fanout(drivers, _driver_report):
+        if error is not None:
+            errors.append({"job_id": job.get("job_id"), "error": error})
+        else:
+            owner_reports.append(report)
+    return {"nodes": node_reports, "owners": owner_reports,
+            "errors": errors}
+
+
+def list_object_refs(limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Cluster-wide flat listing of every live object reference with
+    owner attribution (node, pid, size, kind, callsite, borrowers)."""
+    data = _collect_memory_reports(limit=limit)
+    rows: List[Dict[str, Any]] = []
+    for report in data["owners"]:
+        for obj in report.get("objects", ()):
+            rows.append(dict(obj, node_id=report.get("node_id"),
+                             node_index=report.get("node_index"),
+                             pid=report.get("pid"),
+                             worker_id=report.get("worker_id")))
+    rows.sort(key=lambda r: -(r.get("size") or 0))
+    return rows[:limit]
+
+
+def memory_summary(limit: int = 10_000, top: int = 10) -> Dict[str, Any]:
+    """Cluster memory summary (reference: ray memory / memory_summary):
+    per-node store accounting, per-object reference rows grouped by node
+    and by owner callsite (top-N by bytes), plus a leak heuristic —
+    store-resident objects no owner still holds a reference to.
+
+    `limit` trims only the RETURNED object rows; collection always runs
+    at the full 10k-per-owner bound — a display limit must never shrink
+    the `held` set the leak heuristic checks against (a truncated
+    reference table would flag held objects as leaks)."""
+    data = _collect_memory_reports(limit=max(limit, 10_000))
+    objects = []
+    held: set = set()
+    for report in data["owners"]:
+        for obj in report.get("objects", ()):
+            objects.append(dict(obj, node_id=report.get("node_id"),
+                                node_index=report.get("node_index"),
+                                pid=report.get("pid"),
+                                worker_id=report.get("worker_id")))
+            if obj.get("is_owner") and (
+                    obj.get("local") or obj.get("submitted")
+                    or obj.get("borrowers") or obj.get("contained_in")):
+                held.add(obj["object_id"])
+    objects.sort(key=lambda r: -(r.get("size") or 0))
+
+    by_callsite: Dict[str, Dict[str, Any]] = {}
+    for obj in objects:
+        if not obj.get("is_owner"):
+            continue
+        site = obj.get("callsite") or "(callsite disabled)"
+        agg = by_callsite.setdefault(
+            site, {"callsite": site, "count": 0, "total_bytes": 0})
+        agg["count"] += 1
+        agg["total_bytes"] += obj.get("size") or 0
+    top_callsites = sorted(by_callsite.values(),
+                           key=lambda a: -a["total_bytes"])[:top]
+
+    # Leak detection needs EVERY owner's COMPLETE table: a worker that
+    # timed out contributes nothing to `held`, and a truncated report
+    # (>10k refs) silently drops its smallest held entries — either way
+    # absent-from-held stops meaning unreferenced. Skip the heuristic
+    # and say so rather than fill the panel with false positives.
+    leak_heuristic_ok = not data["errors"] and not any(
+        rep.get("truncated") for rep in data["owners"])
+    nodes, leaked = [], []
+    by_node: Dict[str, Dict[str, Any]] = {}
+    for report in data["nodes"]:
+        node_id = report["node_id"]
+        nodes.append({"node_id": node_id,
+                      "node_index": report.get("node_index"),
+                      "mem_pressure": report.get("mem_pressure", False),
+                      "store": report.get("store", {})})
+        agg = by_node.setdefault(node_id, {
+            "node_id": node_id, "owned_count": 0, "owned_bytes": 0})
+        for obj in report.get("objects", ()):
+            # Leak heuristic: a store-resident (pinned) object whose
+            # owner holds no reference of any kind is unreachable from
+            # user code yet still consuming store memory.
+            if leak_heuristic_ok and obj["object_id"] not in held:
+                leaked.append(dict(obj, node_id=node_id))
+    for obj in objects:
+        if not obj.get("is_owner"):
+            continue
+        agg = by_node.setdefault(obj.get("node_id") or "?", {
+            "node_id": obj.get("node_id") or "?",
+            "owned_count": 0, "owned_bytes": 0})
+        agg["owned_count"] += 1
+        agg["owned_bytes"] += obj.get("size") or 0
+    leaked.sort(key=lambda r: -(r.get("size") or 0))
+    return {
+        "nodes": nodes,
+        "objects": objects[:limit],
+        "by_callsite": top_callsites,
+        "by_node": sorted(by_node.values(),
+                          key=lambda a: -a["owned_bytes"]),
+        "leaked": leaked,
+        "leak_heuristic_skipped": not leak_heuristic_ok,
+        "total_owned_bytes": sum((o.get("size") or 0) for o in objects
+                                 if o.get("is_owner")),
+        "errors": data["errors"],
+    }
+
+
+def list_events(event_type: Optional[str] = None,
+                since: Optional[float] = None,
+                severity: Optional[str] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    """The GCS's persistent cluster event log (node ALIVE/DEAD, actor
+    transitions, job state, SPILL/RESTORE, MEMORY_PRESSURE...)."""
+    return _gcs().call_sync("get_events", event_type=event_type,
+                            since=since, severity=severity, limit=limit)

@@ -1,0 +1,499 @@
+"""Memory observability plane tests: reference-kind classification,
+callsite capture, the GCS event log + task-event ring, memory_summary()
+aggregation with the leak heuristic, spill/restore accounting + events,
+and the cli/dashboard surfaces (reference coverage: `ray memory`,
+memory_monitor, cluster events)."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _get(url, timeout=15):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# ---------------------------------------------------------------------------
+# units: reference kinds, callsites, leak heuristic
+# ---------------------------------------------------------------------------
+
+def test_reference_kind_classification():
+    from ray_tpu._internal.core_worker import RefEntry, classify_reference
+
+    assert classify_reference(RefEntry(is_owner=False, borrowers=1)) \
+        == "BORROWED"
+    assert classify_reference(RefEntry(is_owner=True, local=1)) \
+        == "LOCAL_REFERENCE"
+    assert classify_reference(
+        RefEntry(is_owner=True, local=1, in_plasma=True)) \
+        == "PINNED_IN_OBJECT_STORE"
+    # a pending-task hold outranks store residency
+    assert classify_reference(
+        RefEntry(is_owner=True, local=1, submitted=2, in_plasma=True)) \
+        == "USED_BY_PENDING_TASK"
+    assert classify_reference(
+        RefEntry(is_owner=True, contained_in=1, in_plasma=True)) \
+        == "CAPTURED_IN_ACTOR"
+
+
+def test_callsite_capture_and_kill_switch(monkeypatch):
+    from ray_tpu._internal import core_worker as cw
+
+    site = cw._capture_callsite()
+    assert site is not None and "test_memory_observability.py" in site
+    assert site.endswith("test_callsite_capture_and_kill_switch")
+    # repeated capture from the same line hits the render cache
+    def probe():
+        return cw._capture_callsite()
+    a, b = probe(), probe()
+    assert a is b
+    monkeypatch.setattr(cw, "_NO_CALLSITES", True)
+    assert cw._capture_callsite() is None
+
+
+def test_memory_report_rows_and_limit():
+    from ray_tpu._internal.core_worker import ReferenceCounter
+    from ray_tpu._internal.ids import ObjectID
+
+    rc = ReferenceCounter(core_worker=None)
+    big, small = ObjectID.from_random(), ObjectID.from_random()
+    rc.add_owned(big, in_plasma=True, size=1000, callsite="app.py:1:f")
+    rc.add_owned(small, size=10, callsite="app.py:2:g")
+    rows = {r["object_id"]: r for r in rc.memory_report()}
+    assert rows[big.hex()]["kind"] == "PINNED_IN_OBJECT_STORE"
+    assert rows[big.hex()]["size"] == 1000
+    assert rows[big.hex()]["callsite"] == "app.py:1:f"
+    assert rows[small.hex()]["kind"] == "LOCAL_REFERENCE"
+    # over-limit keeps the biggest rows
+    assert rc.memory_report(limit=1)[0]["object_id"] == big.hex()
+    # batched size recording finds existing entries only
+    rc.set_sizes([(small, 77), (ObjectID.from_random(), 5)])
+    rows = {r["object_id"]: r for r in rc.memory_report()}
+    assert rows[small.hex()]["size"] == 77
+
+
+def test_memory_summary_leak_heuristic_unit(monkeypatch):
+    """The fold itself, on synthetic reports: a store-resident object
+    nobody references is flagged; a held one is not."""
+    from ray_tpu.util.state import api as state_api
+
+    held_hex, leaked_hex = "aa" * 20, "bb" * 20
+    fake = {
+        "nodes": [{
+            "node_id": "n1", "node_index": 1, "mem_pressure": False,
+            "store": {"capacity": 100, "used_bytes": 50,
+                      "pinned_bytes": 0, "spilled_bytes": 0,
+                      "num_objects": 2, "num_spilled": 0,
+                      "spilled_bytes_total": 0, "restored_bytes_total": 0,
+                      "spill_count": 0, "restore_count": 0},
+            "objects": [
+                {"object_id": held_hex, "size": 30, "pinned": 1,
+                 "age_s": 1.0, "spilled": False},
+                {"object_id": leaked_hex, "size": 20, "pinned": 1,
+                 "age_s": 9.0, "spilled": False},
+            ],
+            "workers": [],
+        }],
+        "owners": [{
+            "worker_id": "w1", "pid": 1, "node_id": "n1",
+            "node_index": 1,
+            "objects": [
+                {"object_id": held_hex, "size": 30,
+                 "kind": "PINNED_IN_OBJECT_STORE",
+                 "callsite": "train.py:10:step", "local": 1,
+                 "submitted": 0, "borrowers": 0, "contained_in": 0,
+                 "is_owner": True, "in_plasma": True},
+            ],
+        }],
+        "errors": [],
+    }
+    monkeypatch.setattr(state_api, "_collect_memory_reports",
+                        lambda limit=10_000: fake)
+    summary = state_api.memory_summary()
+    leaked_ids = {r["object_id"] for r in summary["leaked"]}
+    assert leaked_ids == {leaked_hex}
+    assert not summary["leak_heuristic_skipped"]
+    assert summary["total_owned_bytes"] == 30
+    assert summary["by_callsite"][0]["callsite"] == "train.py:10:step"
+    # an unreachable owner report disables the heuristic (its refs are
+    # unknown, so absent-from-held stops meaning unreferenced) ...
+    fake["errors"] = [{"worker_id": "w2", "error": "timeout"}]
+    summary = state_api.memory_summary()
+    assert summary["leaked"] == [] and summary["leak_heuristic_skipped"]
+    fake["errors"] = []
+    # ... and so does a truncated one (>10k refs dropped its smallest)
+    fake["owners"][0]["truncated"] = True
+    summary = state_api.memory_summary()
+    assert summary["leaked"] == [] and summary["leak_heuristic_skipped"]
+
+
+# ---------------------------------------------------------------------------
+# units: GCS event log + task-event ring
+# ---------------------------------------------------------------------------
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_gcs_task_event_ring_is_deque():
+    from ray_tpu._internal.gcs import GcsServer
+
+    gcs = GcsServer("evt-test")
+
+    async def drive():
+        await gcs.handle_add_task_events(
+            events=[{"ts": float(i), "job_id": "j1", "i": i}
+                    for i in range(100_500)])
+        assert len(gcs.task_events) == 100_000
+        # oldest 500 dropped, order preserved
+        assert gcs.task_events[0]["i"] == 500
+        last = await gcs.handle_get_task_events(limit=10)
+        assert [e["i"] for e in last] == list(range(100_490, 100_500))
+        # since: newer events plus a 5-unit flush-skew slack (late
+        # flushes from other workers must not be dropped forever);
+        # pollers fold re-delivered events idempotently
+        newer = await gcs.handle_get_task_events(since=100_497.0)
+        assert [e["i"] for e in newer] == list(range(100_493, 100_500))
+        filtered = await gcs.handle_get_task_events(job_id="nope")
+        assert filtered == []
+        # an out-of-order stale entry at the tail (e.g. a SPAN event
+        # stamped with its span's START time) must not wall off newer
+        # events behind it — the scan stops on a RUN of stale entries
+        await gcs.handle_add_task_events(
+            events=[{"ts": 1.0, "job_id": "j1", "i": -1}])
+        newer = await gcs.handle_get_task_events(since=100_497.0)
+        assert [e["i"] for e in newer] == list(range(100_493, 100_500))
+    _run(drive())
+
+
+def test_gcs_event_log_filters_and_bound():
+    from ray_tpu._internal.gcs import GcsServer
+
+    gcs = GcsServer("evt-test2")
+
+    async def drive():
+        t0 = time.time()
+        gcs.add_event("NODE_ALIVE", "n up", node_id="n1")
+        gcs.add_event("SPILL", "spilled x", object_id="o1", size=5)
+        gcs.add_event("NODE_DEAD", "n down", severity="ERROR",
+                      node_id="n1", cause="test")
+        events = await gcs.handle_get_events()
+        assert [e["type"] for e in events] == ["NODE_ALIVE", "SPILL",
+                                               "NODE_DEAD"]
+        assert events[1]["size"] == 5
+        spills = await gcs.handle_get_events(event_type="SPILL")
+        assert len(spills) == 1 and spills[0]["object_id"] == "o1"
+        errors = await gcs.handle_get_events(severity="ERROR")
+        assert len(errors) == 1 and errors[0]["cause"] == "test"
+        assert await gcs.handle_get_events(since=time.time() + 1) == []
+        assert len(await gcs.handle_get_events(since=t0 - 1, limit=2)) == 2
+        # external publish point (the raylet's spill/restore feed)
+        await gcs.handle_add_event(event_type="MEMORY_PRESSURE",
+                                   message="hot", severity="WARNING",
+                                   fields={"used_ratio": 0.97})
+        pressure = await gcs.handle_get_events(
+            event_type="MEMORY_PRESSURE")
+        assert pressure[0]["used_ratio"] == 0.97
+        # bounded by the deque maxlen
+        for i in range(gcs.events.maxlen + 10):
+            gcs.add_event("T", str(i))
+        assert len(gcs.events) == gcs.events.maxlen
+    _run(drive())
+
+
+def test_event_log_survives_persist_restore(tmp_path):
+    from ray_tpu._internal.gcs import GcsServer
+
+    path = str(tmp_path / "gcs.snap")
+    gcs = GcsServer("evt-persist", persist_path=path)
+    gcs.add_event("NODE_ALIVE", "n up", node_id="n1")
+    gcs._persist()
+    fresh = GcsServer("evt-persist", persist_path=path)
+    fresh._restore()
+    assert [e["type"] for e in fresh.events] == ["NODE_ALIVE"]
+
+
+def test_plasma_size_of_arena_no_copy(tmp_path):
+    """size_of answers without copying the object out (native lookup
+    when the arena is available, file stat otherwise)."""
+    from ray_tpu._internal import serialization
+    from ray_tpu._internal.ids import ObjectID
+    from ray_tpu._internal.plasma import PlasmaDir
+
+    store = PlasmaDir(f"sz-{time.time_ns()}", 0)
+    try:
+        oid = ObjectID.from_random()
+        sobj = serialization.serialize(b"x" * 4096)
+        total = store.put_serialized(oid, sobj)
+        assert store.size_of(oid) == total
+        if store._arena is not None:
+            # the native path reports the size directly
+            assert store._arena.size_of(store._akey(oid)) == total
+        with pytest.raises(FileNotFoundError):
+            store.size_of(ObjectID.from_random())
+    finally:
+        store.destroy()
+
+
+# ---------------------------------------------------------------------------
+# e2e: full path worker -> raylet -> GCS -> state API -> HTTP
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def mem_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_memory_plane_e2e(mem_cluster, capsys):
+    from ray_tpu import cli
+    from ray_tpu._internal import serialization
+    from ray_tpu._internal.core_worker import get_core_worker
+    from ray_tpu._internal.ids import ObjectID
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util import state as st
+
+    # A plasma-resident put (owner holds the ref) ...
+    held = ray_tpu.put(np.zeros(256 * 1024, dtype=np.uint8))
+    # ... a small in-process put ...
+    small = ray_tpu.put({"k": 1})
+    # ... and a task whose worker-side report rides the raylet fan-out.
+    @ray_tpu.remote
+    def hold(x):
+        return x.sum()
+    assert ray_tpu.get(hold.remote(held), timeout=120) == 0
+
+    summary = st.memory_summary()
+    rows = {r["object_id"]: r for r in summary["objects"]}
+    held_row = rows[held.hex()]
+    assert held_row["kind"] == "PINNED_IN_OBJECT_STORE"
+    assert held_row["size"] >= 256 * 1024
+    assert held_row["callsite"] and \
+        "test_memory_observability.py" in held_row["callsite"]
+    small_row = rows[small.hex()]
+    assert small_row["kind"] == "LOCAL_REFERENCE"
+    assert small_row["is_owner"]
+    # the held plasma object is NOT a leak
+    leaked_ids = {r["object_id"] for r in summary["leaked"]}
+    assert held.hex() not in leaked_ids
+    # store accounting reflects the sealed object
+    assert summary["nodes"] and \
+        summary["nodes"][0]["store"]["used_bytes"] >= 256 * 1024
+    assert summary["by_callsite"][0]["total_bytes"] > 0
+    assert st.list_object_refs()[0]["size"] > 0
+
+    # Deliberate leak: a get-less plasma put whose driver ref was
+    # dropped — sealed into the store with no reference-table entry.
+    cw = get_core_worker()
+    leak_oid = ObjectID.from_random()
+    sobj = serialization.serialize(np.ones(128 * 1024, dtype=np.uint8))
+    cw.put_serialized_to_plasma(leak_oid, sobj, owner=cw.rpc_address)
+    deadline = time.monotonic() + 30
+    leaked_ids = set()
+    while time.monotonic() < deadline:
+        leaked_ids = {r["object_id"]
+                      for r in st.memory_summary()["leaked"]}
+        if leak_oid.hex() in leaked_ids:
+            break
+        time.sleep(0.5)
+    assert leak_oid.hex() in leaked_ids
+
+    # Event log has the cluster lifecycle rows.
+    events = st.list_events()
+    types = {e["type"] for e in events}
+    assert "NODE_ALIVE" in types and "JOB_STARTED" in types
+
+    # cli memory renders the table + the leak section.
+    class M:
+        address = None
+        json = False
+        limit = 50
+    cli.cmd_memory(M())
+    out = capsys.readouterr().out
+    assert "PINNED_IN_OBJECT_STORE" in out
+    assert "test_memory_observability.py" in out
+    assert "POSSIBLE LEAKS" in out
+    assert leak_oid.hex()[:16] in out
+
+    # cli events renders the log.
+    class E:
+        address = None
+        type = None
+        json = False
+        limit = 100
+    cli.cmd_events(E())
+    out = capsys.readouterr().out
+    assert "NODE_ALIVE" in out
+
+    # Dashboard routes serve the same data.
+    address = start_dashboard()
+    _s, body = _get(f"{address}/api/memory")
+    api_summary = json.loads(body)
+    assert any(o["object_id"] == held.hex()
+               for o in api_summary["objects"])
+    assert leak_oid.hex() in {r["object_id"]
+                              for r in api_summary["leaked"]}
+    _s, body = _get(f"{address}/api/events")
+    assert "NODE_ALIVE" in {e["type"] for e in json.loads(body)}
+    # incremental task polling: future `since` filters everything out
+    _s, body = _get(f"{address}/api/tasks?since={time.time() + 60}")
+    assert json.loads(body) == []
+
+
+def test_list_workers_reports_unreachable_nodes(mem_cluster):
+    from ray_tpu.util import state as st
+
+    @ray_tpu.remote
+    def warm():
+        return 1
+    assert ray_tpu.get(warm.remote(), timeout=120) == 1
+    # Register a node whose raylet address refuses connections: the
+    # listing must carry an error row for it, not silently drop it.
+    from ray_tpu._internal.core_worker import get_core_worker
+    gcs = get_core_worker().gcs
+    gcs.call_sync("register_node", node_id="deadbeef" * 5,
+                  address=("127.0.0.1", 1), resources={}, labels={})
+    workers = st.list_workers()
+    assert any(w.get("error") for w in workers
+               if w.get("node_id") == "deadbeef" * 5)
+    assert any("worker_id" in w for w in workers)
+
+
+def test_spill_restore_roundtrip_events_and_metrics():
+    """put -> spill -> restore shows correct bytes in memory_summary(),
+    emits SPILL/RESTORE events, bumps the spill counters, and the
+    /metrics exposition still parses with the new series present."""
+    # Tiny store so a handful of 2 MiB puts cross the 80% threshold.
+    ray_tpu.init(num_cpus=2, object_store_memory=8 * 1024 * 1024)
+    try:
+        from ray_tpu.util import metrics as metrics_mod
+        from ray_tpu.util import state as st
+
+        blobs = [np.full(2 * 1024 * 1024, i, dtype=np.uint8)
+                 for i in range(4)]
+        refs = [ray_tpu.put(b) for b in blobs]
+
+        deadline = time.monotonic() + 60
+        store = {}
+        while time.monotonic() < deadline:
+            summary = st.memory_summary()
+            store = summary["nodes"][0]["store"]
+            if store["spill_count"] >= 1:
+                break
+            time.sleep(0.5)
+        assert store["spill_count"] >= 1, store
+        assert store["spilled_bytes"] >= 2 * 1024 * 1024
+        assert store["spilled_bytes_total"] >= store["spilled_bytes"]
+        spilled_before = store["spilled_bytes"]
+
+        # get() every ref: spilled ones restore transparently.
+        values = ray_tpu.get(refs, timeout=120)
+        for i, v in enumerate(values):
+            assert v[0] == i and v.nbytes == 2 * 1024 * 1024
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            store = st.memory_summary()["nodes"][0]["store"]
+            if store["restore_count"] >= 1:
+                break
+            time.sleep(0.5)
+        assert store["restore_count"] >= 1, store
+        assert store["restored_bytes_total"] >= 2 * 1024 * 1024
+        assert store["spilled_bytes"] < spilled_before + 1
+
+        # SPILL + RESTORE in the persistent event log, with sizes.
+        deadline = time.monotonic() + 30
+        types = set()
+        while time.monotonic() < deadline:
+            events = st.list_events()
+            types = {e["type"] for e in events}
+            if {"SPILL", "RESTORE"} <= types:
+                break
+            time.sleep(0.5)
+        assert {"SPILL", "RESTORE"} <= types, types
+        spill_ev = next(e for e in events if e["type"] == "SPILL")
+        assert spill_ev["size"] >= 2 * 1024 * 1024
+        assert spill_ev["node_id"]
+
+        # New series ride the hardened exposition: parseable output,
+        # counter present with the spilled bytes.
+        text = metrics_mod.prometheus_text(metrics_mod.snapshot_all())
+        assert "# TYPE rtpu_store_spilled_bytes_total counter" in text
+        assert "# TYPE rtpu_node_mem_used_ratio gauge" in text
+        spilled_line = next(
+            line for line in text.splitlines()
+            if line.startswith("rtpu_store_spilled_bytes_total{"))
+        assert float(spilled_line.rsplit(" ", 1)[1]) >= 2 * 1024 * 1024
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name_part, value = line.rsplit(" ", 1)
+            float(value)  # every sample line parses
+            assert name_part.count('"') % 2 == 0, line
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_node_memory_watchdog_pressure_events_and_lease_policy():
+    """Fake memory pressure: the watchdog gauge follows the injected
+    usage, MEMORY_PRESSURE lands in the event log, and with the policy
+    hook enabled the raylet refuses new leases while hot."""
+    ray_tpu.init(num_cpus=2, object_store_memory=64 * 1024 * 1024)
+    try:
+        from ray_tpu._internal import api as _api
+        from ray_tpu._internal.config import CONFIG
+        from ray_tpu._internal.core_worker import get_core_worker
+        from ray_tpu.util import state as st
+
+        raylet = _api._local_node.raylet
+        # instance attribute: accessed unbound, called with no args
+        raylet._memory_usage_fn = lambda: 0.93
+        deadline = time.monotonic() + 30
+        pressure = False
+        while time.monotonic() < deadline:
+            events = st.list_events(event_type="MEMORY_PRESSURE")
+            if events and raylet._mem_pressure:
+                pressure = True
+                break
+            time.sleep(0.2)
+        assert pressure
+        assert events[-1]["used_ratio"] == pytest.approx(0.93)
+
+        # Policy hook: new lease requests are refused under pressure.
+        CONFIG.apply_system_config({"memory_pressure_refuse_leases": True})
+        try:
+            cw = get_core_worker()
+            reply = cw.clients.get(cw.raylet_address).call_sync(
+                "request_worker_lease",
+                spec_meta={"resources": {"CPU": 1}, "shape_key": ("t",),
+                           "runtime_env": {}, "grant_or_reject": True},
+                timeout=30)
+            assert reply.get("rejected")
+            assert "pressure" in reply.get("error", "")
+            # back under the watermark: leases flow again
+            raylet._memory_usage_fn = lambda: 0.10
+            deadline = time.monotonic() + 15
+            while raylet._mem_pressure and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert not raylet._mem_pressure
+
+            @ray_tpu.remote
+            def ok():
+                return 42
+            assert ray_tpu.get(ok.remote(), timeout=120) == 42
+        finally:
+            CONFIG.apply_system_config(
+                {"memory_pressure_refuse_leases": False})
+    finally:
+        ray_tpu.shutdown()
