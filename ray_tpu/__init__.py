@@ -19,6 +19,15 @@ Public API mirrors the reference's surface so users can switch:
 
 from typing import Any, Optional
 
+import os as _os
+
+if _os.environ.get("RTPU_SANITIZE"):
+    # Lock-order sanitizer must patch threading.Lock/RLock BEFORE the
+    # runtime modules below create their module-level locks. Raylet and
+    # worker mains call this themselves; this covers plain drivers.
+    from ._internal.lint import sanitizer as _sanitizer
+    _sanitizer.enable_from_env()
+
 from ._internal.api import (available_resources, cancel, cluster_resources,
                             get, get_runtime_context, init, is_initialized,
                             kill, nodes, put, shutdown, wait)

@@ -168,12 +168,18 @@ def shutdown():
             worker.gcs.call_sync("mark_job_finished", job_id=worker.job_id,
                                  timeout=10)
         except Exception:
-            pass
+            logger.debug("mark_job_finished failed during shutdown "
+                         "(GCS already gone?)", exc_info=True)
         worker.shutdown()
         set_core_worker(None)
     if _local_node is not None:
         _local_node.stop()
         _local_node = None
+    else:
+        # Remote-cluster driver: no local node to tear down, but this
+        # process's daemon threads still deserve a bounded join.
+        from .threads import shutdown_daemon_threads
+        shutdown_daemon_threads(timeout_s=2.0)
     CONFIG.reset()
 
 

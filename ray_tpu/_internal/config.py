@@ -126,9 +126,43 @@ _DEFAULTS: Dict[str, Any] = {
     "log_to_driver": True,
     # --- train ---
     "train_health_check_interval_s": 1.0,
+    # --- A/B kill switches (every switch lives here so a typo'd
+    # RTPU_* spelling is caught by rtpulint rule L003 instead of
+    # silently doing nothing) ---
+    # Disable the flat-wire task codec; every spec rides the pickle path.
+    "no_flat_wire": False,
+    # Disable owner callsite capture on put()/submit.
+    "no_callsites": False,
+    # Disable the coalesced submit fast path.
+    "no_submit_fastpath": False,
+    # Disable asyncio eager task factory on the io loop.
+    "no_eager_tasks": False,
+    # --- overrides re-read from the environment at their use site
+    # (tests monkeypatch them after CONFIG construction; registered here
+    # so L003 can resolve the names) ---
+    # Force the pure-asyncio RPC transport even when fastrpc built
+    # (fastrpc.py reads the env at attach time).
+    "disable_native_rpc": False,
+    # Container runtime binary for image_uri runtime envs ("" = autodetect).
+    "container_runtime": "",
+    # TPU chip count override (0 = autodetect).
+    "num_tpu_chips": 0,
+    # Bind host for the device-object transfer server.
+    "transfer_host": "127.0.0.1",
 }
 
 _ENV_PREFIX = "RTPU_"
+
+# Process-plumbing environment variables: per-process bootstrap channel
+# (raylet -> worker) and tooling gates, NOT tunable config flags — they
+# carry identities/addresses, so they have no sensible default row in
+# _DEFAULTS. rtpulint L003 resolves RTPU_* env reads against _DEFAULTS
+# first, then this set.
+BOOTSTRAP_ENV = frozenset({
+    "RTPU_WORKER_ID", "RTPU_SESSION", "RTPU_NODE_ID", "RTPU_NODE_INDEX",
+    "RTPU_RAYLET_ADDR", "RTPU_GCS_ADDR", "RTPU_WORKER_PROFILE",
+    "RTPU_SANITIZE", "RTPU_NATIVE_CACHE",
+})
 
 
 def _coerce(value: str, default: Any) -> Any:
@@ -151,7 +185,15 @@ class _Config:
 
     def _load_env(self):
         for name, default in _DEFAULTS.items():
-            env = os.environ.get(_ENV_PREFIX + name)
+            # Canonical spelling is RTPU_<NAME> (uppercase — what the
+            # docs, tests, and kill-switch runbooks use); the historical
+            # exact-case form is honored as a fallback. Before this,
+            # uppercase overrides of lowercase flag names silently did
+            # nothing (e.g. the RTPU_TESTING_RPC_FAILURE chaos spec
+            # never reached CONFIG in spawned workers).
+            env = os.environ.get(_ENV_PREFIX + name.upper())
+            if env is None:
+                env = os.environ.get(_ENV_PREFIX + name)
             if env is not None:
                 self._values[name] = _coerce(env, default)
 
@@ -163,6 +205,10 @@ class _Config:
 
     def get(self, name: str, default: Any = None) -> Any:
         return self._values.get(name, default)
+
+    def known_flags(self):
+        """Registered flag names (for rtpulint L003 and tooling)."""
+        return frozenset(_DEFAULTS)
 
     def apply_system_config(self, overrides: Dict[str, Any]):
         with self._lock:

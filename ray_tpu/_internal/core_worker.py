@@ -102,9 +102,10 @@ def set_core_worker(worker: Optional["CoreWorker"]):
 # Reference counting (reference: src/ray/core_worker/reference_count.cc)
 # ---------------------------------------------------------------------------
 
-# Callsite capture for `ray memory`-style attribution. Read ONCE: an
-# os.environ.get per put()/submit would sit on the hot path.
-_NO_CALLSITES = bool(os.environ.get("RTPU_NO_CALLSITES"))
+# Callsite capture for `ray memory`-style attribution. Read ONCE from
+# the registered flag table: a lookup per put()/submit would sit on the
+# hot path (RTPU_NO_CALLSITES=1 kill switch).
+_NO_CALLSITES = bool(CONFIG.no_callsites)
 # Trailing separator: a bare prefix would also swallow sibling dirs
 # like .../ray_tpu_addons and misattribute their frames.
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__))) \
@@ -181,7 +182,8 @@ class ReferenceCounter:
         # (deadline, oid) FIFO — appended with monotonically increasing
         # deadlines (constant ttl), so the head is always the earliest.
         self._transit_pins: collections.deque = collections.deque()
-        self._sweeper_started = False
+        self._sweeper_thread: Optional[threading.Thread] = None
+        self._sweeper_stop = threading.Event()
 
     def _entry(self, object_id: ObjectID) -> RefEntry:
         entry = self._entries.get(object_id)
@@ -354,18 +356,30 @@ class ReferenceCounter:
             self.add_borrower(oid)
             self._transit_pins.append((time.monotonic() + ttl, oid))
             pinned = True
-        if pinned and not self._sweeper_started:
+        # Liveness-keyed (a signaled-but-not-yet-exited sweeper counts
+        # as stopped): a pin landing in the window between node
+        # teardown's stop signal and the old thread's exit still gets a
+        # live sweeper — a boolean flag lost that race and leaked the
+        # pins' borrower refs. Unlocked pre-check keeps the common case
+        # (sweeper running) lock-free; the decision re-checks under the
+        # lock, and the spawn happens under it too, so ident is set
+        # before anyone else looks.
+        t = self._sweeper_thread
+        if pinned and (t is None or not t.is_alive()
+                       or self._sweeper_stop.is_set()):
             with self._lock:
-                if not self._sweeper_started:
-                    self._sweeper_started = True
-                    t = threading.Thread(target=self._sweep_transit_pins,
-                                         daemon=True,
-                                         name="rtpu-transit-sweeper")
-                    t.start()
+                t = self._sweeper_thread
+                if t is None or not t.is_alive() \
+                        or self._sweeper_stop.is_set():
+                    stop = threading.Event()
+                    self._sweeper_stop = stop
+                    from .threads import spawn_daemon
+                    self._sweeper_thread = spawn_daemon(
+                        self._sweep_transit_pins, args=(stop,),
+                        name="rtpu-transit-sweeper", stop=stop.set)
 
-    def _sweep_transit_pins(self):
-        while True:
-            time.sleep(1.0)
+    def _sweep_transit_pins(self, stop: threading.Event):
+        while not stop.wait(1.0):
             now = time.monotonic()
             while self._transit_pins and self._transit_pins[0][0] <= now:
                 _deadline, oid = self._transit_pins.popleft()
@@ -496,7 +510,9 @@ class TaskEventBuffer:
                         "add_task_events",
                         events=[self._render(i) for i in batch])
                 except Exception:  # noqa: BLE001 — observability best-effort
-                    pass
+                    logger.debug("task-event flush to GCS failed "
+                                 "(dropping %d events)", len(batch),
+                                 exc_info=True)
 
 
 # ---------------------------------------------------------------------------
@@ -953,7 +969,8 @@ class NormalTaskSubmitter:
                              ".txt",
                         timeout=15)
                 except Exception:  # noqa: BLE001
-                    pass
+                    logger.debug("postmortem dump_stacks on %s failed",
+                                 lease.worker_address, exc_info=True)
             return
         ps.unknown += 1
         if ps.unknown >= CONFIG.push_probe_unknown_threshold:
@@ -1301,7 +1318,7 @@ class ActorClientState:
 
 
 # read once: os.environ.get costs ~1us and sat on every hot-path submit
-_NO_SUBMIT_FASTPATH = bool(os.environ.get("RTPU_NO_SUBMIT_FASTPATH"))
+_NO_SUBMIT_FASTPATH = bool(CONFIG.no_submit_fastpath)
 
 # -- flat actor-stream framing ----------------------------------------------
 # One raw `push_actor_tasks` frame (rpc FLAG_RAW — no pickler on either
@@ -1739,6 +1756,8 @@ class ActorTaskSubmitter:
                     info = await self._cw.gcs.call("get_actor_info",
                                                    actor_id=st.actor_id)
                 except Exception:
+                    logger.debug("get_actor_info during reconcile failed; "
+                                 "retrying", exc_info=True)
                     continue
                 if info is None:
                     continue
@@ -2175,7 +2194,8 @@ class TaskExecutor:
             self._cw.gcs.call_sync("actor_exited", actor_id=spec.actor_id,
                                    cause="terminate() called", timeout=10)
         except Exception:
-            pass
+            logger.debug("actor_exited notification failed; GCS health "
+                         "checks will reap the actor", exc_info=True)
         EventLoopThread.get().loop.call_later(0.1, os._exit, 0)
         return self._package_returns(spec, None)
 
@@ -2352,11 +2372,13 @@ class CoreWorker:
             EventLoopThread.get().run_sync(
                 self.submitter.cancel_pending_requests(), timeout=5)
         except Exception:
-            pass
+            logger.debug("cancel_pending_requests failed during shutdown",
+                         exc_info=True)
         try:
             EventLoopThread.get().run_sync(self.server.stop(), timeout=5)
         except Exception:
-            pass
+            logger.debug("rpc server stop failed during shutdown",
+                         exc_info=True)
 
     def current_job_id(self) -> JobID:
         """The job of the task being executed, else this process's job —
@@ -2492,7 +2514,8 @@ class CoreWorker:
                 fut.set_result(self._get_one(ref, None))
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
-        threading.Thread(target=_work, daemon=True).start()
+        from .threads import spawn_daemon
+        spawn_daemon(_work, name="rtpu-get-async")
         return fut
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
@@ -2675,7 +2698,8 @@ class CoreWorker:
             try:
                 hook(object_id)
             except Exception:
-                pass
+                logger.debug("free hook %r failed for %s", hook,
+                             object_id.hex()[:12], exc_info=True)
         self.memory_store.delete([object_id])
         if not in_plasma:
             # Memory-store-only object: the GCS directory never heard of
@@ -2699,7 +2723,9 @@ class CoreWorker:
             await self.gcs.call("free_objects", object_hexes=hexes,
                                 timeout=10)
         except Exception:
-            pass
+            logger.debug("free_objects notify failed for %d objects "
+                         "(directory entries persist until node death)",
+                         len(hexes), exc_info=True)
 
     # -- task submission -------------------------------------------------
 
@@ -2830,7 +2856,8 @@ class CoreWorker:
                               f"{where}")
                 out.write("\n")
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("asyncio task stack capture failed",
+                             exc_info=True)
         finally:
             if path:
                 out.close()
@@ -2931,7 +2958,9 @@ class CoreWorker:
         try:
             await client.oneway("actor_tasks_done", ids=ids, replies=replies)
         except Exception:
-            pass  # owner unreachable; actor-state pubsub recovers the rest
+            # owner unreachable; actor-state pubsub recovers the rest
+            logger.debug("actor_tasks_done to unreachable owner dropped",
+                         exc_info=True)
 
     async def handle_actor_tasks_done(self, ids: bytes, replies):
         # Packed id array: one bytes blob for the batch, replies aligned

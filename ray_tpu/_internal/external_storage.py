@@ -28,7 +28,9 @@ class FsspecStorage:
         try:
             self._fs.makedirs(self._base_path, exist_ok=True)
         except Exception:
-            pass
+            # Object stores (s3/memory) have no real directories.
+            logger.debug("spill prefix makedirs skipped for %s",
+                         self.base_uri, exc_info=True)
 
     def _path(self, key: str) -> str:
         return f"{self._base_path}/{key}"
@@ -55,7 +57,8 @@ class FsspecStorage:
         try:
             fs.rm(path)
         except Exception:
-            pass
+            logger.debug("spilled-object delete failed for %s (orphaned "
+                         "spill file)", uri, exc_info=True)
 
 
 def storage_from_config() -> Optional[FsspecStorage]:

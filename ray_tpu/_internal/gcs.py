@@ -468,7 +468,10 @@ class GcsServer:
                             rec.last_heartbeat = time.monotonic()
                             rec.missed_health_checks = 0
                         except Exception:
-                            pass
+                            logger.debug(
+                                "health ping to node %s failed (%d missed)",
+                                rec.node_id[:12], rec.missed_health_checks,
+                                exc_info=True)
                     if rec.missed_health_checks >= \
                             CONFIG.health_check_failure_threshold:
                         await self._on_node_death(rec.node_id, "health check failed")
@@ -506,7 +509,9 @@ class GcsServer:
                                    rec.job_id.hex()[:8], e, rec.missed_pings)
                     await self._finish_job(rec.job_id)
             except Exception:
-                pass  # timeout/other: congested, not provably dead
+                # timeout/other: congested, not provably dead
+                logger.debug("driver probe inconclusive for job %s",
+                             rec.job_id.hex()[:8], exc_info=True)
         running = [rec for rec in self.jobs.values()
                    if rec.state == "RUNNING" and rec.driver_address]
         if running:
@@ -1047,7 +1052,8 @@ class GcsServer:
                 await self.clients.get(record.address).call(
                     "kill_actor", actor_id=record.actor_id, timeout=5)
             except Exception:
-                pass
+                logger.debug("kill_actor RPC to %s failed (worker already "
+                             "dead?)", record.address, exc_info=True)
         if no_restart:
             record.max_restarts = record.num_restarts  # exhaust budget
         await self._handle_actor_failure(record, cause)
@@ -1173,7 +1179,8 @@ class GcsServer:
                             "cancel_bundle", pg_id=record.pg_id,
                             bundle_index=index, timeout=10)
                     except Exception:
-                        pass
+                        logger.debug("cancel_bundle rollback on %s failed",
+                                     node_id[:12], exc_info=True)
             return False
         # Phase 2: commit.
         for node_id, index in prepared:
@@ -1197,7 +1204,8 @@ class GcsServer:
                         "cancel_bundle", pg_id=record.pg_id,
                         bundle_index=index, timeout=10)
                 except Exception:
-                    pass
+                    logger.debug("cancel_bundle on %s failed (node "
+                                 "leaving?)", node_id[:12], exc_info=True)
         record.bundle_nodes = [None] * len(record.bundles)
 
     async def handle_remove_placement_group(self, pg_id: PlacementGroupID):

@@ -1,0 +1,243 @@
+"""rtpulint — project-specific static analysis for ray_tpu.
+
+PRs 1-3 established hard invariants (one-lock-per-dep-list refcounting,
+no cloudpickle on the per-call task loop, every kill switch registered
+in ``config._DEFAULTS``, ``rtpu_*`` metric naming, daemon threads with a
+shutdown story). This package machine-checks them on every PR, the way
+the reference wires clang-tidy rules and sanitizers into its C++ core:
+
+==== =====================================================================
+L001 lock discipline: ``.acquire()`` on a lock outside try/finally;
+     blocking calls (time.sleep, RPC ``.call``/``.call_sync``,
+     socket/subprocess ops, plasma gets) inside a ``with <lock>:`` body
+L002 swallowed exceptions: bare ``except:`` / broad ``except Exception:``
+     whose body is only ``pass``/``continue`` with no logging
+L003 flag hygiene: every ``CONFIG.<name>`` access and every ``RTPU_*``
+     env read must resolve against ``config._DEFAULTS`` (or the
+     ``BOOTSTRAP_ENV`` process-plumbing set) — catches typo'd kill
+     switches like a misspelled RTPU_NO_FLAT_WIRE
+L004 metrics hygiene: Counter/Gauge/Histogram names literal and matching
+     ``rtpu_[a-z0-9_]+``, constructed once (module scope / LazyMetrics
+     ``_build*`` / ``is None`` guard — never in a loop), and one
+     consistent label set per series name across the whole tree
+L005 thread hygiene: ``threading.Thread(daemon=True)`` must be created
+     via ``threads.spawn_daemon`` or registered with
+     ``threads.register_daemon_thread`` in the same scope
+L006 hot-path pickle: serialization/cloudpickle/pickle ``dumps``/``loads``
+     in the hot-path modules (rpc.py, task_spec.py, core_worker.py) must
+     sit behind the flat-wire fallback gate (allowlisted with a
+     justification, one entry per call site scope)
+==== =====================================================================
+
+Violations report ``file:line`` and carry a stable allowlist key
+``RULE path:scope`` (scope = enclosing def/class qualname, so the key
+survives unrelated line shifts). ``allowlist.txt`` is a burn-down list:
+tests assert it only shrinks, and unused entries are themselves errors.
+
+Run: ``python -m ray_tpu._internal.lint [--json]`` or ``cli lint``.
+The companion runtime lock-order sanitizer lives in ``.sanitizer``
+(enable with ``RTPU_SANITIZE=1``; see that module's docstring).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .rules import MetricDecl, Violation, lint_source
+
+__all__ = [
+    "Violation", "LintReport", "lint_source", "run_lint",
+    "load_allowlist", "default_allowlist_path", "package_root", "main",
+]
+
+_SKIP_DIRS = {"__pycache__", "generated", "protos"}
+_SKIP_SUFFIXES = (os.path.join("dashboard", "client"),)  # JS assets
+
+
+def package_root() -> str:
+    """Directory containing the ``ray_tpu`` package (the lint root)."""
+    here = os.path.dirname(os.path.abspath(__file__))  # .../ray_tpu/_internal/lint
+    return os.path.dirname(os.path.dirname(os.path.dirname(here)))
+
+
+def default_allowlist_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "allowlist.txt")
+
+
+@dataclass
+class AllowEntry:
+    key: str            # "L002 ray_tpu/_internal/gcs.py:GcsServer._sweep"
+    justification: str
+    lineno: int
+    used: int = 0
+
+
+@dataclass
+class LintReport:
+    violations: List[Violation] = field(default_factory=list)
+    allowlisted: List[Violation] = field(default_factory=list)
+    unused_allowlist: List[AllowEntry] = field(default_factory=list)
+    bad_allowlist_lines: List[str] = field(default_factory=list)
+    checked_files: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.unused_allowlist \
+            and not self.bad_allowlist_lines
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "ok": self.ok,
+            "checked_files": self.checked_files,
+            "violations": [v.to_dict() for v in self.violations],
+            "allowlisted": len(self.allowlisted),
+            "unused_allowlist": [e.key for e in self.unused_allowlist],
+            "bad_allowlist_lines": self.bad_allowlist_lines,
+        }, indent=1)
+
+    def render(self) -> str:
+        lines = []
+        for v in sorted(self.violations, key=lambda v: (v.path, v.line)):
+            lines.append(f"{v.path}:{v.line}: {v.rule} {v.message}")
+            lines.append(f"    allowlist key: {v.key}")
+        for e in self.unused_allowlist:
+            lines.append(f"allowlist.txt:{e.lineno}: unused entry "
+                         f"(fixed? delete it): {e.key}")
+        for bad in self.bad_allowlist_lines:
+            lines.append(f"allowlist.txt: malformed line: {bad}")
+        lines.append(f"{len(self.violations)} violation(s), "
+                     f"{len(self.allowlisted)} allowlisted, "
+                     f"{self.checked_files} files checked")
+        return "\n".join(lines)
+
+
+def load_allowlist(path: str) -> Tuple[List[AllowEntry], List[str]]:
+    """Parse allowlist.txt: ``RULE path:scope -- justification`` per line
+    (``#`` comments and blank lines ignored). A justification is
+    mandatory — an unexplained suppression is itself a violation."""
+    entries: List[AllowEntry] = []
+    bad: List[str] = []
+    if not os.path.exists(path):
+        return entries, bad
+    with open(path, encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(None, 2)
+            if len(parts) < 3 or not parts[0].startswith("L") \
+                    or ":" not in parts[1]:
+                bad.append(line)
+                continue
+            rule, loc, just = parts
+            just = just.lstrip("-— ").strip()
+            if not just:
+                bad.append(line)
+                continue
+            entries.append(AllowEntry(key=f"{rule} {loc}",
+                                      justification=just, lineno=lineno))
+    return entries, bad
+
+
+def iter_source_files(root: str):
+    pkg = os.path.join(root, "ray_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in _SKIP_DIRS
+            and not os.path.join(dirpath, d).endswith(_SKIP_SUFFIXES))
+        for name in sorted(filenames):
+            if name.endswith(".py") and not name.endswith("_pb2.py"):
+                yield os.path.join(dirpath, name)
+
+
+def run_lint(root: Optional[str] = None,
+             allowlist_path: Optional[str] = None,
+             use_allowlist: bool = True) -> LintReport:
+    """Lint every source file under ``<root>/ray_tpu``."""
+    root = root or package_root()
+    report = LintReport()
+    entries: List[AllowEntry] = []
+    if use_allowlist:
+        path = allowlist_path or default_allowlist_path()
+        entries, report.bad_allowlist_lines = load_allowlist(path)
+    by_key: Dict[str, AllowEntry] = {e.key: e for e in entries}
+
+    all_violations: List[Violation] = []
+    metric_decls: List[MetricDecl] = []
+    for filepath in iter_source_files(root):
+        rel = os.path.relpath(filepath, root)
+        try:
+            with open(filepath, encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            all_violations.append(Violation(
+                rule="L000", path=rel, line=0, scope="<module>",
+                message=f"unreadable source file: {e}"))
+            continue
+        violations, decls = lint_source(src, rel)
+        all_violations.extend(violations)
+        metric_decls.extend(decls)
+        report.checked_files += 1
+
+    all_violations.extend(_check_metric_consistency(metric_decls))
+
+    for v in all_violations:
+        entry = by_key.get(v.key)
+        if entry is not None:
+            entry.used += 1
+            report.allowlisted.append(v)
+        else:
+            report.violations.append(v)
+    report.unused_allowlist = [e for e in entries if not e.used]
+    return report
+
+
+def _check_metric_consistency(decls: List[MetricDecl]) -> List[Violation]:
+    """L004 cross-file check: one label set (and kind) per series name.
+    Two declarations of the same series with different tag_keys merge
+    into invalid exposition (duplicate/contradictory sample lines)."""
+    first: Dict[str, MetricDecl] = {}
+    out: List[Violation] = []
+    for d in decls:
+        prev = first.setdefault(d.name, d)
+        if prev is d:
+            continue
+        if d.tag_keys != prev.tag_keys:
+            out.append(Violation(
+                rule="L004", path=d.path, line=d.line, scope=d.scope,
+                message=(f"metric {d.name!r} declared with labels "
+                         f"{list(d.tag_keys)} but "
+                         f"{prev.path}:{prev.line} declared "
+                         f"{list(prev.tag_keys)} — one label set per "
+                         "series")))
+        elif d.kind != prev.kind:
+            out.append(Violation(
+                rule="L004", path=d.path, line=d.line, scope=d.scope,
+                message=(f"metric {d.name!r} declared as {d.kind} but "
+                         f"{prev.path}:{prev.line} declared "
+                         f"{prev.kind}")))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="rtpulint",
+        description="ray_tpu project lint (rules L001-L006)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--root", default=None,
+                        help="directory containing the ray_tpu package")
+    parser.add_argument("--allowlist", default=None,
+                        help="alternative allowlist file")
+    parser.add_argument("--no-allowlist", action="store_true",
+                        help="report allowlisted violations too")
+    args = parser.parse_args(argv)
+    report = run_lint(root=args.root, allowlist_path=args.allowlist,
+                      use_allowlist=not args.no_allowlist)
+    print(report.to_json() if args.json else report.render())
+    return 0 if report.ok else 1

@@ -1,0 +1,8 @@
+"""``python -m ray_tpu._internal.lint [--json]`` — run rtpulint."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

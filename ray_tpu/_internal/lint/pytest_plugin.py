@@ -1,0 +1,68 @@
+"""pytest integration for the lock-order sanitizer.
+
+Two arming modes:
+
+* ``RTPU_SANITIZE=1`` — enabled for the whole session (and, because the
+  env var is inherited, for every raylet/worker subprocess via their
+  mains). Acquisition-order cycles observed in THIS process FAIL the
+  run (exit status 3): this is the CI job the acceptance criteria call
+  "pass clean". Subprocess graphs live in their own processes: their
+  atexit hooks print reports to stderr (forwarded by the worker log
+  pump), but do not flip the exit status.
+* no env var — enabled only for the duration of the concurrency-heavy
+  tests (actor storm, push recovery, flat codec). Cycles are reported in
+  the terminal summary but do not fail tier-1: the sanitizer is an
+  opt-in gate, not a flake source.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import sanitizer
+
+SANITIZED_TEST_MODULES = ("test_actor_storm", "test_push_recovery",
+                          "test_flat_codec")
+
+_env_armed = False
+_ever_armed = False
+
+
+def _module_name(item) -> str:
+    name = os.path.basename(getattr(item, "fspath", None) and
+                            str(item.fspath) or "")
+    return name[:-3] if name.endswith(".py") else name
+
+
+def pytest_configure(config):
+    global _env_armed, _ever_armed
+    if sanitizer.enable_from_env():
+        _env_armed = _ever_armed = True
+
+
+def pytest_runtest_setup(item):
+    global _ever_armed
+    if not _env_armed and _module_name(item) in SANITIZED_TEST_MODULES:
+        sanitizer.enable()
+        _ever_armed = True
+
+
+def pytest_runtest_teardown(item, nextitem):
+    if not _env_armed and sanitizer.is_enabled() \
+            and _module_name(item) in SANITIZED_TEST_MODULES:
+        # Stop instrumenting NEW locks outside the sanitized tests;
+        # already-wrapped instances keep recording (cheap).
+        sanitizer.disable()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _ever_armed:
+        return
+    rep = sanitizer.report()
+    terminalreporter.write_line("")
+    terminalreporter.write_line(sanitizer.render_report(rep))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _env_armed and sanitizer.report()["cycles"]:
+        session.exitstatus = 3

@@ -1,0 +1,487 @@
+"""AST rule implementations for rtpulint (see package docstring for the
+rule catalog). One visitor pass per file; cross-file checks (metric
+label consistency) are folded by the engine from the ``MetricDecl``
+stream each file emits."""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# result types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    scope: str          # enclosing def/class qualname ("<module>" at top)
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Stable allowlist key: rule + file + scope (NOT the line
+        number — unrelated edits must not invalidate suppressions)."""
+        return f"{self.rule} {self.path}:{self.scope}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "scope": self.scope, "message": self.message,
+                "key": self.key}
+
+
+@dataclass
+class MetricDecl:
+    name: str
+    kind: str           # Counter / Gauge / Histogram
+    tag_keys: Tuple[str, ...]
+    path: str
+    line: int
+    scope: str
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+_METRIC_NAME_RE = re.compile(r"^rtpu_[a-z0-9_]+$")
+
+# L001: a with-item context expression whose terminal name contains one
+# of these is treated as a mutex. "cond" is deliberately absent:
+# Condition bodies legitimately block in .wait().
+_LOCKISH = ("lock",)
+
+# L001: calls that block (or can block unboundedly) and therefore must
+# not run while holding a lock. Matched on the full dotted form.
+_BLOCKING_DOTTED = {
+    "time.sleep",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "socket.create_connection",
+}
+# ... and on the method name alone, for receivers we cannot type
+# statically: RPC stubs (.call/.call_sync), the io loop (.run_sync),
+# raw sockets (.recv/.sendall/.accept).
+_BLOCKING_METHODS = {"call", "call_sync", "run_sync", "recv", "sendall",
+                     "accept"}
+# "plasma gets": .get(...) blocks only on store-like receivers.
+_BLOCKING_GET_RECEIVERS = {"store", "plasma", "_store", "_plasma"}
+
+# L003: CONFIG attributes that are API, not flags.
+_CONFIG_METHODS = {"get", "apply_system_config", "snapshot", "reset",
+                   "known_flags"}
+
+# L006: hot-path modules where a pickler on the per-call loop is a
+# regression (PR 2 moved them onto the flat-wire codec).
+_HOT_PATH_FILES = {
+    "ray_tpu/_internal/rpc.py",
+    "ray_tpu/_internal/task_spec.py",
+    "ray_tpu/_internal/core_worker.py",
+}
+_PICKLER_RECEIVERS = {"serialization", "cloudpickle", "pickle"}
+
+# L005: the registry module itself creates the threads.
+_THREADS_HELPER_FILE = "ray_tpu/_internal/threads.py"
+_THREAD_REGISTER_FUNCS = {"register_daemon_thread", "spawn_daemon"}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as "a.b.c" (None if not a chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    term = _terminal(_dotted(expr)).lower()
+    return bool(term) and any(s in term for s in _LOCKISH)
+
+
+def _broad_handler(handler: ast.ExceptHandler) -> Optional[str]:
+    """Return "bare" / "Exception" / "BaseException" when the handler
+    catches everything, else None. Tuples count if any member is broad."""
+    t = handler.type
+    if t is None:
+        return "bare"
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [_terminal(_dotted(e)) for e in t.elts]
+    else:
+        names = [_terminal(_dotted(t))]
+    for n in names:
+        if n in ("Exception", "BaseException"):
+            return n
+    return None
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _Scope:
+    __slots__ = ("name", "node", "lock_depth")
+
+    def __init__(self, name: str, node: ast.AST):
+        self.name = name
+        self.node = node
+        # with-lock nesting INSIDE this scope only: a closure defined
+        # under `with lock:` does not run while the lock is held.
+        self.lock_depth = 0
+
+
+# ---------------------------------------------------------------------------
+# the per-file visitor
+# ---------------------------------------------------------------------------
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, known_flags: Sequence[str],
+                 bootstrap_env: Sequence[str]):
+        self.path = path
+        self.known_flags = frozenset(known_flags)
+        self.bootstrap_env = frozenset(bootstrap_env)
+        self.violations: List[Violation] = []
+        self.metric_decls: List[MetricDecl] = []
+        self._scopes: List[_Scope] = [_Scope("<module>", None)]
+        self._metric_aliases: set = set()   # Counter/... imported from metrics
+        self._loop_depth = 0
+        self._hot_path = path in _HOT_PATH_FILES
+        self._is_threads_helper = path == _THREADS_HELPER_FILE
+        self._is_config = path == "ray_tpu/_internal/config.py"
+
+    # -- bookkeeping --------------------------------------------------------
+
+    @property
+    def scope(self) -> str:
+        names = [s.name for s in self._scopes[1:]]
+        return ".".join(names) if names else "<module>"
+
+    def _emit(self, rule: str, node: ast.AST, message: str):
+        self.violations.append(Violation(
+            rule=rule, path=self.path, line=getattr(node, "lineno", 0),
+            scope=self.scope, message=message))
+
+    def _in_lock(self) -> bool:
+        return self._scopes[-1].lock_depth > 0
+
+    # -- imports: track metric constructor aliases --------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        mod = node.module or ""
+        if mod.endswith("metrics") or mod.endswith("util.metrics"):
+            for alias in node.names:
+                if alias.name in ("Counter", "Gauge", "Histogram"):
+                    self._metric_aliases.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    # -- scope / context stack ----------------------------------------------
+
+    def _visit_scoped(self, node, name: str):
+        self._scopes.append(_Scope(name, node))
+        outer_loop, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = outer_loop
+        self._scopes.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_scoped(node, node.name)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_scoped(node, node.name)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._visit_scoped(node, node.name)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._visit_scoped(node, "<lambda>")
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _visit_loop
+
+    def visit_With(self, node: ast.With):
+        self._visit_with(node)
+
+    def visit_AsyncWith(self, node: ast.AsyncWith):
+        self._visit_with(node)
+
+    def _visit_with(self, node):
+        holds = any(_is_lockish(item.context_expr) for item in node.items)
+        for item in node.items:
+            self.visit(item)
+        if holds:
+            self._scopes[-1].lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if holds:
+            self._scopes[-1].lock_depth -= 1
+
+    # -- L002: swallowed exceptions -----------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        broad = _broad_handler(node)
+        if broad is not None and all(
+                isinstance(s, (ast.Pass, ast.Continue)) for s in node.body):
+            what = "bare except:" if broad == "bare" \
+                else f"except {broad}:"
+            self._emit("L002", node,
+                       f"{what} silently swallows — log at debug level, "
+                       "narrow the exception type, or allowlist with a "
+                       "justification")
+        self.generic_visit(node)
+
+    # -- L003 (CONFIG side) --------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.value, ast.Name) and node.value.id == "CONFIG" \
+                and not self._is_config:
+            attr = node.attr
+            if not attr.startswith("_") and attr not in _CONFIG_METHODS \
+                    and attr not in self.known_flags:
+                self._emit("L003", node,
+                           f"CONFIG.{attr} is not registered in "
+                           "config._DEFAULTS (typo'd flag?)")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript):
+        recv = _dotted(node.value)
+        if recv in ("os.environ", "environ"):
+            key = _str_const(node.slice)
+            if key is not None:
+                self._check_env_key(node, key)
+        self.generic_visit(node)
+
+    def _check_env_key(self, node: ast.AST, key: str):
+        if not key.startswith("RTPU_") or self._is_config:
+            return
+        if key in self.bootstrap_env:
+            return
+        flag = key[len("RTPU_"):].lower()
+        if flag not in self.known_flags:
+            self._emit("L003", node,
+                       f"env read of {key!r} resolves to neither a "
+                       "config._DEFAULTS flag nor config.BOOTSTRAP_ENV "
+                       "(typo'd kill switch?)")
+
+    # -- the big Call dispatcher --------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        term = _terminal(dotted)
+
+        # L003: os.environ.get("RTPU_X") / os.getenv("RTPU_X")
+        if term in ("get", "getenv"):
+            recv = _dotted(node.func.value) \
+                if isinstance(node.func, ast.Attribute) else None
+            if (recv in ("os.environ", "environ")
+                    or dotted == "os.getenv") and node.args:
+                key = _str_const(node.args[0])
+                if key is not None:
+                    self._check_env_key(node, key)
+
+        # L001a: explicit lock acquire outside try/finally-with-release
+        if term == "acquire" and isinstance(node.func, ast.Attribute) \
+                and _is_lockish(node.func.value):
+            if not self._acquire_is_protected(node):
+                self._emit("L001", node,
+                           f"{_dotted(node.func.value)}.acquire() outside "
+                           "`with` / try-finally — a failure between "
+                           "acquire and release leaks the lock")
+
+        # L001b: blocking call while holding a lock
+        if self._in_lock():
+            blocking = dotted in _BLOCKING_DOTTED \
+                or term in _BLOCKING_METHODS \
+                or (term == "get" and isinstance(node.func, ast.Attribute)
+                    and _terminal(_dotted(node.func.value)).lower()
+                    in _BLOCKING_GET_RECEIVERS)
+            if blocking:
+                self._emit("L001", node,
+                           f"blocking call {dotted or term}() inside a "
+                           "`with <lock>:` body — move it outside the "
+                           "critical section")
+
+        # L004: metric construction
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in self._metric_aliases:
+            self._check_metric_ctor(node, node.func.id)
+
+        # L005: raw daemon thread
+        if term == "Thread" and not self._is_threads_helper:
+            for kw in node.keywords:
+                if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is True:
+                    if not self._scope_registers_thread():
+                        self._emit(
+                            "L005", node,
+                            "daemon Thread with no shutdown story — use "
+                            "threads.spawn_daemon() or pass it to "
+                            "threads.register_daemon_thread() in the same "
+                            "scope")
+                    break
+
+        # L006: pickler on a hot-path module
+        if self._hot_path and term in ("dumps", "loads") \
+                and isinstance(node.func, ast.Attribute) \
+                and _terminal(_dotted(node.func.value)) \
+                in _PICKLER_RECEIVERS:
+            self._emit("L006", node,
+                       f"{dotted}() in hot-path module — per-call task "
+                       "encoding must use the flat-wire codec; pickle "
+                       "belongs behind the fallback gate (allowlist with "
+                       "justification if this IS the gate)")
+
+        self.generic_visit(node)
+
+    # -- rule helpers --------------------------------------------------------
+
+    def _acquire_is_protected(self, call: ast.Call) -> bool:
+        """True when the acquire is paired with a structural release:
+        an enclosing Try whose finalbody calls .release(), or a
+        non-blocking conditional acquire (`if lock.acquire(False):` /
+        `acquire(timeout=...)` used as a test)."""
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        for kw in call.keywords:
+            if kw.arg in ("blocking", "timeout"):
+                return True
+        node = self._scopes[-1].node
+        # Search this scope for a Try whose finalbody releases and that
+        # either covers the call (`with`-less acquire inside try) or
+        # starts right after it (the classic `acquire(); try: ...
+        # finally: release()` — the acquire precedes the Try node).
+        # (ast has no parent links and the per-scope subtree is small,
+        # so a walk is fine.)
+        root = node if node is not None else self._module
+        for t in ast.walk(root):
+            if isinstance(t, ast.Try) and t.finalbody \
+                    and call.lineno \
+                    <= (getattr(t, "end_lineno", None) or t.lineno):
+                for sub in t.finalbody:
+                    for c in ast.walk(sub):
+                        if isinstance(c, ast.Call) \
+                                and isinstance(c.func, ast.Attribute) \
+                                and c.func.attr == "release":
+                            return True
+        return False
+
+    def _scope_registers_thread(self) -> bool:
+        """L005: does the innermost function scope (or module) also call
+        register_daemon_thread/spawn_daemon?"""
+        node = self._scopes[-1].node
+        root = node if node is not None else self._module
+        for c in ast.walk(root):
+            if isinstance(c, ast.Call) \
+                    and _terminal(_dotted(c.func)) in _THREAD_REGISTER_FUNCS:
+                return True
+        return False
+
+    def _check_metric_ctor(self, node: ast.Call, kind: str):
+        name = _str_const(node.args[0]) if node.args else None
+        if name is None:
+            self._emit("L004", node,
+                       f"{kind}() series name must be a string literal "
+                       "(the linter cross-checks label sets by name)")
+            return
+        if not _METRIC_NAME_RE.match(name):
+            self._emit("L004", node,
+                       f"{kind} name {name!r} must match rtpu_[a-z0-9_]+")
+        if self._loop_depth:
+            self._emit("L004", node,
+                       f"{kind}({name!r}) constructed inside a loop — "
+                       "series registration is once-per-process, hoist it")
+        elif not self._construction_site_ok():
+            self._emit("L004", node,
+                       f"{kind}({name!r}) constructed per-call — create "
+                       "at module scope, in a LazyMetrics _build*() "
+                       "factory, or behind an `is None` once-guard")
+        tag_keys: Tuple[str, ...] = ()
+        literal = True
+        for kw in node.keywords:
+            if kw.arg == "tag_keys":
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    vals = [_str_const(e) for e in kw.value.elts]
+                    if all(v is not None for v in vals):
+                        tag_keys = tuple(vals)
+                    else:
+                        literal = False
+                else:
+                    literal = False
+        if literal:
+            self.metric_decls.append(MetricDecl(
+                name=name, kind=kind, tag_keys=tag_keys, path=self.path,
+                line=node.lineno, scope=self.scope))
+
+    def _construction_site_ok(self) -> bool:
+        """Metric constructors are once-per-process when at module/class
+        scope, in a ``_build*`` factory (the LazyMetrics idiom), or under
+        an ``is None`` once-guard anywhere in the enclosing function."""
+        func = None
+        for s in self._scopes[1:]:
+            if isinstance(s.node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                func = s
+        if func is None:
+            return True
+        if func.name.startswith("_build") or func.name.startswith("build"):
+            return True
+        for n in ast.walk(func.node):
+            if isinstance(n, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in n.ops) and any(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in [n.left, *n.comparators]):
+                return True
+        return False
+
+    # -- entry ---------------------------------------------------------------
+
+    def run(self, tree: ast.Module):
+        self._module = tree
+        self.visit(tree)
+        return self.violations, self.metric_decls
+
+
+def _project_tables() -> Tuple[frozenset, frozenset]:
+    from ..config import BOOTSTRAP_ENV, CONFIG
+    return frozenset(CONFIG.known_flags()), frozenset(BOOTSTRAP_ENV)
+
+
+def lint_source(src: str, path: str,
+                known_flags: Optional[Sequence[str]] = None,
+                bootstrap_env: Optional[Sequence[str]] = None,
+                ) -> Tuple[List[Violation], List[MetricDecl]]:
+    """Lint one file's source. ``path`` must be repo-relative with
+    forward slashes (it selects per-module rule behavior and becomes the
+    allowlist key)."""
+    if known_flags is None or bootstrap_env is None:
+        flags, env = _project_tables()
+        known_flags = known_flags if known_flags is not None else flags
+        bootstrap_env = bootstrap_env if bootstrap_env is not None else env
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Violation(rule="L000", path=path, line=e.lineno or 0,
+                          scope="<module>",
+                          message=f"syntax error: {e.msg}")], []
+    return _Linter(path, known_flags, bootstrap_env).run(tree)

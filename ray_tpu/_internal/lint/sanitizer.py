@@ -1,0 +1,299 @@
+"""Runtime lock-order sanitizer (the dynamic half of rtpulint).
+
+``RTPU_SANITIZE=1`` (or an explicit :func:`enable`) replaces
+``threading.Lock``/``RLock`` with a factory that hands **ray_tpu
+modules** an instrumented proxy (everyone else keeps the real thing —
+the factory checks the caller's module, so third-party code and the
+interpreter's own locks are untouched). The proxy:
+
+* keeps a per-thread held-lock list,
+* on every acquire while other locks are held, adds an edge
+  ``held_site -> acquired_site`` to a global lock-acquisition-order
+  graph keyed by lock *creation site* (module:line — all instances born
+  at one site share a node, so an AB/BA inversion between two actor
+  instances is still one cycle),
+* records **blocked-while-holding** waits: the acquire first tries
+  non-blocking; a miss while the thread holds another lock is a
+  latent-convoy/deadlock datapoint even when it later succeeds.
+
+:func:`report` returns cycles in the order graph (potential deadlocks —
+the classic AB/BA inversion shows up as a 2-cycle without ever actually
+deadlocking the test) plus the blocked-wait list. With the env var set a
+process-exit hook prints the report to stderr; the pytest plugin
+(``.pytest_plugin``) surfaces it per test session instead.
+
+Overhead when off: zero — nothing is patched, no proxy exists. When on:
+one dict/list op per acquire/release plus one set-add per held pair.
+
+Reentrant same-instance acquires (RLock) record nothing; same-*site*
+nesting across distinct instances is tracked separately
+(``nested_same_site``) and excluded from cycle detection — ordering
+within one site (e.g. per-dep-list refcount locks) needs an instance
+key, which would make every report nondeterministic.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+_enabled = False
+_prefixes: Tuple[str, ...] = ("ray_tpu",)
+_atexit_registered = False
+
+_graph_lock = _REAL_LOCK()
+_edges: Dict[Tuple[str, str], int] = {}       # (held, acquired) -> count
+_sites: Set[str] = set()
+_nested_same_site: Dict[str, int] = {}
+_blocked: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+
+_tls = threading.local()
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []          # [(site, instance_id), ...]
+    return held
+
+
+class LockProxy:
+    """Instrumented Lock/RLock wrapper. API-compatible with the real
+    thing (acquire/release/locked/context manager)."""
+
+    __slots__ = ("_inner", "_site", "_reentrant")
+
+    def __init__(self, inner, site: str, reentrant: bool = False):
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+        with _graph_lock:
+            _sites.add(site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        held = _held()
+        me = id(self)
+        if self._reentrant and any(i == me for _, i in held):
+            # Pure reentry: no ordering information, don't re-record.
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                held.append((self._site, me))
+            return got
+        if not blocking:
+            # Try-lock: a failed non-blocking acquire cannot deadlock,
+            # and threading.Condition._is_owned() probes acquire(False)
+            # on the lock its OWN thread holds — recording it would fill
+            # the report with spurious nested/blocked entries on every
+            # Condition.wait()/notify().
+            got = self._inner.acquire(False)
+            if got:
+                held.append((self._site, me))
+            return got
+        if held:
+            with _graph_lock:
+                for held_site, held_id in held:
+                    if held_site == self._site:
+                        _nested_same_site[self._site] = \
+                            _nested_same_site.get(self._site, 0) + 1
+                    else:
+                        key = (held_site, self._site)
+                        _edges[key] = _edges.get(key, 0) + 1
+        got = self._inner.acquire(False)
+        if not got:
+            if held:
+                key = (self._site, tuple(s for s, _ in held))
+                with _graph_lock:
+                    _blocked[key] = _blocked.get(key, 0) + 1
+            got = self._inner.acquire(True, timeout)
+        if got:
+            held.append((self._site, me))
+        return got
+
+    def release(self):
+        held = _held()
+        me = id(self)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == me:
+                del held[i]
+                break
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<LockProxy site={self._site} {self._inner!r}>"
+
+
+def _caller_site(depth: int = 2) -> Tuple[str, bool]:
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:
+        return "<unknown>", False
+    mod = frame.f_globals.get("__name__", "")
+    site = f"{mod}:{frame.f_lineno}"
+    return site, any(mod == p or mod.startswith(p + ".")
+                     for p in _prefixes)
+
+
+def _make_lock():
+    site, instrument = _caller_site()
+    inner = _REAL_LOCK()
+    return LockProxy(inner, site) if instrument else inner
+
+
+def _make_rlock():
+    site, instrument = _caller_site()
+    inner = _REAL_RLOCK()
+    return LockProxy(inner, site, reentrant=True) if instrument else inner
+
+
+def instrument(inner=None, site: str = "<explicit>",
+               reentrant: bool = False) -> LockProxy:
+    """Wrap one lock explicitly (unit tests; sanitizing a lock created
+    before :func:`enable` ran)."""
+    if inner is None:
+        inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+    return LockProxy(inner, site, reentrant=reentrant)
+
+
+def enable(prefixes: Optional[Tuple[str, ...]] = None,
+           register_atexit: bool = True):
+    """Patch threading.Lock/RLock. Idempotent; thread-unsafe by design
+    (call it before spawning workers — the pytest plugin and worker_main
+    both do)."""
+    global _enabled, _prefixes, _atexit_registered
+    if prefixes:
+        _prefixes = tuple(prefixes)
+    if _enabled:
+        return
+    _enabled = True
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    if register_atexit and not _atexit_registered:
+        _atexit_registered = True
+        import atexit
+        atexit.register(_exit_report)
+
+
+def disable():
+    """Restore the real constructors. Already-instrumented instances
+    keep recording (cheap, and their data stays comparable)."""
+    global _enabled
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def reset():
+    """Clear the recorded graph (between unit-test scenarios)."""
+    with _graph_lock:
+        _edges.clear()
+        _sites.clear()
+        _nested_same_site.clear()
+        _blocked.clear()
+
+
+def find_cycles() -> List[List[str]]:
+    """Elementary cycles in the site order graph via iterative DFS over
+    strongly-reachable back edges. Deterministic (sorted adjacency);
+    each cycle reported once, rotated to its smallest node."""
+    with _graph_lock:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in _edges:
+            adj.setdefault(a, []).append(b)
+        for v in adj.values():
+            v.sort()
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    cycles: List[List[str]] = []
+    for start in sorted(adj):
+        # DFS from `start`, only visiting nodes >= start so each cycle
+        # is found from its smallest node exactly once.
+        stack = [(start, iter(adj.get(start, ())))]
+        path = [start]
+        on_path = {start}
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if nxt < start:
+                    continue
+                if nxt == start:
+                    cyc = tuple(path)
+                    if cyc not in seen_cycles:
+                        seen_cycles.add(cyc)
+                        cycles.append(list(cyc) + [start])
+                elif nxt not in on_path:
+                    stack.append((nxt, iter(adj.get(nxt, ()))))
+                    path.append(nxt)
+                    on_path.add(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                on_path.discard(path.pop())
+    return cycles
+
+
+def report() -> dict:
+    cycles = find_cycles()
+    with _graph_lock:
+        blocked = [{"lock": site, "while_holding": list(held),
+                    "count": count}
+                   for (site, held), count in sorted(_blocked.items())]
+        return {
+            "enabled": _enabled,
+            "locks": len(_sites),
+            "edges": len(_edges),
+            "cycles": cycles,
+            "blocked_while_holding": blocked,
+            "nested_same_site": dict(sorted(_nested_same_site.items())),
+        }
+
+
+def render_report(rep: Optional[dict] = None) -> str:
+    rep = rep or report()
+    lines = [f"lock-order sanitizer: {rep['locks']} lock sites, "
+             f"{rep['edges']} order edges"]
+    for cyc in rep["cycles"]:
+        lines.append("  POTENTIAL DEADLOCK (acquisition-order cycle): "
+                     + " -> ".join(cyc))
+    for b in rep["blocked_while_holding"]:
+        lines.append(f"  blocked x{b['count']} on {b['lock']} while "
+                     f"holding {b['while_holding']}")
+    if not rep["cycles"]:
+        lines.append("  no cycles detected")
+    return "\n".join(lines)
+
+
+def _exit_report():
+    rep = report()
+    if rep["cycles"] or rep["blocked_while_holding"]:
+        print(render_report(rep), file=sys.stderr, flush=True)
+
+
+def enable_from_env():
+    """Enable iff RTPU_SANITIZE is truthy (the worker/raylet mains call
+    this so sanitized runs cover every process in the cluster)."""
+    if os.environ.get("RTPU_SANITIZE", "").lower() in ("1", "true", "yes",
+                                                       "on"):
+        enable()
+        return True
+    return False

@@ -8,6 +8,7 @@ subprocesses for real multi-node semantics.
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import uuid
@@ -17,6 +18,9 @@ from .config import CONFIG
 from .gcs import GcsServer
 from .raylet import Raylet
 from .rpc import Address, EventLoopThread
+from .threads import shutdown_daemon_threads
+
+logger = logging.getLogger(__name__)
 
 
 def new_session_name() -> str:
@@ -86,12 +90,17 @@ class Node:
             try:
                 loop.run_sync(self.raylet.stop(), timeout=10)
             except Exception:
-                pass
+                logger.debug("raylet stop failed during node teardown",
+                             exc_info=True)
         if self.gcs is not None:
             try:
                 loop.run_sync(self.gcs.stop(), timeout=10)
             except Exception:
-                pass
+                logger.debug("gcs stop failed during node teardown",
+                             exc_info=True)
+        # Join registered daemon threads (metrics flusher, sweepers,
+        # reapers) instead of abandoning them — bounded, best-effort.
+        shutdown_daemon_threads(timeout_s=2.0)
 
     @property
     def node_id(self) -> str:

@@ -26,6 +26,7 @@ are the general path.
 
 from __future__ import annotations
 
+import logging
 import mmap
 import os
 import shutil
@@ -34,6 +35,8 @@ from typing import Dict, Optional
 
 from .ids import ObjectID
 from . import serialization
+
+logger = logging.getLogger(__name__)
 
 
 def shm_root() -> str:
@@ -153,7 +156,8 @@ class PlasmaDir:
                     try:
                         buf.release()
                     except Exception:  # noqa: BLE001 — already released
-                        pass
+                        logger.debug("buffer release during seal-failure "
+                                     "cleanup raised", exc_info=True)
                     self._arena.delete(key)
                     raise
                 return total_bytes

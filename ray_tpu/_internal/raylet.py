@@ -206,7 +206,8 @@ class Raylet:
             except asyncio.CancelledError:
                 return
             except Exception:
-                pass
+                logger.debug("heartbeat to GCS failed; retrying next "
+                             "interval", exc_info=True)
             await asyncio.sleep(HEARTBEAT_INTERVAL_S)
 
     def _update_metrics(self):
@@ -424,7 +425,8 @@ class Raylet:
                 try:
                     proc.terminate()
                 except Exception:
-                    pass
+                    logger.debug("terminate of orphaned spawn failed",
+                                 exc_info=True)
         spawn_fut = loop.run_in_executor(None, _popen)
         spawn_fut.add_done_callback(_attach)
         return handle
@@ -510,12 +512,14 @@ class Raylet:
                 if pending:
                     batch.append(pending.decode("utf-8", "replace"))
                 flush()
+        from .threads import spawn_daemon
         for stream, name in ((proc.stdout, "stdout"),
                              (proc.stderr, "stderr")):
             if stream is not None:
-                threading.Thread(target=_pump, args=(stream, name),
-                                 daemon=True,
-                                 name=f"rtpu-log-{proc.pid}").start()
+                # Exits on its own when the worker's fd closes; tracked
+                # but not joined (the fd outlives raylet teardown).
+                spawn_daemon(_pump, args=(stream, name),
+                             name=f"rtpu-log-{proc.pid}")
 
     async def handle_register_worker(self, worker_id: bytes, address: Address,
                                      pid: int):
@@ -558,7 +562,8 @@ class Raylet:
                             assy["buf"].release()
                             self.plasma.abort(ObjectID.from_hex(ohex))
                         except Exception:
-                            pass
+                            logger.debug("abort of half-pushed object %s "
+                                         "failed", ohex[:12], exc_info=True)
             except asyncio.CancelledError:
                 return
             except Exception:
@@ -601,7 +606,8 @@ class Raylet:
                 worker_id=handle.worker_id, cause="worker process died",
                 timeout=10)
         except Exception:
-            pass
+            logger.debug("report_worker_death to GCS failed",
+                         exc_info=True)
 
     # ------------------------------------------------------------------
     # memory monitor (reference: src/ray/common/memory_monitor.h:52 +
@@ -691,7 +697,8 @@ class Raylet:
         try:
             victim.proc.kill()
         except Exception:
-            pass
+            logger.debug("memory-kill of pid %s failed (already gone?)",
+                         victim.pid, exc_info=True)
 
     def _kill_worker(self, handle: WorkerHandle):
         handle.state = "DEAD"
@@ -700,7 +707,8 @@ class Raylet:
             try:
                 handle.proc.terminate()
             except Exception:
-                pass
+                logger.debug("terminate of worker pid %s failed",
+                             handle.pid, exc_info=True)
 
     # ------------------------------------------------------------------
     # leases (reference: node_manager.cc HandleRequestWorkerLease +

@@ -13,6 +13,9 @@ import sys
 
 
 def main(argv=None):
+    # Before any ray_tpu lock is constructed in this process.
+    from .lint import sanitizer as _sanitizer
+    _sanitizer.enable_from_env()
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs-address", required=True)
     parser.add_argument("--session", required=True)

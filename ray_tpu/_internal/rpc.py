@@ -123,13 +123,18 @@ class EventLoopThread:
         # dispatch that complete synchronously never pay a Task schedule
         # round-trip (~25us/call on the n:n flood path).
         if hasattr(asyncio, "eager_task_factory") and \
-                not os.environ.get("RTPU_NO_EAGER_TASKS"):
+                not CONFIG.no_eager_tasks:
             self.loop.set_task_factory(asyncio.eager_task_factory)
         self._post_q: collections.deque = collections.deque()
         self._post_lock = threading.Lock()
         self._post_scheduled = False
         self.thread = threading.Thread(
             target=self._run, name="rtpu-io", daemon=True)
+        # Process-lifetime singleton: tracked for introspection, never
+        # joined (node teardown must not kill the shared io loop —
+        # api.shutdown() still needs it after Node.stop()).
+        from .threads import register_daemon_thread
+        register_daemon_thread(self.thread, joinable=False)
         self.thread.start()
 
     def _run(self):
@@ -462,7 +467,8 @@ class RpcServer:
                 # the listening socket is already closed by close().
                 await asyncio.wait_for(self._server.wait_closed(), 2)
             except Exception:
-                pass
+                logger.debug("server wait_closed timed out; peers hold "
+                             "persistent connections", exc_info=True)
         with _local_servers_lock:
             _local_servers.pop(self.address, None)
 
@@ -520,7 +526,7 @@ class RpcServer:
             try:
                 writer.close()
             except Exception:
-                pass
+                logger.debug("connection close failed", exc_info=True)
 
     # -- shared dispatch -------------------------------------------------
 
@@ -795,7 +801,7 @@ class RpcClient:
             try:
                 self._writer.close()
             except Exception:
-                pass
+                logger.debug("client writer close failed", exc_info=True)
         self._writer = None
         if self._native_conn is not None and self._native is not None:
             self._native.close(self._native_conn)

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import serialization
+from .config import CONFIG
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
 
 # Task types
@@ -340,7 +341,7 @@ _TEMPLATE_FIELDS = tuple(
 
 # A/B kill switch: RTPU_NO_FLAT_WIRE=1 forces every spec onto the
 # pickle path (same-window codec comparisons; read once — hot path).
-_NO_FLAT_WIRE = bool(os.environ.get("RTPU_NO_FLAT_WIRE"))
+_NO_FLAT_WIRE = bool(CONFIG.no_flat_wire)
 
 
 def flat_supported(spec: TaskSpec) -> bool:

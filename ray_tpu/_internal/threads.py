@@ -1,0 +1,109 @@
+"""Daemon-thread registry: every background daemon thread in ray_tpu is
+created through (or registered with) this module so node teardown can
+stop and join them with a bounded timeout instead of abandoning them —
+and so rtpulint rule L005 can verify the invariant statically.
+
+Three lifecycles:
+
+* ``spawn_daemon(target, stop=ev.set)`` — loop threads that poll a
+  ``threading.Event``; teardown calls ``stop`` then joins.
+* ``spawn_daemon(target)`` / ``joinable=False`` — threads whose exit is
+  driven elsewhere (fd close, short-lived one-shot work, the
+  process-lifetime io loop). Tracked for introspection, never joined.
+* ``register_daemon_thread(t, ...)`` — same, for threads a component
+  must construct itself.
+
+``shutdown_daemon_threads()`` is called from ``Node.stop()``; entries
+that joined (or died on their own) are pruned, so a later ``init()`` in
+the same process restarts its singletons cleanly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_entries: List["_Entry"] = []
+
+
+@dataclass
+class _Entry:
+    thread: threading.Thread
+    stop: Optional[Callable[[], None]]
+    joinable: bool
+
+
+def register_daemon_thread(thread: threading.Thread,
+                           stop: Optional[Callable[[], None]] = None,
+                           joinable: Optional[bool] = None) -> threading.Thread:
+    """Track ``thread`` for bounded teardown. ``stop`` is invoked before
+    joining (typically ``Event.set`` breaking the thread's sleep loop).
+    ``joinable`` defaults to ``stop is not None`` — joining a thread with
+    no stop signal would just burn the teardown budget."""
+    if joinable is None:
+        joinable = stop is not None
+    with _lock:
+        _prune_locked()
+        _entries.append(_Entry(thread, stop, joinable))
+    return thread
+
+
+def spawn_daemon(target: Callable, *, name: Optional[str] = None,
+                 args: tuple = (),
+                 stop: Optional[Callable[[], None]] = None,
+                 joinable: Optional[bool] = None) -> threading.Thread:
+    """Create, register, and start a daemon thread in one step."""
+    t = threading.Thread(target=target, args=args, daemon=True, name=name)
+    register_daemon_thread(t, stop=stop, joinable=joinable)
+    t.start()
+    return t
+
+
+def _prune_locked():
+    # ident is None until start(): keep not-yet-started registrations.
+    _entries[:] = [e for e in _entries
+                   if e.thread.ident is None or e.thread.is_alive()]
+
+
+def alive_daemon_threads() -> List[threading.Thread]:
+    with _lock:
+        _prune_locked()
+        return [e.thread for e in _entries]
+
+
+def shutdown_daemon_threads(timeout_s: float = 2.0) -> List[str]:
+    """Signal every registered stop hook, then join joinable threads
+    within one shared ``timeout_s`` budget. Returns the names of threads
+    still alive afterwards (logged, not raised — teardown must finish)."""
+    import time
+    with _lock:
+        _prune_locked()
+        entries = list(_entries)
+    for e in entries:
+        if e.stop is not None:
+            try:
+                e.stop()
+            except Exception:
+                logger.exception("daemon thread %s stop hook failed",
+                                 e.thread.name)
+    deadline = time.monotonic() + timeout_s
+    stuck: List[str] = []
+    for e in entries:
+        # ident None = registered but never started (or start() raised):
+        # join() would raise RuntimeError and abort the teardown sweep.
+        if not e.joinable or e.thread.ident is None:
+            continue
+        e.thread.join(max(0.0, deadline - time.monotonic()))
+        if e.thread.is_alive():
+            stuck.append(e.thread.name or "<unnamed>")
+    if stuck:
+        logger.warning("daemon threads still alive after %.1fs teardown "
+                       "budget: %s", timeout_s, stuck)
+    with _lock:
+        _prune_locked()
+    return stuck

@@ -37,6 +37,10 @@ def main():
         faulthandler.register(signal.SIGUSR2, all_threads=True)
     except (AttributeError, ValueError):
         pass
+    # RTPU_SANITIZE=1 (inherited from the raylet) instruments this
+    # worker's locks too — must run before any ray_tpu lock exists.
+    from .lint import sanitizer as _sanitizer
+    _sanitizer.enable_from_env()
     if os.environ.get("RTPU_WORKER_PROFILE"):
         # Dev/profiling hook: dump the io-loop thread's cProfile stats on
         # SIGUSR1 to RTPU_WORKER_PROFILE/<pid>.prof.
@@ -123,7 +127,8 @@ def _install_profile_hook(out_dir: str):
                 with open(path, "w") as f:
                     pstats.Stats(prof, stream=f).sort_stats(
                         "cumulative").print_stats(40)
-            threading.Thread(target=dump, daemon=True).start()
+            from .threads import spawn_daemon
+            spawn_daemon(dump, name="rtpu-profile-dump")
     signal.signal(signal.SIGUSR1, toggle)
 
 

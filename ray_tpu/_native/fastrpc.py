@@ -120,7 +120,8 @@ class NativeIO:
             try:
                 self._attached_loop.remove_reader(self._notify_fd)
             except Exception:
-                pass
+                logger.debug("remove_reader on dead loop failed",
+                             exc_info=True)
         self._attached_loop = loop
         loop.add_reader(self._notify_fd, self._drain)
 

@@ -202,6 +202,10 @@ class Monitor:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="rtpu-autoscaler")
+        # Tracking-only: the reconcile loop is owned by the cluster
+        # handle (ClusterHandle.down -> Monitor.stop), not node teardown.
+        from .._internal.threads import register_daemon_thread
+        register_daemon_thread(self._thread, joinable=False)
 
     def start(self):
         self._thread.start()

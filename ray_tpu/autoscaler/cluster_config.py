@@ -14,6 +14,7 @@ teardown_cluster)."""
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Dict, Optional
 
 from .autoscaler import (Autoscaler, AutoscalerConfig, Monitor,
@@ -103,7 +104,8 @@ class ClusterHandle:
             try:
                 self.provider.terminate(instance_id)
             except Exception:  # noqa: BLE001 — best-effort teardown
-                pass
+                logging.getLogger(__name__).debug(
+                    "instance terminate failed", exc_info=True)
         if shutdown_cluster:
             self.cluster.shutdown()
 

@@ -376,6 +376,15 @@ def cmd_perf(args):
     perf.main(quick=args.quick)
 
 
+def cmd_lint(args):
+    """rtpulint: project-specific static analysis (rules L001-L006,
+    burn-down allowlist). Exits non-zero on violations."""
+    from ray_tpu._internal import lint
+    raise SystemExit(lint.main(
+        (["--json"] if args.json else [])
+        + (["--no-allowlist"] if args.no_allowlist else [])))
+
+
 def cmd_serve(args):
     """`serve deploy/status/shutdown` (reference: serve/scripts.py —
     the config-file production deploy path)."""
@@ -477,6 +486,11 @@ def main(argv=None):
     p = sub.add_parser("perf")
     p.add_argument("--quick", action="store_true")
     p.set_defaults(fn=cmd_perf)
+
+    p = sub.add_parser("lint")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--no-allowlist", action="store_true")
+    p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("serve")
     p.add_argument("action", choices=["deploy", "status", "shutdown"])

@@ -20,11 +20,14 @@ releases them when the stub is garbage-collected or the session ends.
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 from .._internal import serialization
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["connect", "ClientContext"]
 
@@ -115,8 +118,14 @@ class ClientContext:
         # in the background so its refs/actors survive (reference: the Ray
         # client maintains a heartbeat for exactly this reason).
         self._ping_stop = threading.Event()
+        # Tracking-only registration: the keepalive belongs to this
+        # REMOTE connection, not to any local node — a Node.stop() in
+        # this process must not silence it (the server would reap the
+        # still-live session). disconnect() stops it.
+        from .._internal.threads import register_daemon_thread
         self._ping_thread = threading.Thread(
             target=self._keepalive, daemon=True, name="rtpu-client-ping")
+        register_daemon_thread(self._ping_thread, joinable=False)
         self._ping_thread.start()
 
     def _keepalive(self):
@@ -124,7 +133,7 @@ class ClientContext:
             try:
                 self._rpc("ping", session_id=self._session_id)
             except Exception:
-                pass
+                logger.debug("client keepalive ping failed", exc_info=True)
 
     # -- plumbing --------------------------------------------------------
 
@@ -149,7 +158,8 @@ class ClientContext:
         try:
             self._rpc("release", session_id=self._session_id, refs=refs)
         except Exception:
-            pass
+            logger.debug("ref release batch to client server failed",
+                         exc_info=True)
 
     def _pack_args(self, args: Tuple, kwargs: Dict) -> bytes:
         """Hoist top-level ClientObjectRefs so the server substitutes the
@@ -219,7 +229,7 @@ class ClientContext:
             self._flush_releases()
             self._rpc("disconnect", session_id=self._session_id)
         except Exception:
-            pass
+            logger.debug("client disconnect RPC failed", exc_info=True)
 
 
 def connect(address: str) -> ClientContext:

@@ -38,6 +38,7 @@ class ClientServer:
         self._sessions: Dict[str, _Session] = {}
         self._lock = threading.Lock()
         self._server = None
+        self._reap_stop = threading.Event()
         self.address: Optional[Tuple[str, int]] = None
 
     # -- lifecycle -------------------------------------------------------
@@ -49,18 +50,23 @@ class ClientServer:
         self._server.register_instance(self)  # methods: handle_client_*
         loop = EventLoopThread.get()
         self.address = loop.run_sync(self._server.start(host, port))
-        threading.Thread(target=self._reaper, daemon=True,
-                         name="rtpu-client-reaper").start()
+        from .._internal.threads import spawn_daemon
+        # Fresh event per start(), bound to the thread via args: a
+        # stop()/start() pair can never leave an old reaper waiting on a
+        # cleared event (clear() after set() loses the wakeup).
+        self._reap_stop = threading.Event()
+        spawn_daemon(self._reaper, args=(self._reap_stop,),
+                     name="rtpu-client-reaper", stop=self._reap_stop.set)
         return self.address
 
     def stop(self):
         from .._internal.rpc import EventLoopThread
+        self._reap_stop.set()
         if self._server is not None:
             EventLoopThread.get().run_sync(self._server.stop(), 5)
 
-    def _reaper(self):
-        while True:
-            time.sleep(10.0)
+    def _reaper(self, stop: threading.Event):
+        while not stop.wait(10.0):
             now = time.monotonic()
             with self._lock:
                 dead = [sid for sid, s in self._sessions.items()

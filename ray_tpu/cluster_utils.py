@@ -10,6 +10,7 @@ coverage (SURVEY §4.2)."""
 from __future__ import annotations
 
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -18,6 +19,8 @@ from typing import Dict, List, Optional
 
 from ._internal.node import Node, new_session_name
 from ._internal.rpc import Address
+
+logger = logging.getLogger(__name__)
 
 
 class RemoteNodeHandle:
@@ -146,7 +149,8 @@ class Cluster:
                 handle.proc.kill()
                 handle.proc.wait(timeout=10)
             except Exception:
-                pass
+                logger.debug("kill of remote node pid %s failed",
+                             handle.proc.pid, exc_info=True)
         self.remote_nodes.clear()
         if self.head_node is not None:
             self.head_node.stop()

@@ -12,12 +12,15 @@ stays put inside each actor; only (small) values cross."""
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import uuid
 from typing import Any, Dict, List, Tuple
 
 from .nodes import ClassMethodNode, DAGNode, InputNode, MultiOutputNode
+
+logger = logging.getLogger(__name__)
 
 
 class CompiledDAG:
@@ -217,7 +220,7 @@ class CompiledDAG:
         try:
             ray_tpu.get(self._loop_refs, timeout=30)
         except Exception:  # noqa: BLE001 — teardown is best-effort
-            pass
+            logger.debug("dag loop join at teardown failed", exc_info=True)
         for ch in self._channels:
             ch.destroy()
 

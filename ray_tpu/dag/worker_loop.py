@@ -79,4 +79,5 @@ def exec_loop(instance: Any, plan: List[Dict[str, Any]],
             try:
                 ch.close()
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("channel close in exec loop failed",
+                             exc_info=True)

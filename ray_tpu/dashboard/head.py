@@ -99,7 +99,7 @@ class DashboardHead:
             try:
                 writer.close()
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("dashboard conn close failed", exc_info=True)
 
     async def _route(self, method: str, path: str, query: Dict[str, str],
                      body: bytes) -> Tuple[int, bytes, str]:

@@ -609,6 +609,11 @@ class _SplitCoordinator:
         self._queues = [_queue.Queue(maxsize=4) for _ in range(n)]
         self._executor = dataset._make_executor().run_async()
         self._thread = _threading.Thread(target=self._pump, daemon=True)
+        # Tracked but not joined: the pump parks on bounded queues and
+        # exits with the process; there is no cheap stop signal that
+        # does not also break lagging consumers.
+        from .._internal.threads import register_daemon_thread
+        register_daemon_thread(self._thread, joinable=False)
         self._thread.start()
 
     # A consumer that stops pulling wedges the round-robin pump on its full

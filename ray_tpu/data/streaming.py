@@ -21,12 +21,15 @@ live in the shared-memory object store, only refs flow through the queues.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 from .context import DataContext
+
+logger = logging.getLogger(__name__)
 
 _SENTINEL = object()
 
@@ -128,7 +131,8 @@ class MapOp(Op):
             try:
                 ray_tpu.kill(actor)
             except Exception:
-                pass
+                logger.debug("actor kill at op shutdown failed",
+                             exc_info=True)
         self._actors = []
 
     def num_in_flight(self) -> int:
@@ -162,7 +166,7 @@ class MapOp(Op):
                 try:
                     ray_tpu.kill(doomed)
                 except Exception:  # noqa: BLE001
-                    pass
+                    logger.debug("downscale kill failed", exc_info=True)
         else:
             self._idle_since = None
 
@@ -286,7 +290,7 @@ class ResourceManager:
             elif cw.plasma.contains(oid):
                 size = cw.plasma.size_of(oid)
         except Exception:  # noqa: BLE001 — size is advisory
-            pass
+            logger.debug("block size probe failed", exc_info=True)
         self._size_cache[key] = size
         if len(self._size_cache) > 4096:
             self._size_cache.clear()
@@ -359,8 +363,13 @@ class StreamingExecutor:
     # -- consumer interface ---------------------------------------------
 
     def run_async(self) -> "StreamingExecutor":
+        # Tracking-only: a node teardown sweep must not silently halt a
+        # pipeline mid-iteration; the executor's own shutdown()/consumer
+        # exit sets _stop.
+        from .._internal.threads import register_daemon_thread
         self._thread = threading.Thread(
             target=self._run, name=f"rtpu-data-{self.name}", daemon=True)
+        register_daemon_thread(self._thread, joinable=False)
         self._thread.start()
         return self
 
@@ -454,7 +463,7 @@ class StreamingExecutor:
                 try:
                     op.shutdown()
                 except Exception:
-                    pass
+                    logger.debug("operator shutdown failed", exc_info=True)
             self._finish()
 
     def _emit(self, ref) -> bool:

@@ -23,6 +23,7 @@ them device-to-device). TPU-native design:
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from dataclasses import dataclass
@@ -30,6 +31,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from .._internal.ids import ObjectID
 from .._internal.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _cond = threading.Condition(_lock)
@@ -48,12 +51,12 @@ def _update_gauge():
     try:
         if _gauge is None:
             from ..util.metrics import Gauge
-            _gauge = Gauge("device_object_pinned_bytes",
+            _gauge = Gauge("rtpu_device_object_pinned_bytes",
                            "HBM bytes pinned for device-resident objects "
                            "(device_put_ref + DeviceChannel staging)")
         _gauge.set(float(_accounted_bytes[0]))
     except Exception:  # noqa: BLE001 — metrics best-effort
-        pass
+        logger.debug("pinned-bytes gauge update failed", exc_info=True)
 
 
 def pinned_bytes() -> int:

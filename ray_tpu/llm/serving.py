@@ -130,7 +130,8 @@ class LLMServer:
                 try:
                     self._engine.fail_all(e)
                 except Exception:  # noqa: BLE001
-                    pass
+                    logger.debug("fail_all after engine step failure "
+                                 "raised", exc_info=True)
                 await asyncio.sleep(0.1)
 
     async def _submit(self, request, done_callback, token_callback=None):

@@ -8,10 +8,13 @@ flatten → learner minibatch update → weights broadcast back to runners."""
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class PPOConfig:
@@ -156,4 +159,4 @@ class PPO:
             try:
                 ray_tpu.kill(runner)
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("runner kill at stop failed", exc_info=True)

@@ -14,10 +14,13 @@ ring buffers and batch samples for the learner's device puts."""
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class DQNConfig:
@@ -488,4 +491,4 @@ class DQN:
             try:
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("actor kill at stop failed", exc_info=True)

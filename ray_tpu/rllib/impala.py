@@ -18,10 +18,13 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -444,4 +447,4 @@ class Impala:
             try:
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("actor kill at stop failed", exc_info=True)

@@ -11,11 +11,14 @@ its usual GAE + clipped-surrogate update."""
 
 from __future__ import annotations
 
+import logging
 import time
 import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 
 class MultiAgentEnv:
@@ -414,4 +417,4 @@ class MultiAgentPPO:
             try:
                 ray_tpu.kill(runner)
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("runner kill at stop failed", exc_info=True)

@@ -10,10 +10,13 @@ is ONE jitted XLA program."""
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from .dqn import ReplayBufferActor
 
@@ -461,4 +464,4 @@ class SAC:
             try:
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("actor kill at stop failed", exc_info=True)

@@ -106,7 +106,8 @@ class ServeController:
                 await asyncio.get_running_loop().run_in_executor(
                     None, lambda h=handle: ray_tpu.kill(h))
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("replica kill at shutdown failed",
+                             exc_info=True)
         return True
 
     # -- proxy management --------------------------------------------------

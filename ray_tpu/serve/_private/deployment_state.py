@@ -206,13 +206,15 @@ class DeploymentState:
                 await asyncio.wait_for(
                     r.handle.prepare_for_shutdown.remote(), timeout)
             except Exception:  # noqa: BLE001 — drain is best-effort
-                pass
+                logger.debug("replica drain before stop failed",
+                             exc_info=True)
             loop = asyncio.get_running_loop()
             try:
                 await loop.run_in_executor(
                     None, lambda: ray_tpu.kill(r.handle))
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("replica kill failed (already dead?)",
+                             exc_info=True)
 
     def _harvest_stops(self):
         for tag, r in list(self.replicas.items()):

@@ -24,6 +24,12 @@ class _LocalLoop:
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serve-local-loop")
+        # Singleton loop, re-created on demand (get() checks liveness):
+        # stopping the asyncio loop is enough for join to succeed.
+        from ..._internal.threads import register_daemon_thread
+        register_daemon_thread(
+            self._thread,
+            stop=lambda: self.loop.call_soon_threadsafe(self.loop.stop))
         self._thread.start()
 
     def _run(self):

@@ -127,7 +127,7 @@ class ProxyActor:
             try:
                 writer.close()
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("proxy conn close failed", exc_info=True)
 
     async def _read_request(self, reader: asyncio.StreamReader
                             ) -> Optional[Request]:
@@ -245,7 +245,8 @@ class ProxyActor:
                 await tracked.handle.handle_request.remote(
                     "cancel_stream", (stream_id,), {})
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("cancel_stream after client drop failed",
+                             exc_info=True)
         except Exception:
             # REPLICA failed mid-stream: the chunked body can't be
             # completed and a 500 can't follow a 200 — close the socket
@@ -255,11 +256,12 @@ class ProxyActor:
                 await tracked.handle.handle_request.remote(
                     "cancel_stream", (stream_id,), {})
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("cancel_stream after replica failure failed",
+                             exc_info=True)
             try:
                 writer.close()
             except Exception:  # noqa: BLE001
-                pass
+                logger.debug("proxy conn close failed", exc_info=True)
             raise
 
     def _match_route(self, path: str) -> Optional[str]:

@@ -230,7 +230,8 @@ def shutdown():
         try:
             ray_tpu.kill(controller)
         except Exception:  # noqa: BLE001
-            pass
+            logger.debug("controller kill at serve shutdown failed",
+                         exc_info=True)
 
 
 def _get_controller():

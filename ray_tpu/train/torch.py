@@ -15,6 +15,7 @@ train loops, with gloo allreduce as the DDP data plane."""
 
 from __future__ import annotations
 
+import logging
 import socket
 from typing import Any, Callable, Dict, Optional
 
@@ -70,7 +71,9 @@ def _wrap_torch_loop(user_loop: Callable, torch_config: TorchConfig):
                     finally:
                         probe.close()
             except Exception:  # noqa: BLE001 — rendezvous must not die
-                pass
+                logging.getLogger(__name__).debug(
+                    "routable-address probe failed; using hostname",
+                    exc_info=True)
             sock = socket.socket()
             # bind all interfaces so remote ranks connect via `host`
             sock.bind(("", 0))

@@ -9,6 +9,7 @@ ray_tpu.train.get_context() available."""
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import time
 import uuid
@@ -75,7 +76,8 @@ class JaxTrainer:
             try:
                 ray_tpu.kill(controller)
             except Exception:
-                pass
+                logging.getLogger(__name__).debug(
+                    "controller kill after fit failed", exc_info=True)
         return Result(
             metrics=raw["metrics"],
             checkpoint=Checkpoint(raw["checkpoint"])

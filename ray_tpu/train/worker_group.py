@@ -10,6 +10,7 @@ jax.distributed.initialize master-addr exchange, v2/jax/config.py:36)."""
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 import time
@@ -179,13 +180,15 @@ class WorkerGroup:
             try:
                 ray_tpu.kill(worker)
             except Exception:
-                pass
+                logging.getLogger(__name__).debug(
+                    "worker kill at group shutdown failed", exc_info=True)
         self.workers = []
         for pg in (self.pg, self._slice_pg):
             if pg is not None:
                 try:
                     remove_placement_group(pg)
                 except Exception:
-                    pass
+                    logging.getLogger(__name__).debug(
+                        "placement group removal failed", exc_info=True)
         self.pg = None
         self._slice_pg = None

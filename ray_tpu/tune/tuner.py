@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import time
 import uuid
@@ -165,7 +166,8 @@ class Tuner:
                 try:
                     ray_tpu.kill(trial.actor)
                 except Exception:  # noqa: BLE001
-                    pass
+                    logging.getLogger(__name__).debug(
+                        "trial actor kill failed", exc_info=True)
                 trial.actor = None
             scheduler.on_trial_complete(trial.id)
             # feed model-based searchers (TPE) the final score

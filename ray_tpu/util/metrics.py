@@ -11,12 +11,12 @@ No OpenCensus/OTel dependency — the exposition format is the interface."""
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
-_flusher_started = False
+_flusher_thread: Optional[threading.Thread] = None
+_flusher_stop: Optional[threading.Event] = None
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000]
@@ -174,14 +174,29 @@ METRICS_KV_NS = "metrics"
 
 
 def _ensure_flusher():
-    global _flusher_started
+    global _flusher_thread, _flusher_stop
     with _registry_lock:
-        if _flusher_started:
+        # Liveness-keyed (not a boolean): after node teardown joins the
+        # flusher (or signals it), the next metric construction spawns a
+        # fresh one — and a signaled-but-not-yet-exited thread counts as
+        # stopped, so the restart cannot be lost to that window. An
+        # ident of None means constructed-but-not-yet-started (start()
+        # happens after the lock is released): counts as alive, or two
+        # racing first-metric constructions would both spawn flushers.
+        if _flusher_thread is not None \
+                and (_flusher_thread.ident is None
+                     or _flusher_thread.is_alive()) \
+                and not _flusher_stop.is_set():
             return
-        _flusher_started = True
-    t = threading.Thread(target=_flush_loop, daemon=True,
-                         name="rtpu-metrics-flush")
-    t.start()
+        stop = threading.Event()
+        thread = threading.Thread(target=_flush_loop, args=(stop,),
+                                  daemon=True, name="rtpu-metrics-flush")
+        _flusher_thread, _flusher_stop = thread, stop
+    # Registered with a stop hook so node teardown joins the flusher
+    # (bounded) instead of abandoning it.
+    from .._internal.threads import register_daemon_thread
+    register_daemon_thread(thread, stop=stop.set)
+    thread.start()
 
 
 def snapshot_all() -> List[Dict[str, Any]]:
@@ -217,10 +232,9 @@ def flush_now(gcs=None, key: Optional[str] = None) -> bool:
         return False
 
 
-def _flush_loop():
+def _flush_loop(stop: threading.Event):
     from .._internal.config import CONFIG
-    while True:
-        time.sleep(CONFIG.metrics_report_interval_s)
+    while not stop.wait(CONFIG.metrics_report_interval_s):
         flush_now()
 
 

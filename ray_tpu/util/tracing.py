@@ -15,9 +15,12 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import logging
 import os
 import time
 from typing import Iterator, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _current: contextvars.ContextVar[Optional[Tuple[str, str]]] = \
     contextvars.ContextVar("rtpu_trace_ctx", default=None)
@@ -72,8 +75,9 @@ def _record(name: str, trace_id: str, span_id: str,
                 # span rows the same way it scopes task rows
                 "job_id": worker.current_job_id().hex(),
             }]))
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception:  # noqa: BLE001 — tracing is best-effort
+        logger.debug("span record dropped (GCS unreachable?)",
+                     exc_info=True)
 
 
 def record_child_span(name: str, parent_ctx: Tuple[str, str],

@@ -21,6 +21,12 @@ import threading  # noqa: E402
 
 import pytest  # noqa: E402
 
+# Lock-order sanitizer: armed session-wide under RTPU_SANITIZE=1 (fails
+# the run on acquisition-order cycles), and per-test for the
+# concurrency-heavy modules otherwise (report-only). See
+# ray_tpu/_internal/lint/sanitizer.py.
+pytest_plugins = ["ray_tpu._internal.lint.pytest_plugin"]
+
 TEST_TIMEOUT_S = 120  # reference pytest.ini uses 180s per test
 
 

@@ -1,0 +1,469 @@
+"""rtpulint: per-rule snippet units, the full-tree tier-1 gate, the
+burn-down allowlist contract, and the runtime lock-order sanitizer."""
+
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from ray_tpu._internal.lint import (default_allowlist_path, load_allowlist,
+                                    run_lint)
+from ray_tpu._internal.lint import _check_metric_consistency
+from ray_tpu._internal.lint.rules import lint_source
+from ray_tpu._internal.lint import sanitizer as S
+
+
+def _rules(src, path="ray_tpu/fake_mod.py"):
+    violations, _ = lint_source(src, path)
+    return [v.rule for v in violations]
+
+
+# ---------------------------------------------------------------------------
+# L001 lock discipline
+# ---------------------------------------------------------------------------
+
+def test_l001_bare_acquire_fires():
+    src = (
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f():\n"
+        "    _lock.acquire()\n"
+        "    work()\n"
+        "    _lock.release()\n")
+    assert "L001" in _rules(src)
+
+
+def test_l001_try_finally_acquire_ok():
+    src = (
+        "def f(self):\n"
+        "    self._lock.acquire()\n"
+        "    try:\n"
+        "        work()\n"
+        "    finally:\n"
+        "        self._lock.release()\n")
+    assert "L001" not in _rules(src)
+
+
+def test_l001_freelist_acquire_not_a_lock():
+    # task_spec's template freelist: .acquire() on a non-lock receiver.
+    src = "def f(tmpl):\n    spec = tmpl.acquire()\n    return spec\n"
+    assert _rules(src) == []
+
+
+def test_l001_blocking_call_under_lock_fires():
+    src = (
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        time.sleep(1.0)\n")
+    assert "L001" in _rules(src)
+    src = (
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        self.gcs.call_sync('ping')\n")
+    assert "L001" in _rules(src)
+
+
+def test_l001_blocking_outside_lock_ok():
+    src = (
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        x = self.q.popleft()\n"
+        "    time.sleep(1.0)\n")
+    assert "L001" not in _rules(src)
+
+
+def test_l001_closure_under_with_not_flagged():
+    # A function DEFINED under `with lock:` does not run while held.
+    src = (
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        def cb():\n"
+        "            time.sleep(1.0)\n"
+        "        self.cbs.append(cb)\n")
+    assert "L001" not in _rules(src)
+
+
+def test_l001_condition_wait_not_flagged():
+    src = (
+        "def f(self):\n"
+        "    with self._cond:\n"
+        "        self._cond.wait(1.0)\n")
+    assert "L001" not in _rules(src)
+
+
+# ---------------------------------------------------------------------------
+# L002 swallowed exceptions
+# ---------------------------------------------------------------------------
+
+def test_l002_fires_on_silent_broad_except():
+    src = "try:\n    work()\nexcept Exception:\n    pass\n"
+    assert "L002" in _rules(src)
+    src = "try:\n    work()\nexcept:\n    pass\n"
+    assert "L002" in _rules(src)
+
+
+def test_l002_logging_or_narrow_ok():
+    src = ("try:\n    work()\nexcept Exception:\n"
+           "    logger.debug('x', exc_info=True)\n")
+    assert "L002" not in _rules(src)
+    src = "try:\n    work()\nexcept FileNotFoundError:\n    pass\n"
+    assert "L002" not in _rules(src)
+    # bare except that re-raises is a legitimate cleanup idiom
+    src = "try:\n    work()\nexcept:\n    raise\n"
+    assert "L002" not in _rules(src)
+
+
+# ---------------------------------------------------------------------------
+# L003 flag hygiene
+# ---------------------------------------------------------------------------
+
+def test_l003_typod_kill_switch_fires():
+    assert "L003" in _rules("x = CONFIG.no_flatt_wire\n")
+    assert "L003" in _rules(
+        "import os\nx = os.environ.get('RTPU_NO_FLATT_WIRE')\n")
+    assert "L003" in _rules(
+        "import os\nx = os.environ['RTPU_NO_FLATT_WIRE']\n")
+
+
+def test_l003_registered_flags_ok():
+    assert _rules("x = CONFIG.no_flat_wire\n") == []
+    assert _rules(
+        "import os\nx = os.environ.get('RTPU_NO_FLAT_WIRE')\n") == []
+    # process-plumbing channel, not a flag
+    assert _rules("import os\nx = os.environ['RTPU_WORKER_ID']\n") == []
+    # non-RTPU env is out of scope
+    assert _rules("import os\nx = os.environ.get('HOME')\n") == []
+
+
+# ---------------------------------------------------------------------------
+# L004 metrics hygiene
+# ---------------------------------------------------------------------------
+
+_METRICS_IMPORT = "from ray_tpu.util.metrics import Counter, Gauge\n"
+
+
+def test_l004_bad_name_fires():
+    src = _METRICS_IMPORT + "c = Counter('task_count', 'd')\n"
+    assert "L004" in _rules(src)
+
+
+def test_l004_per_call_construction_fires():
+    src = (_METRICS_IMPORT +
+           "def handler():\n"
+           "    c = Counter('rtpu_requests_total', 'd')\n"
+           "    c.inc()\n")
+    assert "L004" in _rules(src)
+    src = (_METRICS_IMPORT +
+           "for i in range(3):\n"
+           "    c = Counter('rtpu_requests_total', 'd')\n")
+    assert "L004" in _rules(src)
+
+
+def test_l004_sanctioned_construction_ok():
+    src = _METRICS_IMPORT + "c = Counter('rtpu_requests_total', 'd')\n"
+    assert _rules(src) == []
+    src = (_METRICS_IMPORT +
+           "def _build():\n"
+           "    return Counter('rtpu_requests_total', 'd')\n")
+    assert _rules(src) == []
+    src = (_METRICS_IMPORT +
+           "_g = None\n"
+           "def touch():\n"
+           "    global _g\n"
+           "    if _g is None:\n"
+           "        _g = Gauge('rtpu_pinned_bytes', 'd')\n"
+           "    _g.set(1)\n")
+    assert _rules(src) == []
+
+
+def test_l004_collections_counter_not_confused():
+    src = ("import collections\n"
+           "def f():\n"
+           "    return collections.Counter()\n")
+    assert _rules(src) == []
+    src = ("from collections import Counter\n"
+           "def f():\n"
+           "    return Counter()\n")
+    assert _rules(src) == []
+
+
+def test_l004_label_set_consistency_cross_file():
+    _, decls_a = lint_source(
+        _METRICS_IMPORT + "c = Counter('rtpu_x_total', 'd', "
+        "tag_keys=('node',))\n", "ray_tpu/a.py")
+    _, decls_b = lint_source(
+        _METRICS_IMPORT + "c = Counter('rtpu_x_total', 'd', "
+        "tag_keys=('pid',))\n", "ray_tpu/b.py")
+    out = _check_metric_consistency(decls_a + decls_b)
+    assert len(out) == 1 and out[0].rule == "L004"
+    # same labels: fine
+    out = _check_metric_consistency(decls_a + decls_a)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# L005 thread hygiene
+# ---------------------------------------------------------------------------
+
+def test_l005_unregistered_daemon_fires():
+    src = ("import threading\n"
+           "def f():\n"
+           "    threading.Thread(target=work, daemon=True).start()\n")
+    assert "L005" in _rules(src)
+
+
+def test_l005_registered_ok():
+    src = ("import threading\n"
+           "def f():\n"
+           "    t = threading.Thread(target=work, daemon=True)\n"
+           "    register_daemon_thread(t, stop=stop.set)\n"
+           "    t.start()\n")
+    assert "L005" not in _rules(src)
+    src = "def f():\n    spawn_daemon(work, name='x')\n"
+    assert "L005" not in _rules(src)
+    # non-daemon threads are out of scope (they block exit by design)
+    src = ("import threading\n"
+           "def f():\n"
+           "    threading.Thread(target=work).start()\n")
+    assert "L005" not in _rules(src)
+
+
+# ---------------------------------------------------------------------------
+# L006 hot-path pickle
+# ---------------------------------------------------------------------------
+
+def test_l006_pickle_in_hot_path_fires():
+    src = ("from . import serialization\n"
+           "def push(spec):\n"
+           "    return serialization.dumps(spec)\n")
+    assert "L006" in _rules(src, path="ray_tpu/_internal/rpc.py")
+    assert "L006" in _rules(src, path="ray_tpu/_internal/task_spec.py")
+
+
+def test_l006_outside_hot_path_ok():
+    src = ("from . import serialization\n"
+           "def snapshot(x):\n"
+           "    return serialization.dumps(x)\n")
+    assert "L006" not in _rules(src, path="ray_tpu/_internal/gcs.py")
+
+
+# ---------------------------------------------------------------------------
+# full tree + allowlist contract (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+# Burn-down ceiling: the allowlist may only SHRINK. If you fixed an
+# entry, lower this number; never raise it.
+ALLOWLIST_CEILING = 15
+
+
+def test_tree_is_lint_clean():
+    report = run_lint()
+    assert report.checked_files > 100
+    rendered = report.render()
+    assert not report.violations, f"new lint violations:\n{rendered}"
+    assert not report.bad_allowlist_lines, rendered
+    assert not report.unused_allowlist, (
+        "allowlist entries no longer needed (delete them to burn down):\n"
+        + rendered)
+
+
+def test_allowlist_only_burns_down():
+    entries, bad = load_allowlist(default_allowlist_path())
+    assert not bad
+    assert len(entries) <= ALLOWLIST_CEILING, (
+        f"allowlist grew to {len(entries)} entries (ceiling "
+        f"{ALLOWLIST_CEILING}). Fix the violation instead of allowlisting "
+        "it, or justify raising the ceiling in review.")
+    # every suppression must carry a justification
+    assert all(e.justification for e in entries)
+
+
+def test_module_entrypoint_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu._internal.lint", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+    out = json.loads(proc.stdout)
+    assert out["ok"] and out["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order sanitizer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def clean_sanitizer():
+    was_enabled = S.is_enabled()
+    S.reset()
+    yield
+    S.reset()
+    if not was_enabled:
+        S.disable()
+
+
+def test_sanitizer_detects_ab_ba_inversion(clean_sanitizer):
+    A = S.instrument(site="inv:A")
+    B = S.instrument(site="inv:B")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def ba():
+        with B:
+            with A:
+                pass
+
+    for target in (ab, ba):
+        t = threading.Thread(target=target)
+        t.start()
+        t.join()
+    rep = S.report()
+    assert rep["cycles"], "AB/BA inversion must surface as a cycle"
+    cycle = rep["cycles"][0]
+    assert set(cycle) == {"inv:A", "inv:B"}
+    assert "POTENTIAL DEADLOCK" in S.render_report(rep)
+
+
+def test_sanitizer_consistent_order_is_clean(clean_sanitizer):
+    A = S.instrument(site="ord:A")
+    B = S.instrument(site="ord:B")
+    for _ in range(3):
+        with A:
+            with B:
+                pass
+    assert S.report()["cycles"] == []
+
+
+def test_sanitizer_blocked_while_holding(clean_sanitizer):
+    import time
+    A = S.instrument(site="blk:A")
+    B = S.instrument(site="blk:B")
+    entered = threading.Event()
+
+    def holder():
+        with B:
+            entered.set()
+            time.sleep(0.3)
+
+    def waiter():
+        entered.wait(5)
+        with A:
+            with B:   # blocks while holding A
+                pass
+
+    th, tw = threading.Thread(target=holder), threading.Thread(target=waiter)
+    th.start()
+    tw.start()
+    th.join()
+    tw.join()
+    rep = S.report()
+    assert any(b["lock"] == "blk:B" and "blk:A" in b["while_holding"]
+               for b in rep["blocked_while_holding"])
+    assert rep["cycles"] == []  # a wait is not an inversion
+
+
+def test_sanitizer_condition_probe_records_nothing(clean_sanitizer):
+    # threading.Condition._is_owned() try-locks the lock its own thread
+    # holds on every wait()/notify(); try-locks must record no
+    # blocked/nested noise (they cannot deadlock).
+    inner = threading.Lock()
+    proxy = S.instrument(inner, site="cond:L")
+    cond = threading.Condition(proxy)
+    with cond:
+        cond.notify_all()
+        cond.wait(timeout=0.01)
+    rep = S.report()
+    assert rep["blocked_while_holding"] == []
+    assert rep["nested_same_site"] == {}
+
+
+def test_sanitizer_rlock_reentry_not_an_edge(clean_sanitizer):
+    R = S.instrument(site="re:R", reentrant=True)
+    with R:
+        with R:
+            pass
+    rep = S.report()
+    assert rep["edges"] == 0 and rep["cycles"] == []
+
+
+def test_sanitizer_same_site_nesting_tracked_not_cycled(clean_sanitizer):
+    # Two instances born at one site (per-dep-list locks): nesting is
+    # recorded separately, not reported as a 1-node "cycle".
+    L1 = S.instrument(site="dep:lock")
+    L2 = S.instrument(site="dep:lock")
+    with L1:
+        with L2:
+            pass
+    rep = S.report()
+    assert rep["nested_same_site"].get("dep:lock") == 1
+    assert rep["cycles"] == []
+
+
+def test_sanitizer_patches_only_ray_tpu_modules(clean_sanitizer):
+    if S.is_enabled():
+        pytest.skip("sanitizer already armed session-wide")
+    S.enable(register_atexit=False)
+    try:
+        code = "import threading\nL = threading.Lock()\n"
+        ours = {"__name__": "ray_tpu._fake_module"}
+        exec(code, ours)
+        assert isinstance(ours["L"], S.LockProxy)
+        theirs = {"__name__": "some_other_pkg.mod"}
+        exec(code, theirs)
+        assert not isinstance(theirs["L"], S.LockProxy)
+        # the proxy still behaves like a lock
+        with ours["L"]:
+            assert ours["L"].locked()
+        assert not ours["L"].locked()
+    finally:
+        S.disable()
+    after = {"__name__": "ray_tpu._fake_module"}
+    exec("import threading\nL = threading.Lock()\n", after)
+    assert not isinstance(after["L"], S.LockProxy)
+
+
+def test_sanitizer_off_means_untouched():
+    if S.is_enabled():
+        pytest.skip("sanitizer armed session-wide")
+    import threading as t
+    assert t.Lock is S._REAL_LOCK
+    assert t.RLock is S._REAL_RLOCK
+
+
+# ---------------------------------------------------------------------------
+# daemon-thread registry
+# ---------------------------------------------------------------------------
+
+def test_daemon_registry_joins_on_shutdown():
+    from ray_tpu._internal import threads as T
+    stop = threading.Event()
+    seen = []
+
+    def loop():
+        while not stop.wait(0.05):
+            seen.append(1)
+
+    t = T.spawn_daemon(loop, name="test-loop", stop=stop.set)
+    assert t in T.alive_daemon_threads()
+    stuck = T.shutdown_daemon_threads(timeout_s=5.0)
+    assert "test-loop" not in stuck
+    assert not t.is_alive()
+
+
+def test_daemon_registry_nonjoinable_tracked_not_joined():
+    from ray_tpu._internal import threads as T
+    release = threading.Event()
+
+    def park():
+        release.wait(10)
+
+    t = T.spawn_daemon(park, name="test-park")  # no stop => not joinable
+    stuck = T.shutdown_daemon_threads(timeout_s=0.2)
+    assert "test-park" not in stuck          # never attempted
+    assert t.is_alive()                       # still running, by design
+    release.set()
+    t.join(5)
