@@ -145,7 +145,9 @@ def _attach_log_stream(worker):
             print(f"(pid={pid}) {line}", file=stream)
         try:
             stream.flush()
-        except Exception:
+        except (ValueError, OSError):
+            # driver stream already closed at teardown; logging would
+            # write to the same dead stream
             pass
 
     from .rpc import EventLoopThread

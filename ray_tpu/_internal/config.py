@@ -120,6 +120,17 @@ _DEFAULTS: Dict[str, Any] = {
     "event_log_max_entries": 10_000,
     # --- metrics ---
     "metrics_report_interval_s": 5.0,
+    # --- continuous profiler (the CPU observability plane) ---
+    # Default sampling rate for on-demand captures (cli profile /
+    # profile_cluster) when the caller doesn't pass one.
+    "profiler_hz": 100.0,
+    # Bounded per-process sample ring (a sample is ~a few hundred bytes
+    # of interned strings; overflow drops the oldest and counts it).
+    "profiler_ring_size": 65536,
+    # >0: every process (worker/raylet/GCS/driver) starts a continuous
+    # sampler at boot at this rate. Off by default — captures start
+    # samplers on demand.
+    "profiler_autostart_hz": 0.0,
     # --- task events (reference: RAY_task_events_* flags) ---
     "enable_task_events": True,
     # --- logging ---
@@ -137,6 +148,9 @@ _DEFAULTS: Dict[str, Any] = {
     "no_submit_fastpath": False,
     # Disable asyncio eager task factory on the io loop.
     "no_eager_tasks": False,
+    # Kill switch for the stack-sampling profiler: start_profiling
+    # refuses and no sampler thread is ever spawned.
+    "no_profiler": False,
     # --- overrides re-read from the environment at their use site
     # (tests monkeypatch them after CONFIG construction; registered here
     # so L003 can resolve the names) ---

@@ -46,6 +46,7 @@ from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .memory_store import MemoryStore, resolve_entry
 from .object_ref import ObjectRef
 from .plasma import PlasmaDir
+from . import profiler
 from .rpc import Address, ClientPool, EventLoopThread, RpcServer
 from . import serialization
 from . import task_spec as task_spec_codec
@@ -2111,6 +2112,9 @@ class TaskExecutor:
             return {"cancelled": True}
         RUNTIME_CTX.task_spec = spec
         RUNTIME_CTX.actor_id = spec.actor_id
+        # Tag this thread for the stack sampler / fleet stack dumps:
+        # samples taken while user code runs carry the task identity.
+        profiler.note_task(spec)
         self._running_sync.add(spec.task_id)
         self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
         # Continue the caller's trace: user code in this task opening
@@ -2184,6 +2188,7 @@ class TaskExecutor:
                     tuple(spec.trace_context), span_start, time.time())
             RUNTIME_CTX.task_spec = None
             RUNTIME_CTX.actor_id = None
+            profiler.clear_task()
             self._running_sync.discard(spec.task_id)
             # A cancel that raced past the start check is moot once the
             # task finishes; drop the mark so the set stays bounded.
@@ -2233,22 +2238,30 @@ class TaskExecutor:
             if self._is_coroutine_method(spec.method_name, method):
                 RUNTIME_CTX.task_spec = spec
                 RUNTIME_CTX.actor_id = spec.actor_id
+                # io-loop attribution is approximate (awaits interleave
+                # tasks on one thread) but right whenever user code is
+                # actually burning the loop — which is what a CPU
+                # profile needs to show.
+                profiler.note_task(spec)
                 try:
                     result = await method(*args, **kwargs)
                 finally:
                     RUNTIME_CTX.task_spec = None
                     RUNTIME_CTX.actor_id = None
+                    profiler.clear_task()
             else:
                 # Sync method on an async actor: run off-loop so it may
                 # block (e.g. a controller's run() that get()s on workers).
                 def _call(spec=spec):
                     RUNTIME_CTX.task_spec = spec
                     RUNTIME_CTX.actor_id = spec.actor_id
+                    profiler.note_task(spec)
                     try:
                         return method(*args, **kwargs)
                     finally:
                         RUNTIME_CTX.task_spec = None
                         RUNTIME_CTX.actor_id = None
+                        profiler.clear_task()
                 result = await loop.run_in_executor(None, _call)
                 if asyncio.iscoroutine(result):
                     result = await result
@@ -2357,6 +2370,7 @@ class CoreWorker:
                                  self._handle_push_actor_tasks_raw)
         self.server.register_raw("push_task", self._handle_push_task_raw)
         self.rpc_address = loop_thread.run_sync(self.server.start())
+        profiler.maybe_autostart()
 
     def shutdown(self):
         self._shutdown = True
@@ -2838,30 +2852,45 @@ class CoreWorker:
         else:
             self._push_sweeper_on = False
 
-    async def handle_dump_stacks(self, path: str = "") -> bool:
-        """Debug: dump all thread stacks (+ asyncio tasks) to `path` or
-        stderr (reference: the dashboard's on-demand py-spy capture)."""
-        import faulthandler
-        out = open(path, "w") if path else sys.stderr
-        try:
-            faulthandler.dump_traceback(file=out, all_threads=True)
-            try:
-                for t in asyncio.all_tasks():
-                    frames = t.get_stack(limit=5)
-                    where = " <- ".join(
-                        f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
-                        f"{f.f_code.co_name}:{f.f_lineno}"
-                        for f in frames)
-                    out.write(f"\nTASK {t.get_coro().__qualname__} @ "
-                              f"{where}")
-                out.write("\n")
-            except Exception:  # noqa: BLE001
-                logger.debug("asyncio task stack capture failed",
-                             exc_info=True)
-        finally:
-            if path:
-                out.close()
-        return True
+    async def handle_dump_stacks(self, path: str = "",
+                                 quiet: bool = False) -> str:
+        """Debug: render every thread's FULL stack (+ untruncated
+        asyncio task stacks, with task attribution on executor threads)
+        and RETURN the text so `cli stack` can aggregate it
+        cluster-wide; also written to `path` or stderr for the
+        postmortem-file callers (reference: the dashboard's on-demand
+        py-spy capture)."""
+        text = profiler.stack_dump_text(asyncio_tasks=asyncio.all_tasks())
+        if path:
+            with open(path, "w") as out:
+                out.write(text)
+        elif not quiet:
+            sys.stderr.write(text)
+        return text
+
+    # -- continuous profiler control (reference: the reporter agent's
+    # profiling RPCs routing py-spy; here the in-process sampler) ------
+
+    async def handle_start_profiling(self, hz: Optional[float] = None,
+                                     ring_size: Optional[int] = None):
+        return profiler.start_profiling(hz=hz, ring_size=ring_size)
+
+    async def handle_stop_profiling(self):
+        return profiler.stop_profiling()
+
+    async def handle_get_profile(self, clear: bool = True,
+                                 stop: bool = False):
+        report = profiler.get_profile(clear=clear, stop=stop)
+        report["worker_id"] = self.worker_id.hex() \
+            if isinstance(self.worker_id, bytes) else str(self.worker_id)
+        report["node_id"] = self.node_id
+        report["node_index"] = self.node_index
+        report["component"] = self.mode
+        return report
+
+    async def handle_profiling_status(self):
+        return dict(profiler.profiling_status(), component=self.mode,
+                    node_id=self.node_id)
 
     async def handle_task_probe(self, task_hex: str, attempt: int = 0):
         """Owner-side push probe (see _push_with_probe): is this task

@@ -171,6 +171,8 @@ class GcsServer:
         self._restore()
         self._health_task = asyncio.ensure_future(self._health_check_loop())
         self._started = True
+        from . import profiler
+        profiler.maybe_autostart()
         return self.address
 
     async def stop(self):
@@ -1263,6 +1265,36 @@ class GcsServer:
 
     async def handle_ping(self):
         return "pong"
+
+    # -- continuous profiler (the GCS process is part of the fleet:
+    # profile_cluster samples it like any worker/raylet) ---------------
+
+    async def handle_start_profiling(self, hz: Optional[float] = None,
+                                     ring_size: Optional[int] = None):
+        from . import profiler
+        return profiler.start_profiling(hz=hz, ring_size=ring_size)
+
+    async def handle_stop_profiling(self):
+        from . import profiler
+        return profiler.stop_profiling()
+
+    async def handle_get_profile(self, clear: bool = True,
+                                 stop: bool = False):
+        from . import profiler
+        report = profiler.get_profile(clear=clear, stop=stop)
+        report["component"] = "gcs"
+        return report
+
+    async def handle_profiling_status(self):
+        from . import profiler
+        return dict(profiler.profiling_status(), component="gcs")
+
+    async def handle_dump_stacks(self, quiet: bool = True):
+        from . import profiler
+        # pid included so fleet sweeps can dedupe the shared local-mode
+        # process by (host, pid)
+        return {"pid": os.getpid(), "text": profiler.stack_dump_text(
+            asyncio_tasks=asyncio.all_tasks())}
 
     async def handle_get_cluster_view(self):
         return self.cluster_view_snapshot()

@@ -22,7 +22,7 @@ import os
 from . import sanitizer
 
 SANITIZED_TEST_MODULES = ("test_actor_storm", "test_push_recovery",
-                          "test_flat_codec")
+                          "test_flat_codec", "test_profiling")
 
 _env_armed = False
 _ever_armed = False

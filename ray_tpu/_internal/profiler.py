@@ -1,0 +1,458 @@
+"""Continuous stack-sampling profiler: the CPU leg of the
+observability triad (PR 1 time, PR 3 memory, this module CPU).
+
+Every process (worker, raylet, GCS, driver) can run one `StackSampler`
+— a registry-registered daemon thread that samples
+``sys._current_frames()`` at a configurable rate into a bounded ring.
+Each sample is tagged with the task/actor-method the sampled thread is
+executing (the `TaskExecutor` notes its current spec in
+:data:`_CURRENT_TASKS` around user code), so folded profiles attribute
+CPU to tasks and actor classes, not just frames — the py-spy analog
+with no subprocess and no ptrace, per the Parca/conprof
+"always-cheap sampling, post-hoc aggregation" design (PAPERS.md).
+
+Capture flow: CoreWorker/Raylet/GCS expose ``start_profiling`` /
+``stop_profiling`` / ``get_profile`` RPCs over this module's process
+singleton; the raylet fans a node capture out to all its workers, and
+``util/state.profile_cluster`` merges node reports into one collapsed
+flamegraph + speedscope document + top-N attribution tables.
+
+Processes sharing one OS process (local-mode driver + raylet + GCS)
+share the singleton: ``start_profiling`` is idempotent (the first
+caller owns the stop) and ``get_profile(clear=True)`` *drains* the
+ring, so concurrent collectors split samples instead of double-counting
+them.
+
+Kill switch: ``RTPU_NO_PROFILER=1`` — ``start_profiling`` refuses and
+no thread is ever spawned (off-mode cost is zero).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+# Executor threads: samples on these threads with no task attribution
+# are "idle executor" time (the running-vs-idle split in
+# profile_cluster reports).
+_EXECUTOR_THREAD_PREFIXES = ("rtpu-exec", "rtpu-actor", "rtpu-cg-")
+
+# thread ident -> TaskSpec currently executing user code there. Written
+# by TaskExecutor around every task body (two dict ops per task — cheap
+# enough to stay on even with the profiler off, and it doubles as
+# attribution for fleet stack dumps). The sampler reads it racily: a
+# spec recycled between read and attribute access can at worst
+# mis-attribute one sample, which a sampling profiler tolerates.
+_CURRENT_TASKS: Dict[int, Any] = {}
+
+
+def note_task(spec) -> None:
+    """Mark `spec` as executing on the calling thread (executor hook)."""
+    _CURRENT_TASKS[threading.get_ident()] = spec
+
+
+def clear_task() -> None:
+    _CURRENT_TASKS.pop(threading.get_ident(), None)
+
+
+def _task_key(spec) -> Optional[Tuple[str, str, str]]:
+    """(task_hex, display name, actor class) for one executing spec."""
+    if spec is None:
+        return None
+    try:
+        name = spec.name or spec.method_name \
+            or spec.function.display_name()
+        actor = spec.function.qualname if spec.actor_id is not None else ""
+        return (spec.task_id.hex(), name, actor)
+    except Exception:  # noqa: BLE001 — racing a freelist recycle
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the sampler
+# ---------------------------------------------------------------------------
+
+
+class StackSampler:
+    """Daemon-thread sampler over ``sys._current_frames()``.
+
+    Samples land in a bounded ring (`deque(maxlen=ring_size)`) as
+    ``(thread_name, task_key, stack)`` tuples with the stack root-first;
+    `snapshot()` folds them into aggregated rows. Overflow drops the
+    OLDEST sample (the ring is a window onto the recent past) and
+    counts it in `dropped`.
+    """
+
+    def __init__(self, hz: float, ring_size: int):
+        self.hz = max(1.0, min(float(hz), 1000.0))
+        self.interval = 1.0 / self.hz
+        self.ring_size = max(16, int(ring_size))
+        self._ring: deque = deque(maxlen=self.ring_size)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples_total = 0
+        self.dropped = 0
+        self.started_at = time.time()
+        # f_code -> "name (basename" render prefix; code objects are
+        # interned for the process lifetime so the cache is bounded by
+        # the amount of loaded code.
+        self._code_cache: Dict[Any, str] = {}
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stop.is_set()
+
+    def start(self):
+        from .threads import spawn_daemon
+        self._thread = spawn_daemon(
+            self._loop, name=f"rtpu-profiler-{os.getpid()}",
+            stop=self._stop.set)
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        tags = {"pid": str(os.getpid())}
+        while not self._stop.wait(self.interval):
+            t0 = time.perf_counter()
+            dropped_before = self.dropped
+            try:
+                n = self._sample_once()
+            except Exception:  # noqa: BLE001 — sampler must survive
+                logger.debug("profiler sampling pass failed",
+                             exc_info=True)
+                continue
+            metrics.profiler_samples.inc(n, tags=tags)
+            if self.dropped > dropped_before:
+                metrics.profiler_dropped.inc(
+                    self.dropped - dropped_before, tags=tags)
+            metrics.profiler_pass_seconds.observe(
+                time.perf_counter() - t0, tags=tags)
+
+    def _sample_once(self) -> int:
+        """One pass over every live thread; returns samples recorded."""
+        own = threading.get_ident()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        ring = self._ring
+        cache = self._code_cache
+        n = 0
+        for ident, frame in sys._current_frames().items():
+            if ident == own:
+                continue
+            stack: List[str] = []
+            f = frame
+            depth = 0
+            while f is not None and depth < 128:
+                code = f.f_code
+                prefix = cache.get(code)
+                if prefix is None:
+                    prefix = (f"{code.co_name} "
+                              f"({os.path.basename(code.co_filename)}")
+                    cache[code] = prefix
+                stack.append(f"{prefix}:{f.f_lineno})")
+                f = f.f_back
+                depth += 1
+            stack.reverse()  # root-first, the collapsed-stack order
+            task = _task_key(_CURRENT_TASKS.get(ident))
+            if len(ring) == ring.maxlen:
+                self.dropped += 1
+            ring.append((names.get(ident, str(ident)), task,
+                         tuple(stack)))
+            n += 1
+        self.samples_total += n
+        return n
+
+    def snapshot(self, clear: bool = False) -> List[Dict[str, Any]]:
+        """Fold the ring into aggregated rows. ``clear=True`` DRAINS the
+        ring sample-by-sample, so two concurrent collectors in a shared
+        process split the samples instead of double-counting them."""
+        if clear:
+            samples = []
+            ring = self._ring
+            while True:
+                try:
+                    samples.append(ring.popleft())
+                except IndexError:
+                    break
+        else:
+            samples = list(self._ring)
+        return fold_samples(samples)
+
+    def status(self) -> Dict[str, Any]:
+        return {
+            "pid": os.getpid(),
+            "running": self.running,
+            "hz": self.hz,
+            "ring_size": self.ring_size,
+            "ring_len": len(self._ring),
+            "samples_total": self.samples_total,
+            "dropped": self.dropped,
+            "started_at": self.started_at,
+        }
+
+
+def fold_samples(samples) -> List[Dict[str, Any]]:
+    """Fold raw (thread, task, stack) samples into count rows."""
+    counts: Counter = Counter()
+    for thread, task, stack in samples:
+        counts[(thread, task, stack)] += 1
+    rows = []
+    for (thread, task, stack), count in counts.items():
+        rows.append({
+            "thread": thread,
+            "task": task[0] if task else None,
+            "task_name": task[1] if task else None,
+            "actor": (task[2] or None) if task else None,
+            "stack": list(stack),
+            "count": count,
+        })
+    rows.sort(key=lambda r: -r["count"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# process singleton (RPC backend)
+# ---------------------------------------------------------------------------
+
+_SAMPLER: Optional[StackSampler] = None
+_SAMPLER_LOCK = threading.Lock()
+
+
+def profiler_disabled() -> bool:
+    return bool(CONFIG.no_profiler)
+
+
+def start_profiling(hz: Optional[float] = None,
+                    ring_size: Optional[int] = None) -> Dict[str, Any]:
+    """Start (or join) this process's sampler. Returns
+    ``already_running`` so the starter that actually spawned the thread
+    knows it owns the stop."""
+    if profiler_disabled():
+        return {"running": False, "already_running": False,
+                "error": "profiler disabled (RTPU_NO_PROFILER)"}
+    global _SAMPLER
+    with _SAMPLER_LOCK:
+        sampler = _SAMPLER
+        if sampler is not None and sampler.running:
+            return {"running": True, "already_running": True,
+                    "hz": sampler.hz, "pid": os.getpid()}
+        sampler = StackSampler(
+            hz if hz else CONFIG.profiler_hz,
+            ring_size if ring_size else CONFIG.profiler_ring_size)
+        sampler.start()
+        _SAMPLER = sampler
+    return {"running": True, "already_running": False,
+            "hz": sampler.hz, "pid": os.getpid()}
+
+
+def stop_profiling() -> bool:
+    sampler = _SAMPLER
+    if sampler is None:
+        return False
+    sampler.stop()
+    return True
+
+
+def get_profile(clear: bool = True, stop: bool = False) -> Dict[str, Any]:
+    """This process's folded profile + identity/meta. The ring survives
+    a stop, so collect-after-stop orderings lose nothing."""
+    sampler = _SAMPLER
+    if sampler is None:
+        return {"pid": os.getpid(), "samples": [], "meta": {
+            "running": False, "samples_total": 0, "dropped": 0}}
+    if stop:
+        sampler.stop()
+    return {"pid": os.getpid(),
+            "samples": sampler.snapshot(clear=clear),
+            "meta": sampler.status()}
+
+
+def profiling_status() -> Dict[str, Any]:
+    sampler = _SAMPLER
+    if sampler is None:
+        return {"pid": os.getpid(), "running": False,
+                "disabled": profiler_disabled()}
+    return dict(sampler.status(), disabled=profiler_disabled())
+
+
+def maybe_autostart() -> bool:
+    """Continuous mode: every process starts sampling at boot when
+    ``profiler_autostart_hz`` > 0 (off by default; the kill switch wins
+    over it)."""
+    hz = CONFIG.profiler_autostart_hz
+    if hz <= 0 or profiler_disabled():
+        return False
+    return bool(start_profiling(hz).get("running"))
+
+
+# ---------------------------------------------------------------------------
+# whole-process stack dump (cli stack / handle_dump_stacks backend)
+# ---------------------------------------------------------------------------
+
+
+def stack_dump_text(asyncio_tasks=None) -> str:
+    """Render every thread's full stack (and, when the caller passes
+    ``asyncio.all_tasks()``, every asyncio task's UNTRUNCATED stack) as
+    text, with task attribution for executor threads."""
+    lines: List[str] = [f"=== pid {os.getpid()} stack dump "
+                        f"({time.strftime('%H:%M:%S')}) ==="]
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, "?")
+        running = _task_key(_CURRENT_TASKS.get(ident))
+        tag = (f"  [task {running[1]} {running[0][:12]}]"
+               if running else "")
+        lines.append(f"\nThread {name} (ident {ident}){tag}:")
+        lines.append("".join(traceback.format_stack(frame)).rstrip())
+    if asyncio_tasks:
+        lines.append("\n--- asyncio tasks ---")
+        for t in asyncio_tasks:
+            try:
+                frames = t.get_stack()
+                where = " <- ".join(
+                    f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                    f"{f.f_code.co_name}:{f.f_lineno}"
+                    for f in frames) or "(no frames)"
+                lines.append(f"TASK {t.get_coro().__qualname__} @ {where}")
+            except Exception:  # noqa: BLE001 — task may complete mid-walk
+                logger.debug("asyncio task stack render failed",
+                             exc_info=True)
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# report rendering (shared by util/state.profile_cluster and tests)
+# ---------------------------------------------------------------------------
+
+
+def collapse_rows(rows: List[Dict[str, Any]]) -> str:
+    """Collapsed-stack text ("frame;frame;frame count" per line, the
+    flamegraph.pl / speedscope-import format). Task-attributed stacks
+    get a synthetic root frame naming the task so attribution survives
+    into the flamegraph itself."""
+    counts: Counter = Counter()
+    for row in rows:
+        stack = list(row["stack"])
+        if row.get("task_name"):
+            stack.insert(0, f"task:{row['task_name']}")
+        counts[";".join(stack)] += row["count"]
+    return "\n".join(
+        f"{stack} {count}"
+        for stack, count in sorted(counts.items(),
+                                   key=lambda kv: (-kv[1], kv[0])))
+
+
+def speedscope_document(rows: List[Dict[str, Any]],
+                        name: str = "rtpu profile",
+                        hz: float = 100.0) -> Dict[str, Any]:
+    """speedscope.app "sampled" profile: shared frame table + one
+    weighted sample per folded row (weight = sample count / the row's
+    sampling rate → seconds; ``row["hz"]`` overrides the profile-wide
+    `hz` for processes sampled at a different rate)."""
+    frame_index: Dict[str, int] = {}
+    frames: List[Dict[str, str]] = []
+    samples: List[List[int]] = []
+    weights: List[float] = []
+    for row in rows:
+        stack = list(row["stack"])
+        if row.get("task_name"):
+            stack.insert(0, f"task:{row['task_name']}")
+        indexed = []
+        for frame in stack:
+            idx = frame_index.get(frame)
+            if idx is None:
+                idx = frame_index[frame] = len(frames)
+                frames.append({"name": frame})
+            indexed.append(idx)
+        samples.append(indexed)
+        weights.append(row["count"] / (row.get("hz") or hz))
+    total = sum(weights)
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": name,
+            "unit": "seconds",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        }],
+        "exporter": "ray_tpu",
+        "name": name,
+    }
+
+
+def top_attribution(rows: List[Dict[str, Any]], hz: float,
+                    top: int = 20) -> Dict[str, List[Dict[str, Any]]]:
+    """Top-N CPU attribution tables: by task, by actor class, and by
+    (self/leaf) frame. ``cpu_s`` is exclusive sampled CPU time — each
+    row converts at its own sampling rate (``row["hz"]``, set by the
+    cluster merge when a process's continuous sampler runs at a
+    different rate than the capture asked for), falling back to the
+    capture-wide `hz`."""
+    by_task: Dict[Tuple, Dict[str, Any]] = {}
+    by_actor: Dict[str, Dict[str, Any]] = {}
+    by_frame: Dict[str, Dict[str, Any]] = {}
+    for row in rows:
+        count = row["count"]
+        secs = count / (row.get("hz") or hz)
+        if row.get("task"):
+            agg = by_task.setdefault(
+                (row["task"], row.get("task_name")),
+                {"task": row["task"], "name": row.get("task_name"),
+                 "actor": row.get("actor"), "samples": 0, "cpu_s": 0.0})
+            agg["samples"] += count
+            agg["cpu_s"] += secs
+        if row.get("actor"):
+            agg = by_actor.setdefault(
+                row["actor"],
+                {"actor": row["actor"], "samples": 0, "cpu_s": 0.0})
+            agg["samples"] += count
+            agg["cpu_s"] += secs
+        if row["stack"]:
+            leaf = row["stack"][-1]
+            agg = by_frame.setdefault(
+                leaf, {"frame": leaf, "samples": 0, "cpu_s": 0.0})
+            agg["samples"] += count
+            agg["cpu_s"] += secs
+
+    def _top(table):
+        out = sorted(table.values(), key=lambda a: -a["cpu_s"])[:top]
+        for agg in out:
+            agg["cpu_s"] = round(agg["cpu_s"], 3)
+        return out
+
+    return {"by_task": _top(by_task), "by_actor": _top(by_actor),
+            "by_frame": _top(by_frame)}
+
+
+def executor_split(rows: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Running-vs-idle split for executor threads: a sample on an
+    executor thread with no task attribution is idle executor time."""
+    running = idle = 0
+    for row in rows:
+        thread = row.get("thread") or ""
+        if not thread.startswith(_EXECUTOR_THREAD_PREFIXES):
+            continue
+        if row.get("task"):
+            running += row["count"]
+        else:
+            idle += row["count"]
+    return {"running": running, "idle": idle}

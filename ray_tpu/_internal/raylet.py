@@ -160,6 +160,8 @@ class Raylet:
         if CONFIG.memory_monitor_refresh_ms > 0:
             self._tasks.append(
                 asyncio.ensure_future(self._memory_monitor_loop()))
+        from . import profiler
+        profiler.maybe_autostart()
         return self.address
 
     async def stop(self):
@@ -1380,6 +1382,165 @@ class Raylet:
                     "capture_profile", kind=kind, duration_s=duration_s,
                     timeout=duration_s + 60)
         return {"error": f"no worker with pid {pid} on this node"}
+
+    # ------------------------------------------------------------------
+    # continuous profiling plane (the get_memory_report fan-out pattern:
+    # the raylet IS the node agent — one RPC profiles the whole node)
+    # ------------------------------------------------------------------
+
+    def _profiling_targets(self) -> List[WorkerHandle]:
+        return [h for h in self.workers.values()
+                if h.address is not None and h.state != "DEAD"]
+
+    async def handle_start_profiling(self, hz: Optional[float] = None,
+                                     ring_size: Optional[int] = None):
+        from . import profiler
+        return profiler.start_profiling(hz=hz, ring_size=ring_size)
+
+    async def handle_stop_profiling(self):
+        from . import profiler
+        return profiler.stop_profiling()
+
+    async def handle_get_profile(self, clear: bool = True,
+                                 stop: bool = False):
+        from . import profiler
+        report = profiler.get_profile(clear=clear, stop=stop)
+        report["node_id"] = self.node_id
+        report["node_index"] = self.node_index
+        report["component"] = "raylet"
+        return report
+
+    async def handle_profile_node(self, duration_s: float = 2.0,
+                                  hz: Optional[float] = None):
+        """Sample every process on this node for `duration_s`: the
+        raylet's own process plus all live workers, started and
+        collected CONCURRENTLY. A worker that refuses (kill switch) or
+        dies mid-capture contributes an error row, not a gap. Samplers
+        this call started are stopped after collection; an
+        already-running (continuous-mode) sampler is left running."""
+        from . import profiler
+        duration_s = min(float(duration_s), 60.0)
+        hz = hz or CONFIG.profiler_hz
+        own_start = profiler.start_profiling(hz=hz)
+        targets = self._profiling_targets()
+
+        async def _start(handle):
+            try:
+                return await self.clients.get(handle.address).call(
+                    "start_profiling", hz=hz, timeout=10)
+            except Exception as e:  # noqa: BLE001 — surfaced as a row
+                return {"error": str(e)}
+
+        starts = list(await asyncio.gather(
+            *(_start(h) for h in targets))) if targets else []
+
+        # A continuous-mode sampler that was already running has a ring
+        # full of pre-window backlog — drain (discard) it now so the
+        # post-window collection holds only this capture's samples.
+        async def _predrain(handle):
+            try:
+                await self.clients.get(handle.address).call(
+                    "get_profile", clear=True, stop=False, timeout=10)
+            except Exception:  # noqa: BLE001 — collect will surface it
+                logger.debug("profiler pre-drain failed", exc_info=True)
+
+        stale = [h for h, s in zip(targets, starts)
+                 if s.get("already_running")]
+        if own_start.get("already_running"):
+            profiler.get_profile(clear=True)
+        if stale:
+            await asyncio.gather(*(_predrain(h) for h in stale))
+        await asyncio.sleep(duration_s)
+        reports: List[Dict[str, Any]] = []
+        errors: List[Dict[str, Any]] = []
+
+        async def _collect(handle, started):
+            if started.get("error") or not started.get("running"):
+                errors.append({
+                    "node_id": self.node_id, "pid": handle.pid,
+                    "worker_id": handle.worker_id.hex(),
+                    "error": started.get("error", "sampler not running")})
+                return
+            try:
+                reports.append(await asyncio.wait_for(
+                    self.clients.get(handle.address).call(
+                        "get_profile", clear=True,
+                        stop=not started.get("already_running"),
+                        timeout=15), 20))
+            except Exception as e:  # noqa: BLE001 — surfaced as a row
+                errors.append({
+                    "node_id": self.node_id, "pid": handle.pid,
+                    "worker_id": handle.worker_id.hex(),
+                    "error": str(e)})
+
+        if targets:
+            await asyncio.gather(
+                *(_collect(h, s) for h, s in zip(targets, starts)))
+        if own_start.get("running"):
+            own = profiler.get_profile(
+                clear=True, stop=not own_start.get("already_running"))
+            own.update(node_id=self.node_id, node_index=self.node_index,
+                       component="raylet")
+            reports.append(own)
+        else:
+            errors.append({"node_id": self.node_id, "pid": os.getpid(),
+                           "component": "raylet",
+                           "error": own_start.get(
+                               "error", "sampler not running")})
+        return {"node_id": self.node_id, "node_index": self.node_index,
+                "hz": hz, "reports": reports, "errors": errors}
+
+    async def handle_profiling_status(self):
+        """Sampler status for every process on this node."""
+        from . import profiler
+        rows = [dict(profiler.profiling_status(), component="raylet",
+                     node_id=self.node_id)]
+        targets = self._profiling_targets()
+
+        async def _one(handle):
+            try:
+                rows.append(await asyncio.wait_for(
+                    self.clients.get(handle.address).call(
+                        "profiling_status", timeout=10), 15))
+            except Exception as e:  # noqa: BLE001 — surfaced as a row
+                rows.append({"node_id": self.node_id, "pid": handle.pid,
+                             "error": str(e)})
+        if targets:
+            await asyncio.gather(*(_one(h) for h in targets))
+        return {"node_id": self.node_id, "node_index": self.node_index,
+                "processes": rows}
+
+    async def handle_stack_dump_node(self):
+        """One-shot stack dump of every process on this node (the
+        `cli stack` backend): the raylet's own threads plus every live
+        worker's full dump, fetched concurrently."""
+        from . import profiler
+        rows: List[Dict[str, Any]] = [{
+            "node_id": self.node_id, "node_index": self.node_index,
+            "pid": os.getpid(), "component": "raylet",
+            "text": profiler.stack_dump_text(),
+        }]
+        targets = self._profiling_targets()
+
+        async def _one(handle):
+            try:
+                text = await asyncio.wait_for(
+                    self.clients.get(handle.address).call(
+                        "dump_stacks", quiet=True, timeout=15), 20)
+                rows.append({
+                    "node_id": self.node_id,
+                    "node_index": self.node_index,
+                    "pid": handle.pid, "component": "worker",
+                    "worker_id": handle.worker_id.hex(),
+                    "text": text if isinstance(text, str) else "",
+                })
+            except Exception as e:  # noqa: BLE001 — surfaced as a row
+                rows.append({"node_id": self.node_id, "pid": handle.pid,
+                             "worker_id": handle.worker_id.hex(),
+                             "error": str(e)})
+        if targets:
+            await asyncio.gather(*(_one(h) for h in targets))
+        return rows
 
     async def handle_push_object(self, object_hex: str,
                                  target_node_ids: Optional[List[str]] = None):

@@ -112,6 +112,22 @@ def _build() -> SimpleNamespace:
             "rtpu_worker_owned_refs",
             "Entries in this process's reference table",
             tag_keys=("pid",)),
+        # -- continuous profiler meta-metrics (the profiler profiles
+        # itself: sample volume, ring overflow, per-pass overhead) --
+        profiler_samples=Counter(
+            "rtpu_profiler_samples_total",
+            "Stack samples recorded by this process's sampler",
+            tag_keys=("pid",)),
+        profiler_dropped=Counter(
+            "rtpu_profiler_dropped_samples_total",
+            "Samples dropped on ring overflow (oldest evicted)",
+            tag_keys=("pid",)),
+        profiler_pass_seconds=Histogram(
+            "rtpu_profiler_sample_pass_seconds",
+            "Wall time of one sampling pass over all threads",
+            boundaries=[0.00001, 0.00005, 0.0001, 0.00025, 0.0005,
+                        0.001, 0.0025, 0.005, 0.01, 0.05],
+            tag_keys=("pid",)),
     )
 
 

@@ -105,30 +105,41 @@ def _install_profile_hook(out_dir: str):
     import cProfile
     import pstats
     import signal
-    import threading
 
     from .rpc import EventLoopThread
 
-    prof = cProfile.Profile()
-    state = {"on": False}
+    # One FRESH Profile per toggle cycle: reusing a single instance
+    # across cycles accumulated stats forever, and a fixed <pid>.prof
+    # overwrote the previous cycle's dump — each cycle now stands alone
+    # under a timestamped filename.
+    state = {"prof": None}
 
     def toggle(_sig, _frm):
         loop = EventLoopThread.get().loop
-        if not state["on"]:
-            state["on"] = True
+        if state["prof"] is None:
+            prof = state["prof"] = cProfile.Profile()
             loop.call_soon_threadsafe(prof.enable)
         else:
-            state["on"] = False
-            loop.call_soon_threadsafe(prof.disable)
+            prof, state["prof"] = state["prof"], None
 
-            def dump():
+            def dump(prof=prof):
                 os.makedirs(out_dir, exist_ok=True)
-                path = os.path.join(out_dir, f"{os.getpid()}.prof")
+                stamp = time.strftime("%Y%m%d-%H%M%S")
+                path = os.path.join(
+                    out_dir, f"{os.getpid()}-{stamp}.prof")
                 with open(path, "w") as f:
                     pstats.Stats(prof, stream=f).sort_stats(
                         "cumulative").print_stats(40)
-            from .threads import spawn_daemon
-            spawn_daemon(dump, name="rtpu-profile-dump")
+
+            def disable_then_dump(prof=prof):
+                # disable and the dump hand-off run as ONE loop
+                # callback: spawning the dump thread before the loop
+                # has executed disable() would let pstats walk timing
+                # entries the still-profiled loop thread is mutating
+                prof.disable()
+                from .threads import spawn_daemon
+                spawn_daemon(dump, name="rtpu-profile-dump")
+            loop.call_soon_threadsafe(disable_then_dump)
     signal.signal(signal.SIGUSR1, toggle)
 
 

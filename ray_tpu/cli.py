@@ -14,6 +14,9 @@ Usage: python -m ray_tpu.cli <command> ...
   events   [--type T] [--json] [--limit N]               cluster event log
   timeline [--output FILE]                               chrome trace
   trace    [TRACE_ID] [--json]                           span tree / list
+  profile  [--duration S] [--hz N] [--format F]          cluster CPU profile
+           [--node ID] [--pid P] [--task T] [-o FILE]    (merged flamegraph)
+  stack    [--node ID] [--json]                          fleet stack dump
   dashboard                                              start + print URL
   submit   [--wait] -- ENTRYPOINT...                     submit a job
   job      {logs,stop,list} [ID]
@@ -186,8 +189,39 @@ def cmd_status(args):
         for k, v in node["resources_available"].items():
             avail[k] = avail.get(k, 0) + v
     print(f"resources: {avail} available of {total}")
-    pending = len(demand["task_demand"]) + len(demand["pg_demand"])
-    print(f"pending demand: {pending} shapes")
+    # Per-shape pending demand with a feasibility check, so "why is my
+    # task pending" is answerable from here: a shape no amount of
+    # waiting can satisfy is flagged INFEASIBLE. A shape must fit on
+    # ONE node (tasks/bundles don't split), so the test is whether any
+    # single node's totals satisfy every resource at once — not the
+    # cluster-wide sum ({CPU: 12} pends forever on 2x8-CPU nodes).
+    shapes = {}
+    for kind, shape_list in (("task", demand["task_demand"]),
+                             ("pg bundle", demand["pg_demand"])):
+        for shape in shape_list:
+            key = (kind, tuple(sorted(shape.items())))
+            shapes[key] = shapes.get(key, 0) + 1
+    if not shapes:
+        print("pending demand: none")
+        return
+    print(f"pending demand: {sum(shapes.values())} requests, "
+          f"{len(shapes)} shapes")
+    node_totals = [n["resources_total"] for n in nodes]
+    for (kind, shape), count in sorted(shapes.items(),
+                                       key=lambda kv: -kv[1]):
+        demand_dict = dict(shape)
+        line = f"  {count}x {kind} {demand_dict}"
+        fits_somewhere = any(
+            all(nt.get(k, 0) >= v for k, v in shape)
+            for nt in node_totals)
+        if not fits_somewhere:
+            best = {k: max((nt.get(k, 0) for nt in node_totals),
+                           default=0) for k, _v in shape}
+            why = [f"{k} {v:g} > best node {best[k]:g}"
+                   for k, v in shape if v > best[k]]
+            line += (f"  [INFEASIBLE: no single node fits: "
+                     f"{'; '.join(why) or 'combined shape'}]")
+        print(line)
 
 
 def cmd_list(args):
@@ -336,6 +370,88 @@ def cmd_trace(args):
         _render(root, 0)
 
 
+def cmd_profile(args):
+    """Cluster-wide CPU profile (reference: the reporter agent's py-spy
+    routing, fleet-merged): sample every process for --duration at
+    --hz, print top-N task/actor/frame attribution, and emit the merged
+    flamegraph as collapsed stacks or speedscope JSON."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    report = st.profile_cluster(
+        duration_s=args.duration, hz=args.hz, node_id=args.node,
+        pid=args.pid, task=args.task, top=args.top)
+
+    def _emit(text: str):
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(text)
+            print(f"wrote {len(text)} bytes to {args.output}")
+        else:
+            print(text)
+
+    if args.format == "json":
+        _emit(json.dumps(report, indent=1, default=str))
+        return
+    if args.format == "speedscope":
+        _emit(json.dumps(report["speedscope"], default=str))
+        return
+    if args.format == "collapsed":
+        _emit(report["collapsed"])
+        return
+    # table (default): capture summary + attribution tables
+    print(f"sampled {report['num_samples']} stacks across "
+          f"{report['num_processes']} processes "
+          f"({report['duration_s']:g}s @ {report['hz']:g}Hz)")
+    ex = report["executor"]
+    if ex["running"] or ex["idle"]:
+        busy = ex["running"] / (ex["running"] + ex["idle"]) * 100
+        print(f"executor threads: {ex['running']} running / "
+              f"{ex['idle']} idle samples ({busy:.0f}% busy)")
+    for title, key, label in (("top tasks by sampled CPU", "by_task",
+                               "name"),
+                              ("top actor classes", "by_actor", "actor"),
+                              ("top frames (self)", "by_frame", "frame")):
+        rows = report["top"][key]
+        if not rows:
+            continue
+        print(f"\n{title}:")
+        for agg in rows:
+            extra = f"  task={agg['task'][:12]}" if key == "by_task" \
+                else ""
+            print(f"  {agg['cpu_s']:>8.3f}s  x{agg['samples']:<6} "
+                  f"{agg.get(label) or '?'}{extra}")
+    if report["errors"]:
+        print(f"\nunreachable/refused: "
+              f"{json.dumps(report['errors'], default=str)}")
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(report["collapsed"])
+        print(f"\ncollapsed flamegraph written to {args.output}")
+
+
+def cmd_stack(args):
+    """One-shot stack dump of every worker/raylet/GCS/driver in the
+    fleet (reference: `ray stack`, fleet-scoped)."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    rows = st.stack_cluster(node_id=args.node)
+    if args.json:
+        print(json.dumps(rows, indent=1, default=str))
+        return
+    dumped = 0
+    for row in rows:
+        where = f"node {(row.get('node_id') or '?')[:12]} " \
+            f"pid {row.get('pid') or '?'} ({row.get('component', '?')})"
+        if row.get("error"):
+            print(f"==== {where}: UNREACHABLE: {row['error']}")
+            continue
+        dumped += 1
+        print(f"==== {where} " + "=" * 20)
+        print(row.get("text", ""))
+    print(f"dumped {dumped} processes "
+          f"({sum(1 for r in rows if r.get('error'))} unreachable)")
+
+
 def cmd_dashboard(args):
     _connect(args)
     from ray_tpu.dashboard import start_dashboard
@@ -465,6 +581,31 @@ def main(argv=None):
     p.add_argument("--limit", type=int, default=20)
     p.add_argument("--address")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("profile")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--hz", type=float, default=None,
+                   help="sampling rate (default: CONFIG.profiler_hz)")
+    p.add_argument("--format", choices=["table", "collapsed",
+                                        "speedscope", "json"],
+                   default="table")
+    p.add_argument("--output", "-o", default=None)
+    p.add_argument("--node", default=None,
+                   help="restrict to one node (id prefix)")
+    p.add_argument("--pid", type=int, default=None,
+                   help="restrict to one process")
+    p.add_argument("--task", default=None,
+                   help="restrict to one task (id prefix or exact name)")
+    p.add_argument("--top", type=int, default=15)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("stack")
+    p.add_argument("--node", default=None,
+                   help="restrict to one node (id prefix)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_stack)
 
     p = sub.add_parser("dashboard")
     p.add_argument("--address")

@@ -18,6 +18,10 @@ Routes:
   GET  /api/events                    GCS cluster event log
   GET  /api/traces                    recorded trace summaries
   GET  /api/traces/<trace_id>         one trace's span tree
+  GET  /api/profile                   cluster CPU profile (no ?pid=) or
+                                      one-shot worker capture (?pid=)
+  GET  /api/profile/status            fleet sampler status
+  GET  /api/stacks                    fleet-wide stack dumps
   GET  /metrics                       Prometheus exposition
   GET  /-/healthz
   GET  /                              web frontend (single-page app,
@@ -201,8 +205,13 @@ class DashboardHead:
             if not tree["num_spans"]:
                 return self._json({"error": "no such trace"}, 404)
             return self._json(tree)
+        if path == "/api/profile/status":
+            return self._json(st.profiling_status())
         if path == "/api/profile":
             return self._route_profile(query)
+        if path == "/api/stacks":
+            return self._json(st.stack_cluster(
+                node_id=query.get("node_id")))
 
         job_match = re.fullmatch(r"/api/jobs/([^/]*)(/logs|/stop)?", path)
         if path == "/api/jobs/" or job_match:
@@ -210,14 +219,35 @@ class DashboardHead:
         return (404, b"not found", "text/plain")
 
     def _route_profile(self, query: Dict[str, str]):
-        """GET /api/profile?pid=&node_id=&kind=pystack|jax&duration=1
-        (reference: dashboard/modules/reporter/profile_manager.py:82 —
-        on-demand worker profiling; TPU analog = jax xplane capture)."""
-        from .._internal.core_worker import get_core_worker
+        """GET /api/profile — two scopes:
+
+        Cluster (no ``pid``): ?duration=2&hz=100&format=json|collapsed|
+        speedscope[&node_id=&task=&top=] — samples the whole fleet via
+        profile_cluster and returns the merged report (collapsed text
+        for format=collapsed, the speedscope document for
+        format=speedscope, the full report otherwise).
+
+        Single worker (``pid`` given): ?pid=&node_id=&kind=pystack|jax&
+        duration=1 — the original one-shot capture proxied through that
+        node's raylet (reference: dashboard/modules/reporter/
+        profile_manager.py:82; TPU analog = jax xplane capture)."""
+        from ..util import state as st
 
         pid = query.get("pid")
         if not pid:
-            return self._json({"error": "pid query param required"}, 400)
+            report = st.profile_cluster(
+                duration_s=min(float(query.get("duration", 2.0)), 30.0),
+                hz=float(query["hz"]) if query.get("hz") else None,
+                node_id=query.get("node_id"),
+                task=query.get("task"),
+                top=int(query.get("top", 20)))
+            fmt = query.get("format", "json")
+            if fmt == "collapsed":
+                return (200, report["collapsed"].encode(), "text/plain")
+            if fmt == "speedscope":
+                return self._json(report["speedscope"])
+            return self._json(report)
+        from .._internal.core_worker import get_core_worker
         worker = get_core_worker()
         node_id = query.get("node_id") or worker.node_id
         nodes = worker.gcs.call_sync("get_all_nodes", timeout=10)

@@ -38,6 +38,11 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+if pltpu is not None and not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.5 names it TPUCompilerParams; alias so the kernels below
+    # track the current spelling while older toolchains keep working.
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 NEG_INF = -1e30
 
 # Mosaic requires the last dim of every block to be a multiple of the 128-lane
@@ -155,7 +160,7 @@ def _interpret():
     """
     if jax.default_backend() == "tpu":
         return False
-    if pltpu is None:  # pragma: no cover
+    if pltpu is None or not hasattr(pltpu, "InterpretParams"):
         return True
     return pltpu.InterpretParams()
 
@@ -164,6 +169,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
                 acc_scratch, *, sm_scale, causal, block_q, block_k):
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
+    # program_id must be bound at kernel top level: inside a pl.when
+    # branch the interpret-mode cond jaxpr keeps the raw primitive,
+    # which has no CPU lowering (jax < 0.5).
+    qb = pl.program_id(1)
 
     @pl.when(kb == 0)
     def _init():
@@ -179,7 +188,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qb = pl.program_id(1)
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -198,8 +206,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scratch, l_scratch,
             jax.lax.dot(p, v, preferred_element_type=jnp.float32)
 
     if causal:
-        qb = pl.program_id(1)
-
         @pl.when(kb * block_k <= qb * block_q + block_q - 1)
         def _go():
             _compute()
@@ -222,6 +228,8 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qb = pl.program_id(2)
     nq = pl.num_programs(2)
 
+    kb = pl.program_id(1)
+
     @pl.when(qb == 0)
     def _init():
         dk_scratch[:] = jnp.zeros_like(dk_scratch)
@@ -238,7 +246,6 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
         if causal:
-            kb = pl.program_id(1)
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -257,8 +264,6 @@ def _bwd_kv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)    # ds^T q -> [bk, d]
 
     if causal:
-        kb = pl.program_id(1)
-
         @pl.when(qb * block_q + block_q - 1 >= kb * block_k)
         def _go():
             _compute()
@@ -276,6 +281,7 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """Grid (bh, nq, nk): for one q tile, accumulate dQ over kv tiles."""
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
+    qb = pl.program_id(1)
 
     @pl.when(kb == 0)
     def _init():
@@ -292,7 +298,6 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qb = pl.program_id(1)
             q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -307,8 +312,6 @@ def _bwd_q_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k, preferred_element_type=jnp.float32)
 
     if causal:
-        qb = pl.program_id(1)
-
         @pl.when(kb * block_k <= qb * block_q + block_q - 1)
         def _go():
             _compute()

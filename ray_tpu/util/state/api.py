@@ -530,6 +530,255 @@ def memory_summary(limit: int = 10_000, top: int = 10) -> Dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# continuous profiling plane (reference: `ray stack` + the reporter
+# agent's py-spy routing; merged post-hoc like the Parca/conprof line —
+# see _internal/profiler.py for the per-process sampler)
+# ---------------------------------------------------------------------------
+
+def _dedupe_by_host_pid(rows: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Drop later rows that repeat an earlier row's (host, pid):
+    local-mode driver/raylet/GCS share one process and must print once,
+    while bare pids collide ACROSS nodes under per-container pid
+    namespaces so the host must be part of the key. Rows without a pid
+    (pure error rows) always pass through."""
+    deduped: List[Dict[str, Any]] = []
+    seen: set = set()
+    for row in rows:
+        key = (row.get("host"), row.get("pid"))
+        if row.get("pid") is not None and key in seen:
+            continue
+        seen.add(key)
+        deduped.append(row)
+    return deduped
+
+
+def profile_cluster(duration_s: float = 2.0, hz: Optional[float] = None,
+                    node_id: Optional[str] = None,
+                    pid: Optional[int] = None,
+                    task: Optional[str] = None,
+                    top: int = 20) -> Dict[str, Any]:
+    """Sample every process in the fleet for `duration_s` and merge the
+    reports into one collapsed-stack flamegraph, a speedscope document,
+    and top-N CPU attribution tables (by task, actor class, and frame).
+
+    Every raylet fans the capture out to its workers concurrently
+    (`profile_node`); the GCS and the calling driver sample themselves
+    in the same window. Filters: ``node_id`` (prefix) restricts the
+    node sweep, ``pid`` keeps one process's samples, ``task`` keeps
+    samples attributed to a task id prefix or exact task name.
+
+    Processes sharing one OS process (local mode) share a sampler whose
+    collection DRAINS the ring, so concurrent collectors split samples
+    rather than double-count them.
+    """
+    import os as _os
+    import time as _time
+    from ..._internal import profiler
+    from ..._internal.config import CONFIG
+    from ..._internal.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    duration_s = min(float(duration_s), 60.0)
+    hz = float(hz) if hz else CONFIG.profiler_hz
+    nodes = _live_nodes()
+    # The node filter scopes the WHOLE capture: the driver only samples
+    # itself when its own node matches, and the (node-less) GCS only
+    # joins unfiltered captures.
+    include_driver = not node_id or (cw.node_id or "").startswith(node_id)
+    include_gcs = not node_id
+    if node_id:
+        nodes = [n for n in nodes if n["node_id"].startswith(node_id)]
+    errors: List[Dict[str, Any]] = []
+
+    # Start the driver's and the GCS's samplers before the node sweep so
+    # every process covers the same window.
+    own_start = {}
+    gcs_start: Dict[str, Any] = {}
+    if include_driver:
+        own_start = profiler.start_profiling(hz=hz)
+        if own_start.get("already_running"):
+            # continuous-mode sampler: discard the pre-window backlog so
+            # the post-window drain holds only this capture's samples
+            profiler.get_profile(clear=True)
+    if include_gcs:
+        try:
+            gcs_start = _gcs().call_sync("start_profiling", hz=hz,
+                                         timeout=10)
+            if gcs_start.get("already_running"):
+                _gcs().call_sync("get_profile", clear=True, stop=False,
+                                 timeout=10)
+        except Exception as e:  # noqa: BLE001 — surfaced as a row
+            gcs_start = {"error": str(e)}
+            errors.append({"component": "gcs", "error": str(e)})
+
+    def _node_profile(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "profile_node", duration_s=duration_s, hz=hz,
+            timeout=duration_s + 60)
+
+    t0 = _time.monotonic()
+    all_reports: List[Dict[str, Any]] = []
+    for node, result, error in _fanout(nodes, _node_profile):
+        host = tuple(node["address"])[0]
+        if error is not None:
+            errors.append({"node_id": node["node_id"], "error": error})
+            continue
+        all_reports.extend(dict(r, host=host)
+                           for r in result.get("reports", ()))
+        errors.extend(result.get("errors", ()))
+    # No (reachable) raylet slept for us — hold the window open locally.
+    remaining = duration_s - (_time.monotonic() - t0)
+    if remaining > 0:
+        _time.sleep(remaining)
+    own_host = tuple(cw.rpc_address)[0] if cw.rpc_address else "127.0.0.1"
+    if own_start.get("running"):
+        own = profiler.get_profile(
+            clear=True, stop=not own_start.get("already_running"))
+        own.update(component=cw.mode, node_id=cw.node_id,
+                   node_index=cw.node_index, host=own_host)
+        all_reports.append(own)
+    elif own_start.get("error"):
+        errors.append({"component": "driver", "pid": _os.getpid(),
+                       "error": own_start["error"]})
+    if gcs_start.get("running"):
+        gcs_host, _gcs_port = _gcs().address
+        try:
+            all_reports.append(dict(_gcs().call_sync(
+                "get_profile", clear=True,
+                stop=not gcs_start.get("already_running"), timeout=15),
+                host=gcs_host))
+        except Exception as e:  # noqa: BLE001 — surfaced as a row
+            errors.append({"component": "gcs", "error": str(e)})
+
+    merged_rows: List[Dict[str, Any]] = []
+    processes: List[Dict[str, Any]] = []
+    for rep in all_reports:
+        if pid is not None and rep.get("pid") != pid:
+            continue
+        # A continuous-mode sampler keeps its own rate; tag rows with it
+        # so cpu_s/speedscope weights convert at the true rate.
+        rep_hz = rep.get("meta", {}).get("hz") or hz
+        for row in rep.get("samples", ()):
+            if task and not ((row.get("task") or "").startswith(task)
+                             or row.get("task_name") == task):
+                continue
+            if rep_hz != hz:
+                row = dict(row, hz=rep_hz)
+            merged_rows.append(row)
+        meta = rep.get("meta", {})
+        processes.append({
+            "pid": rep.get("pid"),
+            "host": rep.get("host"),
+            "component": rep.get("component"),
+            "node_id": rep.get("node_id"),
+            "node_index": rep.get("node_index"),
+            "worker_id": rep.get("worker_id"),
+            "samples_total": meta.get("samples_total", 0),
+            "dropped": meta.get("dropped", 0),
+        })
+    # local-mode driver/raylet/GCS share one process whose collections
+    # split one ring — keep one meta row per actual OS process
+    processes = _dedupe_by_host_pid(processes)
+    num_samples = sum(r["count"] for r in merged_rows)
+    return {
+        "duration_s": duration_s,
+        "hz": hz,
+        "num_samples": num_samples,
+        "num_processes": len(processes),
+        "collapsed": profiler.collapse_rows(merged_rows),
+        "speedscope": profiler.speedscope_document(
+            merged_rows, name=f"rtpu cluster profile "
+            f"({duration_s:g}s @ {hz:g}Hz)", hz=hz),
+        "top": profiler.top_attribution(merged_rows, hz, top=top),
+        "executor": profiler.executor_split(merged_rows),
+        "processes": processes,
+        "errors": errors,
+    }
+
+
+def stack_cluster(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """One-shot stack dump of every process in the fleet (`cli stack`):
+    each raylet dumps itself + its workers concurrently; the GCS and the
+    calling driver dump themselves. Rows are
+    ``{node_id, pid, component, text}`` (or ``{..., error}``), deduped
+    by (host, pid) so local-mode shared processes print once."""
+    import os as _os
+    from ..._internal import profiler
+    from ..._internal.core_worker import get_core_worker
+
+    cw = get_core_worker()
+    nodes = _live_nodes()
+    if node_id:
+        nodes = [n for n in nodes if n["node_id"].startswith(node_id)]
+
+    def _node_stacks(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "stack_dump_node", timeout=60)
+
+    rows: List[Dict[str, Any]] = []
+    for node, result, error in _fanout(nodes, _node_stacks):
+        host = tuple(node["address"])[0]
+        if error is not None:
+            rows.append({"node_id": node["node_id"], "error": error})
+            continue
+        for row in result:
+            rows.append(dict(row, host=host))
+    # The node filter scopes the whole dump: the (node-less) GCS only
+    # joins unfiltered sweeps, the driver only when its node matches.
+    if not node_id:
+        gcs_host, _gcs_port = _gcs().address
+        try:
+            gcs_dump = _gcs().call_sync("dump_stacks", timeout=30)
+            rows.append({"component": "gcs", "host": gcs_host,
+                         "pid": gcs_dump.get("pid"),
+                         "text": gcs_dump.get("text", "")})
+        except Exception as e:  # noqa: BLE001 — surfaced as a row
+            rows.append({"component": "gcs", "error": str(e)})
+    if not node_id or (cw.node_id or "").startswith(node_id):
+        own_host = tuple(cw.rpc_address)[0] if cw.rpc_address \
+            else "127.0.0.1"
+        rows.append({"component": "driver", "host": own_host,
+                     "node_id": cw.node_id, "pid": _os.getpid(),
+                     "text": profiler.stack_dump_text()})
+    return _dedupe_by_host_pid(rows)
+
+
+def profiling_status() -> List[Dict[str, Any]]:
+    """Per-process sampler status fleet-wide (`/api/profile/status`).
+    Rows dedupe by (host, pid) — bare pids collide across nodes under
+    per-container pid namespaces, while local-mode driver/raylet/GCS
+    share one process and must still print once."""
+    from ..._internal import profiler
+    from ..._internal.core_worker import get_core_worker
+
+    cw = get_core_worker()
+
+    def _node_status(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "profiling_status", timeout=15)
+
+    rows: List[Dict[str, Any]] = []
+    for node, result, error in _fanout(_live_nodes(), _node_status):
+        host = tuple(node["address"])[0]
+        if error is not None:
+            rows.append({"node_id": node["node_id"], "error": error})
+            continue
+        rows.extend(dict(r, host=host)
+                    for r in result.get("processes", ()))
+    gcs_host, _gcs_port = _gcs().address
+    try:
+        rows.append(dict(_gcs().call_sync("profiling_status", timeout=10),
+                         host=gcs_host))
+    except Exception as e:  # noqa: BLE001 — surfaced as a row
+        rows.append({"component": "gcs", "error": str(e)})
+    own_host = tuple(cw.rpc_address)[0] if cw.rpc_address else "127.0.0.1"
+    rows.append(dict(profiler.profiling_status(), component="driver",
+                     node_id=cw.node_id, host=own_host))
+    return _dedupe_by_host_pid(rows)
+
+
 def list_events(event_type: Optional[str] = None,
                 since: Optional[float] = None,
                 severity: Optional[str] = None,
