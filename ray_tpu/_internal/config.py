@@ -98,6 +98,15 @@ _DEFAULTS: Dict[str, Any] = {
     # grant behind hundreds of spawns in an actor storm; retries after
     # this timeout coalesce onto the SAME in-flight grant raylet-side.
     "actor_lease_rpc_timeout_s": 600.0,
+    # --- owner sharding (the multi-loop driver core) ---
+    # Owner shards per CoreWorker: driver-side ownership state (lease /
+    # pending tables, done-stream fold, probe sweeps, reply routing)
+    # partitions across this many io loops, each with its own fastrpc
+    # ring, keyed by hash(task_id/actor_id) % N. 0 = auto (min(4,
+    # cores // 2) for drivers — sharding needs spare cores, small
+    # boxes stay single-loop; always 1 for workers); 1 = the
+    # exact-legacy single-loop A/B path.
+    "owner_shards": 0,
     # --- tasks ---
     "task_max_retries_default": 3,
     "actor_max_restarts_default": 0,

@@ -45,6 +45,9 @@ from .gcs_client import GcsClient
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .memory_store import MemoryStore, resolve_entry
 from .object_ref import ObjectRef
+from .owner_shards import (OwnerShard, ShardSet,
+                           fire_and_forget as _fire_and_forget,
+                           resolve_shard_count, route_bytes)
 from .plasma import PlasmaDir
 from . import profiler
 from .rpc import Address, ClientPool, EventLoopThread, RpcServer
@@ -426,6 +429,129 @@ class ReferenceCounter:
         return rows, truncated
 
 
+class ShardedReferenceCounter:
+    """Owner-sharded reference table (reference: the reference's
+    reference_count.cc partitions its mutex by shard inside the
+    multithreaded core worker). N independent ReferenceCounter slices
+    keyed by object-id hash: unrelated ids never contend on one lock,
+    and ``ObjectID.for_task_return`` shares its task's routing prefix so
+    a task's returns land in one slice. Safe from any thread, exactly
+    like the single-slice counter; batch operations split per slice and
+    keep the one-lock-per-dep-list discipline within each.
+
+    Only constructed for shard counts > 1 — ``RTPU_OWNER_SHARDS=1``
+    instantiates the plain ReferenceCounter (exact-legacy A/B path)."""
+
+    def __init__(self, core_worker: "CoreWorker", count: int):
+        self._count = count
+        self._stripes = [ReferenceCounter(core_worker)
+                         for _ in range(count)]
+
+    def _for(self, object_id: ObjectID) -> ReferenceCounter:
+        return self._stripes[route_bytes(object_id.binary(), self._count)]
+
+    def _split(self, object_ids) -> Dict[int, List[ObjectID]]:
+        buckets: Dict[int, List[ObjectID]] = {}
+        count = self._count
+        for oid in object_ids:
+            buckets.setdefault(route_bytes(oid.binary(), count),
+                               []).append(oid)
+        return buckets
+
+    # -- per-object ops: route to the owning slice ----------------------
+
+    def add_owned(self, object_id: ObjectID, **kwargs):
+        self._for(object_id).add_owned(object_id, **kwargs)
+
+    def new_owned_ref(self, object_id: ObjectID, owner_address: Address,
+                      lineage_task: Optional[TaskID] = None,
+                      callsite: Optional[str] = None) -> ObjectRef:
+        return self._for(object_id).new_owned_ref(
+            object_id, owner_address, lineage_task=lineage_task,
+            callsite=callsite)
+
+    def mark_in_plasma(self, object_id: ObjectID):
+        self._for(object_id).mark_in_plasma(object_id)
+
+    def add_local_ref(self, ref: ObjectRef):
+        self._for(ref.id()).add_local_ref(ref)
+
+    def remove_local_ref(self, ref: ObjectRef):
+        self._for(ref.id()).remove_local_ref(ref)
+
+    def add_borrower(self, object_id: ObjectID):
+        self._for(object_id).add_borrower(object_id)
+
+    def remove_borrower(self, object_id: ObjectID):
+        self._for(object_id).remove_borrower(object_id)
+
+    def on_ref_deserialized(self, ref: ObjectRef):
+        self._for(ref.id()).on_ref_deserialized(ref)
+
+    def is_owner(self, object_id: ObjectID) -> bool:
+        return self._for(object_id).is_owner(object_id)
+
+    # -- batch ops: split once, one lock acquisition per slice ----------
+
+    def set_sizes(self, pairs: List[Tuple[ObjectID, int]]):
+        if not pairs:
+            return
+        count = self._count
+        buckets: Dict[int, List[Tuple[ObjectID, int]]] = {}
+        for oid, size in pairs:
+            buckets.setdefault(route_bytes(oid.binary(), count),
+                               []).append((oid, size))
+        for idx, chunk in buckets.items():
+            self._stripes[idx].set_sizes(chunk)
+
+    def add_submitted(self, object_ids: List[ObjectID]):
+        for idx, chunk in self._split(object_ids).items():
+            self._stripes[idx].add_submitted(chunk)
+
+    def remove_submitted(self, object_ids):
+        for idx, chunk in self._split(object_ids).items():
+            self._stripes[idx].remove_submitted(chunk)
+
+    def add_contained(self, object_ids: List[ObjectID]):
+        for idx, chunk in self._split(object_ids).items():
+            self._stripes[idx].add_contained(chunk)
+
+    def remove_contained(self, object_ids):
+        for idx, chunk in self._split(object_ids).items():
+            self._stripes[idx].remove_contained(chunk)
+
+    def pin_for_transit(self, refs, ttl: float = 60.0):
+        count = self._count
+        buckets: Dict[int, list] = {}
+        for ref in refs:
+            buckets.setdefault(route_bytes(ref.id().binary(), count),
+                               []).append(ref)
+        for idx, chunk in buckets.items():
+            self._stripes[idx].pin_for_transit(chunk, ttl=ttl)
+
+    # -- introspection: fold across slices ------------------------------
+
+    def num_refs(self) -> int:
+        return sum(s.num_refs() for s in self._stripes)
+
+    def memory_report(self, limit: int = 10_000) -> List[Dict[str, Any]]:
+        return self.memory_report_with_meta(limit)[0]
+
+    def memory_report_with_meta(self, limit: int = 10_000
+                                ) -> Tuple[List[Dict[str, Any]], bool]:
+        rows: List[Dict[str, Any]] = []
+        truncated = False
+        for stripe in self._stripes:
+            chunk, trunc = stripe.memory_report_with_meta(limit)
+            rows.extend(chunk)
+            truncated = truncated or trunc
+        if len(rows) > limit:
+            rows.sort(key=lambda r: -r["size"])
+            rows = rows[:limit]
+            truncated = True
+        return rows, truncated
+
+
 # ---------------------------------------------------------------------------
 # Task event buffer (reference: src/ray/core_worker/task_event_buffer.cc —
 # batches task state transitions and flushes them to the GCS task manager,
@@ -680,10 +806,10 @@ class TaskManager:
             logger.info("retrying task %s (%s), attempt %d",
                         spec.name or spec.function.qualname,
                         spec.task_id.hex()[:12], spec.attempt_number)
-            if spec.task_type == ACTOR_TASK:
-                self._cw.actor_submitter.submit(spec)
-            else:
-                self._cw.submitter.resubmit(spec)
+            # Routed resubmit: the retry re-enters the shard that owns
+            # this task/actor (same id -> same shard, so the retry joins
+            # the original's loop-confined state).
+            self._cw.route_submit(spec)
             return True
         with self._lock:
             pending = self.pending.pop(spec.task_id, None)
@@ -708,6 +834,55 @@ class TaskManager:
     def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
         with self._lock:
             return self.lineage.get(task_id)
+
+
+class ShardedTaskManager:
+    """Owner-sharded pending/lineage tables: N TaskManager slices keyed
+    by task-id hash (the reference's in-flight task state partitions the
+    same way inside its multithreaded core worker). Thread-safe like the
+    single slice; every operation routes by the task id it concerns, so
+    a task's whole lifecycle — add_pending, cancel tombstones, the
+    completion fold — stays on one slice/lock. Constructed only for
+    shard counts > 1 (``RTPU_OWNER_SHARDS=1`` keeps the plain
+    TaskManager: exact-legacy A/B path)."""
+
+    def __init__(self, core_worker: "CoreWorker", count: int):
+        self._count = count
+        self._slices = [TaskManager(core_worker) for _ in range(count)]
+
+    def _for(self, task_id: TaskID) -> TaskManager:
+        return self._slices[route_bytes(task_id.binary(), self._count)]
+
+    def add_pending(self, spec: TaskSpec,
+                    dep_ids: Optional[List[ObjectID]] = None,
+                    contained_ids: Optional[List[ObjectID]] = None):
+        self._for(spec.task_id).add_pending(spec, dep_ids, contained_ids)
+
+    def is_pending(self, task_id: TaskID) -> bool:
+        return self._for(task_id).is_pending(task_id)
+
+    def num_pending(self) -> int:
+        return sum(s.num_pending() for s in self._slices)
+
+    def cancel(self, task_id: TaskID) -> Optional[TaskSpec]:
+        return self._for(task_id).cancel(task_id)
+
+    def is_cancelled(self, task_id: TaskID) -> bool:
+        return self._for(task_id).is_cancelled(task_id)
+
+    def _take_cancelled(self, task_id: TaskID) -> bool:
+        return self._for(task_id)._take_cancelled(task_id)
+
+    def on_completed(self, spec: TaskSpec, reply: Dict[str, Any]):
+        self._for(spec.task_id).on_completed(spec, reply)
+
+    def on_failed(self, spec: TaskSpec, error: Exception,
+                  is_application_error: bool) -> bool:
+        return self._for(spec.task_id).on_failed(spec, error,
+                                                 is_application_error)
+
+    def lineage_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        return self._for(task_id).lineage_spec(task_id)
 
 
 # ---------------------------------------------------------------------------
@@ -756,20 +931,27 @@ class _ProbeState:
 
 
 class NormalTaskSubmitter:
-    def __init__(self, core_worker: "CoreWorker"):
+    """One instance per owner shard: every table below is loop-confined
+    to the shard's io loop (``# shard-local`` — rtpulint L007 flags
+    cross-object reads that lack a ``# cross-shard ok:`` justification).
+    Tasks reach their shard via the mailbox (`shard.post`), never by a
+    foreign thread touching these dicts."""
+
+    def __init__(self, core_worker: "CoreWorker", shard: OwnerShard):
         self._cw = core_worker
-        self._idle: Dict[Tuple, List[Lease]] = {}
-        self._running: Dict[TaskID, Lease] = {}  # pushed, awaiting reply
-        self._waiters: Dict[Tuple, collections.deque] = {}
-        self._inflight_requests: Dict[Tuple, int] = {}
-        self._shape_specs: Dict[Tuple, TaskSpec] = {}
+        self._shard = shard
+        self._idle: Dict[Tuple, List[Lease]] = {}  # shard-local
+        self._running: Dict[TaskID, Lease] = {}  # shard-local
+        self._waiters: Dict[Tuple, collections.deque] = {}  # shard-local
+        self._inflight_requests: Dict[Tuple, int] = {}  # shard-local
+        self._shape_specs: Dict[Tuple, TaskSpec] = {}  # shard-local
         # Pre-encoded lease-request meta per shape: the raylet receives
         # an opaque blob it decodes once per request; spillback hops
         # resend the same bytes without re-encoding.
-        self._meta_blobs: Dict[Tuple, bytes] = {}
-        self._request_tasks: set = set()
+        self._meta_blobs: Dict[Tuple, bytes] = {}  # shard-local
+        self._request_tasks: set = set()  # shard-local
         self._cleaner_started = False
-        self._probed: Dict[TaskID, _ProbeState] = {}
+        self._probed: Dict[TaskID, _ProbeState] = {}  # shard-local
         self._probe_sweeper_on = False
 
     async def cancel_pending_requests(self):
@@ -778,7 +960,7 @@ class NormalTaskSubmitter:
             task.cancel()
 
     def submit(self, spec: TaskSpec):
-        self._cw.loop_post(self._submit(spec))
+        self._shard.post(self._submit(spec))
 
     def resubmit(self, spec: TaskSpec):
         self.submit(spec)
@@ -809,7 +991,7 @@ class NormalTaskSubmitter:
         if self._cw.task_manager._take_cancelled(spec.task_id):
             self._return_lease(lease.key, lease)
             return
-        worker = self._cw.clients.get(lease.worker_address)
+        worker = self._shard.clients.get(lease.worker_address)
         self._running[spec.task_id] = lease
         push_t = time.monotonic()
         try:
@@ -823,7 +1005,7 @@ class NormalTaskSubmitter:
                 # Receiver lost the announced template (fresh process on
                 # a reused address / registry pressure): re-announce
                 # inline and push again.
-                self._cw._tmpl_sent.discard(
+                self._shard.tmpl_sent.discard(
                     (lease.worker_address, spec.flat_template.tid))
                 reply = await self._push_with_probe(worker, spec, lease)
         except Exception as e:
@@ -869,7 +1051,7 @@ class NormalTaskSubmitter:
             # is announced once per destination, every push after ships
             # only the struct-packed delta.
             tmpl_data = None
-            sent = self._cw._tmpl_sent
+            sent = self._shard.tmpl_sent
             sent_key = (lease.worker_address, tmpl.tid)
             if sent_key not in sent:
                 if len(sent) > 8192:
@@ -1155,7 +1337,7 @@ class NormalTaskSubmitter:
             if addr is not None:
                 raylet_addr = addr
         for _hop in range(16):
-            raylet = self._cw.clients.get(raylet_addr)
+            raylet = self._shard.clients.get(raylet_addr)
             reply = await raylet.call("request_worker_lease",
                                       meta_blob=blob,
                                       task_hex=spec.task_id.hex(),
@@ -1201,9 +1383,10 @@ class NormalTaskSubmitter:
             # grow with task count.
             if lease.inflight <= 0:
                 lease.dead = True
-                self._cw.fire_and_forget(lease.raylet_address,
-                                         "return_worker", _retries=CONFIG.rpc_max_retries,
-                                         lease_id=lease.lease_id)
+                self._shard.fire_and_forget(lease.raylet_address,
+                                            "return_worker",
+                                            _retries=CONFIG.rpc_max_retries,
+                                            lease_id=lease.lease_id)
                 self._idle.pop(key, None)
                 self._waiters.pop(key, None)
                 self._inflight_requests.pop(key, None)
@@ -1225,9 +1408,10 @@ class NormalTaskSubmitter:
         if lease.retiring:
             if lease.inflight <= 0:
                 lease.dead = True
-                self._cw.fire_and_forget(lease.raylet_address,
-                                         "return_worker", _retries=CONFIG.rpc_max_retries,
-                                         lease_id=lease.lease_id)
+                self._shard.fire_and_forget(lease.raylet_address,
+                                            "return_worker",
+                                            _retries=CONFIG.rpc_max_retries,
+                                            lease_id=lease.lease_id)
                 if self._waiters.get(key):
                     spec = self._shape_specs.get(key)
                     if spec is not None:
@@ -1239,9 +1423,9 @@ class NormalTaskSubmitter:
         if lease.dead:
             return
         lease.dead = True
-        self._cw.fire_and_forget(lease.raylet_address, "return_worker",
-                                 _retries=CONFIG.rpc_max_retries,
-                                 lease_id=lease.lease_id, dispose=True)
+        self._shard.fire_and_forget(lease.raylet_address, "return_worker",
+                                    _retries=CONFIG.rpc_max_retries,
+                                    lease_id=lease.lease_id, dispose=True)
         # With pipelining a failed lease may still be advertised as having
         # capacity — stop handing it out. The lease lives in at most ONE
         # idle list, the one for its acquisition key.
@@ -1265,7 +1449,7 @@ class NormalTaskSubmitter:
                 for lease in leases:
                     if lease.inflight == 0 and \
                             now - lease.last_used > CONFIG.lease_idle_timeout_s:
-                        self._cw.fire_and_forget(
+                        self._shard.fire_and_forget(
                             lease.raylet_address, "return_worker",
                             _retries=CONFIG.rpc_max_retries,
                             lease_id=lease.lease_id)
@@ -1416,15 +1600,22 @@ class ActorTaskSubmitter:
     is recovered through GCS actor-state pubsub + reconcile polling, which
     resubmits or fails whatever is still marked in flight."""
 
-    def __init__(self, core_worker: "CoreWorker"):
+    def __init__(self, core_worker: "CoreWorker", shard: OwnerShard):
         self._cw = core_worker
-        self._actors: Dict[ActorID, ActorClientState] = {}
+        self._shard = shard
+        self._actors: Dict[ActorID, ActorClientState] = {}  # shard-local
         # task_id -> (state, spec) for tasks pushed and not yet reported
-        self._awaiting: Dict[TaskID, Tuple[ActorClientState, TaskSpec]] = {}
-        self._push_time: Dict[TaskID, float] = {}
-        self._subscribed = False
+        self._awaiting: Dict[TaskID, Tuple[ActorClientState, TaskSpec]] = {}  # shard-local
+        self._push_time: Dict[TaskID, float] = {}  # shard-local
         self._sweeper_started = False
         self._wire_bytes_acc = 0  # flushed to the counter every ~32KB
+
+    @property
+    def _subscribed(self) -> bool:
+        # ONE GCS actor-pubsub subscription per process (CoreWorker owns
+        # it and fans updates out to the owning shard's mailbox); every
+        # shard's fast path keys off the same flag.
+        return self._cw._actor_subscribed
 
     def state_for(self, actor_id: ActorID) -> ActorClientState:
         st = self._actors.get(actor_id)
@@ -1436,9 +1627,7 @@ class ActorTaskSubmitter:
         return st
 
     async def ensure_subscribed(self):
-        if not self._subscribed:
-            self._subscribed = True
-            await self._cw.gcs.subscribe("ACTOR", self._on_actor_update)
+        await self._cw.ensure_actor_subscribed()
 
     def submit(self, spec: TaskSpec):
         # Fast path: actor known-ALIVE -> enqueue from the caller's thread
@@ -1472,11 +1661,11 @@ class ActorTaskSubmitter:
                         st.flush_scheduled = True
         if enqueued:
             if need_flush:
-                self._cw.loop_post(self._flush(st))
+                self._shard.post(self._flush(st))
             return
         with st.lock:
             st.slow_pending += 1
-        self._cw.loop_post(self._submit_slow(spec, st))
+        self._shard.post(self._submit_slow(spec, st))
 
     async def _submit_slow(self, spec: TaskSpec, st: ActorClientState):
         try:
@@ -1512,7 +1701,7 @@ class ActorTaskSubmitter:
         fut = st.resolving = asyncio.get_running_loop().create_future()
         try:
             await self.ensure_subscribed()
-            info = await self._cw.gcs.call("get_actor_info",
+            info = await self._cw.gcs_call("get_actor_info",
                                            actor_id=st.actor_id)
             if info is not None and info["state"] == "ALIVE":
                 st.state = "ALIVE"
@@ -1561,7 +1750,7 @@ class ActorTaskSubmitter:
                         st.inflight.pop(spec.sequence_number, None)
                         st.queued.append(spec)
             return
-        worker = self._cw.clients.get(st.address)
+        worker = self._shard.clients.get(st.address)
         try:
             await self._send_batch(worker, st.address, specs)
         except Exception:
@@ -1584,7 +1773,7 @@ class ActorTaskSubmitter:
         frames = []
         tmpls = []
         legacy = []
-        sent = self._cw._tmpl_sent
+        sent = self._shard.tmpl_sent
         encode = task_spec_codec.encode_delta
         for spec in specs:
             tmpl = spec.flat_template
@@ -1612,7 +1801,7 @@ class ActorTaskSubmitter:
         # re-push can batch an arbitrary backlog in one flush.
         for start in range(0, len(frames), 32768):
             chunk = frames[start:start + 32768]
-            payload = _pack_actor_batch(self._cw.rpc_address,
+            payload = _pack_actor_batch(self._shard.rpc_address,
                                         tmpls if start == 0 else [], chunk)
             # Counter inc'd every ~32KB, not per (possibly tiny) batch.
             self._wire_bytes_acc += len(payload)
@@ -1623,7 +1812,7 @@ class ActorTaskSubmitter:
             await worker.oneway_raw("push_actor_tasks", payload)
         if legacy:
             await worker.oneway("push_actor_tasks", specs=legacy,
-                                done_to=self._cw.rpc_address)
+                                done_to=self._shard.rpc_address)
 
     def on_done(self, task_id: TaskID, reply: Dict[str, Any]):
         """A completion from the actor's done stream (possibly duplicated
@@ -1647,7 +1836,7 @@ class ActorTaskSubmitter:
                 # Receiver lost the announced template (fresh process /
                 # registry pressure): clear the announce record so the
                 # re-push re-includes the template bytes.
-                self._cw._tmpl_sent.discard(
+                self._shard.tmpl_sent.discard(
                     (st.address, spec.flat_template.tid))
             if spec.attempt_number < 3:
                 spec.attempt_number += 1
@@ -1693,7 +1882,7 @@ class ActorTaskSubmitter:
             st = self._actors.get(actor_id)
             if st is None or st.state != "ALIVE" or st.address is None:
                 continue
-            client = self._cw.clients.get(st.address)
+            client = self._shard.clients.get(st.address)
             queries = [(self._cw.worker_id.hex(), s.sequence_number,
                         s.task_id.hex()) for s in specs]
             try:
@@ -1754,7 +1943,7 @@ class ActorTaskSubmitter:
                 if not st.queued and not st.inflight:
                     return
                 try:
-                    info = await self._cw.gcs.call("get_actor_info",
+                    info = await self._cw.gcs_call("get_actor_info",
                                                    actor_id=st.actor_id)
                 except Exception:
                     logger.debug("get_actor_info during reconcile failed; "
@@ -1948,7 +2137,7 @@ class TaskExecutor:
         a done-callback to the returned future instead). Must run on the
         io loop. Enforces per-caller submission order by sequence number.
         """
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         caller = spec.owner_worker_id
         seq = spec.sequence_number
         if seq < self._next_seq.get(caller, 0):
@@ -2317,15 +2506,34 @@ class CoreWorker:
         self.gcs = GcsClient(gcs_address, local_server=self.server)
         self.memory_store = MemoryStore()
         self.plasma = PlasmaDir(session_name, node_index)
-        self.reference_counter = ReferenceCounter(self)
         self.task_events = TaskEventBuffer(self)
-        self.task_manager = TaskManager(self)
         from .runtime_env import RuntimeEnvManager
         self.runtime_env_manager = RuntimeEnvManager(
             os.path.join("/tmp", "rtpu", f"session_{session_name}",
                          "runtime_env"))
-        self.submitter = NormalTaskSubmitter(self)
-        self.actor_submitter = ActorTaskSubmitter(self)
+        # Owner shards: ownership state partitions across N io loops
+        # keyed by hash(task_id/actor_id) % N (owner_shards.py). With
+        # one shard (RTPU_OWNER_SHARDS=1, and every worker process)
+        # shard 0 aliases the main loop/server/pool and the plain
+        # TaskManager/ReferenceCounter above stay in place — the
+        # exact-legacy A/B path.
+        self.shards = ShardSet(resolve_shard_count(mode))
+        if len(self.shards) > 1:
+            self.reference_counter = ShardedReferenceCounter(
+                self, len(self.shards))
+            self.task_manager = ShardedTaskManager(self, len(self.shards))
+        else:
+            self.reference_counter = ReferenceCounter(self)
+            self.task_manager = TaskManager(self)
+        for shard in self.shards:
+            shard.submitter = NormalTaskSubmitter(self, shard)
+            shard.actor_submitter = ActorTaskSubmitter(self, shard)
+        # Legacy aliases: shard 0's submitters (the only ones when n=1).
+        self.submitter = self.shards.main.submitter
+        self.actor_submitter = self.shards.main.actor_submitter
+        self._actor_subscribed = False
+        self._actor_sub_lock = threading.Lock()
+        self._actor_sub_fut: Optional[concurrent.futures.Future] = None
         self.executor = TaskExecutor(self)
         self.function_manager = FunctionManager(self.gcs)
         self.job_id = job_id or JobID.from_int(0)
@@ -2335,9 +2543,12 @@ class CoreWorker:
         self._pending_frees: List[str] = []
         self._free_lock = threading.Lock()
         self._done_batches: Dict[Address, List] = {}
-        # (destination address, template id) pairs already announced on
-        # the flat wire path (io-loop-only; see SpecTemplate).
-        self._tmpl_sent: Set[Tuple[Address, bytes]] = set()
+        # The loop serving this process's RpcServer (set at start()):
+        # receive-path timers — push-record TTL sweeps, done-batch
+        # flushes — schedule on THIS handle explicitly, never on the
+        # ambient loop (>1 loop exists once owner shards are up, and
+        # asyncio.get_event_loop() is deprecated under 3.12 anyway).
+        self._serve_loop: Optional[asyncio.AbstractEventLoop] = None
         # normal-task pushes currently known to this worker (arrival ->
         # reply), served to owner-side push probes
         self._received_pushes: Set[TaskID] = set()
@@ -2355,6 +2566,9 @@ class CoreWorker:
         self._completed_push_bytes = 0
         self._push_record_ttl: collections.deque = collections.deque()
         self._push_sweeper_on = False
+        # 1/64 sampling counter for the per-shard submit histogram
+        # (GIL-atomic int ops; racing submitters only skew the phase).
+        self._submit_tick = 0
         # Called with the ObjectID whenever an owned object is freed
         # (device-resident object pins, experimental/device_objects.py).
         self.device_object_free_hooks: List = []
@@ -2364,35 +2578,66 @@ class CoreWorker:
 
     def start(self):
         loop_thread = EventLoopThread.get()
+        self._serve_loop = loop_thread.loop
         self.server.register_instance(self)
         # Flat task paths: raw frames bypass the kwargs pickler.
         self.server.register_raw("push_actor_tasks",
                                  self._handle_push_actor_tasks_raw)
         self.server.register_raw("push_task", self._handle_push_task_raw)
         self.rpc_address = loop_thread.run_sync(self.server.start())
+        self.shards.start_main(loop_thread, self.server, self.clients,
+                               self.rpc_address)
+        self.shards.start_extra(f"{self.mode}-{self.worker_id.hex()[:8]}")
+        for shard in self.shards:
+            # Every shard's server folds ONLY its own done stream
+            # (workers reply to the done_to the owning shard stamped on
+            # the push) — reply routing never crosses shards, and ONE
+            # decoder (the factory) serves main and extra shards alike.
+            shard.server.register(
+                "actor_tasks_done",
+                self._make_done_stream_handler(shard.actor_submitter))
         profiler.maybe_autostart()
+
+    @staticmethod
+    def _make_done_stream_handler(actor_submitter: "ActorTaskSubmitter"):
+        """The ONE actor_tasks_done decoder (bound per shard): a packed
+        id array — one bytes blob per batch, replies aligned by index
+        (the only sender is _flush_done, same build)."""
+        async def handle_actor_tasks_done(ids: bytes, replies):
+            n = TaskID.SIZE
+            for i, reply in enumerate(replies):
+                actor_submitter.on_done(TaskID(ids[i * n:(i + 1) * n]),
+                                        reply)
+        return handle_actor_tasks_done
 
     def shutdown(self):
         self._shutdown = True
-        acc = self.actor_submitter._wire_bytes_acc
+        acc = 0
+        for shard in self.shards:
+            acc += shard.actor_submitter._wire_bytes_acc  # cross-shard ok: teardown, loops quiesced
+            shard.actor_submitter._wire_bytes_acc = 0  # cross-shard ok: teardown, loops quiesced
         if acc:
             # Residual wire-bytes below the batching threshold would
             # otherwise never reach the counter (short-lived drivers
             # would report 0).
-            self.actor_submitter._wire_bytes_acc = 0
             from .runtime_metrics import runtime_metrics
             runtime_metrics().wire_task_bytes.inc(acc)
-        try:
-            EventLoopThread.get().run_sync(
-                self.submitter.cancel_pending_requests(), timeout=5)
-        except Exception:
-            logger.debug("cancel_pending_requests failed during shutdown",
-                         exc_info=True)
+        for shard in self.shards:
+            try:
+                shard.run_sync(
+                    shard.submitter.cancel_pending_requests(), timeout=5)
+            except Exception:
+                logger.debug("cancel_pending_requests failed during "
+                             "shutdown", exc_info=True)
         try:
             EventLoopThread.get().run_sync(self.server.stop(), timeout=5)
         except Exception:
             logger.debug("rpc server stop failed during shutdown",
                          exc_info=True)
+        # Extra owner shards: reply servers, cached clients, loops,
+        # rings — joined here (the threads registry re-joins as a
+        # backstop at node teardown).
+        self.shards.stop()
 
     def current_job_id(self) -> JobID:
         """The job of the task being executed, else this process's job —
@@ -2418,20 +2663,93 @@ class CoreWorker:
 
     def fire_and_forget(self, address: Address, method: str,
                         _retries: int = 0, **kwargs):
-        """Best-effort call. Pass _retries ONLY for IDEMPOTENT methods
-        (return_worker: releasing a lease twice is a no-op) — retries
-        re-execute on a lost reply, which would double-apply counter
-        mutations like borrow_addref/decref."""
-        client = self.clients.get(address)
+        """Best-effort call on the main loop (shared semantics + the
+        _retries idempotency caveat live in owner_shards.fire_and_forget)."""
+        _fire_and_forget(self.clients, self.loop_post, address, method,
+                         _retries=_retries, **kwargs)
 
-        async def _go():
-            try:
-                await client.call(method, timeout=60, retries=_retries,
-                                  **kwargs)
-            except Exception:
-                logger.warning("fire_and_forget %s to %s dropped",
-                               method, address)
-        self.loop_post(_go())
+    # -- cross-shard plumbing --------------------------------------------
+
+    @property
+    def _tmpl_sent(self):
+        """Union of the per-shard flat-wire announce records. Read-only
+        diagnostic (tests / the verify probe); the mutable state lives
+        on each shard (`OwnerShard.tmpl_sent`), loop-confined."""
+        out = set()
+        for shard in self.shards:
+            out |= shard.tmpl_sent  # cross-shard ok: racy diagnostic snapshot
+        return out
+
+    async def gcs_call(self, method: str, **kwargs):
+        """GCS call awaitable from ANY owner-shard loop. The GcsClient's
+        connection (and its pending-reply futures) are main-loop-affine,
+        so a caller on an extra shard's loop hops through the main loop
+        instead of touching the client's state cross-thread. On the main
+        loop itself this is a zero-hop direct call (the shards=1 legacy
+        path compiles down to exactly the old behavior)."""
+        main_loop = self._serve_loop
+        if main_loop is None or asyncio.get_running_loop() is main_loop:
+            return await self.gcs.call(method, **kwargs)
+        cfut = asyncio.run_coroutine_threadsafe(
+            self.gcs.call(method, **kwargs), main_loop)
+        return await asyncio.wrap_future(cfut)
+
+    async def ensure_actor_subscribed(self):
+        """ONE GCS actor-pubsub subscription per process, establishable
+        from any shard loop. The first caller subscribes (on the main
+        loop — pubsub frames arrive at the main server) with a fan-out
+        callback that routes each update to the owning shard's mailbox;
+        concurrent callers from other shards await the same future."""
+        if self._actor_subscribed:
+            return
+        with self._actor_sub_lock:
+            fut = self._actor_sub_fut
+            leader = fut is None
+            if leader:
+                fut = self._actor_sub_fut = concurrent.futures.Future()
+        if not leader:
+            await asyncio.wrap_future(fut)
+            return
+        try:
+            main_loop = self._serve_loop
+            coro = self.gcs.subscribe("ACTOR", self._on_actor_update_fanout)
+            if main_loop is None or \
+                    asyncio.get_running_loop() is main_loop:
+                await coro
+            else:
+                await asyncio.wrap_future(
+                    asyncio.run_coroutine_threadsafe(coro, main_loop))
+            self._actor_subscribed = True
+            fut.set_result(True)
+        except BaseException as e:  # noqa: BLE001 — propagate after reset
+            with self._actor_sub_lock:
+                self._actor_sub_fut = None  # next caller retries
+            fut.set_exception(e)
+            # Exception was handed to the waiters; consuming it here too
+            # keeps "no waiters" runs from logging it as unretrieved.
+            fut.exception()
+            raise
+
+    async def _on_actor_update_fanout(self, message: Dict[str, Any]):
+        """Pubsub fan-out (runs on the main loop): an actor's state
+        updates apply on the shard that owns it — same hash routing as
+        submission, so the update lands where the ActorClientState
+        lives."""
+        shard = self.shards.for_actor(message["actor_id"])
+        if shard.is_main:
+            await shard.actor_submitter._on_actor_update(message)
+        else:
+            shard.post(shard.actor_submitter._on_actor_update(message))
+
+    def route_submit(self, spec: TaskSpec):
+        """Submit/resubmit `spec` on the shard that owns its id (retries
+        and reconstructions re-enter the original's loop-confined
+        state: same id -> same shard)."""
+        shard = self.shards.for_spec(spec)
+        if spec.task_type == ACTOR_TASK:
+            shard.actor_submitter.submit(spec)
+        else:
+            shard.submitter.submit(spec)
 
     async def ensure_job_env(self, job_id: JobID):
         """Adopt the driver's sys.path so its locally-defined functions
@@ -2469,7 +2787,9 @@ class CoreWorker:
         addr = self._node_addr_cache.get(node_id)
         if addr is not None:
             return addr
-        nodes = await self.gcs.call("get_all_nodes")
+        # gcs_call, not gcs.call: node_affinity lease requests await this
+        # from owner-shard loops (the GcsClient is main-loop-affine).
+        nodes = await self.gcs_call("get_all_nodes")
         for n in nodes:
             self._node_addr_cache[n["node_id"]] = tuple(n["address"])
         return self._node_addr_cache.get(node_id)
@@ -2623,7 +2943,7 @@ class CoreWorker:
         dep_ids = [d for d, _ in spec.dependencies()]
         self.reference_counter.add_submitted(
             dep_ids + [c for a in spec.args for c in a.contained_ref_ids])
-        self.submitter.submit(spec)
+        self.route_submit(spec)
         # Wait for it to land.
         self.memory_store.wait_ready(spec.return_ids(), len(spec.return_ids()),
                                      timeout=CONFIG.rpc_call_timeout_s * 10)
@@ -2744,6 +3064,13 @@ class CoreWorker:
     # -- task submission -------------------------------------------------
 
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        # Per-shard submit histogram, 1/64 sampled and only when >1
+        # shard exists (shards=1 has no imbalance to see): this is the
+        # hottest driver path and an unconditional observe() would tax
+        # exactly the workloads the sharding speeds up.
+        sample = self._submit_tick == 0 if len(self.shards) > 1 else False
+        self._submit_tick = (self._submit_tick + 1) & 63
+        t0 = time.monotonic() if sample else 0.0
         dep_ids = [oid for oid, _ in spec.dependencies()]
         contained = [c for a in spec.args for c in a.contained_ref_ids]
         self.task_manager.add_pending(spec, dep_ids, contained)
@@ -2754,10 +3081,16 @@ class CoreWorker:
                     oid, self.rpc_address, lineage_task=spec.task_id,
                     callsite=callsite)
                 for oid in spec.return_ids()]
+        shard = self.shards.for_spec(spec)
         if spec.task_type == ACTOR_TASK:
-            self.actor_submitter.submit(spec)
+            shard.actor_submitter.submit(spec)
         else:
-            self.submitter.submit(spec)
+            shard.submitter.submit(spec)
+        shard.submit_count += 1  # cross-shard ok: monotonic-ish counter, races only lose a tick
+        if sample:
+            from .runtime_metrics import runtime_metrics
+            runtime_metrics().shard_submit.observe(
+                time.monotonic() - t0, tags={"shard": shard.tag})
         return refs
 
     # -- rpc handlers ----------------------------------------------------
@@ -2835,7 +3168,12 @@ class CoreWorker:
         self._push_record_ttl.append((time.monotonic() + 120.0, push_key))
         if not self._push_sweeper_on:
             self._push_sweeper_on = True
-            asyncio.get_event_loop().call_later(60.0, self._sweep_push_records)
+            # Explicit handle: the record table is owned by the serve
+            # loop, and with owner shards up there is more than one loop
+            # in this process — the ambient-loop lookup is the one that
+            # silently rescheduled sweeps onto the wrong loop.
+            loop = self._serve_loop or asyncio.get_running_loop()
+            loop.call_later(60.0, self._sweep_push_records)
 
     def _sweep_push_records(self):
         now = time.monotonic()
@@ -2847,8 +3185,8 @@ class CoreWorker:
             if reply is not None:
                 self._completed_push_bytes -= _reply_nbytes(reply)
         if q:
-            asyncio.get_event_loop().call_later(
-                60.0, self._sweep_push_records)
+            loop = self._serve_loop or asyncio.get_running_loop()
+            loop.call_later(60.0, self._sweep_push_records)
         else:
             self._push_sweeper_on = False
 
@@ -2922,7 +3260,7 @@ class CoreWorker:
                 q.append((task_spec_codec.peek_task_id(delta),
                           {"system_error": "unknown template"}))
                 if len(q) == 1:
-                    asyncio.get_event_loop().call_soon(
+                    asyncio.get_running_loop().call_soon(
                         lambda d=done_to: asyncio.ensure_future(
                             self._flush_done(d)))
                 continue
@@ -2970,7 +3308,11 @@ class CoreWorker:
         # at ~3us/call on n:n floods
         q.append((spec.task_id.binary(), reply))
         if len(q) == 1:
-            asyncio.get_event_loop().call_soon(
+            # Done-batch flush: scheduled on the serve loop that owns
+            # _done_batches (this callback already runs there — the
+            # explicit handle keeps it pinned once >1 loop exists).
+            loop = self._serve_loop or asyncio.get_running_loop()
+            loop.call_soon(
                 lambda: asyncio.ensure_future(self._flush_done(done_to)))
         # codec-decoded specs go back to their freelist (no-op otherwise)
         task_spec_codec.release_spec(spec)
@@ -2990,14 +3332,6 @@ class CoreWorker:
             # owner unreachable; actor-state pubsub recovers the rest
             logger.debug("actor_tasks_done to unreachable owner dropped",
                          exc_info=True)
-
-    async def handle_actor_tasks_done(self, ids: bytes, replies):
-        # Packed id array: one bytes blob for the batch, replies aligned
-        # by index (the only sender is _flush_done, same build).
-        n = TaskID.SIZE
-        for i, reply in enumerate(replies):
-            self.actor_submitter.on_done(
-                TaskID(ids[i * n:(i + 1) * n]), reply)
 
     async def handle_actor_task_status(self, queries):
         """Straggler probe from an owner: for each (caller_hex, seq,
@@ -3019,6 +3353,17 @@ class CoreWorker:
             else:
                 out.append((task_hex, "unknown", None))
         return out
+
+    async def handle_get_shard_stats(self):
+        """Owner-shard introspection: per-shard queue depth, loop lag,
+        and submit counts (cli status and the dashboard node view render
+        these rows — imbalance across shards is visible here)."""
+        return {"pid": os.getpid(), "mode": self.mode,
+                "worker_id": self.worker_id.hex()
+                if isinstance(self.worker_id, bytes)
+                else str(self.worker_id),
+                "num_shards": len(self.shards),
+                "shards": self.shards.stats()}
 
     async def handle_get_memory_report(self, limit: int = 10_000):
         """Owner-side memory introspection (reference: the per-worker
@@ -3092,12 +3437,14 @@ class CoreWorker:
             # Queued specs stay in the stream (pushed as tombstones so the
             # actor's per-caller sequence numbering stays dense); a running
             # task is asyncio-cancelled on the actor.
-            st = self.actor_submitter._actors.get(spec.actor_id)
+            shard = self.shards.for_actor(spec.actor_id)
+            st = shard.actor_submitter._actors.get(spec.actor_id)  # cross-shard ok: racy read, best-effort cancel notify
             if st is not None and st.address is not None:
                 self.fire_and_forget(st.address, "cancel_task",
                                      task_hex=task_id.hex(), force=False)
         else:
-            lease = self.submitter._running.get(task_id)
+            shard = self.shards.for_task(task_id)
+            lease = shard.submitter._running.get(task_id)  # cross-shard ok: racy read, best-effort cancel notify
             if lease is not None:
                 self.fire_and_forget(lease.worker_address, "cancel_task",
                                      task_hex=task_id.hex(), force=force)

@@ -27,6 +27,12 @@ L006 hot-path pickle: serialization/cloudpickle/pickle ``dumps``/``loads``
      in the hot-path modules (rpc.py, task_spec.py, core_worker.py) must
      sit behind the flat-wire fallback gate (allowlisted with a
      justification, one entry per call site scope)
+L007 loop/shard hygiene: ``asyncio.get_event_loop()`` is banned in
+     ``_internal/`` (ambient-loop is wrong once owner shards put >1
+     loop in the process — use ``get_running_loop()`` or an explicit
+     handle); and every cross-object read of a ``# shard-local``
+     registered table (the loop-confined owner-shard dicts) must carry
+     a ``# cross-shard ok: <why>`` justification on the same line
 ==== =====================================================================
 
 Violations report ``file:line`` and carry a stable allowlist key
@@ -46,7 +52,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
-from .rules import MetricDecl, Violation, lint_source
+from .rules import (MetricDecl, ShardAccess, ShardTableDecl, Violation,
+                    check_shard_confinement, lint_source)
 
 __all__ = [
     "Violation", "LintReport", "lint_source", "run_lint",
@@ -168,6 +175,8 @@ def run_lint(root: Optional[str] = None,
 
     all_violations: List[Violation] = []
     metric_decls: List[MetricDecl] = []
+    shard_decls: List[ShardTableDecl] = []
+    shard_accesses: List[ShardAccess] = []
     for filepath in iter_source_files(root):
         rel = os.path.relpath(filepath, root)
         try:
@@ -178,12 +187,16 @@ def run_lint(root: Optional[str] = None,
                 rule="L000", path=rel, line=0, scope="<module>",
                 message=f"unreadable source file: {e}"))
             continue
-        violations, decls = lint_source(src, rel)
+        violations, decls, sdecls, saccs = lint_source(src, rel)
         all_violations.extend(violations)
         metric_decls.extend(decls)
+        shard_decls.extend(sdecls)
+        shard_accesses.extend(saccs)
         report.checked_files += 1
 
     all_violations.extend(_check_metric_consistency(metric_decls))
+    all_violations.extend(
+        check_shard_confinement(shard_decls, shard_accesses))
 
     for v in all_violations:
         entry = by_key.get(v.key)
@@ -227,7 +240,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
         prog="rtpulint",
-        description="ray_tpu project lint (rules L001-L006)")
+        description="ray_tpu project lint (rules L001-L007)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     parser.add_argument("--root", default=None,

@@ -22,7 +22,8 @@ import os
 from . import sanitizer
 
 SANITIZED_TEST_MODULES = ("test_actor_storm", "test_push_recovery",
-                          "test_flat_codec", "test_profiling")
+                          "test_flat_codec", "test_profiling",
+                          "test_owner_shards")
 
 _env_armed = False
 _ever_armed = False

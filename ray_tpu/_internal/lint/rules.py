@@ -45,6 +45,30 @@ class MetricDecl:
     scope: str
 
 
+@dataclass
+class ShardTableDecl:
+    """A ``self.<attr> = ... # shard-local`` declaration: the attr joins
+    the cross-file registry of loop-confined owner-shard tables."""
+    attr: str
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class ShardAccess:
+    """A cross-object read of a private attribute (``x._tbl`` where the
+    receiver is not ``self``). The engine flags it under L007 when the
+    attr is in the shard-table registry and the line lacks a
+    ``# cross-shard ok:`` justification."""
+    attr: str
+    receiver: str
+    annotated: bool
+    path: str
+    line: int
+    scope: str
+
+
 # ---------------------------------------------------------------------------
 # shared helpers
 # ---------------------------------------------------------------------------
@@ -88,6 +112,15 @@ _PICKLER_RECEIVERS = {"serialization", "cloudpickle", "pickle"}
 # L005: the registry module itself creates the threads.
 _THREADS_HELPER_FILE = "ray_tpu/_internal/threads.py"
 _THREAD_REGISTER_FUNCS = {"register_daemon_thread", "spawn_daemon"}
+
+# L007: ambient-loop lookups are banned in _internal/ — with owner
+# shards there is more than one loop per process, so "the" event loop
+# is whichever thread you happen to be on (and get_event_loop() is
+# deprecated outside a running loop under 3.12 anyway). Use
+# get_running_loop(), an explicit loop handle, or the shard mailbox.
+_L007_DIR = "ray_tpu/_internal/"
+_SHARD_LOCAL_MARK = "# shard-local"
+_CROSS_SHARD_MARK = "# cross-shard ok"
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -152,18 +185,23 @@ class _Scope:
 
 class _Linter(ast.NodeVisitor):
     def __init__(self, path: str, known_flags: Sequence[str],
-                 bootstrap_env: Sequence[str]):
+                 bootstrap_env: Sequence[str],
+                 src_lines: Optional[Sequence[str]] = None):
         self.path = path
         self.known_flags = frozenset(known_flags)
         self.bootstrap_env = frozenset(bootstrap_env)
         self.violations: List[Violation] = []
         self.metric_decls: List[MetricDecl] = []
+        self.shard_decls: List[ShardTableDecl] = []
+        self.shard_accesses: List[ShardAccess] = []
+        self._lines: Sequence[str] = src_lines or ()
         self._scopes: List[_Scope] = [_Scope("<module>", None)]
         self._metric_aliases: set = set()   # Counter/... imported from metrics
         self._loop_depth = 0
         self._hot_path = path in _HOT_PATH_FILES
         self._is_threads_helper = path == _THREADS_HELPER_FILE
         self._is_config = path == "ray_tpu/_internal/config.py"
+        self._internal = path.startswith(_L007_DIR)
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -260,6 +298,47 @@ class _Linter(ast.NodeVisitor):
                 self._emit("L003", node,
                            f"CONFIG.{attr} is not registered in "
                            "config._DEFAULTS (typo'd flag?)")
+        # L007b candidate: a private attribute read through a receiver
+        # other than bare `self` (cross-object). Recorded for the
+        # engine's cross-file fold against the shard-table registry —
+        # _internal/ only, like L007a: matching is by bare attribute
+        # name, and an unrelated `_running`/`_actors` in user-facing
+        # code must not trip shard-confinement findings.
+        if self._internal and node.attr.startswith("_") and not (
+                isinstance(node.value, ast.Name)
+                and node.value.id in ("self", "cls")):
+            recv = _dotted(node.value)
+            if recv is not None:
+                self.shard_accesses.append(ShardAccess(
+                    attr=node.attr, receiver=recv,
+                    annotated=self._line_marked(node, _CROSS_SHARD_MARK),
+                    path=self.path, line=node.lineno, scope=self.scope))
+        self.generic_visit(node)
+
+    def _line_marked(self, node: ast.AST, mark: str) -> bool:
+        line = getattr(node, "lineno", 0)
+        if 0 < line <= len(self._lines):
+            return mark in self._lines[line - 1]
+        return False
+
+    # -- L007a: shard-local table declarations ------------------------------
+
+    def _maybe_shard_decl(self, node: ast.AST, target: ast.AST):
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" \
+                and self._line_marked(node, _SHARD_LOCAL_MARK):
+            self.shard_decls.append(ShardTableDecl(
+                attr=target.attr, path=self.path, line=node.lineno,
+                scope=self.scope))
+
+    def visit_Assign(self, node: ast.Assign):
+        for target in node.targets:
+            self._maybe_shard_decl(node, target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        self._maybe_shard_decl(node, node.target)
         self.generic_visit(node)
 
     def visit_Subscript(self, node: ast.Subscript):
@@ -338,6 +417,18 @@ class _Linter(ast.NodeVisitor):
                             "threads.register_daemon_thread() in the same "
                             "scope")
                     break
+
+        # L007a: ambient-loop lookup in _internal/ — with owner shards
+        # more than one loop exists per process, so the ambient loop is
+        # whichever thread you happen to be on.
+        if self._internal and term == "get_event_loop" \
+                and dotted in ("asyncio.get_event_loop",
+                               "get_event_loop"):
+            self._emit("L007", node,
+                       "asyncio.get_event_loop() is ambient-loop — use "
+                       "asyncio.get_running_loop(), an explicit loop "
+                       "handle (CoreWorker._serve_loop / OwnerShard."
+                       "loop), or the shard mailbox")
 
         # L006: pickler on a hot-path module
         if self._hot_path and term in ("dumps", "loads") \
@@ -459,7 +550,8 @@ class _Linter(ast.NodeVisitor):
     def run(self, tree: ast.Module):
         self._module = tree
         self.visit(tree)
-        return self.violations, self.metric_decls
+        return (self.violations, self.metric_decls, self.shard_decls,
+                self.shard_accesses)
 
 
 def _project_tables() -> Tuple[frozenset, frozenset]:
@@ -470,7 +562,8 @@ def _project_tables() -> Tuple[frozenset, frozenset]:
 def lint_source(src: str, path: str,
                 known_flags: Optional[Sequence[str]] = None,
                 bootstrap_env: Optional[Sequence[str]] = None,
-                ) -> Tuple[List[Violation], List[MetricDecl]]:
+                ) -> Tuple[List[Violation], List[MetricDecl],
+                           List[ShardTableDecl], List[ShardAccess]]:
     """Lint one file's source. ``path`` must be repo-relative with
     forward slashes (it selects per-module rule behavior and becomes the
     allowlist key)."""
@@ -483,5 +576,27 @@ def lint_source(src: str, path: str,
     except SyntaxError as e:
         return [Violation(rule="L000", path=path, line=e.lineno or 0,
                           scope="<module>",
-                          message=f"syntax error: {e.msg}")], []
-    return _Linter(path, known_flags, bootstrap_env).run(tree)
+                          message=f"syntax error: {e.msg}")], [], [], []
+    return _Linter(path, known_flags, bootstrap_env,
+                   src_lines=src.splitlines()).run(tree)
+
+
+def check_shard_confinement(decls: List[ShardTableDecl],
+                            accesses: List[ShardAccess]
+                            ) -> List[Violation]:
+    """L007b cross-file fold: every cross-object read of a registered
+    ``# shard-local`` table must carry a ``# cross-shard ok:``
+    justification on the same line — those tables are loop-confined, and
+    an unannotated foreign read is either a data race or an unreviewed
+    observability peek."""
+    registry = {d.attr for d in decls}
+    out: List[Violation] = []
+    for a in accesses:
+        if a.attr in registry and not a.annotated:
+            out.append(Violation(
+                rule="L007", path=a.path, line=a.line, scope=a.scope,
+                message=(f"{a.receiver}.{a.attr} reads a shard-local "
+                         "table across objects — route through the "
+                         "owning shard's mailbox, or annotate the line "
+                         "`# cross-shard ok: <why this race is safe>`")))
+    return out

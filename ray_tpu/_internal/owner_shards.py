@@ -1,0 +1,355 @@
+"""Owner shards: the partitioned driver-side ownership plane.
+
+The reference closes the n:n async-call gap with a multithreaded C++
+core worker whose ownership tables (reference counts, in-flight task
+state) are internally partitioned. This module is the Python analog:
+driver-side ownership state — lease/pending tables, the done-stream
+fold, push-probe sweeps, reply routing — splits into N **owner shards**,
+each owning its slice exclusively on its own io loop with its own
+fastrpc ring (``NativeIO.new_ring()``), keyed by
+``hash(task_id/actor_id) % N``.
+
+Exclusivity rules:
+
+* Loop-confined tables (submitter lease pools, actor send queues, the
+  ``_awaiting`` done-stream fold, probe state) belong to exactly one
+  shard and are only mutated on its loop. There are NO locks between
+  shards.
+* Cross-shard interactions go through a small mailbox —
+  ``OwnerShard.post`` (batched ``call_soon_threadsafe``) for loop work,
+  and the rpc layer's owner-loop hop for in-process calls to main-loop
+  services (raylet/GCS).
+* Lock-striped tables (the reference counter and pending-task slices)
+  partition by id hash so unrelated ids never contend on one lock; they
+  stay safe to read from any thread.
+
+``RTPU_OWNER_SHARDS=1`` is the exact-legacy A/B path: shard 0 IS the
+process-main io loop / server / client pool, no extra threads or rings
+exist, and every routing function degenerates to a constant. ``0`` =
+auto (min(4, cores // 2) for drivers — an io loop saturates about one
+core, so small boxes stay single-loop; 1 for workers — worker-side
+ownership is a nested-submission corner, not the hot path). Raylet and worker
+processes are untouched; the wire format does not change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+import time
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+from .config import CONFIG
+from .rpc import Address, ClientPool, EventLoopThread, IoLoopThread, RpcServer
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .core_worker import ActorTaskSubmitter, NormalTaskSubmitter, TaskManager
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_shard_count(mode: str) -> int:
+    """Shard count for a CoreWorker: ``owner_shards`` flag, 0 = auto.
+
+    Auto gives drivers min(4, cores // 2) — the submit fan-in side
+    where the single-loop bottleneck lives — and workers 1 (their
+    ownership tables only see nested submissions; extra loops would be
+    pure thread bloat across a large worker fleet). The cores // 2
+    clamp matters: an io loop saturates about one core, so sharding
+    pays only when cores exceed what the submitting threads + main
+    loop already use — on a 1-2 core box extra loops just fight the
+    GIL (measured: the multi-client flood REGRESSES ~1.5x there,
+    PERF.md round-10), so auto stays on the exact-legacy single loop
+    below 4 cores."""
+    n = int(CONFIG.owner_shards)
+    if n > 0:
+        return min(n, 64)
+    if mode != "driver":
+        return 1
+    return max(1, min(4, (os.cpu_count() or 1) // 2))
+
+
+def fire_and_forget(clients: "ClientPool", post, address: Address,
+                    method: str, _retries: int = 0, **kwargs) -> None:
+    """Best-effort call on whatever loop `post` targets. Pass _retries
+    ONLY for IDEMPOTENT methods (return_worker: releasing a lease twice
+    is a no-op) — retries re-execute on a lost reply, which would
+    double-apply counter mutations like borrow_addref/decref. Shared by
+    CoreWorker (main loop) and OwnerShard (shard loop) so the semantics
+    can't drift apart."""
+    client = clients.get(address)
+
+    async def _go():
+        try:
+            await client.call(method, timeout=60, retries=_retries,
+                              **kwargs)
+        except Exception:
+            logger.warning("fire_and_forget %s to %s dropped",
+                           method, address)
+    post(_go())
+
+
+def route_bytes(b: bytes, n: int) -> int:
+    """Deterministic id-bytes -> shard index (same id => same shard,
+    stable across processes and runs: Python's salted hash() must not
+    leak into routing). The first two bytes of every routable id are
+    uniformly random — and ``ObjectID.for_task_return`` shares its
+    task's prefix, so an object routes with the task that creates it."""
+    if n <= 1:
+        return 0
+    return (b[0] | (b[1] << 8)) % n
+
+
+class OwnerShard:
+    """One shard's infrastructure: loop, ring, server, clients, and the
+    per-shard ownership components CoreWorker hangs onto it. Shard 0 of
+    a sharded set (and the only shard of a shards=1 set) aliases the
+    process-main loop/server/pool, which makes the legacy path exact."""
+
+    __slots__ = ("index", "tag", "is_main", "loop_thread", "server",
+                 "clients", "rpc_address", "ring", "tmpl_sent",
+                 "task_manager", "submitter", "actor_submitter",
+                 "submit_count")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.tag = str(index)  # precomputed metric tag
+        self.is_main = index == 0
+        self.loop_thread: Optional[IoLoopThread] = None
+        self.server: Optional[RpcServer] = None
+        self.clients: Optional[ClientPool] = None
+        self.rpc_address: Optional[Address] = None
+        self.ring = None  # NativeIO ring (extra shards, native only)
+        # (destination address, template id) pairs this shard has
+        # announced on the flat wire path. Per shard: announces are
+        # idempotent, so two shards announcing to one destination is
+        # benign, while a shared set would race check-then-add across
+        # loops.
+        self.tmpl_sent = set()
+        self.task_manager: Optional["TaskManager"] = None
+        self.submitter: Optional["NormalTaskSubmitter"] = None
+        self.actor_submitter: Optional["ActorTaskSubmitter"] = None
+        self.submit_count = 0  # monotonic-ish; races only lose a tick
+
+    # -- mailbox ---------------------------------------------------------
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        return self.loop_thread.loop
+
+    def post(self, coro) -> None:
+        """The cross-shard mailbox: enqueue loop work from any thread
+        with batched wakeups (one self-pipe byte per burst)."""
+        self.loop_thread.post(coro)
+
+    def post_call(self, fn) -> None:
+        self.loop_thread.post(fn)
+
+    def call_soon(self, coro):
+        return self.loop_thread.call_soon(coro)
+
+    def run_sync(self, coro, timeout: Optional[float] = None):
+        return self.loop_thread.run_sync(coro, timeout)
+
+    def fire_and_forget(self, address: Address, method: str,
+                        _retries: int = 0, **kwargs):
+        """Best-effort call on THIS shard's loop/clients (the shard-local
+        analog of CoreWorker.fire_and_forget; same idempotency caveat on
+        _retries)."""
+        fire_and_forget(self.clients, self.post, address, method,
+                        _retries=_retries, **kwargs)
+
+    # -- observability ---------------------------------------------------
+
+    def queue_depth(self) -> int:
+        """Outstanding owned work on this shard: tasks pushed/awaiting
+        replies plus queued lease waiters plus undrained mailbox posts.
+        Racy len() snapshots — observability only, never control flow."""
+        depth = 0
+        sub = self.submitter
+        if sub is not None:
+            depth += len(sub._running)  # cross-shard ok: racy observability snapshot
+            waiters = sub._waiters  # cross-shard ok: racy observability snapshot
+            depth += sum(len(q) for q in list(waiters.values()))
+        asub = self.actor_submitter
+        if asub is not None:
+            depth += len(asub._awaiting)  # cross-shard ok: racy observability snapshot
+        if self.loop_thread is not None:
+            depth += self.loop_thread.pending_posts()
+        return depth
+
+
+class ShardSet:
+    """The N owner shards of one CoreWorker plus routing and teardown.
+
+    Construction is thread-free; ``start_main``/``start_extra`` bring the
+    loops/rings/servers up inside CoreWorker.start(), and ``stop()``
+    tears every extra loop down (the threads.py registry joins them as a
+    backstop at node teardown)."""
+
+    def __init__(self, count: int):
+        self.count = max(1, count)
+        self.shards: List[OwnerShard] = [OwnerShard(i)
+                                         for i in range(self.count)]
+        self._started = False
+        self._lag_lock = threading.Lock()
+        self._lag_s: Dict[int, float] = {}
+
+    def __iter__(self):
+        return iter(self.shards)
+
+    def __len__(self):
+        return self.count
+
+    @property
+    def main(self) -> OwnerShard:
+        return self.shards[0]
+
+    # -- routing ---------------------------------------------------------
+
+    def for_task(self, task_id) -> OwnerShard:
+        return self.shards[route_bytes(task_id.binary(), self.count)]
+
+    def for_actor(self, actor_id) -> OwnerShard:
+        return self.shards[route_bytes(actor_id.binary(), self.count)]
+
+    def for_spec(self, spec) -> OwnerShard:
+        from .task_spec import ACTOR_TASK
+        if spec.task_type == ACTOR_TASK:
+            return self.for_actor(spec.actor_id)
+        return self.for_task(spec.task_id)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start_main(self, main_loop_thread, server: RpcServer,
+                   clients: ClientPool, rpc_address: Address):
+        """Bind shard 0 to the process-main loop/server/pool (already
+        started by CoreWorker.start)."""
+        shard = self.shards[0]
+        shard.loop_thread = main_loop_thread
+        shard.server = server
+        shard.clients = clients
+        shard.rpc_address = rpc_address
+
+    def start_extra(self, name_prefix: str):
+        """Spawn loops + rings + reply servers for shards 1..N-1."""
+        if self._started or self.count == 1:
+            self._started = True
+            return
+        from .rpc import _native_io
+        native = _native_io() is not None
+        for shard in self.shards[1:]:
+            shard.loop_thread = IoLoopThread(
+                name=f"rtpu-owner-shard-{shard.index}", joinable=True)
+            if native:
+                from .._native.fastrpc import NativeIO
+                shard.ring = NativeIO.new_ring()
+                if shard.ring is None:
+                    logger.warning(
+                        "owner shard %d: no native ring available; "
+                        "falling back to the asyncio transport",
+                        shard.index)
+            # nio=False forces the asyncio transport when this shard has
+            # no ring of its own while the process ring exists — falling
+            # through to ring 0 would drain this shard's frames on the
+            # MAIN loop.
+            nio = shard.ring if shard.ring is not None \
+                else (False if native else None)
+            shard.server = RpcServer(
+                f"{name_prefix}-shard{shard.index}", nio=nio)
+            shard.clients = ClientPool(nio=nio,
+                                       loop_thread=shard.loop_thread)
+            shard.rpc_address = shard.run_sync(shard.server.start())
+        self._started = True
+
+    def stop(self, timeout_s: float = 5.0):
+        """Tear down extra shards: reply servers, cached clients, loops,
+        rings (recycled into the process pool for the next init)."""
+        for shard in self.shards[1:]:
+            if shard.loop_thread is None:
+                continue
+            if shard.server is not None:
+                try:
+                    shard.run_sync(shard.server.stop(), timeout=timeout_s)
+                except Exception:
+                    logger.debug("shard %d server stop failed",
+                                 shard.index, exc_info=True)
+            if shard.clients is not None:
+                try:
+                    shard.clients.close_all()
+                except Exception:
+                    logger.debug("shard %d client close failed",
+                                 shard.index, exc_info=True)
+            if shard.ring is not None:
+                try:
+                    shard.run_sync(_detach_ring(shard.ring, shard.loop),
+                                   timeout=2.0)
+                except Exception:
+                    logger.debug("shard %d ring detach failed",
+                                 shard.index, exc_info=True)
+            shard.loop_thread.join(timeout=timeout_s)
+            if shard.ring is not None:
+                from .._native.fastrpc import NativeIO
+                NativeIO.release_ring(shard.ring)
+                shard.ring = None
+
+    # -- observability ---------------------------------------------------
+
+    def refresh_gauges(self) -> Dict[int, int]:
+        """Update the per-shard gauges and kick async loop-lag probes
+        (sampled on demand — cli status / dashboard / memory report —
+        so an idle cluster pays nothing). Returns the sampled queue
+        depths so stats() reuses the same walk (and its rows agree
+        with the gauges within one sample)."""
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        pid = str(os.getpid())
+        depths: Dict[int, int] = {}
+        for shard in self.shards:
+            depths[shard.index] = depth = shard.queue_depth()
+            metrics.shard_queue_depth.set(
+                depth, tags={"pid": pid, "shard": shard.tag})
+            lag = self._lag_s.get(shard.index)
+            if lag is not None:
+                metrics.shard_loop_lag.set(
+                    lag, tags={"pid": pid, "shard": shard.tag})
+            if shard.loop_thread is None:
+                continue
+            t0 = time.monotonic()
+
+            def _probe(shard=shard, t0=t0):
+                dt = time.monotonic() - t0
+                with self._lag_lock:
+                    self._lag_s[shard.index] = dt
+                metrics.shard_loop_lag.set(
+                    dt, tags={"pid": pid, "shard": shard.tag})
+            try:
+                shard.loop.call_soon_threadsafe(_probe)
+            except RuntimeError:
+                logger.debug("lag probe on stopped shard loop skipped",
+                             exc_info=True)
+        return depths
+
+    def stats(self) -> List[Dict[str, object]]:
+        """Per-shard rows for cli status / the dashboard node view."""
+        depths = self.refresh_gauges()
+        rows = []
+        for shard in self.shards:
+            rows.append({
+                "shard": shard.index,
+                "queue_depth": depths.get(shard.index, 0),
+                "submits": shard.submit_count,
+                "loop_lag_s": self._lag_s.get(shard.index),
+                "rpc_address": list(shard.rpc_address)
+                if shard.rpc_address else None,
+                "native_ring": shard.ring._ring
+                if shard.ring is not None else (0 if shard.is_main
+                                                else None),
+            })
+        return rows
+
+
+async def _detach_ring(ring, loop):
+    ring.detach(loop)

@@ -1038,6 +1038,11 @@ class Raylet:
                 pass
             workers.append(entry)
         stats["workers"] = workers
+        # Owner-shard rows are NOT fanned out here: workers auto-resolve
+        # to 1 shard (the sharded fan-in side is the DRIVER, served by
+        # /api/shards -> state.shard_summary), and a per-poll RPC to
+        # every worker would tax node-stats for rows nobody renders.
+        # Per-worker stats stay one `get_shard_stats` call away.
         stats["num_leases"] = len(self.leases)
         stats["resources_total"] = self.resources.total.to_dict()
         stats["resources_available"] = self.resources.available.to_dict()

@@ -109,14 +109,17 @@ class FrameReader:
 
 
 # --------------------------------------------------------------------------
-# Event loop singleton (one io thread per process)
+# Event loop threads: the process-main singleton plus per-owner-shard
+# loops (same machinery, explicit lifetime)
 # --------------------------------------------------------------------------
 
-class EventLoopThread:
-    _instance: Optional["EventLoopThread"] = None
-    _lock = threading.Lock()
+class IoLoopThread:
+    """One asyncio loop on its own daemon thread with batched cross-
+    thread posting. The process-main io loop (`EventLoopThread`) and the
+    owner-shard loops are both instances; shard loops are joinable so
+    CoreWorker.shutdown / the threads registry can stop them."""
 
-    def __init__(self):
+    def __init__(self, name: str = "rtpu-io", joinable: bool = False):
         self.loop = asyncio.new_event_loop()
         # Eager tasks (3.12): a coroutine spawned via ensure_future runs
         # inline to its first true suspension — RPC handlers and actor
@@ -128,25 +131,22 @@ class EventLoopThread:
         self._post_q: collections.deque = collections.deque()
         self._post_lock = threading.Lock()
         self._post_scheduled = False
+        self._stopping = False
         self.thread = threading.Thread(
-            target=self._run, name="rtpu-io", daemon=True)
-        # Process-lifetime singleton: tracked for introspection, never
-        # joined (node teardown must not kill the shared io loop —
-        # api.shutdown() still needs it after Node.stop()).
+            target=self._run, name=name, daemon=True)
+        # Joinable loops (owner shards) register a stop hook so node
+        # teardown can signal and join them; the process-lifetime
+        # singleton is tracked for introspection only, never joined
+        # (api.shutdown() still needs it after Node.stop()).
         from .threads import register_daemon_thread
-        register_daemon_thread(self.thread, joinable=False)
+        register_daemon_thread(self.thread,
+                               stop=self.stop if joinable else None,
+                               joinable=joinable)
         self.thread.start()
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
         self.loop.run_forever()
-
-    @classmethod
-    def get(cls) -> "EventLoopThread":
-        with cls._lock:
-            if cls._instance is None or not cls._instance.thread.is_alive():
-                cls._instance = cls()
-            return cls._instance
 
     def run_sync(self, coro, timeout: Optional[float] = None):
         if threading.current_thread() is self.thread:
@@ -193,6 +193,56 @@ class EventLoopThread:
     def post_call(self, fn) -> None:
         """Like post() but for a plain callable run on the loop."""
         self.post(fn)
+
+    def pending_posts(self) -> int:
+        """Cross-thread posts not yet drained (shard queue-depth probe)."""
+        return len(self._post_q)
+
+    def stop(self) -> None:
+        """Signal the loop to exit run_forever (idempotent; the threads
+        registry joins the thread afterwards). Pending tasks (idle-lease
+        cleaners, probe/straggler sweepers) are cancelled first so they
+        unwind instead of being destroyed mid-await."""
+        if self._stopping:
+            return
+        self._stopping = True
+
+        def _shutdown():
+            for task in asyncio.all_tasks(self.loop):
+                task.cancel()
+            # Appended after the cancelled tasks' wakeups: they unwind
+            # their CancelledError before the loop exits run_forever.
+            self.loop.call_soon(self.loop.stop)
+        try:
+            self.loop.call_soon_threadsafe(_shutdown)
+        except RuntimeError:
+            logger.debug("loop already closed at stop()", exc_info=True)
+
+    def join(self, timeout: float = 2.0) -> None:
+        self.stop()
+        self.thread.join(timeout)
+        if not self.thread.is_alive():
+            try:
+                self.loop.close()
+            except Exception:
+                logger.debug("loop close after join failed", exc_info=True)
+
+
+class EventLoopThread(IoLoopThread):
+    """The process-main io loop singleton."""
+
+    _instance: Optional["EventLoopThread"] = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        super().__init__(name="rtpu-io", joinable=False)
+
+    @classmethod
+    def get(cls) -> "EventLoopThread":
+        with cls._lock:
+            if cls._instance is None or not cls._instance.thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
 
 
 def get_loop() -> asyncio.AbstractEventLoop:
@@ -387,7 +437,12 @@ class NativeCoalescer:
         self._buf.append(frame)
         if not self._scheduled:
             self._scheduled = True
-            asyncio.get_running_loop().call_soon(self._flush)
+            try:
+                asyncio.get_running_loop().call_soon(self._flush)
+            except RuntimeError:
+                # loop already stopped (shard teardown racing a late
+                # reply): send inline instead of dropping the frame
+                self._flush()
         return True
 
     def _flush(self):
@@ -407,13 +462,59 @@ _local_servers: Dict[Address, "RpcServer"] = {}
 _local_servers_lock = threading.Lock()
 
 
+def _local_owner_loop(server: "RpcServer"):
+    """The loop an in-process dispatch must run on, or None when the
+    caller's running loop already owns the server (the common case: one
+    loop per process, zero-hop dispatch)."""
+    owner = server.loop
+    if owner is None:
+        return None
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    return None if owner is running else owner
+
+
+def _log_oneway_failure(cfut, method: str) -> None:
+    """Cross-loop oneway dispatch returns a concurrent Future nobody
+    awaits; without this hook a failing handler's exception would be
+    GC'd unobserved (the same-loop ensure_future path at least gets the
+    loop's 'Task exception was never retrieved' log)."""
+    def _done(f):
+        exc = f.exception()
+        if exc is not None:
+            logger.warning("oneway %s handler failed on owner loop: %r",
+                           method, exc)
+    cfut.add_done_callback(_done)
+
+
+async def _await_on_owner_loop(owner_loop, coro,
+                               timeout: Optional[float]):
+    """In-process call crossing loops (an owner shard calling the main-
+    loop raylet/GCS): run the handler on its owner loop, await the
+    result from the caller's loop. This is the shard<->main mailbox for
+    local dispatch — without it, the zero-serialization fast path would
+    execute single-loop server state on the wrong thread."""
+    cfut = asyncio.run_coroutine_threadsafe(coro, owner_loop)
+    return await asyncio.wait_for(asyncio.wrap_future(cfut), timeout)
+
+
 class RpcServer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, nio=None):
         self.name = name
         self._handlers: Dict[str, Handler] = {}
         self._raw_handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.address: Optional[Address] = None
+        # Owner loop, recorded at start(): handlers and connection state
+        # live here. The in-process fast path hops to this loop when the
+        # caller runs on a different one (owner shards) — dispatching a
+        # handler on a foreign loop would interleave two loops through
+        # state that is single-loop by design.
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        # Explicit ring override (owner shards); None = the process ring.
+        self._nio_pref = nio
         self._native = None            # NativeIO when serving natively
         self._native_listener: Optional[int] = None
         self._native_conns: set = set()
@@ -433,7 +534,14 @@ class RpcServer:
                 self.register(prefix + attr[len("handle_"):], getattr(obj, attr))
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
-        nio = _native_io()
+        self.loop = asyncio.get_running_loop()
+        # nio=False forces the asyncio transport: a shard whose ring
+        # allocation failed must NOT fall through to the process ring —
+        # ring 0 drains on the MAIN loop, which would run this server's
+        # handlers off its owner loop.
+        nio = self._nio_pref if self._nio_pref is not None else _native_io()
+        if nio is False:
+            nio = None
         if nio is not None:
             nio.attach(asyncio.get_running_loop())
             res = nio.listen(host, port, self._native_accept)
@@ -569,10 +677,16 @@ class RpcServer:
 # --------------------------------------------------------------------------
 
 class RpcClient:
-    """Client to one remote server; persistent connection, multiplexed ids."""
+    """Client to one remote server; persistent connection, multiplexed ids.
 
-    def __init__(self, address: Address):
+    Loop-affine: the connection, pending-reply futures, and (optionally)
+    the native ring all live on the loop that first uses the client —
+    owner shards therefore keep their OWN ClientPool rather than sharing
+    the process pool across loops."""
+
+    def __init__(self, address: Address, nio=None):
         self.address = (address[0], int(address[1]))
+        self._nio_pref = nio          # explicit ring (owner shards)
         self._writer: Optional[asyncio.StreamWriter] = None
         self._cw: Optional[CoalescingWriter] = None
         self._native = None           # NativeIO when connected natively
@@ -598,7 +712,10 @@ class RpcClient:
         async with self._conn_lock:
             if self._connected():
                 return
-            nio = _native_io()
+            nio = self._nio_pref if self._nio_pref is not None \
+                else _native_io()
+            if nio is False:
+                nio = None  # forced asyncio transport (see RpcServer.start)
             if nio is not None:
                 loop = asyncio.get_running_loop()
                 nio.attach(loop)
@@ -708,9 +825,16 @@ class RpcClient:
                          timeout: float) -> Any:
         local = self._local()
         if local is not None:
-            # In-process fast path — no sockets, no serialization.
+            # In-process fast path — no sockets, no serialization. A
+            # caller on a foreign loop (owner shard -> main-loop raylet/
+            # GCS) hops to the server's owner loop instead of running
+            # its handler here.
             if CHAOS.drop_request(method) or CHAOS.drop_response(method):
                 raise asyncio.TimeoutError()
+            owner = _local_owner_loop(local)
+            if owner is not None:
+                return await _await_on_owner_loop(
+                    owner, local._dispatch(method, payload), timeout)
             return await asyncio.wait_for(
                 local._dispatch(method, payload), timeout)
         return await self._call_frame(
@@ -745,7 +869,14 @@ class RpcClient:
         local = self._local()
         if local is not None:
             if not CHAOS.drop_request(method):
-                asyncio.ensure_future(local._dispatch(method, kwargs))
+                owner = _local_owner_loop(local)
+                if owner is not None:
+                    _log_oneway_failure(
+                        asyncio.run_coroutine_threadsafe(
+                            local._dispatch(method, kwargs), owner),
+                        method)
+                else:
+                    asyncio.ensure_future(local._dispatch(method, kwargs))
             return
         await self._ensure_conn()
         await self._send_frame(pack_frame(
@@ -766,6 +897,10 @@ class RpcClient:
             handler = local._raw_handlers.get(method)
             if handler is None:
                 raise RpcError(f"no raw handler for {method!r}")
+            owner = _local_owner_loop(local)
+            if owner is not None:
+                return await _await_on_owner_loop(
+                    owner, handler(payload), timeout)
             return await asyncio.wait_for(handler(payload), timeout)
         return await self._call_frame(FLAG_RAW, method, payload, timeout)
 
@@ -779,7 +914,14 @@ class RpcClient:
                 handler = local._raw_handlers.get(method)
                 if handler is None:
                     raise RpcError(f"no raw handler for {method!r}")
-                asyncio.ensure_future(handler(payload))
+                owner = _local_owner_loop(local)
+                if owner is not None:
+                    _log_oneway_failure(
+                        asyncio.run_coroutine_threadsafe(handler(payload),
+                                                         owner),
+                        method)
+                else:
+                    asyncio.ensure_future(handler(payload))
             return
         await self._ensure_conn()
         await self._send_frame(pack_frame(0, FLAG_RAW, method.encode(),
@@ -809,18 +951,22 @@ class RpcClient:
 
 
 class ClientPool:
-    """Cache of RpcClients keyed by address (reference: per-service pools)."""
+    """Cache of RpcClients keyed by address (reference: per-service
+    pools). One pool per loop: owner shards construct their own with
+    their ring so every cached client stays loop-affine."""
 
-    def __init__(self):
+    def __init__(self, nio=None, loop_thread: Optional[IoLoopThread] = None):
         self._clients: Dict[Address, RpcClient] = {}
         self._lock = threading.Lock()
+        self._nio = nio
+        self._loop_thread = loop_thread
 
     def get(self, address: Address) -> RpcClient:
         address = (address[0], int(address[1]))
         with self._lock:
             client = self._clients.get(address)
             if client is None:
-                client = RpcClient(address)
+                client = RpcClient(address, nio=self._nio)
                 self._clients[address] = client
             return client
 
@@ -828,4 +974,17 @@ class ClientPool:
         with self._lock:
             client = self._clients.pop(tuple(address), None)
         if client is not None:
-            EventLoopThread.get().call_soon(client.close())
+            (self._loop_thread or EventLoopThread.get()).call_soon(
+                client.close())
+
+    def close_all(self):
+        """Close every cached client on the pool's loop (shard teardown)."""
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        lt = self._loop_thread or EventLoopThread.get()
+        for client in clients:
+            try:
+                lt.call_soon(client.close())
+            except RuntimeError:
+                logger.debug("client close after loop stop skipped",
+                             exc_info=True)

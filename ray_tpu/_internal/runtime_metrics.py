@@ -112,6 +112,29 @@ def _build() -> SimpleNamespace:
             "rtpu_worker_owned_refs",
             "Entries in this process's reference table",
             tag_keys=("pid",)),
+        # -- owner shards (the multi-loop driver core): imbalance across
+        # shards shows up here — cli status / the dashboard node view
+        # render these rows --
+        shard_queue_depth=Gauge(
+            "rtpu_owner_shard_queue_depth",
+            "Outstanding owned work on one owner shard "
+            "(pushed tasks awaiting replies + lease waiters "
+            "+ undrained mailbox posts)",
+            tag_keys=("pid", "shard")),
+        shard_loop_lag=Gauge(
+            "rtpu_owner_shard_loop_lag_seconds",
+            "call_soon_threadsafe-to-run latency of one owner "
+            "shard's io loop (probed on demand)",
+            tag_keys=("pid", "shard")),
+        shard_submit=Histogram(
+            "rtpu_owner_shard_submit_seconds",
+            "Driver-side submit_task cost per owner shard "
+            "(refcount + pending bookkeeping + routing; 1/64 "
+            "sampled, recorded only when >1 shard exists)",
+            boundaries=[0.000001, 0.000005, 0.00001, 0.000025,
+                        0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                        0.0025, 0.005, 0.01],
+            tag_keys=("shard",)),
         # -- continuous profiler meta-metrics (the profiler profiles
         # itself: sample volume, ring overflow, per-pass overhead) --
         profiler_samples=Counter(

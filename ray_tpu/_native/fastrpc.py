@@ -1,11 +1,15 @@
 """ctypes binding for the native RPC I/O core (src/fastrpc.cpp).
 
-One NativeIO per process: owns the C epoll thread, routes received frames
-to the RpcServer / RpcClient that own each connection, and wakes the
-asyncio loop once per *batch* of messages via the core's notify eventfd
-(reference role: src/ray/rpc/ — gRPC's completion-queue threads).
+One C epoll thread per process serves N inbound "rings": independent
+event queues, each with its own notify eventfd. `NativeIO.get()` is the
+legacy ring-0 singleton (the process-main io loop); `NativeIO.new_ring()`
+hands an owner shard its own ring so its asyncio loop wakes only for its
+own connections' frames (reference role: src/ray/rpc/ — gRPC's
+completion-queue-per-thread layout). Connections are bound to a ring at
+listen/connect time; accepted conns inherit the listener's ring.
 
-All routing callbacks run on the asyncio event loop thread.
+All routing callbacks run on the asyncio event loop attached to the
+owning ring.
 """
 
 from __future__ import annotations
@@ -15,7 +19,7 @@ import logging
 import os
 import struct
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _U64 = struct.Struct("<Q")
 
@@ -37,35 +41,53 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     lib = ctypes.CDLL(path)
     lib.frpc_start.restype = ctypes.c_int
-    lib.frpc_listen.restype = ctypes.c_int64
-    lib.frpc_listen.argtypes = [ctypes.c_char_p,
-                                ctypes.POINTER(ctypes.c_int)]
-    lib.frpc_connect.restype = ctypes.c_int64
-    lib.frpc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.frpc_ring_create.restype = ctypes.c_int
+    lib.frpc_ring_fd.restype = ctypes.c_int
+    lib.frpc_ring_fd.argtypes = [ctypes.c_int]
+    lib.frpc_listen2.restype = ctypes.c_int64
+    lib.frpc_listen2.argtypes = [ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_int),
+                                 ctypes.c_int]
+    lib.frpc_connect2.restype = ctypes.c_int64
+    lib.frpc_connect2.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int]
     lib.frpc_send.restype = ctypes.c_int
     lib.frpc_send.argtypes = [ctypes.c_int64, ctypes.c_char_p,
                               ctypes.c_uint64]
     lib.frpc_out_bytes.restype = ctypes.c_uint64
     lib.frpc_out_bytes.argtypes = [ctypes.c_int64]
-    lib.frpc_recv.restype = ctypes.c_int64
-    lib.frpc_recv.argtypes = [
+    lib.frpc_recv2.restype = ctypes.c_int64
+    lib.frpc_recv2.argtypes = [
+        ctypes.c_int,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
         ctypes.c_char_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int64]
-    lib.frpc_next_len.restype = ctypes.c_uint64
+    lib.frpc_next_len2.restype = ctypes.c_uint64
+    lib.frpc_next_len2.argtypes = [ctypes.c_int]
     lib.frpc_close.argtypes = [ctypes.c_int64]
     return lib
 
 
 class NativeIO:
-    """Process singleton wrapping the native core + asyncio integration."""
+    """One inbound ring of the native core + its asyncio integration.
+
+    Ring 0 is the process singleton (``get()``); additional rings are
+    created (and pooled across init/shutdown cycles) via ``new_ring()``.
+    ``send``/``out_bytes``/``close`` address conns by their global id and
+    work on any instance.
+    """
 
     _instance: Optional["NativeIO"] = None
     _lock = threading.Lock()
+    # Rings released by a torn-down shard set, reused by the next init —
+    # ring fds are a process-lifetime resource in the C core (capped at
+    # 64), so repeated init/shutdown cycles must recycle them.
+    _ring_pool: List["NativeIO"] = []
 
-    def __init__(self, lib: ctypes.CDLL, notify_fd: int):
+    def __init__(self, lib: ctypes.CDLL, notify_fd: int, ring: int = 0):
         self._lib = lib
+        self._ring = ring
         self._notify_fd = notify_fd
         self._attached_loop = None
         # conn_id -> callable(kind, memoryview-body)
@@ -85,22 +107,59 @@ class NativeIO:
     @classmethod
     def get(cls) -> Optional["NativeIO"]:
         with cls._lock:
-            if cls._instance is None:
-                if os.environ.get("RTPU_DISABLE_NATIVE_RPC"):
-                    return None
-                lib = _load()
-                if lib is None:
-                    return None
-                fd = lib.frpc_start()
-                if fd < 0:
-                    return None
-                cls._instance = cls(lib, fd)
-            return cls._instance
+            return cls._get_locked()
+
+    @classmethod
+    def _get_locked(cls) -> Optional["NativeIO"]:
+        if cls._instance is None:
+            if os.environ.get("RTPU_DISABLE_NATIVE_RPC"):
+                return None
+            lib = _load()
+            if lib is None:
+                return None
+            fd = lib.frpc_start()
+            if fd < 0:
+                return None
+            cls._instance = cls(lib, fd)
+        return cls._instance
+
+    @classmethod
+    def new_ring(cls) -> Optional["NativeIO"]:
+        """A fresh (or recycled) ring for an owner shard, or None when
+        the native core is unavailable / the ring table is full —
+        callers fall back to the asyncio transport or ring 0."""
+        with cls._lock:
+            base = cls._get_locked()
+            if base is None:
+                return None
+            if cls._ring_pool:
+                return cls._ring_pool.pop()
+            ring = base._lib.frpc_ring_create()
+            if ring < 0:
+                return None
+            fd = base._lib.frpc_ring_fd(ring)
+            if fd < 0:
+                return None
+            return cls(base._lib, fd, ring=ring)
+
+    @classmethod
+    def release_ring(cls, ring: "NativeIO"):
+        """Return a shard's ring to the pool at shard-set teardown. The
+        caller has already closed the ring's conns/listeners; routing
+        state is cleared so the next user starts clean."""
+        if ring is None or ring._ring == 0:
+            return
+        ring._sinks.clear()
+        ring._listeners.clear()
+        ring._orphans.clear()
+        with cls._lock:
+            cls._ring_pool.append(ring)
 
     # -- loop integration ------------------------------------------------
 
     def attach(self, loop):
-        """Watch the notify eventfd on `loop`; must run on the loop.
+        """Watch this ring's notify eventfd on `loop`; must run on the
+        loop.
 
         First-wins: once attached to a live loop, later attach attempts
         from OTHER loops are ignored — moving the reader would strand
@@ -125,14 +184,26 @@ class NativeIO:
         self._attached_loop = loop
         loop.add_reader(self._notify_fd, self._drain)
 
+    def detach(self, loop):
+        """Stop watching the notify fd on `loop` (shard teardown; the
+        ring is then recycled via release_ring)."""
+        if self._attached_loop is not loop:
+            return
+        try:
+            loop.remove_reader(self._notify_fd)
+        except Exception:
+            logger.debug("remove_reader during ring detach failed",
+                         exc_info=True)
+        self._attached_loop = None
+
     def _drain(self):
         lib = self._lib
         while True:
-            n = lib.frpc_recv(self._conn_ids, self._kinds, self._buf,
-                              len(self._buf), self._offsets, self._lengths,
-                              _RECV_CAP)
+            n = lib.frpc_recv2(self._ring, self._conn_ids, self._kinds,
+                               self._buf, len(self._buf), self._offsets,
+                               self._lengths, _RECV_CAP)
             if n == 0:
-                need = lib.frpc_next_len()
+                need = lib.frpc_next_len2(self._ring)
                 if need > len(self._buf):
                     self._buf = ctypes.create_string_buffer(
                         int(need) + (1 << 20))
@@ -146,7 +217,7 @@ class NativeIO:
                 self._dispatch(conn, kind, body)
             if n < _RECV_CAP:
                 # queue drained (or next frame needs a larger buffer)
-                if lib.frpc_next_len() == 0:
+                if lib.frpc_next_len2(self._ring) == 0:
                     return
 
     def _dispatch(self, conn: int, kind: int, body):
@@ -184,14 +255,16 @@ class NativeIO:
             self._dispatch(c, kind, body)
 
     # -- operations ------------------------------------------------------
-    # listen/register run on the event loop (same thread as _drain), so
-    # the orphan-buffer check-then-act sequences cannot interleave.
+    # listen/register run on the ring's event loop (same thread as
+    # _drain), so the orphan-buffer check-then-act sequences cannot
+    # interleave.
 
     def listen(self, host: str, port: int,
                accept_factory: Callable[[int], Callable]
                ) -> Optional[Tuple[int, int]]:
         p = ctypes.c_int(port)
-        lid = self._lib.frpc_listen(host.encode(), ctypes.byref(p))
+        lid = self._lib.frpc_listen2(host.encode(), ctypes.byref(p),
+                                     self._ring)
         if lid < 0:
             return None
         self._listeners[lid] = accept_factory
@@ -206,7 +279,8 @@ class NativeIO:
         or raises TimeoutError on a connect timeout — the distinction
         matters for liveness decisions (refused proves the process is
         gone; a timeout proves nothing)."""
-        conn = self._lib.frpc_connect(host.encode(), port, timeout_ms)
+        conn = self._lib.frpc_connect2(host.encode(), port, timeout_ms,
+                                       self._ring)
         if conn == -2:
             raise TimeoutError(f"connect to {host}:{port} timed out")
         return None if conn < 0 else conn

@@ -189,6 +189,18 @@ def cmd_status(args):
         for k, v in node["resources_available"].items():
             avail[k] = avail.get(k, 0) + v
     print(f"resources: {avail} available of {total}")
+    # Owner shards of THIS driver (the submit fan-in side): queue depth
+    # and loop lag per shard make imbalance visible from the terminal.
+    cw = get_core_worker()
+    if len(cw.shards) > 1:
+        print(f"owner shards (driver pid {os.getpid()}): "
+              f"{len(cw.shards)}")
+        for row in cw.shards.stats():
+            lag = row["loop_lag_s"]
+            lag_txt = f"{lag * 1000:.2f}ms" if lag is not None else "-"
+            print(f"  shard {row['shard']}: queue_depth="
+                  f"{row['queue_depth']} submits={row['submits']} "
+                  f"loop_lag={lag_txt}")
     # Per-shape pending demand with a feasibility check, so "why is my
     # task pending" is answerable from here: a shape no amount of
     # waiting can satisfy is flagged INFEASIBLE. A shape must fit on

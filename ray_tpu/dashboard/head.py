@@ -182,6 +182,10 @@ class DashboardHead:
             return self._json(st.list_objects())
         if path == "/api/workers":
             return self._json(st.list_workers())
+        if path == "/api/shards":
+            # owner-shard rows per fan-in process (drivers + self):
+            # queue depth / submits / loop lag per shard
+            return self._json(st.shard_summary())
         if path == "/api/timeline":
             since = query.get("since")
             return self._json(st.timeline(

@@ -67,19 +67,59 @@ os._exit(0)
 """
 
 
+def _await_full_cpus(timeout_s: float = 60.0, stable_samples: int = 5):
+    """Wait out lease reclamation. Dead benchmark drivers (the
+    multi-client clients os._exit) hold their leases until the GCS
+    driver-liveness sweep reclaims them (~10 s); starting the next
+    bench before that measures reclamation latency — or, on a
+    cold/starved cluster, hangs the client warmup outright. The calling
+    driver's own live actors hold CPUs too, so "free == total" may be
+    unreachable — exit when either every CPU is free OR the free count
+    has STOPPED RISING for `stable_samples` seconds (reclamation
+    finished; what's still held is held by live owners)."""
+    from ray_tpu.util.state.api import list_nodes
+    deadline = time.monotonic() + timeout_s
+    last_free, stable = -1.0, 0
+    while time.monotonic() < deadline:
+        nodes = list_nodes()
+        free = sum(n_["resources_available"].get("CPU", 0)
+                   for n_ in nodes)
+        total = sum(n_["resources_total"].get("CPU", 0) for n_ in nodes)
+        if free >= total:
+            return
+        if free > last_free:
+            last_free, stable = free, 0
+        else:
+            stable += 1
+            if stable >= stable_samples:
+                return
+        time.sleep(1.0)
+
+
 def multi_client_bench(n_clients: int = 4, n_per: int = 1000,
-                       results: Optional[Dict[str, float]] = None):
+                       results: Optional[Dict[str, float]] = None,
+                       metric: str = "tasks_async_multi_client_per_s"):
     """Aggregate async task throughput from N separate DRIVER PROCESSES
     against one cluster (reference: ray_perf.py 'tasks async (multi
     client)'; baseline 19,295/s). Assumes a cluster is already up in this
-    process (main() calls it after the single-client suite)."""
+    process (main() calls it after the single-client suite).
+
+    Always takes the round-5 cold-cluster-safe path: (1) wait for all
+    leased CPUs to come back before spawning clients — a previous
+    bench's dead drivers must not starve this run's warmup (the r4
+    cold-cluster hang, re-trippable by any harness that runs this bench
+    more than once, e.g. the --shards A/B); (2) each client warms a
+    worker lease and checks in via a ready-file barrier before the
+    timed flood."""
     import glob
     import os
     import subprocess
     import sys
     import tempfile
 
+    from ray_tpu._internal.config import CONFIG
     from ray_tpu._internal.core_worker import get_core_worker
+    _await_full_cpus()
     host, port = get_core_worker().gcs.address
     addr = f"{host}:{port}"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -87,7 +127,10 @@ def multi_client_bench(n_clients: int = 4, n_per: int = 1000,
     script = os.path.join(workdir, "client.py")
     with open(script, "w") as f:
         f.write(_CLIENT_SCRIPT.format(repo=repo, addr=addr))
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    # Clients are their own drivers: the A/B arm under test must reach
+    # them (apply_system_config doesn't cross process boundaries).
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               RTPU_OWNER_SHARDS=str(CONFIG.owner_shards))
     procs = []
     outs = []
     for i in range(n_clients):
@@ -122,8 +165,8 @@ def multi_client_bench(n_clients: int = 4, n_per: int = 1000,
     wall = max(s["t1"] for s in spans) - min(s["t0"] for s in spans)
     rate = total / wall
     if results is not None:
-        results["tasks_async_multi_client_per_s"] = rate
-    _report("tasks_async_multi_client_per_s", rate, "tasks/s")
+        results[metric] = rate
+    _report(metric, rate, "tasks/s")
     return rate
 
 
@@ -391,16 +434,7 @@ def main(quick: bool = False) -> Dict[str, float]:
     # GCS driver-liveness sweep reclaims (~10 s). Wait for the CPUs to
     # come back so the PG bench measures PG throughput, not
     # dead-driver reclamation latency.
-    from ray_tpu.util.state.api import list_nodes
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline:
-        nodes = list_nodes()
-        free = sum(n_["resources_available"].get("CPU", 0)
-                   for n_ in nodes)
-        total = sum(n_["resources_total"].get("CPU", 0) for n_ in nodes)
-        if free >= total:
-            break
-        time.sleep(1.0)
+    _await_full_cpus()
 
     from ray_tpu.util.placement_group import (placement_group,
                                               remove_placement_group)
@@ -416,6 +450,75 @@ def main(quick: bool = False) -> Dict[str, float]:
             "pgs/s")
 
     ray_tpu.shutdown()
+    return results
+
+
+def shards_bench(shard_counts=(1, 2, 4), quick: bool = False
+                 ) -> Dict[str, float]:
+    """Owner-shard A/B: the two workloads the sharded core targets —
+    n:n async actor calls (4 async actors x 4 submitting threads) and
+    the multi-client flood (4 separate driver processes) — at each
+    shard count, one fresh cluster per arm. ``shards=1`` is the
+    exact-legacy single-loop path; the deltas between arms are the
+    sharding effect with everything else held constant (same box, same
+    run). Feeds the PERF.md round-10 table."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu._internal.config import CONFIG
+
+    scale = 1 if quick else 4
+    results: Dict[str, float] = {}
+    for count in shard_counts:
+        CONFIG.apply_system_config({"owner_shards": int(count)})
+        ray_tpu.init(num_cpus=8, object_store_memory=2 * 1024**3)
+        try:
+            from ray_tpu._internal.core_worker import get_core_worker
+            got = len(get_core_worker().shards)
+            if got != count:
+                raise RuntimeError(
+                    f"arm shards={count}: driver came up with {got}")
+
+            @ray_tpu.remote
+            class Sink:
+                async def aping(self):
+                    return None
+
+            actors = [Sink.options(max_concurrency=16).remote()
+                      for _ in range(4)]
+            ray_tpu.get([a.aping.remote() for a in actors
+                         for _ in range(50)])
+            n_per = 500 * scale
+
+            def _pound(a):
+                ray_tpu.get([a.aping.remote() for _ in range(n_per)])
+
+            def _nn():
+                threads = [threading.Thread(target=_pound, args=(a,))
+                           for a in actors]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            metric = f"actor_calls_async_nn_per_s_shards{count}"
+            results[metric] = _rate(4 * n_per, _nn)
+            _report(metric, results[metric], "calls/s")
+            per_shard = [(row["shard"], row["submits"])
+                         for row in get_core_worker().shards.stats()]
+            print(json.dumps({"metric": f"shard_submits_shards{count}",
+                              "per_shard": per_shard}), flush=True)
+            try:
+                multi_client_bench(
+                    n_clients=2 if quick else 4, n_per=500 * scale,
+                    results=results,
+                    metric=f"tasks_async_multi_client_per_s_shards{count}")
+            except Exception as e:  # noqa: BLE001 — keep the other arms
+                print(json.dumps({
+                    "metric":
+                        f"tasks_async_multi_client_per_s_shards{count}",
+                    "error": str(e)}), flush=True)
+        finally:
+            ray_tpu.shutdown()
     return results
 
 
@@ -489,6 +592,10 @@ if __name__ == "__main__":
     parser.add_argument("--sampler", action="store_true",
                         help="stack-sampler overhead microbench only "
                              "(no cluster)")
+    parser.add_argument("--shards", nargs="?", const="1,2,4",
+                        default=None, metavar="N,N,...",
+                        help="owner-shard A/B: n:n + multi-client at "
+                             "each shard count (default 1,2,4)")
     parser.add_argument("--world", type=int, default=8)
     parser.add_argument("--mb", type=int, default=64)
     args = parser.parse_args()
@@ -500,5 +607,8 @@ if __name__ == "__main__":
         callsite_bench()
     elif args.sampler:
         sampler_bench()
+    elif args.shards:
+        shards_bench(tuple(int(x) for x in args.shards.split(",")),
+                     quick=args.quick)
     else:
         main(quick=args.quick)

@@ -5,13 +5,13 @@ from .api import (get_actor, get_node, get_trace, list_actors, list_events,
                   list_jobs, list_nodes, list_object_refs, list_objects,
                   list_placement_groups, list_tasks, list_traces,
                   list_workers, memory_summary, profile_cluster,
-                  profiling_status, stack_cluster, summarize_tasks,
-                  timeline)
+                  profiling_status, shard_summary, stack_cluster,
+                  summarize_tasks, timeline)
 
 __all__ = [
     "get_actor", "get_node", "get_trace", "list_actors", "list_events",
     "list_jobs", "list_nodes", "list_object_refs", "list_objects",
     "list_placement_groups", "list_tasks", "list_traces", "list_workers",
     "memory_summary", "profile_cluster", "profiling_status",
-    "stack_cluster", "summarize_tasks", "timeline",
+    "shard_summary", "stack_cluster", "summarize_tasks", "timeline",
 ]

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 
@@ -116,6 +117,59 @@ def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
 
 def list_jobs(limit: int = 1000) -> List[Dict[str, Any]]:
     return _gcs().call_sync("get_all_jobs")[:limit]
+
+
+def shard_summary() -> List[Dict[str, Any]]:
+    """Owner-shard stats across the cluster's fan-in processes: every
+    RUNNING job's driver (where shards>1 lives — the submit side) plus
+    this process's own shards. One row per (process, shard) with queue
+    depth, submit count, and loop lag, so shard imbalance is visible
+    from the dashboard and `cli status`."""
+    from ..._internal.core_worker import get_core_worker
+    cw = get_core_worker()
+    rows: List[Dict[str, Any]] = []
+
+    def _rows(report, node_id=None):
+        if not report:
+            return
+        for shard in report.get("shards", ()):
+            rows.append({
+                "pid": report.get("pid"), "mode": report.get("mode"),
+                "worker_id": report.get("worker_id"),
+                "num_shards": report.get("num_shards"),
+                "node_id": node_id, **shard})
+
+    local_addr = tuple(cw.rpc_address) if cw.rpc_address else None
+    seen = set()
+    drivers = [rec for rec in _gcs().call_sync("get_all_jobs")
+               if rec.get("state") == "RUNNING"
+               and rec.get("driver_address")]
+
+    def _stats(rec):
+        # Tight timeout: the dashboard Nodes tab blocks on this sweep,
+        # and a kill -9'd driver stays RUNNING until the liveness sweep
+        # notices — don't stall the UI 10 s per dead driver.
+        return cw.clients.get(tuple(rec["driver_address"])).call_sync(
+            "get_shard_stats", timeout=2)
+
+    for rec, report, error in _fanout(drivers, _stats):
+        addr = tuple(rec["driver_address"])
+        if addr in seen:
+            continue
+        seen.add(addr)
+        if error is not None:
+            rows.append({"pid": None, "mode": "driver",
+                         "error": error,
+                         "job_id": rec.get("job_id")})
+        else:
+            _rows(report)
+    if local_addr is not None and local_addr not in seen:
+        _rows({"pid": os.getpid(), "mode": cw.mode,
+               "worker_id": cw.worker_id.hex()
+               if isinstance(cw.worker_id, bytes) else str(cw.worker_id),
+               "num_shards": len(cw.shards),
+               "shards": cw.shards.stats()})
+    return rows
 
 
 def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:

@@ -18,6 +18,17 @@
 //   frpc_out_bytes(conn)    -> queued-unsent bytes (backpressure probe)
 //   frpc_close(conn)
 //
+// Rings (the owner-shard plane): one epoll/io thread serves N independent
+// inbound event queues ("rings"), each with its OWN notify eventfd so each
+// shard's asyncio loop drains only its own connections' frames. A conn is
+// bound to a ring at listen/connect time; accepted conns inherit the
+// listener's ring. Ring 0 is created by frpc_start and backs the legacy
+// single-queue ABI unchanged:
+//   frpc_ring_create()      -> new ring index (or -1)
+//   frpc_ring_fd(ring)      -> that ring's notify eventfd
+//   frpc_listen2/connect2   -> ring-bound variants
+//   frpc_recv2/next_len2    -> drain one specific ring
+//
 // Wire format (shared with the pure-Python asyncio fallback in rpc.py):
 //   u32le total_len, then `total_len` bytes of frame body. The body's
 //   layout (msg id, flags, method, payload) is parsed in Python. The
@@ -59,10 +70,12 @@ namespace {
 constexpr size_t kReadChunk = 256 * 1024;
 constexpr size_t kMaxIov = 64;
 constexpr size_t kInHighWater = 256ULL * 1024 * 1024;
+constexpr int kMaxRings = 64;
 
 struct Conn {
   int fd = -1;
   int64_t id = 0;
+  int ring = 0;  // inbound queue this conn's events are delivered to
   bool listener = false;
   int64_t accepted_by = 0;  // listener id for accepted conns
   // write side (producer: any python thread; consumer: epoll thread)
@@ -92,10 +105,22 @@ struct InEvent {
   std::string data;
 };
 
+// One inbound event queue + notify eventfd. Ring 0 is the legacy queue;
+// owner shards create one ring each so their loops wake independently.
+struct Ring {
+  std::mutex mu;
+  std::deque<InEvent> q;
+  size_t bytes = 0;
+  bool notified = false;
+  int notifyfd = -1;
+  std::atomic<bool> any_parked{false};  // conns of THIS ring parked
+  std::atomic<bool> resume{false};      // python drained below low-water
+};
+
 struct Core {
   int epfd = -1;
   int wakefd = -1;    // wake epoll thread (sends pending / close requests)
-  int notifyfd = -1;  // wake python (events pending)
+  int notifyfd = -1;  // ring 0's notify fd (legacy ABI)
   std::thread thread;
   std::mutex mu;  // conns map + pending registration lists
   std::unordered_map<int64_t, Conn*> conns;
@@ -106,13 +131,11 @@ struct Core {
   std::mutex dirty_mu;
   std::vector<int64_t> dirty;  // conns with newly queued output
   std::atomic<int64_t> next_id{1};
-  // inbound event queue
-  std::mutex in_mu;
-  std::deque<InEvent> inq;
-  size_t inq_bytes = 0;
-  bool notified = false;
-  std::atomic<bool> any_parked{false};  // some conns have EPOLLIN parked
-  std::atomic<bool> resume{false};      // python drained below low-water
+  // Inbound rings. Slots are written once (under g_start_mu) before
+  // n_rings is bumped; readers index only below n_rings, so no lock is
+  // needed on the hot paths.
+  Ring* rings[kMaxRings] = {nullptr};
+  std::atomic<int> n_rings{0};
   // Closed conns still pinned by an in-flight frpc_send; io thread only.
   // Reaped (deleted) once pins drain — the close path never spins.
   std::vector<Conn*> reap;
@@ -131,21 +154,23 @@ void set_nodelay(int fd) {
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
-void notify_python(Core* c) {
-  // caller holds in_mu
-  if (!c->notified) {
-    c->notified = true;
+void notify_python(Ring* r) {
+  // caller holds r->mu
+  if (!r->notified) {
+    r->notified = true;
     uint64_t one = 1;
-    ssize_t r = write(c->notifyfd, &one, sizeof(one));
-    (void)r;
+    ssize_t w = write(r->notifyfd, &one, sizeof(one));
+    (void)w;
   }
 }
 
-void push_event(Core* c, int64_t conn, uint8_t kind, std::string data) {
-  std::lock_guard<std::mutex> lk(c->in_mu);
-  c->inq_bytes += data.size();
-  c->inq.push_back(InEvent{conn, kind, std::move(data)});
-  notify_python(c);
+void push_event(Core* c, int ring, int64_t conn, uint8_t kind,
+                std::string data) {
+  Ring* r = c->rings[ring];
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->bytes += data.size();
+  r->q.push_back(InEvent{conn, kind, std::move(data)});
+  notify_python(r);
 }
 
 void epoll_mod(Core* c, Conn* conn) {
@@ -162,7 +187,7 @@ void close_conn(Core* c, Conn* conn, bool deliver_event) {
   epoll_ctl(c->epfd, EPOLL_CTL_DEL, conn->fd, nullptr);
   close(conn->fd);
   if (deliver_event && !conn->listener)
-    push_event(c, conn->id, 2, std::string());
+    push_event(c, conn->ring, conn->id, 2, std::string());
   {
     std::lock_guard<std::mutex> lk(c->mu);
     c->conns.erase(conn->id);
@@ -187,6 +212,7 @@ void handle_accept(Core* c, Conn* listener) {
     Conn* conn = new Conn();
     conn->fd = fd;
     conn->id = c->next_id.fetch_add(1);
+    conn->ring = listener->ring;  // shard listeners keep their frames local
     conn->accepted_by = listener->id;
     {
       std::lock_guard<std::mutex> lk(c->mu);
@@ -199,7 +225,7 @@ void handle_accept(Core* c, Conn* listener) {
     std::string payload(8, '\0');
     uint64_t lid = static_cast<uint64_t>(listener->id);
     memcpy(&payload[0], &lid, 8);
-    push_event(c, conn->id, 1, std::move(payload));
+    push_event(c, conn->ring, conn->id, 1, std::move(payload));
   }
 }
 
@@ -212,7 +238,7 @@ void parse_frames(Core* c, Conn* conn) {
     uint32_t len;
     memcpy(&len, buf.data() + off, 4);
     if (buf.size() - off - 4 < len) break;
-    push_event(c, conn->id, 0, buf.substr(off + 4, len));
+    push_event(c, conn->ring, conn->id, 0, buf.substr(off + 4, len));
     off += 4 + static_cast<size_t>(len);
   }
   if (off == buf.size()) {
@@ -358,20 +384,24 @@ void io_loop(Core* c) {
           }
         }
         for (Conn* conn : flush) handle_write(c, conn);
-        if (c->resume.exchange(false)) {
-          // Rearm every parked conn; level-triggered EPOLLIN re-fires
-          // immediately for any data that arrived while parked.
+        int n_rings = c->n_rings.load(std::memory_order_acquire);
+        for (int ri = 0; ri < n_rings; ri++) {
+          Ring* ring = c->rings[ri];
+          if (!ring->resume.exchange(false)) continue;
+          // Rearm this ring's parked conns; level-triggered EPOLLIN
+          // re-fires immediately for any data that arrived while parked.
           std::vector<Conn*> parked;
           {
             std::lock_guard<std::mutex> lk(c->mu);
             for (auto& kv : c->conns)
-              if (kv.second->parked) parked.push_back(kv.second);
+              if (kv.second->parked && kv.second->ring == ri)
+                parked.push_back(kv.second);
           }
           for (Conn* conn : parked) {
             conn->parked = false;
             epoll_mod(c, conn);
           }
-          c->any_parked.store(false);
+          ring->any_parked.store(false);
         }
         continue;
       }
@@ -403,26 +433,28 @@ void io_loop(Core* c) {
         }
       }
       if (evs[i].events & EPOLLIN) {
+        Ring* ring = c->rings[conn->ring];
         bool over;
         {
-          std::lock_guard<std::mutex> lk(c->in_mu);
-          over = c->inq_bytes > kInHighWater;
+          std::lock_guard<std::mutex> lk(ring->mu);
+          over = ring->bytes > kInHighWater;
         }
         if (over) {
           // Park this conn's read side instead of growing the inbound
           // queue without bound: level-triggered epoll re-arms it the
           // moment Python drains below low-water (frpc_recv sets
-          // `resume`, handled at the wakefd branch above).
+          // `resume`, handled at the wakefd branch above). Per-ring: a
+          // congested shard parks only its own conns.
           conn->parked = true;
-          c->any_parked.store(true);
+          ring->any_parked.store(true);
           epoll_mod(c, conn);
           // Re-check: if Python drained past low-water between the
           // check and the park (it couldn't see any_parked yet), no
           // resume will ever fire — unpark immediately.
           bool drained;
           {
-            std::lock_guard<std::mutex> lk(c->in_mu);
-            drained = c->inq_bytes < kInHighWater / 2;
+            std::lock_guard<std::mutex> lk(ring->mu);
+            drained = ring->bytes < kInHighWater / 2;
           }
           if (drained) {
             conn->parked = false;
@@ -449,11 +481,16 @@ int frpc_start() {
   Core* c = new Core();
   c->epfd = epoll_create1(EPOLL_CLOEXEC);
   c->wakefd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
-  c->notifyfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
-  if (c->epfd < 0 || c->wakefd < 0 || c->notifyfd < 0) {
+  Ring* ring0 = new Ring();
+  ring0->notifyfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  c->notifyfd = ring0->notifyfd;
+  if (c->epfd < 0 || c->wakefd < 0 || ring0->notifyfd < 0) {
+    delete ring0;
     delete c;
     return -1;
   }
+  c->rings[0] = ring0;
+  c->n_rings.store(1, std::memory_order_release);
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.u64 = 0;  // id 0 = wake
@@ -464,9 +501,36 @@ int frpc_start() {
   return c->notifyfd;
 }
 
-int64_t frpc_listen(const char* ip, int* port_inout) {
+// Create a new inbound ring; returns its index, or -1 when the core is
+// not started / the ring table is full (callers fall back to ring 0).
+int frpc_ring_create() {
+  std::lock_guard<std::mutex> lk(g_start_mu);
   Core* c = g_core;
   if (!c) return -1;
+  int n = c->n_rings.load(std::memory_order_acquire);
+  if (n >= kMaxRings) return -1;
+  Ring* r = new Ring();
+  r->notifyfd = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+  if (r->notifyfd < 0) {
+    delete r;
+    return -1;
+  }
+  c->rings[n] = r;
+  c->n_rings.store(n + 1, std::memory_order_release);
+  return n;
+}
+
+int frpc_ring_fd(int ring) {
+  Core* c = g_core;
+  if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
+    return -1;
+  return c->rings[ring]->notifyfd;
+}
+
+int64_t frpc_listen2(const char* ip, int* port_inout, int ring) {
+  Core* c = g_core;
+  if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
+    return -1;
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (fd < 0) return -1;
   int one = 1;
@@ -486,6 +550,7 @@ int64_t frpc_listen(const char* ip, int* port_inout) {
   Conn* conn = new Conn();
   conn->fd = fd;
   conn->id = c->next_id.fetch_add(1);
+  conn->ring = ring;
   conn->listener = true;
   {
     std::lock_guard<std::mutex> lk(c->mu);
@@ -498,9 +563,14 @@ int64_t frpc_listen(const char* ip, int* port_inout) {
   return conn->id;
 }
 
-int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
+int64_t frpc_listen(const char* ip, int* port_inout) {
+  return frpc_listen2(ip, port_inout, 0);
+}
+
+int64_t frpc_connect2(const char* ip, int port, int timeout_ms, int ring) {
   Core* c = g_core;
-  if (!c) return -1;
+  if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
+    return -1;
   int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) return -1;
   sockaddr_in addr{};
@@ -527,6 +597,7 @@ int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
   Conn* conn = new Conn();
   conn->fd = fd;
   conn->id = c->next_id.fetch_add(1);
+  conn->ring = ring;
   {
     std::lock_guard<std::mutex> lk(c->mu);
     c->conns[conn->id] = conn;
@@ -536,6 +607,10 @@ int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
   ssize_t r = write(c->wakefd, &onev, 8);
   (void)r;
   return conn->id;
+}
+
+int64_t frpc_connect(const char* ip, int port, int timeout_ms) {
+  return frpc_connect2(ip, port, timeout_ms, 0);
 }
 
 // Queue one frame (caller passes the 4-byte length prefix + body already
@@ -587,20 +662,22 @@ uint64_t frpc_out_bytes(int64_t conn_id) {
   return it == c->conns.end() ? 0 : it->second->out_bytes.load();
 }
 
-// Drain up to `cap` pending events whose bodies fit in out_buf (first
-// event always delivered even if larger than buf_cap... callers size
-// buf generously). Parallel output arrays describe each event. Returns
-// the number of events written.
-int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
-                  uint64_t buf_cap, uint64_t* offsets, uint64_t* lengths,
-                  int64_t cap) {
+// Drain up to `cap` pending events of one ring whose bodies fit in
+// out_buf (first event always delivered even if larger than buf_cap...
+// callers size buf generously). Parallel output arrays describe each
+// event. Returns the number of events written.
+int64_t frpc_recv2(int ring, int64_t* conn_ids, uint8_t* kinds,
+                   uint8_t* out_buf, uint64_t buf_cap, uint64_t* offsets,
+                   uint64_t* lengths, int64_t cap) {
   Core* c = g_core;
-  if (!c) return 0;
-  std::lock_guard<std::mutex> lk(c->in_mu);
+  if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
+    return 0;
+  Ring* r = c->rings[ring];
+  std::lock_guard<std::mutex> lk(r->mu);
   int64_t n = 0;
   uint64_t used = 0;
-  while (n < cap && !c->inq.empty()) {
-    InEvent& e = c->inq.front();
+  while (n < cap && !r->q.empty()) {
+    InEvent& e = r->q.front();
     if (n > 0 && used + e.data.size() > buf_cap) break;
     if (e.data.size() > buf_cap) break;  // caller must grow its buffer
     memcpy(out_buf + used, e.data.data(), e.data.size());
@@ -609,34 +686,45 @@ int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
     offsets[n] = used;
     lengths[n] = e.data.size();
     used += e.data.size();
-    c->inq_bytes -= e.data.size();
-    c->inq.pop_front();
+    r->bytes -= e.data.size();
+    r->q.pop_front();
     n++;
   }
-  if (c->inq.empty()) {
-    c->notified = false;
+  if (r->q.empty()) {
+    r->notified = false;
     uint64_t buf;
-    ssize_t r = read(c->notifyfd, &buf, 8);
-    (void)r;
+    ssize_t rd = read(r->notifyfd, &buf, 8);
+    (void)rd;
   }
-  if (c->any_parked.load() && c->inq_bytes < kInHighWater / 2 &&
-      !c->resume.load()) {
-    c->resume.store(true);
+  if (r->any_parked.load() && r->bytes < kInHighWater / 2 &&
+      !r->resume.load()) {
+    r->resume.store(true);
     uint64_t one = 1;
-    ssize_t r = write(c->wakefd, &one, 8);
-    (void)r;
+    ssize_t w = write(c->wakefd, &one, 8);
+    (void)w;
   }
   return n;
 }
 
+int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
+                  uint64_t buf_cap, uint64_t* offsets, uint64_t* lengths,
+                  int64_t cap) {
+  return frpc_recv2(0, conn_ids, kinds, out_buf, buf_cap, offsets, lengths,
+                    cap);
+}
+
 // Size of the next pending event (0 if none) — lets Python grow its
 // receive buffer before a frpc_recv that would otherwise stall.
-uint64_t frpc_next_len(void) {
+uint64_t frpc_next_len2(int ring) {
   Core* c = g_core;
-  if (!c) return 0;
-  std::lock_guard<std::mutex> lk(c->in_mu);
-  return c->inq.empty() ? 0 : c->inq.front().data.size();
+  if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
+    return 0;
+  Ring* r = c->rings[ring];
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->q.empty() ? 0 : r->q.front().data.size();
 }
+
+uint64_t frpc_next_len(void) { return frpc_next_len2(0); }
 
 void frpc_close(int64_t conn_id) {
   Core* c = g_core;

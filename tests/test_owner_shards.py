@@ -1,0 +1,288 @@
+"""Owner shards: the partitioned multi-loop driver core (PR 6).
+
+Covers the routing contract (same id -> same shard, returns follow
+their task), cross-shard dependency resolution (arg owned by shard A,
+task on shard B), A/B equivalence against the ``RTPU_OWNER_SHARDS=1``
+exact-legacy path, per-shard work partitioning under an n:n actor
+flood (every shard's queue-depth gauge goes nonzero), and teardown
+hygiene (repeated init/shutdown joins every shard loop — no leaked
+``rtpu-owner-shard-*`` threads). The module is on the sanitizer's
+report-only list; the CI acceptance run re-executes it under
+``RTPU_SANITIZE=1`` and requires zero lock-order cycles."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal.config import CONFIG
+from ray_tpu._internal.ids import ActorID, ObjectID, TaskID
+from ray_tpu._internal.owner_shards import (ShardSet, resolve_shard_count,
+                                            route_bytes)
+
+
+@pytest.fixture
+def shard_config():
+    """Set CONFIG.owner_shards for the duration of a test (the flag is
+    read once per CoreWorker construction, i.e. at init())."""
+    prior = CONFIG.owner_shards
+
+    def _set(n):
+        CONFIG.apply_system_config({"owner_shards": n})
+    yield _set
+    CONFIG.apply_system_config({"owner_shards": prior})
+
+
+def _shard_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("rtpu-owner-shard-")]
+
+
+# ---------------------------------------------------------------------------
+# routing units (no cluster)
+# ---------------------------------------------------------------------------
+
+def test_routing_is_deterministic_and_salt_free():
+    for n in (1, 2, 4, 7):
+        for _ in range(50):
+            tid = TaskID.from_random()
+            s = route_bytes(tid.binary(), n)
+            assert 0 <= s < n
+            # same id -> same shard, every time
+            assert route_bytes(tid.binary(), n) == s
+            # routing depends only on the raw bytes, never on Python's
+            # salted hash(): a reconstructed id routes identically
+            assert route_bytes(TaskID(tid.binary()).binary(), n) == s
+
+
+def test_task_returns_route_with_their_task():
+    # ObjectID.for_task_return shares the task's byte prefix, so an
+    # object is owned by the shard that owns the task creating it.
+    for _ in range(50):
+        tid = TaskID.from_random()
+        for index in range(3):
+            oid = ObjectID.for_task_return(tid, index)
+            assert route_bytes(oid.binary(), 4) == \
+                route_bytes(tid.binary(), 4)
+
+
+def test_routing_spreads_across_shards():
+    n = 4
+    hits = [0] * n
+    for _ in range(2000):
+        hits[route_bytes(TaskID.from_random().binary(), n)] += 1
+    # uniform-ish: every shard sees a meaningful share
+    assert all(h > 2000 // n // 2 for h in hits), hits
+
+
+def test_shardset_for_spec_routes_actor_tasks_by_actor():
+    shards = ShardSet(4)
+    aid = ActorID.from_random()
+    expected = shards.shards[route_bytes(aid.binary(), 4)]
+    assert shards.for_actor(aid) is expected
+    # every task of one actor lands on the actor's shard regardless of
+    # its own task id (the actor's send queue is loop-confined)
+    assert all(shards.for_actor(ActorID(aid.binary())) is expected
+               for _ in range(5))
+
+
+def test_resolve_shard_count_defaults():
+    prior = CONFIG.owner_shards
+    try:
+        CONFIG.apply_system_config({"owner_shards": 0})
+        assert resolve_shard_count("worker") == 1  # workers stay legacy
+        assert 1 <= resolve_shard_count("driver") <= 4
+        CONFIG.apply_system_config({"owner_shards": 3})
+        assert resolve_shard_count("driver") == 3
+        assert resolve_shard_count("worker") == 3  # explicit wins
+    finally:
+        CONFIG.apply_system_config({"owner_shards": prior})
+
+
+# ---------------------------------------------------------------------------
+# e2e: cross-shard dependencies + A/B equivalence
+# ---------------------------------------------------------------------------
+
+def _workload():
+    """A mix that crosses ownership boundaries: normal tasks, actor
+    calls, and tasks consuming refs owned by other shards."""
+
+    @ray_tpu.remote
+    def produce(i):
+        return i * 10
+
+    @ray_tpu.remote
+    def consume(x, j):
+        return x + j
+
+    @ray_tpu.remote
+    class Acc:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    produced = [produce.remote(i) for i in range(8)]
+    # each consumer takes a ref argument owned by (very likely) a
+    # different shard than its own task id routes to
+    consumed = [consume.remote(ref, j)
+                for j, ref in enumerate(produced)]
+    accs = [Acc.remote() for _ in range(4)]
+    acc_results = []
+    for k in range(12):
+        acc_results.append(accs[k % 4].add.remote(k))
+    return (ray_tpu.get(produced), ray_tpu.get(consumed),
+            ray_tpu.get(acc_results))
+
+
+def test_cross_shard_dependency_resolution(shard_config):
+    shard_config(4)
+    ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+    try:
+        from ray_tpu._internal.core_worker import get_core_worker
+        cw = get_core_worker()
+        assert len(cw.shards) == 4
+
+        @ray_tpu.remote
+        def produce():
+            return 21
+
+        @ray_tpu.remote
+        def consume(x):
+            return x * 2
+
+        # force at least one genuinely cross-shard pair: submit
+        # producers until a consumer's task routing differs from the
+        # ref owner's routing (ids are random, so a handful suffices)
+        crossed = 0
+        for _ in range(12):
+            ref = produce.remote()
+            out = consume.remote(ref)
+            owner_shard = cw.shards.for_task(ref.id().task_id())
+            consumer_shard = cw.shards.for_task(out.id().task_id())
+            if owner_shard is not consumer_shard:
+                crossed += 1
+            assert ray_tpu.get(out) == 42
+        assert crossed > 0, "no cross-shard pair in 12 tries (p < 1e-13)"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_ab_equivalence_shards_1_vs_4(shard_config):
+    results = {}
+    for n in (1, 4):
+        shard_config(n)
+        ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+        try:
+            from ray_tpu._internal.core_worker import get_core_worker
+            assert len(get_core_worker().shards) == n
+            results[n] = _workload()
+        finally:
+            ray_tpu.shutdown()
+    assert results[1] == results[4]
+
+
+# ---------------------------------------------------------------------------
+# n:n flood: per-shard work partitioning
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(300)
+def test_shard_partitioning_under_actor_flood(shard_config):
+    shard_config(4)
+    ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+    try:
+        from ray_tpu._internal.core_worker import get_core_worker
+        cw = get_core_worker()
+
+        @ray_tpu.remote(num_cpus=0.01)
+        class Worker:
+            def work(self, x):
+                time.sleep(0.002)
+                return x
+
+        # 40 actors spread over 4 shards: P(empty shard) < 1e-4
+        actors = [Worker.remote() for _ in range(40)]
+        refs = []
+        max_depth = [0] * 4
+
+        def _sample():
+            for shard in cw.shards:
+                d = shard.queue_depth()
+                if d > max_depth[shard.index]:
+                    max_depth[shard.index] = d
+        # Sample BETWEEN submission rounds: the fast path enqueues
+        # into the shard's _awaiting from this thread, so right after
+        # a round every shard with actors has live backlog — sampling
+        # only after all rounds raced the drain on small boxes.
+        for round_ in range(10):
+            for a in actors:
+                refs.append(a.work.remote(round_))
+            _sample()
+        for _ in range(200):
+            if all(max_depth):
+                break
+            _sample()
+            time.sleep(0.005)
+        assert ray_tpu.get(refs) == [r for r in range(10)
+                                     for _ in actors]
+        # every shard owned live work at some point during the flood
+        assert all(d > 0 for d in max_depth), max_depth
+        # ... and every shard took submissions (deterministic counter)
+        stats = cw.shards.stats()
+        assert all(row["submits"] > 0 for row in stats), stats
+        # the queue-depth gauge exports one series per shard
+        cw.shards.refresh_gauges()
+        from ray_tpu._internal.runtime_metrics import runtime_metrics
+        snap = runtime_metrics().shard_queue_depth.snapshot()
+        shard_idx = snap["tag_keys"].index("shard")
+        shards_seen = {key[shard_idx] for key, _v in snap["series"]}
+        assert shards_seen >= {"0", "1", "2", "3"}, shards_seen
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# teardown hygiene
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(300)
+def test_repeated_init_shutdown_leaks_no_shard_loops(shard_config):
+    for cycle in range(3):
+        # re-applied each cycle: shutdown() calls CONFIG.reset()
+        shard_config(3)
+        ray_tpu.init(num_cpus=2, object_store_memory=100 * 1024 * 1024)
+        try:
+            @ray_tpu.remote
+            def f(x):
+                return x + 1
+            assert ray_tpu.get([f.remote(i) for i in range(6)]) == \
+                list(range(1, 7))
+            assert len(_shard_threads()) >= 2  # shards 1..2 live
+        finally:
+            ray_tpu.shutdown()
+        deadline = time.monotonic() + 10
+        while _shard_threads() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        leaked = _shard_threads()
+        assert not leaked, (f"cycle {cycle}: leaked shard loops: "
+                            f"{[t.name for t in leaked]}")
+
+
+def test_shards_1_has_no_extra_threads(shard_config):
+    shard_config(1)
+    ray_tpu.init(num_cpus=2, object_store_memory=100 * 1024 * 1024)
+    try:
+        from ray_tpu._internal.core_worker import get_core_worker
+        cw = get_core_worker()
+        assert len(cw.shards) == 1
+        # the exact-legacy path: shard 0 aliases the main loop/server,
+        # no owner-shard threads exist anywhere in the process
+        assert not _shard_threads()
+        # legacy aliases point at shard 0's submitters
+        assert cw.submitter is cw.shards.main.submitter
+        assert cw.actor_submitter is cw.shards.main.actor_submitter
+    finally:
+        ray_tpu.shutdown()
