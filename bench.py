@@ -15,24 +15,10 @@ import time
 import jax
 import jax.numpy as jnp
 
-# Peak bf16 FLOP/s per chip by device kind substring.
-PEAK_FLOPS = {
-    "v6": 918e12,
-    "v5p": 459e12,
-    "v5 lite": 197e12,  # v5e
-    "v5litepod": 197e12,
-    "v5e": 197e12,
-    "v4": 275e12,
-    "cpu": 1e12,  # nominal, so CPU smoke runs produce a line
-}
-
-
-def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
-    for key, value in PEAK_FLOPS.items():
-        if key in kind:
-            return value
-    return 197e12
+# Peak FLOP/s now live in ray_tpu/accelerators/flops.py — ONE table
+# shared with the live MFU gauge (_internal/accel.py), re-exported here
+# for callers that historically imported them from bench.
+from ray_tpu.accelerators.flops import PEAK_FLOPS, peak_flops  # noqa: F401
 
 
 def main():
@@ -97,12 +83,19 @@ def main():
     data = {"tokens": jax.random.randint(rng, (batch, seq), 0,
                                          config.vocab_size)}
 
+    from ray_tpu._internal import accel
+
     with mesh:
         # Warmup / compile. NOTE: fence with device_get of a scalar, not
         # block_until_ready — some PJRT transports (e.g. relayed remote
         # execution) resolve buffer readiness at dispatch time.
+        # The accel plane's compile tracker is installed before warmup
+        # so the compile lands in rtpu_xla_compile_seconds_total.
+        accel.ensure_installed()
+        compile_t0 = time.perf_counter()
         state, metrics = train_step(state, data)
         float(jax.device_get(metrics["loss"]))
+        warmup_s = time.perf_counter() - compile_t0
         start = time.perf_counter()
         for _ in range(steps):
             state, metrics = train_step(state, data)
@@ -121,6 +114,17 @@ def main():
     peak = peak_flops(jax.devices()[0])
     mfu = achieved / peak
 
+    # Feed the live accelerator plane the same numbers the JSON line
+    # reports: the rtpu_step_mfu gauge and the bench's offline MFU now
+    # share both the FLOP model and the peak-FLOPs denominator.
+    accel.report_step(
+        "bench_train", elapsed, steps=steps,
+        tokens=tokens_per_step * steps,
+        device_s=elapsed,  # the loop is device-bound end to end
+        flops=float(flops_per_token) * tokens_per_step * steps
+        / n_devices,
+        device_kind=getattr(jax.devices()[0], "device_kind", "cpu"))
+
     print(json.dumps({
         "metric": "train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 1),
@@ -132,6 +136,9 @@ def main():
         "backend": jax.default_backend(),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         "loss": round(final_loss, 4),
+        "warmup_s": round(warmup_s, 2),
+        # jax.monitoring-attributed compile time (accel plane tracker)
+        "xla_compile_s": round(accel.compile_seconds_total(), 2),
     }))
 
 

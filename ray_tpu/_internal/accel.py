@@ -1,0 +1,836 @@
+"""Accelerator observability plane: the device leg of the
+observability quartet (PR 1 time, PR 3 memory, PR 5 CPU, this module
+the accelerator itself).
+
+Three concerns, one per-process module:
+
+- **Device snapshots** — per-local-device HBM accounting via
+  ``device.memory_stats()`` (TPU/GPU backends), with a
+  ``live_buffers``-equivalent fallback that sums the addressable shard
+  bytes of every live ``jax.Array`` per device — so the CPU backend
+  (where ``memory_stats()`` is ``None``) reports real numbers and the
+  whole plane is testable without hardware. Peak bytes are tracked as a
+  process-lifetime watermark when the backend doesn't report one.
+
+- **XLA compile tracking** — ``jax.monitoring`` listeners accumulate
+  compile counts, cumulative compile seconds (all ``/jax/core/compile``
+  phases), a per-function histogram (attributed to the nearest
+  non-JAX caller frame, the PR-3 callsite idiom — compiles are rare and
+  slow, a stack walk is noise), and compilation-cache hit/miss
+  counters. Surfaced as ``rtpu_xla_compile_seconds_total`` /
+  ``rtpu_xla_compiles_total`` / ``rtpu_xla_cache_{hits,misses}_total``.
+
+- **Step telemetry** — :class:`StepTimer` / :func:`report_step` emit
+  step-time histograms, tokens/s, an achieved-FLOP/s → MFU gauge
+  (denominator from the shared ``accelerators.flops`` table), and
+  goodput accounting that splits wall time into compile /
+  device-compute / host-blocked buckets
+  (``rtpu_goodput_seconds_total{bucket=...}``). Wired into the train
+  controller's report fold, the paged-engine decode tick, and bench.py.
+
+JAX is never imported by this module at module scope, and snapshot /
+install paths only touch JAX when the process has ALREADY imported it
+(``"jax" in sys.modules``) unless the caller forces it — initializing
+JAX from an observability sweep would grab the host's TPU chip lock
+(see accelerators/tpu.py). ``force_jax=True`` is reserved for the
+process the user is driving (cli devices / accel_summary caller).
+
+Kill switch: ``RTPU_NO_ACCEL_METRICS=1`` — zero listeners installed,
+snapshots return empty, StepTimer/report_step become no-ops.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+_JAX_COMPILE_PREFIX = "/jax/core/compile"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_COMPILE_BOUNDARIES = [0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                       10.0, 30.0, 60.0, 300.0]
+_STEP_BOUNDARIES = [0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 60.0]
+
+
+def accel_disabled() -> bool:
+    return bool(CONFIG.no_accel_metrics)
+
+
+# getpid() is a real syscall on every call and this container class
+# (sandboxed kernels) makes syscalls ~100x pricier than a dict lookup —
+# cache the tag string once per process (modules import post-spawn, so
+# the cache can't leak across processes).
+_pid_cache: List[Optional[str]] = [None]
+
+
+def _pid() -> str:
+    pid = _pid_cache[0]
+    if pid is None:
+        pid = _pid_cache[0] = str(os.getpid())
+    return pid
+
+
+# ---------------------------------------------------------------------------
+# metric series (L004: one LazyMetrics factory, literal names)
+# ---------------------------------------------------------------------------
+
+
+def _build_accel_metrics():
+    from types import SimpleNamespace
+
+    from ..util.metrics import Counter, Gauge, Histogram
+    return SimpleNamespace(
+        # gauges carry pid+device: per-process series, last-write-wins
+        # per tag tuple on the cross-process merge (see runtime_metrics)
+        hbm_used=Gauge(
+            "rtpu_accel_hbm_used_bytes",
+            "HBM bytes in use on one local device (memory_stats, "
+            "or live-buffer sum on backends without it)",
+            tag_keys=("pid", "device")),
+        hbm_peak=Gauge(
+            "rtpu_accel_hbm_peak_bytes",
+            "Peak HBM bytes on one local device (backend-reported, "
+            "or a process-lifetime snapshot watermark)",
+            tag_keys=("pid", "device")),
+        hbm_limit=Gauge(
+            "rtpu_accel_hbm_limit_bytes",
+            "HBM capacity of one local device (0 when the backend "
+            "does not report a limit)",
+            tag_keys=("pid", "device")),
+        compiles=Counter(
+            "rtpu_xla_compiles_total",
+            "XLA backend compilations performed by this process"),
+        compile_seconds=Counter(
+            "rtpu_xla_compile_seconds_total",
+            "Cumulative seconds spent in jax trace/lower/backend "
+            "compile phases"),
+        compile_hist=Histogram(
+            "rtpu_xla_compile_seconds",
+            "Per-compilation backend_compile duration",
+            boundaries=_COMPILE_BOUNDARIES),
+        cache_hits=Counter(
+            "rtpu_xla_cache_hits_total",
+            "XLA compilation-cache hits observed via jax.monitoring"),
+        cache_misses=Counter(
+            "rtpu_xla_cache_misses_total",
+            "XLA compilation-cache misses observed via jax.monitoring"),
+        step_time=Histogram(
+            "rtpu_step_time_seconds",
+            "Wall time of one accelerator step (train step / decode "
+            "tick / bench step)",
+            boundaries=_STEP_BOUNDARIES,
+            tag_keys=("kind",)),
+        step_tokens=Counter(
+            "rtpu_step_tokens_total",
+            "Tokens processed by reported steps",
+            tag_keys=("kind",)),
+        tokens_per_sec=Gauge(
+            "rtpu_step_tokens_per_sec",
+            "Smoothed tokens/s of reported steps (EWMA)",
+            tag_keys=("pid", "kind")),
+        mfu=Gauge(
+            "rtpu_step_mfu",
+            "Achieved-FLOP/s / peak-FLOP/s of reported steps "
+            "(denominator: accelerators.flops.PEAK_FLOPS)",
+            tag_keys=("pid", "kind")),
+        goodput=Counter(
+            "rtpu_goodput_seconds_total",
+            "Reported step wall time split into compile / "
+            "device-compute / host-blocked buckets",
+            tag_keys=("kind", "bucket")),
+    )
+
+
+from ..util.metrics import LazyMetrics  # noqa: E402 — after _build def
+
+accel_metrics = LazyMetrics(_build_accel_metrics)
+
+
+# ---------------------------------------------------------------------------
+# XLA compile tracking (jax.monitoring listeners)
+# ---------------------------------------------------------------------------
+
+
+class _CompileTracker:
+    """Accumulates jax.monitoring compile/cache events. One per process;
+    listeners fire synchronously on whatever thread compiles, so all
+    mutation happens under one uncontended lock (compiles are rare)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.installed = False
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        # backend_compile only: these spans are disjoint wall time
+        # (trace/lower events NEST under outer traces, so their sum can
+        # exceed the wall clock of an enclosing region — fine for a
+        # cumulative counter, wrong for a goodput split)
+        self.backend_seconds = 0.0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        # event name -> count (every /jax/ event, for the raw view)
+        self.events: Dict[str, int] = {}
+        # attribution -> {count, seconds} (backend compiles only)
+        self.per_function: Dict[str, Dict[str, float]] = {}
+
+    def summary(self) -> Dict[str, Any]:
+        with self.lock:
+            per_fn = sorted(
+                ({"function": k, **v} for k, v in self.per_function.items()),
+                key=lambda r: -r["seconds"])
+            return {
+                "installed": self.installed,
+                "compiles": self.compiles,
+                "compile_seconds": round(self.compile_seconds, 6),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "events": dict(self.events),
+                "per_function": per_fn[:50],
+            }
+
+
+_TRACKER = _CompileTracker()
+
+
+def _attribute_compile() -> str:
+    """Nearest caller frame outside jax/jaxlib/this module: the
+    user-facing name a compile bills to (cheap relative to the compile
+    itself — same tradeoff as the PR-3 put()/submit callsite capture)."""
+    try:
+        f = sys._getframe(2)
+        while f is not None:
+            fn = f.f_code.co_filename
+            if ("/jax/" not in fn and "/jaxlib/" not in fn
+                    and not fn.endswith("_internal/accel.py")
+                    and not fn.endswith("contextlib.py")
+                    and "importlib" not in fn):
+                return (f"{f.f_code.co_name} "
+                        f"({os.path.basename(fn)}:{f.f_lineno})")
+            f = f.f_back
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        logger.debug("compile attribution walk failed", exc_info=True)
+    return "<unknown>"
+
+
+def _on_duration_event(event: str, duration_s: float, **_kw):
+    # A raise here would propagate into jax's monitoring dispatch MID
+    # COMPILE — the listener must never break user code.
+    try:
+        if not event.startswith(_JAX_COMPILE_PREFIX):
+            return
+        metrics = accel_metrics()
+        metrics.compile_seconds.inc(float(duration_s))
+        tracker = _TRACKER
+        if event == _BACKEND_COMPILE_EVENT:
+            site = _attribute_compile()
+            metrics.compiles.inc()
+            metrics.compile_hist.observe(float(duration_s))
+            with tracker.lock:
+                tracker.compiles += 1
+                tracker.compile_seconds += float(duration_s)
+                tracker.backend_seconds += float(duration_s)
+                tracker.events[event] = tracker.events.get(event, 0) + 1
+                agg = tracker.per_function.setdefault(
+                    site, {"count": 0, "seconds": 0.0})
+                agg["count"] += 1
+                agg["seconds"] += float(duration_s)
+        else:
+            with tracker.lock:
+                tracker.compile_seconds += float(duration_s)
+                tracker.events[event] = tracker.events.get(event, 0) + 1
+    except Exception:  # noqa: BLE001 — observability must not raise
+        logger.debug("compile duration listener failed", exc_info=True)
+
+
+def _on_event(event: str, **_kw):
+    try:
+        tracker = _TRACKER
+        hit = "cache_hit" in event
+        miss = "cache_miss" in event
+        with tracker.lock:
+            tracker.events[event] = tracker.events.get(event, 0) + 1
+            if hit:
+                tracker.cache_hits += 1
+            elif miss:
+                tracker.cache_misses += 1
+        if hit:
+            accel_metrics().cache_hits.inc()
+        elif miss:
+            accel_metrics().cache_misses.inc()
+    except Exception:  # noqa: BLE001 — observability must not raise
+        logger.debug("compile event listener failed", exc_info=True)
+
+
+def ensure_installed() -> bool:
+    """Install the jax.monitoring listeners once per process. Returns
+    False — and installs NOTHING — under the kill switch or when jax
+    isn't importable. Idempotent and cheap once installed."""
+    if accel_disabled():
+        return False
+    tracker = _TRACKER
+    if tracker.installed:
+        return True
+    # Import OUTSIDE tracker.lock: the post-import hook runs
+    # ensure_installed while HOLDING jax's module import lock, so a
+    # concurrent caller that held tracker.lock across this import
+    # (blocking on that same import lock) would deadlock the pair.
+    try:
+        from jax import monitoring
+    except Exception:  # noqa: BLE001 — jax genuinely unavailable
+        logger.debug("jax.monitoring unavailable", exc_info=True)
+        return False
+    with tracker.lock:
+        if tracker.installed:
+            return True
+        monitoring.register_event_duration_secs_listener(
+            _on_duration_event)
+        monitoring.register_event_listener(_on_event)
+        tracker.installed = True
+    return True
+
+
+def maybe_install() -> bool:
+    """Task-boundary fast path: arm the listeners iff jax is already
+    imported in this process. Two dict probes when already installed
+    (or jax absent) — cheap enough for the executor's per-task call."""
+    if _TRACKER.installed:
+        return True
+    if "jax" not in sys.modules:
+        return False
+    return ensure_installed()
+
+
+class _JaxPostImportHook:
+    """Meta-path watcher that arms the compile listeners the moment
+    ``import jax`` COMPLETES anywhere in this process — the only way to
+    count a process's FIRST compile, which usually happens inside the
+    first task body, before any accel entry point runs. Inert for every
+    other import (one string compare), removes itself after firing."""
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname != "jax" or _TRACKER.installed:
+            return None
+        import importlib.machinery  # noqa: F401 — finders below need it
+        for finder in sys.meta_path:
+            if finder is self or not hasattr(finder, "find_spec"):
+                continue
+            spec = finder.find_spec(fullname, path, target)
+            if spec is None or spec.loader is None:
+                continue
+            orig_exec = spec.loader.exec_module
+
+            def exec_module(module, _orig=orig_exec):
+                _orig(module)
+                # jax/__init__ has fully executed; sys.modules["jax"]
+                # is set, so registering listeners is safe now.
+                try:
+                    ensure_installed()
+                except Exception:  # noqa: BLE001 — import must win
+                    logger.debug("post-import accel install failed",
+                                 exc_info=True)
+                try:
+                    sys.meta_path.remove(_IMPORT_HOOK)
+                except ValueError:
+                    pass
+
+            spec.loader.exec_module = exec_module
+            return spec
+        return None
+
+
+_IMPORT_HOOK = _JaxPostImportHook()
+
+
+def install_import_hook() -> bool:
+    """Called once at process boot (CoreWorker/raylet/GCS init). If jax
+    is already imported, installs directly; otherwise registers the
+    post-import watcher. Under the kill switch NOTHING is registered —
+    not even the (inert) finder."""
+    if accel_disabled():
+        return False
+    if maybe_install():
+        return True
+    if _IMPORT_HOOK not in sys.meta_path:
+        # FRONT of meta_path: PathFinder would otherwise resolve jax
+        # before this finder is ever consulted (find_spec delegates to
+        # the rest of the chain, so ordering costs nothing).
+        sys.meta_path.insert(0, _IMPORT_HOOK)
+    return True
+
+
+def uninstall() -> None:
+    """Best-effort listener removal (tests; the unregister API is
+    private to jax so failures just leave idle listeners behind)."""
+    try:
+        from jax._src import monitoring as _m  # import OUTSIDE the lock
+    except Exception:  # noqa: BLE001 — private API may move
+        logger.debug("jax._src.monitoring unavailable", exc_info=True)
+        _m = None
+    tracker = _TRACKER
+    with tracker.lock:
+        if not tracker.installed:
+            return
+        if _m is not None:
+            try:
+                _m._unregister_event_duration_listener_by_callback(
+                    _on_duration_event)
+                _m._unregister_event_listener_by_callback(_on_event)
+            except Exception:  # noqa: BLE001 — private API may move
+                logger.debug("jax.monitoring unregister failed",
+                             exc_info=True)
+        tracker.installed = False
+
+
+def compile_seconds_total() -> float:
+    with _TRACKER.lock:
+        return _TRACKER.compile_seconds
+
+
+def backend_compile_seconds_total() -> float:
+    """Disjoint backend-compile wall seconds — what StepTimer's goodput
+    split subtracts (see _CompileTracker.backend_seconds)."""
+    with _TRACKER.lock:
+        return _TRACKER.backend_seconds
+
+
+def compile_summary() -> Dict[str, Any]:
+    return _TRACKER.summary()
+
+
+# ---------------------------------------------------------------------------
+# device snapshots
+# ---------------------------------------------------------------------------
+
+# device id -> peak bytes watermark, for backends whose memory_stats()
+# is None (CPU) or lacks peak_bytes_in_use.
+_hbm_peak_seen: Dict[int, int] = {}
+_PEAK_LOCK = threading.Lock()
+
+
+def _live_buffer_bytes_by_device() -> Dict[int, int]:
+    """live_buffers()-equivalent: sum every live jax.Array's addressable
+    shard bytes per device. Exact for committed arrays; the fallback
+    that makes the CPU backend report real HBM numbers."""
+    import jax
+
+    per_dev: Dict[int, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            for shard in arr.addressable_shards:
+                dev_id = shard.device.id
+                per_dev[dev_id] = per_dev.get(dev_id, 0) + \
+                    int(shard.data.nbytes)
+        except Exception:  # noqa: BLE001 — arrays can be deleted mid-walk
+            logger.debug("live-array walk skipped one array",
+                         exc_info=True)
+    return per_dev
+
+
+def snapshot_devices(force_jax: bool = False) -> List[Dict[str, Any]]:
+    """One row per local device: identity, HBM used/peak/limit, and the
+    peak-FLOPs denominator. Empty when disabled, or when jax was never
+    imported here (initializing jax from an observability sweep would
+    grab the TPU chip lock) unless ``force_jax``."""
+    if accel_disabled():
+        return []
+    if not force_jax and "jax" not in sys.modules:
+        return []
+    import jax
+
+    from ..accelerators.flops import peak_flops
+
+    ensure_installed()
+    rows: List[Dict[str, Any]] = []
+    live = None  # computed once, only if some device lacks memory_stats
+    for dev in jax.local_devices():
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend-dependent API
+            logger.debug("memory_stats failed on %s", dev, exc_info=True)
+        if stats:
+            used = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", 0))
+            limit = int(stats.get("bytes_limit", 0))
+            source = "memory_stats"
+        else:
+            if live is None:
+                live = _live_buffer_bytes_by_device()
+            used = live.get(dev.id, 0)
+            peak = 0
+            limit = 0
+            source = "live_buffers"
+        with _PEAK_LOCK:
+            watermark = max(_hbm_peak_seen.get(dev.id, 0), used, peak)
+            _hbm_peak_seen[dev.id] = watermark
+        rows.append({
+            "index": dev.id,
+            "process_index": dev.process_index,
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", dev.platform),
+            "hbm_used_bytes": used,
+            "hbm_peak_bytes": watermark,
+            "hbm_limit_bytes": limit,
+            "source": source,
+            "peak_flops": peak_flops(dev),
+        })
+    metrics = accel_metrics()
+    pid = _pid()
+    for row in rows:
+        tags = {"pid": pid, "device": str(row["index"])}
+        metrics.hbm_used.set(row["hbm_used_bytes"], tags=tags)
+        metrics.hbm_peak.set(row["hbm_peak_bytes"], tags=tags)
+        metrics.hbm_limit.set(row["hbm_limit_bytes"], tags=tags)
+    return rows
+
+
+# Rate limit: one DEVICE_MEMORY_PRESSURE event per device per interval.
+_pressure_last_emit: Dict[Any, float] = {}
+_PRESSURE_LOCK = threading.Lock()
+
+
+def check_pressure(rows: List[Dict[str, Any]],
+                   watermark: Optional[float] = None
+                   ) -> List[Dict[str, Any]]:
+    """Device rows above the HBM watermark, rate-limited per device —
+    the caller emits these as DEVICE_MEMORY_PRESSURE events into the
+    GCS event log (the emission path differs by thread context)."""
+    if watermark is None:
+        watermark = CONFIG.accel_hbm_watermark
+    out = []
+    now = time.monotonic()
+    for row in rows:
+        limit = row.get("hbm_limit_bytes") or 0
+        if limit <= 0:
+            continue
+        ratio = row["hbm_used_bytes"] / limit
+        if ratio < watermark:
+            continue
+        key = row["index"]
+        with _PRESSURE_LOCK:
+            last = _pressure_last_emit.get(key, 0.0)
+            if now - last < CONFIG.accel_pressure_min_interval_s:
+                continue
+            _pressure_last_emit[key] = now
+        out.append({
+            "device": row["index"],
+            "device_kind": row["device_kind"],
+            "hbm_used_bytes": row["hbm_used_bytes"],
+            "hbm_limit_bytes": limit,
+            "used_ratio": round(ratio, 4),
+        })
+    return out
+
+
+def emit_pressure_event(message: str, fields: Optional[Dict[str, Any]]
+                        = None) -> bool:
+    """Best-effort DEVICE_MEMORY_PRESSURE publish from a USER thread
+    (sync GCS bridge — never call from an io loop; async handlers
+    schedule ``gcs.call("add_event", ...)`` themselves)."""
+    try:
+        from .core_worker import try_get_core_worker
+        worker = try_get_core_worker()
+        if worker is None:
+            return False
+        worker.gcs.call_sync(
+            "add_event", event_type="DEVICE_MEMORY_PRESSURE",
+            message=message, severity="WARNING",
+            fields=dict(fields or {}, pid=os.getpid()), timeout=5)
+        return True
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        logger.debug("DEVICE_MEMORY_PRESSURE emit failed", exc_info=True)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# step telemetry (StepTimer / report_step) + goodput accounting
+# ---------------------------------------------------------------------------
+
+# kind -> fold of every reported step in this process
+_step_stats: Dict[str, Dict[str, float]] = {}
+_STEP_LOCK = threading.Lock()
+_EWMA_ALPHA = 0.2
+
+# kind -> the 6 tag dicts report_step passes to metric ops, built once
+# (report_step rides the decode tick — per-call dict builds showed up)
+_step_tag_cache: Dict[str, Dict[str, Dict[str, str]]] = {}
+
+
+def _step_tags(kind: str) -> Dict[str, Dict[str, str]]:
+    tags = _step_tag_cache.get(kind)
+    if tags is None:
+        pid = _pid()
+        tags = _step_tag_cache[kind] = {
+            "kind": {"kind": kind},
+            "compile": {"kind": kind, "bucket": "compile"},
+            "device": {"kind": kind, "bucket": "device"},
+            "host": {"kind": kind, "bucket": "host"},
+            "pid_kind": {"pid": pid, "kind": kind},
+        }
+    return tags
+
+
+_device_kind_cache: List[Optional[str]] = [None]
+
+
+def _default_device_kind() -> str:
+    """device_kind of local device 0, cached; "cpu" when jax was never
+    imported (don't initialize a backend from a metrics fold)."""
+    kind = _device_kind_cache[0]
+    if kind is None:
+        if "jax" in sys.modules:
+            import jax
+            try:
+                kind = getattr(jax.local_devices()[0], "device_kind",
+                               "cpu")
+            except Exception:  # noqa: BLE001 — backend init can fail
+                logger.debug("device-kind probe failed", exc_info=True)
+                kind = "cpu"
+        else:
+            kind = "cpu"
+        _device_kind_cache[0] = kind
+    return kind
+
+
+def report_step(kind: str, wall_s: float, tokens: int = 0,
+                device_s: float = 0.0, compile_s: float = 0.0,
+                flops: float = 0.0,
+                device_kind: Optional[str] = None,
+                steps: int = 1) -> Optional[Dict[str, float]]:
+    """Fold one step (or ``steps`` uniform steps) into the process's
+    step telemetry: step-time histogram, tokens/s EWMA gauge, MFU gauge
+    (``flops`` = total FLOPs the interval performed, divided by wall
+    and the shared peak table), and the compile/device/host goodput
+    split (host-blocked = wall − compile − device). Returns the derived
+    numbers, or None when the plane is disabled."""
+    if accel_disabled() or wall_s <= 0:
+        return None
+    metrics = accel_metrics()
+    per_step = wall_s / max(1, steps)
+    tags = _step_tags(kind)
+    if steps == 1:
+        metrics.step_time.observe(per_step, tags=tags["kind"])
+    else:
+        # aggregated interval: observe the mean once per reported step
+        # (bounded — an interval never unrolls into thousands of
+        # histogram appends)
+        for _ in range(min(steps, 64)):
+            metrics.step_time.observe(per_step, tags=tags["kind"])
+    compile_s = max(0.0, min(compile_s, wall_s))
+    device_s = max(0.0, min(device_s, wall_s - compile_s))
+    host_s = max(0.0, wall_s - compile_s - device_s)
+    if compile_s:
+        metrics.goodput.inc(compile_s, tags=tags["compile"])
+    if device_s:
+        metrics.goodput.inc(device_s, tags=tags["device"])
+    if host_s:
+        metrics.goodput.inc(host_s, tags=tags["host"])
+    tokens_per_s = None
+    if tokens:
+        metrics.step_tokens.inc(tokens, tags=tags["kind"])
+        tokens_per_s = tokens / wall_s
+    mfu = None
+    if flops:
+        from ..accelerators.flops import peak_flops_for_kind
+        peak = peak_flops_for_kind(device_kind or _default_device_kind())
+        mfu = (flops / wall_s) / peak
+        metrics.mfu.set(mfu, tags=tags["pid_kind"])
+    with _STEP_LOCK:
+        agg = _step_stats.setdefault(kind, {
+            "steps": 0, "wall_s": 0.0, "tokens": 0,
+            "compile_s": 0.0, "device_s": 0.0, "host_s": 0.0,
+            "tokens_per_s": 0.0, "mfu": 0.0})
+        agg["steps"] += steps
+        agg["wall_s"] += wall_s
+        agg["tokens"] += tokens
+        agg["compile_s"] += compile_s
+        agg["device_s"] += device_s
+        agg["host_s"] += host_s
+        if tokens_per_s is not None:
+            prev = agg["tokens_per_s"]
+            agg["tokens_per_s"] = tokens_per_s if not prev else \
+                prev + _EWMA_ALPHA * (tokens_per_s - prev)
+            metrics.tokens_per_sec.set(
+                agg["tokens_per_s"], tags=tags["pid_kind"])
+        if mfu is not None:
+            agg["mfu"] = mfu
+    return {"wall_s": wall_s, "tokens_per_s": tokens_per_s or 0.0,
+            "mfu": mfu or 0.0, "compile_s": compile_s,
+            "device_s": device_s, "host_s": host_s}
+
+
+def step_summary() -> List[Dict[str, Any]]:
+    """Per-kind fold of every step this process reported."""
+    with _STEP_LOCK:
+        out = []
+        for kind, agg in _step_stats.items():
+            row = dict(agg, kind=kind)
+            steps = max(1, int(agg["steps"]))
+            row["mean_step_s"] = agg["wall_s"] / steps
+            out.append(row)
+    out.sort(key=lambda r: -r["wall_s"])
+    return out
+
+
+class StepAccumulator:
+    """Amortizes report_step over hot loops: each step folds into a
+    handful of float adds, and one aggregated ``report_step(steps=n)``
+    fires every ``every`` steps — so a millisecond-scale decode tick
+    pays ~a perf_counter pair, not six metric-series ops. The histogram
+    sees mean-of-window observations (acceptable smoothing for a
+    window of 16 uniform ticks); gauges/counters are exact."""
+
+    __slots__ = ("kind", "every", "device_kind",
+                 "_n", "_wall", "_tokens", "_device", "_compile",
+                 "_flops")
+
+    def __init__(self, kind: str, every: int = 16,
+                 device_kind: Optional[str] = None):
+        self.kind = kind
+        self.every = max(1, int(every))
+        self.device_kind = device_kind
+        self._n = 0
+        self._wall = self._device = self._compile = self._flops = 0.0
+        self._tokens = 0
+
+    def add(self, wall_s: float, tokens: int = 0, device_s: float = 0.0,
+            compile_s: float = 0.0, flops: float = 0.0):
+        self._n += 1
+        self._wall += wall_s
+        self._tokens += tokens
+        self._device += device_s
+        self._compile += compile_s
+        self._flops += flops
+        if self._n >= self.every:
+            self.flush()
+
+    def flush(self) -> Optional[Dict[str, float]]:
+        n = self._n
+        if not n:
+            return None
+        out = report_step(
+            self.kind, self._wall, tokens=self._tokens,
+            device_s=self._device, compile_s=self._compile,
+            flops=self._flops, device_kind=self.device_kind, steps=n)
+        self._n = 0
+        self._wall = self._device = self._compile = self._flops = 0.0
+        self._tokens = 0
+        return out
+
+
+class StepTimer:
+    """Times one step and reports it on exit.
+
+    ::
+
+        with StepTimer("decode", tokens=n, flops=2 * params * n) as t:
+            host_side_prep()
+            with t.device():
+                out = jitted_step(...)   # device-compute bucket
+        # exit: wall split into compile (jax.monitoring delta during the
+        # step) / device (time inside t.device()) / host (the rest)
+
+    ``sink``: a StepAccumulator to fold into instead of reporting
+    immediately (hot loops — see the paged decode tick). Near-zero when
+    the plane is disabled: __enter__/__exit__ degrade to two attribute
+    checks and report nothing."""
+
+    __slots__ = ("kind", "tokens", "flops", "device_kind", "enabled",
+                 "device_s", "result", "sink", "_t0", "_c0")
+
+    def __init__(self, kind: str, tokens: int = 0, flops: float = 0.0,
+                 device_kind: Optional[str] = None,
+                 sink: Optional[StepAccumulator] = None):
+        self.kind = kind
+        self.tokens = tokens
+        self.flops = flops
+        self.device_kind = device_kind
+        self.sink = sink
+        self.enabled = not accel_disabled()
+        self.device_s = 0.0
+        self.result: Optional[Dict[str, float]] = None
+        self._t0 = 0.0
+        self._c0 = 0.0
+
+    def __enter__(self) -> "StepTimer":
+        if self.enabled:
+            ensure_installed()
+            self._c0 = backend_compile_seconds_total()
+            self._t0 = time.perf_counter()
+        return self
+
+    def device(self):
+        return _DeviceSpan(self)
+
+    def __exit__(self, exc_type, _exc, _tb):
+        if not self.enabled or exc_type is not None:
+            return False
+        wall = time.perf_counter() - self._t0
+        compile_s = backend_compile_seconds_total() - self._c0
+        if self.sink is not None:
+            self.sink.add(wall, tokens=self.tokens,
+                          device_s=self.device_s, compile_s=compile_s,
+                          flops=self.flops)
+        else:
+            self.result = report_step(
+                self.kind, wall, tokens=self.tokens,
+                device_s=self.device_s, compile_s=compile_s,
+                flops=self.flops, device_kind=self.device_kind)
+        return False
+
+
+class _DeviceSpan:
+    """Accumulates time spent inside ``with timer.device():`` into the
+    owning StepTimer's device-compute bucket."""
+
+    __slots__ = ("_timer", "_t0")
+
+    def __init__(self, timer: StepTimer):
+        self._timer = timer
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb):
+        self._timer.device_s += time.perf_counter() - self._t0
+        return False
+
+
+# ---------------------------------------------------------------------------
+# the per-process report (get_accel_report RPC body)
+# ---------------------------------------------------------------------------
+
+
+def accel_report(force_jax: bool = False) -> Dict[str, Any]:
+    """Everything this process knows about its accelerators: device
+    rows, compile tracking, step telemetry, and any pressure rows the
+    caller should publish. ``devices`` stays empty in processes that
+    never imported jax (see snapshot_devices) unless ``force_jax``."""
+    disabled = accel_disabled()
+    report: Dict[str, Any] = {
+        "pid": os.getpid(),
+        "disabled": disabled,
+        "jax_initialized": "jax" in sys.modules,
+        "devices": [],
+        "compile": compile_summary(),
+        "steps": step_summary(),
+        "pressure": [],
+    }
+    if disabled:
+        return report
+    devices = snapshot_devices(force_jax=force_jax)
+    report["devices"] = devices
+    report["jax_initialized"] = report["jax_initialized"] or force_jax
+    report["pressure"] = check_pressure(devices)
+    return report

@@ -140,6 +140,12 @@ _DEFAULTS: Dict[str, Any] = {
     # sampler at boot at this rate. Off by default — captures start
     # samplers on demand.
     "profiler_autostart_hz": 0.0,
+    # --- accelerator observability plane ---
+    # HBM used/limit ratio above which device snapshots publish
+    # DEVICE_MEMORY_PRESSURE events into the GCS event log (only on
+    # backends that report a limit; rate-limited per device below).
+    "accel_hbm_watermark": 0.90,
+    "accel_pressure_min_interval_s": 30.0,
     # --- task events (reference: RAY_task_events_* flags) ---
     "enable_task_events": True,
     # --- logging ---
@@ -160,6 +166,10 @@ _DEFAULTS: Dict[str, Any] = {
     # Kill switch for the stack-sampling profiler: start_profiling
     # refuses and no sampler thread is ever spawned.
     "no_profiler": False,
+    # Kill switch for the accelerator observability plane: zero
+    # jax.monitoring listeners installed, device snapshots return
+    # empty, StepTimer/report_step are no-ops.
+    "no_accel_metrics": False,
     # --- overrides re-read from the environment at their use site
     # (tests monkeypatch them after CONFIG construction; registered here
     # so L003 can resolve the names) ---

@@ -2304,6 +2304,12 @@ class TaskExecutor:
         # Tag this thread for the stack sampler / fleet stack dumps:
         # samples taken while user code runs carry the task identity.
         profiler.note_task(spec)
+        # Arm XLA compile tracking the moment jax appears in this
+        # worker (an earlier task imported it): listeners must precede
+        # the compiles they count, and user code — not ray_tpu — is
+        # what imports jax here.
+        from . import accel
+        accel.maybe_install()
         self._running_sync.add(spec.task_id)
         self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
         # Continue the caller's trace: user code in this task opening
@@ -2423,6 +2429,8 @@ class TaskExecutor:
                 args, kwargs = await loop.run_in_executor(
                     None, self._load_args, spec)
             self._cw.task_events.record(spec, "RUNNING", pid=os.getpid())
+            from . import accel
+            accel.maybe_install()  # see _run_task — same task boundary
             method = getattr(self._actor_instance, spec.method_name)
             if self._is_coroutine_method(spec.method_name, method):
                 RUNTIME_CTX.task_spec = spec
@@ -2597,6 +2605,8 @@ class CoreWorker:
                 "actor_tasks_done",
                 self._make_done_stream_handler(shard.actor_submitter))
         profiler.maybe_autostart()
+        from . import accel
+        accel.install_import_hook()  # arm compile tracking at jax import
 
     @staticmethod
     def _make_done_stream_handler(actor_submitter: "ActorTaskSubmitter"):
@@ -3392,6 +3402,32 @@ class CoreWorker:
             "num_pending_tasks": self.task_manager.num_pending(),
             "objects": objects,
         }
+
+    async def handle_get_accel_report(self):
+        """Accelerator-plane introspection: per-device HBM rows, XLA
+        compile tracking, and step telemetry for THIS process (the
+        device leg of the get_memory_report/get_profile family). Jax is
+        only touched when this process already imported it — an
+        observability sweep must never grab the TPU chip lock.
+        Pressure rows found here are published to the GCS event log
+        asynchronously (the handler runs on the serve loop, so the sync
+        GCS bridge is off limits)."""
+        from . import accel
+        report = accel.accel_report()
+        for pressed in report.get("pressure", ()):
+            asyncio.ensure_future(self.gcs.call(
+                "add_event", event_type="DEVICE_MEMORY_PRESSURE",
+                message=(f"device {pressed['device']} "
+                         f"({pressed['device_kind']}) HBM at "
+                         f"{pressed['used_ratio']:.0%} of limit"),
+                severity="WARNING",
+                fields=dict(pressed, pid=os.getpid(),
+                            node_id=self.node_id)))
+        wid = self.worker_id.hex() if isinstance(self.worker_id, bytes) \
+            else str(self.worker_id)
+        report.update(worker_id=wid, mode=self.mode,
+                      node_id=self.node_id, node_index=self.node_index)
+        return report
 
     async def handle_get_object(self, object_hex: str):
         oid = ObjectID.from_hex(object_hex)

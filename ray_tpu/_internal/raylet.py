@@ -1740,6 +1740,35 @@ class Raylet:
                     *(_one(h) for h in targets)))
         return report
 
+    async def handle_get_accel_report(self, include_workers: bool = True):
+        """Node accelerator report: every local worker's device/compile/
+        step telemetry, fetched concurrently (the get_memory_report
+        fan-out pattern — the raylet IS the node agent). The raylet's
+        own process never initializes jax, so its row is just the node
+        wrapper."""
+        report: Dict[str, Any] = {
+            "node_id": self.node_id,
+            "node_index": self.node_index,
+            "workers": [],
+        }
+        if include_workers:
+            targets = [h for h in self.workers.values()
+                       if h.address is not None and h.state != "DEAD"]
+
+            async def _one(handle):
+                try:
+                    return await asyncio.wait_for(
+                        self.clients.get(handle.address).call(
+                            "get_accel_report", timeout=10), 15)
+                except Exception as e:  # noqa: BLE001 — report the gap
+                    return {"worker_id": handle.worker_id.hex(),
+                            "node_id": self.node_id, "pid": handle.pid,
+                            "error": str(e)}
+            if targets:
+                report["workers"] = list(await asyncio.gather(
+                    *(_one(h) for h in targets)))
+        return report
+
     async def handle_get_node_stats(self):
         return {
             "node_id": self.node_id,

@@ -1,3 +1,4 @@
 from . import tpu
+from .flops import PEAK_FLOPS, peak_flops, peak_flops_for_kind
 
-__all__ = ["tpu"]
+__all__ = ["tpu", "PEAK_FLOPS", "peak_flops", "peak_flops_for_kind"]

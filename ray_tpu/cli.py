@@ -17,6 +17,8 @@ Usage: python -m ray_tpu.cli <command> ...
   profile  [--duration S] [--hz N] [--format F]          cluster CPU profile
            [--node ID] [--pid P] [--task T] [-o FILE]    (merged flamegraph)
   stack    [--node ID] [--json]                          fleet stack dump
+  devices  [--json]                                      per-device HBM /
+                                                         compile / step+MFU
   dashboard                                              start + print URL
   submit   [--wait] -- ENTRYPOINT...                     submit a job
   job      {logs,stop,list} [ID]
@@ -201,6 +203,25 @@ def cmd_status(args):
             print(f"  shard {row['shard']}: queue_depth="
                   f"{row['queue_depth']} submits={row['submits']} "
                   f"loop_lag={lag_txt}")
+    # Per-node accelerator rows from the device plane (chip count, HBM
+    # used/limit, compile seconds since start) — best-effort: a cluster
+    # with no accel reports (or the plane killed) just omits the block.
+    try:
+        accel = st.accel_summary(force_local_jax=False, node_timeout_s=3)
+        accel_nodes = [n for n in accel["nodes"]
+                       if n["num_devices"] or n["compiles"]]
+        if accel_nodes:
+            print("accelerators:")
+            for row in accel_nodes:
+                limit = _fmt_bytes(row["hbm_limit_bytes"]) \
+                    if row["hbm_limit_bytes"] else "?"
+                print(f"  {row['node_id'][:12]}  "
+                      f"{row['num_devices']} chips  HBM "
+                      f"{_fmt_bytes(row['hbm_used_bytes'])} / {limit}  "
+                      f"compile {row['compile_seconds']:.2f}s "
+                      f"({row['compiles']} compiles)")
+    except Exception as e:  # noqa: BLE001 — status must render anyway
+        print(f"accelerators: unavailable ({e})")
     # Per-shape pending demand with a feasibility check, so "why is my
     # task pending" is answerable from here: a shape no amount of
     # waiting can satisfy is flagged INFEASIBLE. A shape must fit on
@@ -464,6 +485,59 @@ def cmd_stack(args):
           f"({sum(1 for r in rows if r.get('error'))} unreachable)")
 
 
+def cmd_devices(args):
+    """Cluster accelerator report (the device leg of memory/profile):
+    per-device HBM used/peak/limit, XLA compile totals + top compiled
+    functions, and step/MFU telemetry per process."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    summary = st.accel_summary()
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+        return
+    comp = summary["compile"]
+    print(f"devices: {len(summary['devices'])} across "
+          f"{len(summary['nodes'])} nodes · compiles {comp['compiles']} "
+          f"({comp['compile_seconds']:.2f}s, "
+          f"cache {comp['cache_hits']} hit / "
+          f"{comp['cache_misses']} miss)")
+    header = (f"{'NODE':<14} {'PID':<7} {'DEV':<4} {'KIND':<14} "
+              f"{'HBM USED':>10} {'PEAK':>10} {'LIMIT':>10}  SOURCE")
+    print(header)
+    print("-" * len(header))
+    for dev in summary["devices"]:
+        print(f"{(dev.get('node_id') or '?')[:12]:<14} "
+              f"{dev.get('pid') or '?':<7} "
+              f"{dev['index']:<4} {dev['device_kind'][:14]:<14} "
+              f"{_fmt_bytes(dev['hbm_used_bytes']):>10} "
+              f"{_fmt_bytes(dev['hbm_peak_bytes']):>10} "
+              f"{_fmt_bytes(dev['hbm_limit_bytes']):>10}  "
+              f"{dev['source']}")
+    if summary["steps"]:
+        print("\nstep telemetry (per process, per kind):")
+        for row in summary["steps"]:
+            print(f"  {row['kind']:<14} pid {row.get('pid') or '?':<7} "
+                  f"steps={int(row['steps'])} "
+                  f"mean={row['mean_step_s'] * 1e3:.2f}ms "
+                  f"tok/s={row['tokens_per_s']:.1f} "
+                  f"mfu={row['mfu'] * 100:.1f}% "
+                  f"goodput compile/device/host="
+                  f"{row['compile_s']:.2f}/{row['device_s']:.2f}/"
+                  f"{row['host_s']:.2f}s")
+    top_fns = []
+    for proc in summary["processes"]:
+        top_fns.extend((proc.get("compile") or {}).get("per_function", ()))
+    top_fns.sort(key=lambda r: -r["seconds"])
+    if top_fns:
+        print("\ntop compiled functions by backend-compile seconds:")
+        for fn in top_fns[:10]:
+            print(f"  {fn['seconds']:>8.3f}s  x{fn['count']:<4} "
+                  f"{fn['function']}")
+    if summary["errors"]:
+        print(f"\nunreachable: "
+              f"{json.dumps(summary['errors'], default=str)}")
+
+
 def cmd_dashboard(args):
     _connect(args)
     from ray_tpu.dashboard import start_dashboard
@@ -618,6 +692,11 @@ def main(argv=None):
     p.add_argument("--json", action="store_true")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("devices")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_devices)
 
     p = sub.add_parser("dashboard")
     p.add_argument("--address")

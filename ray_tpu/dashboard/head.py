@@ -22,6 +22,9 @@ Routes:
                                       one-shot worker capture (?pid=)
   GET  /api/profile/status            fleet sampler status
   GET  /api/stacks                    fleet-wide stack dumps
+  GET  /api/devices                   cluster accelerator summary
+                                      (per-device HBM, XLA compile,
+                                      step/MFU telemetry)
   GET  /metrics                       Prometheus exposition
   GET  /-/healthz
   GET  /                              web frontend (single-page app,
@@ -216,6 +219,12 @@ class DashboardHead:
         if path == "/api/stacks":
             return self._json(st.stack_cluster(
                 node_id=query.get("node_id")))
+        if path == "/api/devices":
+            # the dashboard actor's own process stays jax-free — only
+            # workers/drivers that already run jax contribute devices;
+            # short per-node timeout so a hung raylet can't wedge the tab
+            return self._json(st.accel_summary(force_local_jax=False,
+                                               node_timeout_s=10))
 
         job_match = re.fullmatch(r"/api/jobs/([^/]*)(/logs|/stop)?", path)
         if path == "/api/jobs/" or job_match:

@@ -43,18 +43,35 @@ _server = None                             # this process's TransferServer
 _server_addr: Optional[str] = None
 _next_uuid = [1]
 _conns: Dict[str, Any] = {}                # addr -> TransferConnection
-_gauge = None
+
+
+def _build_device_object_metrics():
+    from types import SimpleNamespace
+
+    from ..util.metrics import Counter, Gauge
+    return SimpleNamespace(
+        pinned_bytes=Gauge(
+            "rtpu_device_object_pinned_bytes",
+            "HBM bytes pinned for device-resident objects "
+            "(device_put_ref + DeviceChannel staging)"),
+        pulls=Counter(
+            "rtpu_device_object_pulls_total",
+            "Runtime-to-runtime device-object pulls started by this "
+            "process"),
+        pull_bytes=Counter(
+            "rtpu_device_object_pull_bytes_total",
+            "Bytes moved by runtime-to-runtime device-object pulls"),
+    )
+
+
+from ..util.metrics import LazyMetrics  # noqa: E402 — after _build def
+
+_metrics = LazyMetrics(_build_device_object_metrics)
 
 
 def _update_gauge():
-    global _gauge
     try:
-        if _gauge is None:
-            from ..util.metrics import Gauge
-            _gauge = Gauge("rtpu_device_object_pinned_bytes",
-                           "HBM bytes pinned for device-resident objects "
-                           "(device_put_ref + DeviceChannel staging)")
-        _gauge.set(float(_accounted_bytes[0]))
+        _metrics().pinned_bytes.set(float(_accounted_bytes[0]))
     except Exception:  # noqa: BLE001 — metrics best-effort
         logger.debug("pinned-bytes gauge update failed", exc_info=True)
 
@@ -68,11 +85,15 @@ def pinned_bytes() -> int:
 def reserve_bytes(nbytes: int, timeout_s: Optional[float] = None) -> bool:
     """Backpressure gate: block until `nbytes` fits under the HBM budget
     (CONFIG.device_object_hbm_budget; 0 = unlimited). Returns False on
-    timeout — callers then spill to host instead of OOMing HBM."""
+    timeout — callers then spill to host instead of OOMing HBM, and the
+    exhaustion is published as a DEVICE_MEMORY_PRESSURE event (silent
+    degradation made a slow pipeline look healthy while every pin was
+    detouring through the host store)."""
     from .._internal.config import CONFIG
     budget = CONFIG.device_object_hbm_budget
     if timeout_s is None:
         timeout_s = CONFIG.device_object_backpressure_timeout_s
+    held = 0
     with _cond:
         if not budget:
             _accounted_bytes[0] += nbytes
@@ -80,14 +101,28 @@ def reserve_bytes(nbytes: int, timeout_s: Optional[float] = None) -> bool:
             return True
         import time as _time
         deadline = _time.monotonic() + timeout_s
+        ok = True
         while _accounted_bytes[0] + nbytes > budget:
             remaining = deadline - _time.monotonic()
             if remaining <= 0 or nbytes > budget:
-                return False
+                ok = False
+                held = _accounted_bytes[0]
+                break
             _cond.wait(remaining)
-        _accounted_bytes[0] += nbytes
-        _update_gauge()
-        return True
+        if ok:
+            _accounted_bytes[0] += nbytes
+            _update_gauge()
+            return True
+    # Emission OUTSIDE the condition lock: it is a (best-effort,
+    # bounded) GCS RPC from this user thread.
+    from .._internal import accel
+    accel.emit_pressure_event(
+        f"device-object HBM budget exhausted: {nbytes} B requested, "
+        f"{held}/{budget} B pinned after {timeout_s:g}s — spilling "
+        "to host object store",
+        fields={"requested_bytes": nbytes, "pinned_bytes": held,
+                "budget_bytes": budget, "source": "device_objects"})
+    return False
 
 
 def release_bytes(nbytes: int):
@@ -191,6 +226,9 @@ def _pull(desc: DeviceObjectDescriptor):
 
     from .._internal.core_worker import get_core_worker
 
+    metrics = _metrics()
+    metrics.pulls.inc()
+    metrics.pull_bytes.inc(desc.nbytes)
     server = _ensure_server()
     worker = get_core_worker()
     # Ask the producer to stage the array for one pull under a fresh

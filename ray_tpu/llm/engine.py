@@ -77,6 +77,9 @@ class LLMEngine:
         self.config = config
         self.model = LlamaModel(config.model)
         self.mesh = mesh
+        # accel plane: compile listeners precede this engine's compiles
+        from .._internal import accel as _accel
+        _accel.ensure_installed()
         rng = jax.random.PRNGKey(config.seed)
         if params is None:
             from ..parallel.mesh import unbox

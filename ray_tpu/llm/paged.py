@@ -168,6 +168,22 @@ class PagedLLMEngine:
         self._by_id: Dict[str, _Seq] = {}
         self._steps = 0
         self._tokens_generated = 0
+        # accelerator-plane step telemetry (StepTimer on the decode
+        # tick): decode forward ≈ 2 FLOPs per param per token. Checked
+        # once here so a killed plane costs the tick two attribute
+        # loads, nothing more.
+        from .._internal import accel as _accel
+        self._accel = _accel if not _accel.accel_disabled() else None
+        if self._accel is not None:
+            # listeners precede this engine's prefill/decode compiles
+            _accel.ensure_installed()
+        # per-tick timings fold locally and flush one aggregated report
+        # every 16 ticks — the tick itself pays a perf_counter pair
+        self._step_accum = _accel.StepAccumulator("decode") \
+            if self._accel is not None else None
+        self._num_params = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(self.params))
         model = self.model
         page_sharding = self._page_sharding
 
@@ -368,6 +384,10 @@ class PagedLLMEngine:
                   if s.request is not None]
         if active:
             finished.extend(self._decode_tick(active))
+        elif self._step_accum is not None:
+            # idle tick: flush the partial window so step telemetry
+            # never lags a drained engine by up to `every` ticks
+            self._step_accum.flush()
         self._steps += 1
         metrics = llm_metrics()
         metrics.queue_depth.set(self._pending.qsize(), tags=_GAUGE_TAGS)
@@ -616,44 +636,55 @@ class PagedLLMEngine:
             req_p = getattr(seq.request, "top_p", None)
             top_ps[i] = req_p if req_p is not None else 1.0
         self._rng, key = jax.random.split(self._rng)
-        with self._mesh_scope():
-            out, self.k_pages, self.v_pages = self._decode(
-                self.params, self.k_pages, self.v_pages,
-                jnp.asarray(block_tables), jnp.asarray(lengths),
-                jnp.asarray(tokens), key, jnp.asarray(temps),
-                jnp.asarray(top_ks), jnp.asarray(top_ps))
-        out = np.asarray(out)
-        for i in active:
-            seq = self.seqs[i]
-            token = int(out[i])
-            seq.generated.append(token)
-            seq.last_token = token
-            seq.length += 1
-            self._tokens_generated += 1
-            self._emit_token(seq, token)
-            request = seq.request
-            hit_eos = (cfg.eos_token is not None
-                       and token == cfg.eos_token)
-            capacity = len(seq.pages) * cfg.page_size
-            if hit_eos or len(seq.generated) >= request.max_new_tokens \
-                    or seq.length + 1 >= capacity \
-                    or seq.length >= cfg.max_len - 1:
-                finished.append((request, list(seq.generated)))
-                callback = getattr(request, "_done_callback", None)
-                if callback is not None:
-                    callback(request, list(seq.generated))
-                self._release(seq)
-                self.seqs[i] = _Seq()
-        metrics = llm_metrics()
-        metrics.token_latency.observe(time.monotonic() - tick_start,
-                                      tags=_TAGS)
-        metrics.decode_tokens.inc(len(active), tags=_TAGS)
-        for request, _tokens in finished:
-            metrics.requests_finished.inc(tags=dict(_TAGS, outcome="done"))
-            submit_ts = getattr(request, "_submit_ts", None)
-            if submit_ts is not None:
-                metrics.request_latency.observe(
-                    time.monotonic() - submit_ts, tags=_TAGS)
+        accel = self._accel
+        timer = accel.StepTimer(
+            "decode", tokens=len(active),
+            flops=2.0 * self._num_params * len(active),
+            sink=self._step_accum) \
+            if accel is not None else None
+        with timer if timer is not None else contextlib.nullcontext():
+            with self._mesh_scope():
+                with (timer.device() if timer is not None
+                      else contextlib.nullcontext()):
+                    out, self.k_pages, self.v_pages = self._decode(
+                        self.params, self.k_pages, self.v_pages,
+                        jnp.asarray(block_tables), jnp.asarray(lengths),
+                        jnp.asarray(tokens), key, jnp.asarray(temps),
+                        jnp.asarray(top_ks), jnp.asarray(top_ps))
+                    out = np.asarray(out)  # fences the dispatch
+            for i in active:
+                seq = self.seqs[i]
+                token = int(out[i])
+                seq.generated.append(token)
+                seq.last_token = token
+                seq.length += 1
+                self._tokens_generated += 1
+                self._emit_token(seq, token)
+                request = seq.request
+                hit_eos = (cfg.eos_token is not None
+                           and token == cfg.eos_token)
+                capacity = len(seq.pages) * cfg.page_size
+                if hit_eos \
+                        or len(seq.generated) >= request.max_new_tokens \
+                        or seq.length + 1 >= capacity \
+                        or seq.length >= cfg.max_len - 1:
+                    finished.append((request, list(seq.generated)))
+                    callback = getattr(request, "_done_callback", None)
+                    if callback is not None:
+                        callback(request, list(seq.generated))
+                    self._release(seq)
+                    self.seqs[i] = _Seq()
+            metrics = llm_metrics()
+            metrics.token_latency.observe(time.monotonic() - tick_start,
+                                          tags=_TAGS)
+            metrics.decode_tokens.inc(len(active), tags=_TAGS)
+            for request, _tokens in finished:
+                metrics.requests_finished.inc(
+                    tags=dict(_TAGS, outcome="done"))
+                submit_ts = getattr(request, "_submit_ts", None)
+                if submit_ts is not None:
+                    metrics.request_latency.observe(
+                        time.monotonic() - submit_ts, tags=_TAGS)
         return finished
 
     # -- conveniences ------------------------------------------------------
@@ -675,6 +706,8 @@ class PagedLLMEngine:
         return [results[i] for i in range(len(prompts))]
 
     def stats(self) -> Dict[str, Any]:
+        if self._step_accum is not None:
+            self._step_accum.flush()  # surfaces the partial window
         cache_bytes = (2 * self.config.model.num_layers *
                        int(np.prod(self.k_pages[0].shape)) *
                        self.k_pages[0].dtype.itemsize)

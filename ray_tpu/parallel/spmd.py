@@ -98,6 +98,10 @@ def make_train_step(loss_fn: Callable, mesh: Mesh,
     input is the first call's output — pays a full re-compile (at 7B
     scale that is minutes of XLA time for an identical program).
     """
+    # accel plane: arm XLA compile tracking before this step's (large)
+    # compile so rtpu_xla_compile_seconds_total sees it
+    from .._internal import accel
+    accel.ensure_installed()
     rules = rules if rules is not None else dict(DEFAULT_LOGICAL_AXIS_RULES)
     batch_sharding = named_sharding(mesh, batch_axes, rules)
 

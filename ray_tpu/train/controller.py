@@ -11,10 +11,13 @@ only sees metrics, checkpoints, and liveness."""
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import shutil
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class TrainController:
@@ -52,9 +55,42 @@ class TrainController:
     def report(self, rank: int, index: int, metrics: Dict[str, Any],
                checkpoint_path: Optional[str]):
         self.reports.setdefault(rank, []).append(metrics)
-        if checkpoint_path and rank == 0:
-            self._register_checkpoint(checkpoint_path)
+        if rank == 0:
+            self._fold_step_telemetry(metrics)
+            if checkpoint_path:
+                self._register_checkpoint(checkpoint_path)
         return True
+
+    def _fold_step_telemetry(self, metrics: Dict[str, Any]):
+        """Rank-0 reports that carry step timing feed the accelerator
+        plane (kind="train"): step-time histogram, tokens/s, and — when
+        the loop reports its FLOP count — the live MFU gauge. Keys are
+        conventions, not a schema: ``step_time_s``/``time_this_iter_s``
+        for wall, ``tokens``/``tokens_per_step``, ``step_flops``."""
+        try:
+            wall = metrics.get("step_time_s") \
+                or metrics.get("time_this_iter_s")
+            if not wall or float(wall) <= 0:
+                return
+            from .._internal import accel
+            flops = float(metrics.get("step_flops") or 0.0)
+            device_kind = metrics.get("device_kind")
+            if flops and not device_kind:
+                # The controller process never runs jax, so the
+                # default device-kind here is the nominal CPU entry —
+                # dividing a TPU loop's FLOPs by 1 TFLOP/s would report
+                # a >100x MFU. No denominator means no MFU, not a
+                # made-up one; tokens/s and goodput still fold.
+                flops = 0.0
+            accel.report_step(
+                "train", float(wall),
+                tokens=int(metrics.get("tokens")
+                           or metrics.get("tokens_per_step") or 0),
+                device_s=float(metrics.get("device_time_s") or 0.0),
+                flops=flops, device_kind=device_kind)
+        except Exception:  # noqa: BLE001 — telemetry must not fail a run
+            logger.debug("train step-telemetry fold failed",
+                         exc_info=True)
 
     def _register_checkpoint(self, path: str):
         self.latest_checkpoint = path

@@ -833,6 +833,131 @@ def profiling_status() -> List[Dict[str, Any]]:
     return _dedupe_by_host_pid(rows)
 
 
+# ---------------------------------------------------------------------------
+# accelerator observability plane (reference: would be `ray status -v`
+# accelerator rows + the reporter agent's GPU/TPU utilization feed; here
+# each raylet fans get_accel_report out to its workers — see
+# _internal/accel.py for the per-process snapshot/compile/step plumbing)
+# ---------------------------------------------------------------------------
+
+
+def accel_summary(force_local_jax: bool = True,
+                  node_timeout_s: float = 30.0) -> Dict[str, Any]:
+    """Cluster accelerator summary: per-process device HBM rows, XLA
+    compile tracking, and step/MFU telemetry, grouped by node.
+
+    Every node's raylet report (its workers fetched concurrently by the
+    raylet), every RUNNING driver's report, and the calling process's
+    own (with ``force_jax=True`` — the caller is asking about devices,
+    so importing jax locally is expected). Unreachable nodes/drivers
+    become error rows, not gaps. Pressure rows the local snapshot
+    surfaces are published to the GCS event log from here (user
+    thread, sync bridge)."""
+    from ..._internal import accel
+    from ..._internal.core_worker import get_core_worker
+    cw = get_core_worker()
+
+    def _node_report(node):
+        # node_timeout_s: 30 for the dedicated `cli devices` sweep;
+        # status/dashboard callers pass a short bound — one hung raylet
+        # must not stall the whole status output (the PR-6
+        # shard_summary lesson).
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "get_accel_report", timeout=node_timeout_s)
+
+    processes: List[Dict[str, Any]] = []
+    errors: List[Dict[str, Any]] = []
+    by_node: Dict[str, Dict[str, Any]] = {}
+
+    def _fold(report, node_id):
+        node = by_node.setdefault(node_id or "?", {
+            "node_id": node_id or "?", "num_devices": 0,
+            "hbm_used_bytes": 0, "hbm_limit_bytes": 0,
+            "compiles": 0, "compile_seconds": 0.0})
+        comp = report.get("compile") or {}
+        node["compiles"] += comp.get("compiles", 0)
+        node["compile_seconds"] += comp.get("compile_seconds", 0.0)
+        for dev in report.get("devices", ()):
+            node["num_devices"] += 1
+            node["hbm_used_bytes"] += dev.get("hbm_used_bytes", 0)
+            node["hbm_limit_bytes"] += dev.get("hbm_limit_bytes", 0)
+        processes.append(dict(report, node_id=node_id))
+
+    for node, report, error in _fanout(_live_nodes(), _node_report):
+        if error is not None:
+            errors.append({"node_id": node["node_id"], "error": error})
+            continue
+        for wrep in report.get("workers", ()):
+            if "error" in wrep:
+                errors.append(wrep)
+            else:
+                _fold(wrep, node["node_id"])
+    # The calling driver's own report, rendered in-process — no RPC to
+    # ourselves, and the only report allowed to force-import jax
+    # (``force_local_jax=False`` keeps lightweight callers like
+    # `cli status` from paying the jax import for a status line).
+    own = accel.accel_report(force_jax=force_local_jax)
+    own.update(mode=cw.mode, worker_id=cw.worker_id.hex()
+               if isinstance(cw.worker_id, bytes) else str(cw.worker_id),
+               node_index=cw.node_index)
+    for pressed in own.get("pressure", ()):
+        accel.emit_pressure_event(
+            f"device {pressed['device']} ({pressed['device_kind']}) HBM "
+            f"at {pressed['used_ratio']:.0%} of limit",
+            fields=dict(pressed, node_id=cw.node_id))
+    _fold(own, cw.node_id)
+    # Other RUNNING drivers, via the job table's driver addresses.
+    own_addr = tuple(cw.rpc_address) if cw.rpc_address else None
+    drivers = [j for j in _gcs().call_sync("get_all_jobs")
+               if j.get("state") == "RUNNING" and j.get("driver_address")
+               and tuple(j["driver_address"]) != own_addr]
+
+    def _driver_report(job):
+        return cw.clients.get(tuple(job["driver_address"])).call_sync(
+            "get_accel_report", timeout=5)
+
+    for job, report, error in _fanout(drivers, _driver_report):
+        if error is not None:
+            errors.append({"job_id": job.get("job_id"), "error": error})
+        else:
+            _fold(report, report.get("node_id"))
+
+    devices: List[Dict[str, Any]] = []
+    steps: List[Dict[str, Any]] = []
+    compiles = compile_seconds = cache_hits = cache_misses = 0
+    for report in processes:
+        for dev in report.get("devices", ()):
+            devices.append(dict(
+                dev, node_id=report.get("node_id"),
+                pid=report.get("pid"),
+                worker_id=report.get("worker_id")))
+        for row in report.get("steps", ()):
+            steps.append(dict(row, node_id=report.get("node_id"),
+                              pid=report.get("pid")))
+        comp = report.get("compile") or {}
+        compiles += comp.get("compiles", 0)
+        compile_seconds += comp.get("compile_seconds", 0.0)
+        cache_hits += comp.get("cache_hits", 0)
+        cache_misses += comp.get("cache_misses", 0)
+    devices.sort(key=lambda r: -(r.get("hbm_used_bytes") or 0))
+    steps.sort(key=lambda r: -(r.get("wall_s") or 0))
+    return {
+        "nodes": sorted(by_node.values(), key=lambda n: n["node_id"]),
+        "devices": devices,
+        "steps": steps,
+        "compile": {
+            "compiles": compiles,
+            "compile_seconds": round(compile_seconds, 6),
+            "cache_hits": cache_hits,
+            "cache_misses": cache_misses,
+        },
+        "processes": [{k: v for k, v in rep.items()
+                       if k not in ("devices", "steps")}
+                      for rep in processes],
+        "errors": errors,
+    }
+
+
 def list_events(event_type: Optional[str] = None,
                 since: Optional[float] = None,
                 severity: Optional[str] = None,
