@@ -142,7 +142,8 @@ def _attach_log_stream(worker):
             else sys.stdout
         pid = message.get("pid")
         for line in message.get("lines", ()):
-            print(f"(pid={pid}) {line}", file=stream)
+            print(f"(pid={pid}) {line}",  # stdout ok: log stream
+                  file=stream)
         try:
             stream.flush()
         except (ValueError, OSError):

@@ -148,8 +148,29 @@ _DEFAULTS: Dict[str, Any] = {
     "accel_pressure_min_interval_s": 30.0,
     # --- task events (reference: RAY_task_events_* flags) ---
     "enable_task_events": True,
-    # --- logging ---
+    # --- logging / the log & forensics plane ---
     "log_to_driver": True,
+    # Per-worker bounded log ring at the raylet (lines; overflow drops
+    # the oldest and counts it). Rings retain output even with
+    # log_to_driver off — the ring IS the retention layer.
+    "log_ring_lines": 2000,
+    # Dead workers' rings kept (FIFO) so `cli logs --task` and
+    # postmortems still answer after the process is gone.
+    "log_ring_dead_workers": 16,
+    # Max concurrently in-flight WORKER_LOGS publishes per raylet: with
+    # the GCS down/slow, batches beyond the window drop-with-counter
+    # instead of queueing unboundedly on the EventLoopThread.
+    "log_pump_inflight_max": 16,
+    # Per-worker forwarding rate limit (lines/s; 0 = unlimited). Gates
+    # pubsub streaming only — the bounded ring always captures.
+    "log_rate_limit_lines_per_s": 0.0,
+    # Lines of a dead worker's ring quoted in its postmortem report.
+    "postmortem_tail_lines": 20,
+    # How long a caller waits for the raylet's death report to reach
+    # the GCS before raising WorkerCrashedError without a postmortem
+    # (the liveness sweep runs every worker_liveness_check_period_s,
+    # so the report usually lags the connection drop by ~1s).
+    "postmortem_fetch_timeout_s": 2.0,
     # --- train ---
     "train_health_check_interval_s": 1.0,
     # --- A/B kill switches (every switch lives here so a typo'd
@@ -170,6 +191,10 @@ _DEFAULTS: Dict[str, Any] = {
     # jax.monitoring listeners installed, device snapshots return
     # empty, StepTimer/report_step are no-ops.
     "no_accel_metrics": False,
+    # Kill switch for the log & forensics plane: no stream stamping in
+    # workers, no raylet rings, exact-legacy pump wiring (DEVNULL with
+    # log_to_driver off), no postmortem assembly — zero extra threads.
+    "no_log_plane": False,
     # --- overrides re-read from the environment at their use site
     # (tests monkeypatch them after CONFIG construction; registered here
     # so L003 can resolve the names) ---

@@ -1011,10 +1011,16 @@ class NormalTaskSubmitter:
         except Exception as e:
             # Worker died or became unreachable — a system failure.
             self._drop_lease(lease)
+            if isinstance(e, WorkerCrashedError):
+                # probe verdict: already carries the postmortem
+                err = e
+            else:
+                err = WorkerCrashedError(
+                    f"worker {lease.worker_address} failed: {e}",
+                    postmortem=await self._cw.fetch_worker_postmortem(
+                        lease.worker_id))
             self._cw.task_manager.on_failed(
-                spec, WorkerCrashedError(
-                    f"worker {lease.worker_address} failed: {e}"),
-                is_application_error=False)
+                spec, err, is_application_error=False)
             return
         finally:
             self._running.pop(spec.task_id, None)
@@ -1084,7 +1090,12 @@ class NormalTaskSubmitter:
                 runtime_metrics().push_recovered.inc()
                 return ps.recovered
             if ps.crashed is not None:
-                raise WorkerCrashedError(ps.crashed) from None
+                # inner push future was cancelled (not this coroutine) —
+                # awaiting the postmortem fetch here is safe
+                raise WorkerCrashedError(
+                    ps.crashed,
+                    postmortem=await self._cw.fetch_worker_postmortem(
+                        ps.lease.worker_id)) from None
             raise
         finally:
             self._probed.pop(spec.task_id, None)
@@ -2380,7 +2391,8 @@ class TaskExecutor:
                 record_child_span(
                     "task:" + (spec.name or spec.method_name
                                or spec.function.display_name()),
-                    tuple(spec.trace_context), span_start, time.time())
+                    tuple(spec.trace_context), span_start, time.time(),
+                    task_id=spec.task_id.hex())
             RUNTIME_CTX.task_spec = None
             RUNTIME_CTX.actor_id = None
             profiler.clear_task()
@@ -2475,7 +2487,8 @@ class TaskExecutor:
                 record_child_span(
                     "task:" + (spec.name or spec.method_name
                                or spec.function.display_name()),
-                    tuple(spec.trace_context), span_start, time.time())
+                    tuple(spec.trace_context), span_start, time.time(),
+                    task_id=spec.task_id.hex())
 
     def _setup_actor(self, spec: TaskSpec):
         # adopt the creating job: background asyncio work this actor
@@ -2703,6 +2716,34 @@ class CoreWorker:
         cfut = asyncio.run_coroutine_threadsafe(
             self.gcs.call(method, **kwargs), main_loop)
         return await asyncio.wrap_future(cfut)
+
+    async def fetch_worker_postmortem(self, worker_id) -> Optional[dict]:
+        """Brief poll for a dead worker's postmortem (log & forensics
+        plane): the raylet's liveness sweep reports the death up to
+        ~1s after the caller's push fails, so WorkerCrashedError
+        construction waits a bounded window for the report rather than
+        raising without the worker's last words. Returns None on
+        timeout, GCS trouble, or under the kill switch."""
+        if CONFIG.no_log_plane:
+            return None
+        whex = worker_id.hex() if isinstance(worker_id, bytes) \
+            else str(worker_id)
+        deadline = time.monotonic() + CONFIG.postmortem_fetch_timeout_s
+        while True:
+            # per-call timeout stays inside the overall budget: a slow
+            # GCS must not stretch the documented bound on raising
+            remaining = deadline - time.monotonic()
+            try:
+                pm = await self.gcs_call("get_worker_postmortem",
+                                         worker_hex=whex,
+                                         timeout=max(0.25, remaining))
+            except Exception:
+                logger.debug("postmortem fetch for %s failed", whex[:12],
+                             exc_info=True)
+                return None
+            if pm is not None or time.monotonic() >= deadline:
+                return pm
+            await asyncio.sleep(0.25)
 
     async def ensure_actor_subscribed(self):
         """ONE GCS actor-pubsub subscription per process, establishable

@@ -79,7 +79,33 @@ class TaskError(RayTpuError):
 
 
 class WorkerCrashedError(RayTpuError):
-    """The worker process executing the task died unexpectedly."""
+    """The worker process executing the task died unexpectedly.
+
+    When the log & forensics plane is on, ``postmortem`` carries the
+    raylet-assembled report for the dead worker (exit-code/signal
+    taxonomy, its last captured log lines, recent task ids, a stack
+    dump pointer) and the rendered report is appended to the message —
+    the worker's last words arrive in the caller's exception."""
+
+    def __init__(self, message: str = "",
+                 postmortem: Optional[dict] = None):
+        self.postmortem = postmortem
+        if postmortem:
+            from .logplane import render_postmortem
+            message = f"{message}\n{render_postmortem(postmortem)}"
+        super().__init__(message)
+
+    def __reduce__(self):
+        # rebuild from the FORMATTED message (postmortem already
+        # rendered in) + keep the structured dict across the boundary
+        return (_rebuild_worker_crashed,
+                (self.args[0] if self.args else "", self.postmortem))
+
+
+def _rebuild_worker_crashed(message: str, postmortem):
+    err = WorkerCrashedError(message)
+    err.postmortem = postmortem
+    return err
 
 
 class TaskCancelledError(RayTpuError):

@@ -159,6 +159,12 @@ class GcsServer:
         # (reference: node_manager HandleJobFinished kills job workers)
         self._finished_jobs: Dict[str, float] = {}
         self._last_driver_sweep = 0.0
+        # Worker postmortems (log & forensics plane): worker hex -> the
+        # raylet-assembled report (exit taxonomy, last captured lines,
+        # stack dump pointer). Bounded FIFO — crashing callers fetch by
+        # the worker_id their dead lease named, shortly after death.
+        self.worker_postmortems: "collections.OrderedDict[str, Dict]" = \
+            collections.OrderedDict()
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -1035,17 +1041,43 @@ class GcsServer:
         return True
 
     async def handle_report_worker_death(self, node_id: str, worker_id: bytes,
-                                         cause: str):
-        """Raylet tells us a worker process died; fail any actor on it."""
+                                         cause: str,
+                                         postmortem: Optional[Dict[str,
+                                                                   Any]]
+                                         = None):
+        """Raylet tells us a worker process died; fail any actor on it.
+        The raylet's postmortem (exit taxonomy + last captured lines)
+        is retained for crashing callers (`get_worker_postmortem`),
+        attached to the WORKER_DIED event, and folded into the death
+        cause so ActorDiedError carries the actor's last words."""
+        from . import logplane
+        whex = worker_id.hex()
+        summary = logplane.summarize_postmortem(postmortem)
+        exit_info = (postmortem or {}).get("exit") or {}
+        if postmortem is not None:
+            self.worker_postmortems[whex] = postmortem
+            while len(self.worker_postmortems) > 200:
+                self.worker_postmortems.popitem(last=False)
         self.add_event("WORKER_DIED",
-                       f"worker {worker_id.hex()[:12]} on node "
-                       f"{node_id[:12]} died: {cause}",
+                       f"worker {whex[:12]} on node "
+                       f"{node_id[:12]} died: {cause}"
+                       + (f" ({summary})" if summary else ""),
                        severity="WARNING", node_id=node_id,
-                       worker_id=worker_id.hex(), cause=cause)
+                       worker_id=whex, cause=cause,
+                       exit_kind=exit_info.get("kind"),
+                       postmortem=postmortem)
+        if summary:
+            cause = f"{cause} ({summary})"
         for record in list(self.actors.values()):
             if record.worker_id == worker_id and record.state == "ALIVE":
                 await self._handle_actor_failure(record, cause)
         return True
+
+    async def handle_get_worker_postmortem(self, worker_hex: str):
+        """The retained postmortem of one dead worker (None while the
+        raylet's death report has not landed yet — callers poll
+        briefly)."""
+        return self.worker_postmortems.get(worker_hex)
 
     async def _kill_actor(self, record: ActorRecord, cause: str,
                           no_restart: bool):

@@ -33,6 +33,12 @@ L007 loop/shard hygiene: ``asyncio.get_event_loop()`` is banned in
      handle); and every cross-object read of a ``# shard-local``
      registered table (the loop-confined owner-shard dicts) must carry
      a ``# cross-shard ok: <why>`` justification on the same line
+L008 logging hygiene: bare ``print()`` in ``_internal/`` (outside
+     ``__main__`` entrypoints) bypasses the log plane's attribution
+     and ring capture — use the structured logger or annotate the line
+     ``# stdout ok: <why>``; ``logging.getLogger`` must take
+     ``__name__`` (or no arg for root), and the module-level handle is
+     named ``logger``
 ==== =====================================================================
 
 Violations report ``file:line`` and carry a stable allowlist key
@@ -240,7 +246,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
         prog="rtpulint",
-        description="ray_tpu project lint (rules L001-L007)")
+        description="ray_tpu project lint (rules L001-L008)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     parser.add_argument("--root", default=None,
@@ -252,5 +258,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
     report = run_lint(root=args.root, allowlist_path=args.allowlist,
                       use_allowlist=not args.no_allowlist)
-    print(report.to_json() if args.json else report.render())
+    print(report.to_json() if args.json  # stdout ok: CLI output
+          else report.render())
     return 0 if report.ok else 1

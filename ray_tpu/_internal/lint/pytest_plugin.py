@@ -23,7 +23,7 @@ from . import sanitizer
 
 SANITIZED_TEST_MODULES = ("test_actor_storm", "test_push_recovery",
                           "test_flat_codec", "test_profiling",
-                          "test_owner_shards")
+                          "test_owner_shards", "test_log_plane")
 
 _env_armed = False
 _ever_armed = False

@@ -122,6 +122,12 @@ _L007_DIR = "ray_tpu/_internal/"
 _SHARD_LOCAL_MARK = "# shard-local"
 _CROSS_SHARD_MARK = "# cross-shard ok"
 
+# L008: logging hygiene — _internal/ output goes through the structured
+# logger (the log plane stamps and retains it); a bare print() bypasses
+# attribution and ring capture. __main__ entrypoints and explicitly
+# annotated protocol/CLI writes are exempt.
+_STDOUT_OK_MARK = "# stdout ok"
+
 
 def _dotted(node: ast.AST) -> Optional[str]:
     """Render a Name/Attribute chain as "a.b.c" (None if not a chain)."""
@@ -202,6 +208,7 @@ class _Linter(ast.NodeVisitor):
         self._is_threads_helper = path == _THREADS_HELPER_FILE
         self._is_config = path == "ray_tpu/_internal/config.py"
         self._internal = path.startswith(_L007_DIR)
+        self._is_main_entry = path.endswith("__main__.py")
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -335,7 +342,29 @@ class _Linter(ast.NodeVisitor):
     def visit_Assign(self, node: ast.Assign):
         for target in node.targets:
             self._maybe_shard_decl(node, target)
+        self._check_logger_naming(node)
         self.generic_visit(node)
+
+    def _check_logger_naming(self, node: ast.Assign):
+        """L008c: the module-level ``logging.getLogger(__name__)``
+        handle is named ``logger`` everywhere in _internal/ — one
+        spelling for greps, docs, and the log plane's conventions."""
+        if not self._internal or len(self._scopes) > 1:
+            return
+        value = node.value
+        if isinstance(value, ast.Call) \
+                and _dotted(value.func) in ("logging.getLogger",
+                                            "getLogger") \
+                and value.args \
+                and isinstance(value.args[0], ast.Name) \
+                and value.args[0].id == "__name__":
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id != "logger":
+                    self._emit(
+                        "L008", node,
+                        f"module-level logger handle named "
+                        f"{target.id!r} — the convention is `logger = "
+                        "logging.getLogger(__name__)`")
 
     def visit_AnnAssign(self, node: ast.AnnAssign):
         self._maybe_shard_decl(node, node.target)
@@ -429,6 +458,33 @@ class _Linter(ast.NodeVisitor):
                        "asyncio.get_running_loop(), an explicit loop "
                        "handle (CoreWorker._serve_loop / OwnerShard."
                        "loop), or the shard mailbox")
+
+        # L008a: bare print() in _internal/ — output must go through
+        # the structured logger (stamped + ring-captured by the log
+        # plane) or carry an explicit `# stdout ok:` annotation.
+        if self._internal and not self._is_main_entry \
+                and isinstance(node.func, ast.Name) \
+                and node.func.id == "print" \
+                and not self._line_marked(node, _STDOUT_OK_MARK):
+            self._emit("L008", node,
+                       "bare print() in _internal/ bypasses log "
+                       "attribution and ring capture — use "
+                       "logger.<level>(), or annotate the line "
+                       "`# stdout ok: <why this is protocol/CLI "
+                       "output>`")
+
+        # L008b: loggers must be module-named — getLogger with a
+        # literal breaks per-module filtering and the __name__
+        # convention the log plane documents.
+        if self._internal and term == "getLogger" \
+                and dotted in ("logging.getLogger", "getLogger") \
+                and node.args \
+                and not (isinstance(node.args[0], ast.Name)
+                         and node.args[0].id == "__name__"):
+            self._emit("L008", node,
+                       "logging.getLogger() in _internal/ must be "
+                       "getLogger(__name__) (or argless for the root "
+                       "logger)")
 
         # L006: pickler on a hot-path module
         if self._hot_path and term in ("dumps", "loads") \

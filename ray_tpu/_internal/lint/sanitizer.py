@@ -286,7 +286,9 @@ def render_report(rep: Optional[dict] = None) -> str:
 def _exit_report():
     rep = report()
     if rep["cycles"] or rep["blocked_while_holding"]:
-        print(render_report(rep), file=sys.stderr, flush=True)
+        # atexit report: logging may already be torn down
+        print(render_report(rep),  # stdout ok: atexit report
+              file=sys.stderr, flush=True)
 
 
 def enable_from_env():

@@ -1,0 +1,594 @@
+"""Fleet log & failure-forensics plane: the fifth observability leg
+(PR 1 time, PR 3 memory, PR 5 CPU, PR 7 accelerator, this module LOGS).
+
+Three layers (reference: _private/log_monitor.py + the dashboard log
+view + state API ``list_logs``/``get_log``):
+
+* **Capture** — a worker process stamps every stdout/stderr line and
+  every ``logging`` record with its attribution ``(task_id, actor_id,
+  job, level)`` before the bytes hit the pipe (the raylet already knows
+  node/pid). The stamp rides as an in-band prefix the raylet's log pump
+  strips, so driver-visible output is unchanged. Attribution reuses the
+  executor thread→spec registry the profiler maintains
+  (:data:`profiler._CURRENT_TASKS`), so a ``print()`` inside a task
+  body carries that task's id with zero extra per-task bookkeeping.
+
+* **Retention** — the raylet keeps a bounded per-worker
+  :class:`LogRing` (size-capped deque + drop counter), so lines are
+  retained and queryable cluster-wide *even with* ``log_to_driver``
+  *off* (the old DEVNULL path becomes ring-only capture; pubsub
+  forwarding to drivers stays the opt-in streaming path).
+
+* **Forensics** — on worker death the raylet assembles a postmortem:
+  exit-code/signal taxonomy (:func:`classify_exit` — OOM-kill,
+  segfault, ``sys.exit``, uncaught exception), the ring's last N lines,
+  the stuck-task stack-dump file if one was captured, and recently seen
+  task ids. The report lands on the ``WORKER_DIED`` GCS event and is
+  threaded into the :class:`~.errors.WorkerCrashedError` /
+  ``ActorDiedError`` raised to callers, so a dead worker's last words
+  arrive *in the driver's exception*.
+
+Kill switch: ``RTPU_NO_LOG_PLANE=1`` — no stream wrappers, no rings,
+exact-legacy pump wiring (DEVNULL when ``log_to_driver`` is off), zero
+extra threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import sys
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+# In-band stamp framing: \x1d (ASCII group separator — never produced
+# by normal text output) brackets the attribution fields.
+#   \x1d<task>|<actor>|<job>|<LEVEL>\x1d<message>
+# Empty fields are omitted but the pipes stay, so parsing is a fixed
+# 2-split + 3-partition with no regex on the hot path.
+STAMP_SEP = "\x1d"
+
+_LEVEL_RANK = {"DEBUG": 10, "INFO": 20, "WARNING": 30, "ERROR": 40,
+               "CRITICAL": 50}
+
+
+def level_rank(level: Optional[str]) -> int:
+    return _LEVEL_RANK.get((level or "INFO").upper(), 20)
+
+
+def plane_disabled() -> bool:
+    return CONFIG.no_log_plane
+
+
+# ---------------------------------------------------------------------------
+# worker-side capture: stamp attribution onto every line
+# ---------------------------------------------------------------------------
+
+
+def current_attribution() -> Tuple[str, str, str]:
+    """``(task_hex, actor_hex, job_hex)`` of the task executing on the
+    CALLING thread ("" when idle). Reads the profiler's executor
+    registry racily — same tolerance as stack sampling: a recycled spec
+    can at worst mis-attribute one line."""
+    from . import profiler
+    spec = profiler._CURRENT_TASKS.get(threading.get_ident())
+    if spec is None:
+        return ("", "", "")
+    try:
+        task = spec.task_id.hex()
+        actor = spec.actor_id.hex() if spec.actor_id is not None else ""
+        job = spec.job_id.hex() if spec.job_id is not None else ""
+        return (task, actor, job)
+    except Exception:  # noqa: BLE001 — racing a freelist recycle
+        return ("", "", "")
+
+
+def stamp_line(line: str, level: str) -> str:
+    task, actor, job = current_attribution()
+    return f"{STAMP_SEP}{task}|{actor}|{job}|{level}{STAMP_SEP}{line}"
+
+
+def parse_line(raw: str) -> Tuple[Dict[str, Optional[str]], str]:
+    """Split one pumped line into ``(attribution, message)``. Unstamped
+    lines (faulthandler writing to fd 2, subprocesses the task spawned)
+    come back with empty attribution."""
+    if not raw.startswith(STAMP_SEP):
+        return ({"task": None, "actor": None, "job": None,
+                 "level": None}, raw)
+    end = raw.find(STAMP_SEP, 1)
+    if end < 0:
+        return ({"task": None, "actor": None, "job": None,
+                 "level": None}, raw)
+    fields = raw[1:end].split("|")
+    if len(fields) != 4:
+        return ({"task": None, "actor": None, "job": None,
+                 "level": None}, raw)
+    task, actor, job, level = fields
+    return ({"task": task or None, "actor": actor or None,
+             "job": job or None, "level": level or None}, raw[end + 1:])
+
+
+class _StampingStream:
+    """TextIO proxy over the worker's real stdout/stderr: buffers until
+    a newline, then writes the stamped line through in ONE underlying
+    write (pipe writes under PIPE_BUF are atomic, so concurrently
+    printing threads don't shear each other's stamps)."""
+
+    def __init__(self, raw, default_level: str):
+        self._raw = raw
+        self._level = default_level
+        self._pending = ""
+        # flush() emitted a STAMPED partial line whose newline has not
+        # arrived yet: the continuation must go out raw (no second
+        # stamp), or the pump's line reassembly would leave stamp bytes
+        # embedded mid-message.
+        self._midline = False
+        self._lock = threading.Lock()
+
+    def write(self, text) -> int:
+        if not isinstance(text, str):
+            text = str(text)
+        with self._lock:
+            self._pending += text
+            if "\n" not in self._pending:
+                return len(text)
+            *lines, self._pending = self._pending.split("\n")
+            parts = []
+            for line in lines:
+                if self._midline:
+                    parts.append(line + "\n")  # completes a flushed stamp
+                    self._midline = False
+                else:
+                    parts.append(stamp_line(line, self._level) + "\n")
+            out = "".join(parts)
+        try:
+            self._raw.write(out)
+            self._raw.flush()
+        except (ValueError, OSError):
+            logger.debug("stamped write to closed stream dropped",
+                         exc_info=True)
+        return len(text)
+
+    def flush(self):
+        with self._lock:
+            pending, self._pending = self._pending, ""
+            if pending:
+                # progress output (print(..., end="", flush=True)) goes
+                # through now; the eventual newline (or the next flush)
+                # continues this SAME stamped line raw
+                pending = pending if self._midline \
+                    else stamp_line(pending, self._level)
+                self._midline = True
+        try:
+            if pending:
+                self._raw.write(pending)
+            self._raw.flush()
+        except (ValueError, OSError):
+            logger.debug("stamped flush to closed stream dropped",
+                         exc_info=True)
+
+    def fileno(self):
+        return self._raw.fileno()
+
+    def isatty(self):
+        return False
+
+    @property
+    def raw(self):
+        return self._raw
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+
+class _StampingLogHandler(logging.Handler):
+    """Root handler for worker processes: stamps each record with its
+    REAL level (a raw ``print`` only gets the stream default) and
+    writes to the ORIGINAL stderr, bypassing the stream wrapper so log
+    records are never double-stamped."""
+
+    def __init__(self, raw_stderr):
+        super().__init__()
+        self._raw = raw_stderr
+        # the format worker_main.basicConfig used before this plane
+        self.setFormatter(logging.Formatter(
+            "[worker %(process)d] %(levelname)s %(name)s: %(message)s"))
+
+    def emit(self, record):
+        try:
+            text = self.format(record)
+            out = "".join(stamp_line(line, record.levelname) + "\n"
+                          for line in text.split("\n"))
+            self._raw.write(out)
+            self._raw.flush()
+        except (ValueError, OSError):
+            pass  # closed stream at teardown — nowhere left to log to
+        except Exception:  # noqa: BLE001 — logging must never raise
+            self.handleError(record)
+
+
+def install_worker_capture() -> bool:
+    """Arm stdout/stderr stamping + the level-stamping root log handler
+    in a WORKER process (called from worker_main before basicConfig —
+    root gaining a handler here turns that basicConfig into a no-op).
+    Idempotent; refuses under the kill switch."""
+    if plane_disabled():
+        return False
+    if isinstance(sys.stdout, _StampingStream):
+        return True
+    raw_stderr = sys.stderr
+    sys.stdout = _StampingStream(sys.stdout, "INFO")
+    sys.stderr = _StampingStream(raw_stderr, "ERROR")
+    root = logging.getLogger()
+    root.addHandler(_StampingLogHandler(raw_stderr))
+    if root.level == logging.WARNING:  # unconfigured default
+        root.setLevel(logging.INFO)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# raylet-side retention: bounded per-worker rings
+# ---------------------------------------------------------------------------
+
+
+class LogRing:
+    """Bounded per-worker line ring. Appends come from the TWO pump
+    threads (stdout + stderr share one ring), reads from the raylet's
+    io loop — appends serialize on a lock so ``seq`` stays strictly
+    monotonic and the byte accounting exact; a read racing an append
+    can at worst miss the line being appended (the follower's next
+    poll gets it by seq).
+
+    Every entry carries a monotonically increasing ``seq``, the
+    follow-cursor: ``query(since_seq=s)`` returns exactly the entries a
+    previous reply's cursor has not seen, across overflow drops.
+    """
+
+    def __init__(self, worker_hex: str, pid: int, maxlen: int,
+                 job: Optional[str] = None):
+        self.worker_hex = worker_hex
+        self.pid = pid
+        self.job = job
+        self.alive = True
+        self._ring: deque = deque(maxlen=max(16, int(maxlen)))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._overflow_unreported = 0
+        self.dropped = 0
+        self.bytes = 0          # bytes currently resident in the ring
+        self.lines_total = 0
+        self.first_ts: Optional[float] = None
+        self.last_ts: Optional[float] = None
+
+    def append(self, stream: str, level: Optional[str], line: str,
+               task: Optional[str] = None, actor: Optional[str] = None,
+               job: Optional[str] = None) -> Dict[str, Any]:
+        now = time.time()
+        with self._lock:
+            self._seq += 1
+            entry = {"seq": self._seq, "ts": now, "stream": stream,
+                     "level": level or ("ERROR" if stream == "stderr"
+                                        else "INFO"),
+                     "line": line, "task": task, "actor": actor,
+                     "job": job or self.job, "pid": self.pid,
+                     "worker_id": self.worker_hex}
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+                self._overflow_unreported += 1
+                self.bytes -= len(self._ring[0]["line"])
+            self._ring.append(entry)
+            self.bytes += len(line)
+            self.lines_total += 1
+            if self.first_ts is None:
+                self.first_ts = now
+            self.last_ts = now
+        return entry
+
+    def take_overflow_delta(self) -> int:
+        """Overflow drops since the last call (the pump reports them to
+        the rtpu_log_dropped_lines_total{reason="ring_overflow"} series
+        — exactly-once across the two pump threads via the lock)."""
+        with self._lock:
+            n, self._overflow_unreported = self._overflow_unreported, 0
+        return n
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def next_seq(self) -> int:
+        return self._seq
+
+    def query(self, job: Optional[str] = None, task: Optional[str] = None,
+              actor: Optional[str] = None, level: Optional[str] = None,
+              grep: Optional[str] = None, since_seq: int = 0,
+              limit: int = 10_000) -> List[Dict[str, Any]]:
+        """Filtered entries with ``seq > since_seq`` (oldest first).
+        ``task``/``actor`` match on hex prefix; ``level`` keeps entries
+        at-or-above that severity; ``grep`` is an ``re.search`` over the
+        message."""
+        pattern = re.compile(grep) if grep else None
+        min_rank = level_rank(level) if level else 0
+        out: List[Dict[str, Any]] = []
+        for entry in list(self._ring):
+            if entry["seq"] <= since_seq:
+                continue
+            if job and entry.get("job") != job:
+                continue
+            if task and not (entry.get("task") or "").startswith(task):
+                continue
+            if actor and not (entry.get("actor") or "").startswith(actor):
+                continue
+            if min_rank and level_rank(entry.get("level")) < min_rank:
+                continue
+            if pattern is not None and not pattern.search(entry["line"]):
+                continue
+            out.append(entry)
+            if len(out) >= limit:
+                break
+        return out
+
+    def meta(self) -> Dict[str, Any]:
+        return {"worker_id": self.worker_hex, "pid": self.pid,
+                "job": self.job, "alive": self.alive,
+                "lines": len(self._ring),
+                "lines_total": self.lines_total,
+                "dropped": self.dropped, "bytes": self.bytes,
+                "first_ts": self.first_ts, "last_ts": self.last_ts}
+
+    def tail(self, n: int) -> List[Dict[str, Any]]:
+        if n <= 0:
+            return []
+        return list(self._ring)[-n:]
+
+    def recent_tasks(self, n: int = 5) -> List[str]:
+        """Most recently seen distinct task ids, newest first — the
+        in-flight-task approximation for postmortems (the raylet never
+        sees pushes, only the lines they emit)."""
+        seen: List[str] = []
+        for entry in reversed(self._ring):
+            task = entry.get("task")
+            if task and task not in seen:
+                seen.append(task)
+                if len(seen) >= n:
+                    break
+        return seen
+
+
+class RingSet:
+    """The raylet's per-worker rings: live rings keyed by worker hex,
+    plus a bounded FIFO of dead workers' rings so `cli logs --task`
+    still answers after the process is gone (the postmortem window)."""
+
+    def __init__(self):
+        self.live: Dict[str, LogRing] = {}
+        self.dead: "OrderedDict[str, LogRing]" = OrderedDict()
+
+    def get_or_create(self, worker_hex: str, pid: int,
+                      job: Optional[str] = None) -> LogRing:
+        ring = self.live.get(worker_hex)
+        if ring is None:
+            ring = LogRing(worker_hex, pid, CONFIG.log_ring_lines, job=job)
+            self.live[worker_hex] = ring
+        return ring
+
+    def retire(self, worker_hex: str):
+        ring = self.live.pop(worker_hex, None)
+        if ring is None:
+            return
+        ring.alive = False
+        self.dead[worker_hex] = ring
+        while len(self.dead) > CONFIG.log_ring_dead_workers:
+            self.dead.popitem(last=False)
+
+    def all_rings(self) -> List[LogRing]:
+        return list(self.live.values()) + list(self.dead.values())
+
+    def total_bytes(self) -> int:
+        return sum(r.bytes for r in self.all_rings())
+
+
+# ---------------------------------------------------------------------------
+# publish backpressure (the log pump's flush window)
+# ---------------------------------------------------------------------------
+
+
+class PublishWindow:
+    """Bounds in-flight log publishes to the GCS. The pump's flush used
+    to post one ``gcs.call`` per batch with NO backpressure — with the
+    GCS down/slow, batches queued unboundedly on the EventLoopThread.
+    Now a batch only posts while fewer than ``max_inflight`` publishes
+    are outstanding; beyond the window it is DROPPED and counted, and
+    the first drop of each stall logs once (rate-limited)."""
+
+    def __init__(self, max_inflight: int):
+        self.max_inflight = max(1, int(max_inflight))
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self.dropped_batches = 0
+        self.dropped_lines = 0
+        self._last_warn = 0.0
+
+    def try_acquire(self, lines: int = 0) -> bool:
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                self.dropped_batches += 1
+                self.dropped_lines += lines
+                now = time.monotonic()
+                if now - self._last_warn > 30.0:
+                    self._last_warn = now
+                    logger.warning(
+                        "log publish window full (%d in flight): dropping "
+                        "batches (%d lines dropped so far) — GCS slow or "
+                        "unreachable", self._inflight, self.dropped_lines)
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self):
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+
+class RateLimiter:
+    """Per-worker token bucket for runaway loggers (``lines_per_s <= 0``
+    disables). Gates pubsub FORWARDING only — the bounded ring always
+    captures, so forensics survive a log storm that streaming drops.
+    Shared by the worker's two pump threads, hence the lock."""
+
+    def __init__(self, lines_per_s: float):
+        self.rate = float(lines_per_s)
+        self._allowance = self.rate
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def allow(self, n: int = 1) -> bool:
+        if self.rate <= 0:
+            return True
+        with self._lock:
+            now = time.monotonic()
+            self._allowance = min(
+                self.rate,
+                self._allowance + (now - self._last) * self.rate)
+            self._last = now
+            if self._allowance < n:
+                self.dropped += n
+                return False
+            self._allowance -= n
+            return True
+
+
+# ---------------------------------------------------------------------------
+# failure forensics: exit taxonomy + postmortem reports
+# ---------------------------------------------------------------------------
+
+_SIGNAL_NAMES = {1: "SIGHUP", 2: "SIGINT", 4: "SIGILL", 6: "SIGABRT",
+                 7: "SIGBUS", 8: "SIGFPE", 9: "SIGKILL", 11: "SIGSEGV",
+                 13: "SIGPIPE", 15: "SIGTERM"}
+
+
+def classify_exit(returncode: Optional[int],
+                  last_lines: Optional[List[str]] = None,
+                  kill_reason: Optional[str] = None) -> Dict[str, str]:
+    """Exit-code/signal taxonomy for a dead worker process.
+
+    ``kill_reason`` is the raylet's own annotation when IT delivered
+    the kill (the memory watchdog) — a SIGKILL the raylet sent for
+    memory is ``OOM_KILLED`` with certainty, while a foreign SIGKILL
+    can only be flagged as *possibly* the kernel OOM killer."""
+    lines = last_lines or []
+    if returncode is None:
+        return {"kind": "UNKNOWN", "detail": "no exit status collected"}
+    if returncode < 0:
+        sig = -returncode
+        name = _SIGNAL_NAMES.get(sig, f"signal {sig}")
+        if sig == 9:
+            if kill_reason == "memory":
+                return {"kind": "OOM_KILLED",
+                        "detail": "SIGKILL by the node memory watchdog"}
+            return {"kind": "SIGKILL",
+                    "detail": "SIGKILL (kernel OOM killer, ray_tpu.kill,"
+                              " or an external kill -9)"}
+        if sig == 11:
+            return {"kind": "SEGFAULT",
+                    "detail": "SIGSEGV — native crash (check the stack "
+                              "dump / last stderr lines)"}
+        return {"kind": name, "detail": f"terminated by {name}"}
+    if returncode == 0:
+        return {"kind": "CLEAN_EXIT", "detail": "exit code 0"}
+    if any("Traceback (most recent call last)" in line
+           for line in lines):
+        return {"kind": "UNCAUGHT_EXCEPTION",
+                "detail": f"exit code {returncode} with a traceback in "
+                          "the last captured lines"}
+    return {"kind": "SYS_EXIT",
+            "detail": f"exit code {returncode} (sys.exit or fatal "
+                      "runtime error)"}
+
+
+def build_postmortem(*, worker_hex: str, pid: int, node_id: str,
+                     returncode: Optional[int], ring: Optional[LogRing],
+                     kill_reason: Optional[str] = None,
+                     cause: str = "") -> Dict[str, Any]:
+    """Assemble one worker's postmortem: taxonomy, the ring's last N
+    lines, recent task ids, and the stuck-task stack-dump file when
+    the probe sweeper captured one (core_worker._probe_one writes
+    /tmp/rtpu-stuck-<task8>.txt; the file survives the processes)."""
+    tail_n = CONFIG.postmortem_tail_lines
+    entries = ring.tail(tail_n) if ring is not None else []
+    lines = [f"[{e['stream']} {e.get('level') or '?'}"
+             + (f" task={e['task'][:12]}" if e.get("task") else "")
+             + f"] {e['line']}" for e in entries]
+    tasks = ring.recent_tasks() if ring is not None else []
+    pm: Dict[str, Any] = {
+        "worker_id": worker_hex,
+        "pid": pid,
+        "node_id": node_id,
+        "ts": time.time(),
+        "returncode": returncode,
+        "exit": classify_exit(returncode,
+                              [e["line"] for e in entries],
+                              kill_reason),
+        "cause": cause,
+        "last_lines": lines,
+        "dropped_lines": ring.dropped if ring is not None else 0,
+        "tasks_recent": tasks,
+    }
+    for task_hex in tasks:
+        path = f"/tmp/rtpu-stuck-{task_hex[:8]}.txt"
+        try:
+            with open(path) as f:
+                pm["stack_dump"] = f.read(16384)
+                pm["stack_dump_path"] = path
+            break
+        except OSError:
+            continue
+    return pm
+
+
+def render_postmortem(pm: Optional[Dict[str, Any]]) -> str:
+    """Human text block for embedding in driver-side exceptions."""
+    if not pm:
+        return ""
+    exit_info = pm.get("exit") or {}
+    out = [f"--- worker postmortem (pid {pm.get('pid')}, node "
+           f"{(pm.get('node_id') or '?')[:12]}) ---",
+           f"exit: {exit_info.get('kind', '?')} — "
+           f"{exit_info.get('detail', '')}"]
+    if pm.get("tasks_recent"):
+        out.append("recent tasks: "
+                   + ", ".join(t[:12] for t in pm["tasks_recent"]))
+    lines = pm.get("last_lines") or []
+    if lines:
+        out.append(f"last {len(lines)} captured lines:")
+        out.extend("  " + line for line in lines)
+    elif plane_disabled():
+        out.append("(log capture disabled: RTPU_NO_LOG_PLANE)")
+    else:
+        out.append("(no lines captured)")
+    if pm.get("stack_dump_path"):
+        out.append(f"stack dump: {pm['stack_dump_path']}")
+    return "\n".join(out)
+
+
+def summarize_postmortem(pm: Optional[Dict[str, Any]]) -> str:
+    """One-to-three-line summary for GCS death causes (ActorDiedError
+    carries this, so an actor's last words reach its callers without
+    shipping the full report through every actor-info reply)."""
+    if not pm:
+        return ""
+    exit_info = pm.get("exit") or {}
+    parts = [f"exit={exit_info.get('kind', '?')}"]
+    lines = pm.get("last_lines") or []
+    if lines:
+        parts.append("last words: " + " | ".join(
+            line[-120:] for line in lines[-3:]))
+    return "; ".join(parts)

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .config import CONFIG
 from .ids import NodeID, ObjectID, PlacementGroupID, WorkerID
+from . import logplane
 from .memory_store import MemoryStore
 from .plasma import PlasmaDir
 from .resources import NodeResources, ResourceSet
@@ -52,6 +53,10 @@ class WorkerHandle:
     last_idle: float = 0.0
     is_actor_worker: bool = False
     job_hex: Optional[str] = None  # last-leased job (log-stream routing)
+    # Set when the RAYLET delivered the kill (memory watchdog): the
+    # postmortem taxonomy then reports OOM_KILLED with certainty
+    # instead of guessing at a foreign SIGKILL.
+    kill_reason: Optional[str] = None
 
 
 @dataclass
@@ -140,6 +145,12 @@ class Raylet:
         self._spawn_sem: Optional[asyncio.Semaphore] = None
         self._tasks: List[asyncio.Task] = []
         self._pulls: Dict[str, asyncio.Future] = {}
+        # Log & forensics plane: per-worker line rings (live + a bounded
+        # FIFO of dead workers' rings) and the bounded publish window
+        # the pump flushes through (see logplane.py).
+        self.log_rings = logplane.RingSet()
+        self._log_pub_window = logplane.PublishWindow(
+            CONFIG.log_pump_inflight_max)
         self._stopped = False
 
     # ------------------------------------------------------------------
@@ -224,6 +235,9 @@ class Raylet:
             sum(e.size for e in self.objects.values() if e.pinned > 0),
             tags=tags)
         metrics.store_spilled_bytes.set(self.spilled_bytes, tags=tags)
+        if not CONFIG.no_log_plane:
+            metrics.log_ring_bytes.set(self.log_rings.total_bytes(),
+                                       tags=tags)
 
     def _gcs_event(self, event_type: str, message: str,
                    severity: str = "INFO", **fields):
@@ -377,12 +391,20 @@ class Raylet:
                     # same way on every node — callers must not retry.
                     raise RuntimeEnvSetupError(
                         f"python env setup failed: {e}") from e
-            if CONFIG.log_to_driver:
-                out_target = err_target = subprocess.PIPE
+            if CONFIG.no_log_plane:
+                # exact-legacy wiring (the kill switch's contract)
+                if CONFIG.log_to_driver:
+                    out_target = err_target = subprocess.PIPE
+                else:
+                    # stderr stays inherited: crash tracebacks must
+                    # surface somewhere even with log streaming disabled
+                    out_target, err_target = subprocess.DEVNULL, None
             else:
-                # stderr stays inherited: crash tracebacks must surface
-                # somewhere even with log streaming disabled
-                out_target, err_target = subprocess.DEVNULL, None
+                # Log plane: ALWAYS pipe — the per-worker ring captures
+                # (and postmortems quote) output even when pubsub
+                # streaming to drivers is off. The old DEVNULL path
+                # becomes ring-only capture.
+                out_target = err_target = subprocess.PIPE
             argv = [interpreter, "-m", "ray_tpu._internal.worker_main"]
             from .task_spec import ENV_KEY_IMAGE_URI
             image_uri = env_key[ENV_KEY_IMAGE_URI] \
@@ -420,7 +442,7 @@ class Raylet:
                 return
             handle.proc = proc
             handle.pid = proc.pid
-            if CONFIG.log_to_driver:
+            if proc.stdout is not None or proc.stderr is not None:
                 self._start_log_forwarders(proc, handle)
             if handle.state == "DEAD":
                 # killed while the fork was in flight — don't leak it
@@ -435,18 +457,62 @@ class Raylet:
 
     def _start_log_forwarders(self, proc: subprocess.Popen,
                               handle: "WorkerHandle" = None):
-        """Tail the worker's stdout/stderr pipes and publish line batches
-        to the WORKER_LOGS pubsub channel (reference:
-        _private/log_monitor.py -> driver prints them)."""
-        import threading
-
+        """Tail the worker's stdout/stderr pipes: capture lines into the
+        per-worker ring (attribution stamps parsed off), and publish
+        cleaned batches to the WORKER_LOGS pubsub channel when
+        log_to_driver streaming is on (reference:
+        _private/log_monitor.py -> driver prints them). Under
+        RTPU_NO_LOG_PLANE the pump degrades to the exact-legacy
+        publish-only behavior (and only runs when log_to_driver piped
+        the streams at all)."""
         from .rpc import EventLoopThread
 
         gcs = self.clients.get(self.gcs_address)
+        capture = not CONFIG.no_log_plane
+        forward = CONFIG.log_to_driver
+        window = self._log_pub_window
+        ring = self.log_rings.get_or_create(
+            handle.worker_id.hex(), proc.pid) if capture \
+            and handle is not None else None
+        limiter = logplane.RateLimiter(
+            CONFIG.log_rate_limit_lines_per_s) if capture else None
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        node_tag = str(self.node_index)
 
         def _pump(stream, name):
             batch: List[str] = []
             last_flush = time.monotonic()
+
+            def _ingest(raw: str):
+                """One raw pumped line -> ring capture + (maybe) the
+                forward batch. Returns with the batch updated; the ring
+                always captures, streaming is what rate limits."""
+                if not capture or ring is None:
+                    batch.append(raw)
+                    return
+                attribution, msg = logplane.parse_line(raw)
+                if ring.job is None and handle is not None:
+                    # the lease that binds this worker to a job lands
+                    # after spawn; adopt it as soon as it exists
+                    ring.job = handle.job_hex
+                entry = ring.append(
+                    name, attribution["level"], msg,
+                    task=attribution["task"], actor=attribution["actor"],
+                    job=attribution["job"])
+                metrics.log_lines.inc(tags={
+                    "node": node_tag, "stream": name,
+                    "level": entry["level"]})
+                overflow = ring.take_overflow_delta()
+                if overflow:
+                    metrics.log_dropped.inc(overflow, tags={
+                        "node": node_tag, "reason": "ring_overflow"})
+                if forward:
+                    if limiter is None or limiter.allow(1):
+                        batch.append(msg)
+                    else:
+                        metrics.log_dropped.inc(tags={
+                            "node": node_tag, "reason": "rate_limited"})
 
             def flush():
                 nonlocal batch, last_flush
@@ -454,15 +520,42 @@ class Raylet:
                     return
                 lines, batch = batch, []
                 last_flush = time.monotonic()
+                if capture and not forward:
+                    return  # ring-only mode: nothing streams
                 # job read at flush time: the lease that binds this worker
                 # to a job lands after spawn; drivers filter on it so one
                 # job's output doesn't print on every driver
                 job = handle.job_hex if handle is not None else None
-                EventLoopThread.get().post(gcs.call(
-                    "publish", channel="WORKER_LOGS",
-                    message={"pid": proc.pid, "node_id": self.node_id,
-                             "stream": name, "job": job, "lines": lines},
-                    timeout=10))
+                # Bounded in-flight window: with the GCS down/slow,
+                # batches DROP (counted, warned once) instead of
+                # queueing unboundedly on the EventLoopThread. Applies
+                # in kill-switch mode too (the unbounded queue was a
+                # bug, not plane behavior) — but only the plane moves
+                # rtpu_log_* metrics; off-mode drops are visible via
+                # the PublishWindow's own counters + warning.
+                if not window.try_acquire(len(lines)):
+                    if capture:
+                        metrics.log_dropped.inc(
+                            len(lines),
+                            tags={"node": node_tag,
+                                  "reason": "backpressure"})
+                    return
+
+                async def _publish(lines=lines, job=job):
+                    try:
+                        await gcs.call(
+                            "publish", channel="WORKER_LOGS",
+                            message={"pid": proc.pid,
+                                     "node_id": self.node_id,
+                                     "stream": name, "job": job,
+                                     "lines": lines},
+                            timeout=10)
+                    except Exception:
+                        logger.debug("WORKER_LOGS publish failed",
+                                     exc_info=True)
+                    finally:
+                        window.release()
+                EventLoopThread.get().post(_publish())
             # Raw nonblocking fd reads with our own line splitting.
             # select + BufferedReader.readline() is WRONG here: readline
             # slurps a whole chunk into the Python buffer and returns one
@@ -495,7 +588,7 @@ class Raylet:
                     pending += chunk
                     *lines, pending = pending.split(b"\n")
                     for raw in lines:
-                        batch.append(raw.decode("utf-8", "replace"))
+                        _ingest(raw.decode("utf-8", "replace"))
                     if len(batch) >= 100 or \
                             time.monotonic() - last_flush > 0.1:
                         flush()
@@ -512,7 +605,7 @@ class Raylet:
             finally:
                 sel.close()
                 if pending:
-                    batch.append(pending.decode("utf-8", "replace"))
+                    _ingest(pending.decode("utf-8", "replace"))
                 flush()
         from .threads import spawn_daemon
         for stream, name in ((proc.stdout, "stdout"),
@@ -548,14 +641,28 @@ class Raylet:
             try:
                 await asyncio.sleep(CONFIG.worker_liveness_check_period_s)
                 now = time.monotonic()
+                dead: List[WorkerHandle] = []
                 for handle in list(self.workers.values()):
                     if handle.proc is not None and handle.proc.poll() is not None \
                             and handle.state != "DEAD":
-                        await self._on_worker_death(handle)
+                        dead.append(handle)
                     elif (handle.state == "IDLE" and not handle.is_actor_worker
                           and now - handle.last_idle >
                           CONFIG.worker_idle_timeout_s):
                         self._kill_worker(handle)
+                if dead:
+                    # concurrent: a mass death (OOM storm, job teardown)
+                    # must not serialize at one postmortem grace sleep +
+                    # GCS report per worker — callers poll the GCS for
+                    # these postmortems on a ~2s budget
+                    results = await asyncio.gather(
+                        *(self._on_worker_death(h) for h in dead),
+                        return_exceptions=True)
+                    for handle, res in zip(dead, results):
+                        if isinstance(res, Exception):
+                            logger.error(
+                                "death handling for worker %s failed: "
+                                "%r", handle.worker_id.hex()[:12], res)
                 # Reap abandoned push assemblies (sender died mid-stream).
                 for ohex, assy in list(self._push_assembly.items()):
                     if now - assy["t"] > 120:
@@ -592,6 +699,14 @@ class Raylet:
                     req.future.set_result({"canceled": True})
 
     async def _on_worker_death(self, handle: WorkerHandle):
+        # Single-flight: the liveness sweep and a caller's dispose
+        # (handle_return_worker) can both spot the same death. Whoever
+        # sets DEAD first (synchronously below — no await before it, so
+        # same-loop callers can't interleave) owns the postmortem; the
+        # loser must neither re-report nor touch the ring while the
+        # owner's grace sleep is still draining it.
+        if handle.state == "DEAD":
+            return
         # Actor workers routinely die on purpose (ray.kill / job teardown
         # kill_actor goes GCS->worker directly); the GCS owns their
         # restart-or-fail decision, so that's not warning-worthy here.
@@ -602,11 +717,31 @@ class Raylet:
         self.workers.pop(handle.worker_id, None)
         if handle.lease_id is not None:
             self._release_lease(handle.lease_id)
+        # Assemble the postmortem BEFORE retiring the ring: exit
+        # taxonomy + the ring's last lines + recent task ids + the
+        # stuck-task stack dump if the probe sweeper captured one. It
+        # rides the death report so the GCS can attach it to the
+        # WORKER_DIED event and serve it to crashing callers.
+        postmortem = None
+        if not CONFIG.no_log_plane:
+            # One pump tick of grace so lines still buffered in the dead
+            # worker's pipe reach the ring before we quote it (the pump
+            # polls every 0.1s; its EOF drain flushes the remainder).
+            await asyncio.sleep(0.2)
+            whex = handle.worker_id.hex()
+            postmortem = logplane.build_postmortem(
+                worker_hex=whex, pid=handle.pid, node_id=self.node_id,
+                returncode=handle.proc.returncode
+                if handle.proc is not None else None,
+                ring=self.log_rings.live.get(whex),
+                kill_reason=handle.kill_reason,
+                cause="worker process died")
+            self.log_rings.retire(whex)
         try:
             await self.clients.get(self.gcs_address).call(
                 "report_worker_death", node_id=self.node_id,
                 worker_id=handle.worker_id, cause="worker process died",
-                timeout=10)
+                postmortem=postmortem, timeout=10)
         except Exception:
             logger.debug("report_worker_death to GCS failed",
                          exc_info=True)
@@ -696,6 +831,7 @@ class Raylet:
             usage * 100, CONFIG.memory_usage_threshold * 100,
             victim.worker_id.hex()[:12], victim.pid,
             "actor" if victim.is_actor_worker else "task", consequence)
+        victim.kill_reason = "memory"  # postmortem taxonomy: OOM_KILLED
         try:
             victim.proc.kill()
         except Exception:
@@ -705,6 +841,10 @@ class Raylet:
     def _kill_worker(self, handle: WorkerHandle):
         handle.state = "DEAD"
         self.workers.pop(handle.worker_id, None)
+        if not CONFIG.no_log_plane:
+            # intentional teardown: no postmortem, but the ring moves to
+            # the dead FIFO so `cli logs` still answers for a while
+            self.log_rings.retire(handle.worker_id.hex())
         if handle.proc is not None:
             try:
                 handle.proc.terminate()
@@ -1054,7 +1194,30 @@ class Raylet:
         if entry and dispose:
             handle = self.workers.get(entry[0])
             if handle is not None:
-                self._kill_worker(handle)
+                died = False
+                if not CONFIG.no_log_plane and handle.proc is not None \
+                        and handle.state != "DEAD":
+                    # The usual dispose reason is a worker that died
+                    # underneath its caller (the failed push races our
+                    # liveness sweep). Give the kernel a short grace to
+                    # reap — poll() flips within ~50ms of a SIGKILL —
+                    # so a real death takes the postmortem/report path
+                    # (the crashing caller is about to ask the GCS for
+                    # this worker's last words); a healthy disposal
+                    # falls through to the plain kill.
+                    deadline = time.monotonic() + 0.5
+                    while True:
+                        died = handle.proc.poll() is not None
+                        if died or time.monotonic() >= deadline:
+                            break
+                        await asyncio.sleep(0.05)
+                if died and handle.state != "DEAD":
+                    await self._on_worker_death(handle)
+                elif handle.state != "DEAD":
+                    self._kill_worker(handle)
+                # state == DEAD: the liveness sweep owns this death —
+                # killing/retiring here would yank the ring from under
+                # its in-flight postmortem
         self._release_lease(lease_id)
         return True
 
@@ -1739,6 +1902,89 @@ class Raylet:
                 report["workers"] = list(await asyncio.gather(
                     *(_one(h) for h in targets)))
         return report
+
+    async def handle_get_logs(self, job: Optional[str] = None,
+                              task: Optional[str] = None,
+                              actor: Optional[str] = None,
+                              level: Optional[str] = None,
+                              grep: Optional[str] = None,
+                              tail: Optional[int] = None,
+                              since: Optional[Dict[str, int]] = None,
+                              limit: int = 1000,
+                              pid: Optional[int] = None,
+                              include_dead: bool = True):
+        """Query this node's worker log rings (live + retained dead).
+        Filters: job/task/actor hex (prefix for ids), min `level`,
+        `grep` regex, `tail`-N after the merge; `since` is the cursor
+        dict a previous reply returned ({worker_hex: seq}) — pass it
+        back to follow (only lines newer than the cursor return)."""
+        since = since or {}
+        limit = max(1, min(int(limit), 10_000))
+        rows: List[Dict[str, Any]] = []
+        cursors: Dict[str, int] = {}
+        matched_counts: Dict[str, int] = {}
+        scan_complete: Dict[str, int] = {}  # worker -> seq scanned to
+        dropped = 0
+        for ring in self.log_rings.all_rings():
+            if not include_dead and not ring.alive:
+                continue
+            if pid is not None and ring.pid != pid:
+                continue
+            since_seq = int(since.get(ring.worker_hex, 0))
+            cursors[ring.worker_hex] = since_seq
+            # end-of-scan seq is captured BEFORE the query: an append
+            # racing in between must not be fast-forwarded over (it
+            # lands at a seq above this bound and the next poll gets it)
+            end_seq = ring.next_seq
+            matched = ring.query(
+                job=job, task=task, actor=actor, level=level, grep=grep,
+                since_seq=since_seq, limit=limit)
+            matched_counts[ring.worker_hex] = len(matched)
+            if len(matched) < limit:
+                # the scan reached the ring's end — everything up to
+                # end_seq was either matched or filtered out
+                scan_complete[ring.worker_hex] = end_seq
+            dropped += ring.dropped
+            rows.extend(matched)
+        rows.sort(key=lambda e: (e["ts"], e["seq"]))
+        if tail:
+            rows = rows[-max(1, int(tail)):]
+        rows = rows[:limit]
+        # Follow-cursor contract: advance a worker's cursor only past
+        # lines actually RETURNED, or past fully scanned-and-filtered
+        # ranges. Truncation (per-ring limit, the global limit, or
+        # tail) must never fast-forward a follower over lines it was
+        # not handed. Per ring, ts and seq are both monotonic, so
+        # global-limit truncation drops a ring's HIGHEST seqs (safe to
+        # cursor at the returned max) while tail drops its lowest
+        # (skipping those is exactly what tail asks for).
+        returned: Dict[str, int] = {}
+        for r in rows:
+            w = r["worker_id"]
+            returned[w] = returned.get(w, 0) + 1
+            if r["seq"] > cursors.get(w, 0):
+                cursors[w] = r["seq"]
+        for w, end_seq in scan_complete.items():
+            if returned.get(w, 0) == matched_counts.get(w, 0):
+                # every matched line of this ring was returned and the
+                # scan was complete: skip the filtered-out remainder
+                cursors[w] = max(cursors[w], end_seq)
+        rows = [dict(r, node_id=self.node_id,
+                     node_index=self.node_index) for r in rows]
+        return {"node_id": self.node_id, "node_index": self.node_index,
+                "lines": rows, "cursors": cursors, "dropped": dropped,
+                "disabled": CONFIG.no_log_plane}
+
+    async def handle_list_logs(self):
+        """Ring inventory for this node: one meta row per worker ring
+        (live and retained-dead) — line/drop/byte counts and the
+        first/last timestamps, no line payloads."""
+        return {"node_id": self.node_id, "node_index": self.node_index,
+                "disabled": CONFIG.no_log_plane,
+                "pub_dropped_lines": self._log_pub_window.dropped_lines,
+                "rings": [dict(r.meta(), node_id=self.node_id,
+                               node_index=self.node_index)
+                          for r in self.log_rings.all_rings()]}
 
     async def handle_get_accel_report(self, include_workers: bool = True):
         """Node accelerator report: every local worker's device/compile/

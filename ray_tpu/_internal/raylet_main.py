@@ -45,7 +45,8 @@ def main(argv=None):
 
     async def run():
         await raylet.start()
-        print(f"RTPU_RAYLET_READY {raylet.node_id} "
+        # readiness protocol line cluster_utils waits on
+        print(f"RTPU_RAYLET_READY {raylet.node_id} "  # stdout ok: protocol
               f"{raylet.address[0]}:{raylet.address[1]}", flush=True)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

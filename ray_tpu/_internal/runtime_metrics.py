@@ -135,6 +135,21 @@ def _build() -> SimpleNamespace:
                         0.00005, 0.0001, 0.00025, 0.0005, 0.001,
                         0.0025, 0.005, 0.01],
             tag_keys=("shard",)),
+        # -- log & forensics plane (per-worker rings at the raylet:
+        # capture volume, every drop reason, resident ring bytes) --
+        log_lines=Counter(
+            "rtpu_log_lines_total",
+            "Worker log lines captured into raylet rings",
+            tag_keys=("node", "stream", "level")),
+        log_dropped=Counter(
+            "rtpu_log_dropped_lines_total",
+            "Worker log lines dropped (ring_overflow / "
+            "rate_limited / backpressure)",
+            tag_keys=("node", "reason")),
+        log_ring_bytes=Gauge(
+            "rtpu_log_ring_bytes",
+            "Bytes resident across this raylet's worker log rings",
+            tag_keys=("node",)),
         # -- continuous profiler meta-metrics (the profiler profiles
         # itself: sample volume, ring overflow, per-pass overhead) --
         profiler_samples=Counter(

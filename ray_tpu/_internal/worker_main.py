@@ -25,6 +25,15 @@ import time
 
 
 def main():
+    # Log & forensics plane: stamp every stdout/stderr line and logging
+    # record with (task, actor, job, level) BEFORE anything writes —
+    # the raylet's pump parses the stamps into its per-worker ring.
+    # install_worker_capture puts a level-stamping handler on the root
+    # logger (same format as the basicConfig below, which then no-ops);
+    # under RTPU_NO_LOG_PLANE it installs nothing and basicConfig runs
+    # exactly as before.
+    from .logplane import install_worker_capture
+    install_worker_capture()
     logging.basicConfig(
         level=logging.INFO,
         format="[worker %(process)d] %(levelname)s %(name)s: %(message)s")

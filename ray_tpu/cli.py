@@ -13,7 +13,9 @@ Usage: python -m ray_tpu.cli <command> ...
   memory   [--json] [--limit N]                          cluster memory report
   events   [--type T] [--json] [--limit N]               cluster event log
   timeline [--output FILE]                               chrome trace
-  trace    [TRACE_ID] [--json]                           span tree / list
+  trace    [TRACE_ID] [--json] [--logs]                  span tree / list
+  logs     [--task|--actor|--job|--node|--level|--grep]  cluster log search
+           [--tail N] [--follow] [--json]                (worker ring query)
   profile  [--duration S] [--hz N] [--format F]          cluster CPU profile
            [--node ID] [--pid P] [--task T] [-o FILE]    (merged flamegraph)
   stack    [--node ID] [--json]                          fleet stack dump
@@ -367,7 +369,9 @@ def cmd_timeline(args):
 
 
 def cmd_trace(args):
-    """Print one trace's span tree (or list recent traces with no id)."""
+    """Print one trace's span tree (or list recent traces with no id).
+    --logs interleaves each execution span's captured log lines (by the
+    task id the span carries) under its node."""
     _connect(args)
     from ray_tpu.util import state as st
     if not args.trace_id:
@@ -393,14 +397,76 @@ def cmd_trace(args):
     print(f"trace {tree['trace_id']}: {tree['num_spans']} spans across "
           f"{tree['num_processes']} processes")
 
+    lines_by_task = {}
+    if getattr(args, "logs", False):
+        # ONE cluster sweep serves the whole tree; lines group by the
+        # task id each execution span carries.
+        for line in st.get_logs(limit=10_000)["lines"]:
+            if line.get("task"):
+                lines_by_task.setdefault(line["task"], []).append(line)
+
     def _render(node, depth):
         print(f"{'  ' * depth}- {node['name']}  "
               f"[{node['duration_s'] * 1e3:.1f}ms pid={node['pid']} "
               f"span={node['span_id'][:8]}]")
+        for line in lines_by_task.get(node.get("task_id") or "", ()):
+            stamp = time.strftime("%H:%M:%S",
+                                  time.localtime(line["ts"]))
+            print(f"{'  ' * (depth + 1)}| {stamp} "
+                  f"[{line.get('level') or '?'}] {line['line']}")
         for child in node["children"]:
             _render(child, depth + 1)
     for root in tree["roots"]:
         _render(root, 0)
+
+
+def cmd_logs(args):
+    """Cluster log search/tail over the per-worker rings (reference:
+    `ray logs` + the dashboard log view): works with log_to_driver OFF
+    — retention lives at the raylets, not in driver stdout."""
+    _connect(args)
+    from ray_tpu.util import state as st
+
+    def _print_batch(batch):
+        for line in batch["lines"]:
+            stamp = time.strftime("%H:%M:%S",
+                                  time.localtime(line["ts"]))
+            who = f"node{line.get('node_index', '?')} " \
+                  f"pid={line.get('pid', '?')}"
+            task = f" task={line['task'][:12]}" if line.get("task") else ""
+            actor = f" actor={line['actor'][:12]}" \
+                if line.get("actor") else ""
+            print(f"{stamp} [{who}{task}{actor} "
+                  f"{line.get('level') or '?'}] {line['line']}")
+
+    if args.follow:
+        try:
+            for batch in st.tail_logs(task=args.task, actor=args.actor,
+                                      job=args.job, node_id=args.node,
+                                      level=args.level, grep=args.grep):
+                _print_batch(batch)
+        except KeyboardInterrupt:
+            return
+        return
+    result = st.get_logs(task=args.task, actor=args.actor, job=args.job,
+                         node_id=args.node, level=args.level,
+                         grep=args.grep, tail=args.tail,
+                         limit=args.limit)
+    if args.json:
+        print(json.dumps(result, indent=1, default=str))
+        return
+    if result.get("disabled"):
+        print("log plane disabled (RTPU_NO_LOG_PLANE) on some nodes")
+    _print_batch(result)
+    extras = []
+    if result["dropped"]:
+        extras.append(f"{result['dropped']} lines dropped (ring "
+                      "overflow)")
+    if result["errors"]:
+        extras.append(f"unreachable: "
+                      f"{json.dumps(result['errors'], default=str)}")
+    if extras:
+        print("-- " + "; ".join(extras))
 
 
 def cmd_profile(args):
@@ -579,7 +645,7 @@ def cmd_perf(args):
 
 
 def cmd_lint(args):
-    """rtpulint: project-specific static analysis (rules L001-L006,
+    """rtpulint: project-specific static analysis (rules L001-L008,
     burn-down allowlist). Exits non-zero on violations."""
     from ray_tpu._internal import lint
     raise SystemExit(lint.main(
@@ -664,9 +730,32 @@ def main(argv=None):
     p = sub.add_parser("trace")
     p.add_argument("trace_id", nargs="?")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--logs", action="store_true",
+                   help="interleave captured log lines under each "
+                        "execution span (by task id)")
     p.add_argument("--limit", type=int, default=20)
     p.add_argument("--address")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("logs")
+    p.add_argument("--task", default=None,
+                   help="task id (hex prefix)")
+    p.add_argument("--actor", default=None,
+                   help="actor id (hex prefix)")
+    p.add_argument("--job", default=None, help="job id (hex)")
+    p.add_argument("--node", default=None,
+                   help="restrict to one node (id prefix)")
+    p.add_argument("--level", default=None,
+                   help="minimum level (DEBUG/INFO/WARNING/ERROR)")
+    p.add_argument("--grep", default=None, help="regex over messages")
+    p.add_argument("--tail", type=int, default=None,
+                   help="last N lines after the merge")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="poll for new lines (cursor-based)")
+    p.add_argument("--limit", type=int, default=1000)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("profile")
     p.add_argument("--duration", type=float, default=5.0)

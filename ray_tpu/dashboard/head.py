@@ -25,6 +25,10 @@ Routes:
   GET  /api/devices                   cluster accelerator summary
                                       (per-device HBM, XLA compile,
                                       step/MFU telemetry)
+  GET  /api/logs                      attributed worker log lines from
+                                      the raylet rings (?task=&actor=&
+                                      job=&level=&grep=&tail=&since=)
+  GET  /api/logs/rings                per-worker ring inventory
   GET  /metrics                       Prometheus exposition
   GET  /-/healthz
   GET  /                              web frontend (single-page app,
@@ -225,10 +229,27 @@ class DashboardHead:
             # short per-node timeout so a hung raylet can't wedge the tab
             return self._json(st.accel_summary(force_local_jax=False,
                                                node_timeout_s=10))
+        if path == "/api/logs":
+            # cluster log search over the per-worker raylet rings
+            # (?task=&actor=&job=&node_id=&level=&grep=&tail=&limit=
+            # &since=<cursor json> — since is the "cursors" object a
+            # previous reply returned, for follow-style polling)
+            since = query.get("since")
+            tail = query.get("tail")
+            return self._json(st.get_logs(
+                task=query.get("task"), actor=query.get("actor"),
+                job=query.get("job"), node_id=query.get("node_id"),
+                level=query.get("level"), grep=query.get("grep"),
+                tail=int(tail) if tail else None,
+                limit=int(query.get("limit", 1000)),
+                since=json.loads(since) if since else None))
+        if path == "/api/logs/rings":
+            return self._json(st.list_logs(
+                node_id=query.get("node_id")))
 
         job_match = re.fullmatch(r"/api/jobs/([^/]*)(/logs|/stop)?", path)
         if path == "/api/jobs/" or job_match:
-            return self._route_jobs(method, job_match, body)
+            return self._route_jobs(method, job_match, body, query)
         return (404, b"not found", "text/plain")
 
     def _route_profile(self, query: Dict[str, str]):
@@ -280,11 +301,13 @@ class DashboardHead:
             else "text/plain"
         return (200, reply["data"], ctype)
 
-    def _route_jobs(self, method: str, match, body: bytes):
+    def _route_jobs(self, method: str, match, body: bytes,
+                    query: Optional[Dict[str, str]] = None):
         from ..job_submission import JobManager
         if self._job_manager is None:
             self._job_manager = JobManager()
         manager = self._job_manager
+        query = query or {}
         sub_id = match.group(1) if match else ""
         action = match.group(2) if match else None
 
@@ -299,7 +322,25 @@ class DashboardHead:
         if method == "GET" and not sub_id:
             return self._json(manager.list_jobs())
         if method == "GET" and action == "/logs":
-            return self._json({"logs": manager.get_job_logs(sub_id)})
+            # Cursor pagination (?limit=&since=) — the /api/tasks
+            # pattern; without params the legacy {"logs": <str>} shape
+            # survives for small outputs, while big logs page instead
+            # of shipping one unbounded concatenated string.
+            if "limit" in query or "since" in query:
+                return self._json(manager.get_job_logs_paged(
+                    sub_id, limit=int(query.get("limit", 1000)),
+                    since=int(query.get("since", 0))))
+            info = manager.get_job_info(sub_id)
+            try:
+                import os as _os
+                size = _os.path.getsize(info["log_path"]) if info else 0
+            except OSError:
+                size = 0
+            if size <= 1_000_000:  # legacy shape for small outputs
+                return self._json({"logs": manager.get_job_logs(sub_id)})
+            return self._json(dict(
+                manager.get_job_logs_paged(sub_id, limit=10_000),
+                paged=True))
         if method == "POST" and action == "/stop":
             return self._json({"stopped": manager.stop_job(sub_id)})
         if method == "GET" and sub_id:

@@ -412,6 +412,96 @@ def accel_bench(results: Optional[Dict[str, float]] = None
     return out
 
 
+def logplane_bench(results: Optional[Dict[str, float]] = None
+                   ) -> Dict[str, float]:
+    """Log-plane overhead: the worker-side per-line stamp tax (what
+    every print()/log record pays), raylet-side parse + ring-append
+    cost, and a cluster A/B — the same print-heavy workload timed with
+    the plane ON (ring-only capture) vs the RTPU_NO_LOG_PLANE kill
+    switch (legacy DEVNULL), both with log_to_driver off. The A/B
+    proves default-on capture rides within machine noise."""
+    from ray_tpu._internal import logplane
+
+    out: Dict[str, float] = {}
+    line = "a typical task log line with some payload attached: 12345"
+    reps = 200_000
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        logplane.stamp_line(line, "INFO")
+    out["logplane_stamp_ns"] = (time.perf_counter() - t0) / reps * 1e9
+    stamped = logplane.stamp_line(line, "INFO")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        logplane.parse_line(stamped)
+    out["logplane_parse_ns"] = (time.perf_counter() - t0) / reps * 1e9
+    ring = logplane.LogRing("w" * 8, pid=1, maxlen=2000)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ring.append("stdout", "INFO", line, task="ab" * 8)
+    out["logplane_ring_append_ns"] = \
+        (time.perf_counter() - t0) / reps * 1e9
+
+    # Cluster A/B: each arm spawns its own workers (the pipe wiring is
+    # fixed at spawn), min-of-rounds inside each arm. The kill switch
+    # rides the environment so worker subprocesses inherit it.
+    def _arm(disabled: bool) -> float:
+        import os
+
+        import ray_tpu
+        if disabled:
+            os.environ["RTPU_NO_LOG_PLANE"] = "1"
+        from ray_tpu._internal.config import CONFIG
+        CONFIG.reset()
+        try:
+            ray_tpu.init(num_cpus=2, log_to_driver=False,
+                         object_store_memory=128 * 1024 * 1024)
+
+            @ray_tpu.remote
+            def chatty(n):
+                # a realistic logging task: some work per line, not a
+                # pure print loop (which would benchmark /dev/null)
+                x = 0
+                for i in range(n):
+                    for j in range(2000):
+                        x += j * j
+                    print("bench line", i, x % 97)
+                return n
+
+            ray_tpu.get(chatty.remote(20), timeout=120)  # warm worker
+            best = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                ray_tpu.get([chatty.remote(250) for _ in range(4)],
+                            timeout=120)
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            return best
+        finally:
+            ray_tpu.shutdown()
+            os.environ.pop("RTPU_NO_LOG_PLANE", None)
+            CONFIG.reset()
+
+    off_s = _arm(disabled=True)
+    on_s = _arm(disabled=False)
+    total_lines = 250 * 4
+    out["logplane_off_chatty_s"] = off_s
+    out["logplane_on_chatty_s"] = on_s
+    out["logplane_chatty_overhead_pct"] = \
+        max(0.0, (on_s - off_s) / off_s * 100.0)
+    # the honest per-line figure: what one captured line costs end to
+    # end (stamp + pipe + parse + ring) vs the DEVNULL legacy path
+    out["logplane_per_line_us"] = \
+        max(0.0, (on_s - off_s)) / total_lines * 1e6
+    for metric, value in out.items():
+        unit = "%" if metric.endswith("pct") else \
+            ("s" if metric.endswith("_s") else
+             ("us" if metric.endswith("_us") else "ns"))
+        _report(metric, value, unit)
+    if results is not None:
+        results.update(out)
+    return out
+
+
 def _rate(n: int, fn: Callable[[], None]) -> float:
     start = time.perf_counter()
     fn()
@@ -692,6 +782,10 @@ if __name__ == "__main__":
                         help="accelerator-plane overhead microbench: "
                              "snapshot cost + decode-loop on/off A/B "
                              "(no cluster)")
+    parser.add_argument("--logplane", action="store_true",
+                        help="log-plane overhead microbench: per-line "
+                             "stamp/parse/ring cost + print-heavy "
+                             "cluster A/B (plane on vs kill switch)")
     parser.add_argument("--shards", nargs="?", const="1,2,4",
                         default=None, metavar="N,N,...",
                         help="owner-shard A/B: n:n + multi-client at "
@@ -709,6 +803,8 @@ if __name__ == "__main__":
         sampler_bench()
     elif args.accel:
         accel_bench()
+    elif args.logplane:
+        logplane_bench()
     elif args.shards:
         shards_bench(tuple(int(x) for x in args.shards.split(",")),
                      quick=args.quick)

@@ -1,18 +1,19 @@
 """State API (reference: python/ray/util/state — api.py list_actors/
 list_tasks/list_objects/list_nodes/..., common.py state schemas)."""
 
-from .api import (accel_summary, get_actor, get_node, get_trace,
-                  list_actors, list_events, list_jobs, list_nodes,
-                  list_object_refs, list_objects, list_placement_groups,
-                  list_tasks, list_traces, list_workers, memory_summary,
-                  profile_cluster, profiling_status, shard_summary,
-                  stack_cluster, summarize_tasks, timeline)
+from .api import (accel_summary, get_actor, get_logs, get_node,
+                  get_trace, list_actors, list_events, list_jobs,
+                  list_logs, list_nodes, list_object_refs, list_objects,
+                  list_placement_groups, list_tasks, list_traces,
+                  list_workers, memory_summary, profile_cluster,
+                  profiling_status, shard_summary, stack_cluster,
+                  summarize_tasks, tail_logs, timeline)
 
 __all__ = [
-    "accel_summary", "get_actor", "get_node", "get_trace", "list_actors",
-    "list_events", "list_jobs", "list_nodes", "list_object_refs",
-    "list_objects", "list_placement_groups", "list_tasks", "list_traces",
-    "list_workers", "memory_summary", "profile_cluster",
-    "profiling_status", "shard_summary", "stack_cluster",
-    "summarize_tasks", "timeline",
+    "accel_summary", "get_actor", "get_logs", "get_node", "get_trace",
+    "list_actors", "list_events", "list_jobs", "list_logs", "list_nodes",
+    "list_object_refs", "list_objects", "list_placement_groups",
+    "list_tasks", "list_traces", "list_workers", "memory_summary",
+    "profile_cluster", "profiling_status", "shard_summary",
+    "stack_cluster", "summarize_tasks", "tail_logs", "timeline",
 ]

@@ -409,7 +409,11 @@ def get_trace(trace_id: str) -> Dict[str, Any]:
             "parent_span_id": ev.get("parent_span_id"),
             "start": ev.get("ts"),
             "duration_s": ev.get("duration_s", 0.0),
-            "pid": ev.get("pid"), "children": [],
+            "pid": ev.get("pid"),
+            # execution spans carry their task id (tracing._record) so
+            # `cli trace --logs` can interleave that task's log lines
+            "task_id": ev.get("task_id_hex"),
+            "children": [],
         }
     roots = []
     for node in nodes.values():
@@ -956,6 +960,126 @@ def accel_summary(force_local_jax: bool = True,
                       for rep in processes],
         "errors": errors,
     }
+
+
+# ---------------------------------------------------------------------------
+# log & forensics plane (reference: state API list_logs/get_log + the
+# dashboard log view; here every raylet serves its workers' bounded
+# rings — see _internal/logplane.py for capture/attribution/postmortems)
+# ---------------------------------------------------------------------------
+
+
+def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Ring inventory cluster-wide: one row per worker log ring (live
+    and retained-dead) with line/drop/byte counts — no line payloads.
+    Unreachable nodes become error rows."""
+    from ..._internal.core_worker import get_core_worker
+    cw = get_core_worker()
+    nodes = _live_nodes()
+    if node_id:
+        nodes = [n for n in nodes if n["node_id"].startswith(node_id)]
+
+    def _node_rings(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "list_logs", timeout=10)
+
+    rows: List[Dict[str, Any]] = []
+    for node, result, error in _fanout(nodes, _node_rings):
+        if error is not None:
+            rows.append({"node_id": node["node_id"], "error": error})
+            continue
+        rows.extend(result.get("rings", ()))
+    return rows
+
+
+def get_logs(task: Optional[str] = None, actor: Optional[str] = None,
+             job: Optional[str] = None, node_id: Optional[str] = None,
+             level: Optional[str] = None, grep: Optional[str] = None,
+             tail: Optional[int] = None, limit: int = 1000,
+             since: Optional[Dict[str, Dict[str, int]]] = None
+             ) -> Dict[str, Any]:
+    """Attributed log lines cluster-wide, merged across every node's
+    worker rings and sorted by timestamp. Filters: ``task``/``actor``
+    hex prefix, ``job`` hex, ``node_id`` prefix, min ``level``,
+    ``grep`` regex, ``tail``-N after the merge. ``since`` is the
+    cursor this function returned last time ({node_id: {worker: seq}})
+    — pass it back to receive only newer lines (the follow loop
+    `tail_logs` wraps)."""
+    from ..._internal.core_worker import get_core_worker
+    cw = get_core_worker()
+    since = since or {}
+    nodes = _live_nodes()
+    if node_id:
+        nodes = [n for n in nodes if n["node_id"].startswith(node_id)]
+
+    def _node_logs(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "get_logs", task=task, actor=actor, job=job, level=level,
+            grep=grep, tail=tail, limit=limit,
+            since=since.get(node["node_id"]), timeout=15)
+
+    lines: List[Dict[str, Any]] = []
+    cursors: Dict[str, Dict[str, int]] = {}
+    errors: List[Dict[str, Any]] = []
+    dropped = 0
+    disabled = False
+    for node, result, error in _fanout(nodes, _node_logs):
+        if error is not None:
+            errors.append({"node_id": node["node_id"], "error": error})
+            # keep the previous cursor: a transiently unreachable node
+            # must not make the next follow poll replay its whole rings
+            if node["node_id"] in since:
+                cursors[node["node_id"]] = since[node["node_id"]]
+            continue
+        lines.extend(result.get("lines", ()))
+        cursors[node["node_id"]] = result.get("cursors", {})
+        dropped += result.get("dropped", 0)
+        disabled = disabled or result.get("disabled", False)
+    lines.sort(key=lambda e: (e.get("ts") or 0, e.get("seq") or 0))
+    if tail:
+        # dropping the OLDEST merged lines is what tail asks for — the
+        # per-node cursors legitimately skip them
+        lines = lines[-max(1, int(tail)):]
+    cut, lines = lines[limit:], lines[:limit]
+    # The global cap cuts the NEWEST merged lines, but each raylet's
+    # reply already advanced its cursors past everything it returned —
+    # clamp the affected (node, worker) cursors back to the newest line
+    # actually kept, or a follower would skip the cut lines forever.
+    if cut:
+        kept_max: Dict[tuple, int] = {}
+        for line in lines:
+            key = (line.get("node_id"), line.get("worker_id"))
+            if (line.get("seq") or 0) > kept_max.get(key, 0):
+                kept_max[key] = line["seq"]
+        for line in cut:
+            node, worker = line.get("node_id"), line.get("worker_id")
+            node_cursors = cursors.get(node)
+            if node_cursors is None or worker not in node_cursors:
+                continue
+            prev = int((since.get(node) or {}).get(worker, 0))
+            node_cursors[worker] = max(
+                prev, kept_max.get((node, worker), prev))
+    return {"lines": lines, "cursors": cursors,
+            "dropped": dropped, "errors": errors, "disabled": disabled}
+
+
+def tail_logs(task: Optional[str] = None, actor: Optional[str] = None,
+              job: Optional[str] = None, node_id: Optional[str] = None,
+              level: Optional[str] = None, grep: Optional[str] = None,
+              poll_s: float = 0.5):
+    """Generator for `cli logs --follow`: yields one `get_logs` result
+    per poll, threading the cursor through so each batch holds only
+    lines the previous batch has not seen. The first batch tails the
+    recent past (last 100 lines) instead of replaying whole rings."""
+    batch = get_logs(task=task, actor=actor, job=job, node_id=node_id,
+                     level=level, grep=grep, tail=100)
+    while True:
+        yield batch
+        import time as _time
+        _time.sleep(poll_s)
+        batch = get_logs(task=task, actor=actor, job=job,
+                         node_id=node_id, level=level, grep=grep,
+                         since=batch["cursors"])
 
 
 def list_events(event_type: Optional[str] = None,

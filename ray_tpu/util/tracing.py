@@ -58,30 +58,38 @@ def trace_span(name: str) -> Iterator[Tuple[str, str]]:
 
 
 def _record(name: str, trace_id: str, span_id: str,
-            parent_span: Optional[str], start: float, end: float):
+            parent_span: Optional[str], start: float, end: float,
+            task_id: Optional[str] = None):
     """Span -> task-event plane (best-effort; traces are observability)."""
     try:
         from .._internal.core_worker import try_get_core_worker
         worker = try_get_core_worker()
         if worker is None:
             return
-        worker.loop_post(worker.gcs.call(
-            "add_task_events", events=[{
-                "event": "SPAN", "name": name, "trace_id": trace_id,
-                "span_id": span_id, "parent_span_id": parent_span,
-                "ts": start, "duration_s": end - start,
-                "pid": os.getpid(),
-                # job attribution so timeline(job_id=...) can scope
-                # span rows the same way it scopes task rows
-                "job_id": worker.current_job_id().hex(),
-            }]))
+        event = {
+            "event": "SPAN", "name": name, "trace_id": trace_id,
+            "span_id": span_id, "parent_span_id": parent_span,
+            "ts": start, "duration_s": end - start,
+            "pid": os.getpid(),
+            # job attribution so timeline(job_id=...) can scope
+            # span rows the same way it scopes task rows
+            "job_id": worker.current_job_id().hex(),
+        }
+        if task_id is not None:
+            # execution spans carry their task id so the log plane can
+            # interleave that task's captured lines into the span tree
+            # (`cli trace <id> --logs`)
+            event["task_id_hex"] = task_id
+        worker.loop_post(worker.gcs.call("add_task_events",
+                                         events=[event]))
     except Exception:  # noqa: BLE001 — tracing is best-effort
         logger.debug("span record dropped (GCS unreachable?)",
                      exc_info=True)
 
 
 def record_child_span(name: str, parent_ctx: Tuple[str, str],
-                      start: float, end: float):
+                      start: float, end: float,
+                      task_id: Optional[str] = None):
     """Record a completed span as a child of `parent_ctx` WITHOUT
     touching the active context (the task executor uses this for the
     execution span: user code must keep inheriting the caller's
@@ -89,7 +97,8 @@ def record_child_span(name: str, parent_ctx: Tuple[str, str],
     contract)."""
     if parent_ctx is None:
         return
-    _record(name, parent_ctx[0], _new_id(), parent_ctx[1], start, end)
+    _record(name, parent_ctx[0], _new_id(), parent_ctx[1], start, end,
+            task_id=task_id)
 
 
 def child_context_for_submit() -> Optional[Tuple[str, str]]:

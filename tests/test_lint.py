@@ -321,6 +321,48 @@ def test_l007_unregistered_private_attr_ok():
 
 
 # ---------------------------------------------------------------------------
+# L008 logging hygiene (the log & forensics plane's capture contract)
+# ---------------------------------------------------------------------------
+
+def test_l008_bare_print_fires_in_internal():
+    assert "L008" in _rules("print('hi')\n",
+                            path="ray_tpu/_internal/foo.py")
+
+
+def test_l008_annotated_print_ok():
+    assert "L008" not in _rules(
+        "print('READY')  # stdout ok: protocol line\n",
+        path="ray_tpu/_internal/foo.py")
+
+
+def test_l008_main_entry_and_non_internal_ok():
+    assert "L008" not in _rules(
+        "print('hi')\n", path="ray_tpu/_internal/lint/__main__.py")
+    assert "L008" not in _rules("print('hi')\n", path="ray_tpu/cli.py")
+
+
+def test_l008_literal_logger_name_fires():
+    src = "import logging\nlogger = logging.getLogger('rtpu.thing')\n"
+    assert "L008" in _rules(src, path="ray_tpu/_internal/foo.py")
+
+
+def test_l008_module_handle_naming():
+    bad = "import logging\nlog = logging.getLogger(__name__)\n"
+    good = "import logging\nlogger = logging.getLogger(__name__)\n"
+    root = ("import logging\n"
+            "def f():\n"
+            "    root = logging.getLogger()\n"
+            "    return root\n")
+    assert "L008" in _rules(bad, path="ray_tpu/_internal/foo.py")
+    assert "L008" not in _rules(good, path="ray_tpu/_internal/foo.py")
+    # argless root-logger access (logplane install) is not the module
+    # handle; naming is free there
+    assert "L008" not in _rules(root, path="ray_tpu/_internal/foo.py")
+    # outside _internal/ the convention is advisory, not linted
+    assert "L008" not in _rules(bad, path="ray_tpu/util/foo.py")
+
+
+# ---------------------------------------------------------------------------
 # full tree + allowlist contract (tier-1 gate)
 # ---------------------------------------------------------------------------
 
