@@ -100,10 +100,21 @@ def init(address: Optional[str] = None, *,
             gcs_address=gcs_address, raylet_address=raylet_address,
             node_id=node_id, node_index=node_index)
         worker.start()
+        import uuid as _uuid
         job_id = worker.gcs.call_sync(
             "add_job", driver_address=worker.rpc_address,
-            namespace=namespace)
+            namespace=namespace,
+            # Idempotency token: a retry across a GCS failover coalesces
+            # onto the same job instead of double-registering.
+            token=_uuid.uuid4().hex)
         worker.job_id = job_id
+        try:
+            # Seed the failover detector: the client must know the
+            # CURRENT incarnation to tell a restart from first contact.
+            info = worker.gcs.call_sync("gcs_info", timeout=10)
+            worker.gcs.note_incarnation(info["incarnation"])
+        except Exception:
+            logger.debug("gcs_info seed fetch failed", exc_info=True)
         # Propagate the driver's import environment so workers can
         # deserialize functions defined in driver-side modules (reference:
         # runtime-env working_dir / py_modules path propagation).
@@ -167,6 +178,9 @@ def shutdown():
     global _local_node
     worker = try_get_core_worker()
     if worker is not None:
+        # Failures past this point are expected (the GCS may already be
+        # gone); they must not arm reconnect probes.
+        worker.gcs.suppress_reconnect()
         try:
             worker.gcs.call_sync("mark_job_finished", job_id=worker.job_id,
                                  timeout=10)

@@ -1,0 +1,98 @@
+"""Unified retry backoff (reference: retryable_grpc_client.cc's
+exponential backoff + the scattered `delay *= 1.6` loops this replaces).
+
+One policy object, three verbs:
+
+    bo = Backoff(base_s=0.05, max_s=2.0, deadline_s=60.0)
+    while True:
+        try:
+            return do_thing()
+        except TransientError:
+            if not bo.sleep():          # or: await bo.async_sleep()
+                raise                   # deadline exhausted
+
+Delays are jittered exponential: ``base * mult^attempt`` capped at
+``max_s``, each multiplied by a uniform factor in [0.5, 1.5) so a herd
+of reconnecting clients doesn't synchronize its retry storms. A
+``deadline_s`` bounds the TOTAL time spent sleeping (None = retry
+forever); ``next_delay()`` exposes the schedule without sleeping for
+callers that drive their own waits (select loops, Event.wait).
+
+rtpulint rule L009 flags raw ``time.sleep``/``asyncio.sleep`` calls in
+retry loops inside ``_internal/`` — this module is the sanctioned
+replacement (and is itself exempt, being the implementation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Optional
+
+__all__ = ["Backoff"]
+
+
+class Backoff:
+    """Jittered exponential backoff with a cap and an optional deadline.
+
+    Not thread-safe: one instance per retry loop (they're cheap)."""
+
+    __slots__ = ("base_s", "max_s", "mult", "deadline", "attempt", "_rng")
+
+    def __init__(self, base_s: float = 0.05, max_s: float = 2.0,
+                 mult: float = 2.0, deadline_s: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self.base_s = base_s
+        self.max_s = max_s
+        self.mult = mult
+        self.deadline = (time.monotonic() + deadline_s
+                         if deadline_s is not None else None)
+        self.attempt = 0
+        # Seedable for deterministic tests; unseeded instances share no
+        # state (each loop gets an independent stream).
+        self._rng = random.Random(seed)
+
+    # -- schedule ----------------------------------------------------------
+
+    def next_delay(self) -> Optional[float]:
+        """The next sleep in seconds, clamped to the remaining deadline,
+        or None when the deadline is already exhausted. Advances the
+        attempt counter."""
+        raw = min(self.base_s * (self.mult ** self.attempt), self.max_s)
+        self.attempt += 1
+        delay = raw * (0.5 + self._rng.random())
+        if self.deadline is not None:
+            remaining = self.deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            delay = min(delay, remaining)
+        return delay
+
+    def expired(self) -> bool:
+        return self.deadline is not None \
+            and time.monotonic() >= self.deadline
+
+    def reset(self):
+        """Back to the base delay (call after a success so the NEXT
+        failure starts the schedule over)."""
+        self.attempt = 0
+
+    # -- sleeping ----------------------------------------------------------
+
+    def sleep(self) -> bool:
+        """Blocking sleep for the next delay. False = deadline exhausted
+        (caller should give up)."""
+        delay = self.next_delay()
+        if delay is None:
+            return False
+        time.sleep(delay)
+        return True
+
+    async def async_sleep(self) -> bool:
+        """asyncio sleep for the next delay. False = deadline exhausted."""
+        delay = self.next_delay()
+        if delay is None:
+            return False
+        await asyncio.sleep(delay)
+        return True

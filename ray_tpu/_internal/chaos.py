@@ -1,0 +1,198 @@
+"""Deterministic fault injection (reference: src/ray/rpc/rpc_chaos.h,
+grown into a registry).
+
+The ad-hoc ``RTPU_TESTING_RPC_FAILURE`` env flag (drop requests/responses
+by method substring) is promoted here into a seeded registry that the
+RPC layer, tests, and ``cli chaos`` all drive:
+
+- **RPC faults** by site key (method substring): ``drop_req`` /
+  ``drop_resp`` (the legacy spec compiles to these), ``delay`` (hold the
+  request ``param`` seconds before dispatch), ``dup`` (deliver the
+  response frame twice — exercises caller idempotency).
+- **Process faults**: ``kill_pid`` (SIGKILL — the worker/GCS ``kill -9``
+  primitive for failover tests), plus the GCS/raylet ``set_chaos`` RPC
+  handlers that let ``cli chaos set`` re-arm a live cluster.
+
+Spec grammar (``CONFIG.chaos_spec``, comma-separated)::
+
+    <method-substring>:<action>:<prob>[:<param>]
+    e.g.  push_task:drop_resp:0.2 , heartbeat:delay:1.0:0.5 , kv_put:dup:0.1
+
+The legacy ``CONFIG.testing_rpc_failure`` grammar
+(``method:req_p:resp_p``) is still honored and folds into the same rule
+table. All probability draws come from ONE ``random.Random`` seeded by
+``CONFIG.chaos_seed`` (0 = process-random), so a failing chaos run
+replays bit-identically under the same seed and call sequence.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+_ACTIONS = ("drop_req", "drop_resp", "delay", "dup")
+
+
+@dataclass
+class Rule:
+    pattern: str        # method substring
+    action: str         # one of _ACTIONS
+    prob: float
+    param: float = 0.0  # delay seconds (delay action)
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    """Parse the extended grammar; raises ValueError on malformed entries
+    (a typo'd chaos spec must fail loudly, not silently inject nothing)."""
+    rules: List[Rule] = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split(":")
+        if len(parts) < 3 or parts[1] not in _ACTIONS:
+            raise ValueError(
+                f"bad chaos rule {entry!r}: want "
+                "<method>:<drop_req|drop_resp|delay|dup>:<prob>[:<param>]")
+        rules.append(Rule(pattern=parts[0], action=parts[1],
+                          prob=float(parts[2]),
+                          param=float(parts[3]) if len(parts) > 3 else 0.0))
+    return rules
+
+
+def parse_legacy_spec(spec: str) -> List[Rule]:
+    """``method:req_p:resp_p`` (RTPU_TESTING_RPC_FAILURE back-compat)."""
+    rules: List[Rule] = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split(":")
+        req_p, resp_p = float(parts[1]), float(parts[2])
+        if req_p:
+            rules.append(Rule(parts[0], "drop_req", req_p))
+        if resp_p:
+            rules.append(Rule(parts[0], "drop_resp", resp_p))
+    return rules
+
+
+class ChaosRegistry:
+    """The process's fault-injection state. Rules reload lazily when the
+    CONFIG specs change (tests monkeypatch CONFIG / env between runs);
+    the RNG reseeds only when the seed value changes, so one test's
+    draws don't perturb the next seeded run."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rules: List[Rule] = []
+        self._specs: Optional[tuple] = None
+        self._rng = None
+        self._seed_used: Optional[int] = None
+        self._hits: Dict[str, int] = {}
+
+    # -- rule table --------------------------------------------------------
+
+    def _load(self):
+        specs = (CONFIG.testing_rpc_failure, CONFIG.chaos_spec,
+                 CONFIG.chaos_seed)
+        if specs == self._specs:
+            return
+        with self._lock:
+            if specs == self._specs:
+                return
+            rules: List[Rule] = []
+            try:
+                if specs[0]:
+                    rules.extend(parse_legacy_spec(specs[0]))
+                if specs[1]:
+                    rules.extend(parse_spec(specs[1]))
+            except (ValueError, IndexError):
+                logger.exception("malformed chaos spec; injecting nothing")
+                rules = []
+            import random
+            seed = specs[2]
+            if self._rng is None or seed != self._seed_used:
+                self._rng = random.Random(seed if seed else None)
+                self._seed_used = seed
+            self._rules = rules
+            self._specs = specs
+            if rules:
+                logger.warning("chaos armed: %d rule(s), seed=%s",
+                               len(rules), seed or "process-random")
+
+    def arm(self, spec: str = "", seed: int = 0,
+            legacy_spec: Optional[str] = None):
+        """Programmatic re-arm (tests / the set_chaos RPC): writes the
+        specs into CONFIG so every read site — including freshly spawned
+        code paths — sees the same rules, then reloads."""
+        overrides: Dict[str, object] = {"chaos_spec": spec,
+                                        "chaos_seed": seed}
+        if legacy_spec is not None:
+            overrides["testing_rpc_failure"] = legacy_spec
+        CONFIG.apply_system_config(overrides)
+        self._specs = None
+        self._load()
+
+    def active_rules(self) -> List[Rule]:
+        self._load()
+        return list(self._rules)
+
+    def hit_counts(self) -> Dict[str, int]:
+        """Per-(pattern, action) trigger counts — `cli chaos show` and
+        tests assert injection actually happened (a vacuously green
+        chaos test is worse than none)."""
+        return dict(self._hits)
+
+    # -- decision points (called from rpc.py) ------------------------------
+
+    def _roll(self, method: str, action: str) -> Optional[Rule]:
+        self._load()
+        if not self._rules:
+            return None
+        for rule in self._rules:
+            if rule.action == action and rule.pattern in method \
+                    and self._rng.random() < rule.prob:
+                key = f"{rule.pattern}:{rule.action}"
+                self._hits[key] = self._hits.get(key, 0) + 1
+                return rule
+        return None
+
+    def drop_request(self, method: str) -> bool:
+        return self._roll(method, "drop_req") is not None
+
+    def drop_response(self, method: str) -> bool:
+        return self._roll(method, "drop_resp") is not None
+
+    def request_delay(self, method: str) -> float:
+        rule = self._roll(method, "delay")
+        return rule.param if rule is not None else 0.0
+
+    def duplicate_response(self, method: str) -> bool:
+        return self._roll(method, "dup") is not None
+
+
+REGISTRY = ChaosRegistry()
+
+
+# ---------------------------------------------------------------------------
+# process faults
+# ---------------------------------------------------------------------------
+
+def kill_pid(pid: int) -> bool:
+    """SIGKILL a process — the ``kill -9`` primitive for failover tests
+    and ``cli chaos kill-worker``. Returns False if the pid is gone."""
+    try:
+        os.kill(pid, signal.SIGKILL)
+        return True
+    except (ProcessLookupError, PermissionError) as e:
+        logger.warning("chaos kill of pid %s failed: %s", pid, e)
+        return False
+
+
+async def handle_set_chaos(spec: str = "", seed: int = 0):
+    """Shared RPC handler body (GCS + raylets register it): re-arm this
+    process's registry. An empty spec disarms."""
+    REGISTRY.arm(spec=spec, seed=seed)
+    return {"rules": len(REGISTRY.active_rules()), "pid": os.getpid()}

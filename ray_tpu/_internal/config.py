@@ -27,6 +27,17 @@ _DEFAULTS: Dict[str, Any] = {
     # dropping the request / the response of matching RPC methods.
     # (Reference: src/ray/rpc/rpc_chaos.h RAY_testing_rpc_failure.)
     "testing_rpc_failure": "",
+    # --- chaos harness (_internal/chaos.py) ---
+    # Extended fault spec "method:action:prob[:param],..." with actions
+    # drop_req / drop_resp / delay / dup; folds into one registry with
+    # the legacy testing_rpc_failure rules.
+    "chaos_spec": "",
+    # Seed for the chaos RNG (0 = process-random). A fixed seed makes a
+    # failing chaos run replayable bit-for-bit.
+    "chaos_seed": 0,
+    # Gate on the self-kill RPCs (`cli chaos kill-gcs`): a production
+    # cluster must not expose a remote SIGKILL by default.
+    "chaos_allow_kill": False,
     # --- object store ---
     "object_store_memory_bytes": 2 * 1024**3,
     # Objects <= this many bytes are returned inline in RPC replies and live
@@ -88,6 +99,33 @@ _DEFAULTS: Dict[str, Any] = {
     "worker_liveness_check_period_s": 1.0,
     # --- gcs ---
     "gcs_storage": "memory",  # or a file path for persistence
+    # Persistence path selector once a storage path exists:
+    #   wal    — write-ahead log + compacted snapshot (durable per
+    #            mutation, O(record) appends, torn-write detection)
+    #   legacy — whole-state snapshot rewrite on every mutation (the
+    #            pre-WAL behavior, kept as the A/B arm)
+    #   off    — storage path ignored, nothing persisted
+    "gcs_persist": "wal",
+    # Compact (fold WAL into the snapshot) once the log passes this size.
+    "gcs_wal_compact_bytes": 4 * 1024**2,
+    # fsync appended records (group-committed per event-loop tick).
+    # Off trades the last tick's mutations for bench-grade append speed.
+    "gcs_wal_fsync": True,
+    # Consecutive persist failures (disk full, permissions) before the
+    # GCS emits a rate-limited GCS_PERSIST_FAILING event — durability
+    # loss must be visible, not a logger.exception loop.
+    "gcs_persist_failure_event_threshold": 3,
+    # --- gcs failover / reconnect ---
+    # Consecutive heartbeat failures before a raylet declares the GCS
+    # down and enters its reconnect loop.
+    "gcs_heartbeat_failure_threshold": 3,
+    # Jittered-exponential reconnect schedule (raylets, drivers, the
+    # serve controller and autoscaler all ride backoff.Backoff with
+    # these bounds) and the total give-up deadline for client-side
+    # reconnecting calls (0 = fail fast, no reconnect window).
+    "gcs_reconnect_base_delay_ms": 50,
+    "gcs_reconnect_max_delay_ms": 2000,
+    "gcs_reconnect_timeout_s": 60.0,
     "pubsub_push_timeout_s": 5.0,
     # --- actors ---
     # Bound on actor __init__: a wedged-but-alive worker must fail the

@@ -1977,6 +1977,19 @@ class ActorTaskSubmitter:
         finally:
             st.reconciling = False
 
+    def replay_after_gcs_reconnect(self):
+        """Runs on this shard's loop after the GCS client re-established
+        itself on a new incarnation: pubsub updates published during the
+        outage are gone, so every actor with in-flight or parked work
+        (or a non-terminal unresolved state) re-reconciles against the
+        recovered actor table instead of waiting for the straggler
+        sweep's 30s backstop."""
+        for st in list(self._actors.values()):
+            if st.state == "DEAD":
+                continue
+            if st.inflight or st.queued or st.state != "ALIVE":
+                asyncio.ensure_future(self._reconcile(st))
+
     async def _on_actor_update(self, message: Dict[str, Any]):
         actor_id = message["actor_id"]
         st = self._actors.get(actor_id)
@@ -2617,9 +2630,23 @@ class CoreWorker:
             shard.server.register(
                 "actor_tasks_done",
                 self._make_done_stream_handler(shard.actor_submitter))
+        # GCS failover: when the client re-establishes itself on a new
+        # incarnation, every shard replays its in-flight actor state
+        # (pubsub published during the outage is gone for good).
+        self.gcs.add_reconnect_hook(self._on_gcs_reconnected)
         profiler.maybe_autostart()
         from . import accel
         accel.install_import_hook()  # arm compile tracking at jax import
+
+    def _on_gcs_reconnected(self):
+        """GcsClient reconnect hook (runs on the main loop): fan the
+        replay out to each owner shard's own loop."""
+        for shard in self.shards:
+            sub = shard.actor_submitter
+            if shard.is_main:
+                sub.replay_after_gcs_reconnect()
+            else:
+                shard.post_call(sub.replay_after_gcs_reconnect)
 
     @staticmethod
     def _make_done_stream_handler(actor_submitter: "ActorTaskSubmitter"):
@@ -3552,7 +3579,13 @@ class CoreWorker:
         EventLoopThread.get().loop.call_later(0.05, os._exit, 1)
         return True
 
-    async def handle_ping(self):
+    async def handle_ping(self, gcs_incarnation: Optional[int] = None):
+        # The GCS's driver-liveness sweep piggybacks its incarnation on
+        # the ping: a restart is detected within one sweep period even
+        # when none of this process's own GCS calls ever failed (the
+        # client then re-subscribes pubsub + replays in-flight state).
+        if gcs_incarnation is not None:
+            self.gcs.note_incarnation(gcs_incarnation)
         return "pong"
 
     async def handle_capture_profile(self, kind: str = "pystack",

@@ -16,8 +16,16 @@ gcs_job_manager, pubsub_handler). One per cluster, owns all cluster metadata:
 - the object directory for shared-memory objects (location set per object)
 - job table and task-event collection (state API / timeline backend)
 
-Storage is in-memory tables with an optional snapshot file for fault-tolerant
-restart (the reference's Redis mode).
+Storage is in-memory tables with durable persistence underneath
+(reference: the Redis-backed GCS fault-tolerance mode): every mutation
+appends a typed record to a write-ahead log and a compactor folds the
+log into a snapshot (`gcs_store.py`); recovery = snapshot + WAL-tail
+replay. `RTPU_GCS_PERSIST=legacy|wal|off` selects the old whole-snapshot
+path, the WAL path, or nothing. Each (re)start stamps a monotonic
+**incarnation** id: clients carry the last incarnation they saw, so a
+restarted GCS is detected (they re-register and replay in-flight state)
+and a zombie pre-restart GCS rejects writes from clients that have
+already seen its successor.
 """
 
 from __future__ import annotations
@@ -31,8 +39,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from .backoff import Backoff
 from .config import CONFIG
 from .errors import ActorDiedError, PlacementGroupError
+from . import gcs_store
 from .ids import ActorID, JobID, NodeID, PlacementGroupID
 from .resources import NodeResources, ResourceSet
 from .rpc import Address, ClientPool, RpcServer, get_loop
@@ -105,6 +115,10 @@ class JobRecord:
     end_time: float = 0.0
     metadata: Dict[str, str] = field(default_factory=dict)
     missed_pings: int = 0
+    # Driver-supplied idempotency token: an add_job retry whose original
+    # reply was lost (GCS restart mid-call) coalesces onto the existing
+    # record instead of double-creating the job.
+    token: str = ""
 
 
 class GcsServer:
@@ -165,6 +179,40 @@ class GcsServer:
         # the worker_id their dead lease named, shortly after death.
         self.worker_postmortems: "collections.OrderedDict[str, Dict]" = \
             collections.OrderedDict()
+        # -- durability & incarnation --------------------------------------
+        # Monotonic across restarts (recovered + 1): clients stamp the
+        # incarnation they last saw so restarts are detectable and a
+        # zombie pre-restart GCS can't accept writes from clients that
+        # already follow its successor.
+        self.incarnation = 1
+        self._failovers = 0
+        mode = CONFIG.gcs_persist if persist_path else "off"
+        if mode not in ("wal", "legacy", "off"):
+            logger.warning("unknown gcs_persist mode %r; using 'wal'", mode)
+            mode = "wal"
+        self._persist_mode = mode
+        self._store = gcs_store.DurableStore(persist_path) \
+            if mode == "wal" else None
+        self._persist_fail_streak = 0
+        self._last_persist_fail_event = 0.0
+        self._wal_sync_scheduled = False
+        self._compacting = False
+        self._had_prior_state = False
+        # Registration-event dedupe: (event_type, entity) pairs already
+        # in the event log — a reconnect replaying a registration must
+        # not double-fire JOB_STARTED/ACTOR_*/NODE_ALIVE rows. Seeded
+        # from the recovered log so rows survive across incarnations.
+        # Dict-as-ordered-set: overflow evicts the OLDEST entries (a
+        # wholesale clear would re-enable double-fires for every live
+        # entity at the next reconnect storm).
+        self._event_dedupe: Dict[Tuple[str, str], None] = {}
+        # Per-row sequence stamp: makes event WAL records idempotent on
+        # replay (a crash between compact()'s snapshot rename and the
+        # WAL truncation replays rows the snapshot already holds).
+        self._event_seq = 0
+        # add_job idempotency-token index (token -> job id): O(1) dedupe
+        # of retried registrations; rebuilt from job records at recovery.
+        self._job_tokens: Dict[str, JobID] = {}
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -174,7 +222,36 @@ class GcsServer:
         self.actor_sched_lock = asyncio.Lock()
         self.server.register_instance(self)
         self.address = await self.server.start(host, port)
-        self._restore()
+        self._recover()
+        if self._had_prior_state:
+            self._failovers += 1
+            from .runtime_metrics import runtime_metrics
+            runtime_metrics().gcs_failovers.inc()
+            self.add_event(
+                "GCS_RESTARTED",
+                f"gcs recovered ({self._persist_mode}) as incarnation "
+                f"{self.incarnation}: {len(self.nodes)} nodes, "
+                f"{len(self.actors)} actors, {len(self.jobs)} jobs",
+                severity="WARNING", incarnation=self.incarnation,
+                persist_mode=self._persist_mode)
+            # Replay in-flight control work the old incarnation was
+            # driving: actors mid-(re)schedule resume their loops, PGs
+            # mid-placement resume theirs. ALIVE actors keep their
+            # addresses — their workers live in raylets that survived.
+            for record in self.actors.values():
+                if record.state in ("PENDING", "RESTARTING"):
+                    record.sched_epoch += 1
+                    asyncio.ensure_future(self._schedule_actor(record))
+            for pg in self.pgs.values():
+                if pg.state in ("PENDING", "RESCHEDULING"):
+                    asyncio.ensure_future(self._schedule_pg(pg))
+        if self._persist_mode == "wal":
+            self._mutate("meta", "incarnation", self.incarnation)
+            # Clean base for the new incarnation: fold the recovered WAL
+            # tail into the snapshot so replay work never compounds.
+            self._compact()
+        elif self._persist_mode == "legacy":
+            self._persist()
         self._health_task = asyncio.ensure_future(self._health_check_loop())
         self._started = True
         from . import profiler
@@ -184,54 +261,250 @@ class GcsServer:
     async def stop(self):
         if self._health_task:
             self._health_task.cancel()
+        if self._store is not None:
+            self._store.close()
         await self.server.stop()
 
     # ------------------------------------------------------------------
-    # persistence (reference: redis store client; here a snapshot file)
+    # persistence (reference: redis store client; here WAL + snapshot —
+    # gcs_store.py — with the legacy whole-snapshot path as the A/B arm)
     # ------------------------------------------------------------------
 
+    def _snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "nodes": self.nodes, "actors": self.actors,
+            "named_actors": self.named_actors, "pgs": self.pgs,
+            "jobs": self.jobs, "kv": self.kv,
+            "job_counter": self._job_counter,
+            "events": list(self.events),
+            "incarnation": self.incarnation,
+            "failovers": self._failovers,
+        }
+
     def _persist(self):
-        if not self.persist_path:
+        """Legacy mode: rewrite the whole snapshot (the pre-WAL behavior,
+        kept as the `RTPU_GCS_PERSIST=legacy` A/B arm)."""
+        if not self.persist_path or self._persist_mode != "legacy":
             return
         try:
-            snapshot = serialization.dumps({
-                "nodes": self.nodes, "actors": self.actors,
-                "named_actors": self.named_actors, "pgs": self.pgs,
-                "jobs": self.jobs, "kv": self.kv,
-                "job_counter": self._job_counter,
-                "events": list(self.events),
-            })
-            tmp = self.persist_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(snapshot)
-            import os
-            os.replace(tmp, self.persist_path)
+            gcs_store.write_snapshot(
+                self.persist_path,
+                serialization.dumps(self._snapshot_state()))
         except Exception:
             logger.exception("gcs persist failed")
+            self._note_persist_failure()
+        else:
+            self._note_persist_ok()
 
-    def _restore(self):
-        if not self.persist_path:
+    def _mutate(self, kind: str, key: Any, value: Any,
+                legacy_persist: bool = True):
+        """Record one durable mutation. WAL mode appends a typed record
+        (O(record), fsync group-committed per loop tick); legacy mode
+        falls back to the full-snapshot rewrite for the call sites that
+        persisted before (`legacy_persist=False` marks the new
+        fine-grained sites — per-event and per-KV rows — that the old
+        path only captured incidentally)."""
+        if self._persist_mode == "off":
+            return
+        if self._persist_mode == "legacy":
+            if legacy_persist:
+                self._persist()
             return
         try:
-            with open(self.persist_path, "rb") as f:
-                snap = serialization.loads(f.read())
-        except FileNotFoundError:
-            return
+            nbytes = self._store.append(kind, key, value)
         except Exception:
-            logger.exception("gcs restore failed")
+            logger.exception("gcs wal append failed")
+            self._note_persist_failure()
             return
-        self.nodes = snap["nodes"]
-        self.actors = snap["actors"]
-        self.named_actors = snap["named_actors"]
-        self.pgs = snap["pgs"]
-        self.jobs = snap["jobs"]
-        self.kv = snap["kv"]
-        self._job_counter = snap["job_counter"]
-        self.events = collections.deque(
-            snap.get("events", ()), maxlen=CONFIG.event_log_max_entries)
-        # Nodes must re-register; mark everything stale until they do.
+        self._note_persist_ok()
+        from .runtime_metrics import runtime_metrics
+        runtime_metrics().gcs_wal_bytes.inc(nbytes)
+        if CONFIG.gcs_wal_fsync and not self._wal_sync_scheduled:
+            # Group commit: one fsync per event-loop tick batch.
+            self._wal_sync_scheduled = True
+            try:
+                asyncio.get_running_loop().call_soon(self._wal_sync)
+            except RuntimeError:
+                self._wal_sync()  # off-loop caller (unit tests)
+        # _compacting guards REENTRY through the failure path, not
+        # concurrency: a failing _compact emits GCS_PERSIST_FAILING via
+        # add_event -> _mutate, which would otherwise re-enter _compact
+        # (the log is still over threshold) and recurse.
+        if self._store.wal.size > CONFIG.gcs_wal_compact_bytes \
+                and not self._compacting:
+            self._compact()
+
+    def _wal_sync(self):
+        self._wal_sync_scheduled = False
+        try:
+            self._store.wal.sync()
+        except Exception:
+            logger.exception("gcs wal fsync failed")
+            self._note_persist_failure()
+
+    def _compact(self):
+        """Fold the WAL into the snapshot. Synchronous on the event loop
+        (no awaits between building the state blob and cutting the log,
+        so no record can land in the truncated window)."""
+        if self._store is None:
+            return
+        self._compacting = True
+        try:
+            self._store.compact(
+                serialization.dumps(self._snapshot_state()))
+        except Exception:
+            logger.exception("gcs wal compaction failed")
+            self._note_persist_failure()
+        else:
+            self._note_persist_ok()
+        finally:
+            self._compacting = False
+
+    def _note_persist_failure(self):
+        """Make durability loss VISIBLE: count it, and after N
+        consecutive failures emit a rate-limited event — a GCS whose
+        disk is full must not degrade to an eternal logger.exception."""
+        self._persist_fail_streak += 1
+        from .runtime_metrics import runtime_metrics
+        runtime_metrics().gcs_persist_failures.inc()
+        now = time.monotonic()
+        if self._persist_fail_streak >= \
+                CONFIG.gcs_persist_failure_event_threshold \
+                and now - self._last_persist_fail_event > 60.0:
+            self._last_persist_fail_event = now
+            self.add_event(
+                "GCS_PERSIST_FAILING",
+                f"{self._persist_fail_streak} consecutive GCS persist "
+                f"failures ({self._persist_mode} mode) — cluster state "
+                "is NOT being made durable",
+                severity="ERROR", failures=self._persist_fail_streak,
+                persist_mode=self._persist_mode)
+
+    def _note_persist_ok(self):
+        if self._persist_fail_streak:
+            logger.warning("gcs persistence recovered after %d failures",
+                           self._persist_fail_streak)
+        self._persist_fail_streak = 0
+
+    def _recover(self):
+        if not self.persist_path or self._persist_mode == "off":
+            return
+        if self._persist_mode == "legacy":
+            try:
+                snap = gcs_store.load_snapshot(self.persist_path)
+            except Exception:
+                logger.exception("gcs restore failed")
+                return
+            records: List[Tuple[str, Any, Any]] = []
+        else:
+            try:
+                snap, records = self._store.recover()
+            except Exception:
+                logger.exception("gcs recovery failed; starting empty")
+                return
+        if snap is None and not records:
+            return
+        self._had_prior_state = True
+        if snap is not None:
+            try:
+                self.nodes = snap["nodes"]
+                self.actors = snap["actors"]
+                self.named_actors = snap["named_actors"]
+                self.pgs = snap["pgs"]
+                self.jobs = snap["jobs"]
+                self.kv = snap["kv"]
+                self._job_counter = snap["job_counter"]
+                self.events = collections.deque(
+                    snap.get("events", ()),
+                    maxlen=CONFIG.event_log_max_entries)
+                self._event_seq = max(
+                    (e.get("seq", 0) for e in self.events), default=0)
+                self.incarnation = snap.get("incarnation", 0)
+                self._failovers = snap.get("failovers", 0)
+            except Exception:
+                logger.exception("gcs snapshot malformed; replaying WAL "
+                                 "over empty tables")
+        for kind, key, value in records:
+            try:
+                self._apply_record(kind, key, value)
+            except Exception:
+                logger.exception("gcs wal record (%s) unapplicable; "
+                                 "skipped", kind)
+        self.incarnation += 1
+        # Registration rows already logged must not re-fire after the
+        # re-registration storm that follows a restart.
+        for ev in self.events:
+            entity = ev.get("job_id") or ev.get("actor_id") \
+                or ev.get("node_id")
+            if entity:
+                self._event_dedupe[(ev["type"], entity)] = None
+        # Index allocation resumes past the recovered nodes — a fresh
+        # joiner must not collide with a live node's index (metric tags
+        # and state-API rows key on it).
+        self._next_node_index = max(
+            (r.node_index for r in self.nodes.values()), default=0) + 1
+        # Rebuild the add_job token index from the recovered job table.
+        self._job_tokens = {
+            getattr(r, "token", ""): jid
+            for jid, r in self.jobs.items() if getattr(r, "token", "")}
+        # Nodes must heartbeat (or re-register) to prove liveness; mark
+        # everything fresh until they do.
         for rec in self.nodes.values():
             rec.missed_health_checks = 0
+            rec.last_heartbeat = time.monotonic()
+        logger.warning(
+            "gcs recovered as incarnation %d: %d nodes, %d actors, "
+            "%d jobs, %d kv namespaces (%d wal records replayed)",
+            self.incarnation, len(self.nodes), len(self.actors),
+            len(self.jobs), len(self.kv), len(records))
+
+    def _apply_record(self, kind: str, key: Any, value: Any):
+        """Fold one WAL record into the tables (replay)."""
+        if kind == "node":
+            if value is None:
+                self.nodes.pop(key, None)
+            else:
+                self.nodes[key] = value
+        elif kind == "actor":
+            if value is None:
+                self.actors.pop(key, None)
+            else:
+                self.actors[key] = value
+        elif kind == "named":
+            if value is None:
+                self.named_actors.pop(key, None)
+            else:
+                self.named_actors[key] = value
+        elif kind == "job":
+            self.jobs[key] = value
+        elif kind == "pg":
+            if value is None:
+                self.pgs.pop(key, None)
+            else:
+                self.pgs[key] = value
+        elif kind == "kv":
+            ns, k = key
+            if value is None:
+                self.kv.get(ns, {}).pop(k, None)
+            else:
+                self.kv.setdefault(ns, {})[k] = value
+        elif kind == "counter":
+            self._job_counter = max(self._job_counter, value)
+        elif kind == "event":
+            # Idempotent replay: rows the snapshot already holds (a
+            # crash between compact()'s rename and the WAL truncation
+            # leaves them in both) are skipped by sequence stamp.
+            seq = value.get("seq")
+            if seq is not None and seq <= self._event_seq:
+                return
+            self.events.append(value)
+            if seq is not None:
+                self._event_seq = seq
+        elif kind == "meta":
+            if key == "incarnation":
+                self.incarnation = max(self.incarnation, value)
+        else:
+            logger.warning("unknown gcs wal record kind %r", kind)
 
     # ------------------------------------------------------------------
     # pubsub
@@ -277,6 +550,9 @@ class GcsServer:
         if not overwrite and key in table:
             return False
         table[key] = value
+        # Fine-grained durability the legacy path never had per-put (KV
+        # only rode along with the next whole-state persist).
+        self._mutate("kv", (ns, key), value, legacy_persist=False)
         return True
 
     async def handle_kv_get(self, ns: str, key: str):
@@ -287,7 +563,10 @@ class GcsServer:
         return {k: table[k] for k in keys if k in table}
 
     async def handle_kv_del(self, ns: str, key: str):
-        return self.kv.get(ns, {}).pop(key, None) is not None
+        existed = self.kv.get(ns, {}).pop(key, None) is not None
+        if existed:
+            self._mutate("kv", (ns, key), None, legacy_persist=False)
+        return existed
 
     async def handle_kv_keys(self, ns: str, prefix: str = ""):
         return [k for k in self.kv.get(ns, {}) if k.startswith(prefix)]
@@ -299,35 +578,104 @@ class GcsServer:
     # nodes / resources / health
     # ------------------------------------------------------------------
 
+    def _check_incarnation(self, caller_incarnation: Optional[int]) -> bool:
+        """Zombie-GCS guard: a caller stamping a NEWER incarnation than
+        ours has already registered with our successor — we are a stale
+        process that must not accept its writes. Returns False when the
+        call must be rejected."""
+        return not (caller_incarnation is not None
+                    and caller_incarnation > self.incarnation)
+
     async def handle_register_node(self, node_id: str, address: Address,
                                    resources: Dict[str, float],
                                    labels: Dict[str, str],
-                                   is_head: bool = False):
-        rec = NodeRecord(
-            node_id=node_id, address=tuple(address),
-            resources_total=resources, labels=labels,
-            node_index=self._next_node_index, is_head=is_head,
-            session_name=self.session_name, last_heartbeat=time.monotonic())
-        self._next_node_index += 1
-        self.nodes[node_id] = rec
-        nr = NodeResources(ResourceSet(resources), labels)
-        self._resource_views[node_id] = NodeView(node_id, nr)
-        self._bump_view(node_id)
-        self.publish("NODE", {"event": "ALIVE", "node_id": node_id,
-                              "address": rec.address})
-        self.add_event("NODE_ALIVE", f"node {node_id[:12]} joined",
-                       node_id=node_id, is_head=is_head)
-        self._persist()
-        return {"node_index": rec.node_index, "session_name": self.session_name}
+                                   is_head: bool = False,
+                                   worker_ids: Optional[List[str]] = None,
+                                   gcs_incarnation: Optional[int] = None):
+        if not self._check_incarnation(gcs_incarnation):
+            return {"stale_gcs": True, "incarnation": self.incarnation}
+        rec = self.nodes.get(node_id)
+        if rec is not None and rec.state == "DEAD":
+            # Fencing: a node we declared DEAD had its actors failed
+            # over — letting it back in would resurrect their stale
+            # worker instances alongside the replacements (doubled
+            # actors). Same contract as the heartbeat path: a declared-
+            # dead raylet exits; its host rejoins as a FRESH node id.
+            return {"dead": True, "incarnation": self.incarnation}
+        if rec is not None:
+            # Reconnect-and-replay: the raylet re-announces itself after
+            # a GCS restart (or after its own network blip). Keep its
+            # identity (node_index), refresh address/resources, and
+            # reconcile the announced worker inventory against the actor
+            # table — actors whose workers died during the outage fail
+            # over NOW instead of on first use.
+            rec.address = tuple(address)
+            rec.resources_total = resources
+            rec.labels = labels
+            rec.last_heartbeat = time.monotonic()
+            rec.missed_health_checks = 0
+            nr = NodeResources(ResourceSet(resources), labels)
+            self._resource_views[node_id] = NodeView(node_id, nr)
+            self._bump_view(node_id)
+            self.add_event("NODE_RECONNECTED",
+                           f"node {node_id[:12]} re-registered",
+                           node_id=node_id, is_head=is_head)
+            if worker_ids is not None:
+                await self._reconcile_node_workers(node_id,
+                                                   set(worker_ids))
+        else:
+            rec = NodeRecord(
+                node_id=node_id, address=tuple(address),
+                resources_total=resources, labels=labels,
+                node_index=self._next_node_index, is_head=is_head,
+                session_name=self.session_name,
+                last_heartbeat=time.monotonic())
+            self._next_node_index += 1
+            self.nodes[node_id] = rec
+            nr = NodeResources(ResourceSet(resources), labels)
+            self._resource_views[node_id] = NodeView(node_id, nr)
+            self._bump_view(node_id)
+            self.publish("NODE", {"event": "ALIVE", "node_id": node_id,
+                                  "address": rec.address})
+            self.add_event("NODE_ALIVE", f"node {node_id[:12]} joined",
+                           node_id=node_id, is_head=is_head,
+                           dedupe_key=node_id)
+        self._mutate("node", node_id, rec)
+        return {"node_index": rec.node_index,
+                "session_name": self.session_name,
+                "incarnation": self.incarnation}
+
+    async def _reconcile_node_workers(self, node_id: str,
+                                      live_workers: Set[str]):
+        """Fold a re-registering raylet's worker inventory: ALIVE actors
+        on that node whose worker no longer exists died while the GCS
+        was down (their death report raced the outage) — fail them over
+        now (restart-or-dead per budget)."""
+        for record in list(self.actors.values()):
+            if record.node_id == node_id and record.state == "ALIVE" \
+                    and record.worker_id is not None \
+                    and record.worker_id.hex() not in live_workers:
+                logger.warning(
+                    "actor %s lost its worker during a GCS outage; "
+                    "failing over", record.actor_id.hex()[:12])
+                await self._handle_actor_failure(
+                    record, "worker died during GCS outage")
 
     async def handle_heartbeat(self, node_id: str,
                                resources_available: Dict[str, float],
                                resources_total: Dict[str, float],
                                pending_demand: Optional[List[Dict]] = None,
-                               known_ver: int = -1, known_epoch: int = 0):
+                               known_ver: int = -1, known_epoch: int = 0,
+                               gcs_incarnation: Optional[int] = None):
+        if not self._check_incarnation(gcs_incarnation):
+            return {"stale_gcs": True, "incarnation": self.incarnation}
         rec = self.nodes.get(node_id)
-        if rec is None or rec.state == "DEAD":
-            return {"dead": True}
+        if rec is None:
+            # Not "dead" — unknown. A GCS restarted without this node's
+            # record must ask it to re-register, not to exit.
+            return {"unknown": True, "incarnation": self.incarnation}
+        if rec.state == "DEAD":
+            return {"dead": True, "incarnation": self.incarnation}
         rec.last_heartbeat = time.monotonic()
         rec.missed_health_checks = 0
         view = self._resource_views.get(node_id)
@@ -351,8 +699,10 @@ class GcsServer:
         # Reply with the cluster-view *delta* since the raylet's last known
         # version (reference: ray_syncer.h's versioned resource broadcast —
         # a stable cluster exchanges no per-node payload at all, vs the
-        # O(nodes^2) traffic of full snapshots every interval).
-        reply = {"dead": False,
+        # O(nodes^2) traffic of full snapshots every interval). The
+        # incarnation rides every ack: a raylet seeing it change knows
+        # the GCS restarted and re-announces (workers, reports, view).
+        reply = {"dead": False, "incarnation": self.incarnation,
                  "view": self.view_delta(known_ver, known_epoch)}
         if self._finished_jobs:
             # prune here too: without it the last job ever finished
@@ -500,8 +850,13 @@ class GcsServer:
         drops — this wire has no channel ownership, so an active probe)."""
         async def probe(rec):
             try:
+                # The incarnation rides the liveness ping: a driver that
+                # never noticed the restart (its calls all succeeded or
+                # it was idle) learns of the new incarnation within one
+                # sweep period and re-subscribes its pubsub channels.
                 await self.clients.get(tuple(rec.driver_address)).call(
-                    "ping", timeout=CONFIG.health_check_timeout_s)
+                    "ping", gcs_incarnation=self.incarnation,
+                    timeout=CONFIG.health_check_timeout_s)
                 rec.missed_pings = 0
             except (ConnectionError, ConnectionRefusedError) as e:
                 # Refused/closed connection = the process is GONE (a
@@ -559,9 +914,13 @@ class GcsServer:
                     node_id in [n for n in pg.bundle_nodes if n]:
                 pg.state = "RESCHEDULING"
                 asyncio.ensure_future(self._schedule_pg(pg))
-        self._persist()
+        self._mutate("node", node_id, rec)
 
-    async def handle_report_node_death(self, node_id: str, cause: str):
+    async def handle_report_node_death(self, node_id: str, cause: str,
+                                       gcs_incarnation: Optional[int]
+                                       = None):
+        if not self._check_incarnation(gcs_incarnation):
+            return {"stale_gcs": True}
         await self._on_node_death(node_id, cause)
         return True
 
@@ -571,17 +930,33 @@ class GcsServer:
 
     async def handle_add_job(self, driver_address: Optional[Address],
                              namespace: str,
-                             metadata: Optional[Dict[str, str]] = None):
+                             metadata: Optional[Dict[str, str]] = None,
+                             token: str = ""):
+        if token:
+            # Idempotent re-registration: a driver retrying after a lost
+            # reply (GCS restart mid-call) coalesces onto its existing
+            # job — no duplicate record, no second JOB_STARTED row.
+            existing = self._job_tokens.get(token)
+            if existing is not None:
+                return existing
         self._job_counter += 1
         job_id = JobID.from_int(self._job_counter)
-        self.jobs[job_id] = JobRecord(
+        rec = JobRecord(
             job_id=job_id,
             driver_address=tuple(driver_address) if driver_address else None,
             namespace=namespace, start_time=time.time(),
-            metadata=metadata or {})
+            metadata=metadata or {}, token=token)
+        self.jobs[job_id] = rec
+        if token:
+            self._job_tokens[token] = job_id
         self.add_event("JOB_STARTED", f"job {job_id.hex()[:8]} started",
-                       job_id=job_id.hex())
-        self._persist()
+                       job_id=job_id.hex(), dedupe_key=job_id.hex())
+        # legacy_persist=False on the counter: in legacy mode the job
+        # mutate's full-snapshot write already carries it — one rewrite
+        # per handler, exactly the pre-WAL cost.
+        self._mutate("counter", "job_counter", self._job_counter,
+                     legacy_persist=False)
+        self._mutate("job", job_id, rec)
         return job_id
 
     async def handle_mark_job_finished(self, job_id: JobID):
@@ -613,7 +988,8 @@ class GcsServer:
             if pg.creator_job == job_id and not pg.is_detached \
                     and pg.state != "REMOVED":
                 await self.handle_remove_placement_group(pg.pg_id)
-        self._persist()
+        if rec:
+            self._mutate("job", job_id, rec)
 
     async def handle_get_all_jobs(self):
         return [
@@ -756,11 +1132,31 @@ class GcsServer:
     # ------------------------------------------------------------------
 
     def add_event(self, event_type: str, message: str = "",
-                  severity: str = "INFO", **fields):
+                  severity: str = "INFO",
+                  dedupe_key: Optional[str] = None, **fields):
+        """Append one event row. ``dedupe_key`` marks registration-type
+        rows (JOB_STARTED, NODE_ALIVE, ACTOR registrations): one row per
+        (type, entity) across reconnects AND restarts — the recovered
+        log seeds the dedupe set, so a re-registration storm after
+        failover can't double-fire them."""
+        if dedupe_key is not None:
+            k = (event_type, dedupe_key)
+            if k in self._event_dedupe:
+                return
+            if len(self._event_dedupe) > 50_000:
+                # Evict the oldest fifth (insertion-ordered): recent
+                # entities keep their double-fire protection.
+                for old in list(itertools.islice(self._event_dedupe,
+                                                 10_000)):
+                    del self._event_dedupe[old]
+            self._event_dedupe[k] = None
+        self._event_seq += 1
         ev = {"ts": time.time(), "type": event_type,
-              "severity": severity, "message": message}
+              "severity": severity, "message": message,
+              "seq": self._event_seq}
         ev.update(fields)
         self.events.append(ev)
+        self._mutate("event", None, ev, legacy_persist=False)
 
     async def handle_add_event(self, event_type: str, message: str = "",
                                severity: str = "INFO",
@@ -795,6 +1191,14 @@ class GcsServer:
     async def handle_register_actor(self, spec: TaskSpec, name: str,
                                     namespace: str, is_detached: bool,
                                     get_if_exists: bool = False):
+        actor_id = spec.actor_id
+        prior = self.actors.get(actor_id)
+        if prior is not None and prior.state != "DEAD":
+            # Idempotent re-registration (a driver retrying a call whose
+            # reply was lost across a GCS restart): the record exists —
+            # return it without re-firing ACTOR_* events, scheduling a
+            # second instance, or double-counting.
+            return {"actor_id": actor_id, "existing": True}
         if name:
             existing_id = self.named_actors.get((namespace, name))
             if existing_id is not None:
@@ -805,7 +1209,6 @@ class GcsServer:
                     raise ValueError(
                         f"actor name {name!r} already taken in namespace "
                         f"{namespace!r}")
-        actor_id = spec.actor_id
         record = ActorRecord(
             actor_id=actor_id, spec=spec, name=name, namespace=namespace,
             max_restarts=spec.max_restarts, is_detached=is_detached,
@@ -814,9 +1217,12 @@ class GcsServer:
         self.actors[actor_id] = record
         if name:
             self.named_actors[(namespace, name)] = actor_id
+            # legacy mode: the actor mutate below snapshots everything.
+            self._mutate("named", (namespace, name), actor_id,
+                         legacy_persist=False)
         record.sched_epoch += 1
         asyncio.ensure_future(self._schedule_actor(record))
-        self._persist()
+        self._mutate("actor", actor_id, record)
         return {"actor_id": actor_id, "existing": False}
 
     async def _schedule_actor(self, record: ActorRecord):
@@ -827,7 +1233,7 @@ class GcsServer:
         demand = ResourceSet(spec.resources)
         strategy = spec.scheduling_strategy
         deadline = time.monotonic() + 1e9  # actors wait indefinitely
-        backoff = 0.05
+        bo = Backoff(base_s=0.05, max_s=1.0, mult=1.6)
         # After a lease-RPC timeout the grant is (very likely) still in
         # flight on THAT raylet; the retry must return to the same node
         # so the idempotency key can coalesce — re-picking would strand
@@ -844,8 +1250,7 @@ class GcsServer:
                     node_id = self._pick_node(demand, strategy,
                                               spec.label_selector)
             if node_id is None:
-                await asyncio.sleep(min(backoff, 1.0))
-                backoff *= 1.6
+                await bo.async_sleep()
                 if time.monotonic() > deadline:
                     break
                 continue
@@ -884,8 +1289,7 @@ class GcsServer:
                 logger.warning("actor lease request to %s failed: %s",
                                node_id[:12], e)
                 pinned_node = node_id  # retry where the grant may live
-                await asyncio.sleep(backoff)
-                backoff *= 1.6
+                await bo.async_sleep()
                 continue
             pinned_node = None
             if reply.get("rejected"):
@@ -898,8 +1302,7 @@ class GcsServer:
                             f"worker environment failed: "
                             f"{reply.get('error')}", restartable=False)
                     return
-                await asyncio.sleep(min(backoff, 1.0))
-                backoff *= 1.6
+                await bo.async_sleep()
                 continue
             worker_addr = tuple(reply["worker_address"])
             lease_id = reply["lease_id"]
@@ -956,14 +1359,14 @@ class GcsServer:
                 record.state = "DEAD"
                 record.death_cause = f"creation failed: {result['error']}"
                 self._publish_actor(record)
-                self._persist()
+                self._mutate("actor", record.actor_id, record)
                 return
             record.state = "ALIVE"
             record.address = worker_addr
             record.node_id = node_id
             record.worker_id = reply.get("worker_id")
             self._publish_actor(record)
-            self._persist()
+            self._mutate("actor", record.actor_id, record)
             return
 
     def _pick_node(self, demand: ResourceSet, strategy,
@@ -1031,7 +1434,10 @@ class GcsServer:
             self._publish_actor(record)
             if record.name:
                 self.named_actors.pop((record.namespace, record.name), None)
-        self._persist()
+                # legacy mode: the actor mutate below snapshots it all.
+                self._mutate("named", (record.namespace, record.name),
+                             None, legacy_persist=False)
+        self._mutate("actor", record.actor_id, record)
 
     async def handle_report_actor_failure(self, actor_id: ActorID,
                                           cause: str):
@@ -1044,12 +1450,16 @@ class GcsServer:
                                          cause: str,
                                          postmortem: Optional[Dict[str,
                                                                    Any]]
+                                         = None,
+                                         gcs_incarnation: Optional[int]
                                          = None):
         """Raylet tells us a worker process died; fail any actor on it.
         The raylet's postmortem (exit taxonomy + last captured lines)
         is retained for crashing callers (`get_worker_postmortem`),
         attached to the WORKER_DIED event, and folded into the death
         cause so ActorDiedError carries the actor's last words."""
+        if not self._check_incarnation(gcs_incarnation):
+            return {"stale_gcs": True}
         from . import logplane
         whex = worker_id.hex()
         summary = logplane.summarize_postmortem(postmortem)
@@ -1150,18 +1560,21 @@ class GcsServer:
             self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
             strategy: str, name: str, creator_job: Optional[JobID],
             is_detached: bool = False):
+        if pg_id in self.pgs:
+            # Idempotent re-registration after a reconnect/lost reply.
+            return True
         record = PlacementGroupRecord(
             pg_id=pg_id, bundles=bundles, strategy=strategy, name=name,
             creator_job=creator_job, is_detached=is_detached,
             bundle_nodes=[None] * len(bundles))
         self.pgs[pg_id] = record
         asyncio.ensure_future(self._schedule_pg(record))
-        self._persist()
+        self._mutate("pg", pg_id, record)
         return True
 
     async def _schedule_pg(self, record: PlacementGroupRecord):
         demand = [ResourceSet(b) for b in record.bundles]
-        backoff = 0.05
+        bo = Backoff(base_s=0.05, max_s=1.0, mult=1.6)
         # Rescheduling after a node death: release the surviving nodes'
         # reservations first, else their capacity leaks (and STRICT
         # strategies can become permanently infeasible).
@@ -1171,8 +1584,7 @@ class GcsServer:
             placement = place_bundles(self._resource_views, demand,
                                       record.strategy)
             if placement is None:
-                await asyncio.sleep(min(backoff, 1.0))
-                backoff = min(backoff * 1.6, 1.0)
+                await bo.async_sleep()
                 continue
             ok = await self._try_place(record, placement)
             if ok:
@@ -1181,10 +1593,9 @@ class GcsServer:
                 self.publish("PG", {"pg_id": record.pg_id,
                                     "state": "CREATED",
                                     "bundle_nodes": placement})
-                self._persist()
+                self._mutate("pg", record.pg_id, record)
                 return
-            await asyncio.sleep(min(backoff, 1.0))
-            backoff = min(backoff * 1.6, 1.0)
+            await bo.async_sleep()
 
     async def _try_place(self, record: PlacementGroupRecord,
                          placement: List[str]) -> bool:
@@ -1255,7 +1666,7 @@ class GcsServer:
         await self._cancel_bundles(record)
         self.publish("PG", {"pg_id": pg_id, "state": "REMOVED",
                             "bundle_nodes": []})
-        self._persist()
+        self._mutate("pg", pg_id, record)
         return True
 
     async def handle_get_placement_group(self, pg_id: Optional[PlacementGroupID] = None,
@@ -1297,6 +1708,41 @@ class GcsServer:
 
     async def handle_ping(self):
         return "pong"
+
+    async def handle_gcs_info(self):
+        """Identity + durability status: the probe target for reconnect
+        loops (cheap, side-effect free) and the `cli chaos` / dashboard
+        failover surface."""
+        return {
+            "incarnation": self.incarnation,
+            "session_name": self.session_name,
+            "pid": os.getpid(),
+            "persist_mode": self._persist_mode,
+            "persist_path": self.persist_path,
+            "wal_bytes": self._store.wal.size if self._store else 0,
+            "failovers": self._failovers,
+            "persist_fail_streak": self._persist_fail_streak,
+        }
+
+    # -- chaos harness (cli chaos / tests) -----------------------------
+
+    async def handle_set_chaos(self, spec: str = "", seed: int = 0):
+        from . import chaos
+        return await chaos.handle_set_chaos(spec=spec, seed=seed)
+
+    async def handle_chaos_kill_self(self):
+        """`cli chaos kill-gcs`: SIGKILL this GCS process (the headline
+        failover drill). Gated — a production cluster must opt in via
+        RTPU_CHAOS_ALLOW_KILL=1."""
+        if not CONFIG.chaos_allow_kill:
+            raise PermissionError(
+                "chaos kill refused: set RTPU_CHAOS_ALLOW_KILL=1 on the "
+                "GCS process to allow it")
+        from . import chaos
+        loop = asyncio.get_running_loop()
+        # Reply first, die a beat later.
+        loop.call_later(0.05, chaos.kill_pid, os.getpid())
+        return {"pid": os.getpid()}
 
     # -- continuous profiler (the GCS process is part of the fleet:
     # profile_cluster samples it like any worker/raylet) ---------------

@@ -3,19 +3,45 @@
 Thin async wrappers plus sync bridges for user-thread callers. Subscription
 delivery rides the process's own RpcServer: the GCS pushes `pubsub_message`
 RPCs at us and we fan out to registered callbacks.
+
+Failover: the client tracks the GCS **incarnation** (stamped by the
+server, bumped on every restart). A restart is detected two ways —
+transport failures trigger a jittered-backoff probe loop against
+`gcs_info`, and the GCS's own driver-liveness pings piggyback the
+current incarnation (see `CoreWorker.handle_ping`). On a new
+incarnation the client re-subscribes every pubsub channel it holds
+(subscriptions are server-side soft state, lost with the old process)
+and fires registered reconnect hooks so owners can replay in-flight
+state. `reconnecting_call` additionally rides individual calls through
+a restart window (bounded by `gcs_reconnect_timeout_s`) for callers
+that must not fail across a failover (actor registration, subscribe).
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .backoff import Backoff
 from .config import CONFIG
+from .errors import RpcError
 from .rpc import (DEFAULT_TIMEOUT, Address, EventLoopThread, RpcClient,
                   RpcServer)
 
 logger = logging.getLogger(__name__)
+
+# Transport-level failures that may mean "the GCS is restarting".
+# Deliberately NARROW: a handler's own exception crosses the wire as its
+# original type, and e.g. a PermissionError (an OSError subclass, raised
+# by the gated chaos kill) must fail immediately, not spin the 60s
+# reconnect window. Raw socket errors surface as ConnectionError/
+# RpcError from the rpc layer; a server-side RpcError ("no handler") is
+# the one accepted ambiguity (version skew is transient during a rolling
+# head upgrade).
+_TRANSPORT_ERRORS = (RpcError, ConnectionError, asyncio.TimeoutError)
 
 
 class GcsClient:
@@ -28,18 +54,211 @@ class GcsClient:
         self._subscriptions: Dict[str, List[Callable]] = {}
         if local_server is not None:
             local_server.register("pubsub_message", self._on_pubsub_message)
+        # Failover state: the incarnation we last saw, a single-flight
+        # probe guard, and hooks run after a reconnect (owners replay
+        # in-flight state: actor submitters reconcile, etc.).
+        self._incarnation: Optional[int] = None
+        self._probe_running = False
+        self._probe_lock = threading.Lock()
+        self._down_since: Optional[float] = None
+        self._reconnect_hooks: List[Callable] = []
+        self._closed = False
 
     # -- async core ------------------------------------------------------
 
     async def call(self, method: str, **kwargs) -> Any:
-        return await self.client.call(
-            method, retries=CONFIG.rpc_max_retries, **kwargs)
+        try:
+            return await self.client.call(
+                method, retries=CONFIG.rpc_max_retries, **kwargs)
+        except _TRANSPORT_ERRORS:
+            self._note_failure()
+            raise
 
     def call_sync(self, method: str,
                   timeout: Optional[float] = DEFAULT_TIMEOUT,
                   **kwargs) -> Any:
-        return self.client.call_sync(
-            method, timeout=timeout, retries=CONFIG.rpc_max_retries, **kwargs)
+        try:
+            return self.client.call_sync(
+                method, timeout=timeout, retries=CONFIG.rpc_max_retries,
+                **kwargs)
+        except _TRANSPORT_ERRORS:
+            self._note_failure()
+            raise
+
+    async def reconnecting_call(self, method: str,
+                                timeout: Optional[float] = DEFAULT_TIMEOUT,
+                                **kwargs) -> Any:
+        """`call`, but riding through a GCS restart: transport failures
+        retry on a jittered-exponential schedule until
+        `gcs_reconnect_timeout_s` is exhausted (0 = behave like call).
+        Use only for idempotent calls — the server may have executed an
+        attempt whose reply was lost (registration paths dedupe
+        server-side for exactly this reason)."""
+        window = CONFIG.gcs_reconnect_timeout_s
+        if not window:
+            return await self.call(method, timeout=timeout, **kwargs)
+        bo = Backoff(base_s=CONFIG.gcs_reconnect_base_delay_ms / 1000.0,
+                     max_s=CONFIG.gcs_reconnect_max_delay_ms / 1000.0,
+                     deadline_s=window)
+        while True:
+            try:
+                return await self.client.call(
+                    method, timeout=timeout,
+                    retries=CONFIG.rpc_max_retries, **kwargs)
+            except _TRANSPORT_ERRORS:
+                self._note_failure()
+                if not await bo.async_sleep():
+                    raise
+
+    def call_sync_reconnecting(self, method: str,
+                               timeout: Optional[float] = DEFAULT_TIMEOUT,
+                               **kwargs) -> Any:
+        """Sync bridge for reconnecting_call (user-thread callers that
+        must survive a GCS failover, e.g. actor registration)."""
+        per_call = CONFIG.rpc_call_timeout_s if timeout is DEFAULT_TIMEOUT \
+            else (timeout or 60.0)
+        total = (CONFIG.gcs_reconnect_timeout_s or 0.0) + per_call + 10.0
+        return EventLoopThread.get().run_sync(
+            self.reconnecting_call(method, timeout=timeout, **kwargs),
+            timeout=total)
+
+    # -- failover detection ----------------------------------------------
+
+    def suppress_reconnect(self):
+        """Shutdown is beginning: call failures are expected and must
+        not spawn probe tasks that outlive the process's useful life."""
+        self._closed = True
+
+    def _note_failure(self):
+        """A transport failure MAY mean the GCS is restarting: start the
+        (single-flight) incarnation probe so subscriptions re-establish
+        the moment a live incarnation answers."""
+        if self._closed:
+            return
+        with self._probe_lock:
+            if self._probe_running:
+                return
+            self._probe_running = True
+            if self._down_since is None:
+                self._down_since = time.monotonic()
+        try:
+            EventLoopThread.get().post(self._probe_reconnect())
+        except RuntimeError:
+            with self._probe_lock:
+                self._probe_running = False
+
+    def note_incarnation(self, incarnation: int):
+        """Piggybacked incarnation observation (the GCS's driver-liveness
+        ping carries it): detects a restart even when no call of ours
+        ever failed. Schedules re-subscription when it changed."""
+        if self._incarnation is None:
+            self._incarnation = incarnation
+            return
+        if incarnation != self._incarnation:
+            self._note_failure()
+
+    async def _probe_reconnect(self):
+        """Single-flight probe: poll gcs_info with backoff until a live
+        incarnation answers (bounded by gcs_reconnect_timeout_s), then
+        re-subscribe + fire hooks if the incarnation changed."""
+        bo = Backoff(base_s=CONFIG.gcs_reconnect_base_delay_ms / 1000.0,
+                     max_s=CONFIG.gcs_reconnect_max_delay_ms / 1000.0,
+                     deadline_s=CONFIG.gcs_reconnect_timeout_s or None)
+        try:
+            await self._probe_reconnect_inner(bo)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("gcs reconnect probe failed unexpectedly")
+        finally:
+            with self._probe_lock:
+                self._probe_running = False
+                self._down_since = None
+
+    async def _probe_reconnect_inner(self, bo: Backoff):
+        while True:
+            try:
+                info = await self.client.call(
+                    "gcs_info",
+                    timeout=CONFIG.health_check_timeout_s)
+                break
+            except _TRANSPORT_ERRORS:
+                if not await bo.async_sleep():
+                    logger.warning(
+                        "gcs unreachable for %.0fs; giving up the "
+                        "reconnect probe (a later call retriggers "
+                        "it)", CONFIG.gcs_reconnect_timeout_s)
+                    return
+        incarnation = info.get("incarnation")
+        down_for = (time.monotonic() - self._down_since
+                    if self._down_since else 0.0)
+        if self._incarnation is None or incarnation != self._incarnation:
+            # Changed incarnation = restart. An UNKNOWN baseline (a
+            # worker process whose client was never seeded) must be
+            # treated the same: the failure that armed this probe may
+            # have been a restart, and re-subscribing on a live GCS is
+            # idempotent — skipping it would silently orphan every
+            # pubsub channel this process holds.
+            logger.warning(
+                "gcs reconnected (incarnation %s -> %s, unreachable "
+                "%.2fs); re-subscribing %d channel(s)",
+                self._incarnation, incarnation, down_for,
+                len(self._subscriptions))
+            # Adopt the new incarnation only AFTER resubscription lands:
+            # adopting first would make a failed resubscribe permanent
+            # (every later probe/ping would see a matching incarnation
+            # and skip it — the channel stays orphaned until the next
+            # restart).
+            while not await self._resubscribe_all():
+                if not await bo.async_sleep():
+                    logger.warning(
+                        "re-subscription after GCS restart did not "
+                        "complete; leaving the old incarnation so a "
+                        "later probe retries")
+                    return
+            await self._run_reconnect_hooks()
+            self._incarnation = incarnation
+            from .runtime_metrics import runtime_metrics
+            metrics = runtime_metrics()
+            metrics.gcs_reconnects.inc(tags={"component": "driver"})
+            metrics.gcs_reconnect_latency.observe(
+                down_for, tags={"component": "driver"})
+
+    def add_reconnect_hook(self, hook: Callable):
+        """Register a callable (sync or async, no args) run after the
+        client re-establishes itself on a new GCS incarnation."""
+        self._reconnect_hooks.append(hook)
+
+    async def _run_reconnect_hooks(self):
+        for hook in list(self._reconnect_hooks):
+            try:
+                result = hook()
+                if hasattr(result, "__await__"):
+                    await result
+            except Exception:
+                logger.exception("gcs reconnect hook failed")
+
+    async def _resubscribe_all(self) -> bool:
+        """Subscriptions are GCS-side soft state: re-issue them against
+        the new incarnation so pubsub (actor updates, logs) resumes.
+        Returns False when any channel failed (the caller retries)."""
+        if self._local_server is None \
+                or self._local_server.address is None:
+            return True
+        with self._subs_lock:
+            channels = list(self._subscriptions)
+        ok = True
+        for channel in channels:
+            try:
+                await self.client.call(
+                    "subscribe", channel=channel,
+                    address=self._local_server.address,
+                    retries=CONFIG.rpc_max_retries)
+            except Exception:
+                ok = False
+                logger.warning("re-subscribe of %r after GCS restart "
+                               "failed", channel, exc_info=True)
+        return ok
 
     # -- pubsub ----------------------------------------------------------
 
@@ -62,8 +281,9 @@ class GcsClient:
             first = channel not in self._subscriptions
             self._subscriptions.setdefault(channel, []).append(callback)
         if first:
-            await self.call("subscribe", channel=channel,
-                            address=self._local_server.address)
+            await self.reconnecting_call(
+                "subscribe", channel=channel,
+                address=self._local_server.address)
 
     # -- KV (sync surface used by FunctionManager etc.) -------------------
 

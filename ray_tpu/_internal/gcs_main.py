@@ -1,0 +1,56 @@
+"""Standalone GCS process entrypoint (reference: gcs_server main via
+`ray start --head`). Runs the head's control plane as its own process so
+it can be killed and restarted independently of raylets and drivers —
+the deployment shape the failover machinery (WAL + snapshot recovery,
+client reconnect-and-replay) is built for, and the process the chaos
+harness `kill -9`s in tests/test_gcs_failover.py.
+
+A fixed --port keeps the address stable across restarts (clients
+reconnect; no rediscovery needed). --persist-path points at the durable
+store; RTPU_GCS_PERSIST selects wal/legacy/off."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+def main(argv=None):
+    # Before any ray_tpu lock is constructed in this process.
+    from .lint import sanitizer as _sanitizer
+    _sanitizer.enable_from_env()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--persist-path", default="")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[gcs] %(levelname)s %(name)s: %(message)s")
+
+    from .gcs import GcsServer
+
+    gcs = GcsServer(args.session,
+                    persist_path=args.persist_path or None)
+
+    async def run():
+        address = await gcs.start(args.host, args.port)
+        # readiness protocol line tests/tools wait on
+        print(f"RTPU_GCS_READY {address[0]}:{address[1]} "  # stdout ok: protocol
+              f"incarnation={gcs.incarnation}", flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await gcs.stop()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

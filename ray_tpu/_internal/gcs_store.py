@@ -1,0 +1,221 @@
+"""Durable GCS storage: write-ahead log + compacted snapshot.
+
+Replaces the old whole-state ``_persist()``-per-mutation (one full
+snapshot write for every actor update) with the classic WAL design the
+reference gets from Redis persistence (PAPER §GCS fault tolerance):
+
+- Mutations append one typed record to an append-only log
+  (``<path>.wal``): ``u32 len | u32 crc32 | payload`` where payload is
+  the pickled ``(kind, key, value)`` triple. Appends are O(record), not
+  O(state).
+- A compactor periodically folds the log into the snapshot file
+  (``<path>``, atomic tmp+rename) and truncates the log.
+- Recovery = load snapshot + replay the WAL tail. The length+checksum
+  framing detects torn writes (a crash mid-append): replay stops at the
+  first bad frame and ``open_append`` truncates the tail so new records
+  never land after garbage.
+
+Durability contract: records are flushed to the OS on every append;
+``fsync`` is group-committed (one per event-loop tick batch, see
+``GcsServer._wal_sync_soon``) unless the caller syncs explicitly.
+``RTPU_GCS_PERSIST=legacy|wal|off`` selects this path, the old
+whole-snapshot path, or nothing (gcs.py reads the flag; this module is
+mode-agnostic storage).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import zlib
+from typing import Any, Iterator, List, Optional, Tuple
+
+from . import serialization
+
+logger = logging.getLogger(__name__)
+
+_REC_HDR = struct.Struct("<II")     # u32 payload_len | u32 crc32(payload)
+_MAX_RECORD = 256 * 1024 * 1024     # sanity bound on one record
+
+
+def encode_record(kind: str, key: Any, value: Any) -> bytes:
+    payload = serialization.dumps((kind, key, value))
+    return _REC_HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def scan_records(data: bytes) -> Tuple[List[Tuple[str, Any, Any]], int]:
+    """Decode records from a WAL byte string. Returns (records,
+    clean_length): replay stops at the first torn/corrupt frame and
+    ``clean_length`` is the offset of the last fully valid record — the
+    caller truncates there before appending."""
+    records: List[Tuple[str, Any, Any]] = []
+    off, n = 0, len(data)
+    while n - off >= _REC_HDR.size:
+        length, crc = _REC_HDR.unpack_from(data, off)
+        if length > _MAX_RECORD or n - off - _REC_HDR.size < length:
+            break  # torn tail: the append died mid-write
+        payload = data[off + _REC_HDR.size:off + _REC_HDR.size + length]
+        if zlib.crc32(payload) != crc:
+            logger.warning("gcs wal: checksum mismatch at offset %d; "
+                           "discarding the tail", off)
+            break
+        try:
+            records.append(serialization.loads(payload))
+        except Exception:
+            logger.exception("gcs wal: undecodable record at offset %d; "
+                             "discarding the tail", off)
+            break
+        off += _REC_HDR.size + length
+    return records, off
+
+
+class WriteAheadLog:
+    """Append-only fsync-able record log at ``path``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+        self.size = 0            # offset of the last fully-written record
+        self._size_known = False
+        self._dirty = False  # bytes written since the last fsync
+
+    # -- recovery ----------------------------------------------------------
+
+    def replay(self) -> List[Tuple[str, Any, Any]]:
+        """Read and decode the existing log (empty list if absent)."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            return []
+        records, clean = scan_records(data)
+        if clean != len(data):
+            logger.warning("gcs wal: truncating torn tail (%d -> %d bytes)",
+                           len(data), clean)
+            with open(self.path, "r+b") as f:
+                f.truncate(clean)
+        return records
+
+    # -- appending ---------------------------------------------------------
+
+    def open_append(self):
+        if self._f is not None:
+            return
+        self._f = open(self.path, "ab")
+        end = self._f.tell()
+        if self._size_known and end > self.size:
+            # A previously FAILED append (ENOSPC mid-write) tore the
+            # tail; cut back to the last good record so later appends
+            # never land after garbage — recovery would discard them.
+            logger.warning("gcs wal: truncating torn tail from a failed "
+                           "append (%d -> %d bytes)", end, self.size)
+            self._f.truncate(self.size)
+        else:
+            self.size = end
+        self._size_known = True
+
+    def append(self, kind: str, key: Any, value: Any) -> int:
+        """Append one record; returns bytes written. The write reaches
+        the OS immediately (flush); call sync() to force it to disk.
+        On failure the file handle is dropped so the next append reopens
+        and truncates any torn frame back to the last good record."""
+        self.open_append()
+        rec = encode_record(kind, key, value)
+        try:
+            self._f.write(rec)
+            self._f.flush()
+        except OSError:
+            try:
+                self._f.close()
+            except OSError:
+                logger.debug("wal close after failed append failed",
+                             exc_info=True)
+            self._f = None  # open_append heals the tail next time
+            raise
+        self.size += len(rec)
+        self._dirty = True
+        return len(rec)
+
+    def sync(self):
+        """fsync pending appends (group commit point)."""
+        if self._f is not None and self._dirty:
+            os.fsync(self._f.fileno())
+            self._dirty = False
+
+    def reset(self):
+        """Truncate after a successful compaction (records now live in
+        the snapshot)."""
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        with open(self.path, "wb"):
+            pass
+        self.size = 0
+        self._dirty = False
+
+    def close(self):
+        if self._f is not None:
+            try:
+                self.sync()
+                self._f.close()
+            except OSError:
+                logger.debug("wal close failed", exc_info=True)
+            self._f = None
+
+
+def write_snapshot(path: str, blob: bytes):
+    """Atomic snapshot write: tmp + fsync + rename — a crash mid-write
+    leaves the previous snapshot intact."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str) -> Optional[dict]:
+    try:
+        with open(path, "rb") as f:
+            return serialization.loads(f.read())
+    except FileNotFoundError:
+        return None
+
+
+class DurableStore:
+    """Snapshot + WAL pair rooted at ``path`` (snapshot at ``path``,
+    log at ``path + '.wal'``). The GCS folds records back into its
+    tables via ``apply``-style replay at recovery; this class only owns
+    the bytes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.wal = WriteAheadLog(path + ".wal")
+
+    def recover(self) -> Tuple[Optional[dict], List[Tuple[str, Any, Any]]]:
+        """(snapshot dict or None, WAL tail records)."""
+        snap = None
+        try:
+            snap = load_snapshot(self.path)
+        except Exception:
+            logger.exception("gcs snapshot unreadable; recovering from "
+                             "WAL alone")
+        records = self.wal.replay()
+        return snap, records
+
+    def append(self, kind: str, key: Any, value: Any) -> int:
+        return self.wal.append(kind, key, value)
+
+    def compact(self, blob: bytes):
+        """Fold: write the full-state snapshot, then truncate the log.
+        Must be called with no concurrent appends (the GCS runs this
+        synchronously on its event loop). Ordering matters: the rename
+        lands the new snapshot (which already contains every WAL
+        record's effect) before the log is cut, so a crash between the
+        two replays records that are merely redundant, never missing."""
+        write_snapshot(self.path, blob)
+        self.wal.reset()
+
+    def close(self):
+        self.wal.close()

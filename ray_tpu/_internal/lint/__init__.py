@@ -39,6 +39,11 @@ L008 logging hygiene: bare ``print()`` in ``_internal/`` (outside
      ``# stdout ok: <why>``; ``logging.getLogger`` must take
      ``__name__`` (or no arg for root), and the module-level handle is
      named ``logger``
+L009 retry backoff: ``time.sleep``/``asyncio.sleep`` on the error path
+     of a loop in ``_internal/`` is a hand-rolled retry schedule — use
+     ``backoff.Backoff`` (jittered exponential, cap, deadline) so
+     fleet-wide retry storms don't synchronize, or annotate the line
+     ``# backoff ok: <why>``
 ==== =====================================================================
 
 Violations report ``file:line`` and carry a stable allowlist key
@@ -246,7 +251,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
         prog="rtpulint",
-        description="ray_tpu project lint (rules L001-L008)")
+        description="ray_tpu project lint (rules L001-L009)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     parser.add_argument("--root", default=None,

@@ -128,6 +128,16 @@ _CROSS_SHARD_MARK = "# cross-shard ok"
 # annotated protocol/CLI writes are exempt.
 _STDOUT_OK_MARK = "# stdout ok"
 
+# L009: retry backoff — a raw time.sleep/asyncio.sleep inside an except
+# handler inside a loop is a hand-rolled retry loop; those sleep
+# schedules must come from backoff.Backoff (jittered exponential, cap,
+# deadline) so retry storms across the fleet don't synchronize. The
+# implementation module itself is exempt; deliberate fixed-period waits
+# annotate the line `# backoff ok: <why>`.
+_BACKOFF_OK_MARK = "# backoff ok"
+_BACKOFF_IMPL_FILE = "ray_tpu/_internal/backoff.py"
+_SLEEP_DOTTED = {"time.sleep", "asyncio.sleep"}
+
 
 def _dotted(node: ast.AST) -> Optional[str]:
     """Render a Name/Attribute chain as "a.b.c" (None if not a chain)."""
@@ -204,6 +214,7 @@ class _Linter(ast.NodeVisitor):
         self._scopes: List[_Scope] = [_Scope("<module>", None)]
         self._metric_aliases: set = set()   # Counter/... imported from metrics
         self._loop_depth = 0
+        self._except_depth = 0
         self._hot_path = path in _HOT_PATH_FILES
         self._is_threads_helper = path == _THREADS_HELPER_FILE
         self._is_config = path == "ray_tpu/_internal/config.py"
@@ -240,8 +251,11 @@ class _Linter(ast.NodeVisitor):
     def _visit_scoped(self, node, name: str):
         self._scopes.append(_Scope(name, node))
         outer_loop, self._loop_depth = self._loop_depth, 0
+        # A closure defined inside an except handler does not RUN there.
+        outer_except, self._except_depth = self._except_depth, 0
         self.generic_visit(node)
         self._loop_depth = outer_loop
+        self._except_depth = outer_except
         self._scopes.pop()
 
     def visit_FunctionDef(self, node: ast.FunctionDef):
@@ -292,7 +306,9 @@ class _Linter(ast.NodeVisitor):
                        f"{what} silently swallows — log at debug level, "
                        "narrow the exception type, or allowlist with a "
                        "justification")
+        self._except_depth += 1
         self.generic_visit(node)
+        self._except_depth -= 1
 
     # -- L003 (CONFIG side) --------------------------------------------------
 
@@ -485,6 +501,19 @@ class _Linter(ast.NodeVisitor):
                        "logging.getLogger() in _internal/ must be "
                        "getLogger(__name__) (or argless for the root "
                        "logger)")
+
+        # L009: raw sleep in a retry loop (sleep-on-error inside a loop)
+        # in _internal/ — retry schedules come from backoff.Backoff so
+        # fleet-wide retry storms stay jittered, capped and bounded.
+        if self._internal and self.path != _BACKOFF_IMPL_FILE \
+                and dotted in _SLEEP_DOTTED \
+                and self._loop_depth > 0 and self._except_depth > 0 \
+                and not self._line_marked(node, _BACKOFF_OK_MARK):
+            self._emit("L009", node,
+                       f"{dotted}() on the error path of a retry loop — "
+                       "use backoff.Backoff (jittered exponential, cap, "
+                       "deadline), or annotate the line "
+                       "`# backoff ok: <why a raw sleep is right>`")
 
         # L006: pickler on a hot-path module
         if self._hot_path and term in ("dumps", "loads") \

@@ -49,7 +49,8 @@ class Node:
                  labels: Optional[Dict[str, str]] = None,
                  node_index: int = 0,
                  object_store_memory: Optional[int] = None,
-                 gcs_persist_path: Optional[str] = None):
+                 gcs_persist_path: Optional[str] = None,
+                 gcs_port: int = 0):
         self.head = head
         self.session_name = session_name or new_session_name()
         self.node_index = node_index
@@ -59,7 +60,15 @@ class Node:
         self.gcs_address = gcs_address
         self.raylet: Optional[Raylet] = None
         self.object_store_memory = object_store_memory
+        if gcs_persist_path is None and CONFIG.gcs_storage \
+                not in ("", "memory"):
+            # RTPU_GCS_STORAGE=<path> turns on durable GCS state without
+            # any code change (persistence mode via RTPU_GCS_PERSIST).
+            gcs_persist_path = CONFIG.gcs_storage
         self.gcs_persist_path = gcs_persist_path
+        # Fixed port (head restarts keep their address, so reconnecting
+        # clients need no rediscovery); 0 = ephemeral.
+        self.gcs_port = gcs_port
         self.session_dir = os.path.join("/tmp", "rtpu",
                                         f"session_{self.session_name}")
         os.makedirs(self.session_dir, exist_ok=True)
@@ -69,7 +78,8 @@ class Node:
         if self.head:
             self.gcs = GcsServer(self.session_name,
                                  persist_path=self.gcs_persist_path)
-            self.gcs_address = loop.run_sync(self.gcs.start())
+            self.gcs_address = loop.run_sync(
+                self.gcs.start(port=self.gcs_port))
         assert self.gcs_address is not None
         self.raylet = Raylet(
             session_name=self.session_name,

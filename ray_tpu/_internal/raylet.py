@@ -16,6 +16,7 @@ python/ray/cluster_utils.py).
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import random
@@ -25,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from .backoff import Backoff
 from .config import CONFIG
 from .ids import NodeID, ObjectID, PlacementGroupID, WorkerID
 from . import logplane
@@ -151,6 +153,15 @@ class Raylet:
         self.log_rings = logplane.RingSet()
         self._log_pub_window = logplane.PublishWindow(
             CONFIG.log_pump_inflight_max)
+        # GCS failover state: the incarnation we registered with (a
+        # changed incarnation in any heartbeat ack means the GCS
+        # restarted — re-announce), and reports whose delivery failed
+        # while the GCS was down (replayed after re-registration so
+        # worker deaths/events that raced the outage aren't lost).
+        self._gcs_incarnation: Optional[int] = None
+        self._gcs_reconnecting = False
+        self._gcs_reports_pending: collections.deque = \
+            collections.deque(maxlen=256)
         self._stopped = False
 
     # ------------------------------------------------------------------
@@ -161,11 +172,13 @@ class Raylet:
         self.server.register_instance(self)
         self.address = await self.server.start(host, port)
         gcs = self.clients.get(self.gcs_address)
-        await gcs.call("register_node", node_id=self.node_id,
-                       address=self.address,
-                       resources=self.resources.total.to_dict(),
-                       labels=self.labels, is_head=self.is_head,
-                       retries=CONFIG.rpc_max_retries)
+        reply = await gcs.call("register_node", node_id=self.node_id,
+                               address=self.address,
+                               resources=self.resources.total.to_dict(),
+                               labels=self.labels, is_head=self.is_head,
+                               retries=CONFIG.rpc_max_retries)
+        if isinstance(reply, dict):
+            self._gcs_incarnation = reply.get("incarnation")
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._worker_liveness_loop()))
         if CONFIG.memory_monitor_refresh_ms > 0:
@@ -191,6 +204,7 @@ class Raylet:
     async def _heartbeat_loop(self):
         gcs = self.clients.get(self.gcs_address)
         next_metrics_flush = 0.0
+        hb_failures = 0
         while not self._stopped:
             try:
                 self._update_metrics()
@@ -207,21 +221,175 @@ class Raylet:
                                     for req in self.queued[:100]],
                     known_ver=self._view_ver,
                     known_epoch=self._view_epoch,
+                    gcs_incarnation=self._gcs_incarnation,
                     timeout=CONFIG.health_check_timeout_s)
-                if reply.get("dead"):
+                if reply.get("stale_gcs"):
+                    # A zombie pre-restart GCS answered (we already
+                    # follow its successor): not an ack. If EVERY
+                    # heartbeat says stale (the successor's state was
+                    # lost and it restarted with a lower incarnation),
+                    # reconnect — _reannounce stamps the server's own
+                    # incarnation, so the re-registration is accepted
+                    # and the cluster reforms instead of orbiting a
+                    # GCS that refuses us forever.
+                    logger.warning("heartbeat answered by a stale GCS "
+                                   "incarnation; ignoring")
+                    hb_failures += 1
+                    if hb_failures >= \
+                            CONFIG.gcs_heartbeat_failure_threshold:
+                        await self._reconnect_to_gcs(
+                            "heartbeats answered by a stale GCS "
+                            "incarnation")
+                        hb_failures = 0
+                elif reply.get("dead"):
                     logger.warning("raylet %s marked dead by gcs; exiting",
                                    self.node_id[:12])
                     return
-                self._update_view(reply.get("view", {}))
-                fj = reply.get("finished_jobs")
-                if fj:
-                    self._reap_job_leases(fj)
+                elif reply.get("unknown"):
+                    # The GCS restarted without our record (persistence
+                    # off / lost): re-register instead of exiting.
+                    await self._reconnect_to_gcs(
+                        "gcs lost our registration")
+                    hb_failures = 0
+                else:
+                    hb_failures = 0
+                    inc = reply.get("incarnation")
+                    if inc is not None and self._gcs_incarnation is not None \
+                            and inc != self._gcs_incarnation:
+                        # Restart detected between heartbeats (durable
+                        # GCS knows us, so the ack still succeeded):
+                        # re-announce workers + replay unacked reports.
+                        await self._reconnect_to_gcs(
+                            f"gcs incarnation changed "
+                            f"{self._gcs_incarnation} -> {inc}")
+                    elif inc is not None:
+                        self._gcs_incarnation = inc
+                    self._update_view(reply.get("view", {}))
+                    fj = reply.get("finished_jobs")
+                    if fj:
+                        self._reap_job_leases(fj)
             except asyncio.CancelledError:
                 return
             except Exception:
-                logger.debug("heartbeat to GCS failed; retrying next "
-                             "interval", exc_info=True)
+                hb_failures += 1
+                if hb_failures >= CONFIG.gcs_heartbeat_failure_threshold:
+                    await self._reconnect_to_gcs(
+                        f"{hb_failures} consecutive heartbeat failures")
+                    hb_failures = 0
+                else:
+                    logger.debug("heartbeat to GCS failed; retrying next "
+                                 "interval", exc_info=True)
             await asyncio.sleep(HEARTBEAT_INTERVAL_S)
+
+    # -- GCS failover: reconnect-and-replay ----------------------------
+
+    async def _reconnect_to_gcs(self, reason: str):
+        """Ride through a GCS restart: jittered-exponential probing
+        until a live incarnation answers, then re-register (same
+        node_id, address and resources re-announced, live worker
+        inventory attached so the GCS can fail over actors whose
+        workers died during the outage) and replay reports whose
+        delivery was lost. Never gives up — a raylet without a GCS has
+        no cluster."""
+        if self._gcs_reconnecting:
+            return
+        self._gcs_reconnecting = True
+        t0 = time.monotonic()
+        try:
+            gcs = self.clients.get(self.gcs_address)
+            logger.warning("raylet %s reconnecting to GCS (%s)",
+                           self.node_id[:12], reason)
+            bo = Backoff(
+                base_s=CONFIG.gcs_reconnect_base_delay_ms / 1000.0,
+                max_s=CONFIG.gcs_reconnect_max_delay_ms / 1000.0)
+            info = None
+            while not self._stopped:
+                try:
+                    info = await gcs.call(
+                        "gcs_info", timeout=CONFIG.health_check_timeout_s)
+                    break
+                except Exception:
+                    logger.debug("gcs reconnect probe failed",
+                                 exc_info=True)
+                    await bo.async_sleep()
+            if info is None:  # stopped mid-reconnect
+                return
+            try:
+                accepted = await self._reannounce(info.get("incarnation"))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # The GCS died again between the probe and the register
+                # (or rejected us): the next failed heartbeat re-enters
+                # this loop. Must not raise — one call site is the
+                # heartbeat loop's own except handler, and an escape
+                # there would kill heartbeating for good.
+                logger.warning("gcs re-registration failed; will retry",
+                               exc_info=True)
+                return
+            if not accepted:
+                # Fenced or stale-rejected: not a reconnect — the
+                # failover dashboards must not count a refused node.
+                return
+            elapsed = time.monotonic() - t0
+            from .runtime_metrics import runtime_metrics
+            metrics = runtime_metrics()
+            metrics.gcs_reconnects.inc(tags={"component": "raylet"})
+            metrics.gcs_reconnect_latency.observe(
+                elapsed, tags={"component": "raylet"})
+            logger.warning(
+                "raylet %s re-registered with GCS incarnation %s after "
+                "%.2fs", self.node_id[:12], self._gcs_incarnation,
+                elapsed)
+        finally:
+            self._gcs_reconnecting = False
+
+    async def _reannounce(self, incarnation: Optional[int]) -> bool:
+        """Re-register on the (possibly new) GCS incarnation and replay
+        in-flight state: resource totals, live worker inventory, and any
+        queued reports (worker deaths, events) the outage swallowed.
+        Returns False when the GCS refused us (stale/fenced)."""
+        gcs = self.clients.get(self.gcs_address)
+        worker_ids = [h.worker_id.hex() for h in self.workers.values()
+                      if h.state != "DEAD"]
+        reply = await gcs.call(
+            "register_node", node_id=self.node_id, address=self.address,
+            resources=self.resources.total.to_dict(), labels=self.labels,
+            is_head=self.is_head, worker_ids=worker_ids,
+            gcs_incarnation=incarnation,
+            retries=CONFIG.rpc_max_retries)
+        if isinstance(reply, dict):
+            if reply.get("stale_gcs"):
+                logger.warning("re-registration rejected by a stale GCS")
+                return False
+            if reply.get("dead"):
+                # Fenced out: we were declared dead and our actors
+                # failed over. The next heartbeat's {"dead": True} makes
+                # the heartbeat loop exit this raylet cleanly.
+                logger.warning("re-registration refused: this node was "
+                               "declared dead; exiting on next heartbeat")
+                return False
+            self._gcs_incarnation = reply.get("incarnation")
+        # The new incarnation numbers its view from scratch.
+        self._view_ver = -1
+        self._view_epoch = 0
+        # Replay unacked reports in arrival order; re-queue on failure
+        # (the next reconnect cycle retries).
+        pending = list(self._gcs_reports_pending)
+        self._gcs_reports_pending.clear()
+        for method, kwargs in pending:
+            try:
+                await gcs.call(method, timeout=10, **kwargs)
+            except Exception:
+                logger.debug("replay of %s after reconnect failed",
+                             method, exc_info=True)
+                self._gcs_reports_pending.append((method, kwargs))
+        return True
+
+    def _queue_gcs_report(self, method: str, kwargs: Dict[str, Any]):
+        """Remember a report whose delivery failed (GCS down) for replay
+        after re-registration. Bounded: oldest dropped beyond 256."""
+        self._gcs_reports_pending.append((method, kwargs))
 
     def _update_metrics(self):
         from .runtime_metrics import runtime_metrics
@@ -241,13 +409,19 @@ class Raylet:
 
     def _gcs_event(self, event_type: str, message: str,
                    severity: str = "INFO", **fields):
-        """Best-effort structured event to the GCS event log."""
+        """Best-effort structured event to the GCS event log; failures
+        (GCS down) queue for replay after reconnection."""
         gcs = self.clients.get(self.gcs_address)
+        kwargs = dict(event_type=event_type, message=message,
+                      severity=severity,
+                      fields=dict(fields, node_id=self.node_id))
         fut = asyncio.ensure_future(gcs.call(
-            "add_event", event_type=event_type, message=message,
-            severity=severity, fields=dict(fields, node_id=self.node_id),
-            timeout=10))
-        fut.add_done_callback(lambda f: f.cancelled() or f.exception())
+            "add_event", timeout=10, **kwargs))
+
+        def _done(f):
+            if not f.cancelled() and f.exception() is not None:
+                self._queue_gcs_report("add_event", kwargs)
+        fut.add_done_callback(_done)
 
     def _flush_metrics(self, gcs):
         """Push this process's registry into the metrics KV. Standalone
@@ -737,14 +911,17 @@ class Raylet:
                 kill_reason=handle.kill_reason,
                 cause="worker process died")
             self.log_rings.retire(whex)
+        report = dict(node_id=self.node_id, worker_id=handle.worker_id,
+                      cause="worker process died", postmortem=postmortem)
         try:
             await self.clients.get(self.gcs_address).call(
-                "report_worker_death", node_id=self.node_id,
-                worker_id=handle.worker_id, cause="worker process died",
-                postmortem=postmortem, timeout=10)
+                "report_worker_death", timeout=10, **report)
         except Exception:
-            logger.debug("report_worker_death to GCS failed",
-                         exc_info=True)
+            # GCS down: queue for replay after re-registration — a death
+            # that races the outage must still fail its actor over.
+            logger.debug("report_worker_death to GCS failed; queued for "
+                         "reconnect replay", exc_info=True)
+            self._queue_gcs_report("report_worker_death", report)
 
     # ------------------------------------------------------------------
     # memory monitor (reference: src/ray/common/memory_monitor.h:52 +
@@ -1093,12 +1270,17 @@ class Raylet:
                             "release", handle.worker_id.hex()[:12],
                             lease_id)
                 self._kill_worker(handle)
-                asyncio.ensure_future(self.clients.get(
+                report = dict(
+                    node_id=self.node_id, worker_id=handle.worker_id,
+                    cause="actor worker disposed on lease release")
+                fut = asyncio.ensure_future(self.clients.get(
                     self.gcs_address).call(
-                        "report_worker_death", node_id=self.node_id,
-                        worker_id=handle.worker_id,
-                        cause="actor worker disposed on lease release",
-                        timeout=10))
+                        "report_worker_death", timeout=10, **report))
+                fut.add_done_callback(
+                    lambda f, r=report: (not f.cancelled()
+                                         and f.exception() is not None
+                                         and self._queue_gcs_report(
+                                             "report_worker_death", r)))
             else:
                 handle.state = "IDLE"
                 handle.lease_id = None
@@ -1842,6 +2024,32 @@ class Raylet:
 
     async def handle_ping(self):
         return "pong"
+
+    # -- chaos harness (cli chaos / tests) -----------------------------
+
+    async def handle_set_chaos(self, spec: str = "", seed: int = 0):
+        from . import chaos
+        return await chaos.handle_set_chaos(spec=spec, seed=seed)
+
+    async def handle_chaos_kill_worker(self, worker_hex: str = "",
+                                       pid: int = 0):
+        """SIGKILL one of this raylet's workers (`cli chaos kill-worker`
+        / tests): by worker hex or raw pid. Gated like kill-gcs."""
+        if not CONFIG.chaos_allow_kill:
+            raise PermissionError(
+                "chaos kill refused: set RTPU_CHAOS_ALLOW_KILL=1 on the "
+                "raylet process to allow it")
+        from . import chaos
+        if worker_hex:
+            handle = next((h for h in self.workers.values()
+                           if h.worker_id.hex().startswith(worker_hex)),
+                          None)
+            if handle is None:
+                return False
+            pid = handle.pid
+        if not pid:
+            return False
+        return chaos.kill_pid(pid)
 
     async def handle_get_memory_report(self, limit: int = 10_000,
                                        include_workers: bool = True):

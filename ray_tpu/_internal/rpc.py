@@ -14,8 +14,10 @@ the target environment rather than translated:
   what makes "head node in the driver process" mode cheap.
 - Retry with exponential backoff for idempotent control-plane calls
   (reference: retryable_grpc_client.cc).
-- Fault injection: `testing_rpc_failure` config drops requests/responses by
-  method pattern (reference: rpc_chaos.h) for chaos tests.
+- Fault injection: the seeded chaos registry (`chaos.py`) drops, delays
+  and duplicates requests/responses by method pattern (reference:
+  rpc_chaos.h, grown into `testing_rpc_failure` + `chaos_spec` rules)
+  for deterministic chaos tests.
 
 Wire frames (both transports):
   u32le body_len | u64le msg_id | u8 flags | u16le method_len |
@@ -32,7 +34,6 @@ import asyncio
 import collections
 import logging
 import os
-import random
 import struct
 import threading
 import time
@@ -284,41 +285,13 @@ def _resolve_future(fut: "asyncio.Future", result, exc: Exception = None):
 
 
 # --------------------------------------------------------------------------
-# Chaos / fault injection
+# Chaos / fault injection — the seeded registry in chaos.py owns the
+# rules (legacy `testing_rpc_failure` drop specs + the extended
+# drop/delay/dup grammar); this layer only consults it at the transport
+# decision points.
 # --------------------------------------------------------------------------
 
-class _Chaos:
-    """Parses `testing_rpc_failure` = "method:req_p:resp_p,..." and decides
-    whether to drop a request or response. `method` may be a substring."""
-
-    def __init__(self):
-        self._rules = None
-        self._spec = None
-
-    def _load(self):
-        spec = CONFIG.testing_rpc_failure
-        if spec == self._spec:
-            return
-        self._spec = spec
-        rules = []
-        if spec:
-            for entry in spec.split(","):
-                parts = entry.split(":")
-                rules.append((parts[0], float(parts[1]), float(parts[2])))
-        self._rules = rules
-
-    def drop_request(self, method: str) -> bool:
-        self._load()
-        return any(pat in method and random.random() < p
-                   for pat, p, _ in self._rules)
-
-    def drop_response(self, method: str) -> bool:
-        self._load()
-        return any(pat in method and random.random() < p
-                   for pat, _, p in self._rules)
-
-
-CHAOS = _Chaos()
+from .chaos import REGISTRY as CHAOS  # noqa: E402  (after config import)
 
 # Sentinel distinguishing "use the configured default timeout" from
 # timeout=None, which means no deadline at all (unbounded pushes).
@@ -642,6 +615,9 @@ class RpcServer:
                               msg_id: int, reply, conn, flags: int = 0):
         if CHAOS.drop_request(method):
             return
+        delay = CHAOS.request_delay(method)
+        if delay > 0:
+            await asyncio.sleep(delay)
         try:
             if flags & FLAG_RAW:
                 handler = self._raw_handlers.get(method)
@@ -667,9 +643,18 @@ class RpcServer:
             ok, data = False, serialization.dumps(
                 RpcError(f"unpicklable reply: {e}"))
         flags = FLAG_RESP | (FLAG_OK if ok else 0)
-        waiter = reply(conn, pack_frame(msg_id, flags, b"", data))
+        frame = pack_frame(msg_id, flags, b"", data)
+        waiter = reply(conn, frame)
         if waiter is not None:
             await waiter  # transport backpressure
+        if CHAOS.duplicate_response(method):
+            # Chaos dup: deliver the reply twice — the client's pending-
+            # future pop makes the second frame a no-op there, but
+            # callers above (lease grants, death reports) must stay
+            # idempotent against transport-level redelivery.
+            waiter = reply(conn, frame)
+            if waiter is not None:
+                await waiter
 
 
 # --------------------------------------------------------------------------
@@ -806,6 +791,7 @@ class RpcClient:
         if timeout is DEFAULT_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
         attempt = 0
+        bo = None  # built on first failure — the success path pays nothing
         while True:
             try:
                 return await self._call_once(method, kwargs, timeout)
@@ -816,10 +802,12 @@ class RpcClient:
                         raise RpcError(
                             f"rpc {method} to {self.address} timed out") from e
                     raise
-                delay = min(
-                    CONFIG.rpc_retry_base_delay_ms * (2 ** (attempt - 1)),
-                    CONFIG.rpc_retry_max_delay_ms) / 1000.0
-                await asyncio.sleep(delay * (0.5 + random.random()))
+                if bo is None:
+                    from .backoff import Backoff
+                    bo = Backoff(
+                        base_s=CONFIG.rpc_retry_base_delay_ms / 1000.0,
+                        max_s=CONFIG.rpc_retry_max_delay_ms / 1000.0)
+                await bo.async_sleep()
 
     async def _call_once(self, method: str, payload: Dict[str, Any],
                          timeout: float) -> Any:
@@ -831,6 +819,9 @@ class RpcClient:
             # its handler here.
             if CHAOS.drop_request(method) or CHAOS.drop_response(method):
                 raise asyncio.TimeoutError()
+            delay = CHAOS.request_delay(method)
+            if delay > 0:
+                await asyncio.sleep(delay)
             owner = _local_owner_loop(local)
             if owner is not None:
                 return await _await_on_owner_loop(

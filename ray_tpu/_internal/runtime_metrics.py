@@ -150,6 +150,29 @@ def _build() -> SimpleNamespace:
             "rtpu_log_ring_bytes",
             "Bytes resident across this raylet's worker log rings",
             tag_keys=("node",)),
+        # -- GCS durability & failover plane --
+        gcs_failovers=Counter(
+            "rtpu_gcs_failovers_total",
+            "GCS recoveries from persisted state (restart with a "
+            "prior incarnation on disk)"),
+        gcs_wal_bytes=Counter(
+            "rtpu_gcs_wal_bytes_total",
+            "Bytes appended to the GCS write-ahead log"),
+        gcs_persist_failures=Counter(
+            "rtpu_gcs_persist_failures_total",
+            "Failed GCS persist operations (WAL append / snapshot "
+            "write) — nonzero means durability is degraded"),
+        gcs_reconnects=Counter(
+            "rtpu_gcs_reconnects_total",
+            "Completed GCS reconnect cycles (client detected the GCS "
+            "down, then re-registered on a live incarnation)",
+            tag_keys=("component",)),
+        gcs_reconnect_latency=Histogram(
+            "rtpu_gcs_reconnect_seconds",
+            "GCS-down detection to successful re-registration, per "
+            "reconnecting component (raylet / driver)",
+            boundaries=_LATENCY_BOUNDARIES,
+            tag_keys=("component",)),
         # -- continuous profiler meta-metrics (the profiler profiles
         # itself: sample volume, ring overflow, per-pass overhead) --
         profiler_samples=Counter(

@@ -207,7 +207,10 @@ class ActorClass:
             is_asyncio=self._is_asyncio(),
             is_detached=detached,
         )
-        reply = worker.gcs.call_sync(
+        # Reconnecting + idempotent (the GCS dedupes on actor_id): a GCS
+        # restart mid-registration retries onto the new incarnation
+        # instead of failing the creation.
+        reply = worker.gcs.call_sync_reconnecting(
             "register_actor", spec=spec, name=opts.get("name", "") or "",
             namespace=opts.get("namespace", "") or "",
             is_detached=detached,

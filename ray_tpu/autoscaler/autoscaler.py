@@ -215,9 +215,16 @@ class Monitor:
         self._stop.set()
 
     def _loop(self):
+        from .._internal.backoff import Backoff
+        bo = None  # armed while reconciles fail (GCS failover)
         while not self._stop.is_set():
+            wait = self.interval_s
             try:
                 self.autoscaler.reconcile()
+                bo = None
             except Exception:  # noqa: BLE001 — keep reconciling
                 logger.exception("autoscaler reconcile failed")
-            self._stop.wait(self.interval_s)
+                if bo is None:
+                    bo = Backoff(base_s=self.interval_s, max_s=30.0)
+                wait = bo.next_delay() or 30.0
+            self._stop.wait(wait)

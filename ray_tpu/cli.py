@@ -24,6 +24,8 @@ Usage: python -m ray_tpu.cli <command> ...
   dashboard                                              start + print URL
   submit   [--wait] -- ENTRYPOINT...                     submit a job
   job      {logs,stop,list} [ID]
+  chaos    {show,set,clear,kill-gcs,kill-worker}         fault injection
+           [--spec S] [--seed N] [--pid P]               drills / failover
   perf     [--quick]                                     microbenchmarks
 
 The head address is written to /tmp/rtpu/head_address; commands default
@@ -639,6 +641,66 @@ def cmd_job(args):
         print("stopped" if manager.stop_job(args.id) else "not running")
 
 
+def cmd_chaos(args):
+    """Fault-injection drills (the deterministic chaos harness,
+    _internal/chaos.py): arm/disarm RPC fault rules cluster-wide, show
+    the GCS's failover status, and kill processes for failover tests."""
+    _connect(args)
+    from ray_tpu.util.state import api as state_api
+    if args.action == "show":
+        info = state_api.gcs_info()
+        from ray_tpu._internal.chaos import REGISTRY
+        out = {"gcs": info, "local_rules": [vars(r) for r in
+                                           REGISTRY.active_rules()],
+               "local_hits": REGISTRY.hit_counts()}
+        if args.json:
+            print(json.dumps(out, indent=2, default=str))
+        else:
+            print(f"gcs incarnation {info['incarnation']} "
+                  f"(pid {info['pid']}, persist={info['persist_mode']}, "
+                  f"wal={info['wal_bytes']}B, "
+                  f"failovers={info['failovers']})")
+            for r in out["local_rules"]:
+                print(f"  rule {r['pattern']}:{r['action']}"
+                      f":{r['prob']}" + (f":{r['param']}"
+                                         if r["param"] else ""))
+            for site, n in out["local_hits"].items():
+                print(f"  hits {site}: {n}")
+    elif args.action == "set":
+        if not args.spec:
+            raise SystemExit("chaos set requires --spec "
+                             "(method:action:prob[:param],...)")
+        rows = state_api.set_chaos(spec=args.spec, seed=args.seed)
+        for row in rows:
+            print(row)
+    elif args.action == "clear":
+        for row in state_api.set_chaos(spec="", seed=0):
+            print(row)
+    elif args.action == "kill-gcs":
+        info = state_api.gcs_info()
+        print(f"SIGKILLing gcs incarnation {info['incarnation']} "
+              f"(pid {info['pid']})...")
+        try:
+            state_api._gcs().call_sync("chaos_kill_self", timeout=10)
+        except Exception as e:  # noqa: BLE001 — death races the reply
+            print(f"(kill call returned {e!r})")
+    elif args.action == "kill-worker":
+        import ray_tpu
+        from ray_tpu._internal.core_worker import get_core_worker
+        cw = get_core_worker()
+        for node in ray_tpu.nodes():
+            if args.node and not node["node_id"].startswith(args.node):
+                continue
+            ok = cw.run_sync(cw.clients.get(tuple(node["address"])).call(
+                "chaos_kill_worker", worker_hex=args.worker or "",
+                pid=args.pid, timeout=10), timeout=15)
+            print(f"node {node['node_id'][:12]}: {ok}")
+            if ok:
+                break
+    else:
+        raise SystemExit(f"unknown chaos action {args.action!r}")
+
+
 def cmd_perf(args):
     from ray_tpu import perf
     perf.main(quick=args.quick)
@@ -803,6 +865,28 @@ def main(argv=None):
     p.add_argument("id", nargs="?")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_job)
+
+    p = sub.add_parser(
+        "chaos",
+        help="fault-injection drills: arm/disarm rpc chaos rules, "
+             "show failover status, kill the GCS or a worker")
+    p.add_argument("action",
+                   choices=["show", "set", "clear", "kill-gcs",
+                            "kill-worker"])
+    p.add_argument("--address")
+    p.add_argument("--spec", default="",
+                   help="method:action:prob[:param],... with actions "
+                        "drop_req|drop_resp|delay|dup")
+    p.add_argument("--seed", type=int, default=0,
+                   help="chaos RNG seed (0 = process-random)")
+    p.add_argument("--pid", type=int, default=0,
+                   help="kill-worker: worker pid")
+    p.add_argument("--worker", default="",
+                   help="kill-worker: worker id hex prefix")
+    p.add_argument("--node", default="",
+                   help="kill-worker: restrict to one node id prefix")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("perf")
     p.add_argument("--quick", action="store_true")

@@ -129,11 +129,18 @@ class ClientContext:
         self._ping_thread.start()
 
     def _keepalive(self):
-        while not self._ping_stop.wait(30.0):
+        from .._internal.backoff import Backoff
+        bo = None  # armed while pings fail: retry on the shared schedule
+        wait = 30.0
+        while not self._ping_stop.wait(wait):
             try:
                 self._rpc("ping", session_id=self._session_id)
+                bo, wait = None, 30.0
             except Exception:
                 logger.debug("client keepalive ping failed", exc_info=True)
+                if bo is None:
+                    bo = Backoff(base_s=1.0, max_s=30.0)
+                wait = bo.next_delay() or 30.0
 
     # -- plumbing --------------------------------------------------------
 

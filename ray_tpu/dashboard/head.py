@@ -16,6 +16,8 @@ Routes:
   GET  /api/memory                    cluster memory summary (stores,
                                       per-object refs, leak heuristic)
   GET  /api/events                    GCS cluster event log
+  GET  /api/gcs                       GCS failover status (incarnation,
+                                      persist mode, WAL bytes, failovers)
   GET  /api/traces                    recorded trace summaries
   GET  /api/traces/<trace_id>         one trace's span tree
   GET  /api/profile                   cluster CPU profile (no ?pid=) or
@@ -207,6 +209,10 @@ class DashboardHead:
                 event_type=query.get("type"),
                 since=float(since) if since else None,
                 limit=int(query.get("limit", 500))))
+        if path == "/api/gcs":
+            # Failover surface: incarnation, persist mode, WAL bytes,
+            # failover count, persist-failure streak.
+            return self._json(st.gcs_info())
         if path == "/api/traces":
             return self._json(st.list_traces(
                 limit=int(query.get("limit", 100))))

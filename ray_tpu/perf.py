@@ -708,6 +708,121 @@ def shards_bench(shard_counts=(1, 2, 4), quick: bool = False
     return results
 
 
+def failover_bench(quick: bool = False) -> Dict[str, float]:
+    """GCS durability + failover numbers (PERF.md round-13):
+
+    - persist-path overhead per mutation, A/B across
+      RTPU_GCS_PERSIST=off|legacy|wal (the WAL's O(record) append vs the
+      legacy whole-snapshot rewrite),
+    - recovery time (snapshot + WAL-tail replay) for a populated store,
+    - time-to-first-task-after-restart on a live cluster (in-process GCS
+      restart at the same address; raylet re-registers, a fresh actor
+      schedules on the new incarnation).
+    """
+    import os
+    import tempfile
+
+    from ray_tpu._internal.config import CONFIG
+    from ray_tpu._internal.gcs import GcsServer
+    from ray_tpu._internal.rpc import EventLoopThread
+
+    results: Dict[str, float] = {}
+    loop = EventLoopThread.get()
+    n_fast = 2000 if quick else 10000
+    n_legacy = 100 if quick else 300  # whole-snapshot per op: keep small
+
+    for mode in ("off", "legacy", "wal"):
+        CONFIG.apply_system_config({"gcs_persist": mode})
+        tmp = tempfile.mkdtemp(prefix=f"rtpu-failover-{mode}-")
+        path = os.path.join(tmp, "gcs.db")
+        gcs = GcsServer("perf", persist_path=path)
+        loop.run_sync(gcs.start())
+        # add_job persists in EVERY mode (legacy rewrote the whole
+        # snapshot per call — the n must stay small there; the WAL
+        # appends three O(record) rows).
+        n = n_legacy if mode == "legacy" else n_fast
+
+        async def _pound(gcs=gcs, n=n):
+            import asyncio
+            for i in range(n):
+                await gcs.handle_add_job(driver_address=None,
+                                         namespace="bench")
+                # One loop tick per mutation, as real RPC arrivals pay:
+                # the group-commit fsync callback fires per tick — a
+                # no-yield loop would amortize ALL fsyncs into one.
+                await asyncio.sleep(0)
+        start = time.perf_counter()
+        loop.run_sync(_pound())
+        per_op_us = (time.perf_counter() - start) / n * 1e6
+        results[f"gcs_mutation_{mode}_us"] = per_op_us
+        _report(f"gcs_mutation_{mode}_us", per_op_us, "us/op")
+        if mode == "wal":
+            # ... plus the fine-grained KV append path (new in wal mode)
+            payload = b"x" * 256
+
+            async def _kv(gcs=gcs, n=n_fast):
+                import asyncio
+                for i in range(n):
+                    await gcs.handle_kv_put(ns="bench", key=f"k{i}",
+                                            value=payload)
+                    await asyncio.sleep(0)  # fsync per tick (see above)
+            start = time.perf_counter()
+            loop.run_sync(_kv())
+            kv_us = (time.perf_counter() - start) / n_fast * 1e6
+            results["gcs_kv_append_wal_us"] = kv_us
+            _report("gcs_kv_append_wal_us", kv_us, "us/op")
+            loop.run_sync(gcs.stop())
+            start = time.perf_counter()
+            gcs2 = GcsServer("perf", persist_path=path)
+            loop.run_sync(gcs2.start())
+            recovery_ms = (time.perf_counter() - start) * 1e3
+            assert len(gcs2.kv.get("bench", {})) == n_fast
+            assert len(gcs2.jobs) == n
+            results["gcs_recovery_ms"] = recovery_ms
+            _report("gcs_recovery_ms", recovery_ms, "ms")
+            loop.run_sync(gcs2.stop())
+        else:
+            loop.run_sync(gcs.stop())
+        CONFIG.reset()
+
+    # -- time-to-first-task-after-restart on a live cluster ------------
+    import ray_tpu
+    from ray_tpu._internal.node import Node
+    CONFIG.apply_system_config({"gcs_persist": "wal"})
+    tmp = tempfile.mkdtemp(prefix="rtpu-failover-e2e-")
+    path = os.path.join(tmp, "gcs.db")
+    node = Node(head=True, resources={"CPU": 4}, gcs_persist_path=path)
+    node.start()
+    ray_tpu.init(_node=node, log_to_driver=False)
+    try:
+        @ray_tpu.remote
+        class Probe:
+            def ping(self):
+                return 1
+
+        warm = Probe.remote()
+        ray_tpu.get(warm.ping.remote(), timeout=60)
+        port = node.gcs_address[1]
+        start = time.perf_counter()
+        loop.run_sync(node.gcs.stop())
+        new_gcs = GcsServer(node.session_name, persist_path=path)
+        loop.run_sync(new_gcs.start(port=port))
+        node.gcs = new_gcs
+        # First NEW control-plane work on the new incarnation: schedule
+        # a fresh actor and run one call on it.
+        fresh = Probe.remote()
+        ray_tpu.get(fresh.ping.remote(), timeout=120)
+        ttft_ms = (time.perf_counter() - start) * 1e3
+        results["gcs_restart_first_task_ms"] = ttft_ms
+        _report("gcs_restart_first_task_ms", ttft_ms, "ms")
+        # The pre-restart actor still answers (zero lost state).
+        ray_tpu.get(warm.ping.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+        CONFIG.reset()
+    return results
+
+
 def collectives_bench(world: int = 8, mb: int = 64) -> Dict[str, float]:
     """Host-plane collective microbench: ring vs star allreduce of
     `mb` MiB float32 across `world` single-process ranks.
@@ -786,6 +901,11 @@ if __name__ == "__main__":
                         help="log-plane overhead microbench: per-line "
                              "stamp/parse/ring cost + print-heavy "
                              "cluster A/B (plane on vs kill switch)")
+    parser.add_argument("--failover", action="store_true",
+                        help="GCS durability/failover bench: per-"
+                             "mutation persist A/B (off/legacy/wal), "
+                             "recovery time, time-to-first-task after "
+                             "an in-process GCS restart")
     parser.add_argument("--shards", nargs="?", const="1,2,4",
                         default=None, metavar="N,N,...",
                         help="owner-shard A/B: n:n + multi-client at "
@@ -805,6 +925,8 @@ if __name__ == "__main__":
         accel_bench()
     elif args.logplane:
         logplane_bench()
+    elif args.failover:
+        failover_bench(quick=args.quick)
     elif args.shards:
         shards_bench(tuple(int(x) for x in args.shards.split(",")),
                      quick=args.quick)

@@ -244,8 +244,10 @@ class ServeController:
     # -- reconcile loop ----------------------------------------------------
 
     async def _reconcile_loop(self):
+        from ray_tpu._internal.backoff import Backoff
         metrics_interval = 0.25
         last_metrics = 0.0
+        bo = None  # armed while ticks fail (GCS failover, replica churn)
         while not self._shutdown:
             try:
                 for key, state in list(self.deployments.items()):
@@ -257,9 +259,18 @@ class ServeController:
                 if now - last_metrics >= metrics_interval:
                     last_metrics = now
                     await self._collect_metrics_and_autoscale()
+                bo = None
             except Exception:  # noqa: BLE001 — the loop must survive
                 logger.exception("reconcile tick failed")
-            await asyncio.sleep(0.05)
+                if bo is None:
+                    # Failing ticks (e.g. the control plane mid-failover)
+                    # back off jittered-exponentially instead of spinning
+                    # the failure at full tick rate.
+                    bo = Backoff(base_s=0.05, max_s=2.0)
+            if bo is not None:
+                await bo.async_sleep()
+            else:
+                await asyncio.sleep(0.05)
 
     async def _collect_metrics_and_autoscale(self):
         for state in self.deployments.values():

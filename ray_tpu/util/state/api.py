@@ -1090,3 +1090,35 @@ def list_events(event_type: Optional[str] = None,
     transitions, job state, SPILL/RESTORE, MEMORY_PRESSURE...)."""
     return _gcs().call_sync("get_events", event_type=event_type,
                             since=since, severity=severity, limit=limit)
+
+
+def gcs_info() -> Dict[str, Any]:
+    """GCS identity + durability status: incarnation, persist mode, WAL
+    size, failover count (the `cli chaos` / dashboard failover surface)."""
+    return _gcs().call_sync("gcs_info")
+
+
+def set_chaos(spec: str = "", seed: int = 0) -> List[Dict[str, Any]]:
+    """Arm (or, with an empty spec, disarm) the fault-injection registry
+    on the GCS and every live raylet. Returns one row per process.
+    Workers pick rules up through their own CONFIG env; this call covers
+    the control plane, which is where the chaos harness aims."""
+    rows = []
+    reply = _gcs().call_sync("set_chaos", spec=spec, seed=seed)
+    rows.append(dict(reply, component="gcs"))
+    from ..._internal.core_worker import get_core_worker
+    worker = get_core_worker()
+
+    def _one(node):
+        return worker.run_sync(
+            worker.clients.get(tuple(node["address"])).call(
+                "set_chaos", spec=spec, seed=seed, timeout=10), timeout=15)
+
+    for node, result, error in _fanout(_live_nodes(), _one):
+        row = {"component": "raylet", "node_id": node["node_id"]}
+        if error is not None:
+            row["error"] = error
+        else:
+            row.update(result)
+        rows.append(row)
+    return rows

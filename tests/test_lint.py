@@ -362,6 +362,91 @@ def test_l008_module_handle_naming():
     assert "L008" not in _rules(bad, path="ray_tpu/util/foo.py")
 
 
+def test_l009_sleep_in_retry_loop_fires():
+    src = ("import time\n"
+           "def f():\n"
+           "    while True:\n"
+           "        try:\n"
+           "            work()\n"
+           "        except Exception:\n"
+           "            time.sleep(1.0)\n")
+    assert "L009" in _rules(src, path="ray_tpu/_internal/foo.py")
+    src_async = ("import asyncio\n"
+                 "async def f():\n"
+                 "    while True:\n"
+                 "        try:\n"
+                 "            await work()\n"
+                 "        except Exception:\n"
+                 "            await asyncio.sleep(1.0)\n")
+    assert "L009" in _rules(src_async, path="ray_tpu/_internal/foo.py")
+
+
+def test_l009_annotated_backoff_impl_and_non_retry_ok():
+    annotated = ("import time\n"
+                 "def f():\n"
+                 "    while True:\n"
+                 "        try:\n"
+                 "            work()\n"
+                 "        except Exception:\n"
+                 "            time.sleep(1.0)  # backoff ok: fixed probe\n")
+    assert "L009" not in _rules(annotated,
+                                path="ray_tpu/_internal/foo.py")
+    # the sanctioned replacement: Backoff drives the schedule
+    backoff = ("from .backoff import Backoff\n"
+               "async def f():\n"
+               "    bo = Backoff()\n"
+               "    while True:\n"
+               "        try:\n"
+               "            return await work()\n"
+               "        except Exception:\n"
+               "            await bo.async_sleep()\n")
+    assert "L009" not in _rules(backoff, path="ray_tpu/_internal/foo.py")
+    # a periodic heartbeat sleep at loop tail is not a retry schedule
+    periodic = ("import asyncio\n"
+                "async def f():\n"
+                "    while True:\n"
+                "        try:\n"
+                "            await tick()\n"
+                "        except Exception:\n"
+                "            pass  # logged elsewhere\n"
+                "        await asyncio.sleep(0.2)\n")
+    assert "L009" not in _rules(periodic,
+                                path="ray_tpu/_internal/foo.py")
+    # the implementation module is exempt
+    impl = ("import time\n"
+            "def sleep_loop():\n"
+            "    while True:\n"
+            "        try:\n"
+            "            return 1\n"
+            "        except Exception:\n"
+            "            time.sleep(0.1)\n")
+    assert "L009" not in _rules(impl,
+                                path="ray_tpu/_internal/backoff.py")
+    # outside _internal/ the rule is advisory
+    assert "L009" not in _rules(
+        "import time\n"
+        "def f():\n"
+        "    while True:\n"
+        "        try:\n"
+        "            work()\n"
+        "        except Exception:\n"
+        "            time.sleep(1.0)\n", path="ray_tpu/cli.py")
+
+
+def test_l009_closure_inside_except_not_flagged():
+    # a function DEFINED inside an except handler doesn't run there
+    src = ("import time\n"
+           "def f():\n"
+           "    while True:\n"
+           "        try:\n"
+           "            work()\n"
+           "        except Exception:\n"
+           "            def later():\n"
+           "                time.sleep(1.0)\n"
+           "            schedule(later)\n")
+    assert "L009" not in _rules(src, path="ray_tpu/_internal/foo.py")
+
+
 # ---------------------------------------------------------------------------
 # full tree + allowlist contract (tier-1 gate)
 # ---------------------------------------------------------------------------

@@ -213,15 +213,30 @@ def test_gcs_event_log_filters_and_bound():
 
 
 def test_event_log_survives_persist_restore(tmp_path):
+    from ray_tpu._internal.config import CONFIG
     from ray_tpu._internal.gcs import GcsServer
 
+    # WAL mode (the default): add_event appends a durable record.
     path = str(tmp_path / "gcs.snap")
     gcs = GcsServer("evt-persist", persist_path=path)
     gcs.add_event("NODE_ALIVE", "n up", node_id="n1")
-    gcs._persist()
+    gcs._store.close()
     fresh = GcsServer("evt-persist", persist_path=path)
-    fresh._restore()
+    fresh._recover()
     assert [e["type"] for e in fresh.events] == ["NODE_ALIVE"]
+
+    # Legacy whole-snapshot mode keeps the old contract.
+    CONFIG.apply_system_config({"gcs_persist": "legacy"})
+    try:
+        lpath = str(tmp_path / "gcs-legacy.snap")
+        lgcs = GcsServer("evt-persist", persist_path=lpath)
+        lgcs.add_event("NODE_ALIVE", "n up", node_id="n1")
+        lgcs._persist()
+        lfresh = GcsServer("evt-persist", persist_path=lpath)
+        lfresh._recover()
+        assert [e["type"] for e in lfresh.events] == ["NODE_ALIVE"]
+    finally:
+        CONFIG.reset()
 
 
 def test_plasma_size_of_arena_no_copy(tmp_path):
