@@ -216,6 +216,12 @@ _DEFAULTS: Dict[str, Any] = {
     # silently doing nothing) ---
     # Disable the flat-wire task codec; every spec rides the pickle path.
     "no_flat_wire": False,
+    # Disable the native receive path (PR 11): frames are delivered raw
+    # and decoded in Python, done streams ride the legacy pickled
+    # oneway, and refcount decrements go one RPC per object — the
+    # exact-legacy A/B arm. Receivers still understand both wire forms,
+    # so mixed on/off processes interoperate.
+    "no_native_decode": False,
     # Disable owner callsite capture on put()/submit.
     "no_callsites": False,
     # Disable the coalesced submit fast path.
@@ -257,7 +263,7 @@ _ENV_PREFIX = "RTPU_"
 BOOTSTRAP_ENV = frozenset({
     "RTPU_WORKER_ID", "RTPU_SESSION", "RTPU_NODE_ID", "RTPU_NODE_INDEX",
     "RTPU_RAYLET_ADDR", "RTPU_GCS_ADDR", "RTPU_WORKER_PROFILE",
-    "RTPU_SANITIZE", "RTPU_NATIVE_CACHE",
+    "RTPU_SANITIZE", "RTPU_NATIVE_CACHE", "RTPU_NATIVE_DEBUG",
 })
 
 

@@ -44,6 +44,7 @@ from .errors import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
 from .gcs_client import GcsClient
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .memory_store import MemoryStore, resolve_entry
+from . import native_decode
 from .object_ref import ObjectRef
 from .owner_shards import (OwnerShard, ShardSet,
                            fire_and_forget as _fire_and_forget,
@@ -306,8 +307,7 @@ class ReferenceCounter:
         if free:
             self._cw._free_owned_object(object_id, in_plasma=in_plasma)
         elif notify_owner is not None:
-            self._cw.fire_and_forget(notify_owner, "borrow_decref",
-                                     object_hex=object_id.hex())
+            self._cw.queue_borrow_decref(notify_owner, object_id)
 
     def _decrement_many(self, object_ids, kind: str):
         """Release a batch of refs of one kind under ONE lock acquisition
@@ -333,8 +333,14 @@ class ReferenceCounter:
         for object_id, in_plasma in frees:
             self._cw._free_owned_object(object_id, in_plasma=in_plasma)
         for object_id, owner in notify:
-            self._cw.fire_and_forget(owner, "borrow_decref",
-                                     object_hex=object_id.hex())
+            self._cw.queue_borrow_decref(owner, object_id)
+
+    def remove_borrowers_fold(self, object_ids: List[ObjectID]):
+        """Apply one decref fold (a batch of borrower decrements that
+        arrived as a single contiguous id array) under ONE lock
+        acquisition — the receive-side twin of the sender's
+        _decrement_many batching."""
+        self._decrement_many(object_ids, "borrowers")
 
     def is_owner(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -484,6 +490,10 @@ class ShardedReferenceCounter:
 
     def remove_borrower(self, object_id: ObjectID):
         self._for(object_id).remove_borrower(object_id)
+
+    def remove_borrowers_fold(self, object_ids: List[ObjectID]):
+        for idx, chunk in self._split(object_ids).items():
+            self._stripes[idx].remove_borrowers_fold(chunk)
 
     def on_ref_deserialized(self, ref: ObjectRef):
         self._for(ref.id()).on_ref_deserialized(ref)
@@ -1827,7 +1837,10 @@ class ActorTaskSubmitter:
 
     def on_done(self, task_id: TaskID, reply: Dict[str, Any]):
         """A completion from the actor's done stream (possibly duplicated
-        on redelivery; only the first report wins)."""
+        on redelivery; only the first report wins). `task_id` may be a
+        BORROWED key (ids.iter_borrowed) — valid for the pops below but
+        never retained; anything that outlives this call uses the
+        entry's own spec.task_id."""
         entry = self._awaiting.pop(task_id, None)
         self._push_time.pop(task_id, None)
         if entry is None:
@@ -2577,6 +2590,16 @@ class CoreWorker:
         self._pending_frees: List[str] = []
         self._free_lock = threading.Lock()
         self._done_batches: Dict[Address, List] = {}
+        # Native receive path (PR 11): resolved per CoreWorker so the
+        # RTPU_NO_NATIVE_DECODE A/B can flip between init cycles in one
+        # process (workers resolve from their inherited environment).
+        self._no_native_decode = not native_decode.enabled()
+        # Outbound borrow-decref folds: owner address -> packed id
+        # bytes, flushed once per loop tick as one borrow_decref_fold
+        # frame per owner instead of one borrow_decref RPC per object.
+        self._decref_pending: Dict[Address, bytearray] = {}
+        self._decref_lock = threading.Lock()
+        self._decref_flush_scheduled = False
         # The loop serving this process's RpcServer (set at start()):
         # receive-path timers — push-record TTL sweeps, done-batch
         # flushes — schedule on THIS handle explicitly, never on the
@@ -2618,6 +2641,19 @@ class CoreWorker:
         self.server.register_raw("push_actor_tasks",
                                  self._handle_push_actor_tasks_raw)
         self.server.register_raw("push_task", self._handle_push_task_raw)
+        # Native receive path: arm (or disarm — the A/B can flip per
+        # init) the in-ring decoder, route its pre-decoded events, and
+        # accept the two new raw wire forms. Handlers for BOTH forms
+        # are registered unconditionally so mixed on/off peers
+        # interoperate; the kill switch only gates what THIS process
+        # sends and whether its rings decode.
+        self._arm_native_decode()
+        self.server.register_decoded("push_task",
+                                     self._handle_push_task_decoded)
+        self.server.register_decoded("push_actor_tasks",
+                                     self._handle_push_actor_tasks_decoded)
+        self.server.register_raw("borrow_decref_fold",
+                                 self._handle_borrow_decref_fold_raw)
         self.rpc_address = loop_thread.run_sync(self.server.start())
         self.shards.start_main(loop_thread, self.server, self.clients,
                                self.rpc_address)
@@ -2627,9 +2663,16 @@ class CoreWorker:
             # (workers reply to the done_to the owning shard stamped on
             # the push) — reply routing never crosses shards, and ONE
             # decoder (the factory) serves main and extra shards alike.
+            # Three registrations per shard, one stream: the legacy
+            # pickled form, the raw packed form, and the C-validated
+            # kind-5 event all land in the same per-shard fold.
             shard.server.register(
                 "actor_tasks_done",
                 self._make_done_stream_handler(shard.actor_submitter))
+            raw_done = self._make_done_stream_raw_handler(
+                shard.actor_submitter)
+            shard.server.register_raw("actor_tasks_done", raw_done)
+            shard.server.register_decoded("actor_tasks_done", raw_done)
         # GCS failover: when the client re-establishes itself on a new
         # incarnation, every shard replays its in-flight actor state
         # (pubsub published during the outage is gone for good).
@@ -2648,20 +2691,55 @@ class CoreWorker:
             else:
                 shard.post_call(sub.replay_after_gcs_reconnect)
 
+    def _arm_native_decode(self):
+        """Apply this CoreWorker's native-decode setting to the C ring
+        (process-wide flag + the ring-level decref-fold sink). Safe when
+        the native library is unavailable: everything stays on the
+        asyncio/legacy path and the raw handlers still understand the
+        new wire forms."""
+        try:
+            from .._native.fastrpc import NativeIO
+        except Exception:  # noqa: BLE001 — native optional by design
+            logger.debug("native decode unavailable", exc_info=True)
+            return
+        on = NativeIO.apply_decode_config(not self._no_native_decode)
+        NativeIO.set_fold_sink(self._apply_decref_fold if on else None)
+
     @staticmethod
     def _make_done_stream_handler(actor_submitter: "ActorTaskSubmitter"):
-        """The ONE actor_tasks_done decoder (bound per shard): a packed
-        id array — one bytes blob per batch, replies aligned by index
-        (the only sender is _flush_done, same build)."""
+        """The actor_tasks_done decoder for the LEGACY pickled stream
+        (bound per shard): a packed id array — one bytes blob per batch,
+        replies aligned by index (the only sender is _flush_done, same
+        build). Ids iterate as borrowed keys re-pointed at each 24-byte
+        window of the ONE contiguous buffer — no bytes object per id
+        even on the kill-switch arm, so the native-decode A/B measures
+        the C-vs-Python delta, not allocator noise (on_done only looks
+        the key up; the retained id is the spec's own task_id)."""
         async def handle_actor_tasks_done(ids: bytes, replies):
-            n = TaskID.SIZE
-            for i, reply in enumerate(replies):
-                actor_submitter.on_done(TaskID(ids[i * n:(i + 1) * n]),
-                                        reply)
+            for key, reply in zip(TaskID.iter_borrowed(ids), replies):
+                actor_submitter.on_done(key, reply)
         return handle_actor_tasks_done
+
+    @staticmethod
+    def _make_done_stream_raw_handler(
+            actor_submitter: "ActorTaskSubmitter"):
+        """The actor_tasks_done decoder for the raw packed stream —
+        serving both the asyncio raw frame and the C ring's validated
+        kind-5 event (identical layout: u32 n | contiguous ids |
+        batch-pickled replies)."""
+        async def handle_actor_tasks_done_raw(payload):
+            ids, replies = native_decode.unpack_done_stream(bytes(payload))
+            for key, reply in zip(TaskID.iter_borrowed(ids), replies):
+                actor_submitter.on_done(key, reply)
+        return handle_actor_tasks_done_raw
 
     def shutdown(self):
         self._shutdown = True
+        try:
+            from .._native.fastrpc import NativeIO
+            NativeIO.set_fold_sink(None)
+        except Exception:  # noqa: BLE001 — native optional by design
+            logger.debug("fold sink clear failed", exc_info=True)
         acc = 0
         for shard in self.shards:
             acc += shard.actor_submitter._wire_bytes_acc  # cross-shard ok: teardown, loops quiesced
@@ -2717,6 +2795,61 @@ class CoreWorker:
         _retries idempotency caveat live in owner_shards.fire_and_forget)."""
         _fire_and_forget(self.clients, self.loop_post, address, method,
                          _retries=_retries, **kwargs)
+
+    # -- batched borrow-decref folds (the refcount leg of the native
+    # receive path) ------------------------------------------------------
+
+    def queue_borrow_decref(self, owner: Address, object_id: ObjectID):
+        """Release one borrowed ref toward its owner. Native path:
+        append the raw id to the per-owner fold and flush ONE
+        borrow_decref_fold frame per owner per loop tick (a completing
+        dep list costs one frame, and the owner's C ring folds frames
+        from many workers into one wakeup). Kill-switch path: the
+        legacy one-RPC-per-object borrow_decref. Callable from any
+        thread (ObjectRef finalizers release borrowed refs off-loop)."""
+        if self._no_native_decode:
+            self.fire_and_forget(owner, "borrow_decref",
+                                 object_hex=object_id.hex())
+            return
+        owner = (owner[0], int(owner[1]))
+        with self._decref_lock:
+            buf = self._decref_pending.get(owner)
+            if buf is None:
+                buf = self._decref_pending[owner] = bytearray()
+            buf += object_id.binary()
+            if self._decref_flush_scheduled:
+                return
+            self._decref_flush_scheduled = True
+        self.loop_post(self._flush_decref_folds())
+
+    async def _flush_decref_folds(self):
+        with self._decref_lock:
+            pending, self._decref_pending = self._decref_pending, {}
+            self._decref_flush_scheduled = False
+        for owner, buf in pending.items():
+            client = self.clients.get(owner)
+            try:
+                await client.oneway_raw("borrow_decref_fold", bytes(buf))
+            except Exception:
+                # Same delivery contract as the legacy per-object
+                # oneway: best effort — a dead owner has no refs left
+                # to count.
+                logger.debug("borrow_decref_fold to %s dropped", owner,
+                             exc_info=True)
+
+    async def _handle_borrow_decref_fold_raw(self, payload):
+        """The raw-frame twin of the kind-6 ring fold (asyncio
+        transport / in-process fast path)."""
+        self._apply_decref_fold(payload)
+
+    def _apply_decref_fold(self, payload):
+        """Apply one fold of borrower decrements: one pass, one lock
+        acquisition per refcount stripe (also the NativeIO kind-6 fold
+        sink, called from whichever loop drains the ring — the counter
+        is thread-safe)."""
+        ids = [ObjectID(b) for b in native_decode.iter_fold_ids(payload)]
+        if ids:
+            self.reference_counter.remove_borrowers_fold(ids)
 
     # -- cross-shard plumbing --------------------------------------------
 
@@ -3180,6 +3313,23 @@ class CoreWorker:
         return await self.handle_push_task(
             lease_id=lease_id, tmpl=tid, frame=delta, tmpl_data=tmpl_data)
 
+    async def _handle_push_task_decoded(self, payload):
+        """Flat lease push the C ring already parsed (kind-3 event): the
+        record carries the per-call fields pre-split, so the freelist
+        spec fills from slices of ONE buffer — no incremental delta
+        walk on the Python side."""
+        _msg_id, lease_id, tid, tmpl_data, fields = \
+            native_decode.parse_push_record(payload)
+        if tmpl_data is not None:
+            task_spec_codec.register_template(tid, tmpl_data)
+        template = task_spec_codec.lookup_template(tid)
+        if template is None:
+            # C mirror said known but this registry evicted it: same
+            # re-announce protocol as the raw path.
+            return {"need_template": True}
+        spec = task_spec_codec.spec_from_fields(template, *fields)
+        return await self._execute_push(spec, lease_id, pooled=True)
+
     async def handle_push_task(self, spec: Optional[TaskSpec] = None,
                                lease_id: Optional[int] = None,
                                tmpl: Optional[bytes] = None,
@@ -3197,6 +3347,13 @@ class CoreWorker:
                 return {"need_template": True}
             spec = task_spec_codec.decode_delta(frame, template)
             pooled = True
+        return await self._execute_push(spec, lease_id, pooled)
+
+    async def _execute_push(self, spec: TaskSpec,
+                            lease_id: Optional[int], pooled: bool):
+        """The shared execution tail of every push route (pickled spec,
+        raw flat frame, C-decoded record): dedup, execute, cache the
+        reply for probe recovery, release pooled specs."""
         if lease_id is not None:
             self.current_lease_id = lease_id
         # Duplicate push of the SAME attempt (owner re-sent after losing
@@ -3334,16 +3491,46 @@ class CoreWorker:
         for tid, delta in frames:
             template = task_spec_codec.lookup_template(tid)
             if template is None:
-                q = self._done_batches.setdefault(done_to, [])
-                q.append((task_spec_codec.peek_task_id(delta),
-                          {"system_error": "unknown template"}))
-                if len(q) == 1:
-                    asyncio.get_running_loop().call_soon(
-                        lambda d=done_to: asyncio.ensure_future(
-                            self._flush_done(d)))
+                self._report_unknown_template(
+                    done_to, task_spec_codec.peek_task_id(delta))
                 continue
             specs.append(task_spec_codec.decode_delta(delta, template))
         await self.handle_push_actor_tasks(specs, done_to)
+
+    async def _handle_push_actor_tasks_decoded(self, payload):
+        """Flat actor stream the C ring already parsed (kind-4 event):
+        per-record pre-split fields feed the freelist specs directly.
+        The C `known` bit is advisory — a record whose template this
+        registry lost anyway takes the same unknown-template report,
+        using the task id the record carries."""
+        done_to, tmpls, recs = \
+            native_decode.parse_actor_batch_record(payload)
+        for tid, data in tmpls:
+            task_spec_codec.register_template(tid, data)
+        specs = []
+        for tid, _known, fields in recs:
+            # This registry is authoritative; the C known-bit is only a
+            # hint and is deliberately ignored here — a stale mirror
+            # (evictions advance independently) must cost speed, never
+            # spurious unknown-template errors for shapes we DO hold.
+            template = task_spec_codec.lookup_template(tid)
+            if template is None:
+                self._report_unknown_template(done_to, fields[0])
+                continue
+            specs.append(
+                task_spec_codec.spec_from_fields(template, *fields))
+        await self.handle_push_actor_tasks(specs, done_to)
+
+    def _report_unknown_template(self, done_to, task_id_bytes: bytes):
+        """Queue an unknown-template system error onto the done batch
+        for `done_to` (the owner re-announces and resends)."""
+        q = self._done_batches.setdefault(done_to, [])
+        q.append((bytes(task_id_bytes),
+                  {"system_error": "unknown template"}))
+        if len(q) == 1:
+            asyncio.get_running_loop().call_soon(
+                lambda d=done_to: asyncio.ensure_future(
+                    self._flush_done(d)))
 
     async def handle_push_actor_tasks(self, specs: List[TaskSpec],
                                       done_to):
@@ -3405,7 +3592,17 @@ class CoreWorker:
         ids = b"".join(task_key for task_key, _reply in results)
         replies = [reply for _task_key, reply in results]
         try:
-            await client.oneway("actor_tasks_done", ids=ids, replies=replies)
+            if self._no_native_decode:
+                await client.oneway("actor_tasks_done", ids=ids,
+                                    replies=replies)
+            else:
+                # Raw packed stream: the owner's C ring validates the
+                # id array in-ring (kind-5 event) and its Python side
+                # pays one batch unpickle for the replies instead of a
+                # kwargs pickle round trip per flush.
+                await client.oneway_raw(
+                    "actor_tasks_done",
+                    native_decode.pack_done_stream(ids, replies))
         except Exception:
             # owner unreachable; actor-state pubsub recovers the rest
             logger.debug("actor_tasks_done to unreachable owner dropped",

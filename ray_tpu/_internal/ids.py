@@ -97,6 +97,32 @@ class BaseID:
     def __reduce__(self):
         return (type(self), (self._bytes,))
 
+    @classmethod
+    def iter_borrowed(cls, buf):
+        """Iterate dict-lookup keys over a packed id array (the done
+        stream's contiguous id-bytes buffer) WITHOUT a fresh bytes
+        object per id: yields ONE reusable instance re-pointed at each
+        SIZE-byte window via a read-only memoryview slice. hash/eq match
+        the equivalent bytes-backed id (a read-only memoryview hashes
+        like its bytes, and `bytes == memoryview` compares content), so
+        dict pops keyed by real ids work.
+
+        The yielded object is BORROWED: valid only until the next
+        iteration, for lookups only — never store it (consumers that
+        need a retained id use the one already held by the table entry,
+        e.g. spec.task_id). `buf` must be bytes (writable buffers are
+        unhashable as memoryviews)."""
+        size = cls.SIZE
+        salt = cls._SALT
+        key = cls.__new__(cls)
+        mv = memoryview(buf)
+        n = len(mv) - (len(mv) % size)
+        for off in range(0, n, size):
+            window = mv[off:off + size]
+            key._bytes = window
+            key._hash = hash(window) ^ salt
+            yield key
+
 
 class UniqueID(BaseID):
     SIZE = 28

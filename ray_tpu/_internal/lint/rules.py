@@ -101,13 +101,21 @@ _CONFIG_METHODS = {"get", "apply_system_config", "snapshot", "reset",
                    "known_flags"}
 
 # L006: hot-path modules where a pickler on the per-call loop is a
-# regression (PR 2 moved them onto the flat-wire codec).
+# regression (PR 2 moved them onto the flat-wire codec; PR 11 added the
+# receive-side decode module).
 _HOT_PATH_FILES = {
     "ray_tpu/_internal/rpc.py",
     "ray_tpu/_internal/task_spec.py",
     "ray_tpu/_internal/core_worker.py",
+    "ray_tpu/_internal/native_decode.py",
 }
 _PICKLER_RECEIVERS = {"serialization", "cloudpickle", "pickle"}
+# L006b: the batch-scoped pickle entry points (serialization.dumps_batch
+# / loads_batch) are allowed on hot paths ONLY with a same-line
+# `# batch ok: <why the cost is per batch, not per call>` annotation —
+# the rule keeps "batch" honest instead of becoming a rename loophole.
+_PICKLER_BATCH_TERMS = {"dumps_batch", "loads_batch"}
+_BATCH_OK_MARK = "# batch ok"
 
 # L005: the registry module itself creates the threads.
 _THREADS_HELPER_FILE = "ray_tpu/_internal/threads.py"
@@ -525,6 +533,20 @@ class _Linter(ast.NodeVisitor):
                        "encoding must use the flat-wire codec; pickle "
                        "belongs behind the fallback gate (allowlist with "
                        "justification if this IS the gate)")
+
+        # L006b: batch-scoped pickler on a hot-path module without its
+        # justification mark
+        if self._hot_path and term in _PICKLER_BATCH_TERMS \
+                and isinstance(node.func, ast.Attribute) \
+                and _terminal(_dotted(node.func.value)) \
+                in _PICKLER_RECEIVERS \
+                and not self._line_marked(node, _BATCH_OK_MARK):
+            self._emit("L006", node,
+                       f"{dotted}() in hot-path module without a "
+                       "`# batch ok: <why>` annotation — batch-scoped "
+                       "pickling is allowed only where one call covers "
+                       "a whole batch of completions, and the line must "
+                       "say so")
 
         self.generic_visit(node)
 

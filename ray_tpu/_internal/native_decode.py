@@ -1,0 +1,170 @@
+"""Receive-path decode: the Python half of the in-ring native decoder.
+
+src/fastrpc.cpp's epoll thread (PR 11) pre-parses the completion hot
+path — flat-wire task deltas, done-stream id arrays, batched refcount
+decrements — into normalized records so each shard's drain callback
+consumes arrays of pre-decoded fields instead of raw frame bytes. This
+module owns the Python-side record layouts (they MUST match the C
+appenders byte for byte), the pack/unpack helpers for the two new raw
+wire formats (``actor_tasks_done`` and ``borrow_decref_fold``), and the
+kill-switch resolution.
+
+Hot-path rules (rtpulint L006 covers this module): no per-call pickler.
+The only pickling here is the done-stream's *batch* reply blob — one
+``dumps_batch``/``loads_batch`` per batch of completions, annotated
+``# batch ok`` — and the decoded records themselves are pure
+struct/slice work feeding the ``__slots__`` TaskSpec freelists
+(task_spec.spec_from_fields).
+
+A/B: ``RTPU_NO_NATIVE_DECODE=1`` keeps every sender on the legacy wire
+(pickled done streams, per-object borrow_decref RPCs) and never arms
+the C decoder — the exact-legacy arm. Receivers register handlers for
+BOTH forms unconditionally, so mixed-mode processes interoperate.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+from . import serialization
+from .config import CONFIG
+from .ids import TaskID
+
+# -- record layouts (mirror src/fastrpc.cpp's appenders) --------------------
+
+# DELTAREC fixed header: dflags, task_id, seq, attempt, method_len,
+# trace0_len, trace1_len, args_len — then the four variable sections.
+_REC_HEAD = struct.Struct("<B24sqIHHHI")
+REC_HEAD_LEN = _REC_HEAD.size  # 47
+
+# kind-3 decoded push_task header: msg_id, lease_id, template id,
+# template announce length.
+_PUSH_HEAD = struct.Struct("<QQ16sI")
+
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+OBJECT_ID_LEN = 28
+TASK_ID_LEN = TaskID.SIZE
+
+
+def enabled() -> bool:
+    """Resolved once per call site that caches it (CoreWorker init):
+    native decode is on unless the kill switch says otherwise."""
+    return not bool(CONFIG.no_native_decode)
+
+
+# SpecFields: the pre-parsed per-call fields a DELTAREC carries, in
+# task_spec.spec_from_fields argument order.
+SpecFields = Tuple[bytes, int, int, Optional[str],
+                   Optional[Tuple[str, str]], bytes]
+
+
+def parse_delta_record(buf, off: int) -> Tuple[SpecFields, int]:
+    """Parse one DELTAREC at ``buf[off:]`` -> (fields, next offset).
+    ``buf`` must be bytes (records are copied out of the drain buffer
+    before any await point)."""
+    dflags, tid_b, seq, attempt, mlen, t0len, t1len, alen = \
+        _REC_HEAD.unpack_from(buf, off)
+    off += REC_HEAD_LEN
+    method = None
+    if mlen:
+        method = buf[off:off + mlen].decode()
+        off += mlen
+    trace = None
+    if dflags & 1:
+        trace = (buf[off:off + t0len].decode(),
+                 buf[off + t0len:off + t0len + t1len].decode())
+        off += t0len + t1len
+    args_raw = buf[off:off + alen]
+    off += alen
+    return (tid_b, seq, attempt, method, trace, args_raw), off
+
+
+def parse_push_record(payload: bytes):
+    """kind-3 record -> (msg_id, lease_id, tmpl_id, tmpl_data|None,
+    SpecFields)."""
+    msg_id, lease_id, tmpl_id, tlen = _PUSH_HEAD.unpack_from(payload, 0)
+    off = _PUSH_HEAD.size
+    tmpl_data = payload[off:off + tlen] if tlen else None
+    off += tlen
+    fields, _end = parse_delta_record(payload, off)
+    return msg_id, lease_id, tmpl_id, tmpl_data, fields
+
+
+def parse_actor_batch_record(payload: bytes):
+    """kind-4 record -> (done_to, [(tid, tmpl_bytes)],
+    [(tid, known, SpecFields)])."""
+    (hlen,) = _U16.unpack_from(payload, 0)
+    off = 2
+    host = payload[off:off + hlen].decode()
+    off += hlen
+    (port,) = _U32.unpack_from(payload, off)
+    off += 4
+    n_tmpls = payload[off]
+    off += 1
+    tmpls = []
+    for _ in range(n_tmpls):
+        tid = payload[off:off + 16]
+        off += 16
+        (tlen,) = _U32.unpack_from(payload, off)
+        off += 4
+        tmpls.append((tid, payload[off:off + tlen]))
+        off += tlen
+    (n_recs,) = _U16.unpack_from(payload, off)
+    off += 2
+    recs = []
+    for _ in range(n_recs):
+        tid = payload[off:off + 16]
+        known = payload[off + 16]
+        off += 17
+        (rec_len,) = _U32.unpack_from(payload, off)
+        off += 4
+        fields, end = parse_delta_record(payload, off)
+        if end != off + rec_len:
+            raise ValueError("decoded actor batch record length mismatch")
+        off = end
+        recs.append((tid, bool(known), fields))
+    return (host, port), tmpls, recs
+
+
+# -- done-stream raw wire format --------------------------------------------
+# payload := u32 n | n * 24s task ids (contiguous) | batch-pickled replies
+
+def pack_done_stream(ids: bytes, replies: List) -> bytes:
+    n, rem = divmod(len(ids), TASK_ID_LEN)
+    if rem:
+        raise ValueError("done-stream id array not a multiple of id size")
+    return (_U32.pack(n) + ids
+            + serialization.dumps_batch(replies))  # batch ok: one pickle per done batch
+
+
+def unpack_done_stream(payload: bytes) -> Tuple[bytes, List]:
+    """-> (contiguous id bytes, replies list). The caller iterates ids
+    with ids.iter_borrowed (no per-id allocation)."""
+    (n,) = _U32.unpack_from(payload, 0)
+    end = 4 + n * TASK_ID_LEN
+    ids = payload[4:end]
+    replies = serialization.loads_batch(payload[end:])  # batch ok: one unpickle per done batch
+    if len(replies) != n:
+        raise ValueError(
+            f"done-stream id/reply count mismatch: {n} ids, "
+            f"{len(replies)} replies")
+    return ids, replies
+
+
+# -- decref fold wire format ------------------------------------------------
+# payload := k * 28-byte object ids, no framing (the C ring concatenates
+# payloads across frames; any multiple of 28 is a valid fold).
+
+def iter_fold_ids(payload) -> Iterator[bytes]:
+    """Materialized object-id bytes of one fold. Unlike done-stream
+    lookups these escape into the reference counter's free/notify lists,
+    so they are real bytes objects, one slice per id — still one frame,
+    one lock and one unpickle-free pass per BATCH of decrements."""
+    if len(payload) % OBJECT_ID_LEN:
+        raise ValueError("decref fold not a multiple of object-id size")
+    buf = bytes(payload)
+    for off in range(0, len(buf), OBJECT_ID_LEN):
+        yield buf[off:off + OBJECT_ID_LEN]

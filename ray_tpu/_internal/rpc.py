@@ -55,6 +55,19 @@ _BODY_HDR_LEN = _BODY_HDR.size
 FLAG_RESP = 1
 FLAG_OK = 2
 FLAG_RAW = 4
+# Internal-only (never on the wire): the payload reaching
+# _handle_request is a record the native ring already decoded
+# (src/fastrpc.cpp), so dispatch selects the decoded handler table.
+FLAG_DECODED = 256
+
+# Decoded event kind -> the method the C classifier matched. The decode
+# set is fixed in src/fastrpc.cpp; this table is its Python twin.
+_DECODED_KIND_METHOD = {
+    3: "push_task",          # KIND_DECODED_PUSH (request: msg_id in rec)
+    4: "push_actor_tasks",   # KIND_DECODED_ACTOR_BATCH (oneway)
+    5: "actor_tasks_done",   # KIND_DONE_STREAM (oneway)
+}
+_U64LE = struct.Struct("<Q")
 
 
 def pack_frame(msg_id: int, flags: int, method: bytes,
@@ -478,6 +491,7 @@ class RpcServer:
         self.name = name
         self._handlers: Dict[str, Handler] = {}
         self._raw_handlers: Dict[str, Handler] = {}
+        self._decoded_handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self.address: Optional[Address] = None
         # Owner loop, recorded at start(): handlers and connection state
@@ -499,6 +513,14 @@ class RpcServer:
         """Handler for FLAG_RAW frames: called with the payload bytes
         as-is — no kwargs pickling on either side of the wire."""
         self._raw_handlers[method] = handler
+
+    def register_decoded(self, method: str, handler: Handler):
+        """Handler for frames the native ring pre-decoded (kind 3-5
+        events): called with the C decoder's record bytes instead of the
+        raw wire payload. Requests routed here still flow through
+        _handle_request, so chaos injection and the reply path are
+        identical to the raw route."""
+        self._decoded_handlers[method] = handler
 
     def register_instance(self, obj: Any, prefix: str = ""):
         """Register every `async def handle_<x>` method of obj as rpc `<x>`."""
@@ -569,6 +591,23 @@ class RpcServer:
             if kind == 2:  # closed
                 self._native_conns.discard(conn_id)
                 return
+            if kind >= 3:
+                # Pre-decoded by the C ring: the body IS the decoded
+                # record. One copy out of the reused drain buffer, then
+                # the normal dispatch (chaos, reply, backpressure)
+                # against the decoded handler table. kind-3 requests
+                # carry their msg_id as the record's first field; 4/5
+                # are oneway streams.
+                method = _DECODED_KIND_METHOD.get(kind)
+                if method is None:
+                    logger.warning("unknown decoded event kind %d", kind)
+                    return
+                msg_id = _U64LE.unpack_from(body, 0)[0] if kind == 3 else 0
+                asyncio.ensure_future(
+                    self._handle_request(method, bytes(body), msg_id,
+                                         self._native_reply, coalescer,
+                                         FLAG_RAW | FLAG_DECODED))
+                return
             msg_id, flags, method, payload = unpack_body(body)
             asyncio.ensure_future(
                 self._handle_request(method, payload, msg_id,
@@ -620,7 +659,10 @@ class RpcServer:
             await asyncio.sleep(delay)
         try:
             if flags & FLAG_RAW:
-                handler = self._raw_handlers.get(method)
+                if flags & FLAG_DECODED:
+                    handler = self._decoded_handlers.get(method)
+                else:
+                    handler = self._raw_handlers.get(method)
                 if handler is None:
                     raise RpcError(
                         f"{self.name}: no raw handler for {method!r}")

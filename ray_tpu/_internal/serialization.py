@@ -189,3 +189,20 @@ def dumps(value: Any) -> bytes:
 
 def loads(data: bytes) -> Any:
     return pickle.loads(data)
+
+
+# -- batch-scoped pickling ---------------------------------------------------
+# The hot-path pickle rule (rtpulint L006) bans per-CALL picklers on the
+# task fast path. These entry points exist for payloads whose pickle
+# cost is amortized over a whole batch of completions (one call per
+# done-stream flush, never one per task); call sites in hot-path modules
+# must still carry a `# batch ok: <why>` annotation, which L006 checks.
+
+def dumps_batch(values: Any) -> bytes:
+    """`dumps` for a batch-level payload (one encode per batch)."""
+    return dumps(values)
+
+
+def loads_batch(data: bytes) -> Any:
+    """`loads` for a batch-level payload (one decode per batch)."""
+    return pickle.loads(data)

@@ -14,6 +14,7 @@ store keyed by a content hash; workers load and cache them on first use.
 from __future__ import annotations
 
 import hashlib
+import logging
 import os
 import struct
 import threading
@@ -23,6 +24,8 @@ from typing import Any, Dict, List, Optional, Tuple
 from . import serialization
 from .config import CONFIG
 from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+logger = logging.getLogger(__name__)
 
 # Task types
 NORMAL_TASK = "normal"
@@ -523,6 +526,7 @@ _host_cache: Dict[bytes, str] = {}
 
 
 def register_template(tid: bytes, data: bytes):
+    _mirror_template(tid)
     with _template_lock:
         if tid in _templates:
             return
@@ -540,6 +544,18 @@ def register_template(tid: bytes, data: bytes):
             for old in list(_templates)[:2048]:
                 del _templates[old]
         _templates[tid] = tmpl
+
+
+def _mirror_template(tid: bytes):
+    """Keep the C decoder's template mirror (src/fastrpc.cpp) in step
+    with this registry, so in-ring decode recognizes shapes announced
+    through the pickled/legacy paths too. Soft dependency: decode is an
+    optimization, so mirror failure must never fail registration."""
+    try:
+        from .._native import fastrpc as _native_fastrpc
+        _native_fastrpc.mirror_template(tid)
+    except Exception:  # noqa: BLE001 — mirror is advisory
+        logger.debug("native template mirror failed", exc_info=True)
 
 
 def lookup_template(tid: bytes) -> Optional[_Template]:
@@ -620,6 +636,21 @@ def decode_delta(delta, tmpl: _Template) -> TaskSpec:
         trace = (t0, bytes(delta[off:off + n]).decode())
         off += n
     raw_args = bytes(delta[off:])
+    return spec_from_fields(tmpl, tid_b, seq, attempt, method, trace,
+                            raw_args)
+
+
+def spec_from_fields(tmpl: _Template, tid_b: bytes, seq: int, attempt: int,
+                     method: Optional[str],
+                     trace: Optional[Tuple[str, str]],
+                     raw_args: bytes) -> TaskSpec:
+    """Fill a freelist spec from pre-parsed per-call fields — the
+    consumer of the C decoder's DELTAREC records (native_decode.
+    parse_delta_record) and the shared tail of decode_delta. The
+    template's constant slots are already populated; only the per-call
+    slots are written, with the last-seen args section memoized per
+    template (floods repeat one args shape, so steady state is a bytes
+    compare plus a shared read-only list)."""
     if raw_args == tmpl.last_args_raw:
         # Receiver never mutates arg objects, so identical args bytes
         # (the common flood shape) share one decoded read-only list.

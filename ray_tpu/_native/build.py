@@ -22,24 +22,40 @@ _CACHE_DIR = os.environ.get(
     "RTPU_NATIVE_CACHE", os.path.expanduser("~/.cache/rtpu-native"))
 
 
-def build_library(name: str) -> Optional[str]:
+def build_library(name: str, debug: Optional[bool] = None) -> Optional[str]:
     """Compile src/<name>.cpp into a cached .so; returns its path or None
     if the toolchain is unavailable/failing (callers fall back to the
-    pure-Python path)."""
+    pure-Python path).
+
+    ``debug`` (default: the RTPU_NATIVE_DEBUG env toggle) builds a
+    sanitizer variant — ``-fsanitize=address,undefined -g`` — cached
+    under its own name. Loading it requires libasan to be preloaded
+    (see tests/test_native_decode.py's smoke test, which runs a
+    subprocess with LD_PRELOAD), so the debug build is a diagnosis
+    tool, not a production transport: C decode bugs surface as ASAN
+    reports instead of corrupted specs."""
+    if debug is None:
+        debug = bool(os.environ.get("RTPU_NATIVE_DEBUG"))
     src = os.path.join(_SRC_DIR, f"{name}.cpp")
     try:
         with open(src, "rb") as f:
             digest = hashlib.sha256(f.read()).hexdigest()[:16]
     except OSError:
         return None
-    out = os.path.join(_CACHE_DIR, f"{name}-{digest}.so")
+    suffix = "-dbg" if debug else ""
+    out = os.path.join(_CACHE_DIR, f"{name}-{digest}{suffix}.so")
     if os.path.exists(out):
         return out
     os.makedirs(_CACHE_DIR, exist_ok=True)
     tmp = tempfile.mktemp(prefix=f"{name}-", suffix=".so",
                           dir=_CACHE_DIR)
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           src, "-o", tmp]
+    if debug:
+        flags = ["-O1", "-g", "-fsanitize=address,undefined",
+                 "-fno-omit-frame-pointer"]
+    else:
+        flags = ["-O2"]
+    cmd = (["g++"] + flags +
+           ["-shared", "-fPIC", "-std=c++17", "-pthread", src, "-o", tmp])
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:

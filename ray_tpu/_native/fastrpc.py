@@ -14,6 +14,7 @@ owning ring.
 
 from __future__ import annotations
 
+import asyncio
 import ctypes
 import logging
 import os
@@ -31,8 +32,21 @@ logger = logging.getLogger(__name__)
 KIND_FRAME = 0
 KIND_ACCEPT = 1
 KIND_CLOSED = 2
+# decoded-path kinds (frpc_recv_decoded; src/fastrpc.cpp header comment
+# documents each record layout)
+KIND_DECODED_PUSH = 3        # decoded push_task request
+KIND_DECODED_ACTOR_BATCH = 4  # decoded push_actor_tasks batch
+KIND_DONE_STREAM = 5         # validated actor_tasks_done payload
+KIND_DECREF_FOLD = 6         # accumulated borrow_decref_fold ids
 
 _RECV_CAP = 1024
+
+_RECV_ARGTYPES = [
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
+    ctypes.c_char_p, ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int64]
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -57,16 +71,79 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.frpc_out_bytes.restype = ctypes.c_uint64
     lib.frpc_out_bytes.argtypes = [ctypes.c_int64]
     lib.frpc_recv2.restype = ctypes.c_int64
-    lib.frpc_recv2.argtypes = [
-        ctypes.c_int,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
-        ctypes.c_char_p, ctypes.c_uint64,
-        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
-        ctypes.c_int64]
+    lib.frpc_recv2.argtypes = _RECV_ARGTYPES
+    lib.frpc_recv_decoded.restype = ctypes.c_int64
+    lib.frpc_recv_decoded.argtypes = _RECV_ARGTYPES
     lib.frpc_next_len2.restype = ctypes.c_uint64
     lib.frpc_next_len2.argtypes = [ctypes.c_int]
     lib.frpc_close.argtypes = [ctypes.c_int64]
+    lib.frpc_decode_enable.argtypes = [ctypes.c_int]
+    lib.frpc_decode_enabled.restype = ctypes.c_int
+    lib.frpc_tmpl_register.argtypes = [ctypes.c_char_p]
+    lib.frpc_tmpl_known.restype = ctypes.c_int
+    lib.frpc_tmpl_known.argtypes = [ctypes.c_char_p]
+    lib.frpc_test_decode.restype = ctypes.c_int64
+    lib.frpc_test_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8)]
     return lib
+
+
+# Library handle shared by the io singleton and the loop-free helpers
+# below (test_decode/mirror_template can run without starting the io
+# thread — the decoder itself has no dependency on the epoll core).
+_lib_cached: Optional[ctypes.CDLL] = None
+_lib_checked = False
+_lib_lock = threading.Lock()
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _lib_cached, _lib_checked
+    with _lib_lock:
+        if not _lib_checked:
+            try:
+                _lib_cached = _load()
+            except Exception:
+                logger.exception("fastrpc library unavailable")
+                _lib_cached = None
+            _lib_checked = True
+        return _lib_cached
+
+
+def mirror_template(tid: bytes) -> None:
+    """Mirror one announced template id into the C decoder's table (the
+    receive-side twin of task_spec.register_template). No-op when the
+    native library is unavailable."""
+    lib = _lib()
+    if lib is not None:
+        lib.frpc_tmpl_register(tid)
+
+
+def template_known(tid: bytes) -> bool:
+    lib = _lib()
+    return bool(lib is not None and lib.frpc_tmpl_known(tid))
+
+
+def test_decode(body: bytes, cap: int = 1 << 20, buf=None):
+    """Run the C classifier/decoder on one frame body (unit tests and
+    the --codec microbench). Returns (kind, decoded bytes) — kind 0
+    means passthrough (decoded is the untouched body), kind 6 means the
+    frame would be absorbed into the ring's decref fold. None when the
+    native library is unavailable. Pass a reusable
+    ctypes.create_string_buffer as `buf` to keep a timing loop free of
+    per-call allocations."""
+    lib = _lib()
+    if lib is None:
+        return None
+    out = buf if buf is not None else ctypes.create_string_buffer(cap)
+    kind = ctypes.c_uint8(0)
+    n = lib.frpc_test_decode(body, len(body), out, len(out),
+                             ctypes.byref(kind))
+    if n == -2:
+        raise ValueError("frpc_test_decode: output buffer too small")
+    if n == 0:
+        return 0, body
+    return kind.value, out.raw[:n]
 
 
 class NativeIO:
@@ -84,6 +161,14 @@ class NativeIO:
     # ring fds are a process-lifetime resource in the C core (capped at
     # 64), so repeated init/shutdown cycles must recycle them.
     _ring_pool: List["NativeIO"] = []
+    # Native receive decode: process-wide (the C flag is global), applied
+    # by CoreWorker.start per init so the RTPU_NO_NATIVE_DECODE A/B can
+    # flip between init/shutdown cycles in one process.
+    _decode_on = False
+    # Ring-level sink for kind-6 decref folds (process-global: exactly
+    # one CoreWorker per process owns borrow-decref handling). Runs on
+    # whichever loop drains the ring; the fold consumer is thread-safe.
+    _fold_sink: Optional[Callable[[memoryview], None]] = None
 
     def __init__(self, lib: ctypes.CDLL, notify_fd: int, ring: int = 0):
         self._lib = lib
@@ -114,7 +199,7 @@ class NativeIO:
         if cls._instance is None:
             if os.environ.get("RTPU_DISABLE_NATIVE_RPC"):
                 return None
-            lib = _load()
+            lib = _lib()
             if lib is None:
                 return None
             fd = lib.frpc_start()
@@ -122,6 +207,26 @@ class NativeIO:
                 return None
             cls._instance = cls(lib, fd)
         return cls._instance
+
+    @classmethod
+    def apply_decode_config(cls, enabled: bool) -> bool:
+        """Arm (or disarm) the in-ring native decode, process-wide.
+        Called once per CoreWorker.start with the resolved
+        RTPU_NO_NATIVE_DECODE setting; returns the effective state.
+        Every ring of this process switches drain entry points together
+        — frpc_recv_decoded is the only drain that delivers the decref
+        fold."""
+        lib = _lib()
+        if lib is None:
+            cls._decode_on = False
+            return False
+        lib.frpc_decode_enable(1 if enabled else 0)
+        cls._decode_on = enabled
+        return enabled
+
+    @classmethod
+    def set_fold_sink(cls, sink: Optional[Callable]) -> None:
+        cls._fold_sink = sink
 
     @classmethod
     def new_ring(cls) -> Optional["NativeIO"]:
@@ -198,10 +303,12 @@ class NativeIO:
 
     def _drain(self):
         lib = self._lib
+        recv = lib.frpc_recv_decoded if NativeIO._decode_on \
+            else lib.frpc_recv2
         while True:
-            n = lib.frpc_recv2(self._ring, self._conn_ids, self._kinds,
-                               self._buf, len(self._buf), self._offsets,
-                               self._lengths, _RECV_CAP)
+            n = recv(self._ring, self._conn_ids, self._kinds,
+                     self._buf, len(self._buf), self._offsets,
+                     self._lengths, _RECV_CAP)
             if n == 0:
                 need = lib.frpc_next_len2(self._ring)
                 if need > len(self._buf):
@@ -221,6 +328,33 @@ class NativeIO:
                     return
 
     def _dispatch(self, conn: int, kind: int, body):
+        if kind == KIND_DECREF_FOLD:
+            # Ring-scoped (conn id 0), always the LAST event of a drain
+            # (the C side orders it after the queued frames). Apply via
+            # call_soon rather than synchronously: the frame events of
+            # this same drain dispatch their handlers through
+            # ensure_future, and a decrement must never run before an
+            # earlier-arrived borrow_addref frame's handler — late
+            # decrements only delay a free, early ones corrupt the
+            # count. The consumer (the lock-striped reference counter)
+            # is thread-safe, so WHICH loop runs it doesn't matter,
+            # only the ordering on this one.
+            sink = NativeIO._fold_sink
+            if sink is None:
+                logger.warning("decref fold dropped: no sink registered")
+                return
+            data = bytes(body)  # the recv buffer is reused
+
+            def _apply():
+                try:
+                    sink(data)
+                except Exception:
+                    logger.exception("decref fold sink failed")
+            try:
+                asyncio.get_running_loop().call_soon(_apply)
+            except RuntimeError:
+                _apply()  # no loop (tests driving _drain by hand)
+            return
         if kind == KIND_ACCEPT:
             (lid,) = _U64.unpack(body)
             factory = self._listeners.get(lid)
@@ -239,7 +373,7 @@ class NativeIO:
             self._orphans.setdefault(conn, []).append(
                 (conn, kind, bytes(body)))
             return
-        if kind != KIND_FRAME:
+        if kind == KIND_CLOSED:
             self._sinks.pop(conn, None)
         try:
             sink(kind, body)

@@ -213,11 +213,141 @@ def codec_bench(n: int = 20000, results: Optional[Dict[str, float]] = None
         "codec_flat_bytes_per_task": float(len(delta)),
         "codec_pickle_bytes_per_task": float(len(pickled)),
     }
+    out.update(_recv_side_bench(spec, tmpl, delta, reg, n))
     for metric, value in out.items():
         _report(metric, value,
-                "bytes" if metric.endswith("per_task") else "ns")
+                "bytes" if metric.endswith("per_task") else
+                ("ids/us" if metric.endswith("ids_per_us") else
+                 ("decrs/us" if metric.endswith("decrs_per_us") else
+                  "ns")))
     if results is not None:
         results.update(out)
+    return out
+
+
+def _recv_side_bench(spec, tmpl, delta, reg, n: int):
+    """Receive-path microbench (PERF.md round 14): the in-ring C decode
+    vs the Python decode it replaces, the done-stream id walk (pooled
+    borrowed keys vs per-id TaskID construction), and the batched
+    decref fold vs the legacy per-object handler path."""
+    from ray_tpu._internal import native_decode as nd
+    from ray_tpu._internal import rpc
+    from ray_tpu._internal.core_worker import (ReferenceCounter,
+                                               _pack_actor_batch)
+    from ray_tpu._internal.ids import ObjectID, TaskID
+    from ray_tpu._native import fastrpc as fp
+
+    out = {}
+    # -- C delta decode (64-delta actor batch amortizes the ctypes
+    # call; the decode itself runs in the C classifier exactly as the
+    # epoll thread runs it) vs the Python decode of the same frame.
+    batch = 64
+    payload = _pack_actor_batch(("127.0.0.1", 50123),
+                                [(tmpl.tid, tmpl.data)],
+                                [(tmpl.tid, delta)] * batch)
+    body = rpc.pack_frame(0, rpc.FLAG_RAW, b"push_actor_tasks",
+                          payload)[4:]
+    decoded = fp.test_decode(body)
+    if decoded is not None and decoded[0] == 4:
+        import ctypes
+        reuse = ctypes.create_string_buffer(len(body) + (1 << 16))
+        reps = max(1, n // batch)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fp.test_decode(body, buf=reuse)
+        out["recv_c_delta_decode_ns"] = \
+            (time.perf_counter() - t0) / (reps * batch) * 1e9
+        # Python consumption of the decoded records (record parse +
+        # freelist fill) — the per-spec Python residue left after C.
+        rec_payload = decoded[1]
+        from ray_tpu._internal import task_spec as ts_fill
+
+        def _consume():
+            _done_to, _tmpls, recs = nd.parse_actor_batch_record(
+                rec_payload)
+            for _tid, _known, fields in recs:
+                ts_fill.release_spec(
+                    ts_fill.spec_from_fields(reg, *fields))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _consume()
+        out["recv_decoded_fill_ns"] = \
+            (time.perf_counter() - t0) / (reps * batch) * 1e9
+    # Python-side decode of the same batch (what the A/B kill switch
+    # runs): per-frame walk + decode_delta per spec.
+    from ray_tpu._internal import task_spec as ts_mod
+    from ray_tpu._internal.core_worker import _unpack_actor_batch
+
+    def _py_decode():
+        _done_to, _tmpls, frames = _unpack_actor_batch(payload)
+        for _tid, d in frames:
+            ts_mod.release_spec(ts_mod.decode_delta(d, reg))
+    reps = max(1, n // batch)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        _py_decode()
+    out["recv_py_delta_decode_ns"] = \
+        (time.perf_counter() - t0) / (reps * batch) * 1e9
+
+    # -- done-stream id walk: fresh bytes + TaskID per id (pre-PR-11)
+    # vs borrowed keys over the one contiguous buffer.
+    n_ids = 4096
+    ids = b"".join(TaskID.of(spec.job_id).binary() for _ in range(n_ids))
+    table = {}
+    for key in TaskID.iter_borrowed(ids):
+        table[TaskID(bytes(key.binary()))] = None
+    sz = TaskID.SIZE
+
+    def _legacy_walk():
+        for i in range(n_ids):
+            table.get(TaskID(ids[i * sz:(i + 1) * sz]))
+
+    def _pooled_walk():
+        get = table.get
+        for key in TaskID.iter_borrowed(ids):
+            get(key)
+    t0 = time.perf_counter()
+    _legacy_walk()
+    out["recv_done_legacy_ids_per_us"] = \
+        n_ids / ((time.perf_counter() - t0) * 1e6)
+    t0 = time.perf_counter()
+    _pooled_walk()
+    out["recv_done_pooled_ids_per_us"] = \
+        n_ids / ((time.perf_counter() - t0) * 1e6)
+
+    # -- decref folds: one contiguous fold through the batch handler vs
+    # the legacy per-object path (hex round trip + one locked
+    # decrement per id, as one borrow_decref RPC per object paid).
+    class _Sink:
+        rpc_address = ("127.0.0.1", 1)
+
+        def _free_owned_object(self, *a, **k):
+            pass
+
+        def queue_borrow_decref(self, *a, **k):
+            pass
+
+        def fire_and_forget(self, *a, **k):
+            pass
+
+    n_oids = 4096
+    oids = [ObjectID.from_random() for _ in range(n_oids)]
+    rc = ReferenceCounter(_Sink())
+    for oid in oids:
+        rc.add_borrower(oid)
+        rc.add_borrower(oid)  # stays alive through one decrement round
+    fold = b"".join(o.binary() for o in oids)
+    t0 = time.perf_counter()
+    rc.remove_borrowers_fold(
+        [ObjectID(b) for b in nd.iter_fold_ids(fold)])
+    out["recv_fold_decrs_per_us"] = \
+        n_oids / ((time.perf_counter() - t0) * 1e6)
+    hexes = [o.hex() for o in oids]
+    t0 = time.perf_counter()
+    for h in hexes:
+        rc.remove_borrower(ObjectID(bytes.fromhex(h)))
+    out["recv_legacy_decrs_per_us"] = \
+        n_oids / ((time.perf_counter() - t0) * 1e6)
     return out
 
 
@@ -639,73 +769,113 @@ def main(quick: bool = False) -> Dict[str, float]:
     return results
 
 
-def shards_bench(shard_counts=(1, 2, 4), quick: bool = False
-                 ) -> Dict[str, float]:
-    """Owner-shard A/B: the two workloads the sharded core targets —
-    n:n async actor calls (4 async actors x 4 submitting threads) and
-    the multi-client flood (4 separate driver processes) — at each
-    shard count, one fresh cluster per arm. ``shards=1`` is the
-    exact-legacy single-loop path; the deltas between arms are the
-    sharding effect with everything else held constant (same box, same
-    run). Feeds the PERF.md round-10 table."""
+def shards_bench(shard_counts=(1, 2, 4), quick: bool = False,
+                 decode_arms=(True, False)) -> Dict[str, float]:
+    """Owner-shard x native-decode A/B: the workloads the sharded core
+    and the in-ring receive decode target — sync tasks, n:n async actor
+    calls (4 async actors x 4 submitting threads) and the multi-client
+    flood (4 separate driver processes) — at each shard count, paired
+    with native decode on and off (`RTPU_NO_NATIVE_DECODE`), one fresh
+    cluster per arm. ``shards=1`` + decode-off is the exact-legacy
+    path; only paired same-window ratios are signal. Feeds the PERF.md
+    round-10/round-14 tables. Decode arms set the ENV flag so spawned
+    raylets/workers inherit it (CONFIG alone would only flip the
+    driver)."""
+    import os
+
+    from ray_tpu._internal.config import CONFIG
+
+    scale = 1 if quick else 4
+    results: Dict[str, float] = {}
+    saved_nd = os.environ.get("RTPU_NO_NATIVE_DECODE")
+    try:
+        _shards_bench_arms(shard_counts, decode_arms, scale, quick,
+                           results)
+    finally:
+        if saved_nd is None:
+            os.environ.pop("RTPU_NO_NATIVE_DECODE", None)
+        else:
+            os.environ["RTPU_NO_NATIVE_DECODE"] = saved_nd
+        CONFIG.reset()
+    return results
+
+
+def _shards_bench_arms(shard_counts, decode_arms, scale, quick, results):
+    import os
     import threading
 
     import ray_tpu
     from ray_tpu._internal.config import CONFIG
 
-    scale = 1 if quick else 4
-    results: Dict[str, float] = {}
-    for count in shard_counts:
-        CONFIG.apply_system_config({"owner_shards": int(count)})
-        ray_tpu.init(num_cpus=8, object_store_memory=2 * 1024**3)
-        try:
-            from ray_tpu._internal.core_worker import get_core_worker
-            got = len(get_core_worker().shards)
-            if got != count:
-                raise RuntimeError(
-                    f"arm shards={count}: driver came up with {got}")
+    for decode_on in decode_arms:
+        os.environ["RTPU_NO_NATIVE_DECODE"] = "" if decode_on else "1"
+        CONFIG.reset()
+        tag = "" if decode_on else "_nodecode"
+        for count in shard_counts:
+            CONFIG.apply_system_config({"owner_shards": int(count)})
+            ray_tpu.init(num_cpus=8, object_store_memory=2 * 1024**3)
+            try:
+                from ray_tpu._internal.core_worker import get_core_worker
+                got = len(get_core_worker().shards)
+                if got != count:
+                    raise RuntimeError(
+                        f"arm shards={count}: driver came up with {got}")
 
-            @ray_tpu.remote
-            class Sink:
-                async def aping(self):
+                @ray_tpu.remote
+                def noop():
                     return None
 
-            actors = [Sink.options(max_concurrency=16).remote()
-                      for _ in range(4)]
-            ray_tpu.get([a.aping.remote() for a in actors
-                         for _ in range(50)])
-            n_per = 500 * scale
+                @ray_tpu.remote
+                class Sink:
+                    async def aping(self):
+                        return None
 
-            def _pound(a):
-                ray_tpu.get([a.aping.remote() for _ in range(n_per)])
+                # sync tasks (one at a time, full lease + push + reply
+                # round trip per call)
+                ray_tpu.get([noop.remote() for _ in range(20)])
+                n_sync = 100 * scale
+                metric = f"tasks_sync_per_s_shards{count}{tag}"
+                results[metric] = _rate(
+                    n_sync,
+                    lambda: [ray_tpu.get(noop.remote())
+                             for _ in range(n_sync)])
+                _report(metric, results[metric], "tasks/s")
 
-            def _nn():
-                threads = [threading.Thread(target=_pound, args=(a,))
-                           for a in actors]
-                for t in threads:
-                    t.start()
-                for t in threads:
-                    t.join()
-            metric = f"actor_calls_async_nn_per_s_shards{count}"
-            results[metric] = _rate(4 * n_per, _nn)
-            _report(metric, results[metric], "calls/s")
-            per_shard = [(row["shard"], row["submits"])
-                         for row in get_core_worker().shards.stats()]
-            print(json.dumps({"metric": f"shard_submits_shards{count}",
-                              "per_shard": per_shard}), flush=True)
-            try:
-                multi_client_bench(
-                    n_clients=2 if quick else 4, n_per=500 * scale,
-                    results=results,
-                    metric=f"tasks_async_multi_client_per_s_shards{count}")
-            except Exception as e:  # noqa: BLE001 — keep the other arms
-                print(json.dumps({
-                    "metric":
-                        f"tasks_async_multi_client_per_s_shards{count}",
-                    "error": str(e)}), flush=True)
-        finally:
-            ray_tpu.shutdown()
-    return results
+                actors = [Sink.options(max_concurrency=16).remote()
+                          for _ in range(4)]
+                ray_tpu.get([a.aping.remote() for a in actors
+                             for _ in range(50)])
+                n_per = 500 * scale
+
+                def _pound(a):
+                    ray_tpu.get([a.aping.remote() for _ in range(n_per)])
+
+                def _nn():
+                    threads = [threading.Thread(target=_pound, args=(a,))
+                               for a in actors]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                metric = f"actor_calls_async_nn_per_s_shards{count}{tag}"
+                results[metric] = _rate(4 * n_per, _nn)
+                _report(metric, results[metric], "calls/s")
+                per_shard = [(row["shard"], row["submits"])
+                             for row in get_core_worker().shards.stats()]
+                print(json.dumps(
+                    {"metric": f"shard_submits_shards{count}{tag}",
+                     "per_shard": per_shard}), flush=True)
+                mc_metric = \
+                    f"tasks_async_multi_client_per_s_shards{count}{tag}"
+                try:
+                    multi_client_bench(
+                        n_clients=2 if quick else 4, n_per=500 * scale,
+                        results=results, metric=mc_metric)
+                except Exception as e:  # noqa: BLE001 — keep other arms
+                    print(json.dumps({"metric": mc_metric,
+                                      "error": str(e)}), flush=True)
+            finally:
+                ray_tpu.shutdown()
 
 
 def failover_bench(quick: bool = False) -> Dict[str, float]:

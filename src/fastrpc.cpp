@@ -31,17 +31,54 @@
 //
 // Wire format (shared with the pure-Python asyncio fallback in rpc.py):
 //   u32le total_len, then `total_len` bytes of frame body. The body's
-//   layout (msg id, flags, method, payload) is parsed in Python. The
-//   frame types ride in the body's flags byte and are OPAQUE here —
-//   including FLAG_RAW (bit2), the flat task path's template-announce +
-//   delta frames, whose payload is struct-packed rather than pickled.
-//   This core forwards those bodies untouched: no re-encoding, no flag
-//   interpretation, so new frame types never require a native rebuild.
+//   layout (msg id, flags, method, payload) is parsed in Python. By
+//   default the frame types riding in the body's flags byte are OPAQUE
+//   here and bodies are forwarded untouched.
+//
+// Native receive decode (frpc_decode_enable): the per-completion hot
+// path — flat-wire task deltas, done-stream id arrays, refcount
+// decrements — additionally decodes ON THIS THREAD, so the Python
+// callback wakes once per notify with pre-parsed records instead of
+// once per frame with raw bytes. The decoder only touches FLAG_RAW
+// (bit2) request frames whose method is one of the four known hot
+// methods; anything else — pickled control RPCs, responses, unknown
+// methods, ANY malformed/torn body — passes through untouched as a
+// kind-0 event and takes the legacy Python path. Decoding is therefore
+// strictly an optimization: no new failure mode, and the
+// RTPU_NO_NATIVE_DECODE=1 kill switch simply never enables it.
+//
+//   push_task           -> kind 3: u64 msg_id | u64 lease_id | 16s tid
+//                          | u32 tmpl_len | tmpl bytes | DELTAREC
+//                          (template-unknown frames pass through so the
+//                          need_template reply stays a Python decision)
+//   push_actor_tasks    -> kind 4: u16 hlen | host | u32 port
+//                          | u8 n_tmpls | n*(16s tid | u32 len | bytes)
+//                          | u16 n_recs
+//                          | n*(16s tid | u8 known | u32 rec_len | DELTAREC)
+//   actor_tasks_done    -> kind 5: payload verbatim (u32 n | n*24s ids
+//                          | batch-pickled replies), bounds-validated
+//   borrow_decref_fold  -> no event: the contiguous 28-byte object-id
+//                          payload is accumulated into the ring's fold
+//                          buffer; frpc_recv_decoded delivers ONE
+//                          kind-6 event per drain with every decrement
+//                          that arrived since the last wakeup
+//
+//   DELTAREC (the normalized flat-wire delta):
+//     u8 dflags | 24s task_id | i64 seq | u32 attempt
+//     | u16 method_len | u16 trace0_len | u16 trace1_len | u32 args_len
+//     | method | trace0 | trace1 | args
+//
+// The template-id mirror (frpc_tmpl_register) tracks which announced
+// templates this process has seen so the decoder can distinguish
+// "decode against a known shape" from "unknown template: pass through".
+// It is conservative: eviction or a stale entry only costs a
+// passthrough / a need_template round trip in Python, never corruption.
 //
 // Event kinds delivered by frpc_recv:
 //   0 = frame (data = frame body)
 //   1 = accepted conn (data = u64le listener id)
 //   2 = conn closed (data empty)
+//   3-6 = decoded events (see above; frpc_recv_decoded only)
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -63,6 +100,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -71,6 +109,11 @@ constexpr size_t kReadChunk = 256 * 1024;
 constexpr size_t kMaxIov = 64;
 constexpr size_t kInHighWater = 256ULL * 1024 * 1024;
 constexpr int kMaxRings = 64;
+// A frame DECLARING more than this is not a frame — it is a torn/
+// corrupt length prefix (the runtime's largest legitimate frames are
+// inline returns, far below this). Reading it would buffer unbounded
+// garbage, so the conn is closed instead.
+constexpr size_t kMaxFrame = 1ULL << 30;
 
 struct Conn {
   int fd = -1;
@@ -115,6 +158,11 @@ struct Ring {
   int notifyfd = -1;
   std::atomic<bool> any_parked{false};  // conns of THIS ring parked
   std::atomic<bool> resume{false};      // python drained below low-water
+  // Batched refcount-decrement fold: borrow_decref_fold payloads
+  // (contiguous 28-byte object ids) accumulate here instead of queueing
+  // one event per frame; frpc_recv_decoded drains it as ONE kind-6
+  // event per wakeup. Guarded by mu; counts toward `bytes`.
+  std::string fold;
 };
 
 struct Core {
@@ -171,6 +219,364 @@ void push_event(Core* c, int ring, int64_t conn, uint8_t kind,
   r->bytes += data.size();
   r->q.push_back(InEvent{conn, kind, std::move(data)});
   notify_python(r);
+}
+
+// --------------------------------------------------------------------------
+// Native receive decode (see the file header for formats). Every helper
+// is strictly bounds-checked; any inconsistency makes the whole frame
+// pass through untouched, so a decoder bug can only cost speed.
+// --------------------------------------------------------------------------
+
+constexpr uint8_t kFlagResp = 1;
+constexpr uint8_t kFlagRaw = 4;
+constexpr size_t kBodyHdr = 11;       // u64 msg_id | u8 flags | u16 mlen
+constexpr size_t kTmplIdLen = 16;
+constexpr size_t kTaskIdLen = 24;
+constexpr size_t kObjectIdLen = 28;
+
+constexpr uint8_t kKindDecodedPush = 3;
+constexpr uint8_t kKindDecodedBatch = 4;
+constexpr uint8_t kKindDoneStream = 5;
+constexpr uint8_t kKindDecrefFold = 6;
+
+std::atomic<bool> g_decode{false};
+
+// Mirror of the Python receiver's announced-template registry.
+// Eviction mirrors the Python side's policy (oldest HALF by insertion
+// order, never a full clear — a wholesale clear would thrash every
+// active shape at once), and the bound sits above Python's 4096 so
+// mirror ⊇ registry holds in steady state. Staleness is safe either
+// way: an evicted entry only demotes that shape's frames to the raw
+// passthrough path until its next announce.
+struct TmplMirror {
+  std::mutex mu;
+  std::unordered_set<std::string> known;
+  std::deque<std::string> order;  // insertion order for eviction
+};
+TmplMirror g_tmpl;
+constexpr size_t kTmplMirrorCap = 8192;
+
+void tmpl_mirror_add(const uint8_t* tid) {
+  std::string key(reinterpret_cast<const char*>(tid), kTmplIdLen);
+  std::lock_guard<std::mutex> lk(g_tmpl.mu);
+  if (!g_tmpl.known.insert(key).second) return;  // already present
+  g_tmpl.order.push_back(std::move(key));
+  if (g_tmpl.known.size() > kTmplMirrorCap) {
+    for (size_t i = 0; i < kTmplMirrorCap / 2; i++) {
+      g_tmpl.known.erase(g_tmpl.order.front());
+      g_tmpl.order.pop_front();
+    }
+  }
+}
+
+bool tmpl_mirror_known(const uint8_t* tid) {
+  std::lock_guard<std::mutex> lk(g_tmpl.mu);
+  return g_tmpl.known.count(
+             std::string(reinterpret_cast<const char*>(tid),
+                         kTmplIdLen)) != 0;
+}
+
+// Little-endian bounded reader over one frame body.
+struct Rd {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+
+  bool take(size_t k, const uint8_t** out) {
+    if (n - off < k) return false;
+    *out = p + off;
+    off += k;
+    return true;
+  }
+  bool skip(size_t k) {
+    if (n - off < k) return false;
+    off += k;
+    return true;
+  }
+  bool u8(uint8_t* v) {
+    const uint8_t* b;
+    if (!take(1, &b)) return false;
+    *v = *b;
+    return true;
+  }
+  bool u16(uint16_t* v) {
+    const uint8_t* b;
+    if (!take(2, &b)) return false;
+    memcpy(v, b, 2);
+    return true;
+  }
+  bool u32(uint32_t* v) {
+    const uint8_t* b;
+    if (!take(4, &b)) return false;
+    memcpy(v, b, 4);
+    return true;
+  }
+  bool u64(uint64_t* v) {
+    const uint8_t* b;
+    if (!take(8, &b)) return false;
+    memcpy(v, b, 8);
+    return true;
+  }
+};
+
+void ap_u8(std::string* out, uint8_t v) {
+  out->push_back(static_cast<char>(v));
+}
+void ap_u16(std::string* out, uint16_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 2);
+}
+void ap_u32(std::string* out, uint32_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 4);
+}
+void ap_u64(std::string* out, uint64_t v) {
+  out->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+// Validate the flat-wire args section (u16 n_args, then typed entries);
+// it must consume the reader exactly.
+bool check_args_section(Rd* r) {
+  uint16_t n_args;
+  if (!r->u16(&n_args)) return false;
+  for (uint16_t i = 0; i < n_args; i++) {
+    uint8_t kind;
+    if (!r->u8(&kind)) return false;
+    if (kind == 0) {  // inline: u32 len + data, u16 n_contained + oids
+      uint32_t dlen;
+      uint16_t n_cont;
+      if (!r->u32(&dlen) || !r->skip(dlen) || !r->u16(&n_cont) ||
+          !r->skip(static_cast<size_t>(n_cont) * kObjectIdLen))
+        return false;
+    } else if (kind == 1) {  // ref, no owner
+      if (!r->skip(kObjectIdLen)) return false;
+    } else if (kind == 2) {  // ref + owner address
+      uint16_t hlen;
+      if (!r->skip(kObjectIdLen) || !r->u16(&hlen) || !r->skip(hlen) ||
+          !r->skip(4))
+        return false;
+    } else {
+      return false;
+    }
+  }
+  return r->off == r->n;
+}
+
+// Parse one flat-wire delta and append the normalized DELTAREC to *out.
+bool decode_delta_rec(const uint8_t* d, size_t n, std::string* out) {
+  Rd r{d, n};
+  uint8_t dflags;
+  const uint8_t* task_id;
+  const uint8_t* seq_attempt;  // i64 seq + u32 attempt, copied verbatim
+  if (!r.u8(&dflags) || !r.take(kTaskIdLen, &task_id) ||
+      !r.take(12, &seq_attempt))
+    return false;
+  const uint8_t* method = nullptr;
+  uint16_t mlen = 0;
+  if (dflags & 2) {
+    if (!r.u16(&mlen) || !r.take(mlen, &method)) return false;
+  }
+  const uint8_t* t0 = nullptr;
+  const uint8_t* t1 = nullptr;
+  uint16_t t0len = 0, t1len = 0;
+  if (dflags & 1) {
+    if (!r.u16(&t0len) || !r.take(t0len, &t0) || !r.u16(&t1len) ||
+        !r.take(t1len, &t1))
+      return false;
+  }
+  const uint8_t* args = d + r.off;
+  size_t args_len = n - r.off;
+  Rd ar{args, args_len};
+  if (args_len > 0xFFFFFFFFull || !check_args_section(&ar)) return false;
+  ap_u8(out, dflags);
+  out->append(reinterpret_cast<const char*>(task_id), kTaskIdLen);
+  out->append(reinterpret_cast<const char*>(seq_attempt), 12);
+  ap_u16(out, mlen);
+  ap_u16(out, t0len);
+  ap_u16(out, t1len);
+  ap_u32(out, static_cast<uint32_t>(args_len));
+  if (mlen) out->append(reinterpret_cast<const char*>(method), mlen);
+  if (t0len) out->append(reinterpret_cast<const char*>(t0), t0len);
+  if (t1len) out->append(reinterpret_cast<const char*>(t1), t1len);
+  out->append(reinterpret_cast<const char*>(args), args_len);
+  return true;
+}
+
+// push_task payload: u8 pflags | 16s tid | u64 lease
+//                    | [pflags&1: u32 tlen + tmpl] | delta
+bool decode_push_task(uint64_t msg_id, const uint8_t* p, size_t n,
+                      std::string* out) {
+  Rd r{p, n};
+  uint8_t pflags;
+  const uint8_t* tid;
+  uint64_t lease;
+  if (!r.u8(&pflags) || !r.take(kTmplIdLen, &tid) || !r.u64(&lease))
+    return false;
+  const uint8_t* tmpl = nullptr;
+  uint32_t tlen = 0;
+  if (pflags & 1) {
+    if (!r.u32(&tlen) || !r.take(tlen, &tmpl)) return false;
+  }
+  if (tmpl != nullptr) {
+    tmpl_mirror_add(tid);
+  } else if (!tmpl_mirror_known(tid)) {
+    // Unknown template and no in-band announce: the need_template
+    // reply is a Python-side protocol decision — pass through.
+    return false;
+  }
+  out->reserve(36 + tlen + (n - r.off) + 64);
+  ap_u64(out, msg_id);
+  ap_u64(out, lease);
+  out->append(reinterpret_cast<const char*>(tid), kTmplIdLen);
+  ap_u32(out, tlen);
+  if (tlen) out->append(reinterpret_cast<const char*>(tmpl), tlen);
+  return decode_delta_rec(p + r.off, n - r.off, out);
+}
+
+// push_actor_tasks payload:
+//   u16 hlen | host | u32 port | u8 n_tmpls
+//   | n*(16s tid | u32 len | bytes) | u16 n_frames
+//   | n*(16s tid | u32 dlen | delta)
+bool decode_actor_batch(const uint8_t* p, size_t n, std::string* out) {
+  Rd r{p, n};
+  uint16_t hlen;
+  const uint8_t* host;
+  uint32_t port;
+  uint8_t n_tmpls;
+  if (!r.u16(&hlen) || !r.take(hlen, &host) || !r.u32(&port) ||
+      !r.u8(&n_tmpls))
+    return false;
+  out->reserve(n + static_cast<size_t>(n_tmpls) * 4 + 256);
+  ap_u16(out, hlen);
+  out->append(reinterpret_cast<const char*>(host), hlen);
+  ap_u32(out, port);
+  ap_u8(out, n_tmpls);
+  for (uint8_t i = 0; i < n_tmpls; i++) {
+    const uint8_t* tid;
+    uint32_t tlen;
+    const uint8_t* data;
+    if (!r.take(kTmplIdLen, &tid) || !r.u32(&tlen) || !r.take(tlen, &data))
+      return false;
+    tmpl_mirror_add(tid);
+    out->append(reinterpret_cast<const char*>(tid), kTmplIdLen);
+    ap_u32(out, tlen);
+    out->append(reinterpret_cast<const char*>(data), tlen);
+  }
+  uint16_t n_frames;
+  if (!r.u16(&n_frames)) return false;
+  ap_u16(out, n_frames);
+  // Batches overwhelmingly repeat ONE template id: memoize the last
+  // (tid, known) pair so the mirror mutex is taken ~once per frame,
+  // not once per delta record, on the epoll hot thread.
+  uint8_t last_tid[kTmplIdLen];
+  bool have_last = false;
+  bool last_known = false;
+  for (uint16_t i = 0; i < n_frames; i++) {
+    const uint8_t* tid;
+    uint32_t dlen;
+    const uint8_t* delta;
+    if (!r.take(kTmplIdLen, &tid) || !r.u32(&dlen) ||
+        !r.take(dlen, &delta))
+      return false;
+    out->append(reinterpret_cast<const char*>(tid), kTmplIdLen);
+    // `known` is advisory: a stale mirror only sends Python down its
+    // existing unknown-template report path (the rec carries the task
+    // id, so the report needs no template).
+    if (!have_last || memcmp(last_tid, tid, kTmplIdLen) != 0) {
+      memcpy(last_tid, tid, kTmplIdLen);
+      have_last = true;
+      last_known = tmpl_mirror_known(tid);
+    }
+    ap_u8(out, last_known ? 1 : 0);
+    size_t len_at = out->size();
+    ap_u32(out, 0);  // rec_len placeholder, patched below
+    size_t rec_at = out->size();
+    if (!decode_delta_rec(delta, dlen, out)) return false;
+    uint32_t rec_len = static_cast<uint32_t>(out->size() - rec_at);
+    memcpy(&(*out)[len_at], &rec_len, 4);
+  }
+  return r.off == r.n;
+}
+
+// actor_tasks_done payload: u32 n | n*24s ids | batch-pickled replies.
+// Forwarded verbatim once the id array is bounds-validated.
+bool decode_done_stream(const uint8_t* p, size_t n, std::string* out) {
+  Rd r{p, n};
+  uint32_t cnt;
+  if (!r.u32(&cnt)) return false;
+  if (!r.skip(static_cast<size_t>(cnt) * kTaskIdLen)) return false;
+  out->assign(reinterpret_cast<const char*>(p), n);
+  return true;
+}
+
+// Classify one frame body. Returns:
+//   0 = passthrough (deliver raw kind-0, the legacy path)
+//   1 = decoded event (*kind_out, *out filled)
+//   2 = decref fold (*out = the contiguous object-id payload; the
+//       caller appends it to the ring's fold buffer — no event)
+int classify_frame(const uint8_t* p, size_t n, uint8_t* kind_out,
+                   std::string* out) {
+  if (n < kBodyHdr) return 0;
+  uint64_t msg_id;
+  memcpy(&msg_id, p, 8);
+  uint8_t flags = p[8];
+  uint16_t mlen;
+  memcpy(&mlen, p + 9, 2);
+  if ((flags & kFlagResp) || !(flags & kFlagRaw)) return 0;
+  if (kBodyHdr + static_cast<size_t>(mlen) > n) return 0;  // torn body
+  const char* m = reinterpret_cast<const char*>(p) + kBodyHdr;
+  const uint8_t* pay = p + kBodyHdr + mlen;
+  size_t plen = n - kBodyHdr - mlen;
+  if (mlen == 9 && memcmp(m, "push_task", 9) == 0) {
+    if (!decode_push_task(msg_id, pay, plen, out)) {
+      out->clear();
+      return 0;
+    }
+    *kind_out = kKindDecodedPush;
+    return 1;
+  }
+  if (mlen == 16 && memcmp(m, "push_actor_tasks", 16) == 0) {
+    if (!decode_actor_batch(pay, plen, out)) {
+      out->clear();
+      return 0;
+    }
+    *kind_out = kKindDecodedBatch;
+    return 1;
+  }
+  if (mlen == 16 && memcmp(m, "actor_tasks_done", 16) == 0) {
+    if (!decode_done_stream(pay, plen, out)) {
+      out->clear();
+      return 0;
+    }
+    *kind_out = kKindDoneStream;
+    return 1;
+  }
+  if (mlen == 18 && memcmp(m, "borrow_decref_fold", 18) == 0) {
+    if (plen == 0 || plen % kObjectIdLen != 0 || msg_id != 0) return 0;
+    out->assign(reinterpret_cast<const char*>(pay), plen);
+    return 2;
+  }
+  return 0;
+}
+
+void deliver_frame(Core* c, Conn* conn, const char* p, size_t len) {
+  if (g_decode.load(std::memory_order_relaxed)) {
+    std::string out;
+    uint8_t kind = 0;
+    int cls = classify_frame(reinterpret_cast<const uint8_t*>(p), len,
+                             &kind, &out);
+    if (cls == 1) {
+      push_event(c, conn->ring, conn->id, kind, std::move(out));
+      return;
+    }
+    if (cls == 2) {
+      Ring* r = c->rings[conn->ring];
+      std::lock_guard<std::mutex> lk(r->mu);
+      r->fold.append(out);
+      r->bytes += out.size();
+      notify_python(r);
+      return;
+    }
+  }
+  push_event(c, conn->ring, conn->id, 0, std::string(p, len));
 }
 
 void epoll_mod(Core* c, Conn* conn) {
@@ -230,15 +636,23 @@ void handle_accept(Core* c, Conn* listener) {
 }
 
 // Parse complete frames out of conn->in; deliver bodies to the in-queue.
-void parse_frames(Core* c, Conn* conn) {
+// Returns false when the conn was closed (oversized length prefix).
+bool parse_frames(Core* c, Conn* conn) {
   std::string& buf = conn->in;
   size_t off = conn->in_off;
   for (;;) {
     if (buf.size() - off < 4) break;
     uint32_t len;
     memcpy(&len, buf.data() + off, 4);
+    if (static_cast<size_t>(len) > kMaxFrame) {
+      // A torn/corrupt length prefix, not a frame: buffering it would
+      // grow without bound. Close and let the peer's recovery paths
+      // (probe / reconcile) take over.
+      close_conn(c, conn, true);
+      return false;
+    }
     if (buf.size() - off - 4 < len) break;
-    push_event(c, conn->ring, conn->id, 0, buf.substr(off + 4, len));
+    deliver_frame(c, conn, buf.data() + off + 4, len);
     off += 4 + static_cast<size_t>(len);
   }
   if (off == buf.size()) {
@@ -250,6 +664,7 @@ void parse_frames(Core* c, Conn* conn) {
   } else {
     conn->in_off = off;
   }
+  return true;
 }
 
 void handle_read(Core* c, Conn* conn) {
@@ -258,7 +673,7 @@ void handle_read(Core* c, Conn* conn) {
     ssize_t n = read(conn->fd, tmp, sizeof(tmp));
     if (n > 0) {
       conn->in.append(tmp, static_cast<size_t>(n));
-      parse_frames(c, conn);
+      if (!parse_frames(c, conn)) return;  // conn closed (bad framing)
       if (n < static_cast<ssize_t>(sizeof(tmp))) return;
       continue;
     }
@@ -665,15 +1080,26 @@ uint64_t frpc_out_bytes(int64_t conn_id) {
 // Drain up to `cap` pending events of one ring whose bodies fit in
 // out_buf (first event always delivered even if larger than buf_cap...
 // callers size buf generously). Parallel output arrays describe each
-// event. Returns the number of events written.
-int64_t frpc_recv2(int ring, int64_t* conn_ids, uint8_t* kinds,
-                   uint8_t* out_buf, uint64_t buf_cap, uint64_t* offsets,
-                   uint64_t* lengths, int64_t cap) {
+// event. Returns the number of events written. With `with_fold`, the
+// ring's accumulated decref fold is delivered first as one kind-6
+// event (conn id 0).
+int64_t recv_impl(int ring, bool with_fold, int64_t* conn_ids,
+                  uint8_t* kinds, uint8_t* out_buf, uint64_t buf_cap,
+                  uint64_t* offsets, uint64_t* lengths, int64_t cap) {
   Core* c = g_core;
   if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
     return 0;
   Ring* r = c->rings[ring];
   std::lock_guard<std::mutex> lk(r->mu);
+  if (!with_fold && !r->fold.empty()) {
+    // Legacy drain with a residual fold: only possible after a
+    // decode-on -> decode-off flip across init cycles (the fold's
+    // decrements belong to the torn-down cluster). Discard it — a
+    // legacy drain has no fold consumer, and keeping it would pin the
+    // notify eventfd readable forever (busy-looping the reader).
+    r->bytes -= r->fold.size();
+    r->fold.clear();
+  }
   int64_t n = 0;
   uint64_t used = 0;
   while (n < cap && !r->q.empty()) {
@@ -690,7 +1116,24 @@ int64_t frpc_recv2(int ring, int64_t* conn_ids, uint8_t* kinds,
     r->q.pop_front();
     n++;
   }
-  if (r->q.empty()) {
+  // The fold is delivered AFTER the queued frames, and only on a call
+  // that fully drained the queue: a refcount DECREMENT applied late is
+  // always safe (it can only delay a free), but a decrement jumping
+  // ahead of an earlier-arrived borrow_addref frame would corrupt the
+  // owner's count (lost decrement / premature free).
+  if (with_fold && !r->fold.empty() && r->q.empty() && n < cap &&
+      used + r->fold.size() <= buf_cap) {
+    memcpy(out_buf + used, r->fold.data(), r->fold.size());
+    conn_ids[n] = 0;
+    kinds[n] = kKindDecrefFold;
+    offsets[n] = used;
+    lengths[n] = r->fold.size();
+    used += r->fold.size();
+    r->bytes -= r->fold.size();
+    r->fold.clear();
+    n++;
+  }
+  if (r->q.empty() && r->fold.empty()) {
     r->notified = false;
     uint64_t buf;
     ssize_t rd = read(r->notifyfd, &buf, 8);
@@ -706,6 +1149,25 @@ int64_t frpc_recv2(int ring, int64_t* conn_ids, uint8_t* kinds,
   return n;
 }
 
+int64_t frpc_recv2(int ring, int64_t* conn_ids, uint8_t* kinds,
+                   uint8_t* out_buf, uint64_t buf_cap, uint64_t* offsets,
+                   uint64_t* lengths, int64_t cap) {
+  return recv_impl(ring, false, conn_ids, kinds, out_buf, buf_cap, offsets,
+                   lengths, cap);
+}
+
+// The decoded-path drain: same contract as frpc_recv2 plus kind 3-6
+// events (the fold, if any, arrives first). The process that enables
+// decode must drain every ring through THIS entry — frpc_recv2 would
+// deliver the decoded kinds but never the fold.
+int64_t frpc_recv_decoded(int ring, int64_t* conn_ids, uint8_t* kinds,
+                          uint8_t* out_buf, uint64_t buf_cap,
+                          uint64_t* offsets, uint64_t* lengths,
+                          int64_t cap) {
+  return recv_impl(ring, true, conn_ids, kinds, out_buf, buf_cap, offsets,
+                   lengths, cap);
+}
+
 int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
                   uint64_t buf_cap, uint64_t* offsets, uint64_t* lengths,
                   int64_t cap) {
@@ -714,14 +1176,17 @@ int64_t frpc_recv(int64_t* conn_ids, uint8_t* kinds, uint8_t* out_buf,
 }
 
 // Size of the next pending event (0 if none) — lets Python grow its
-// receive buffer before a frpc_recv that would otherwise stall.
+// receive buffer before a frpc_recv that would otherwise stall. The
+// pending fold counts: frpc_recv_decoded delivers it first, so the
+// buffer must fit it.
 uint64_t frpc_next_len2(int ring) {
   Core* c = g_core;
   if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
     return 0;
   Ring* r = c->rings[ring];
   std::lock_guard<std::mutex> lk(r->mu);
-  return r->q.empty() ? 0 : r->q.front().data.size();
+  uint64_t front = r->q.empty() ? 0 : r->q.front().data.size();
+  return front > r->fold.size() ? front : r->fold.size();
 }
 
 uint64_t frpc_next_len(void) { return frpc_next_len2(0); }
@@ -736,6 +1201,60 @@ void frpc_close(int64_t conn_id) {
   uint64_t one = 1;
   ssize_t r = write(c->wakefd, &one, 8);
   (void)r;
+}
+
+// -- native receive decode control ------------------------------------------
+
+// Turn in-ring decode on/off process-wide. Callers that enable it must
+// drain every ring via frpc_recv_decoded. Safe to toggle at runtime
+// (frames mid-queue keep the kind they were parsed with). Disabling
+// discards any accumulated folds — the A/B flip happens at init
+// boundaries, where pending decrements belong to a torn-down cluster
+// (recv_impl's legacy-drain path discards residuals the same way).
+void frpc_decode_enable(int on) {
+  g_decode.store(on != 0, std::memory_order_relaxed);
+  Core* c = g_core;
+  if (on || !c) return;
+  int n_rings = c->n_rings.load(std::memory_order_acquire);
+  for (int i = 0; i < n_rings; i++) {
+    Ring* r = c->rings[i];
+    std::lock_guard<std::mutex> lk(r->mu);
+    if (!r->fold.empty()) {
+      r->bytes -= r->fold.size();
+      r->fold.clear();
+    }
+  }
+}
+
+int frpc_decode_enabled(void) { return g_decode.load() ? 1 : 0; }
+
+// Mirror one announced template id (16 bytes) into the decoder's table.
+// Python calls this from its own register_template so the two registries
+// advance together; in-band announces register themselves.
+void frpc_tmpl_register(const uint8_t* tid) { tmpl_mirror_add(tid); }
+
+int frpc_tmpl_known(const uint8_t* tid) {
+  return tmpl_mirror_known(tid) ? 1 : 0;
+}
+
+// Run the classifier/decoder on ONE frame body outside the io loop —
+// the unit-test and microbench hook (also exercised by the ASAN debug
+// build's smoke test). Writes the decoded event into `out` and its
+// kind into *kind_out (kind 6 = the frame would be absorbed into the
+// fold; `out` then holds the fold payload). Returns the decoded
+// length, 0 for passthrough (the frame would be delivered raw), or -2
+// if `out` is too small. Mutates the process template mirror exactly
+// like the io thread would.
+int64_t frpc_test_decode(const uint8_t* body, uint64_t len, uint8_t* out,
+                         uint64_t cap, uint8_t* kind_out) {
+  std::string decoded;
+  uint8_t kind = 0;
+  int cls = classify_frame(body, static_cast<size_t>(len), &kind, &decoded);
+  if (cls == 0) return 0;
+  if (decoded.size() > cap) return -2;
+  memcpy(out, decoded.data(), decoded.size());
+  *kind_out = (cls == 2) ? kKindDecrefFold : kind;
+  return static_cast<int64_t>(decoded.size());
 }
 
 }  // extern "C"

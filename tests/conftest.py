@@ -34,6 +34,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "timeout_s(seconds): override the per-test watchdog timeout")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` gate (heavy "
+        "A/B arms, soaks)")
 
 
 @pytest.fixture(autouse=True)

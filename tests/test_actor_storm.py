@@ -17,14 +17,19 @@ import ray_tpu
 from ray_tpu._internal.config import CONFIG
 
 
-@pytest.mark.timeout_s(600)
-def test_actor_identity_under_lease_retry_storm(monkeypatch):
+def _storm(monkeypatch, no_decode: bool, shards: int, n_actors: int):
     # 40% of lease replies vanish; the caller times out in 2s and
     # retries. Spawns are real worker processes, so identity crossing
     # (two creations on one worker) would surface as a wrong idx.
     monkeypatch.setenv("RTPU_TESTING_RPC_FAILURE",
                        "request_worker_lease:0:0.4")
-    CONFIG.apply_system_config({"actor_lease_rpc_timeout_s": 2.0})
+    # the native-decode x owner-shards arms (PR 11): env so spawned
+    # raylet/workers inherit, CONFIG for this driver
+    monkeypatch.setenv("RTPU_NO_NATIVE_DECODE", "1" if no_decode else "")
+    monkeypatch.setenv("RTPU_OWNER_SHARDS", str(shards))
+    CONFIG.apply_system_config({"actor_lease_rpc_timeout_s": 2.0,
+                                "no_native_decode": no_decode,
+                                "owner_shards": shards})
     try:
         ray_tpu.init(num_cpus=8, object_store_memory=200 * 1024 * 1024)
 
@@ -36,17 +41,40 @@ def test_actor_identity_under_lease_retry_storm(monkeypatch):
             def whoami(self):
                 return (os.getpid(), self.idx)
 
-        N = 60
-        actors = [Probe.remote(i) for i in range(N)]
+        from ray_tpu._internal.core_worker import get_core_worker
+        assert len(get_core_worker().shards) == shards
+        actors = [Probe.remote(i) for i in range(n_actors)]
         infos = ray_tpu.get([a.whoami.remote() for a in actors],
                             timeout=500)
-        assert [idx for _pid, idx in infos] == list(range(N))
+        assert [idx for _pid, idx in infos] == list(range(n_actors))
         # every actor lives in its OWN process (no worker double-binding)
         pids = [pid for pid, _ in infos]
-        assert len(set(pids)) == N, \
-            f"{N - len(set(pids))} worker processes host 2+ actors"
+        assert len(set(pids)) == n_actors, \
+            f"{n_actors - len(set(pids))} worker processes host 2+ actors"
         for a in actors:
             ray_tpu.kill(a)
     finally:
-        CONFIG.apply_system_config({"actor_lease_rpc_timeout_s": 600.0})
         ray_tpu.shutdown()
+        # Explicit re-apply, NOT CONFIG.reset(): reset() re-reads the
+        # environment while the monkeypatched arm variables are still
+        # set (monkeypatch restores env only after the test returns),
+        # which would leak this arm's config into later tests.
+        CONFIG.apply_system_config({"actor_lease_rpc_timeout_s": 600.0,
+                                    "no_native_decode": False,
+                                    "owner_shards": 0})
+
+
+@pytest.mark.timeout_s(600)
+def test_actor_identity_under_lease_retry_storm(monkeypatch):
+    # default configuration (native decode ON since PR 11)
+    _storm(monkeypatch, no_decode=False, shards=1, n_actors=60)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(600)
+@pytest.mark.parametrize("no_decode,shards", [
+    (True, 1), (False, 4), (True, 4)])
+def test_actor_identity_storm_decode_arms(monkeypatch, no_decode, shards):
+    """The storm suite across the native-decode x owner-shards matrix
+    (smaller N per arm; the default arm above keeps the full 60)."""
+    _storm(monkeypatch, no_decode=no_decode, shards=shards, n_actors=24)

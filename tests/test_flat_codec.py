@@ -214,10 +214,10 @@ def test_actor_batch_packing():
                                                  (tid, b"d1")]
 
 
-def test_no_cloudpickle_on_steady_state_submit(ray_start_regular):
-    """Regression guard: the steady-state submit path for plain-args
-    tasks and actor calls must not invoke cloudpickle.dumps (patch and
-    count). Export/warm-up may; the loop may not."""
+def _steady_state_submit_guard():
+    """The steady-state submit path for plain-args tasks and actor
+    calls must not invoke cloudpickle.dumps (patch and count).
+    Export/warm-up may; the loop may not. Assumes a cluster is up."""
     import cloudpickle
 
     @ray_tpu.remote
@@ -255,3 +255,37 @@ def test_no_cloudpickle_on_steady_state_submit(ray_start_regular):
     # and the flat wire path was actually exercised
     from ray_tpu._internal.core_worker import get_core_worker
     assert get_core_worker()._tmpl_sent
+
+
+def test_no_cloudpickle_on_steady_state_submit(ray_start_regular):
+    """Regression guard at the default configuration (native receive
+    decode ON since PR 11)."""
+    _steady_state_submit_guard()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(240)
+@pytest.mark.parametrize("no_decode,shards", [
+    (True, 1), (False, 4), (True, 4)])
+def test_no_cloudpickle_steady_state_decode_arms(monkeypatch, no_decode,
+                                                 shards):
+    """The flat-codec steady-state guard across the native-decode x
+    owner-shards matrix (env set so spawned raylet/workers inherit the
+    arm; the default arm rides test_no_cloudpickle_on_steady_state_
+    submit)."""
+    from ray_tpu._internal.config import CONFIG
+    monkeypatch.setenv("RTPU_NO_NATIVE_DECODE", "1" if no_decode else "")
+    monkeypatch.setenv("RTPU_OWNER_SHARDS", str(shards))
+    CONFIG.apply_system_config({"no_native_decode": no_decode,
+                                "owner_shards": shards})
+    try:
+        ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+        from ray_tpu._internal.core_worker import get_core_worker
+        assert len(get_core_worker().shards) == shards
+        _steady_state_submit_guard()
+    finally:
+        ray_tpu.shutdown()
+        # explicit re-apply, not reset(): reset() would re-read the
+        # still-monkeypatched env and leak the arm into later tests
+        CONFIG.apply_system_config({"no_native_decode": False,
+                                    "owner_shards": 0})

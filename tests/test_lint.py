@@ -248,6 +248,48 @@ def test_l006_outside_hot_path_ok():
     assert "L006" not in _rules(src, path="ray_tpu/_internal/gcs.py")
 
 
+def test_l006_covers_native_decode_module():
+    src = ("from . import serialization\n"
+           "def unpack(payload):\n"
+           "    return serialization.loads(payload)\n")
+    assert "L006" in _rules(src,
+                            path="ray_tpu/_internal/native_decode.py")
+
+
+def test_l006_batch_pickler_needs_annotation():
+    bare = ("from . import serialization\n"
+            "def flush(replies):\n"
+            "    return serialization.dumps_batch(replies)\n")
+    for path in ("ray_tpu/_internal/native_decode.py",
+                 "ray_tpu/_internal/core_worker.py"):
+        assert "L006" in _rules(bare, path=path)
+    marked = ("from . import serialization\n"
+              "def flush(replies):\n"
+              "    return serialization.dumps_batch(replies)"
+              "  # batch ok: one pickle per done batch\n")
+    assert "L006" not in _rules(marked,
+                                path="ray_tpu/_internal/native_decode.py")
+    # outside hot-path modules the batch helpers need no mark
+    assert "L006" not in _rules(bare, path="ray_tpu/_internal/gcs.py")
+
+
+def test_shard_registry_covers_c_fed_tables():
+    """The tables the native receive path (PR 11) feeds — the
+    done-stream fold (`_awaiting`/`_push_time`) and the submitter's
+    reply-routing state — must stay in the `# shard-local` registry so
+    L007 keeps guarding them as C-decoded events flow in."""
+    import os
+    from ray_tpu._internal.lint.rules import lint_source
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = "ray_tpu/_internal/core_worker.py"
+    with open(os.path.join(repo, path)) as f:
+        src = f.read()
+    _v, _m, decls, _a = lint_source(src, path)
+    registry = {d.attr for d in decls}
+    for attr in ("_awaiting", "_push_time", "_running", "_probed"):
+        assert attr in registry, f"{attr} lost its # shard-local mark"
+
+
 # ---------------------------------------------------------------------------
 # L007 loop/shard hygiene
 # ---------------------------------------------------------------------------

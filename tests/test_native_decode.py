@@ -1,0 +1,468 @@
+"""Native receive path (PR 11): the in-ring C decoder vs its Python
+twin — record round-trips through frpc_test_decode, template-mirror
+behavior (unknown => passthrough, announce => known), torn/oversized
+frame rejection, freelist reuse from C-decoded fields, borrowed-key
+done-stream iteration, batched decref folds, and the ASAN debug-build
+smoke test."""
+
+import os
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal import native_decode as nd
+from ray_tpu._internal import rpc
+from ray_tpu._internal import task_spec as ts
+from ray_tpu._internal.config import CONFIG
+from ray_tpu._internal.core_worker import (ReferenceCounter,
+                                           _pack_actor_batch,
+                                           _pack_push_task)
+from ray_tpu._internal.ids import ActorID, JobID, ObjectID, TaskID
+from ray_tpu._native import fastrpc as fp
+from ray_tpu.remote_function import pack_args
+
+
+def _spec(**overrides):
+    job = JobID.from_int(3)
+    kwargs = dict(
+        task_id=TaskID.of(job), job_id=job, task_type=ts.ACTOR_TASK,
+        function=ts.FunctionDescriptor("mod", "Cls.fn", "abc"),
+        args=pack_args((), {}), num_returns=1, resources={},
+        owner_address=("127.0.0.1", 50001), owner_worker_id=b"w" * 28,
+        name="Cls.fn", actor_id=ActorID.of(job), method_name="fn",
+        sequence_number=11)
+    kwargs.update(overrides)
+    return ts.TaskSpec(**kwargs)
+
+
+def _native_available():
+    return fp.test_decode(b"") is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="native toolchain unavailable")
+
+
+def _frame(method: bytes, payload: bytes, msg_id: int = 0) -> bytes:
+    """A frame BODY (no length prefix) as the C parser sees it."""
+    return rpc.pack_frame(msg_id, rpc.FLAG_RAW, method, payload)[4:]
+
+
+# ---------------------------------------------------------------------------
+# template mirroring + push_task records
+# ---------------------------------------------------------------------------
+
+def test_unknown_template_passes_through_then_announce_recovers():
+    """The C decoder must never guess: a delta whose template it has
+    not seen passes through raw (Python answers need_template), the
+    same frame WITH the announce decodes — and afterwards the mirror
+    knows the shape, so announce-free deltas decode too (the re-announce
+    recovery the owner's need_template retry relies on)."""
+    spec = _spec(method_name="fresh_mirror_a")
+    tmpl = ts.make_template(spec)
+    delta = ts.encode_delta(spec, tmpl.method_name)
+
+    bare = _frame(b"push_task", _pack_push_task(tmpl.tid, 7, None, delta),
+                  msg_id=5)
+    kind, body = fp.test_decode(bare)
+    assert kind == 0 and body == bare  # passthrough, untouched
+
+    announced = _frame(
+        b"push_task", _pack_push_task(tmpl.tid, 7, tmpl.data, delta),
+        msg_id=5)
+    kind, rec = fp.test_decode(announced)
+    assert kind == 3
+    msg_id, lease, tid, tmpl_data, fields = nd.parse_push_record(rec)
+    assert (msg_id, lease, tid, tmpl_data) == (5, 7, tmpl.tid, tmpl.data)
+
+    # mirror learned the shape: the bare frame now decodes
+    kind, rec2 = fp.test_decode(bare)
+    assert kind == 3
+    _msg, _lease, _tid, no_tmpl, fields2 = nd.parse_push_record(rec2)
+    assert no_tmpl is None
+    assert fields2[0] == spec.task_id.binary()
+    assert fp.template_known(tmpl.tid)
+
+
+def test_push_record_fills_freelist_spec():
+    spec = _spec(method_name="fill_b",
+                 trace_context=("trace-x", "span-y"))
+    tmpl = ts.make_template(spec)
+    ts.register_template(tmpl.tid, tmpl.data)  # also mirrors into C
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    body = _frame(b"push_task", _pack_push_task(tmpl.tid, 1, None, delta),
+                  msg_id=9)
+    kind, rec = fp.test_decode(body)
+    assert kind == 3
+    _m, _l, tid, _t, fields = nd.parse_push_record(rec)
+    reg = ts.lookup_template(tid)
+    decoded = ts.spec_from_fields(reg, *fields)
+    assert decoded.task_id == spec.task_id
+    assert decoded.sequence_number == spec.sequence_number
+    assert decoded.trace_context == ("trace-x", "span-y")
+    assert decoded.method_name == "fill_b"
+    # freelist reuse: release -> same object comes back, clean
+    ts.release_spec(decoded)
+    again = ts.spec_from_fields(reg, *fields)
+    assert again is decoded
+    assert again.trace_context == ("trace-x", "span-y")
+    ts.release_spec(again)
+
+
+def test_register_template_mirrors_into_c():
+    spec = _spec(method_name="mirror_c")
+    tmpl = ts.make_template(spec)
+    assert not fp.template_known(tmpl.tid)
+    ts.register_template(tmpl.tid, tmpl.data)
+    assert fp.template_known(tmpl.tid)
+
+
+def test_mirror_evicts_oldest_half_not_everything():
+    """The C mirror partial-evicts by insertion order (like the Python
+    registry) — a full clear would thrash every active shape at once.
+    Newest entries must survive an overflow; evicted ones just demote
+    to the passthrough path."""
+    first = bytes([1]) + os.urandom(15)
+    fp.mirror_template(first)
+    assert fp.template_known(first)
+    # push the mirror past its 8192 cap
+    for _ in range(8300):
+        fp.mirror_template(os.urandom(16))
+    newest = os.urandom(16)
+    fp.mirror_template(newest)
+    assert fp.template_known(newest)
+    assert not fp.template_known(first)  # oldest half evicted
+
+
+# ---------------------------------------------------------------------------
+# actor batches
+# ---------------------------------------------------------------------------
+
+def test_actor_batch_record_roundtrip():
+    spec = _spec(method_name="batch_d")
+    tmpl = ts.make_template(spec)
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    payload = _pack_actor_batch(("10.0.0.9", 40404),
+                                [(tmpl.tid, tmpl.data)],
+                                [(tmpl.tid, delta)] * 3)
+    kind, rec = fp.test_decode(_frame(b"push_actor_tasks", payload))
+    assert kind == 4
+    done_to, tmpls, recs = nd.parse_actor_batch_record(rec)
+    assert done_to == ("10.0.0.9", 40404)
+    assert tmpls == [(tmpl.tid, tmpl.data)]
+    assert len(recs) == 3
+    ts.register_template(tmpl.tid, tmpl.data)
+    reg = ts.lookup_template(tmpl.tid)
+    for tid, known, fields in recs:
+        assert tid == tmpl.tid and known
+        decoded = ts.spec_from_fields(reg, *fields)
+        assert decoded.task_id == spec.task_id
+        ts.release_spec(decoded)
+
+
+def test_actor_batch_unknown_template_keeps_task_id():
+    """A record whose template the mirror does not know still carries
+    the task id, so the unknown-template done report works without the
+    shape."""
+    spec = _spec(method_name="batch_unknown_e")
+    tmpl = ts.make_template(spec)
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    payload = _pack_actor_batch(("127.0.0.1", 1), [],
+                                [(tmpl.tid, delta)])
+    kind, rec = fp.test_decode(_frame(b"push_actor_tasks", payload))
+    assert kind == 4
+    _done_to, _tmpls, recs = nd.parse_actor_batch_record(rec)
+    (tid, known, fields), = recs
+    assert tid == tmpl.tid and not known
+    assert fields[0] == spec.task_id.binary()
+
+
+# ---------------------------------------------------------------------------
+# torn / oversized frames
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mutate", [
+    lambda b: b[:len(b) - 3],                 # truncated args section
+    lambda b: b[:40],                          # truncated delta head
+    lambda b: b + b"\x00" * 7,                 # trailing garbage
+])
+def test_torn_push_frames_pass_through(mutate):
+    spec = _spec(method_name="torn_f")
+    tmpl = ts.make_template(spec)
+    ts.register_template(tmpl.tid, tmpl.data)
+    delta = ts.encode_delta(spec, tmpl.method_name)
+    body = _frame(b"push_task",
+                  mutate(_pack_push_task(tmpl.tid, 1, None, delta)),
+                  msg_id=2)
+    kind, out = fp.test_decode(body)
+    assert kind == 0 and out == body  # rejected -> untouched passthrough
+
+
+def test_torn_done_stream_and_fold_pass_through():
+    bad_done = _frame(b"actor_tasks_done",
+                      struct.pack("<I", 1000) + b"x" * 16)
+    assert fp.test_decode(bad_done)[0] == 0
+    bad_fold = _frame(b"borrow_decref_fold", b"y" * 27)
+    assert fp.test_decode(bad_fold)[0] == 0
+    empty_fold = _frame(b"borrow_decref_fold", b"")
+    assert fp.test_decode(empty_fold)[0] == 0
+
+
+def test_non_raw_and_response_frames_never_decode():
+    spec = _spec(method_name="flags_g")
+    tmpl = ts.make_template(spec)
+    ts.register_template(tmpl.tid, tmpl.data)
+    payload = _pack_push_task(tmpl.tid, 1, None,
+                              ts.encode_delta(spec, tmpl.method_name))
+    pickled = rpc.pack_frame(3, 0, b"push_task", payload)[4:]
+    assert fp.test_decode(pickled)[0] == 0
+    resp = rpc.pack_frame(3, rpc.FLAG_RESP | rpc.FLAG_RAW, b"push_task",
+                          payload)[4:]
+    assert fp.test_decode(resp)[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# done stream + borrowed keys
+# ---------------------------------------------------------------------------
+
+def test_done_stream_validate_and_unpack():
+    job = JobID.from_int(4)
+    tids = [TaskID.of(job) for _ in range(5)]
+    ids = b"".join(t.binary() for t in tids)
+    replies = [{"i": i} for i in range(5)]
+    payload = nd.pack_done_stream(ids, replies)
+    kind, out = fp.test_decode(_frame(b"actor_tasks_done", payload))
+    assert kind == 5 and out == payload
+    got_ids, got_replies = nd.unpack_done_stream(out)
+    assert got_ids == ids and got_replies == replies
+
+
+def test_borrowed_keys_look_up_real_ids():
+    job = JobID.from_int(5)
+    tids = [TaskID.of(job) for _ in range(64)]
+    table = {t: i for i, t in enumerate(tids)}
+    ids = b"".join(t.binary() for t in tids)
+    seen = [table.pop(key) for key in TaskID.iter_borrowed(ids)]
+    assert seen == list(range(64)) and not table
+    # a partial trailing window is ignored, not mis-sliced
+    assert len(list(TaskID.iter_borrowed(ids + b"zz"))) == 64
+
+
+# ---------------------------------------------------------------------------
+# decref folds
+# ---------------------------------------------------------------------------
+
+class _FakeCW:
+    rpc_address = ("127.0.0.1", 1)
+
+    def __init__(self):
+        self.queued = []
+
+    def _free_owned_object(self, *a, **k):
+        pass
+
+    def queue_borrow_decref(self, owner, oid):
+        self.queued.append((owner, oid))
+
+    def fire_and_forget(self, *a, **k):
+        pass
+
+
+def test_fold_applies_batched_borrower_decrements():
+    cw = _FakeCW()
+    rc = ReferenceCounter(cw)
+    oids = [ObjectID.from_random() for _ in range(50)]
+    for oid in oids:
+        rc.add_borrower(oid)
+        rc.add_borrower(oid)
+    fold = b"".join(o.binary() for o in oids)
+    rc.remove_borrowers_fold([ObjectID(b) for b in nd.iter_fold_ids(fold)])
+    for oid in oids:
+        assert rc._entries[oid].borrowers == 1
+    rc.remove_borrowers_fold([ObjectID(b) for b in nd.iter_fold_ids(fold)])
+    assert not rc._entries  # fully released
+
+
+def test_fold_frames_absorb_and_concatenate():
+    a, b = b"a" * 28, b"b" * 28
+    kind, out = fp.test_decode(_frame(b"borrow_decref_fold", a + b))
+    assert kind == 6 and out == a + b
+    assert list(nd.iter_fold_ids(out)) == [a, b]
+
+
+def test_decrement_notify_routes_through_fold_queue():
+    """Borrower-side release toward a remote owner goes through the
+    fold batcher (one frame per owner per tick), not one RPC per id."""
+    cw = _FakeCW()
+    rc = ReferenceCounter(cw)
+    owner = ("10.1.1.1", 999)
+    oid = ObjectID.from_random()
+    rc.add_borrower(oid)
+    rc._entries[oid].owner_address = owner
+    rc.remove_borrower(oid)
+    assert cw.queued == [(owner, oid)]
+
+
+# ---------------------------------------------------------------------------
+# oversized frame prefix closes the conn (live ring)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(60)
+def test_oversized_length_prefix_closes_conn():
+    import select
+    import socket
+
+    from ray_tpu._native.fastrpc import NativeIO
+    nio = NativeIO.get()
+    if nio is None:
+        pytest.skip("native io unavailable")
+    events = []
+    res = nio.listen("127.0.0.1", 0,
+                     lambda conn: (lambda kind, body:
+                                   events.append((kind, bytes(body)))))
+    assert res is not None
+    _lid, port = res
+    s = socket.create_connection(("127.0.0.1", port))
+    # declared length 2 GiB > kMaxFrame: the server must close, not buffer
+    s.sendall(struct.pack("<I", 2 << 30) + b"junk")
+    deadline = 50
+    closed = False
+    for _ in range(deadline * 10):
+        rl, _, _ = select.select([nio._notify_fd], [], [], 0.1)
+        if rl:
+            nio._drain()
+        if any(kind == fp.KIND_CLOSED for kind, _ in events):
+            closed = True
+            break
+        # the peer socket reports the close too
+        try:
+            s.settimeout(0.05)
+            if s.recv(1) == b"":
+                pass
+        except (BlockingIOError, TimeoutError, OSError):
+            pass
+    s.close()
+    assert closed, f"conn not closed on oversized prefix: {events}"
+
+
+# ---------------------------------------------------------------------------
+# ASAN debug build smoke (RTPU_NATIVE_DEBUG=1)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(300)
+def test_debug_build_roundtrips_one_frame_under_asan():
+    """Compile src/fastrpc.cpp with -fsanitize=address,undefined and
+    round-trip one decoded frame in a subprocess (libasan preloaded) —
+    C decode bugs surface as ASAN reports, not corrupted specs."""
+    libasan = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"],
+        capture_output=True, text=True).stdout.strip()
+    if not libasan or not os.path.isabs(libasan):
+        pytest.skip("libasan unavailable")
+    env = dict(os.environ,
+               RTPU_NATIVE_DEBUG="1",
+               LD_PRELOAD=libasan,
+               ASAN_OPTIONS="detect_leaks=0,abort_on_error=1",
+               JAX_PLATFORMS="cpu")
+    script = textwrap.dedent("""
+        from ray_tpu._internal import native_decode as nd
+        from ray_tpu._internal import rpc
+        from ray_tpu._internal import task_spec as ts
+        from ray_tpu._internal.core_worker import _pack_push_task
+        from ray_tpu._internal.ids import ActorID, JobID, TaskID
+        from ray_tpu._native import fastrpc as fp
+        from ray_tpu.remote_function import pack_args
+
+        job = JobID.from_int(1)
+        spec = ts.TaskSpec(
+            task_id=TaskID.of(job), job_id=job, task_type=ts.ACTOR_TASK,
+            function=ts.FunctionDescriptor("m", "C.f", ""),
+            args=pack_args((), {}), num_returns=1, resources={},
+            owner_address=("127.0.0.1", 1), owner_worker_id=b"w" * 28,
+            name="C.f", actor_id=ActorID.of(job), method_name="f",
+            sequence_number=1)
+        tmpl = ts.make_template(spec)
+        delta = ts.encode_delta(spec, tmpl.method_name)
+        body = rpc.pack_frame(
+            7, rpc.FLAG_RAW, b"push_task",
+            _pack_push_task(tmpl.tid, 3, tmpl.data, delta))[4:]
+        kind, rec = fp.test_decode(body)
+        assert kind == 3, kind
+        _m, _l, tid, _t, fields = nd.parse_push_record(rec)
+        ts.register_template(tmpl.tid, tmpl.data)
+        decoded = ts.spec_from_fields(ts.lookup_template(tid), *fields)
+        assert decoded.task_id == spec.task_id
+        # a torn frame must reject cleanly under the sanitizer too
+        torn = body[:len(body) - 5]
+        assert fp.test_decode(torn)[0] == 0
+        print("ASAN_SMOKE_OK")
+    """)
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=280,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ASAN_SMOKE_OK" in proc.stdout
+    assert "ERROR: AddressSanitizer" not in proc.stderr
+    assert "runtime error" not in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# e2e arms: native decode on/off x shards 1/4 (the heavy arms are slow-
+# marked; tier-1 keeps the default-configuration arm)
+# ---------------------------------------------------------------------------
+
+def _mixed_workload_arm(monkeypatch, no_decode: bool, shards: int):
+    monkeypatch.setenv("RTPU_NO_NATIVE_DECODE", "1" if no_decode else "")
+    monkeypatch.setenv("RTPU_OWNER_SHARDS", str(shards))
+    CONFIG.apply_system_config({"no_native_decode": no_decode,
+                                "owner_shards": shards})
+    try:
+        ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        @ray_tpu.remote
+        class Sink:
+            async def aping(self, x):
+                return x
+
+        from ray_tpu._internal.core_worker import get_core_worker
+        assert len(get_core_worker().shards) == shards
+        assert get_core_worker()._no_native_decode == no_decode
+        sinks = [Sink.options(max_concurrency=8).remote()
+                 for _ in range(2)]
+        out = ray_tpu.get([s.aping.remote(i) for s in sinks
+                           for i in range(40)], timeout=90)
+        assert out == [i for _ in range(2) for i in range(40)]
+        assert ray_tpu.get([add.remote(i, i) for i in range(40)],
+                           timeout=90) == [2 * i for i in range(40)]
+        # ref args exercise the borrow/decref fold path end to end
+        refs = [ray_tpu.put(i) for i in range(10)]
+        assert ray_tpu.get([add.remote(r, 1) for r in refs],
+                           timeout=90) == [i + 1 for i in range(10)]
+    finally:
+        ray_tpu.shutdown()
+        # explicit re-apply, not reset(): reset() would re-read the
+        # still-monkeypatched env and leak the arm into later tests
+        CONFIG.apply_system_config({"no_native_decode": False,
+                                    "owner_shards": 0})
+
+
+@pytest.mark.timeout_s(240)
+def test_e2e_native_decode_default_arm(monkeypatch):
+    _mixed_workload_arm(monkeypatch, no_decode=False, shards=1)
+
+
+@pytest.mark.slow
+@pytest.mark.timeout_s(240)
+@pytest.mark.parametrize("no_decode,shards", [
+    (True, 1), (False, 4), (True, 4)])
+def test_e2e_native_decode_arms(monkeypatch, no_decode, shards):
+    _mixed_workload_arm(monkeypatch, no_decode=no_decode, shards=shards)
