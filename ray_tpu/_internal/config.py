@@ -209,6 +209,29 @@ _DEFAULTS: Dict[str, Any] = {
     # (the liveness sweep runs every worker_liveness_check_period_s,
     # so the report usually lags the connection drop by ~1s).
     "postmortem_fetch_timeout_s": 2.0,
+    # --- collectives backend (util/collective) ---
+    # Algorithm forcing for the host-plane allreduce: auto picks per
+    # (bytes, topology) — flat topologies keep the exact legacy
+    # star/ring cutover, multi-slice topologies take the binomial tree
+    # below the ring threshold and the hierarchical schedule (intra-
+    # slice reduce-scatter, DCN allreduce of the shards, intra-slice
+    # allgather) above it. ring/tree/hier/star force one arm for A/B.
+    "collective_algo": "auto",
+    # EQuARX-style block-int8 quantization of the hierarchical
+    # schedule's inter-slice (DCN) hop: off (default, bit-exact) or
+    # int8 (quantize per block, accumulate fp32, dequantize — SUM over
+    # float payloads only; everything else stays exact).
+    "collective_quant": "off",
+    # Elements per quantization block (one fp32 scale per block).
+    "collective_quant_block": 64,
+    # --- owner-shard lease reclaim ---
+    # With the owner core sharded, one shard's queued lease request can
+    # starve behind ANOTHER shard's idle leases until the holder's 2s
+    # idle-lease cleaner tick (observed as ~2s sync-get outliers at
+    # RTPU_OWNER_SHARDS>=2). If a grant hasn't landed within this
+    # delay, the requesting shard asks every other shard to return its
+    # idle leases (zero in-flight, no local waiters) immediately.
+    "lease_reclaim_delay_s": 0.1,
     # --- train ---
     "train_health_check_interval_s": 1.0,
     # --- A/B kill switches (every switch lives here so a typo'd

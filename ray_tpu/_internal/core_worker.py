@@ -1273,7 +1273,7 @@ class NormalTaskSubmitter:
 
     async def _request_lease(self, key: Tuple, spec: TaskSpec):
         try:
-            lease = await self._request_new_lease(spec)
+            lease = await self._request_new_lease_reclaiming(spec)
         except Exception as e:  # noqa: BLE001 — delivered to one waiter
             self._inflight_requests[key] -= 1
             waiters = self._waiters.get(key)
@@ -1325,6 +1325,72 @@ class NormalTaskSubmitter:
                 idle.append(lease)
         elif lease in idle:
             idle.remove(lease)
+
+    async def _request_new_lease_reclaiming(self,
+                                            spec: TaskSpec
+                                            ) -> Optional[Lease]:
+        """Grant-time reclaim of cross-shard idle leases (ROADMAP item 6
+        follow-up): with the owner core sharded, every raylet worker can
+        be pinned by OTHER shards' idle leases — this shard's request
+        then queues at the raylet until some holder's idle-lease cleaner
+        tick (lease_idle_timeout_s = 2s) returns a worker, observed as
+        ~2s sync-get outliers at RTPU_OWNER_SHARDS>=2. If the grant
+        hasn't landed within lease_reclaim_delay_s, ask every other
+        shard to return its idle leases (zero in-flight, no local
+        waiters) NOW; the raylet's release pump then grants our queued
+        request. Single-shard processes skip the watchdog entirely —
+        the shards=1 arm stays exact-legacy.
+
+        The watchdog can false-positive on a legitimately slow grant
+        (cold worker spawn takes >> the delay even with free
+        capacity). That trade is deliberate and cheap: a reclaimed
+        worker goes back to the RAYLET's warm idle pool (return
+        without dispose — the process is not killed), so the holder
+        shard's next task pays one extra lease round trip, not a
+        spawn; and the reclaim fires at most once per grant attempt."""
+        if len(self._cw.shards) <= 1:
+            return await self._request_new_lease(spec)
+        grant = asyncio.ensure_future(self._request_new_lease(spec))
+        try:
+            return await asyncio.wait_for(
+                asyncio.shield(grant), CONFIG.lease_reclaim_delay_s)
+        except asyncio.TimeoutError:
+            self._cw.reclaim_idle_leases(exclude=self._shard)
+        except asyncio.CancelledError:
+            grant.cancel()
+            raise
+        try:
+            return await grant
+        except asyncio.CancelledError:
+            grant.cancel()
+            raise
+
+    async def reclaim_idle_now(self):
+        """Posted onto THIS shard's loop by a peer shard whose lease
+        request is starving (see _request_new_lease_reclaiming): the
+        idle-lease cleaner's return path without the idle-timeout wait.
+        Leases with queued local waiters or in-flight pipelined tasks
+        keep their warmth — reclaim must not trade this shard's latency
+        for another's."""
+        from .runtime_metrics import runtime_metrics
+        for key, leases in list(self._idle.items()):
+            if self._waiters.get(key):
+                continue
+            keep = []
+            for lease in leases:
+                if lease.inflight == 0:
+                    lease.dead = True
+                    self._shard.fire_and_forget(
+                        lease.raylet_address, "return_worker",
+                        _retries=CONFIG.rpc_max_retries,
+                        lease_id=lease.lease_id)
+                    runtime_metrics().lease_reclaims.inc()
+                else:
+                    keep.append(lease)
+            if keep:
+                self._idle[key] = keep
+            else:
+                self._idle.pop(key, None)
 
     async def _request_new_lease(self, spec: TaskSpec) -> Optional[Lease]:
         shape = spec.shape_key()
@@ -2993,6 +3059,18 @@ class CoreWorker:
                 if path and path not in sys.path:
                     sys.path.insert(0, path)
         fut.set_result(None)
+
+    def reclaim_idle_leases(self, exclude=None):
+        """Cross-shard idle-lease recall (grant-time, not cleaner-tick):
+        posts onto every other shard's loop, where the shard returns its
+        genuinely idle leases to the raylet immediately so a starving
+        peer's queued lease request can grant. Thread-safe: only the
+        coroutine OBJECT is built here; every table touch happens on the
+        owning shard's loop."""
+        for shard in self.shards:
+            if shard is exclude:
+                continue
+            shard.post(shard.submitter.reclaim_idle_now())
 
     async def node_address(self, node_id: str) -> Optional[Address]:
         addr = self._node_addr_cache.get(node_id)

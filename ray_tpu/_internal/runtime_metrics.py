@@ -58,6 +58,10 @@ def _build() -> SimpleNamespace:
             "rtpu_raylet_lease_queue_depth",
             "Lease requests queued at the raylet",
             tag_keys=("node",)),
+        lease_reclaims=Counter(
+            "rtpu_lease_reclaims_total",
+            "Idle leases returned early by grant-time cross-shard "
+            "reclaim (a peer shard's lease request was starving)"),
         raylet_leases_granted=Counter(
             "rtpu_raylet_leases_granted_total",
             "Worker leases granted by the raylet",

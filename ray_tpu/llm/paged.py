@@ -13,6 +13,7 @@ prompts stored once); admission blocks on page budget, not slot shape.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import os
@@ -162,7 +163,13 @@ class PagedLLMEngine:
         self.pool = PagePool(P)
         # prefix cache: hash(token-prefix through page k) -> per-layer page
         self.prefix_pages: Dict[Tuple, List[int]] = {}
-        self._prefix_lru: List[Tuple] = []
+        # true LRU: ordered keys, O(1) move-to-end on hit / popitem on
+        # evict (the old list.pop(0) was an O(n) shift and hits never
+        # refreshed recency — a hot system prompt aged out under churn)
+        self._prefix_lru: "collections.OrderedDict[Tuple, None]" = \
+            collections.OrderedDict()
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         self.seqs: List[_Seq] = [_Seq() for _ in range(config.max_batch)]
         self._pending: "queue.Queue[GenerationRequest]" = queue.Queue()
         self._by_id: Dict[str, _Seq] = {}
@@ -513,7 +520,20 @@ class PagedLLMEngine:
                 for page in hit:
                     self.pool.incref(page)
                 shared = list(hit)
+                # a hit refreshes recency — hot prefixes (system
+                # prompts) must not age out while they're being
+                # reused. Ancestor keys (shorter prefixes of the hit,
+                # whose pages this hit shares) refresh too, so
+                # eviction order never inverts the sharing hierarchy.
+                for j in range(1, k + 1):
+                    akey = tuple(prompt[:j * ps])
+                    if akey in self._prefix_lru:
+                        self._prefix_lru.move_to_end(akey)
+                self._prefix_hits += 1
                 break
+        else:
+            if n_full:
+                self._prefix_misses += 1
         n_pages = self._pages_needed(request)
         new_ids = []
         for _ in range(n_pages - len(shared)):
@@ -540,7 +560,7 @@ class PagedLLMEngine:
                 for page in pages[:k]:
                     self.pool.incref(page)
                 self.prefix_pages[key] = pages[:k]
-                self._prefix_lru.append(key)
+                self._prefix_lru[key] = None
         self._evict_prefixes()
         # 4. first token from the prefill logits (sampled when the request
         # asks for temperature > 0, mirroring the slot engine's branch —
@@ -582,7 +602,7 @@ class PagedLLMEngine:
 
     def _evict_prefixes(self, max_entries: int = 128):
         while len(self._prefix_lru) > max_entries:
-            key = self._prefix_lru.pop(0)
+            key, _ = self._prefix_lru.popitem(last=False)  # oldest first
             pages = self.prefix_pages.pop(key, None)
             if pages:
                 for page in pages:
@@ -721,6 +741,8 @@ class PagedLLMEngine:
             "pending": self._pending.qsize(),
             "free_pages": self.pool.num_free(),
             "prefix_entries": len(self.prefix_pages),
+            "prefix_hits": self._prefix_hits,
+            "prefix_misses": self._prefix_misses,
             "tp": self._tp,
             "hbm_cache_bytes": cache_bytes,
             # per-chip residency: pages shard on kv_heads, params on

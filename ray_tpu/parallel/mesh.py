@@ -204,6 +204,16 @@ class MeshConfig:
         per = len(devices) // num_slices
         return [devices[i * per:(i + 1) * per] for i in range(num_slices)]
 
+    def host_topology(self, world_size: int):
+        """Collective-backend `Topology` for a host-plane group of
+        `world_size` ranks laid out like this mesh's slices: one
+        contiguous rank group per slice (the `slice_groups` order), so
+        the backend's algorithm selector knows which hops ride DCN.
+        The DCN axes must have fixed sizes (their product is the slice
+        count)."""
+        from ..util.collective.topology import Topology
+        return Topology.from_mesh_config(self, world_size)
+
 
 def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
                          rules: Dict[str, object]) -> P:

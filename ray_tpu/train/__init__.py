@@ -1,5 +1,6 @@
 from .checkpoint import Checkpoint, load_pytree, save_pytree
-from .collectives import barrier, broadcast_from_rank_zero
+from .collectives import (allreduce_gradients, barrier,
+                          broadcast_from_rank_zero)
 from .config import (CheckpointConfig, FailureConfig, RunConfig,
                      ScalingConfig)
 from .context import get_checkpoint, get_context, get_dataset_shard, report
@@ -12,5 +13,6 @@ __all__ = [
     "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Checkpoint", "Result", "report", "get_checkpoint",
     "get_context", "get_dataset_shard", "barrier",
-    "broadcast_from_rank_zero", "save_pytree", "load_pytree",
+    "broadcast_from_rank_zero", "allreduce_gradients", "save_pytree",
+    "load_pytree",
 ]

@@ -1,6 +1,14 @@
-"""Control-plane collectives for the train loop
-(reference: train/collective/collectives.py:14 broadcast_from_rank_zero,
-:57 barrier — controller-mediated, NOT the tensor data plane)."""
+"""Collectives for the train loop.
+
+Control plane (reference: train/collective/collectives.py:14
+broadcast_from_rank_zero, :57 barrier — controller-mediated, NOT the
+tensor data plane), plus the host-plane gradient data plane for groups
+with no shared ICI domain (CPU multi-worker groups — see
+worker_group.TrainWorker.setup_distributed): `allreduce_gradients`
+routes through the `util.collective` backend, so topology-aware
+algorithm selection and the quantized DCN arm
+(``collective_algo``/``collective_quant``) apply to train gradient
+sync without the loop changing."""
 
 from __future__ import annotations
 
@@ -22,3 +30,30 @@ def broadcast_from_rank_zero(value: Any = None, name: str = "default") -> Any:
     return ray_tpu.get(ctx.controller.broadcast_from_rank_zero.remote(
         name, ctx.rank, ctx.world_size,
         value if ctx.rank == 0 else None), timeout=600)
+
+
+def allreduce_gradients(grads: Any, group_name: str = "default") -> Any:
+    """Mean-allreduce a gradient pytree over the joined collective
+    group (the host/DCN data plane). The tree is flattened into ONE
+    contiguous fp32 buffer so the backend's per-(bytes, topology)
+    algorithm selection — and the quantized DCN arm — applies once per
+    step instead of per leaf, then split back to the original
+    shapes/dtypes."""
+    import jax
+    import numpy as np
+
+    from ..util.collective import collective as col
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    arrays = [np.asarray(leaf) for leaf in leaves]
+    flat = np.concatenate(
+        [a.astype(np.float32, copy=False).ravel() for a in arrays]) \
+        if arrays else np.zeros(0, np.float32)
+    world = col.get_collective_group_size(group_name)
+    summed = col.allreduce(flat, group_name=group_name) / world
+    out, offset = [], 0
+    for a in arrays:
+        part = summed[offset:offset + a.size]
+        out.append(part.reshape(a.shape).astype(a.dtype, copy=False))
+        offset += a.size
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -3,11 +3,14 @@ from .collective import (allgather, allreduce, barrier, broadcast,
                          get_rank, get_collective_group_size,
                          init_collective_group, recv, reduce, reducescatter,
                          send)
+from .topology import Topology, select_algorithm
+from . import quant
 from . import xla
 
 __all__ = [
     "init_collective_group", "create_collective_group",
     "destroy_collective_group", "allreduce", "allgather", "reducescatter",
     "broadcast", "reduce", "send", "recv", "barrier", "get_rank",
-    "get_collective_group_size", "xla",
+    "get_collective_group_size", "Topology", "select_algorithm", "quant",
+    "xla",
 ]

@@ -14,18 +14,33 @@ NCCL/gloo backends). The TPU framework has TWO collective planes (SURVEY §5):
   named-actor ncclUniqueId store, nccl_collective_group.py:28-77).
 
 Semantics: ranks call collectives in the same order (standard collective
-contract). Algorithm selection (reference concept:
-nccl_collective_group.py's NCCL rings, re-derived for the host plane):
+contract). Algorithm selection (PAPERS: "The Big Send-off" arxiv
+2504.18658 — topology-aware selection; see `.topology.select_algorithm`
+for the full policy, forceable via ``collective_algo=auto|ring|tree|
+hier|star``):
 
-- small payloads / tiny worlds: rank-0-rooted star — two hops, minimal
-  latency, fine for control-plane sizes.
+- small payloads on a flat topology: rank-0-rooted star — two hops,
+  minimal latency, fine for control-plane sizes.
 - large payloads (>= _RING_MIN_BYTES) with world >= 3: **chunked ring**
   — reduce-scatter then allgather, 2(W-1)/W x N bytes per rank with no
   root hotspot; each rank only ever talks to its neighbors, so bandwidth
   scales with the number of links instead of one root NIC.
+- small payloads on a multi-slice topology: **binomial tree** —
+  2·ceil(log2 W) full-payload rounds, latency-optimal below the
+  bandwidth cutover.
+- large payloads on a multi-slice topology: **hierarchical** —
+  intra-slice ring reduce-scatter, inter-slice allreduce of the
+  scattered shards over DCN (optionally EQuARX block-int8 quantized,
+  ``collective_quant=int8`` — see `.quant`), intra-slice allgather.
+  Only (S-1) x N/Ws bytes per rank ever cross a slice boundary (the
+  rotation's cost; equal to the reduce-scatter+allgather optimum at
+  the S=2 the two-slice topologies use, up to 2x it for larger S).
 
 Sends are one-way messages over the framework RPC plane (reliable,
-in-order per connection); receives block on a local mailbox.
+in-order per connection); receives block on a local mailbox. Per-op
+wall time and per-link bytes ride the flight recorder
+(``rtpu_collective_op_seconds{op,algo}``,
+``rtpu_collective_bytes_total{link,quant}``).
 """
 
 from __future__ import annotations
@@ -33,12 +48,17 @@ from __future__ import annotations
 import json
 import threading
 import time
+from types import SimpleNamespace
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..._internal.config import CONFIG
 from ..._internal.core_worker import get_core_worker
 from ..._internal.rpc import EventLoopThread
+from ...util.metrics import LazyMetrics
+from . import quant as quant_mod
+from .topology import Topology, select_algorithm
 
 SUM, PRODUCT, MIN, MAX = "sum", "product", "min", "max"
 _OPS = {SUM: np.add, PRODUCT: np.multiply, MIN: np.minimum, MAX: np.maximum}
@@ -46,6 +66,30 @@ _OPS = {SUM: np.add, PRODUCT: np.multiply, MIN: np.minimum, MAX: np.maximum}
 # Below this many bytes the star's two-hop latency beats the ring's
 # 2(W-1) steps.
 _RING_MIN_BYTES = 1 << 16
+
+_OP_BOUNDARIES = [0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                  0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+
+
+def _build_metrics() -> SimpleNamespace:
+    from ...util.metrics import Counter, Histogram
+    return SimpleNamespace(
+        op_seconds=Histogram(
+            "rtpu_collective_op_seconds",
+            "Wall time of one host-plane collective call, by "
+            "operation and selected algorithm",
+            boundaries=_OP_BOUNDARIES,
+            tag_keys=("op", "algo")),
+        bytes_total=Counter(
+            "rtpu_collective_bytes_total",
+            "Payload bytes sent by host-plane collectives, by link "
+            "class (ici = intra-slice, dcn = cross-slice) and "
+            "quantization arm",
+            tag_keys=("link", "quant")),
+    )
+
+
+_metrics = LazyMetrics(_build_metrics)
 
 _groups: Dict[str, "CollectiveGroup"] = {}
 _groups_lock = threading.Lock()
@@ -108,26 +152,77 @@ def _install_handler():
 
 class CollectiveGroup:
     def __init__(self, name: str, rank: int, world_size: int,
-                 members: List[Tuple[str, int]]):
+                 members: List[Tuple[str, int]],
+                 topology: Optional[Topology] = None,
+                 dcn_emulate_gbps: float = 0.0):
         self.name = name
         self.rank = rank
         self.world_size = world_size
         self.members = members  # rank -> rpc address
         self.op_seq: Dict[str, int] = {}
+        self.topology = topology if topology is not None \
+            else Topology.flat(world_size)
+        if self.topology.world_size != world_size:
+            raise ValueError(
+                f"topology world {self.topology.world_size} != group "
+                f"world {world_size}")
+        # DCN link emulation for benches on single-host virtual slices
+        # (this box has no real slice boundary): cross-slice sends pay
+        # nbytes / (gbps GB/s) of serialization delay. 0 = off.
+        self.dcn_emulate_gbps = dcn_emulate_gbps
+        # per-group byte ledger, keyed (link, quant) — the per-process
+        # rtpu_collective_bytes_total counter aggregated per group so a
+        # bench can read one group's traffic in isolation
+        self._bytes: Dict[Tuple[str, str], int] = {}
+        # O(1) rank -> slice map for the per-message accounting (the
+        # Topology query is a linear scan — O(W^2) over one ring op)
+        self._slice_by_rank = {r: s
+                               for s, group in enumerate(
+                                   self.topology.slices)
+                               for r in group}
+        self._my_slice = self._slice_by_rank[rank]
+
+    def _account(self, rank: int, nbytes: int, quant: bool = False):
+        link = "dcn" if self._slice_by_rank[rank] != self._my_slice \
+            else "ici"
+        arm = "int8" if quant else "off"
+        self._bytes[(link, arm)] = self._bytes.get((link, arm), 0) + nbytes
+        _metrics().bytes_total.inc(nbytes, tags={"link": link,
+                                                 "quant": arm})
+        if link == "dcn" and self.dcn_emulate_gbps > 0:
+            time.sleep(nbytes / (self.dcn_emulate_gbps * 1e9))
+
+    def bytes_sent(self) -> Dict[str, int]:
+        """Payload bytes this rank has sent, folded per link class:
+        {"ici": n, "dcn": n, "dcn_int8": n}."""
+        out = {"ici": 0, "dcn": 0, "dcn_int8": 0}
+        for (link, arm), n in self._bytes.items():
+            if link == "dcn" and arm == "int8":
+                out["dcn_int8"] += n
+                out["dcn"] += n
+            else:
+                out[link] += n
+        return out
 
     def _send_to(self, rank: int, key: Tuple, array: np.ndarray):
         worker = get_core_worker()
         client = worker.clients.get(tuple(self.members[rank]))
         payload = _pack(array)
+        self._account(rank, len(payload))
         client.call_sync("collective_msg", key=key, data=payload,
                          timeout=120, retries=3)
 
     def _post_to(self, rank: int, key: Tuple, array: np.ndarray):
         """Fire-and-forget send (ring steps don't need the ack round
         trip; the receiver's own step-s recv is the synchronization)."""
+        payload = _pack(array)
+        self._post_raw(rank, key, payload)
+
+    def _post_raw(self, rank: int, key: Tuple, payload: bytes,
+                  quant: bool = False):
         worker = get_core_worker()
         client = worker.clients.get(tuple(self.members[rank]))
-        payload = _pack(array)
+        self._account(rank, len(payload), quant=quant)
         EventLoopThread.get().post(
             client.oneway("collective_msg", key=key, data=payload))
 
@@ -138,13 +233,175 @@ class CollectiveGroup:
 
     def allreduce(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
         seq = self._next_seq("allreduce")
-        if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
+        algo = select_algorithm(array.nbytes, self.topology,
+                                self.world_size,
+                                ring_min_bytes=_RING_MIN_BYTES)
+        t0 = time.perf_counter()
+        if algo == "hier":
+            out = self._hier_allreduce(array, op, seq)
+        elif algo == "tree":
+            out = self._tree_allreduce(array, op, seq)
+        elif algo == "ring":
             chunks = self._ring_reduce_scatter(array, op, seq)
             chunks = self._ring_allgather_chunks(chunks, seq)
-            return np.concatenate(chunks).reshape(array.shape)
-        reduced = self.reduce(array, dst_rank=0, op=op, _seq=seq)
-        return self.broadcast(reduced if self.rank == 0 else array,
-                              src_rank=0, _seq=seq)
+            out = np.concatenate(chunks).reshape(array.shape)
+        else:  # star
+            reduced = self.reduce(array, dst_rank=0, op=op, _seq=seq)
+            out = self.broadcast(reduced if self.rank == 0 else array,
+                                 src_rank=0, _seq=seq)
+        _metrics().op_seconds.observe(time.perf_counter() - t0,
+                                      tags={"op": "allreduce",
+                                            "algo": algo})
+        return out
+
+    # -- binomial tree ---------------------------------------------------
+    #
+    # 2·ceil(log2 W) full-payload rounds (reduce up, broadcast down) —
+    # the latency regime's schedule: below the bandwidth cutover the
+    # ring's 2(W-1) rounds dominate wall clock, not bytes.
+
+    def _tree_allreduce(self, array: np.ndarray, op: str,
+                        seq: int) -> np.ndarray:
+        W, r = self.world_size, self.rank
+        fn = _OPS[op]
+        acc = np.array(array, copy=True)
+        rounds = max(1, (W - 1).bit_length())
+        for s in range(rounds):
+            step = 1 << s
+            if r % (2 * step) == step:
+                self._post_to(r - step, (self.name, "tr", seq, s, r), acc)
+                break  # sent up; wait for the broadcast phase
+            if r % (2 * step) == 0 and r + step < W:
+                inc = self._recv_from(
+                    (self.name, "tr", seq, s, r + step))
+                acc = fn(acc, inc)
+        for s in reversed(range(rounds)):
+            step = 1 << s
+            if r % (2 * step) == step:
+                acc = self._recv_from(
+                    (self.name, "tb", seq, s, r - step))
+            elif r % (2 * step) == 0 and r + step < W:
+                self._post_to(r + step, (self.name, "tb", seq, s, r),
+                              acc)
+        return acc
+
+    # -- hierarchical (intra-slice RS -> DCN allreduce -> intra AG) ------
+
+    def _hier_allreduce(self, array: np.ndarray, op: str,
+                        seq: int) -> np.ndarray:
+        """Hierarchical schedule over the topology: ring reduce-scatter
+        among this slice's members (ICI-class links), allreduce of each
+        member's reduced shard across its cross-slice peer group
+        (DCN-class — the only bytes that leave the slice, optionally
+        block-int8 quantized), ring allgather back within the slice."""
+        topo = self.topology
+        my_slice = topo.slice_of(self.rank)
+        members = topo.members(my_slice)
+        i = members.index(self.rank)
+        Ws = len(members)
+        flat = np.ascontiguousarray(array).ravel()
+        chunks = [c.copy() for c in np.array_split(flat, Ws)]
+        if Ws > 1:
+            chunks = self._sub_ring_reduce_scatter(members, i, chunks,
+                                                   op, seq)
+        if topo.num_slices > 1:
+            chunks[i] = self._dcn_allreduce(topo.peer_group(self.rank),
+                                            chunks[i], op, seq)
+        if Ws > 1:
+            chunks = self._sub_ring_allgather(members, i, chunks, seq)
+        out = np.concatenate(chunks)
+        if out.dtype != array.dtype:
+            out = out.astype(array.dtype)
+        return out.reshape(array.shape)
+
+    def _sub_ring_reduce_scatter(self, members: Tuple[int, ...], i: int,
+                                 chunks: List[np.ndarray], op: str,
+                                 seq: int) -> List[np.ndarray]:
+        """The two-phase ring's reduce-scatter restricted to a subgroup
+        (same schedule as `_ring_reduce_scatter`, neighbor = next member
+        of the subgroup). After W-1 steps chunks[i] is fully reduced."""
+        W = len(members)
+        fn = _OPS[op]
+        nxt = members[(i + 1) % W]
+        for s in range(W - 1):
+            send_idx = (i - s - 1) % W
+            recv_idx = (i - s - 2) % W
+            self._post_to(nxt, (self.name, "hrs", seq, s, send_idx),
+                          chunks[send_idx])
+            incoming = self._recv_from(
+                (self.name, "hrs", seq, s, recv_idx))
+            chunks[recv_idx] = fn(chunks[recv_idx], incoming)
+        return chunks
+
+    def _sub_ring_allgather(self, members: Tuple[int, ...], i: int,
+                            chunks: List[np.ndarray],
+                            seq: int) -> List[np.ndarray]:
+        W = len(members)
+        nxt = members[(i + 1) % W]
+        for s in range(W - 1):
+            send_idx = (i - s) % W
+            recv_idx = (i - s - 1) % W
+            self._post_to(nxt, (self.name, "hag", seq, s, send_idx),
+                          chunks[send_idx])
+            chunks[recv_idx] = self._recv_from(
+                (self.name, "hag", seq, s, recv_idx))
+        return chunks
+
+    def _dcn_allreduce(self, peers: Tuple[int, ...], own: np.ndarray,
+                       op: str, seq: int) -> np.ndarray:
+        """Allreduce of one scattered shard across the cross-slice peer
+        group, by rotation: each peer forwards what it just received
+        S-1 times, accumulating locally. (S-1)·|shard| bytes per rank —
+        byte-optimal at the S=2 the two-slice topologies use.
+
+        Every rank folds the S shards in SLICE ORDER — never "own
+        first" — so all replicas compute the bit-identical sum (a
+        rank-dependent fold order, or treating one's own shard exactly
+        while peers see its quantized copy, would make data-parallel
+        replicas drift apart step over step with nothing resyncing
+        them).
+
+        Quantized arm (``collective_quant=int8``, SUM over floats only —
+        MIN/MAX and integer payloads always take the exact path):
+        EQuARX-style (arxiv 2506.17615). Each rank quantizes its shard
+        ONCE; the rotation forwards received int8 payloads *verbatim*
+        (never re-quantized), every rank dequantizes ALL S shards —
+        its own included, from the same codes every peer sees — and
+        accumulates fp32, so the end-to-end error is the sum of S
+        single quantizations, never compounded hop-over-hop."""
+        S = len(peers)
+        j = peers.index(self.rank)
+        nxt = peers[(j + 1) % S]
+        use_quant = (CONFIG.collective_quant == "int8" and op == SUM
+                     and own.dtype.kind == "f")
+        parts: List[Optional[np.ndarray]] = [None] * S
+        if use_quant:
+            block = int(CONFIG.collective_quant_block)
+            qt = quant_mod.quantize(own, block)
+            parts[j] = quant_mod.dequantize(qt).ravel()
+            blob = quant_mod.pack(qt)
+            for s in range(S - 1):
+                self._post_raw(nxt, (self.name, "hq", seq, s), blob,
+                               quant=True)
+                blob = _mailbox.take((self.name, "hq", seq, s))
+                # step-s arrival originated at peer (j - 1 - s) mod S
+                parts[(j - 1 - s) % S] = quant_mod.dequantize(
+                    quant_mod.unpack(blob)).ravel()
+            acc = np.array(parts[0], dtype=np.float32, copy=True)
+            for part in parts[1:]:
+                acc = acc + part
+            return acc.astype(own.dtype)
+        fn = _OPS[op]
+        parts[j] = np.asarray(own)
+        cur = own
+        for s in range(S - 1):
+            self._post_to(nxt, (self.name, "hx", seq, s), cur)
+            cur = self._recv_from((self.name, "hx", seq, s))
+            parts[(j - 1 - s) % S] = cur
+        acc = np.array(parts[0], copy=True)
+        for part in parts[1:]:
+            acc = fn(acc, part)
+        return acc
 
     # -- ring internals --------------------------------------------------
     #
@@ -152,36 +409,24 @@ class CollectiveGroup:
     # payload), offset so that after reduce-scatter rank r owns fully
     # reduced chunk r (send index (r-s-1) mod W at step s). The allgather
     # phase rotates the finished chunks W-1 more steps. 2(W-1)/W x N
-    # bytes per rank, neighbor links only — no root hotspot.
+    # bytes per rank, neighbor links only — no root hotspot. The flat
+    # ring IS the subgroup ring over members=range(W) — one schedule,
+    # one implementation (the hierarchical path passes a slice's
+    # members instead).
 
     def _ring_reduce_scatter(self, array: np.ndarray, op: str,
                              seq: int) -> List[np.ndarray]:
-        W, r = self.world_size, self.rank
-        fn = _OPS[op]
+        W = self.world_size
         flat = np.ascontiguousarray(array).ravel()
         chunks = [c.copy() for c in np.array_split(flat, W)]
-        nxt = (r + 1) % W
-        for s in range(W - 1):
-            send_idx = (r - s - 1) % W
-            recv_idx = (r - s - 2) % W
-            self._post_to(nxt, (self.name, "rs", seq, s, send_idx),
-                          chunks[send_idx])
-            incoming = self._recv_from((self.name, "rs", seq, s, recv_idx))
-            chunks[recv_idx] = fn(chunks[recv_idx], incoming)
-        return chunks  # chunks[r] is this rank's fully-reduced share
+        return self._sub_ring_reduce_scatter(
+            tuple(range(W)), self.rank, chunks, op, seq)
+        # chunks[rank] is this rank's fully-reduced share
 
     def _ring_allgather_chunks(self, chunks: List[np.ndarray],
                                seq: int) -> List[np.ndarray]:
-        W, r = self.world_size, self.rank
-        nxt = (r + 1) % W
-        for s in range(W - 1):
-            send_idx = (r - s) % W
-            recv_idx = (r - s - 1) % W
-            self._post_to(nxt, (self.name, "ag2", seq, s, send_idx),
-                          chunks[send_idx])
-            chunks[recv_idx] = self._recv_from(
-                (self.name, "ag2", seq, s, recv_idx))
-        return chunks
+        return self._sub_ring_allgather(
+            tuple(range(self.world_size)), self.rank, chunks, seq)
 
     def _post_obj(self, rank: int, key: Tuple, obj):
         from ..._internal import serialization
@@ -265,8 +510,21 @@ class CollectiveGroup:
         return self._chain_broadcast_recv(data, src_rank, seq)
 
     def allgather(self, array: np.ndarray) -> List[np.ndarray]:
+        # the cutover lives HERE only; _allgather branches on the label
+        algo = "ring" if (array.nbytes >= _RING_MIN_BYTES
+                          and self.world_size >= 3) else "star"
+        t0 = time.perf_counter()
+        try:
+            return self._allgather(array, algo)
+        finally:
+            _metrics().op_seconds.observe(time.perf_counter() - t0,
+                                          tags={"op": "allgather",
+                                                "algo": algo})
+
+    def _allgather(self, array: np.ndarray, algo: str
+                   ) -> List[np.ndarray]:
         seq = self._next_seq("allgather")
-        if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
+        if algo == "ring":
             # ring rotation: each rank forwards what it just received;
             # (W-1) x N per rank over neighbor links, no root funnel
             W, r = self.world_size, self.rank
@@ -305,9 +563,14 @@ class CollectiveGroup:
     def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
         if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
             seq = self._next_seq("reducescatter")
+            t0 = time.perf_counter()
             # ring reduce-scatter alone: (W-1)/W x N bytes per rank,
             # half the full allreduce's traffic
-            return self._ring_reduce_scatter(array, op, seq)[self.rank]
+            out = self._ring_reduce_scatter(array, op, seq)[self.rank]
+            _metrics().op_seconds.observe(
+                time.perf_counter() - t0,
+                tags={"op": "reducescatter", "algo": "ring"})
+            return out
         reduced = self.allreduce(array, op)
         chunks = np.array_split(reduced.ravel(), self.world_size)
         return chunks[self.rank]
@@ -369,14 +632,26 @@ def _unpack(data: bytes) -> np.ndarray:
 
 def init_collective_group(world_size: int, rank: int,
                           backend: str = "host",
-                          group_name: str = "default") -> CollectiveGroup:
+                          group_name: str = "default",
+                          topology: Optional[Topology] = None,
+                          num_slices: int = 1,
+                          dcn_emulate_gbps: float = 0.0
+                          ) -> CollectiveGroup:
     """Join a collective group; blocks until all ranks have joined.
-    Rendezvous through the GCS KV (the reference uses a named actor)."""
+    Rendezvous through the GCS KV (the reference uses a named actor).
+
+    `topology` (or the `num_slices` shorthand — contiguous rank groups,
+    the `MeshConfig.slice_groups` layout) declares the ICI/DCN split
+    the algorithm selector keys on; every rank must pass the same one.
+    Without it the group is flat and `auto` selection reproduces the
+    pre-backend star/ring behavior exactly."""
     if backend not in ("host", "gloo", "cpu"):
         raise ValueError(
             f"backend {backend!r} not supported out-of-program; in-program "
             "ICI collectives are jax.lax ops over the mesh (see "
             "ray_tpu.util.collective.xla)")
+    if topology is None and num_slices > 1:
+        topology = Topology.from_slices(world_size, num_slices)
     _install_handler()
     worker = get_core_worker()
     key_prefix = f"{group_name}:"
@@ -400,7 +675,9 @@ def init_collective_group(world_size: int, rank: int,
         raise TimeoutError(
             f"collective group {group_name!r} incomplete: "
             f"{[i for i, m in enumerate(members) if m is None]} missing")
-    group = CollectiveGroup(group_name, rank, world_size, members)
+    group = CollectiveGroup(group_name, rank, world_size, members,
+                            topology=topology,
+                            dcn_emulate_gbps=dcn_emulate_gbps)
     with _groups_lock:
         _groups[group_name] = group
     return group

@@ -1,0 +1,179 @@
+"""EQuARX-style block quantization for DCN collective hops
+(PAPERS: arxiv 2506.17615 — quantize per block, accumulate wide,
+dequantize). The inter-slice hop of a hierarchical allreduce is
+byte-dominated: int8 payloads with one fp32 scale per block move ~4x
+fewer bytes than fp32 at a bounded per-element error (<= blockmax/254),
+and summation stays exact in fp32 ("accumulate wide") so error never
+compounds across ranks beyond each rank's single quantization.
+
+Two implementations of the same scheme:
+
+- numpy (`quantize`/`dequantize` + `pack`/`unpack`): the host/DCN
+  transport plane — what `util.collective`'s hierarchical allreduce
+  ships over the wire when ``collective_quant=int8``.
+- traced jnp (`quantize_traced`/`dequantize_traced`, jitted wrappers
+  `quantize_jit`/`dequantize_jit`): for in-jit use inside shard_map
+  bodies (see `.xla.quantized_psum`) — shapes are static under trace,
+  so the kernels compile once per (shape, block).
+
+Symmetric int8: values map to [-127, 127] (the -128 code is unused so
+quantization is sign-symmetric); an all-zero block stores scale 1.0 and
+codes 0 (dequantizes to exact zeros, no div-by-zero).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import struct
+from typing import Tuple
+
+import numpy as np
+
+DEFAULT_BLOCK = 64
+QMAX = 127
+
+# wire header: u32 element count | u16 block | u8 ndim | u8 dtype-str len
+_HEADER = struct.Struct("<IHBB")
+
+
+@dataclasses.dataclass
+class Quantized:
+    """One block-quantized tensor: int8 codes (flat, trimmed to the true
+    element count — the non-divisible tail pads only at (de)quantize
+    time, never on the wire) + one fp32 scale per block."""
+    q: np.ndarray        # int8 [n]
+    scales: np.ndarray   # float32 [ceil(n / block)]
+    shape: Tuple[int, ...]
+    dtype: str           # original dtype str (restored on dequantize cast)
+    block: int
+
+    @property
+    def n(self) -> int:
+        return int(self.q.size)
+
+    def wire_bytes(self) -> int:
+        """Exact bytes this tensor occupies packed on the wire."""
+        return (_HEADER.size + 4 * len(self.shape) + len(self.dtype)
+                + self.scales.nbytes + self.q.nbytes)
+
+
+def quantize(x: np.ndarray, block: int = DEFAULT_BLOCK) -> Quantized:
+    """Block-wise symmetric int8 quantization with per-block fp32
+    max-abs scales. Accepts any shape/float dtype; non-divisible tails
+    are padded with zeros only for the blocked max/divide."""
+    if block <= 0:
+        raise ValueError(f"block must be positive, got {block}")
+    x = np.ascontiguousarray(x)
+    shape, dtype = x.shape, x.dtype.str
+    flat = x.ravel().astype(np.float32, copy=False)
+    n = flat.size
+    nb = max(1, -(-n // block))
+    pad = nb * block - n
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, np.float32)])
+    blocks = flat.reshape(nb, block)
+    scales = np.abs(blocks).max(axis=1).astype(np.float32) / QMAX
+    # all-zero blocks: scale 1.0, codes 0 — dequantizes to exact zeros
+    scales = np.where(scales > 0, scales, np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.rint(blocks / scales[:, None]), -QMAX, QMAX)
+    return Quantized(q=q.astype(np.int8).ravel()[:n], scales=scales,
+                     shape=shape, dtype=dtype, block=block)
+
+
+def dequantize(qt: Quantized) -> np.ndarray:
+    """fp32 reconstruction in the original shape (cast to the original
+    dtype is the caller's choice — accumulation should stay fp32)."""
+    per_elem = np.repeat(qt.scales, qt.block)[:qt.n]
+    return (qt.q.astype(np.float32) * per_elem).reshape(qt.shape)
+
+
+def pack(qt: Quantized) -> bytes:
+    """Serialize for the wire: header | dims | dtype | scales | codes."""
+    dtype_b = qt.dtype.encode()
+    parts = [_HEADER.pack(qt.n, qt.block, len(qt.shape), len(dtype_b))]
+    parts.extend(struct.pack("<I", d) for d in qt.shape)
+    parts.append(dtype_b)
+    parts.append(np.ascontiguousarray(qt.scales).tobytes())
+    parts.append(np.ascontiguousarray(qt.q).tobytes())
+    return b"".join(parts)
+
+
+def unpack(data: bytes) -> Quantized:
+    n, block, ndim, dlen = _HEADER.unpack_from(data, 0)
+    off = _HEADER.size
+    shape = tuple(struct.unpack_from("<I", data, off + 4 * i)[0]
+                  for i in range(ndim))
+    off += 4 * ndim
+    dtype = data[off:off + dlen].decode()
+    off += dlen
+    nb = max(1, -(-n // block))
+    scales = np.frombuffer(data, np.float32, count=nb, offset=off).copy()
+    off += 4 * nb
+    q = np.frombuffer(data, np.int8, count=n, offset=off).copy()
+    return Quantized(q=q, scales=scales, shape=shape, dtype=dtype,
+                     block=block)
+
+
+def max_rel_error(x: np.ndarray, reconstructed: np.ndarray) -> float:
+    """Max abs error normalized by the global max magnitude — the gate
+    metric (per-block max-abs scaling bounds it by ~1/(2*QMAX) for a
+    single quantization)."""
+    x = np.asarray(x, np.float64)
+    denom = float(np.abs(x).max()) or 1.0
+    return float(np.abs(np.asarray(reconstructed, np.float64) - x).max()
+                 / denom)
+
+
+# ---------------------------------------------------------------------------
+# traced jnp kernels (for in-jit use; see .xla.quantized_psum)
+# ---------------------------------------------------------------------------
+
+def quantize_traced(x, block: int = DEFAULT_BLOCK):
+    """jnp twin of `quantize` for use inside jit/shard_map bodies.
+    Returns (codes [nb, block] int8, scales [nb] f32); the pad region
+    carries zero codes."""
+    import jax.numpy as jnp
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    nb = max(1, -(-n // block))
+    pad = nb * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(nb, block)
+    scales = jnp.max(jnp.abs(blocks), axis=1) / QMAX
+    scales = jnp.where(scales > 0, scales, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(blocks / scales[:, None]), -QMAX, QMAX)
+    return q.astype(jnp.int8), scales
+
+
+def dequantize_traced(q, scales, n: int, shape):
+    """jnp twin of `dequantize`: fp32, original shape."""
+    deq = q.astype("float32") * scales[:, None]
+    return deq.reshape(-1)[:n].reshape(shape)
+
+
+# The jitted callables are cached per static config — a fresh
+# jax.jit(partial(...)) every call would retrace+recompile each time
+# (jit's cache is keyed on the wrapped function OBJECT).
+
+@functools.lru_cache(maxsize=64)
+def _jitted_quantize(block: int):
+    import jax
+    return jax.jit(functools.partial(quantize_traced, block=block))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_dequantize(n: int, shape: Tuple[int, ...]):
+    import jax
+    return jax.jit(functools.partial(dequantize_traced, n=n,
+                                     shape=shape))
+
+
+def quantize_jit(x, block: int = DEFAULT_BLOCK):
+    """Jitted standalone quantize (one compile per (shape, block))."""
+    return _jitted_quantize(block)(x)
+
+
+def dequantize_jit(q, scales, n: int, shape):
+    return _jitted_dequantize(n, tuple(shape))(q, scales)

@@ -1,0 +1,160 @@
+"""Topology model + algorithm selection for the collective backend.
+
+"The Big Send-off" (PAPERS: arxiv 2504.18658): collective performance
+at scale is a function of *which algorithm runs on which wires*, not of
+one schedule. This module gives the host-plane backend the two pieces
+the flat ring lacked:
+
+- a `Topology` descriptor mapping ranks to slices (the ICI/DCN split —
+  ranks in one slice share cheap intra-slice links, ranks in different
+  slices talk over DCN where bytes are expensive), derivable from a
+  `MeshConfig`'s dcn_axes layout, an explicit slice count, or a
+  placement-group/bundle node assignment;
+- `select_algorithm`: per-(op, bytes, topology) choice among the flat
+  ring, a binomial tree (latency regime: 2·ceil(log2 W) full-payload
+  rounds beat the ring's 2(W-1) below the bandwidth cutover), and the
+  hierarchical schedule (intra-slice reduce-scatter → inter-slice
+  allreduce of the scattered shards over DCN → intra-slice allgather),
+  with `collective_algo=auto|ring|tree|hier|star` forcing for A/B.
+
+The degenerate flat (single-slice) topology under `auto` reproduces the
+pre-backend behavior exactly: star below the ring threshold, chunked
+ring above — bit-identical results, no regime change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from ..._internal.config import CONFIG
+
+ALGORITHMS = ("auto", "ring", "tree", "hier", "star")
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Rank → slice layout of one collective group.
+
+    `slices[s]` is the tuple of ranks in slice `s`, each tuple sorted
+    ascending; every rank appears exactly once. Intra-slice links are
+    ICI-class, inter-slice links are DCN-class (quantization target)."""
+
+    world_size: int
+    slices: Tuple[Tuple[int, ...], ...]
+
+    def __post_init__(self):
+        seen = sorted(r for group in self.slices for r in group)
+        if seen != list(range(self.world_size)):
+            raise ValueError(
+                f"topology slices {self.slices} do not partition "
+                f"ranks 0..{self.world_size - 1}")
+
+    # -- constructors ----------------------------------------------------
+
+    @classmethod
+    def flat(cls, world_size: int) -> "Topology":
+        """Single slice — the degenerate topology (no DCN boundary)."""
+        return cls(world_size, (tuple(range(world_size)),))
+
+    @classmethod
+    def from_slices(cls, world_size: int, num_slices: int) -> "Topology":
+        """Contiguous rank groups per slice — the layout
+        `MeshConfig.slice_groups` produces (rank r lives in slice
+        r // (world // num_slices))."""
+        if num_slices <= 0 or world_size % num_slices:
+            raise ValueError(
+                f"{world_size} ranks not divisible into {num_slices} "
+                f"slices")
+        per = world_size // num_slices
+        return cls(world_size, tuple(
+            tuple(range(s * per, (s + 1) * per))
+            for s in range(num_slices)))
+
+    @classmethod
+    def from_mesh_config(cls, mesh_config, world_size: int) -> "Topology":
+        """Derive the slice count from a `MeshConfig`'s dcn_axes (their
+        size product = slice count, the hybrid-mesh contract). The DCN
+        axes must have fixed sizes — `world_size` here is a RANK count,
+        not a device count, so the -1 device wildcard cannot resolve
+        against it."""
+        num = 1
+        for axis in mesh_config.dcn_axes:
+            size = getattr(mesh_config, axis)
+            if size == -1:
+                raise ValueError(
+                    f"dcn axis {axis!r} is the -1 wildcard; a host "
+                    "topology needs fixed DCN axis sizes")
+            num *= size
+        return cls.from_slices(world_size, num)
+
+    @classmethod
+    def from_bundle_nodes(cls, node_ids: Sequence[str]) -> "Topology":
+        """From a placement-group bundle layout: rank i runs on
+        `node_ids[i]`; each distinct node (in first-seen order) is one
+        slice — co-located ranks share the fast plane, cross-node hops
+        are DCN-class."""
+        order: List[str] = []
+        groups: dict = {}
+        for rank, node in enumerate(node_ids):
+            if node not in groups:
+                groups[node] = []
+                order.append(node)
+            groups[node].append(rank)
+        return cls(len(node_ids), tuple(tuple(groups[n]) for n in order))
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def num_slices(self) -> int:
+        return len(self.slices)
+
+    @property
+    def regular(self) -> bool:
+        """Equal-size slices with aligned peer groups — what the
+        hierarchical schedule requires (chunk i of every slice has the
+        same byte extent)."""
+        return len({len(g) for g in self.slices}) == 1
+
+    def slice_of(self, rank: int) -> int:
+        for s, group in enumerate(self.slices):
+            if rank in group:
+                return s
+        raise ValueError(f"rank {rank} not in topology")
+
+    def members(self, slice_index: int) -> Tuple[int, ...]:
+        return self.slices[slice_index]
+
+    def peer_group(self, rank: int) -> Tuple[int, ...]:
+        """Ranks at this rank's intra-slice position across every slice
+        (one per slice, in slice order) — the DCN exchange group of the
+        hierarchical schedule. Requires a regular topology."""
+        s = self.slice_of(rank)
+        i = self.slices[s].index(rank)
+        return tuple(group[i] for group in self.slices)
+
+
+def select_algorithm(nbytes: int, topology: Optional[Topology],
+                     world_size: int, *, ring_min_bytes: int,
+                     forced: Optional[str] = None) -> str:
+    """Pick the allreduce schedule for (bytes, topology).
+
+    `forced` (default `CONFIG.collective_algo`) short-circuits for A/B;
+    otherwise: multi-slice regular topologies take the tree in the
+    latency regime (below `ring_min_bytes`) and the hierarchical
+    schedule in the bandwidth regime; flat topologies keep the exact
+    pre-backend star/ring cutover."""
+    forced = CONFIG.collective_algo if forced is None else forced
+    if forced and forced != "auto":
+        if forced not in ALGORITHMS:
+            raise ValueError(
+                f"collective_algo={forced!r} (want one of {ALGORITHMS})")
+        if forced == "hier" and (topology is None or not topology.regular):
+            return "ring" if world_size >= 2 else "star"
+        return forced
+    if topology is not None and topology.num_slices > 1 \
+            and topology.regular:
+        return "hier" if nbytes >= ring_min_bytes else "tree"
+    if nbytes >= ring_min_bytes and world_size >= 3:
+        return "ring"
+    return "star"

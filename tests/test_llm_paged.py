@@ -82,6 +82,40 @@ def test_prefix_pages_shared():
     assert paged.stats()["prefix_entries"] >= 3
 
 
+def test_prefix_lru_hit_refreshes_recency_and_counts():
+    """A reused prefix must not age out of the LRU while hot, and
+    stats() exposes the hit/miss counters (PR-12 satellite: the old
+    list-based LRU popped in insertion order regardless of hits)."""
+    model = tiny_model()
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=128, page_size=8,
+        num_pages=128, prefill_buckets=(32, 64)))
+    hot = list(range(1, 17))  # 16 tokens = 2 full pages
+    paged.generate([hot + [30]], max_new_tokens=2)
+    s0 = paged.stats()
+    assert s0["prefix_misses"] >= 1 and s0["prefix_hits"] == 0
+    # a few distinct filler prefixes inserted AFTER the hot one
+    rng = np.random.RandomState(7)
+    filler = [list(rng.randint(40, 128, size=16)) + [i + 1]
+              for i in range(4)]
+    paged.generate(filler, max_new_tokens=2)
+    # hit the hot prefix; its keys move to the MRU end
+    paged.generate([hot + [31]], max_new_tokens=2)
+    s1 = paged.stats()
+    assert s1["prefix_hits"] == 1
+    assert s1["prefix_misses"] > s0["prefix_misses"]  # fillers missed
+    hot_keys = {tuple(hot[:8]), tuple(hot)}
+    assert hot_keys <= set(paged.prefix_pages)
+    # evict down to 2 entries: insertion order would keep only the
+    # newest fillers; true LRU keeps the hot keys (just refreshed)
+    paged._evict_prefixes(max_entries=2)
+    assert hot_keys == set(paged.prefix_pages), \
+        "hot prefix evicted despite being reused (recency not refreshed)"
+    assert len(paged._prefix_lru) == 2
+    # ledger consistency: every LRU key has pages and vice versa
+    assert set(paged._prefix_lru) == set(paged.prefix_pages)
+
+
 def test_streaming_and_cancellation(engines):
     _slot, paged = engines
     streamed = []

@@ -245,6 +245,47 @@ def test_shard_partitioning_under_actor_flood(shard_config):
 
 
 # ---------------------------------------------------------------------------
+# grant-time idle-lease reclaim (the PR-11 follow-up stall)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout_s(300)
+def test_no_lease_stall_across_shards(shard_config):
+    """Sequential sync gets on a 1-CPU cluster at shards=4: each task's
+    lease parks idle on its owning shard, and the NEXT task (routed to
+    a different shard by id hash) used to queue at the raylet until the
+    holder's 2s idle-lease cleaner tick — a reproducible ~2s sync-get
+    outlier (ROADMAP item 6 follow-up; median 2.0s, max 3.0s measured
+    pre-fix). Grant-time reclaim must keep every get under the cleaner
+    tick, and the reclaim counter must actually fire."""
+    shard_config(4)
+    ray_tpu.init(num_cpus=1, object_store_memory=100 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        def f(i):
+            return i
+
+        # warm: worker spawn + first lease are excluded from the gate
+        assert ray_tpu.get(f.remote(-1), timeout=60) == -1
+        latencies = []
+        for i in range(12):  # pre-fix EVERY get sat at ~2s (median)
+            t0 = time.monotonic()
+            assert ray_tpu.get(f.remote(i), timeout=30) == i
+            latencies.append(time.monotonic() - t0)
+        from ray_tpu._internal.config import CONFIG as _CONFIG
+        # every get must beat the idle-lease cleaner tick by a wide
+        # margin (pre-fix the MEDIAN sat at lease_idle_timeout_s)
+        assert max(latencies) < _CONFIG.lease_idle_timeout_s * 0.75, \
+            sorted(latencies)[-3:]
+        from ray_tpu._internal.runtime_metrics import runtime_metrics
+        snap = runtime_metrics().lease_reclaims.snapshot()
+        reclaims = sum(v for _k, v in snap["series"])
+        # 25 cross-shard handoffs on 1 CPU: the watchdog must have fired
+        assert reclaims > 0, snap
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # teardown hygiene
 # ---------------------------------------------------------------------------
 

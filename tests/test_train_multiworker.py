@@ -72,7 +72,10 @@ def _dp_train_fn(config):
     for step in range(start_step, config["steps"]):
         pred = x @ w
         grad_local = 2.0 * x.T @ (pred - target) / len(target)
-        grad = col.allreduce(grad_local, group_name=group_name) / world
+        # gradient sync routes through the collective backend (mean;
+        # topology/algorithm/quant selection applies here)
+        grad = train.allreduce_gradients(grad_local,
+                                         group_name=group_name)
         w = w - 0.05 * grad
         loss = float(np.mean((pred - target) ** 2))
         if rank == 0:
