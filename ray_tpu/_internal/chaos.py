@@ -31,6 +31,7 @@ import logging
 import os
 import signal
 import threading
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -47,6 +48,40 @@ class Rule:
     action: str         # one of _ACTIONS
     prob: float
     param: float = 0.0  # delay seconds (delay action)
+
+
+@dataclass
+class ScheduledRule:
+    """One time-scheduled fault entry: ``rule`` ARMS ``at_s`` seconds
+    after the schedule itself was armed and stays active until a LATER
+    entry for the same (pattern, action) replaces it — so
+    ``5:hb:delay:1.0:0.2, 15:hb:delay:0`` injects a 200ms heartbeat
+    delay only during t=[5, 15). Deterministic under ``chaos_seed``
+    (all probability draws still come from the one seeded RNG), which
+    is what lets the chaos soak replay its fault script bit-identically."""
+    at_s: float
+    rule: Rule
+
+
+def parse_schedule(spec: str) -> List[ScheduledRule]:
+    """Parse ``at_s:method:action:prob[:param],...`` — the scheduled
+    variant of :func:`parse_spec`; malformed entries raise (a typo'd
+    soak script must fail loudly, not soak nothing)."""
+    entries: List[ScheduledRule] = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        parts = entry.split(":")
+        if len(parts) < 4 or parts[2] not in _ACTIONS:
+            raise ValueError(
+                f"bad chaos schedule entry {entry!r}: want "
+                "<at_s>:<method>:<drop_req|drop_resp|delay|dup>"
+                ":<prob>[:<param>]")
+        entries.append(ScheduledRule(
+            at_s=float(parts[0]),
+            rule=Rule(pattern=parts[1], action=parts[2],
+                      prob=float(parts[3]),
+                      param=float(parts[4]) if len(parts) > 4 else 0.0)))
+    entries.sort(key=lambda s: s.at_s)
+    return entries
 
 
 def parse_spec(spec: str) -> List[Rule]:
@@ -87,6 +122,8 @@ class ChaosRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._rules: List[Rule] = []
+        self._schedule: List[ScheduledRule] = []
+        self._armed_at: Optional[float] = None
         self._specs: Optional[tuple] = None
         self._rng = None
         self._seed_used: Optional[int] = None
@@ -96,34 +133,48 @@ class ChaosRegistry:
 
     def _load(self):
         specs = (CONFIG.testing_rpc_failure, CONFIG.chaos_spec,
-                 CONFIG.chaos_seed)
+                 CONFIG.chaos_seed, CONFIG.chaos_schedule)
         if specs == self._specs:
             return
         with self._lock:
             if specs == self._specs:
                 return
             rules: List[Rule] = []
+            schedule: List[ScheduledRule] = []
             try:
                 if specs[0]:
                     rules.extend(parse_legacy_spec(specs[0]))
                 if specs[1]:
                     rules.extend(parse_spec(specs[1]))
+                if specs[3]:
+                    schedule = parse_schedule(specs[3])
             except (ValueError, IndexError):
                 logger.exception("malformed chaos spec; injecting nothing")
                 rules = []
+                schedule = []
             import random
             seed = specs[2]
             if self._rng is None or seed != self._seed_used:
                 self._rng = random.Random(seed if seed else None)
                 self._seed_used = seed
             self._rules = rules
+            if [s.rule for s in schedule] != \
+                    [s.rule for s in self._schedule] or \
+                    [s.at_s for s in schedule] != \
+                    [s.at_s for s in self._schedule]:
+                # t=0 of the script is the moment it was (re)armed.
+                self._schedule = schedule
+                self._armed_at = time.monotonic() if schedule else None
             self._specs = specs
-            if rules:
-                logger.warning("chaos armed: %d rule(s), seed=%s",
-                               len(rules), seed or "process-random")
+            if rules or schedule:
+                logger.warning(
+                    "chaos armed: %d rule(s) + %d scheduled, seed=%s",
+                    len(rules), len(schedule),
+                    seed or "process-random")
 
     def arm(self, spec: str = "", seed: int = 0,
-            legacy_spec: Optional[str] = None):
+            legacy_spec: Optional[str] = None,
+            schedule: Optional[str] = None):
         """Programmatic re-arm (tests / the set_chaos RPC): writes the
         specs into CONFIG so every read site — including freshly spawned
         code paths — sees the same rules, then reloads."""
@@ -131,13 +182,49 @@ class ChaosRegistry:
                                         "chaos_seed": seed}
         if legacy_spec is not None:
             overrides["testing_rpc_failure"] = legacy_spec
+        if schedule is not None:
+            overrides["chaos_schedule"] = schedule
         CONFIG.apply_system_config(overrides)
         self._specs = None
+        if schedule is not None:
+            # Re-arming the SAME schedule restarts its clock (a soak's
+            # restart of an identical script must replay from t=0);
+            # schedule=None (spec-only update) keeps the armed script
+            # AND its clock.
+            self._schedule = []
         self._load()
+
+    def _effective_rules(self) -> List[Rule]:
+        """Static rules plus the schedule's currently active entries;
+        a later-activated scheduled entry REPLACES any earlier rule for
+        the same (pattern, action) — `at:m:a:0` switches a fault off."""
+        if not self._schedule or self._armed_at is None:
+            return self._rules
+        elapsed = time.monotonic() - self._armed_at
+        merged: Dict[tuple, Rule] = {
+            (r.pattern, r.action): r for r in self._rules}
+        for entry in self._schedule:       # sorted by at_s
+            if entry.at_s <= elapsed:
+                merged[(entry.rule.pattern, entry.rule.action)] = \
+                    entry.rule
+        return list(merged.values())
 
     def active_rules(self) -> List[Rule]:
         self._load()
-        return list(self._rules)
+        return [r for r in self._effective_rules() if r.prob > 0]
+
+    def schedule_status(self) -> List[Dict[str, object]]:
+        """The armed schedule with per-entry activation state
+        (`cli chaos show` prints these rows)."""
+        self._load()
+        if not self._schedule or self._armed_at is None:
+            return []
+        elapsed = time.monotonic() - self._armed_at
+        return [{"at_s": e.at_s, "pattern": e.rule.pattern,
+                 "action": e.rule.action, "prob": e.rule.prob,
+                 "param": e.rule.param, "active": e.at_s <= elapsed,
+                 "elapsed_s": round(elapsed, 2)}
+                for e in self._schedule]
 
     def hit_counts(self) -> Dict[str, int]:
         """Per-(pattern, action) trigger counts — `cli chaos show` and
@@ -149,9 +236,9 @@ class ChaosRegistry:
 
     def _roll(self, method: str, action: str) -> Optional[Rule]:
         self._load()
-        if not self._rules:
+        if not self._rules and not self._schedule:
             return None
-        for rule in self._rules:
+        for rule in self._effective_rules():
             if rule.action == action and rule.pattern in method \
                     and self._rng.random() < rule.prob:
                 key = f"{rule.pattern}:{rule.action}"
@@ -191,8 +278,15 @@ def kill_pid(pid: int) -> bool:
         return False
 
 
-async def handle_set_chaos(spec: str = "", seed: int = 0):
+async def handle_set_chaos(spec: str = "", seed: int = 0,
+                           schedule: Optional[str] = None):
     """Shared RPC handler body (GCS + raylets register it): re-arm this
-    process's registry. An empty spec disarms."""
-    REGISTRY.arm(spec=spec, seed=seed)
-    return {"rules": len(REGISTRY.active_rules()), "pid": os.getpid()}
+    process's registry — static rules and/or a time-scheduled script.
+    ``schedule=None`` keeps an already-armed schedule (updating only
+    the static rules must not silently disarm a running soak script);
+    an explicit ``""`` clears it. An empty spec + empty schedule
+    disarms everything (`cli chaos clear`)."""
+    REGISTRY.arm(spec=spec, seed=seed, schedule=schedule)
+    return {"rules": len(REGISTRY.active_rules()),
+            "scheduled": len(REGISTRY.schedule_status()),
+            "pid": os.getpid()}

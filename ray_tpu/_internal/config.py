@@ -38,6 +38,27 @@ _DEFAULTS: Dict[str, Any] = {
     # Gate on the self-kill RPCs (`cli chaos kill-gcs`): a production
     # cluster must not expose a remote SIGKILL by default.
     "chaos_allow_kill": False,
+    # Time-scheduled chaos script: "at_s:method:action:prob[:param],..."
+    # — each entry ARMS its rule `at_s` seconds after the schedule is
+    # armed (a later entry for the same method:action replaces the
+    # earlier one, so `10:hb:delay:0` switches a fault off at t=10).
+    # Deterministic under chaos_seed; `cli chaos show` prints the armed
+    # schedule with per-entry activation state.
+    "chaos_schedule": "",
+    # --- fleet operations (drain / rolling upgrades) ---
+    # Graceful-drain budget: how long a draining raylet waits for
+    # in-flight leases to finish before stragglers get postmortem-tagged
+    # kills (kill_reason=drain_timeout -> DRAIN_TIMEOUT_KILLED).
+    "drain_timeout_s": 30.0,
+    # --- elastic autoscaler (autoscaler/elastic.py) ---
+    # Scale-up fires only after the pending-lease queue has been
+    # non-empty AND older than queue_age_up_s for up_delay_s straight;
+    # scale-in only after a node has been fully idle for down_delay_s.
+    # Both delays are the hysteresis that keeps an oscillating queue
+    # from flapping the fleet.
+    "autoscale_queue_age_up_s": 1.0,
+    "autoscale_up_delay_s": 2.0,
+    "autoscale_down_delay_s": 15.0,
     # --- object store ---
     "object_store_memory_bytes": 2 * 1024**3,
     # Objects <= this many bytes are returned inline in RPC replies and live

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 import numpy as np
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from .backoff import Backoff
 from .config import CONFIG
 from .errors import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
                      ObjectLostError, RayTpuError, TaskError,
@@ -1418,23 +1419,60 @@ class NormalTaskSubmitter:
         # instead of granting locally (reference:
         # scheduling/policy/spread_scheduling_policy)
         spread = strategy.kind == "SPREAD"
-        raylet_addr = self._cw.raylet_address
+        local_addr = self._cw.raylet_address
+        raylet_addr = local_addr
+        affinity_addr = None
+        hard_affinity = False
         if strategy.kind == "node_affinity" and strategy.node_id:
             addr = await self._cw.node_address(strategy.node_id)
             if addr is not None:
-                raylet_addr = addr
-        for _hop in range(16):
+                raylet_addr = affinity_addr = addr
+                hard_affinity = not strategy.soft
+        # Spillback hops stay bounded (16); rejection retries ride a
+        # jittered backoff instead of counting as hops — a node under
+        # memory pressure or mid-drain legitimately rejects for longer
+        # than 16 * 50ms, and the request's semantics are "queue until
+        # grantable", not "fail after 0.8s".
+        spill_hops = 0
+        bo = None
+        while True:
             raylet = self._shard.clients.get(raylet_addr)
-            reply = await raylet.call("request_worker_lease",
-                                      meta_blob=blob,
-                                      task_hex=spec.task_id.hex(),
-                                      job=spec.job_id.hex(),
-                                      strategy="SPREAD" if spread else None,
-                                      timeout=None,
-                                      retries=CONFIG.rpc_max_retries)
+            try:
+                reply = await raylet.call("request_worker_lease",
+                                          meta_blob=blob,
+                                          task_hex=spec.task_id.hex(),
+                                          job=spec.job_id.hex(),
+                                          strategy="SPREAD" if spread
+                                          else None,
+                                          timeout=None,
+                                          retries=CONFIG.rpc_max_retries)
+            except (asyncio.CancelledError, GeneratorExit):
+                raise
+            except Exception:
+                # A REMOTE raylet died under us (rolling restart /
+                # node failure): fall back to the local raylet, which
+                # re-spills onto a live node once the view updates.
+                # HARD node-affinity targets and the local raylet
+                # itself keep the old fail-fast contract; soft
+                # affinity prefers running elsewhere over failing.
+                if tuple(raylet_addr) == tuple(local_addr) or \
+                        (hard_affinity
+                         and tuple(raylet_addr) == tuple(affinity_addr)):
+                    raise
+                logger.warning(
+                    "lease request to raylet %s failed; retrying via "
+                    "the local raylet", raylet_addr, exc_info=True)
+                raylet_addr = local_addr
+                spill_hops = 0
+                continue
             if reply.get("canceled"):
                 return None  # dropped at the raylet; caller re-issues
             if reply.get("spillback_to"):
+                spill_hops += 1
+                if spill_hops > 16:
+                    raise RayTpuError(
+                        "could not acquire a worker lease (too many "
+                        "spillback hops)")
                 raylet_addr = tuple(reply["spillback_to"][1])
                 # A SPREAD redirect already chose the node: the target
                 # must grant/queue locally, not re-spread (ping-pong).
@@ -1444,7 +1482,24 @@ class NormalTaskSubmitter:
                 if reply.get("permanent"):
                     raise RayTpuError(
                         f"worker environment failed: {reply.get('error')}")
-                await asyncio.sleep(0.05)
+                if reply.get("draining") and hard_affinity and \
+                        tuple(raylet_addr) == tuple(affinity_addr):
+                    # HARD affinity to a draining node: silently
+                    # re-routing elsewhere would violate the pin — fail
+                    # loudly instead (soft affinity re-routes below).
+                    raise RayTpuError(
+                        f"node-affinity target "
+                        f"{strategy.node_id[:12]} is draining and the "
+                        "affinity is hard (soft=False)")
+                if bo is None:
+                    bo = Backoff(base_s=0.05, max_s=1.0)
+                await bo.async_sleep()
+                if reply.get("draining") and \
+                        tuple(raylet_addr) != tuple(local_addr):
+                    # A draining node never grants again — go home and
+                    # let the local raylet re-route the request.
+                    raylet_addr = local_addr
+                    spill_hops = 0
                 continue
             if not self._cleaner_started:
                 self._cleaner_started = True
@@ -1455,7 +1510,6 @@ class NormalTaskSubmitter:
                 worker_id=reply["worker_id"],
                 raylet_address=raylet_addr,
                 node_id=reply["node_id"])
-        raise RayTpuError("could not acquire a worker lease (too many hops)")
 
     def _return_lease(self, key: Tuple, lease: Lease):
         lease.inflight -= 1
@@ -1559,6 +1613,11 @@ class ActorClientState:
     state: str = "PENDING"          # PENDING|ALIVE|RESTARTING|DEAD
     address: Optional[Address] = None
     num_restarts: int = 0
+    # GCS scheduling-epoch token: bumps on every (re)schedule of the
+    # instance — including budget-free drain migrations, which do NOT
+    # move num_restarts. A changed instance means a FRESH process that
+    # expects our sequence stream to restart at 0.
+    instance: int = 0
     seq: int = 0
     queued: List[TaskSpec] = field(default_factory=list)
     inflight: Dict[int, TaskSpec] = field(default_factory=dict)
@@ -1793,6 +1852,9 @@ class ActorTaskSubmitter:
             if info is not None and info["state"] == "ALIVE":
                 st.state = "ALIVE"
                 st.address = tuple(info["address"])
+                st.num_restarts = info.get("num_restarts",
+                                           st.num_restarts)
+                st.instance = info.get("instance", st.instance)
             elif info is not None and info["state"] == "DEAD":
                 st.state = "DEAD"
                 st.death_cause = info.get("death_cause", "actor dead")
@@ -2050,7 +2112,8 @@ class ActorTaskSubmitter:
                     await self._on_actor_update({
                         "actor_id": st.actor_id, "state": "ALIVE",
                         "address": info["address"],
-                        "num_restarts": info.get("num_restarts", 0)})
+                        "num_restarts": info.get("num_restarts", 0),
+                        "instance": info.get("instance", st.instance)})
                     return
                 # RESTARTING/PENDING: keep polling as a pubsub backstop.
         finally:
@@ -2078,8 +2141,11 @@ class ActorTaskSubmitter:
         if state == "ALIVE":
             with st.lock:
                 restarted = \
-                    message.get("num_restarts", 0) != st.num_restarts
+                    message.get("num_restarts", 0) != st.num_restarts \
+                    or message.get("instance",
+                                   st.instance) != st.instance
                 st.num_restarts = message.get("num_restarts", 0)
+                st.instance = message.get("instance", st.instance)
                 st.state = "ALIVE"
                 st.address = tuple(message["address"])
                 pending = sorted(st.queued + list(st.inflight.values()),
@@ -3223,6 +3289,16 @@ class CoreWorker:
         spec = self.task_manager.lineage_spec(oid.task_id())
         if spec is None:
             return False
+        entry = self.memory_store.get_entry(oid)
+        if entry is not None and not entry.in_plasma:
+            # The reply landed while we were concluding "lost" (the
+            # getter reads entry -> None, then on_completed puts the
+            # value AND pops the pending row, then the getter's
+            # is_pending check sees False and falls through to here).
+            # Resubmitting would re-execute a COMPLETED task — a
+            # doubled side effect. Report success; the caller's loop
+            # re-reads the store and returns the value.
+            return True
         logger.info("reconstructing %s by resubmitting task %s",
                     oid.hex()[:12], spec.name or spec.function.qualname)
         # Clear stale state and resubmit.

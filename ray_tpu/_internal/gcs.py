@@ -140,6 +140,17 @@ class GcsServer:
         self.spilled: Dict[str, str] = {}   # obj hex -> spilled path
         # per-node unmet lease demand, from heartbeats (autoscaler input)
         self._pending_demand: Dict[str, List[Dict[str, float]]] = {}
+        # per-node oldest-pending-lease age per shape (autoscaler
+        # state-manager input; reference: gcs_autoscaler_state_manager)
+        self._queue_ages: Dict[str, Dict[str, float]] = {}
+        # When WE last flipped a node's view drain flag (drain_node set
+        # or cancel): heartbeat adoption of the raylet's own flag is
+        # suppressed for a short grace after, so a pre-flip heartbeat
+        # in flight can neither clear a just-raised fence nor re-raise
+        # a just-canceled one. Past the grace the raylet's heartbeat is
+        # authoritative BOTH ways (it survives a GCS failover; the
+        # recovered view starts clean).
+        self._drain_view_ts: Dict[str, float] = {}
         # pubsub: channel -> {subscriber addr}
         self.subscribers: Dict[str, Set[Address]] = {}
         # deque(maxlen): overflow drops the oldest entries in O(1) per
@@ -665,6 +676,9 @@ class GcsServer:
                                resources_available: Dict[str, float],
                                resources_total: Dict[str, float],
                                pending_demand: Optional[List[Dict]] = None,
+                               queue_ages: Optional[Dict[str, float]]
+                               = None,
+                               draining: Optional[bool] = None,
                                known_ver: int = -1, known_epoch: int = 0,
                                gcs_incarnation: Optional[int] = None):
         if not self._check_incarnation(gcs_incarnation):
@@ -693,9 +707,23 @@ class GcsServer:
             view.resources.total = ResourceSet(resources_total)
             view.resources.available = ResourceSet(resources_available)
             self._bump_view(node_id)
-        # Unmet lease demand feeds the autoscaler (reference:
-        # gcs_autoscaler_state_manager.cc resource_load).
+        # Unmet lease demand + queue ages feed the autoscaler
+        # (reference: gcs_autoscaler_state_manager.cc resource_load).
         self._pending_demand[node_id] = pending_demand or []
+        self._queue_ages[node_id] = queue_ages or {}
+        if draining is not None and \
+                bool(draining) != bool(getattr(view, "draining", False)) \
+                and time.monotonic() - \
+                self._drain_view_ts.get(node_id, 0.0) > 5.0:
+            # Adopt the raylet's own fence state (it survives a GCS
+            # failover in the raylet's memory; the recovered view
+            # starts clean) — but NOT within the grace window after WE
+            # flipped the view flag: a pre-flip heartbeat in flight
+            # must neither clear a just-raised fence (drain start) nor
+            # re-raise a just-canceled one (the node would be excluded
+            # from scheduling forever).
+            view.draining = bool(draining)
+            self._bump_view(node_id)
         # Reply with the cluster-view *delta* since the raylet's last known
         # version (reference: ray_syncer.h's versioned resource broadcast —
         # a stable cluster exchanges no per-node payload at all, vs the
@@ -742,6 +770,7 @@ class GcsServer:
                 "total": view.resources.total.to_dict(),
                 "available": view.resources.available.to_dict(),
                 "labels": view.resources.labels,
+                "draining": bool(getattr(view, "draining", False)),
             }
         return out
 
@@ -784,6 +813,7 @@ class GcsServer:
                 "total": view.resources.total.to_dict(),
                 "available": view.resources.available.to_dict(),
                 "labels": view.resources.labels,
+                "draining": bool(getattr(view, "draining", False)),
             }
         removed = [nid for ver, nid in self._view_removals if ver > since]
         return {"full": False, "ver": self._view_version,
@@ -801,11 +831,145 @@ class GcsServer:
             for r in self.nodes.values()
         ]
 
-    async def handle_drain_node(self, node_id: str):
+    async def handle_drain_node(self, node_id: str,
+                                timeout_s: Optional[float] = None,
+                                exit_process: bool = False,
+                                migrate: bool = True,
+                                cancel: bool = False):
+        """GCS-coordinated graceful drain of one node (the rolling-
+        upgrade / elastic-scale-in primitive; reference: the autoscaler
+        drain protocol through gcs_autoscaler_state_manager):
+
+        1. fence the node in the cluster view (schedulers and peer
+           raylets stop placing work there — propagated in the next
+           heartbeat's view delta),
+        2. fence the raylet itself (``drain_self(phase="fence")``:
+           queued lease requests spill to healthy nodes or bounce),
+        3. migrate its actors — detached/named included — through the
+           restart path WITHOUT consuming restart budget (drain is an
+           operator action, not a failure),
+        4. wait for in-flight leases (``phase="wait"``): stragglers
+           past ``timeout_s`` get postmortem-tagged kills,
+        5. with ``exit_process``, the raylet main exits clean and the
+           node is declared dead here so its record doesn't linger
+           until the health checker times it out.
+
+        ``cancel=True`` lowers the fence instead (scale-in abort)."""
+        rec = self.nodes.get(node_id)
+        if rec is None or rec.state == "DEAD":
+            return {"error": f"unknown or dead node {node_id[:12]}"}
         view = self._resource_views.get(node_id)
-        if view is not None:
+        raylet = self.clients.get(rec.address)
+        if cancel:
+            if view is not None and getattr(view, "draining", False):
+                view.draining = False
+                self._drain_view_ts[node_id] = time.monotonic()
+                self._bump_view(node_id)
+            try:
+                await raylet.call("drain_self", phase="cancel",
+                                  timeout=10)
+            except Exception as e:
+                return {"error": f"drain cancel rpc failed: {e}"}
+            self.add_event("NODE_DRAIN_CANCELED",
+                           f"drain of node {node_id[:12]} canceled",
+                           node_id=node_id)
+            return {"draining": False}
+        if view is not None and not getattr(view, "draining", False):
             view.draining = True
-        return True
+            self._drain_view_ts[node_id] = time.monotonic()
+            self._bump_view(node_id)
+        self.add_event("NODE_DRAINING",
+                       f"node {node_id[:12]} draining"
+                       + (" (will exit)" if exit_process else ""),
+                       severity="WARNING", node_id=node_id)
+        try:
+            await raylet.call("drain_self", phase="fence",
+                              reason="gcs-coordinated drain", timeout=10)
+        except Exception as e:
+            return {"error": f"drain fence rpc failed: {e}"}
+        migrated: List[str] = []
+        if migrate:
+            for record in list(self.actors.values()):
+                if record.node_id == node_id and record.state == "ALIVE":
+                    await self._migrate_actor(
+                        record, f"node {node_id[:12]} draining")
+                    migrated.append(record.actor_id.hex())
+        budget = timeout_s if timeout_s is not None \
+            else CONFIG.drain_timeout_s
+        try:
+            report = await raylet.call(
+                "drain_self", phase="wait", timeout_s=budget,
+                exit_process=exit_process, timeout=budget + 30)
+        except Exception as e:
+            return {"error": f"drain wait rpc failed: {e}",
+                    "migrated_actors": migrated}
+        if not isinstance(report, dict):
+            report = {"drained": bool(report)}
+        report["node_id"] = node_id
+        report["migrated_actors"] = migrated
+        if exit_process:
+            # The raylet is exiting on purpose: retire the node record
+            # now (fails over anything missed, removes it from views)
+            # instead of waiting out the health-check threshold.
+            await self._on_node_death(node_id,
+                                      "drained for rolling restart")
+        return report
+
+    async def _migrate_actor(self, record: ActorRecord, cause: str):
+        """Move one ALIVE actor off its node through the restart path
+        WITHOUT consuming restart budget: publish RESTARTING first (so
+        callers park new calls), kill the old instance, reschedule on a
+        non-draining node. Named/detached actors keep their name — the
+        PR-10 failover path re-resolves them at the new address."""
+        if record.state != "ALIVE":
+            return
+        old_addr = record.address
+        record.state = "RESTARTING"
+        record.address = None
+        record.node_id = None
+        record.worker_id = None
+        record.sched_epoch += 1
+        self._publish_actor(record)
+        if old_addr is not None:
+            try:
+                await self.clients.get(tuple(old_addr)).call(
+                    "kill_actor", actor_id=record.actor_id, timeout=5)
+            except Exception:
+                logger.debug("kill_actor during drain migration failed "
+                             "(worker already gone?)", exc_info=True)
+        asyncio.ensure_future(self._schedule_actor(record))
+        self._mutate("actor", record.actor_id, record)
+        logger.info("migrating actor %s: %s",
+                    record.actor_id.hex()[:12], cause)
+
+    async def handle_get_autoscaler_state(self):
+        """The autoscaler state manager's view (reference:
+        gcs_autoscaler_state_manager.h): per-node capacity/queue/drain
+        state plus aggregate unmet demand — everything the elastic
+        reconciler needs in ONE rpc."""
+        demand = await self.handle_get_cluster_demand()
+        nodes: Dict[str, Any] = {}
+        for nid, rec in self.nodes.items():
+            if rec.state == "DEAD":
+                continue
+            view = self._resource_views.get(nid)
+            ages = self._queue_ages.get(nid, {})
+            nodes[nid] = {
+                "node_index": rec.node_index,
+                "is_head": rec.is_head,
+                "labels": rec.labels,
+                "total": view.resources.total.to_dict()
+                if view else rec.resources_total,
+                "available": view.resources.available.to_dict()
+                if view else {},
+                "draining": bool(getattr(view, "draining", False)),
+                "queue_depth": len(self._pending_demand.get(nid, ())),
+                "queue_age_s": max(ages.values(), default=0.0),
+                "queue_ages": ages,
+            }
+        return {"nodes": nodes,
+                "task_demand": demand["task_demand"],
+                "pg_demand": demand["pg_demand"]}
 
     async def _health_check_loop(self):
         period = CONFIG.health_check_period_s
@@ -1411,6 +1575,11 @@ class GcsServer:
             "address": record.address,
             "node_id": record.node_id,
             "num_restarts": record.num_restarts,
+            # Instance token: bumps on EVERY (re)schedule — including
+            # budget-free drain migrations, where num_restarts does not
+            # move. Callers renumber their sequence stream when it
+            # changes (a fresh instance expects seq 0).
+            "instance": record.sched_epoch,
             "death_cause": record.death_cause,
         })
 
@@ -1534,6 +1703,7 @@ class GcsServer:
             "address": record.address, "node_id": record.node_id,
             "name": record.name, "namespace": record.namespace,
             "num_restarts": record.num_restarts,
+            "instance": record.sched_epoch,
             "death_cause": record.death_cause,
             "is_detached": record.is_detached,
             "class_name": record.spec.function.qualname,
@@ -1726,9 +1896,11 @@ class GcsServer:
 
     # -- chaos harness (cli chaos / tests) -----------------------------
 
-    async def handle_set_chaos(self, spec: str = "", seed: int = 0):
+    async def handle_set_chaos(self, spec: str = "", seed: int = 0,
+                               schedule: Optional[str] = None):
         from . import chaos
-        return await chaos.handle_set_chaos(spec=spec, seed=seed)
+        return await chaos.handle_set_chaos(spec=spec, seed=seed,
+                                            schedule=schedule)
 
     async def handle_chaos_kill_self(self):
         """`cli chaos kill-gcs`: SIGKILL this GCS process (the headline

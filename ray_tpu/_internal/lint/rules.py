@@ -145,6 +145,13 @@ _STDOUT_OK_MARK = "# stdout ok"
 _BACKOFF_OK_MARK = "# backoff ok"
 _BACKOFF_IMPL_FILE = "ray_tpu/_internal/backoff.py"
 _SLEEP_DOTTED = {"time.sleep", "asyncio.sleep"}
+# L009 also covers the reconciler loops OUTSIDE _internal/: the
+# autoscaler (config-driven Monitor + the elastic metric-driven
+# reconciler) and the serve control plane both run forever against a
+# control plane that fails over — their error paths must ride the same
+# jittered schedule or a GCS restart synchronizes a fleet-wide retry
+# storm.
+_L009_EXTRA_DIRS = ("ray_tpu/autoscaler/", "ray_tpu/serve/_private/")
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -511,9 +518,12 @@ class _Linter(ast.NodeVisitor):
                        "logger)")
 
         # L009: raw sleep in a retry loop (sleep-on-error inside a loop)
-        # in _internal/ — retry schedules come from backoff.Backoff so
+        # in _internal/ or a reconciler package (autoscaler, serve
+        # control plane) — retry schedules come from backoff.Backoff so
         # fleet-wide retry storms stay jittered, capped and bounded.
-        if self._internal and self.path != _BACKOFF_IMPL_FILE \
+        if (self._internal
+                or self.path.startswith(_L009_EXTRA_DIRS)) \
+                and self.path != _BACKOFF_IMPL_FILE \
                 and dotted in _SLEEP_DOTTED \
                 and self._loop_depth > 0 and self._except_depth > 0 \
                 and not self._line_marked(node, _BACKOFF_OK_MARK):

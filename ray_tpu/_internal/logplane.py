@@ -494,6 +494,11 @@ def classify_exit(returncode: Optional[int],
             if kill_reason == "memory":
                 return {"kind": "OOM_KILLED",
                         "detail": "SIGKILL by the node memory watchdog"}
+            if kill_reason == "drain_timeout":
+                return {"kind": "DRAIN_TIMEOUT_KILLED",
+                        "detail": "SIGKILL by the drain deadline — the "
+                                  "task outlived drain_timeout_s during "
+                                  "a graceful node drain"}
             return {"kind": "SIGKILL",
                     "detail": "SIGKILL (kernel OOM killer, ray_tpu.kill,"
                               " or an external kill -9)"}

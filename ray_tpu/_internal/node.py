@@ -50,8 +50,15 @@ class Node:
                  node_index: int = 0,
                  object_store_memory: Optional[int] = None,
                  gcs_persist_path: Optional[str] = None,
-                 gcs_port: int = 0):
+                 gcs_port: int = 0,
+                 is_head: Optional[bool] = None):
         self.head = head
+        # `head` decides whether the GCS runs in-process; `is_head`
+        # marks the node's ROLE in the cluster (scheduler preference,
+        # serve system-actor affinity, rollout skip list). They split
+        # when the GCS is a standalone killable process (external_gcs
+        # clusters): the driver's co-located raylet is still the head.
+        self.is_head = head if is_head is None else is_head
         self.session_name = session_name or new_session_name()
         self.node_index = node_index
         self.resources = resources or default_resources()
@@ -87,7 +94,7 @@ class Node:
             resources=self.resources,
             labels=self.labels,
             node_index=self.node_index,
-            is_head=self.head,
+            is_head=self.is_head,
             object_store_memory=self.object_store_memory,
             spill_dir=os.path.join(self.session_dir,
                                    f"spill-{self.node_index}"))

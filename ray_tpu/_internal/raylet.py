@@ -68,6 +68,9 @@ class LeaseRequest:
     spec_meta: Dict[str, Any]
     future: asyncio.Future = None
     pg: Optional[Tuple[PlacementGroupID, int]] = None
+    # Queue-age accounting (autoscaler scale-up signal + the
+    # rtpu_lease_queue_age_seconds gauge): when this request arrived.
+    enqueued_at: float = 0.0
 
 
 @dataclass
@@ -162,6 +165,20 @@ class Raylet:
         self._gcs_reconnecting = False
         self._gcs_reports_pending: collections.deque = \
             collections.deque(maxlen=256)
+        # Graceful-drain fence (rolling upgrades / elastic scale-in):
+        # while draining, NO new lease grants — requests spill back to
+        # healthy nodes or are rejected with {"draining": True}, workers
+        # whose leases return are disposed instead of re-pooled, and
+        # drain_self(phase="wait") blocks until in-flight leases empty
+        # (stragglers past the deadline get postmortem-tagged kills).
+        self._draining = False
+        self._drain_reason = ""
+        # Set by drain_self(exit_process=True): standalone raylet mains
+        # (raylet_main.py) wait on it and exit clean after the drain.
+        self.exit_requested: Optional[asyncio.Event] = None
+        # Gauge hygiene: shapes whose queue-age series we exported last
+        # tick, so a drained shape's stale age is zeroed, not frozen.
+        self._last_age_shapes: Set[str] = set()
         self._stopped = False
 
     # ------------------------------------------------------------------
@@ -169,6 +186,7 @@ class Raylet:
     # ------------------------------------------------------------------
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Address:
+        self.exit_requested = asyncio.Event()
         self.server.register_instance(self)
         self.address = await self.server.start(host, port)
         gcs = self.clients.get(self.gcs_address)
@@ -219,6 +237,8 @@ class Raylet:
                     resources_total=self.resources.total.to_dict(),
                     pending_demand=[req.demand.to_dict()
                                     for req in self.queued[:100]],
+                    queue_ages=self._queue_ages(),
+                    draining=self._draining,
                     known_ver=self._view_ver,
                     known_epoch=self._view_epoch,
                     gcs_incarnation=self._gcs_incarnation,
@@ -391,11 +411,42 @@ class Raylet:
         after re-registration. Bounded: oldest dropped beyond 256."""
         self._gcs_reports_pending.append((method, kwargs))
 
+    @staticmethod
+    def _shape_tag(demand: ResourceSet) -> str:
+        """Compact stable tag for one lease shape's resource demand
+        (the per-shape queue-age gauge + autoscaler state rows)."""
+        d = demand.to_dict()
+        if not d:
+            return "none"
+        return ",".join(f"{k}={v:g}" for k, v in sorted(d.items()))
+
+    def _queue_ages(self) -> Dict[str, float]:
+        """Oldest pending lease age per resource shape — the elastic
+        autoscaler's primary scale-up signal (a deep-but-fresh queue is
+        a burst; an OLD queue is starvation)."""
+        now = time.monotonic()
+        ages: Dict[str, float] = {}
+        for req in self.queued:
+            shape = self._shape_tag(req.demand)
+            age = now - (req.enqueued_at or now)
+            if age > ages.get(shape, -1.0):
+                ages[shape] = age
+        return ages
+
     def _update_metrics(self):
         from .runtime_metrics import runtime_metrics
         metrics = runtime_metrics()
         tags = {"node": str(self.node_index)}
         metrics.raylet_lease_queue.set(len(self.queued), tags=tags)
+        metrics.node_draining.set(1 if self._draining else 0, tags=tags)
+        ages = self._queue_ages()
+        for shape, age in ages.items():
+            metrics.lease_queue_age.set(
+                age, tags={"node": str(self.node_index), "shape": shape})
+        for stale in self._last_age_shapes - set(ages):
+            metrics.lease_queue_age.set(
+                0.0, tags={"node": str(self.node_index), "shape": stale})
+        self._last_age_shapes = set(ages)
         metrics.raylet_store_bytes.set(self.store_used, tags=tags)
         metrics.raylet_workers.set(len(self.workers), tags=tags)
         metrics.store_capacity.set(self.capacity, tags=tags)
@@ -455,7 +506,11 @@ class Raylet:
         for nid, info in delta.items():
             nr = NodeResources(ResourceSet(info["total"]), info["labels"])
             nr.available = ResourceSet(info["available"])
-            view[nid] = NodeView(nid, nr)
+            nv = NodeView(nid, nr)
+            # Drain fence propagation: peer raylets must stop spilling
+            # lease requests onto a draining node.
+            nv.draining = bool(info.get("draining"))
+            view[nid] = nv
             self.node_addresses[nid] = tuple(info["address"])
         self.cluster_view = view
         if "ver" in vd:
@@ -1092,7 +1147,25 @@ class Raylet:
             demand=ResourceSet(spec_meta.get("resources", {})),
             spec_meta=spec_meta,
             future=asyncio.get_running_loop().create_future(),
-            pg=spec_meta.get("pg"))
+            pg=spec_meta.get("pg"),
+            enqueued_at=time.monotonic())
+        if self._draining:
+            # Drain fence: this node grants nothing new.
+            # grant_or_reject callers (the GCS actor scheduler) have a
+            # two-outcome contract — grant or {"rejected"} — so they
+            # get a transient rejection (their own view skips draining
+            # nodes on the re-pick); everyone else is redirected to a
+            # healthy node when one fits, else told WHY
+            # ({"draining": True}) so the driver's retry loop goes
+            # back to its local raylet instead of spinning here.
+            if spec_meta.get("grant_or_reject"):
+                return {"rejected": True, "draining": True,
+                        "error": "node is draining"}
+            spill = self._pick_spillback(req)
+            if spill is not None:
+                return {"spillback_to": spill}
+            return {"rejected": True, "draining": True,
+                    "error": "node is draining"}
         if spec_meta.get("strategy") == "SPREAD":
             # Round-robin across schedulable nodes BEFORE considering a
             # local grant (reference: spread_scheduling_policy — default
@@ -1150,6 +1223,11 @@ class Raylet:
     def _try_grant(self, req: LeaseRequest):
         """Attempt to allocate resources + a worker; returns awaitable reply
         or None if resources unavailable."""
+        if self._draining:
+            # Drain fence: grants stop the moment the drain begins —
+            # including re-grants of just-returned workers to queued
+            # requests (the drain-leak the return path used to allow).
+            return None
         if self._refuse_new_leases():
             return None
         if req.pg is not None:
@@ -1281,6 +1359,18 @@ class Raylet:
                                          and f.exception() is not None
                                          and self._queue_gcs_report(
                                              "report_worker_death", r)))
+            elif self._draining:
+                # Drain fence on the return path: a worker returned
+                # mid-drain (including via handle_return_worker's
+                # grace-poll, which awaits and can resume AFTER the
+                # fence went up) must NOT re-enter the idle pool where
+                # a queued request from another job could re-lease it —
+                # that leak kept drains from ever converging. The
+                # process is disposed; its resources were refunded
+                # above, so the drain's lease count still converges.
+                logger.info("disposing worker %s returned during drain",
+                            handle.worker_id.hex()[:12])
+                self._kill_worker(handle)
             else:
                 handle.state = "IDLE"
                 handle.lease_id = None
@@ -1419,6 +1509,132 @@ class Raylet:
                 req.future.set_result({"rejected": True, "canceled": True})
                 self.queued.remove(req)
         return True
+
+    # ------------------------------------------------------------------
+    # graceful drain (rolling upgrades / elastic scale-in; reference:
+    # node_manager.cc HandleDrainRaylet + the autoscaler drain protocol)
+    # ------------------------------------------------------------------
+
+    def _begin_drain(self, reason: str = ""):
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_reason = reason or "drain requested"
+        logger.warning("raylet %s draining: %s", self.node_id[:12],
+                       self._drain_reason)
+        # Resolve every queued request NOW: spill it to a healthy node
+        # or reject it with the draining marker — drain convergence
+        # must not wait on requests this node will never grant.
+        queued, self.queued = self.queued, []
+        for req in queued:
+            if req.future.done():
+                continue
+            spill = self._pick_spillback(req)
+            if spill is not None:
+                req.future.set_result({"spillback_to": spill})
+            else:
+                req.future.set_result(
+                    {"rejected": True, "draining": True,
+                     "error": "node is draining"})
+        self._update_metrics()
+
+    def _cancel_drain(self):
+        if not self._draining:
+            return
+        logger.warning("raylet %s drain canceled", self.node_id[:12])
+        self._draining = False
+        self._drain_reason = ""
+        self._update_metrics()
+        self._pump_queue()
+
+    async def handle_drain_self(self, phase: str = "all",
+                                timeout_s: Optional[float] = None,
+                                exit_process: bool = False,
+                                reason: str = ""):
+        """GCS-coordinated graceful drain of this raylet.
+
+        ``phase="fence"`` raises the fence and returns immediately (the
+        coordinator then migrates actors off this node);
+        ``phase="wait"`` blocks until every in-flight lease is returned
+        — idle leases come home via the owners' fairness-rotation /
+        idle-cleaner ticks within ~lease_idle_timeout_s — or the
+        deadline passes, at which point stragglers get postmortem-
+        tagged SIGKILLs (kill_reason="drain_timeout" →
+        DRAIN_TIMEOUT_KILLED), never a hang. ``exit_process=True`` asks
+        a standalone raylet main to exit clean after replying.
+        ``phase="cancel"`` lowers the fence and re-pumps the queue."""
+        if phase == "cancel":
+            self._cancel_drain()
+            return {"draining": False}
+        self._begin_drain(reason)
+        if phase == "fence":
+            return {"draining": True, "leases": len(self.leases),
+                    "workers": len(self.workers)}
+        budget = timeout_s if timeout_s is not None \
+            else CONFIG.drain_timeout_s
+        t0 = time.monotonic()
+        deadline = t0 + budget
+        while self.leases and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        killed: List[str] = []
+        if self.leases:
+            # Stragglers: killed, tagged so the postmortem taxonomy
+            # reports DRAIN_TIMEOUT_KILLED with certainty rather than
+            # guessing at a foreign SIGKILL.
+            for worker_id, _demand, _pg in list(self.leases.values()):
+                handle = self.workers.get(worker_id)
+                if handle is None or handle.state == "DEAD":
+                    continue
+                logger.warning(
+                    "drain deadline (%.1fs): killing straggler worker "
+                    "%s (pid %s)", budget, handle.worker_id.hex()[:12],
+                    handle.pid)
+                handle.kill_reason = "drain_timeout"
+                killed.append(handle.worker_id.hex())
+                if handle.proc is not None:
+                    try:
+                        handle.proc.kill()
+                    except Exception:
+                        logger.debug("drain kill of pid %s failed",
+                                     handle.pid, exc_info=True)
+                else:
+                    self._kill_worker(handle)
+            # The death path (liveness sweep / dispose) releases their
+            # leases and files the postmortems; wait briefly for the
+            # fold, then force-release whatever is left.
+            grace = time.monotonic() + 5.0
+            while self.leases and time.monotonic() < grace:
+                await asyncio.sleep(0.05)
+            for lease_id in list(self.leases):
+                self._release_lease(lease_id)
+        # Idle/starting workers are never reused post-drain: reap them.
+        for handle in list(self.workers.values()):
+            if handle.state in ("IDLE", "STARTING") \
+                    and handle.lease_id is None:
+                self._kill_worker(handle)
+        elapsed = time.monotonic() - t0
+        from .runtime_metrics import runtime_metrics
+        metrics = runtime_metrics()
+        tags = {"node": str(self.node_index)}
+        metrics.drain_latency.observe(elapsed, tags=tags)
+        metrics.drains_completed.inc(tags=dict(
+            tags, outcome="timeout" if killed else "clean"))
+        self._gcs_event(
+            "NODE_DRAINED",
+            f"node {self.node_id[:12]} drained in {elapsed:.2f}s"
+            + (f" ({len(killed)} stragglers killed)" if killed else ""),
+            severity="WARNING" if killed else "INFO",
+            elapsed_s=elapsed, stragglers_killed=killed,
+            will_exit=exit_process)
+        if exit_process and self.exit_requested is not None:
+            # Reply first; a standalone raylet main (raylet_main.py)
+            # wakes on the event and exits clean. In-process raylets
+            # (local mode / the embedded head) just stay fenced.
+            asyncio.get_running_loop().call_later(
+                0.2, self.exit_requested.set)
+        return {"drained": True, "elapsed_s": elapsed,
+                "stragglers_killed": killed,
+                "timed_out": bool(killed), "exiting": exit_process}
 
     # ------------------------------------------------------------------
     # placement group bundles (two-phase commit, raylet side)
@@ -2027,9 +2243,11 @@ class Raylet:
 
     # -- chaos harness (cli chaos / tests) -----------------------------
 
-    async def handle_set_chaos(self, spec: str = "", seed: int = 0):
+    async def handle_set_chaos(self, spec: str = "", seed: int = 0,
+                               schedule: Optional[str] = None):
         from . import chaos
-        return await chaos.handle_set_chaos(spec=spec, seed=seed)
+        return await chaos.handle_set_chaos(spec=spec, seed=seed,
+                                            schedule=schedule)
 
     async def handle_chaos_kill_worker(self, worker_hex: str = "",
                                        pid: int = 0):
@@ -2231,6 +2449,8 @@ class Raylet:
             "num_workers": len(self.workers),
             "num_leases": len(self.leases),
             "num_queued_leases": len(self.queued),
+            "draining": self._draining,
+            "queue_ages": self._queue_ages(),
             "object_store_used": self.store_used,
             "object_store_capacity": self.capacity,
             "num_objects": len(self.objects),

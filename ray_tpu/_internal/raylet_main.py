@@ -52,7 +52,19 @@ def main(argv=None):
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
-        await stop.wait()
+        # Two exit triggers: a signal, or a completed graceful drain
+        # with exit_process=True (the rolling-restart primitive —
+        # drain_self replies first, then wakes this event).
+        waits = [asyncio.ensure_future(stop.wait()),
+                 asyncio.ensure_future(raylet.exit_requested.wait())]
+        done, pending = await asyncio.wait(
+            waits, return_when=asyncio.FIRST_COMPLETED)
+        for fut in pending:
+            fut.cancel()
+        if raylet.exit_requested.is_set():
+            logging.getLogger(__name__).warning(
+                "raylet %s exiting clean after drain",
+                raylet.node_id[:12])
         await raylet.stop()
 
     asyncio.run(run())

@@ -62,6 +62,35 @@ def _build() -> SimpleNamespace:
             "rtpu_lease_reclaims_total",
             "Idle leases returned early by grant-time cross-shard "
             "reclaim (a peer shard's lease request was starving)"),
+        # -- fleet operations (drain / rolling upgrades / elastic
+        # autoscaler): queue age is the autoscaler's primary scale-up
+        # signal, the draining gauge is the dashboard's drain indicator --
+        lease_queue_age=Gauge(
+            "rtpu_lease_queue_age_seconds",
+            "Age of the oldest pending lease request queued at the "
+            "raylet, per resource shape",
+            tag_keys=("node", "shape")),
+        node_draining=Gauge(
+            "rtpu_node_draining",
+            "1 while this raylet is fenced for a graceful drain "
+            "(no new lease grants), else 0",
+            tag_keys=("node",)),
+        drains_completed=Counter(
+            "rtpu_drains_total",
+            "Graceful node drains completed, by outcome (clean = all "
+            "leases returned in time; timeout = stragglers killed)",
+            tag_keys=("node", "outcome")),
+        drain_latency=Histogram(
+            "rtpu_drain_seconds",
+            "Fence-to-empty drain latency (in-flight leases returned "
+            "or killed at the deadline)",
+            boundaries=_LATENCY_BOUNDARIES,
+            tag_keys=("node",)),
+        autoscale_decisions=Counter(
+            "rtpu_autoscale_decisions_total",
+            "Elastic-autoscaler actions taken (launch / drain_in / "
+            "terminate)",
+            tag_keys=("action",)),
         raylet_leases_granted=Counter(
             "rtpu_raylet_leases_granted_total",
             "Worker leases granted by the raylet",

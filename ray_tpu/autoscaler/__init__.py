@@ -7,8 +7,10 @@ _private/fake_multi_node/node_provider.py)."""
 from .autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
 from .cluster_config import (ClusterHandle, load_cluster_config, up,
                              validate_cluster_config)
+from .elastic import ElasticAutoscaler, ElasticConfig, ElasticMonitor
 from .node_provider import FakeNodeProvider, NodeProvider
 
-__all__ = ["Autoscaler", "AutoscalerConfig", "FakeNodeProvider",
+__all__ = ["Autoscaler", "AutoscalerConfig", "ElasticAutoscaler",
+           "ElasticConfig", "ElasticMonitor", "FakeNodeProvider",
            "NodeProvider", "NodeTypeConfig", "ClusterHandle",
            "load_cluster_config", "validate_cluster_config", "up"]

@@ -1,0 +1,307 @@
+"""Elastic autoscaler: a metric-driven closed loop with hysteresis
+(reference: autoscaler/v2 reconciler driven by the GCS autoscaler state
+manager — gcs_autoscaler_state_manager.h — instead of raw config).
+
+Where the config-driven :class:`~ray_tpu.autoscaler.Autoscaler` bin-packs
+the *instantaneous* demand snapshot, this reconciler closes the loop on
+flight-recorder signals and refuses to act on transients:
+
+- **scale-up** fires only after unmet demand has persisted AND the
+  oldest pending lease is older than ``queue_age_up_s`` for
+  ``up_delay_s`` straight (a deep-but-fresh queue is a burst the
+  current fleet will absorb; an OLD queue is starvation),
+- **scale-in** fires only after a node has been fully idle (all
+  resources free, zero queued leases) for ``down_delay_s`` — and it is
+  routed through the GCS **drain** path (fence → actor migration →
+  in-flight leases finish) before the provider terminates the machine,
+  so shrink never kills running work,
+- errors back off jittered-exponentially (the shared
+  ``backoff.Backoff`` primitive, rtpulint rule L009) instead of
+  spinning the failure at tick rate.
+
+Both delays are the hysteresis that keeps an oscillating queue from
+flapping the fleet — unit-tested in tests/test_fleet_ops.py against a
+synthetic oscillating signal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from .autoscaler import NodeTypeConfig
+from .._internal.config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ElasticConfig:
+    node_types: List[NodeTypeConfig]
+    # Hysteresis thresholds. None = the CONFIG defaults (overridable
+    # per-cluster via RTPU_AUTOSCALE_*).
+    queue_age_up_s: Optional[float] = None
+    up_delay_s: Optional[float] = None
+    down_delay_s: Optional[float] = None
+    max_launch_batch: int = 4
+    drain_timeout_s: Optional[float] = None
+
+    def resolved(self) -> "ElasticConfig":
+        return dataclasses.replace(
+            self,
+            queue_age_up_s=self.queue_age_up_s
+            if self.queue_age_up_s is not None
+            else CONFIG.autoscale_queue_age_up_s,
+            up_delay_s=self.up_delay_s if self.up_delay_s is not None
+            else CONFIG.autoscale_up_delay_s,
+            down_delay_s=self.down_delay_s
+            if self.down_delay_s is not None
+            else CONFIG.autoscale_down_delay_s,
+            drain_timeout_s=self.drain_timeout_s
+            if self.drain_timeout_s is not None
+            else CONFIG.drain_timeout_s)
+
+
+class ElasticAutoscaler:
+    """One reconcile() pass reads the GCS autoscaler state (ONE rpc:
+    per-node capacity/queue/drain rows + aggregate unmet demand),
+    updates the hysteresis clocks, and acts only on signals that have
+    persisted. Scale-in drains before it terminates."""
+
+    def __init__(self, config: ElasticConfig, provider, gcs_client,
+                 clock=time.monotonic):
+        self.config = config.resolved()
+        self.provider = provider
+        self.gcs = gcs_client
+        self._clock = clock
+        # Hysteresis state: when the scale-up signal first turned on,
+        # and per-node when full idleness began.
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Dict[str, float] = {}
+        self.num_launches = 0
+        self.num_drains = 0
+        self.num_terminations = 0
+
+    # -- signals -----------------------------------------------------------
+
+    @staticmethod
+    def _unmet_demand(state: Dict[str, Any]) -> List[Dict[str, float]]:
+        """Demand not satisfiable by capacity already free on live,
+        non-draining nodes (draining capacity is leaving — counting it
+        would starve the scale-up exactly when a drain needs cover)."""
+        free = [dict(n.get("available", {}))
+                for n in state["nodes"].values()
+                if not n.get("draining")]
+        unmet = []
+        for demand in [dict(d) for d in state.get("task_demand", ())] + \
+                [dict(b) for b in state.get("pg_demand", ())]:
+            placed = False
+            for cap in free:
+                if all(cap.get(k, 0.0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(demand)
+        return unmet
+
+    # -- one pass ----------------------------------------------------------
+
+    def reconcile(self) -> Dict[str, int]:
+        state = self.gcs.call_sync("get_autoscaler_state")
+        now = self._clock()
+        cfg = self.config
+        unmet = self._unmet_demand(state)
+        max_age = max((n.get("queue_age_s", 0.0)
+                       for n in state["nodes"].values()), default=0.0)
+        counts = self._count_by_type()
+
+        # ---- scale-up with hysteresis -------------------------------
+        launched = 0
+        pressure = bool(unmet) and max_age >= cfg.queue_age_up_s
+        if pressure:
+            if self._pressure_since is None:
+                self._pressure_since = now
+            if now - self._pressure_since >= cfg.up_delay_s:
+                launched = self._launch_for(unmet, counts)
+                if launched:
+                    # One action per persisted signal: the clock re-arms
+                    # so the NEXT launch again needs a persisted signal
+                    # (the new capacity needs time to register).
+                    self._pressure_since = None
+        else:
+            self._pressure_since = None
+
+        # ---- scale-in with hysteresis, via drain --------------------
+        drained = self._scale_in(state, counts, has_unmet=bool(unmet),
+                                 now=now)
+        return {"launched": launched, "drained": drained,
+                "unmet": len(unmet), "max_queue_age_s": max_age}
+
+    def _count_by_type(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for info in self.provider.non_terminated_instances().values():
+            counts[info["node_type"]] = counts.get(info["node_type"], 0) + 1
+        return counts
+
+    def _launch_for(self, unmet: List[Dict[str, float]],
+                    counts: Dict[str, int]) -> int:
+        from .._internal.runtime_metrics import runtime_metrics
+        launched = 0
+        pending_caps: List[Dict[str, float]] = []
+        for demand in unmet:
+            placed = False
+            for cap in pending_caps:
+                if all(cap.get(k, 0.0) >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            fitting = [
+                nt for nt in self.config.node_types
+                if all(nt.resources.get(k, 0.0) >= v
+                       for k, v in demand.items())
+                and counts.get(nt.name, 0) < nt.max_workers]
+            if not fitting:
+                logger.warning("elastic autoscaler: demand %s "
+                               "unsatisfiable under max_workers", demand)
+                continue
+            if launched >= self.config.max_launch_batch:
+                break
+            nt = min(fitting, key=lambda t: sum(t.resources.values()))
+            logger.info("elastic autoscaler: launching %s (unmet=%s)",
+                        nt.name, demand)
+            self.provider.launch(nt.name, dict(nt.resources),
+                                 dict(nt.labels))
+            runtime_metrics().autoscale_decisions.inc(
+                tags={"action": "launch"})
+            counts[nt.name] = counts.get(nt.name, 0) + 1
+            self.num_launches += 1
+            launched += 1
+            cap = dict(nt.resources)
+            for k, v in demand.items():
+                cap[k] = cap.get(k, 0.0) - v
+            pending_caps.append(cap)
+        return launched
+
+    def _scale_in(self, state: Dict[str, Any], counts: Dict[str, int],
+                  has_unmet: bool, now: float) -> int:
+        from .._internal.runtime_metrics import runtime_metrics
+        cfg = self.config
+        instances = self.provider.non_terminated_instances()
+        node_to_instance = {info.get("node_id"): iid
+                            for iid, info in instances.items()}
+        drained = 0
+        live = set()
+        for node_id, info in state["nodes"].items():
+            live.add(node_id)
+            if info.get("is_head") or info.get("draining"):
+                continue
+            total = info.get("total", {})
+            avail = info.get("available", {})
+            busy = any(avail.get(k, 0.0) < v for k, v in total.items()) \
+                or info.get("queue_depth", 0) > 0
+            if busy or has_unmet:
+                # Pending demand anywhere holds ALL idle nodes: tearing
+                # down capacity the queue is about to need just trades
+                # a queue wait for a cold boot.
+                self._idle_since.pop(node_id, None)
+                continue
+            since = self._idle_since.setdefault(node_id, now)
+            if now - since < cfg.down_delay_s:
+                continue
+            instance_id = node_to_instance.get(node_id)
+            if instance_id is None:
+                labeled = (info.get("labels") or {}).get(
+                    "rtpu-instance-id")
+                if labeled in instances:
+                    instance_id = labeled
+            if instance_id is None:
+                continue  # not ours (e.g. a manually added node)
+            node_type = instances[instance_id]["node_type"]
+            nt = next((t for t in self.config.node_types
+                       if t.name == node_type), None)
+            if nt is not None and \
+                    counts.get(node_type, 0) <= nt.min_workers:
+                continue
+            logger.info("elastic autoscaler: draining idle node %s "
+                        "(%s) before terminate", node_id[:12], node_type)
+            report = self.gcs.call_sync(
+                "drain_node", node_id=node_id,
+                timeout_s=cfg.drain_timeout_s, exit_process=False,
+                timeout=cfg.drain_timeout_s + 60)
+            runtime_metrics().autoscale_decisions.inc(
+                tags={"action": "drain_in"})
+            self.num_drains += 1
+            if isinstance(report, dict) and report.get("error"):
+                # Failed drain must not strand a FENCED node that is
+                # never terminated, never retried (the draining flag
+                # excludes it from every future reconcile), and never
+                # takes work again: lower the fence so the node returns
+                # to service, and keep the idle clock so a later pass
+                # retries the scale-in.
+                logger.warning("drain of %s failed (%s); canceling the "
+                               "fence and keeping the node",
+                               node_id[:12], report["error"])
+                try:
+                    self.gcs.call_sync("drain_node", node_id=node_id,
+                                       cancel=True, timeout=30)
+                except Exception:  # noqa: BLE001 — best-effort unfence
+                    logger.warning("drain cancel of %s failed too",
+                                   node_id[:12], exc_info=True)
+                continue
+            self.provider.terminate(instance_id)
+            runtime_metrics().autoscale_decisions.inc(
+                tags={"action": "terminate"})
+            counts[node_type] = counts.get(node_type, 0) - 1
+            self._idle_since.pop(node_id, None)
+            self.num_terminations += 1
+            drained += 1
+        for node_id in list(self._idle_since):
+            if node_id not in live:
+                self._idle_since.pop(node_id, None)
+        return drained
+
+
+class ElasticMonitor:
+    """Background reconcile loop for the elastic autoscaler (the
+    metric-driven sibling of autoscaler.Monitor). Failing ticks back
+    off jittered-exponentially; healthy ticks run at ``interval_s``."""
+
+    def __init__(self, autoscaler: ElasticAutoscaler,
+                 interval_s: float = 1.0):
+        import threading
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rtpu-elastic-autoscaler")
+        from .._internal.threads import register_daemon_thread
+        register_daemon_thread(self._thread, joinable=False)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        from .._internal.backoff import Backoff
+        bo = None  # armed while reconciles fail (GCS failover window)
+        while not self._stop.is_set():
+            wait = self.interval_s
+            try:
+                self.autoscaler.reconcile()
+                bo = None
+            except Exception:  # noqa: BLE001 — keep reconciling
+                logger.exception("elastic reconcile failed")
+                if bo is None:
+                    bo = Backoff(base_s=self.interval_s, max_s=30.0)
+                wait = bo.next_delay() or 30.0
+            self._stop.wait(wait)

@@ -641,6 +641,73 @@ def cmd_job(args):
         print("stopped" if manager.stop_job(args.id) else "not running")
 
 
+def cmd_drain(args):
+    """Graceful node drain (`cli drain <node-prefix>`): fence new lease
+    grants, migrate actors, wait for in-flight work up to the deadline
+    — the rolling-upgrade / scale-in primitive."""
+    _connect(args)
+    from ray_tpu.util.state import api as state_api
+    report = state_api.drain_node(
+        args.node, timeout_s=args.timeout, exit_process=args.exit,
+        cancel=args.cancel)
+    print(json.dumps(report, indent=1, default=str))
+    if report.get("error"):
+        raise SystemExit(1)
+
+
+def cmd_rollout(args):
+    """Rolling restart (`cli rollout`): drain every non-head node one
+    by one (each with exit_process so a supervised raylet restarts
+    clean) and wait for a replacement to register before moving on —
+    the cluster keeps serving throughout. The head restart itself rides
+    the PR-10 incarnation reconnect-and-replay path (restart the GCS
+    process out-of-band; clients re-register automatically)."""
+    _connect(args)
+    import time as _time
+    from ray_tpu.util.state import api as state_api
+    targets = [n for n in state_api.list_nodes()
+               if n["state"] == "ALIVE" and not n["is_head"]]
+    if not targets:
+        print("no non-head nodes to roll")
+        return
+    for i, node in enumerate(targets):
+        nid = node["node_id"]
+        print(f"[{i + 1}/{len(targets)}] draining node {nid[:12]} "
+              f"(index {node['node_index']})...")
+        report = state_api.drain_node(nid, timeout_s=args.timeout,
+                                      exit_process=True)
+        print(f"  drained in {report.get('elapsed_s', 0):.2f}s, "
+              f"migrated {len(report.get('migrated_actors', ()))} "
+              f"actor(s), "
+              f"{len(report.get('stragglers_killed', ()))} straggler(s)"
+              + (f"; ERROR {report['error']}"
+                 if report.get("error") else ""))
+        if report.get("error"):
+            raise SystemExit(1)
+        if args.no_wait:
+            continue
+        # Wait for the replacement (a supervisor restarting the raylet)
+        # to re-register before rolling the next node, so capacity never
+        # dips by more than one node.
+        before = {n["node_id"] for n in targets} | \
+            {n["node_id"] for n in state_api.list_nodes()}
+        deadline = _time.monotonic() + args.rejoin_timeout
+        while _time.monotonic() < deadline:
+            fresh = [n for n in state_api.list_nodes()
+                     if n["state"] == "ALIVE"
+                     and n["node_id"] not in before]
+            if fresh:
+                print(f"  replacement node {fresh[0]['node_id'][:12]} "
+                      "registered")
+                break
+            _time.sleep(0.5)
+        else:
+            print("  (no replacement registered within "
+                  f"{args.rejoin_timeout:.0f}s — is a supervisor "
+                  "restarting the raylet? continuing)")
+    print("rollout complete")
+
+
 def cmd_chaos(args):
     """Fault-injection drills (the deterministic chaos harness,
     _internal/chaos.py): arm/disarm RPC fault rules cluster-wide, show
@@ -652,6 +719,7 @@ def cmd_chaos(args):
         from ray_tpu._internal.chaos import REGISTRY
         out = {"gcs": info, "local_rules": [vars(r) for r in
                                            REGISTRY.active_rules()],
+               "local_schedule": REGISTRY.schedule_status(),
                "local_hits": REGISTRY.hit_counts()}
         if args.json:
             print(json.dumps(out, indent=2, default=str))
@@ -664,17 +732,26 @@ def cmd_chaos(args):
                 print(f"  rule {r['pattern']}:{r['action']}"
                       f":{r['prob']}" + (f":{r['param']}"
                                          if r["param"] else ""))
+            for s in out["local_schedule"]:
+                state = "ACTIVE" if s["active"] else "armed"
+                print(f"  sched t+{s['at_s']:g}s {s['pattern']}:"
+                      f"{s['action']}:{s['prob']:g}"
+                      + (f":{s['param']:g}" if s["param"] else "")
+                      + f"  [{state}, t={s['elapsed_s']:g}s]")
             for site, n in out["local_hits"].items():
                 print(f"  hits {site}: {n}")
     elif args.action == "set":
-        if not args.spec:
+        if not args.spec and not args.schedule:
             raise SystemExit("chaos set requires --spec "
-                             "(method:action:prob[:param],...)")
-        rows = state_api.set_chaos(spec=args.spec, seed=args.seed)
+                             "(method:action:prob[:param],...) and/or "
+                             "--schedule (at_s:method:action:prob"
+                             "[:param],...)")
+        rows = state_api.set_chaos(spec=args.spec, seed=args.seed,
+                                   schedule=args.schedule or None)
         for row in rows:
             print(row)
     elif args.action == "clear":
-        for row in state_api.set_chaos(spec="", seed=0):
+        for row in state_api.set_chaos(spec="", seed=0, schedule=""):
             print(row)
     elif args.action == "kill-gcs":
         info = state_api.gcs_info()
@@ -867,6 +944,38 @@ def main(argv=None):
     p.set_defaults(fn=cmd_job)
 
     p = sub.add_parser(
+        "drain",
+        help="gracefully drain one node: fence leases, migrate actors, "
+             "wait for in-flight work")
+    p.add_argument("node", help="node id (hex prefix)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="drain deadline seconds (default: "
+                        "CONFIG.drain_timeout_s); stragglers past it "
+                        "are postmortem-tag killed")
+    p.add_argument("--exit", action="store_true",
+                   help="ask a standalone raylet to exit clean after "
+                        "the drain (rolling-restart primitive)")
+    p.add_argument("--cancel", action="store_true",
+                   help="lower the fence instead (abort a drain)")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_drain)
+
+    p = sub.add_parser(
+        "rollout",
+        help="rolling restart: drain+exit every non-head node one by "
+             "one, waiting for replacements between nodes")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="per-node drain deadline seconds")
+    p.add_argument("--rejoin-timeout", type=float, default=60.0,
+                   help="how long to wait for a replacement node "
+                        "before rolling the next one")
+    p.add_argument("--no-wait", action="store_true",
+                   help="do not wait for replacements (drain-only "
+                        "sweep)")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_rollout)
+
+    p = sub.add_parser(
         "chaos",
         help="fault-injection drills: arm/disarm rpc chaos rules, "
              "show failover status, kill the GCS or a worker")
@@ -877,6 +986,11 @@ def main(argv=None):
     p.add_argument("--spec", default="",
                    help="method:action:prob[:param],... with actions "
                         "drop_req|drop_resp|delay|dup")
+    p.add_argument("--schedule", default="",
+                   help="time-scheduled script at_s:method:action:prob"
+                        "[:param],... — each entry arms at_s seconds "
+                        "after set; a later entry for the same "
+                        "method:action replaces the earlier one")
     p.add_argument("--seed", type=int, default=0,
                    help="chaos RNG seed (0 = process-random)")
     p.add_argument("--pid", type=int, default=0,

@@ -213,6 +213,11 @@ class DashboardHead:
             # Failover surface: incarnation, persist mode, WAL bytes,
             # failover count, persist-failure streak.
             return self._json(st.gcs_info())
+        if path == "/api/autoscaler":
+            # Autoscaler state manager view: per-node capacity /
+            # pending-lease queue depth + age / drain flag and the
+            # aggregate unmet demand the elastic reconciler acts on.
+            return self._json(st.autoscaler_state())
         if path == "/api/traces":
             return self._json(st.list_traces(
                 limit=int(query.get("limit", 100))))

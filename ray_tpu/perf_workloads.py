@@ -3,7 +3,16 @@
 HTTP throughput + latency, Data pipeline throughput, and LLM engine
 decode throughput. The Train number comes from bench.py on the TPU.
 
-Run: python -m ray_tpu.perf_workloads [--which all|ppo|impala|serve|data|llm]
+Plus the **standing chaos soak** (``--which soak`` / ``bench_soak``):
+sustained serve+train-style load on a real multi-process cluster
+(external killable GCS, subprocess raylets) under a seeded fault
+script — scheduled transport chaos, a full rolling restart of every
+worker raylet through the graceful-drain path, and a ``kill -9`` of
+the GCS mid-rollout — gated on SLOs (zero lost/doubled tasks, zero
+dropped serve streams, bounded p99 during failover) and recorded as a
+JSON artifact like the mesh-sustained bench.
+
+Run: python -m ray_tpu.perf_workloads [--which all|ppo|impala|serve|data|llm|soak]
 Prints one JSON line per metric.
 """
 
@@ -11,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import time
 
 
@@ -142,13 +152,300 @@ def bench_llm(steps: int = 40):
                  "HBM-bound decode is the TPU bench")
 
 
+class _SoakStreamer:
+    """Streaming serve deployment for the soak: each request opens a
+    token stream the proxy relays as chunked ndjson (the LLM serving
+    wire shape), paced so streams span the fault windows."""
+
+    def __init__(self, chunks: int = 40, delay_s: float = 0.15):
+        self._chunks = chunks
+        self._delay = delay_s
+        self._streams = {}
+        self._opened = 0
+
+    def __call__(self, request):
+        import uuid
+        sid = uuid.uuid4().hex
+        self._streams[sid] = 0
+        self._opened += 1
+        return {"__rtpu_stream__": sid}
+
+    def stream_next(self, sid):
+        sent = self._streams.get(sid)
+        if sent is None or sent >= self._chunks:
+            self._streams.pop(sid, None)
+            return {"tokens": [], "done": True}
+        time.sleep(self._delay)
+        self._streams[sid] = sent + 1
+        return {"tokens": [f"tok-{sent}"],
+                "done": sent + 1 >= self._chunks}
+
+    def cancel_stream(self, sid):
+        self._streams.pop(sid, None)
+        return True
+
+
+def _soak_stream_once(host: str, port: int, path: str,
+                      expected_chunks: int, timeout_s: float):
+    """One streaming client request over a raw socket; returns the
+    number of token lines received (== expected on a healthy stream)."""
+    import socket
+
+    s = socket.create_connection((host, int(port)), timeout=timeout_s)
+    try:
+        s.sendall((f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                   "Content-Length: 2\r\n"
+                   "Connection: close\r\n\r\n{}").encode())
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    finally:
+        s.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    if b"200" not in head.split(b"\r\n", 1)[0]:
+        raise RuntimeError(f"stream request failed: {head[:120]!r}")
+    tokens = body.count(b"tok-")
+    return tokens
+
+
+def bench_soak(duration_s: float = 45.0, seed: int = 1234,
+               nodes: int = 2, wave_size: int = 24,
+               stream_chunks: int = 30, stream_delay_s: float = 0.15,
+               drain_timeout_s: float = 20.0,
+               slo_wave_p99_s: float = 20.0,
+               slo_recover_s: float = 10.0,
+               chaos_schedule: str = "",
+               artifact_path: str = "") -> dict:
+    """Standing chaos soak (ROADMAP item 5): sustained mixed load —
+    a train-style task flood with an exactly-once audit trail plus
+    streaming serve clients — on a multi-process cluster while a
+    SEEDED fault script runs: scheduled transport chaos from t=0, a
+    graceful rolling restart of every worker raylet, and one GCS
+    ``kill -9`` mid-rollout. Gates: zero lost / zero doubled tasks,
+    zero dropped streams, wave p99 under ``slo_wave_p99_s`` and
+    post-fault recovery under ``slo_recover_s``. Returns (and
+    optionally writes) the artifact dict."""
+    import os
+    import tempfile
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.state import api as state_api
+
+    tmpdir = tempfile.mkdtemp(prefix="rtpu-soak-")
+    persist = os.path.join(tmpdir, "gcs.db")
+    audit = os.path.join(tmpdir, "audit.log")
+    # The control-plane fault script: duplicate heartbeat replies from
+    # t=0 (idempotency drill), a heartbeat delay window opening at 25%
+    # of the run and closing at 60% — deterministic under the seed.
+    schedule = chaos_schedule or (
+        f"0:heartbeat:dup:0.05,"
+        f"{duration_s * 0.25:g}:heartbeat:delay:0.3:0.05,"
+        f"{duration_s * 0.6:g}:heartbeat:delay:0")
+    cluster = Cluster(
+        head_node_args={"num_cpus": 2},
+        external_gcs=True, gcs_persist_path=persist,
+        gcs_env={"RTPU_GCS_PERSIST": "wal",
+                 "RTPU_CHAOS_SCHEDULE": schedule,
+                 "RTPU_CHAOS_SEED": str(seed)})
+    result = {"duration_s": duration_s, "seed": seed,
+              "chaos_schedule": schedule, "nodes": nodes}
+    try:
+        cluster.connect()
+        worker_nodes = [cluster.add_node(num_cpus=2)
+                        for _ in range(nodes)]
+        cluster.wait_for_nodes()
+        # Arm the same schedule on the driver+raylet side registries.
+        state_api.set_chaos(seed=seed, schedule=schedule)
+
+        @ray_tpu.remote(num_cpus=1)
+        def bump(i, marker):
+            fd = os.open(marker, os.O_WRONLY | os.O_APPEND | os.O_CREAT,
+                         0o644)
+            try:
+                os.write(fd, f"{i}\n".encode())
+            finally:
+                os.close(fd)
+            time.sleep(0.02)
+            return i
+
+        from ray_tpu.util.scheduling_strategies import \
+            NodeAffinitySchedulingStrategy
+        head_id = next(n["node_id"] for n in state_api.list_nodes()
+                       if n["is_head"])
+        streamer = serve.deployment(_SoakStreamer).options(
+            ray_actor_options={
+                "num_cpus": 0,
+                # replicas live on the head (off the rolled nodes): a
+                # drained replica's in-flight streams are killed by
+                # contract — the zero-dropped-streams SLO exercises the
+                # proxy + GCS failover planes under the rollout
+                "scheduling_strategy": NodeAffinitySchedulingStrategy(
+                    head_id, soft=True)})
+        serve.run(streamer.bind(stream_chunks, stream_delay_s),
+                  name="soak", route_prefix="/soak")
+        addr = serve.api.get_http_address()
+        host, port = addr.rsplit("://", 1)[-1].rsplit(":", 1)
+
+        stop = threading.Event()
+        wave_lat: list = []        # (t_rel, wall_s, n_tasks)
+        task_errors: list = []
+        submitted = []
+        streams: list = []         # (t_rel, chunks_received, error)
+        t0 = time.monotonic()
+
+        def task_thread():
+            base = 0
+            while not stop.is_set():
+                idx = list(range(base, base + wave_size))
+                base += wave_size
+                submitted.extend(idx)
+                w0 = time.monotonic()
+                try:
+                    got = ray_tpu.get(
+                        [bump.remote(i, audit) for i in idx],
+                        timeout=180)
+                    assert got == idx
+                except Exception as e:  # noqa: BLE001 — gated below
+                    task_errors.append(repr(e))
+                    return
+                wave_lat.append((round(w0 - t0, 2),
+                                 time.monotonic() - w0, len(idx)))
+
+        def stream_thread():
+            while not stop.is_set():
+                s0 = time.monotonic()
+                try:
+                    n = _soak_stream_once(
+                        host, port, "/soak", stream_chunks,
+                        timeout_s=duration_s + 120)
+                    streams.append((round(s0 - t0, 2), n, None))
+                except Exception as e:  # noqa: BLE001 — gated below
+                    streams.append((round(s0 - t0, 2), 0, repr(e)))
+                    return
+
+        from ray_tpu._internal.threads import spawn_daemon
+        threads = [spawn_daemon(task_thread, name="rtpu-soak-tasks"),
+                   spawn_daemon(stream_thread, name="rtpu-soak-stream")]
+
+        # --- the fault script (wall-clock scheduled, seed-stable) ----
+        faults = []
+
+        def _at(frac, name, fn):
+            target = t0 + duration_s * frac
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            f0 = time.monotonic()
+            fn()
+            faults.append({"at_s": round(f0 - t0, 2), "fault": name,
+                           "took_s": round(time.monotonic() - f0, 2)})
+
+        replacements = {}
+
+        def _roll(i):
+            def _do():
+                replacements[i] = cluster.restart_node(
+                    worker_nodes[i], timeout_s=drain_timeout_s)
+            return _do
+
+        def _gcs_bounce():
+            cluster.kill_gcs()
+            time.sleep(0.5)
+            cluster.restart_gcs()
+
+        _at(0.15, "rolling_restart_node_0", _roll(0))
+        _at(0.40, "gcs_kill9_restart", _gcs_bounce)
+        if nodes > 1:
+            _at(0.60, "rolling_restart_node_1", _roll(1))
+        # run out the clock under load, then stop and settle
+        remaining = t0 + duration_s - time.monotonic()
+        if remaining > 0:
+            time.sleep(remaining)
+        last_fault_rel = faults[-1]["at_s"] + faults[-1]["took_s"]
+        stop.set()
+        for t in threads:
+            t.join(timeout=duration_s + 180)
+
+        # --- SLO gates ----------------------------------------------
+        with open(audit) as f:
+            executed = sorted(int(x) for x in f.read().split())
+        lost = sorted(set(submitted) - set(executed))
+        doubled = sorted(x for x in set(executed)
+                         if executed.count(x) > 1)
+        lats = sorted(w for (_t, w, _n) in wave_lat)
+        p99 = lats[int(len(lats) * 0.99)] if lats else float("inf")
+        p50 = lats[len(lats) // 2] if lats else float("inf")
+        # time-to-recover: the gap from the last fault to the FIRST
+        # wave completion after it (NOT that wave's own latency — a
+        # long wedge followed by fast waves must not pass this gate)
+        recover = [t_rel + w - last_fault_rel
+                   for (t_rel, w, _n) in wave_lat
+                   if t_rel + w >= last_fault_rel]
+        recover_s = min(recover) if recover else None
+        dropped_streams = [s for s in streams
+                           if s[2] is not None or s[1] != stream_chunks]
+        result.update({
+            "waves": len(wave_lat),
+            "tasks_submitted": len(submitted),
+            "tasks_lost": lost[:10],
+            "tasks_doubled": doubled[:10],
+            "task_errors": task_errors,
+            "wave_p50_s": round(p50, 3),
+            "wave_p99_s": round(p99, 3),
+            "streams_completed": len(streams),
+            "streams_dropped": dropped_streams[:10],
+            "recover_wave_s": round(recover_s, 3)
+            if recover_s is not None else None,
+            "faults": faults,
+            "slo": {
+                "zero_lost": not lost and not task_errors,
+                "zero_doubled": not doubled,
+                "zero_dropped_streams": bool(streams)
+                and not dropped_streams,
+                "p99_bounded": p99 <= slo_wave_p99_s,
+                "recovered": recover_s is not None
+                and recover_s <= slo_recover_s,
+            },
+        })
+        result["passed"] = all(result["slo"].values())
+        _report("soak_wave_p99_s", p99, "s")
+        _report("soak_streams_completed", len(streams), "streams")
+        _report("soak_passed", 1.0 if result["passed"] else 0.0, "bool",
+                slo=result["slo"])
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            logging.getLogger(__name__).debug(
+                "serve shutdown after soak failed", exc_info=True)
+    finally:
+        cluster.shutdown()
+    if artifact_path:
+        with open(artifact_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--which", default="all")
+    parser.add_argument("--soak-seconds", type=float, default=45.0)
+    parser.add_argument("--soak-seed", type=int, default=1234)
+    parser.add_argument("--soak-artifact", default="")
     args = parser.parse_args()
+    which = args.which
+    if which == "soak":
+        # builds its OWN multi-process cluster (killable external GCS)
+        bench_soak(duration_s=args.soak_seconds, seed=args.soak_seed,
+                   artifact_path=args.soak_artifact)
+        return
     import ray_tpu
     ray_tpu.init(num_cpus=8, object_store_memory=1 << 30)
-    which = args.which
     try:
         if which in ("all", "ppo"):
             bench_ppo()

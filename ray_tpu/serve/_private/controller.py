@@ -120,6 +120,7 @@ class ServeController:
             def _create():
                 # Blocking GCS round-trips — keep off the event loop.
                 import ray_tpu
+                from ..api import head_node_strategy
                 from .common import CONTROLLER_NAME
                 from .proxy import ProxyActor
                 try:
@@ -129,10 +130,19 @@ class ServeController:
                     controller = ray_tpu.get_actor(CONTROLLER_NAME,
                                                    namespace=SERVE_NAMESPACE)
                     proxy_cls = ray_tpu.remote(ProxyActor)
-                    return proxy_cls.options(
+                    options = dict(
                         name=PROXY_NAME, namespace=SERVE_NAMESPACE,
                         lifetime="detached", num_cpus=0, get_if_exists=True,
-                        max_concurrency=1000).remote(controller, host, port)
+                        max_concurrency=1000)
+                    strategy = head_node_strategy()
+                    if strategy is not None:
+                        # the proxy owns the PUBLISHED http address:
+                        # it must live on the head, not wherever the
+                        # hybrid policy spills under load (a worker
+                        # drain would migrate it mid-connection)
+                        options["scheduling_strategy"] = strategy
+                    return proxy_cls.options(**options).remote(
+                        controller, host, port)
             loop = asyncio.get_running_loop()
             self._proxy_handle = await loop.run_in_executor(None, _create)
             # Block until the HTTP server is listening.
@@ -149,6 +159,7 @@ class ServeController:
 
             def _create():
                 import ray_tpu
+                from ..api import head_node_strategy
                 from .common import CONTROLLER_NAME
                 from .grpc_proxy import GrpcProxyActor
                 try:
@@ -158,12 +169,16 @@ class ServeController:
                     controller = ray_tpu.get_actor(
                         CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
                     proxy_cls = ray_tpu.remote(GrpcProxyActor)
-                    return proxy_cls.options(
+                    options = dict(
                         name="SERVE_GRPC_PROXY",
                         namespace=SERVE_NAMESPACE, lifetime="detached",
                         num_cpus=0, get_if_exists=True,
-                        max_concurrency=1000).remote(
-                            controller, host, port)
+                        max_concurrency=1000)
+                    strategy = head_node_strategy()
+                    if strategy is not None:
+                        options["scheduling_strategy"] = strategy
+                    return proxy_cls.options(**options).remote(
+                        controller, host, port)
             loop = asyncio.get_running_loop()
             self._grpc_proxy_handle = await loop.run_in_executor(
                 None, _create)
@@ -279,6 +294,8 @@ class ServeController:
             if not auto:
                 continue
             total = 0.0
+            queued = 0.0
+            ttfts = []
             probes = []
             replicas = [r for r in state.replicas.values()
                         if r.state == "RUNNING" and r.handle is not None]
@@ -294,4 +311,14 @@ class ServeController:
                     if isinstance(res, dict):
                         state.last_metrics[r.tag] = res
                         total += res.get("ongoing", 0)
-            state.autoscale_tick(total)
+                        # Flight-recorder signals a replica's engine
+                        # reports (queue depth / TTFT) drive the
+                        # metric-based scale path when the autoscaling
+                        # config targets them.
+                        queued += res.get("queued", 0) or 0
+                        if res.get("ttft_s"):
+                            ttfts.append(res["ttft_s"])
+            ttfts.sort()
+            state.autoscale_tick(
+                total, total_queued=queued,
+                p50_ttft_s=ttfts[len(ttfts) // 2] if ttfts else None)

@@ -256,16 +256,26 @@ class DeploymentState:
 
     # -- autoscaling -------------------------------------------------------
 
-    def autoscale_tick(self, total_ongoing: float):
-        """Adjust target_num_replicas from the ongoing-request metric
+    def autoscale_tick(self, total_ongoing: float,
+                       total_queued: float = 0.0,
+                       p50_ttft_s: Optional[float] = None):
+        """Adjust target_num_replicas from the replica metrics
         (reference: serve/autoscaling_policy.py:13
-        _calculate_desired_num_replicas + autoscaling_state.py delays)."""
+        _calculate_desired_num_replicas + autoscaling_state.py delays).
+        Beyond the ongoing-request formula the desired count folds in
+        engine queue depth and TTFT when the autoscaling config sets
+        targets for them (the flight-recorder closed loop); the
+        upscale/downscale delays below are the hysteresis that keeps an
+        oscillating signal from flapping the replica set."""
         config = self.target_config
         auto = config.autoscaling_config if config else None
         if not auto or self.deleting:
             return
         from ..autoscaling_policy import calculate_desired_num_replicas
-        desired = calculate_desired_num_replicas(auto, total_ongoing)
+        desired = calculate_desired_num_replicas(
+            auto, total_ongoing, total_queued=total_queued,
+            p50_ttft_s=p50_ttft_s,
+            current_num_replicas=self.target_num_replicas)
         now = time.monotonic()
         if desired > self.target_num_replicas:
             self._autoscale_below_since = None

@@ -69,10 +69,13 @@ class GrpcProxyActor:
         return (self._host, self._port)
 
     async def _poll_routes(self):
+        from ray_tpu._internal.backoff import Backoff
+        bo = None  # armed while the controller is restarting/migrating
         while True:
             try:
                 version, snapshot = await self._controller.\
                     listen_for_change.remote("routes", self._routes_version)
+                bo = None
                 if snapshot is not None:
                     self._routes_version = version
                     routes, kinds = {}, {}
@@ -90,7 +93,9 @@ class GrpcProxyActor:
                     self._routers = {k: v for k, v in self._routers.items()
                                      if k in live}
             except Exception:  # noqa: BLE001 — controller restarting
-                await asyncio.sleep(0.5)
+                if bo is None:
+                    bo = Backoff(base_s=0.1, max_s=2.0)
+                await bo.async_sleep()
 
     def _router_for(self, key: str) -> PowerOfTwoChoicesRouter:
         router = self._routers.get(key)

@@ -163,7 +163,23 @@ class Replica:
     # -- control plane ----------------------------------------------------
 
     def get_metrics(self) -> Dict[str, Any]:
-        return {"ongoing": self._ongoing, "served": self._total_served}
+        out = {"ongoing": self._ongoing, "served": self._total_served}
+        # Flight-recorder closed loop: a callable wrapping an engine can
+        # expose autoscaling_metrics() -> {"queued": int, "ttft_s":
+        # float, ...} (e.g. LLM engine queue depth / median TTFT / KV
+        # occupancy); the controller folds them into the metric-driven
+        # replica autoscaler. Best-effort — a broken hook must not take
+        # health checks down with it.
+        hook = getattr(self._callable, "autoscaling_metrics", None)
+        if hook is not None:
+            try:
+                extra = hook()
+                if isinstance(extra, dict):
+                    out.update(extra)
+            except Exception:  # noqa: BLE001 — autoscaling is advisory
+                logger.debug("autoscaling_metrics() hook failed",
+                             exc_info=True)
+        return out
 
     async def check_health(self) -> bool:
         probe = getattr(self._callable, "check_health", None)

@@ -107,6 +107,28 @@ def deployment(_func_or_class=None, **options):
 # controller lifecycle
 # ---------------------------------------------------------------------------
 
+def head_node_strategy():
+    """Soft node-affinity to the head node for serve's singleton system
+    actors (controller, proxies). The reference pins them to the head
+    too: a proxy carries the published HTTP address and the controller
+    the cluster's serve state — letting the hybrid scheduler place them
+    on an arbitrary worker node means a routine worker drain/rollout
+    would migrate them (new proxy port = dropped client connections).
+    Soft: a head-less or full head still gets a placement."""
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import \
+        NodeAffinitySchedulingStrategy
+    try:
+        head = next((n for n in ray_tpu.nodes()
+                     if n.get("is_head") and n.get("state") == "ALIVE"),
+                    None)
+    except Exception:  # noqa: BLE001 — placement hint only
+        head = None
+    if head is None:
+        return None
+    return NodeAffinitySchedulingStrategy(head["node_id"], soft=True)
+
+
 def start(http_options: Optional[HTTPOptions] = None, detached: bool = True):
     """Ensure the Serve controller (and HTTP proxy) is running
     (reference: serve/api.py start / _private/client ServeControllerClient)."""
@@ -118,10 +140,15 @@ def start(http_options: Optional[HTTPOptions] = None, detached: bool = True):
         pass
     from ._private.controller import ServeController
     controller_cls = ray_tpu.remote(ServeController)
-    controller = controller_cls.options(
+    options = dict(
         name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
         lifetime="detached", num_cpus=0, max_concurrency=1000,
-        get_if_exists=True).remote(http.host, http.port)
+        get_if_exists=True)
+    strategy = head_node_strategy()
+    if strategy is not None:
+        options["scheduling_strategy"] = strategy
+    controller = controller_cls.options(**options).remote(
+        http.host, http.port)
     ray_tpu.get(controller.ping.remote(), timeout=60)
     return controller
 

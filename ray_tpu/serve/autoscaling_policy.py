@@ -1,20 +1,50 @@
 """Autoscaling policy: desired replica count from request metrics
 (reference: serve/autoscaling_policy.py:13 _calculate_desired_num_replicas
 — target ongoing-requests-per-replica formula; delays live in
-autoscaling_state.py and here in DeploymentState.autoscale_tick)."""
+autoscaling_state.py and here in DeploymentState.autoscale_tick).
+
+Beyond the reference's ongoing-requests formula, the desired count can
+be driven by flight-recorder signals the replicas report (the elastic
+closed loop): engine **queue depth** (`target_queue_depth`) and **TTFT**
+(`target_ttft_s`) — whichever signal asks for the most replicas wins,
+so a deployment saturated on queueing scales even while each replica's
+ongoing count sits at its cap."""
 
 from __future__ import annotations
 
 import math
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 
-def calculate_desired_num_replicas(autoscaling_config: Dict[str, Any],
-                                   total_ongoing_requests: float) -> int:
-    """ceil(total_ongoing / target_per_replica), clamped to [min, max]."""
+def calculate_desired_num_replicas(
+        autoscaling_config: Dict[str, Any],
+        total_ongoing_requests: float,
+        total_queued: float = 0.0,
+        p50_ttft_s: Optional[float] = None,
+        current_num_replicas: int = 0) -> int:
+    """max over the configured signals, clamped to [min, max]:
+
+    - ``ceil(total_ongoing / target_ongoing_requests)`` (the reference
+      formula; a nonpositive target returns max_replicas),
+    - ``ceil(total_queued / target_queue_depth)`` when
+      ``target_queue_depth`` is configured — queued work is demand the
+      running replicas have not absorbed,
+    - ``current * ttft / target_ttft_s`` when ``target_ttft_s`` is
+      configured and the reported median TTFT exceeds it — latency
+      over target means the current fleet is undersized roughly in
+      proportion.
+    """
     target = autoscaling_config["target_ongoing_requests"]
     if target <= 0:
         return autoscaling_config["max_replicas"]
     desired = math.ceil(total_ongoing_requests / target)
+    target_queue = autoscaling_config.get("target_queue_depth")
+    if target_queue and target_queue > 0 and total_queued > 0:
+        desired = max(desired, math.ceil(total_queued / target_queue))
+    target_ttft = autoscaling_config.get("target_ttft_s")
+    if target_ttft and target_ttft > 0 and p50_ttft_s \
+            and p50_ttft_s > target_ttft and current_num_replicas > 0:
+        desired = max(desired, math.ceil(
+            current_num_replicas * p50_ttft_s / target_ttft))
     return min(max(desired, autoscaling_config["min_replicas"]),
                autoscaling_config["max_replicas"])

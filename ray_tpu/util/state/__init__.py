@@ -1,19 +1,23 @@
 """State API (reference: python/ray/util/state — api.py list_actors/
 list_tasks/list_objects/list_nodes/..., common.py state schemas)."""
 
-from .api import (accel_summary, get_actor, get_logs, get_node,
-                  get_trace, list_actors, list_events, list_jobs,
-                  list_logs, list_nodes, list_object_refs, list_objects,
+from .api import (accel_summary, autoscaler_state, drain_node,
+                  gcs_info, get_actor, get_logs, get_node, get_trace,
+                  list_actors, list_events, list_jobs, list_logs,
+                  list_nodes, list_object_refs, list_objects,
                   list_placement_groups, list_tasks, list_traces,
                   list_workers, memory_summary, profile_cluster,
-                  profiling_status, shard_summary, stack_cluster,
-                  summarize_tasks, tail_logs, timeline)
+                  profiling_status, set_chaos, shard_summary,
+                  stack_cluster, summarize_tasks, tail_logs, timeline)
 
 __all__ = [
-    "accel_summary", "get_actor", "get_logs", "get_node", "get_trace",
+    "accel_summary", "autoscaler_state", "drain_node", "gcs_info",
+    "get_actor",
+    "get_logs", "get_node", "get_trace",
     "list_actors", "list_events", "list_jobs", "list_logs", "list_nodes",
     "list_object_refs", "list_objects", "list_placement_groups",
     "list_tasks", "list_traces", "list_workers", "memory_summary",
-    "profile_cluster", "profiling_status", "shard_summary",
-    "stack_cluster", "summarize_tasks", "tail_logs", "timeline",
+    "profile_cluster", "profiling_status", "set_chaos",
+    "shard_summary", "stack_cluster", "summarize_tasks", "tail_logs",
+    "timeline",
 ]

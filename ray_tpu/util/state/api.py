@@ -60,6 +60,7 @@ def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
             "resources_available": live.get("available", {}),
             "labels": node.get("labels", {}),
             "is_head": node.get("is_head", False),
+            "draining": live.get("draining", False),
         })
     return out
 
@@ -1098,13 +1099,45 @@ def gcs_info() -> Dict[str, Any]:
     return _gcs().call_sync("gcs_info")
 
 
-def set_chaos(spec: str = "", seed: int = 0) -> List[Dict[str, Any]]:
-    """Arm (or, with an empty spec, disarm) the fault-injection registry
-    on the GCS and every live raylet. Returns one row per process.
-    Workers pick rules up through their own CONFIG env; this call covers
-    the control plane, which is where the chaos harness aims."""
+def drain_node(node_id: str, timeout_s: Optional[float] = None,
+               exit_process: bool = False,
+               cancel: bool = False) -> Dict[str, Any]:
+    """GCS-coordinated graceful drain of one node (`cli drain` / the
+    elastic autoscaler's scale-in path): fence new lease grants,
+    migrate its actors (restart budget untouched), wait for in-flight
+    leases up to ``timeout_s``, postmortem-tag stragglers. A node-id
+    PREFIX is accepted (resolved against the alive node table);
+    ``exit_process`` additionally makes a standalone raylet exit clean
+    (the rolling-restart primitive); ``cancel`` lowers the fence."""
+    from ..._internal.config import CONFIG
+    matches = [n for n in _live_nodes()
+               if n["node_id"].startswith(node_id)]
+    if len(matches) != 1:
+        return {"error": f"node prefix {node_id!r} matched "
+                         f"{len(matches)} alive nodes"}
+    budget = timeout_s if timeout_s is not None else CONFIG.drain_timeout_s
+    return _gcs().call_sync(
+        "drain_node", node_id=matches[0]["node_id"], timeout_s=budget,
+        exit_process=exit_process, cancel=cancel, timeout=budget + 60)
+
+
+def autoscaler_state() -> Dict[str, Any]:
+    """The GCS autoscaler state manager's view: per-node capacity /
+    pending-lease queue depth + age / drain flag, plus aggregate unmet
+    demand (the elastic reconciler's input, also on `/api/autoscaler`)."""
+    return _gcs().call_sync("get_autoscaler_state")
+
+
+def set_chaos(spec: str = "", seed: int = 0,
+              schedule: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Arm (or, with an empty spec+schedule, disarm) the fault-injection
+    registry on the GCS and every live raylet — static rules and/or a
+    time-scheduled script. Returns one row per process. Workers pick
+    rules up through their own CONFIG env; this call covers the control
+    plane, which is where the chaos harness aims."""
     rows = []
-    reply = _gcs().call_sync("set_chaos", spec=spec, seed=seed)
+    reply = _gcs().call_sync("set_chaos", spec=spec, seed=seed,
+                             schedule=schedule)
     rows.append(dict(reply, component="gcs"))
     from ..._internal.core_worker import get_core_worker
     worker = get_core_worker()
@@ -1112,7 +1145,8 @@ def set_chaos(spec: str = "", seed: int = 0) -> List[Dict[str, Any]]:
     def _one(node):
         return worker.run_sync(
             worker.clients.get(tuple(node["address"])).call(
-                "set_chaos", spec=spec, seed=seed, timeout=10), timeout=15)
+                "set_chaos", spec=spec, seed=seed, schedule=schedule,
+                timeout=10), timeout=15)
 
     for node, result, error in _fanout(_live_nodes(), _one):
         row = {"component": "raylet", "node_id": node["node_id"]}
