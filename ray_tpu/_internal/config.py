@@ -255,6 +255,16 @@ _DEFAULTS: Dict[str, Any] = {
     "lease_reclaim_delay_s": 0.1,
     # --- train ---
     "train_health_check_interval_s": 1.0,
+    # GSPMD trainer: ZeRO-1 cross-replica sharded weight updates
+    # (reduce-scatter grads, shard-local Adam on the 1/W optimizer
+    # slice, allgather the param delta). RTPU_TRAIN_ZERO1=0 is the
+    # replicated-update A/B arm (full optimizer state on every
+    # replica, allreduce grads).
+    "train_zero1": True,
+    # MPMD pipeline: microbatches per GPipe round (bubble fraction is
+    # (S-1)/(S-1+M) on parallel hardware; more microbatches = smaller
+    # bubble, more in-flight activation memory).
+    "train_pipeline_microbatches": 4,
     # --- A/B kill switches (every switch lives here so a typo'd
     # RTPU_* spelling is caught by rtpulint rule L003 instead of
     # silently doing nothing) ---

@@ -25,7 +25,8 @@ SANITIZED_TEST_MODULES = ("test_actor_storm", "test_push_recovery",
                           "test_flat_codec", "test_profiling",
                           "test_owner_shards", "test_log_plane",
                           "test_gcs_failover", "test_collective_ring",
-                          "test_collective_backend", "test_fleet_ops")
+                          "test_collective_backend", "test_fleet_ops",
+                          "test_train_gspmd")
 
 _env_armed = False
 _ever_armed = False

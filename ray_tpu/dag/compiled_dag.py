@@ -190,11 +190,27 @@ class CompiledDAG:
 
     def execute(self, *input_value) -> Any:
         """One synchronous step: feed the input, return the output(s)."""
+        self.feed(*input_value)
+        return self.drain()
+
+    def feed(self, *input_value) -> None:
+        """Write one input WITHOUT waiting for its output — the
+        pipelined half of execute(). Keeping several feeds in flight
+        lets chained actors overlap (stage s works on item t while
+        stage s+1 works on item t-1 — the MPMD microbatch schedule).
+        Channels are single-slot, so feed blocks once the graph and the
+        slots are full: callers must drain() concurrently past a depth
+        of ~2x the chain length or the feed/drain pair deadlocks."""
         if self._torn_down:
             raise RuntimeError("DAG has been torn down")
         value = input_value[0] if len(input_value) == 1 else input_value
         for path in self._input_paths:
             self._chan_by_path(path).put(value, timeout=self._timeout)
+
+    def drain(self) -> Any:
+        """Read one output (FIFO order of the feeds)."""
+        if self._torn_down:
+            raise RuntimeError("DAG has been torn down")
         outs = [self._chan_by_path(p).get(timeout=self._timeout)
                 for p in self._output_paths]
         from ..experimental.channel import DagTaskError

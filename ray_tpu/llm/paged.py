@@ -530,10 +530,12 @@ class PagedLLMEngine:
                     if akey in self._prefix_lru:
                         self._prefix_lru.move_to_end(akey)
                 self._prefix_hits += 1
+                llm_metrics().prefix_hits.inc(tags=_TAGS)
                 break
         else:
             if n_full:
                 self._prefix_misses += 1
+                llm_metrics().prefix_misses.inc(tags=_TAGS)
         n_pages = self._pages_needed(request)
         new_ids = []
         for _ in range(n_pages - len(shared)):
@@ -607,6 +609,8 @@ class PagedLLMEngine:
             if pages:
                 for page in pages:
                     self.pool.decref(page)
+        llm_metrics().prefix_entries.set(len(self._prefix_lru),
+                                         tags=_GAUGE_TAGS)
 
     def _emit_token(self, seq: _Seq, token: int):
         callback = getattr(seq.request, "_token_callback", None)

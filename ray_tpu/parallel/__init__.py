@@ -1,10 +1,15 @@
-from .mesh import (DEFAULT_LOGICAL_AXIS_RULES, MeshConfig, named_sharding,
-                   params_shardings, shard_logical, unbox)
-from .spmd import (TrainState, create_train_state, default_optimizer,
-                   make_train_step)
+from .mesh import (DEFAULT_LOGICAL_AXIS_RULES, MeshConfig, dp_rules,
+                   named_sharding, params_shardings, shard_logical, unbox)
+from .spmd import (TrainState, Zero1Hyper, Zero1State, create_train_state,
+                   create_zero1_state, default_optimizer, make_grad_step,
+                   make_train_step, make_zero1_apply_step,
+                   make_zero1_train_step, opt_state_bytes_per_device)
 
 __all__ = [
     "MeshConfig", "DEFAULT_LOGICAL_AXIS_RULES", "named_sharding",
-    "shard_logical", "params_shardings", "unbox", "TrainState",
+    "shard_logical", "params_shardings", "unbox", "dp_rules", "TrainState",
     "create_train_state", "make_train_step", "default_optimizer",
+    "Zero1Hyper", "Zero1State", "create_zero1_state",
+    "make_zero1_train_step", "make_zero1_apply_step", "make_grad_step",
+    "opt_state_bytes_per_device",
 ]

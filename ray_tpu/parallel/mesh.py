@@ -215,6 +215,30 @@ class MeshConfig:
         return Topology.from_mesh_config(self, world_size)
 
 
+def dp_rules(dp_axes: Sequence[str],
+             base: Optional[Sequence[Tuple[str, object]]] = None
+             ) -> Dict[str, object]:
+    """Logical-axis rules for a PURE data-parallel layout over
+    `dp_axes` (the ZeRO-1 sharded-update requirement: params replicated
+    over the update axes). Batch-like logical axes map onto the dp
+    axes; any other rule that would shard a tensor over one of them is
+    dropped to replicated."""
+    dp = tuple(dp_axes)
+    dp_set = set(dp)
+    out: Dict[str, object] = {}
+    for name, target in (base if base is not None
+                         else DEFAULT_LOGICAL_AXIS_RULES):
+        if name in ("batch", "activation_batch"):
+            out[name] = dp if len(dp) > 1 else dp[0]
+            continue
+        targets = target if isinstance(target, tuple) else (target,)
+        if any(t in dp_set for t in targets if t is not None):
+            out[name] = None
+        else:
+            out[name] = target
+    return out
+
+
 def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
                          rules: Dict[str, object]) -> P:
     """Map ('batch','seq','embed') -> PartitionSpec(('data','fsdp'),...)"""

@@ -4,6 +4,9 @@ from .collectives import (allreduce_gradients, barrier,
 from .config import (CheckpointConfig, FailureConfig, RunConfig,
                      ScalingConfig)
 from .context import get_checkpoint, get_context, get_dataset_shard, report
+from .gspmd import (GSPMDTrainSpec, gspmd_train_loop,
+                    run_single_process_baseline)
+from .pipeline_mpmd import MPMDPipeline, PipelineStage
 from .result import Result
 from .torch import TorchConfig, TorchTrainer
 from .trainer import JaxTrainer
@@ -14,5 +17,6 @@ __all__ = [
     "CheckpointConfig", "Checkpoint", "Result", "report", "get_checkpoint",
     "get_context", "get_dataset_shard", "barrier",
     "broadcast_from_rank_zero", "allreduce_gradients", "save_pytree",
-    "load_pytree",
+    "load_pytree", "GSPMDTrainSpec", "gspmd_train_loop",
+    "run_single_process_baseline", "MPMDPipeline", "PipelineStage",
 ]

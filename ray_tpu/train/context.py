@@ -16,7 +16,8 @@ class TrainContext:
     def __init__(self, rank: int, world_size: int, node_rank: int,
                  controller_handle, run_name: str,
                  resume_checkpoint: Optional[Checkpoint] = None,
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 mesh_spec: Optional[Dict[str, Any]] = None):
         self.rank = rank
         self.world_size = world_size
         self.node_rank = node_rank
@@ -25,6 +26,10 @@ class TrainContext:
         self.resume_checkpoint = resume_checkpoint
         self.dataset_shards = dataset_shards or {}
         self.report_index = 0
+        # {"mesh_config": MeshConfig, "num_slices": n} from
+        # ScalingConfig — the GSPMD trainer's device-mesh declaration.
+        self.mesh_spec = mesh_spec or {}
+        self._mesh = None
 
     # -- reference API ----------------------------------------------------
 
@@ -42,6 +47,31 @@ class TrainContext:
 
     def get_experiment_name(self) -> str:
         return self.run_name
+
+    # -- GSPMD mesh -------------------------------------------------------
+
+    def mesh_config(self):
+        """The validated `parallel.MeshConfig` built by
+        ScalingConfig.mesh_config() at submit time, or None for
+        rank-Python loops."""
+        return self.mesh_spec.get("mesh_config")
+
+    def get_mesh(self, devices=None):
+        """Build (once) and return this worker's device mesh from the
+        scaling config's mesh_axes/dcn_axes/num_slices declaration.
+        Raises if the trainer was not given mesh_axes."""
+        if self._mesh is not None and devices is None:
+            return self._mesh
+        config = self.mesh_config()
+        if config is None:
+            raise RuntimeError(
+                "no mesh declared; pass mesh_axes= in ScalingConfig to "
+                "run a GSPMD train loop")
+        mesh = config.build(devices,
+                            num_slices=self.mesh_spec.get("num_slices"))
+        if devices is None:
+            self._mesh = mesh
+        return mesh
 
 
 def set_train_context(ctx: Optional[TrainContext]):

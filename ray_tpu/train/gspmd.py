@@ -1,0 +1,540 @@
+"""GSPMD train loops: JaxTrainer over a real device mesh.
+
+The multi-chip training plane (ROADMAP item 1). `ScalingConfig.mesh_axes`
+declares a hybrid device mesh (data/fsdp/tensor axes; `dcn_axes` across
+slices); each train worker builds it over its addressable devices and
+runs ONE jitted program per step with cross-replica **sharded weight
+updates** (ZeRO-1, arxiv 2004.13336 — `parallel.spmd.make_zero1_train_step`:
+reduce-scatter grads, shard-local Adam on the 1/W optimizer shard,
+allgather the param delta). Two schedules:
+
+- **gspmd** (world_size == 1): the whole mesh lives in one worker; every
+  collective — including the cross-slice DCN hop — is GSPMD-inserted
+  inside the jitted step.
+- **two-level** (world_size > 1): each worker is one slice. The backward
+  and the intra-slice combine run in-program over the slice's local
+  (ICI) mesh; the cross-slice gradient combine rides the HOST plane
+  through `train.allreduce_gradients`'s selected backend (hierarchical
+  schedule + optional block-int8 DCN quantization — the topology-aware
+  collectives from PR 12), then the ZeRO-1 apply step updates shard-
+  locally. Rank 0's final report carries the backend's per-link byte
+  ledger (`collective_bytes`).
+
+Every arm reports the PR-7 step telemetry from day one: step_time_s /
+tokens / step_flops keys per report (the controller folds them into
+`rtpu_step_time_seconds{kind="train"}` / MFU / goodput), plus a local
+fold (`mfu`, `goodput`) in the final report so the numbers survive into
+`Result.metrics` even without scraping."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from .context import get_context, report
+
+
+@dataclasses.dataclass
+class GSPMDTrainSpec:
+    """What to train, declaratively enough to ship to workers.
+
+    model_fn() -> flax module; loss_fn(model, params, batch) -> scalar;
+    batch_fn(step, rank, world) -> host batch pytree (the GLOBAL batch
+    for the gspmd schedule, rank's slice-local shard for two-level —
+    leading dims must divide by the update axes' product).
+    """
+    model_fn: Callable[[], Any]
+    loss_fn: Callable[[Any, Any, Any], Any]
+    batch_fn: Callable[[int, int, int], Any]
+    steps: int = 4
+    seed: int = 0
+    hyper: Any = None                      # Zero1Hyper; default below
+    zero1: bool = True                     # sharded updates (A/B:
+    #                                        CONFIG.train_zero1 gates too)
+    update_axes: Tuple[str, ...] = ("data", "fsdp")
+    tokens_per_step: int = 0
+    flops_per_step: float = 0.0
+    collective_group: Optional[str] = None  # two-level group name
+    report_every: int = 1
+    # auto: world==1 -> whole-mesh gspmd, world>1 -> two_level.
+    # "dp": the rank-Python data-parallel BASELINE — single-device
+    # backward per rank, host allreduce, replicated optimizer (what the
+    # GSPMD/pipeline arms are measured against).
+    schedule: str = "auto"
+    # Override CONFIG.collective_quant in the workers for this run
+    # (e.g. "int8" = EQuARX block-int8 on the cross-slice DCN hop).
+    collective_quant: Optional[str] = None
+
+
+def _resolved_hyper(spec: GSPMDTrainSpec):
+    from ..parallel.spmd import Zero1Hyper
+    return spec.hyper if spec.hyper is not None else \
+        Zero1Hyper(learning_rate=1e-2)
+
+
+def _present_axes(mesh, axes: Sequence[str]) -> Tuple[str, ...]:
+    """Validate the requested update axes against the mesh. Meshes from
+    MeshConfig.build carry every named axis (size-1 included — those
+    contribute factor 1 to the ZeRO-1 shard count W, which is correct);
+    a hand-built Mesh missing one is a config error, not a silent skip."""
+    missing = [a for a in axes if a not in mesh.shape]
+    if missing:
+        raise ValueError(f"update_axes {missing} not present in mesh "
+                         f"axes {tuple(mesh.shape)}")
+    return tuple(axes)
+
+
+def _replicated_tx(hyper):
+    """The optax twin of the ZeRO-1 shard-local AdamW (the parity
+    reference and the replicated-update A/B arm)."""
+    import optax
+    chain = []
+    if hyper.clip_norm:
+        chain.append(optax.clip_by_global_norm(hyper.clip_norm))
+    chain.append(optax.adamw(
+        hyper.learning_rate, b1=hyper.b1, b2=hyper.b2, eps=hyper.eps,
+        weight_decay=hyper.weight_decay))
+    return optax.chain(*chain)
+
+
+def _telemetry_report(rank: int, step: int, loss: float,
+                      timer, spec: GSPMDTrainSpec,
+                      extra: Optional[Dict[str, Any]] = None):
+    """Per-step report with the accel-plane keys the controller folds
+    (step_time_s/tokens/step_flops/device_kind)."""
+    metrics: Dict[str, Any] = {"step": step, "loss": loss}
+    if timer is not None and timer.result is not None:
+        res = timer.result
+        metrics.update(
+            step_time_s=res["wall_s"],
+            device_time_s=res["device_s"],
+            tokens=spec.tokens_per_step,
+            step_flops=spec.flops_per_step,
+            device_kind=_device_kind(),
+            mfu=res["mfu"], tokens_per_s=res["tokens_per_s"])
+    if extra:
+        metrics.update(extra)
+    if rank == 0 or step == spec.steps - 1:
+        report(metrics)
+    return metrics
+
+
+def _device_kind() -> str:
+    import jax
+    return getattr(jax.devices()[0], "device_kind", "cpu")
+
+
+def _final_fold(metrics: Dict[str, Any], losses, t_start: float,
+                spec: GSPMDTrainSpec) -> Dict[str, Any]:
+    """The run-level fold rank 0 ships home: losses, steady step time,
+    and this process's accel-plane goodput split."""
+    from .._internal import accel
+    steps = [row for row in accel.step_summary() if row["kind"] == "train"]
+    fold = dict(metrics)
+    fold["losses"] = [float(x) for x in losses]
+    fold["loss"] = fold["losses"][-1] if losses else None
+    fold["wall_s"] = time.perf_counter() - t_start
+    if steps:
+        row = steps[0]
+        fold["goodput"] = {
+            "compile_s": row["compile_s"], "device_s": row["device_s"],
+            "host_s": row["host_s"]}
+        fold["mean_step_s"] = row["mean_step_s"]
+        if row.get("mfu"):
+            fold["mfu"] = row["mfu"]
+    return fold
+
+
+# ---------------------------------------------------------------------------
+# schedule 1: whole-mesh GSPMD (one worker owns every device)
+# ---------------------------------------------------------------------------
+
+def _run_gspmd(spec: GSPMDTrainSpec) -> Dict[str, Any]:
+    import jax
+
+    from .._internal import accel
+    from .._internal.config import CONFIG
+    from ..parallel.mesh import dp_rules
+    from ..parallel.spmd import (TrainState, create_train_state,
+                                 create_zero1_state, make_train_step,
+                                 make_zero1_train_step)
+
+    ctx = get_context()
+    accel.ensure_installed()
+    if ctx.world_size != 1:
+        raise ValueError(
+            f"schedule='gspmd' is the whole-mesh single-worker program "
+            f"(one worker owns every device) but the group has "
+            f"{ctx.world_size} workers; use 'two_level' (one worker per "
+            f"slice) or num_workers=1")
+    mesh = ctx.get_mesh()
+    mesh_config = ctx.mesh_config()
+    hyper = _resolved_hyper(spec)
+    model = spec.model_fn()
+    zero1 = bool(spec.zero1) and bool(CONFIG.train_zero1)
+    axes = _present_axes(mesh, spec.update_axes)
+    rng = jax.random.PRNGKey(spec.seed)
+    sample = spec.batch_fn(0, 0, 1)
+
+    def loss_fn(params, batch):
+        return spec.loss_fn(model, params, batch)
+
+    t_start = time.perf_counter()
+    if zero1:
+        rules = dp_rules(axes, base=mesh_config.logical_axis_rules)
+        state = create_zero1_state(rng, model, _first_leaf(sample), mesh,
+                                   hyper, rules=rules, axes=axes)
+        step = make_zero1_train_step(loss_fn, mesh, state, axes=axes)
+    else:
+        rules = mesh_config.rules_dict()
+        state = create_train_state(rng, model, _first_leaf(sample), mesh,
+                                   _replicated_tx(hyper), rules)
+        step = make_train_step(
+            loss_fn, mesh, rules, batch_axes=("batch", None), state=state)
+
+    losses = []
+    metrics: Dict[str, Any] = {}
+    with mesh:
+        for i in range(spec.steps):
+            batch = _to_device(spec.batch_fn(i, 0, 1))
+            with accel.StepTimer(
+                    "train", tokens=spec.tokens_per_step,
+                    flops=spec.flops_per_step) as timer:
+                with timer.device():
+                    state, step_metrics = step(state, batch)
+                    loss = float(jax.device_get(step_metrics["loss"]))
+            losses.append(loss)
+            metrics = _telemetry_report(ctx.rank, i, loss, timer, spec,
+                                        extra={"schedule": "gspmd",
+                                               "zero1": zero1})
+    final = _final_fold(metrics, losses, t_start, spec)
+    report(final)
+    return final
+
+
+# ---------------------------------------------------------------------------
+# schedule 2: two-level — in-program slice backward, host/DCN combine,
+# ZeRO-1 shard-local apply (the cross-slice path rides the selected
+# collective backend: hier + optional int8 DCN)
+# ---------------------------------------------------------------------------
+
+def _run_two_level(spec: GSPMDTrainSpec) -> Dict[str, Any]:
+    import jax
+
+    from .._internal import accel
+    from .._internal.config import CONFIG
+    from ..parallel.mesh import MeshConfig, dp_rules
+    from ..parallel.spmd import (create_zero1_state, make_grad_step,
+                                 make_zero1_apply_step)
+    from ..util.collective import collective as col
+    from .collectives import allreduce_gradients, broadcast_from_rank_zero
+
+    ctx = get_context()
+    accel.ensure_installed()
+    world, rank = ctx.world_size, ctx.rank
+    zero1 = bool(spec.zero1) and bool(CONFIG.train_zero1)
+    mesh_config = ctx.mesh_config()
+    # This worker IS one slice: its local mesh keeps the ICI axes only
+    # (each dcn axis collapses to 1 — the hop it stood for is the host
+    # plane below).
+    sizes = _ici_sizes(mesh_config, world)
+    local_devices = jax.devices()[:max(1, _prod(sizes.values()))]
+    local_mesh = MeshConfig(**sizes).build(local_devices)
+    hyper = _resolved_hyper(spec)
+    model = spec.model_fn()
+    axes = _present_axes(local_mesh, spec.update_axes)
+    rules = dp_rules(axes, base=mesh_config.logical_axis_rules)
+    rng = jax.random.PRNGKey(spec.seed)
+    sample = spec.batch_fn(0, rank, world)
+
+    def loss_fn(params, batch):
+        return spec.loss_fn(model, params, batch)
+
+    # One collective group per run: every rank is one slice, so EVERY
+    # inter-rank hop is DCN-class — exactly what Topology.from_slices
+    # (one rank per slice) declares, and what the algorithm selector
+    # and the int8-DCN arm key on. A fresh name per attempt keeps a
+    # restarted group off stale mailboxes.
+    name0 = None
+    if rank == 0:
+        import os
+        name0 = spec.collective_group or \
+            f"gspmd-{ctx.run_name}-{os.getpid()}"
+    group_name = broadcast_from_rank_zero(name0, name="gspmd-group")
+    from ..util.collective.topology import Topology
+    _apply_quant_override(spec)
+    col.init_collective_group(
+        world, rank, group_name=group_name,
+        topology=Topology.from_slices(world, world))
+
+    import numpy as np
+
+    t_start = time.perf_counter()
+    losses = []
+    metrics: Dict[str, Any] = {}
+    algo = None
+    try:
+        if zero1:
+            state = create_zero1_state(rng, model, _first_leaf(sample),
+                                       local_mesh, hyper, rules=rules,
+                                       axes=axes)
+            apply_step = make_zero1_apply_step(local_mesh, state,
+                                               axes=axes)
+            params = state.params
+        else:
+            # the replicated-update A/B arm (RTPU_TRAIN_ZERO1=0 /
+            # spec.zero1=False): full optax moments on every rank
+            import optax
+
+            from ..parallel.mesh import unbox
+            tx = _replicated_tx(hyper)
+            params = unbox(model.init(rng, _first_leaf(sample))["params"])
+            opt_state = tx.init(params)
+
+            @jax.jit
+            def apply_fn(params, opt_state, grads):
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+        grad_step = make_grad_step(loss_fn, local_mesh, rules,
+                                   batch_axes=("batch", None))
+
+        with local_mesh:
+            for i in range(spec.steps):
+                batch = _to_device(spec.batch_fn(i, rank, world))
+                with accel.StepTimer(
+                        "train", tokens=spec.tokens_per_step,
+                        flops=spec.flops_per_step) as timer:
+                    with timer.device():
+                        loss_local, grads = grad_step(params, batch)
+                        loss_local = float(jax.device_get(loss_local))
+                        grads = jax.device_get(grads)
+                    if algo is None:
+                        algo = col.selected_algorithm(
+                            4 * _leaf_count(grads), group_name=group_name)
+                    # cross-slice hop: host plane, selected backend
+                    grads = allreduce_gradients(grads,
+                                                group_name=group_name)
+                    # global loss = mean of the slice-local (mean-type)
+                    # losses — 4 bytes per step next to the grad buffer
+                    loss = float(col.allreduce(
+                        np.float32(loss_local),
+                        group_name=group_name)) / world
+                    with timer.device():
+                        if zero1:
+                            state, _ = apply_step(state, grads)
+                            params = state.params
+                            jax.block_until_ready(state.m)
+                        else:
+                            params, opt_state = apply_fn(
+                                params, opt_state, grads)
+                            jax.block_until_ready(params)
+                losses.append(loss)
+                metrics = _telemetry_report(
+                    rank, i, loss, timer, spec,
+                    extra={"schedule": "two_level", "zero1": zero1,
+                           "loss_local": loss_local})
+        final = _final_fold(metrics, losses, t_start, spec)
+        final["collective_bytes"] = col.bytes_sent(group_name)
+        final["collective_algo"] = algo
+        if rank == 0:
+            report(final)
+    finally:
+        # a mid-loop failure (peer death, transport error) must not
+        # leak the group's mailboxes for the worker's lifetime
+        col.destroy_collective_group(group_name)
+    return final
+
+
+def _leaf_count(grads) -> int:
+    import numpy as np
+    import jax
+    return sum(int(np.asarray(l).size)
+               for l in jax.tree_util.tree_leaves(grads))
+
+
+def _apply_quant_override(spec: GSPMDTrainSpec):
+    """Per-run collective_quant override, applied in the WORKER process
+    (the backend reads CONFIG at allreduce time)."""
+    if spec.collective_quant is not None:
+        from .._internal.config import CONFIG
+        CONFIG.apply_system_config(
+            {"collective_quant": spec.collective_quant})
+
+
+def _ici_sizes(mesh_config, world: int) -> Dict[str, int]:
+    """The slice-local (ICI) axis sizes: the full mesh_axes declaration
+    with every DCN axis collapsed to 1. The dcn axes' product must
+    equal the worker count (one worker per slice)."""
+    from ..parallel.mesh import AXIS_ORDER
+    sizes = {a: getattr(mesh_config, a) for a in AXIS_ORDER}
+    if any(v == -1 for v in sizes.values()):
+        raise ValueError("two-level GSPMD needs fixed mesh_axes sizes "
+                         "(no -1 wildcard)")
+    dcn_prod = _prod([sizes[a] for a in mesh_config.dcn_axes])
+    if dcn_prod != world:
+        raise ValueError(
+            f"dcn axes {mesh_config.dcn_axes} have product {dcn_prod} "
+            f"but the group has {world} workers (one per slice)")
+    return {a: (1 if a in mesh_config.dcn_axes else s)
+            for a, s in sizes.items()}
+
+
+def _prod(values) -> int:
+    return int(math.prod(values)) if values else 1
+
+
+def _first_leaf(batch):
+    """The model's sample input: by convention the batch pytree's
+    'tokens'/'x' leaf (what model.init consumes)."""
+    if isinstance(batch, dict):
+        for key in ("tokens", "x", "inputs"):
+            if key in batch:
+                return batch[key]
+        return next(iter(batch.values()))
+    return batch
+
+
+def _to_device(batch):
+    import jax.numpy as jnp
+    import jax
+    return jax.tree_util.tree_map(jnp.asarray, batch)
+
+
+# ---------------------------------------------------------------------------
+# schedule 3: rank-Python DP — the measured-against BASELINE. One
+# device per rank, full replicated optimizer, a host allreduce + a
+# Python turnaround EVERY step (the costs the GSPMD schedules delete).
+# ---------------------------------------------------------------------------
+
+def _run_dp_python(spec: GSPMDTrainSpec) -> Dict[str, Any]:
+    import os
+
+    import jax
+    import numpy as np
+    import optax
+
+    from .._internal import accel
+    from ..parallel.mesh import unbox
+    from ..util.collective import collective as col
+    from .collectives import allreduce_gradients, broadcast_from_rank_zero
+
+    ctx = get_context()
+    accel.ensure_installed()
+    world, rank = ctx.world_size, ctx.rank
+    hyper = _resolved_hyper(spec)
+    model = spec.model_fn()
+    tx = _replicated_tx(hyper)
+    rng = jax.random.PRNGKey(spec.seed)
+    sample = _to_device(spec.batch_fn(0, rank, world))
+
+    name0 = f"dp-{ctx.run_name}-{os.getpid()}" if rank == 0 else None
+    group_name = broadcast_from_rank_zero(name0, name="dp-group")
+    # Same physical topology declaration as the GSPMD arms: the
+    # baseline's gradient allreduce also crosses slices, and its ledger
+    # should say so (one rank per slice -> every hop is DCN-class).
+    from ..util.collective.topology import Topology
+    col.init_collective_group(world, rank, group_name=group_name,
+                              topology=Topology.from_slices(world, world))
+
+    def loss_fn(params, batch):
+        return spec.loss_fn(model, params, batch)
+
+    t_start = time.perf_counter()
+    losses = []
+    metrics: Dict[str, Any] = {}
+    try:
+        params = unbox(model.init(rng, _first_leaf(sample))["params"])
+        opt_state = tx.init(params)
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+        @jax.jit
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        for i in range(spec.steps):
+            batch = _to_device(spec.batch_fn(i, rank, world))
+            with accel.StepTimer(
+                    "train", tokens=spec.tokens_per_step,
+                    flops=spec.flops_per_step) as timer:
+                with timer.device():
+                    loss_local, grads = grad_fn(params, batch)
+                    loss_local = float(jax.device_get(loss_local))
+                    grads = jax.device_get(grads)
+                grads = allreduce_gradients(grads, group_name=group_name)
+                loss = float(col.allreduce(
+                    np.float32(loss_local),
+                    group_name=group_name)) / world
+                with timer.device():
+                    params, opt_state = apply_fn(params, opt_state, grads)
+                    jax.block_until_ready(params)
+            losses.append(loss)
+            metrics = _telemetry_report(
+                rank, i, loss, timer, spec,
+                extra={"schedule": "dp_python", "zero1": False})
+        final = _final_fold(metrics, losses, t_start, spec)
+        final["collective_bytes"] = col.bytes_sent(group_name)
+        if rank == 0:
+            report(final)
+    finally:
+        col.destroy_collective_group(group_name)
+    return final
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def gspmd_train_loop(config: Dict[str, Any]) -> Dict[str, Any]:
+    """`train_loop_per_worker` for JaxTrainer: config = {"spec":
+    GSPMDTrainSpec}. `spec.schedule` picks the arm; "auto" maps the
+    group shape — one worker = whole-mesh GSPMD, many workers =
+    two-level with the host/DCN cross-slice hop."""
+    spec = config["spec"]
+    ctx = get_context()
+    schedule = spec.schedule
+    if schedule == "auto":
+        schedule = "gspmd" if ctx.world_size == 1 else "two_level"
+    if schedule == "gspmd":
+        return _run_gspmd(spec)
+    if schedule == "two_level":
+        return _run_two_level(spec)
+    if schedule == "dp":
+        return _run_dp_python(spec)
+    raise ValueError(f"unknown schedule {spec.schedule!r}")
+
+
+def run_single_process_baseline(spec: GSPMDTrainSpec) -> Dict[str, Any]:
+    """The loss-parity reference: the SAME model/seed/batches/optimizer
+    on one device, replicated optax AdamW, no mesh, no actors. Call it
+    on the driver; compare its per-step losses to the trainer's."""
+    import jax
+    import optax
+
+    model = spec.model_fn()
+    hyper = _resolved_hyper(spec)
+    tx = _replicated_tx(hyper)
+    rng = jax.random.PRNGKey(spec.seed)
+    sample = _to_device(spec.batch_fn(0, 0, 1))
+
+    from ..parallel.mesh import unbox
+    params = unbox(model.init(rng, _first_leaf(sample))["params"])
+    opt_state = tx.init(params)
+
+    def loss_fn(params, batch):
+        return spec.loss_fn(model, params, batch)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for i in range(spec.steps):
+        batch = _to_device(spec.batch_fn(i, 0, 1))
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(jax.device_get(loss)))
+    return {"losses": losses, "loss": losses[-1] if losses else None}

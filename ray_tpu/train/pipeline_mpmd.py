@@ -1,0 +1,397 @@
+"""MPMD pipeline parallelism: pipeline stages as actors in a compiled DAG.
+
+The in-program GPipe (`parallel.pipeline`) runs every stage on one mesh
+inside one XLA program — SPMD, stages advance in lockstep. This module
+is the MPMD twin (PAPERS: arxiv 2412.14374): each stage is a ray_tpu
+ACTOR owning its own devices and its own jitted programs; stages
+exchange activations as DEVICE OBJECTS, so only a ~200-byte descriptor
+crosses the compiled-DAG channel and the activation payload moves
+runtime-to-runtime (`jax.experimental.transfer` — ICI/DCN on TPU, never
+through the host object store: the zero-host-round-trip property the
+1 GiB actor→actor transfer path proved).
+
+Schedule: GPipe. The driver streams M microbatch forwards through the
+forward DAG (stages overlap — stage s works on microbatch t while stage
+s+1 works on t-1, the compiled channels carrying only descriptors),
+then M backwards through the reverse DAG (activation grads flow
+last→first as device objects; each stage accumulates its param grads),
+then applies shard-local AdamW on every stage concurrently. Stages
+timestamp their busy intervals with the shared CLOCK_MONOTONIC, so the
+driver can report a MEASURED bubble fraction next to the
+(S-1)/(S-1+M) theoretical one."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class PipelineStage:
+    """Actor: one pipeline stage.
+
+    stage_init(stage_index, num_stages) -> (apply_fn, params) where
+    apply_fn(params, x) -> y. The LAST stage also gets the loss:
+    loss_fn(y, targets) -> scalar. Backward recomputes the stage
+    forward (remat — GPipe stashes only stage INPUTS, 1F1B-grade
+    memory)."""
+
+    def __init__(self, stage_index: int, num_stages: int,
+                 stage_init: Callable, loss_fn: Optional[Callable],
+                 hyper_kwargs: Optional[Dict[str, Any]] = None):
+        import jax
+
+        from .._internal import accel
+        accel.ensure_installed()
+        self.stage_index = stage_index
+        self.num_stages = num_stages
+        self.is_first = stage_index == 0
+        self.is_last = stage_index == num_stages - 1
+        apply_fn, params = stage_init(stage_index, num_stages)
+        self.params = jax.tree_util.tree_map(jax.numpy.asarray, params)
+        self._apply = apply_fn
+        self._loss_fn = loss_fn
+        self._hyper = dict(hyper_kwargs or {})
+        # stashes: mb_index -> stage input (device array); refs we
+        # produced this round stay alive until apply() so consumers can
+        # finish their runtime-to-runtime pulls before the pin drops.
+        self._stash: Dict[int, Any] = {}
+        self._losses: Dict[int, float] = {}
+        self._grad_accum = None
+        self._opt_state = None
+        self._live_refs: List[Any] = []
+        self._step = 0
+        # telemetry
+        self.busy_s = 0.0
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
+        self.host_roundtrips = 0
+        self.device_pulls = 0
+        self._build_jits()
+
+    def _build_jits(self):
+        import jax
+
+        apply_fn, loss_fn = self._apply, self._loss_fn
+
+        @jax.jit
+        def fwd(params, x):
+            return apply_fn(params, x)
+
+        @jax.jit
+        def bwd_mid(params, x, g):
+            _, vjp = jax.vjp(apply_fn, params, x)
+            dparams, dx = vjp(g)
+            return dparams, dx
+
+        if self.is_last and loss_fn is not None:
+            @jax.jit
+            def bwd_last(params, x, targets):
+                def scalar(p, xx):
+                    return loss_fn(apply_fn(p, xx), targets)
+                loss, grads = jax.value_and_grad(
+                    scalar, argnums=(0, 1))(params, x)
+                return loss, grads[0], grads[1]
+            self._bwd_last = bwd_last
+
+        self._fwd = fwd
+        self._bwd_mid = bwd_mid
+
+    # -- activation transport ---------------------------------------------
+
+    def _resolve(self, value):
+        """Incoming activation: a device-object ref (descriptor on the
+        wire, payload pulled runtime-to-runtime) or raw host data (the
+        first stage's microbatch input — that is the data loader, not
+        an inter-stage activation)."""
+        import jax.numpy as jnp
+
+        import ray_tpu
+        from ..experimental.device_objects import (DeviceObjectDescriptor,
+                                                   resolve_control)
+        from .._internal.object_ref import ObjectRef
+        if isinstance(value, ObjectRef):
+            # one control-plane fetch per hop: resolve_control pulls
+            # straight from the descriptor (device_get would re-get)
+            control = ray_tpu.get(value)
+            if isinstance(control, DeviceObjectDescriptor):
+                self.device_pulls += 1
+                return resolve_control(control, value)
+            # producer spilled to host (HBM budget) — a host round trip
+            self.host_roundtrips += 1
+            return jnp.asarray(control)
+        return jnp.asarray(value)
+
+    def _ship(self, array):
+        from ..experimental.device_objects import device_put_ref
+        ref = device_put_ref(array)
+        self._live_refs.append(ref)
+        return ref
+
+    def _busy(self, t0: float):
+        t1 = time.monotonic()
+        self.busy_s += t1 - t0
+        if self.t_first is None:
+            self.t_first = t0
+        self.t_last = t1
+
+    # -- GPipe phases ------------------------------------------------------
+
+    def forward(self, packet):
+        """(mb_index, activation) -> same shape for the next stage; the
+        LAST stage only stashes (its forward runs once, fused into the
+        backward recompute) and returns (mb_index, None). Targets never
+        ride the forward channels — they arrive with the backward feed,
+        which goes straight to the last stage."""
+        mb_index, value = packet
+        t0 = time.monotonic()
+        x = self._resolve(value)
+        self._stash[mb_index] = x
+        if self.is_last:
+            # grads AND the loss come in the backward phase: bwd_last's
+            # value_and_grad is the single forward+backward this stage
+            # runs per microbatch
+            self._busy(t0)
+            return (mb_index, None)
+        y = self._fwd(self.params, x)
+        y.block_until_ready()
+        self._busy(t0)
+        return (mb_index, self._ship(y))
+
+    def backward(self, packet):
+        """Reverse phase. Last stage: packet = (mb_index, targets) —
+        seed from the stashed loss recompute. Others:
+        (mb_index, grad_ref)."""
+        import jax
+
+        t0 = time.monotonic()
+        mb_index = packet[0]
+        x = self._stash.pop(mb_index)
+        if self.is_last:
+            loss, dparams, dx = self._bwd_last(self.params, x, packet[1])
+            self._losses[mb_index] = float(jax.device_get(loss))
+        else:
+            g = self._resolve(packet[1])
+            dparams, dx = self._bwd_mid(self.params, x, g)
+        self._accumulate(dparams)
+        if self.is_first:
+            self._busy(t0)
+            return (mb_index, None)
+        dx.block_until_ready()
+        self._busy(t0)
+        return (mb_index, self._ship(dx))
+
+    def _accumulate(self, dparams):
+        import jax
+        if self._grad_accum is None:
+            self._grad_accum = dparams
+        else:
+            self._grad_accum = jax.tree_util.tree_map(
+                lambda a, b: a + b, self._grad_accum, dparams)
+
+    def apply(self, num_microbatches: int) -> Dict[str, Any]:
+        """End of round: AdamW on the mean accumulated grads; release
+        this round's activation pins."""
+        import jax
+        import optax
+
+        t0 = time.monotonic()
+        if self._opt_state is None:
+            self._tx = optax.adamw(self._hyper.get("learning_rate", 1e-2),
+                                   b1=self._hyper.get("b1", 0.9),
+                                   b2=self._hyper.get("b2", 0.999),
+                                   eps=self._hyper.get("eps", 1e-8))
+            self._opt_state = self._tx.init(self.params)
+        grads = jax.tree_util.tree_map(
+            lambda g: g / num_microbatches, self._grad_accum)
+        updates, self._opt_state = self._tx.update(
+            grads, self._opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        gnorm = float(optax.global_norm(grads))
+        losses = [self._losses[mb] for mb in sorted(self._losses)]
+        self._losses.clear()
+        self._grad_accum = None
+        self._stash.clear()
+        self._live_refs.clear()  # consumers are done: pins may drop
+        self._step += 1
+        self._busy(t0)
+        return {"stage": self.stage_index, "grad_norm": gnorm,
+                "step": self._step, "losses": losses}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "stage": self.stage_index,
+            "busy_s": self.busy_s,
+            "t_first": self.t_first,
+            "t_last": self.t_last,
+            "host_roundtrips": self.host_roundtrips,
+            "device_pulls": self.device_pulls,
+        }
+
+    def reset_window(self):
+        """Zero the busy window (measure steady-state rounds only)."""
+        self.busy_s = 0.0
+        self.t_first = self.t_last = None
+        return True
+
+    def get_params(self):
+        import numpy as np
+        import jax
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+
+class MPMDPipeline:
+    """Driver handle: builds the stage actors + the forward/backward
+    compiled DAGs and runs GPipe rounds.
+
+    stage_init(stage_index, num_stages) -> (apply_fn, params);
+    loss_fn(y, targets) -> scalar (used by the last stage)."""
+
+    def __init__(self, stage_init: Callable, num_stages: int,
+                 loss_fn: Callable,
+                 microbatches: Optional[int] = None,
+                 hyper_kwargs: Optional[Dict[str, Any]] = None,
+                 num_cpus: float = 0.25,
+                 channel_capacity: int = 1 << 20,
+                 timeout_s: float = 120.0):
+        import ray_tpu
+        from .._internal.config import CONFIG
+        from ..dag.nodes import InputNode
+
+        self.num_stages = num_stages
+        self.microbatches = int(microbatches or
+                                CONFIG.train_pipeline_microbatches)
+        stage_cls = ray_tpu.remote(PipelineStage)
+        self.stages = [
+            # max_concurrency: BOTH compiled DAGs (forward + backward)
+            # pin one exec loop each on every stage, and apply()/stats()
+            # control calls must still get a slot next to them.
+            stage_cls.options(num_cpus=num_cpus, max_concurrency=4).remote(
+                s, num_stages, stage_init, loss_fn, hyper_kwargs)
+            for s in range(num_stages)
+        ]
+        ray_tpu.get([s.stats.remote() for s in self.stages], timeout=120)
+
+        with InputNode() as inp:
+            node = self.stages[0].forward.bind(inp)
+            for s in range(1, num_stages):
+                node = self.stages[s].forward.bind(node)
+        self._fwd_dag = node.experimental_compile(
+            channel_capacity=channel_capacity, timeout_s=timeout_s)
+
+        with InputNode() as inp:
+            node = self.stages[-1].backward.bind(inp)
+            for s in range(num_stages - 2, -1, -1):
+                node = self.stages[s].backward.bind(node)
+        self._bwd_dag = node.experimental_compile(
+            channel_capacity=channel_capacity, timeout_s=timeout_s)
+        self._rounds = 0
+
+    # -- schedule ----------------------------------------------------------
+
+    def step(self, x, y) -> Dict[str, Any]:
+        """One GPipe round: split (x, y) into M microbatches, stream M
+        forwards (stages overlap through the DAG channels), stream M
+        backwards, apply. Returns the mean microbatch loss."""
+        import numpy as np
+
+        import ray_tpu
+
+        M, S = self.microbatches, self.num_stages
+        if len(x) % M:
+            raise ValueError(f"batch of {len(x)} not divisible into "
+                             f"{M} microbatches")
+        xs = np.split(np.asarray(x), M)
+        ys = np.split(np.asarray(y), M)
+
+        # forward wave — keep at most S+1 in flight: channels are
+        # single-slot, so deeper feeds without draining would deadlock
+        # against the full output slot.
+        in_flight = 0
+        for mb in range(M):
+            self._fwd_dag.feed((mb, xs[mb]))
+            in_flight += 1
+            if in_flight > S:
+                self._fwd_dag.drain()
+                in_flight -= 1
+        while in_flight:
+            self._fwd_dag.drain()
+            in_flight -= 1
+
+        # backward wave, reverse microbatch order (GPipe); targets ride
+        # this feed — it goes straight to the last stage, so labels
+        # never transit the forward channels
+        in_flight = 0
+        for mb in reversed(range(M)):
+            self._bwd_dag.feed((mb, ys[mb]))
+            in_flight += 1
+            if in_flight > S:
+                self._bwd_dag.drain()
+                in_flight -= 1
+        while in_flight:
+            self._bwd_dag.drain()
+            in_flight -= 1
+
+        applies = ray_tpu.get(
+            [s.apply.remote(M) for s in self.stages], timeout=120)
+        self._rounds += 1
+        losses = applies[-1]["losses"]  # last stage owns the loss
+        return {"loss": float(np.mean(losses)), "losses": losses,
+                "grad_norms": [a["grad_norm"] for a in applies]}
+
+    # -- measurement -------------------------------------------------------
+
+    def reset_window(self):
+        import ray_tpu
+        ray_tpu.get([s.reset_window.remote() for s in self.stages],
+                    timeout=60)
+
+    def bubble_report(self) -> Dict[str, Any]:
+        """Measured pipeline occupancy over the current window. Stages
+        stamp busy intervals with the host-shared CLOCK_MONOTONIC;
+        bubble = 1 - sum(busy) / (S * span). On serialized cores the
+        floor is 1 - 1/S (stages cannot physically overlap), so read it
+        against `bubble_theoretical` = (S-1)/(S-1+M) AND
+        `bubble_serial_floor`."""
+        import ray_tpu
+
+        stats = ray_tpu.get([s.stats.remote() for s in self.stages],
+                            timeout=60)
+        starts = [s["t_first"] for s in stats if s["t_first"] is not None]
+        ends = [s["t_last"] for s in stats if s["t_last"] is not None]
+        span = (max(ends) - min(starts)) if starts and ends else 0.0
+        busy = sum(s["busy_s"] for s in stats)
+        S, M = self.num_stages, self.microbatches
+        return {
+            "num_stages": S,
+            "microbatches": M,
+            "span_s": span,
+            "busy_s": busy,
+            "bubble_fraction": (1.0 - busy / (S * span)) if span else None,
+            "bubble_theoretical": (S - 1) / (S - 1 + M),
+            "bubble_serial_floor": 1.0 - 1.0 / S,
+            "host_roundtrips": sum(s["host_roundtrips"] for s in stats),
+            "device_pulls": sum(s["device_pulls"] for s in stats),
+            "per_stage": stats,
+        }
+
+    def get_params(self) -> List[Any]:
+        import ray_tpu
+        return ray_tpu.get([s.get_params.remote() for s in self.stages],
+                           timeout=120)
+
+    def teardown(self):
+        import ray_tpu
+        for dag in (self._fwd_dag, self._bwd_dag):
+            try:
+                dag.teardown()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                logger.debug("pipeline dag teardown failed", exc_info=True)
+        for stage in self.stages:
+            try:
+                ray_tpu.kill(stage)
+            except Exception:  # noqa: BLE001
+                logger.debug("stage kill failed", exc_info=True)
+        self.stages = []

@@ -21,13 +21,15 @@ class TrainWorker:
     """Actor wrapping one rank of the SPMD group."""
 
     def __init__(self, rank: int, world_size: int, run_name: str,
-                 controller, use_tpu: bool, coordinator: Optional[str]):
+                 controller, use_tpu: bool, coordinator: Optional[str],
+                 mesh_spec: Optional[Dict[str, Any]] = None):
         self.rank = rank
         self.world_size = world_size
         self.run_name = run_name
         self.controller = controller
         self.use_tpu = use_tpu
         self.coordinator = coordinator
+        self.mesh_spec = mesh_spec or {}
         self._jax_initialized = False
 
     def setup_distributed(self):
@@ -88,7 +90,8 @@ class TrainWorker:
             run_name=self.run_name,
             resume_checkpoint=Checkpoint(resume_checkpoint)
             if resume_checkpoint else None,
-            dataset_shards=shards)
+            dataset_shards=shards,
+            mesh_spec=self.mesh_spec)
         set_train_context(ctx)
         try:
             return train_fn(config) if config else train_fn({})
@@ -140,6 +143,21 @@ class WorkerGroup:
         if self.scaling.use_tpu:
             env_vars["RTPU_WORKER_JAX_PLATFORMS"] = "tpu,cpu"
             env_vars["JAX_PLATFORMS"] = ""
+        if self.scaling.virtual_devices:
+            # The --dryrun7b harness: each worker gets an n-device
+            # virtual CPU mesh so the full GSPMD sharding compiles and
+            # executes without real chips.
+            env_vars["JAX_PLATFORMS"] = "cpu"
+            env_vars["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "") +
+                " --xla_force_host_platform_device_count="
+                f"{int(self.scaling.virtual_devices)}")
+        mesh_spec = None
+        if self.scaling.mesh_axes is not None:
+            # build the MeshConfig HERE so a typo'd axis raises at
+            # submit time; workers get the validated config itself
+            mesh_spec = {"mesh_config": self.scaling.mesh_config(),
+                         "num_slices": self.scaling.num_slices}
         coordinator = None
         self.workers = []
         for rank in range(n):
@@ -155,7 +173,7 @@ class WorkerGroup:
                     placement_group=self.pg,
                     placement_group_bundle_index=rank),
             ).remote(rank, n, self.run_name, self.controller,
-                     self.scaling.use_tpu, coordinator)
+                     self.scaling.use_tpu, coordinator, mesh_spec)
             self.workers.append(worker)
             if rank == 0 and n > 1:
                 coordinator = ray_tpu.get(worker.get_coordinator.remote(),

@@ -1,8 +1,8 @@
 from .collective import (allgather, allreduce, barrier, broadcast,
-                         create_collective_group, destroy_collective_group,
-                         get_rank, get_collective_group_size,
-                         init_collective_group, recv, reduce, reducescatter,
-                         send)
+                         bytes_sent, create_collective_group,
+                         destroy_collective_group, get_rank,
+                         get_collective_group_size, init_collective_group,
+                         recv, reduce, reducescatter, send)
 from .topology import Topology, select_algorithm
 from . import quant
 from . import xla
@@ -10,7 +10,8 @@ from . import xla
 __all__ = [
     "init_collective_group", "create_collective_group",
     "destroy_collective_group", "allreduce", "allgather", "reducescatter",
-    "broadcast", "reduce", "send", "recv", "barrier", "get_rank",
+    "broadcast", "reduce", "send", "recv", "barrier", "bytes_sent",
+    "get_rank",
     "get_collective_group_size", "Topology", "select_algorithm", "quant",
     "xla",
 ]

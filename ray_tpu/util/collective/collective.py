@@ -761,3 +761,21 @@ def recv(src_rank: int, group_name: str = "default"):
 
 def barrier(group_name: str = "default"):
     _group(group_name).barrier()
+
+
+def bytes_sent(group_name: str = "default") -> Dict[str, int]:
+    """This rank's per-link byte ledger for the group:
+    {"ici": n, "dcn": n, "dcn_int8": n} (see CollectiveGroup.bytes_sent
+    — the number the train report surfaces so a gradient-sync regression
+    shows up as DCN bytes, not just wall time)."""
+    return _group(group_name).bytes_sent()
+
+
+def selected_algorithm(nbytes: int, group_name: str = "default") -> str:
+    """The allreduce schedule the selector picks for an nbytes payload
+    on this group's topology — what the train report records next to
+    the ledger (CONFIG.collective_algo alone usually just says
+    'auto')."""
+    group = _group(group_name)
+    return select_algorithm(nbytes, group.topology, group.world_size,
+                            ring_min_bytes=_RING_MIN_BYTES)

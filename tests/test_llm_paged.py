@@ -116,6 +116,57 @@ def test_prefix_lru_hit_refreshes_recency_and_counts():
     assert set(paged._prefix_lru) == set(paged.prefix_pages)
 
 
+def _series_value(metric, tags):
+    snap = metric.snapshot()
+    key = [tags.get(k, "") for k in snap["tag_keys"]]
+    for tag_values, value in snap["series"]:
+        if tag_values == key:
+            return value
+    return 0.0
+
+
+def test_prefix_cache_metrics_exposition():
+    """prefix_hits/prefix_misses/LRU occupancy (previously stats()-only)
+    export as rtpu_prefix_cache_* series through the Prometheus
+    exposition pipeline. Counters are process-global, so the assertions
+    are deltas against this engine instance's own stats()."""
+    import os
+
+    from ray_tpu.llm._metrics import llm_metrics
+    from ray_tpu.util.metrics import prometheus_text
+
+    m = llm_metrics()
+    tags = {"engine": "paged"}
+    hits0 = _series_value(m.prefix_hits, tags)
+    miss0 = _series_value(m.prefix_misses, tags)
+
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=tiny_model(), max_batch=4, max_len=128, page_size=8,
+        num_pages=128, prefill_buckets=(32, 64)))
+    hot = list(range(1, 17))  # 16 tokens = 2 full pages
+    paged.generate([hot + [30]], max_new_tokens=2)   # miss
+    paged.generate([hot + [31]], max_new_tokens=2)   # hit
+    s = paged.stats()
+    assert s["prefix_hits"] == 1 and s["prefix_misses"] >= 1
+    assert _series_value(m.prefix_hits, tags) - hits0 \
+        == s["prefix_hits"]
+    assert _series_value(m.prefix_misses, tags) - miss0 \
+        == s["prefix_misses"]
+    gauge_tags = {"engine": "paged", "pid": str(os.getpid())}
+    assert _series_value(m.prefix_entries, gauge_tags) \
+        == len(paged._prefix_lru) > 0
+
+    text = prometheus_text([m.prefix_hits.snapshot(),
+                            m.prefix_misses.snapshot(),
+                            m.prefix_entries.snapshot()])
+    assert "# TYPE rtpu_prefix_cache_hits_total counter" in text
+    assert "# TYPE rtpu_prefix_cache_misses_total counter" in text
+    assert "# TYPE rtpu_prefix_cache_entries gauge" in text
+    assert 'rtpu_prefix_cache_hits_total{engine="paged"}' in text
+    assert ('rtpu_prefix_cache_entries{engine="paged",'
+            f'pid="{os.getpid()}"}}') in text
+
+
 def test_streaming_and_cancellation(engines):
     _slot, paged = engines
     streamed = []
