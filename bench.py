@@ -500,6 +500,99 @@ def _ab_pipeline_arm(steps: int, num_stages: int = 2,
     }
 
 
+class _FlightDeckRank:
+    """One rank of the straggler/SLO-alert demo (plain class; wrapped
+    with ray_tpu.remote inside _flight_deck_demo)."""
+
+    def __init__(self, rank, world, group):
+        self.rank, self.world, self.group = rank, world, group
+
+    def join(self, chaos_spec=""):
+        if chaos_spec:
+            # arm THIS process's chaos registry: every incoming
+            # collective hop is delayed, making this rank late into
+            # every subsequent op — the seeded straggler
+            from ray_tpu._internal.chaos import REGISTRY
+            REGISTRY.arm(spec=chaos_spec, seed=7)
+        from ray_tpu.util.collective import collective as col
+        col.init_collective_group(self.world, self.rank,
+                                  group_name=self.group)
+        return True
+
+    def run_ops(self, ops):
+        import numpy as np
+
+        from ray_tpu.util.collective import collective as col
+        for _ in range(ops):
+            col.allreduce(np.arange(64, dtype=np.int64),
+                          group_name=self.group)
+        summary = col._group(self.group).straggler_summary()
+        return summary
+
+    def flush(self):
+        from ray_tpu.train import steptrace
+        from ray_tpu.util import metrics
+        steptrace.flush()
+        return metrics.flush_now()
+
+    def leave(self):
+        from ray_tpu.util.collective import collective as col
+        col.destroy_collective_group(self.group)
+        return True
+
+
+def _flight_deck_demo(ops: int = 8, delay_s: float = 0.05) -> dict:
+    """Deterministic straggler + SLO-alert e2e on the live cluster:
+    four collective ranks; rank 1 arms a prob-1.0 chaos delay on its
+    incoming collective hops (fixed seed — nothing is time-seeded), so
+    it enters every op ~delay_s late. Rank 0 — the star root, the only
+    rank that hears from several peers — attributes the skew to rank 1
+    and emits STRAGGLER_DETECTED; one alert-engine pass over the
+    cluster's flushed metrics then trips the collective-wait p95 SLO.
+    Both surfaces land in the GCS (cli stragglers / cli alerts /
+    /api/alerts)."""
+    import ray_tpu
+    from ray_tpu._internal.alerts import AlertEngine, default_rules
+    from ray_tpu._internal.core_worker import get_core_worker
+    from ray_tpu.train.steptrace import steptrace_disabled
+    from ray_tpu.util import state as st
+    from ray_tpu.util.metrics import collect_cluster_metrics
+
+    world = 4
+    group = "flightdeck-demo"
+    rank_cls = ray_tpu.remote(num_cpus=1)(_FlightDeckRank)
+    actors = [rank_cls.remote(r, world, group) for r in range(world)]
+    spec = f"collective_msg:delay:1.0:{delay_s}"
+    ray_tpu.get([a.join.remote(spec if r == 1 else "")
+                 for r, a in enumerate(actors)], timeout=120)
+    summaries = ray_tpu.get([a.run_ops.remote(ops) for a in actors],
+                            timeout=300)
+    ray_tpu.get([a.flush.remote() for a in actors], timeout=60)
+    stragglers = st.stragglers()
+    engine = AlertEngine(rules=default_rules())
+    fired = engine.evaluate_once(
+        snapshots=collect_cluster_metrics(get_core_worker().gcs))
+    alert_rows = st.alerts()
+    try:
+        ray_tpu.get([a.leave.remote() for a in actors], timeout=60)
+    except Exception:
+        pass
+    for a in actors:
+        ray_tpu.kill(a)
+    return {
+        "chaos_spec": spec,
+        "ops": ops,
+        "steptrace_disabled": steptrace_disabled(),
+        "straggler_events": [
+            {k: e.get(k) for k in ("rank", "phase", "observer_rank",
+                                   "wait_s", "median_others_s")}
+            for e in stragglers["events"]],
+        "observer_summary": summaries[0],
+        "alerts_fired": [f["rule"] for f in fired],
+        "alert_table_rules": sorted({a["rule"] for a in alert_rows}),
+    }
+
+
 def multichip_ab(steps: int = 6, out_path: str = None) -> dict:
     """The multi-chip A/B: rank-Python DP baseline vs two-level GSPMD
     vs whole-mesh GSPMD (ZeRO-1) vs MPMD pipeline, all on the emulated
@@ -525,6 +618,13 @@ def multichip_ab(steps: int = 6, out_path: str = None) -> dict:
             _ab_trainer_arm("gspmd", num_workers=1, steps=steps),
             _ab_pipeline_arm(steps),
         ]
+        # -- train-plane flight deck --------------------------------------
+        # (1) the cross-rank step timeline the arms just flushed;
+        # (2) the seeded straggler + SLO-alert e2e
+        from ray_tpu.util import state as st
+        timeline_path = "MULTICHIP_timeline.json"
+        trace = st.train_timeline(filename=timeline_path)
+        flight_deck = _flight_deck_demo()
     finally:
         ray_tpu.shutdown()
     for row in rows:
@@ -539,6 +639,12 @@ def multichip_ab(steps: int = 6, out_path: str = None) -> dict:
         "model": dict(_AB),
         "baseline_losses": [round(x, 6) for x in baseline["losses"]],
         "rows": rows,
+        "timeline": {
+            "path": timeline_path,
+            "spans": len(trace),
+            "tracks": sorted({str(r["pid"]) for r in trace}),
+        },
+        "flight_deck": flight_deck,
         "caveat": ("one contended CPU socket: stage/worker overlap is "
                    "partially serialized, so pipeline/DP wall-clock "
                    "gaps understate real multi-chip behavior; the "

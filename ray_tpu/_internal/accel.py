@@ -145,7 +145,8 @@ def _build_accel_metrics():
         goodput=Counter(
             "rtpu_goodput_seconds_total",
             "Reported step wall time split into compile / "
-            "device-compute / host-blocked buckets",
+            "device-compute / comm (host-plane collectives) / "
+            "host-blocked buckets",
             tag_keys=("kind", "bucket")),
     )
 
@@ -573,6 +574,7 @@ def _step_tags(kind: str) -> Dict[str, Dict[str, str]]:
             "kind": {"kind": kind},
             "compile": {"kind": kind, "bucket": "compile"},
             "device": {"kind": kind, "bucket": "device"},
+            "comm": {"kind": kind, "bucket": "comm"},
             "host": {"kind": kind, "bucket": "host"},
             "pid_kind": {"pid": pid, "kind": kind},
         }
@@ -605,13 +607,16 @@ def report_step(kind: str, wall_s: float, tokens: int = 0,
                 device_s: float = 0.0, compile_s: float = 0.0,
                 flops: float = 0.0,
                 device_kind: Optional[str] = None,
-                steps: int = 1) -> Optional[Dict[str, float]]:
+                steps: int = 1,
+                comm_s: float = 0.0) -> Optional[Dict[str, float]]:
     """Fold one step (or ``steps`` uniform steps) into the process's
     step telemetry: step-time histogram, tokens/s EWMA gauge, MFU gauge
     (``flops`` = total FLOPs the interval performed, divided by wall
-    and the shared peak table), and the compile/device/host goodput
-    split (host-blocked = wall − compile − device). Returns the derived
-    numbers, or None when the plane is disabled."""
+    and the shared peak table), and the compile/device/comm/host
+    goodput split (``comm_s`` = host-plane collective time, so
+    comm-bound and compute-bound steps are distinguishable;
+    host-blocked = wall − compile − device − comm). Returns the
+    derived numbers, or None when the plane is disabled."""
     if accel_disabled() or wall_s <= 0:
         return None
     metrics = accel_metrics()
@@ -627,11 +632,14 @@ def report_step(kind: str, wall_s: float, tokens: int = 0,
             metrics.step_time.observe(per_step, tags=tags["kind"])
     compile_s = max(0.0, min(compile_s, wall_s))
     device_s = max(0.0, min(device_s, wall_s - compile_s))
-    host_s = max(0.0, wall_s - compile_s - device_s)
+    comm_s = max(0.0, min(comm_s, wall_s - compile_s - device_s))
+    host_s = max(0.0, wall_s - compile_s - device_s - comm_s)
     if compile_s:
         metrics.goodput.inc(compile_s, tags=tags["compile"])
     if device_s:
         metrics.goodput.inc(device_s, tags=tags["device"])
+    if comm_s:
+        metrics.goodput.inc(comm_s, tags=tags["comm"])
     if host_s:
         metrics.goodput.inc(host_s, tags=tags["host"])
     tokens_per_s = None
@@ -647,13 +655,14 @@ def report_step(kind: str, wall_s: float, tokens: int = 0,
     with _STEP_LOCK:
         agg = _step_stats.setdefault(kind, {
             "steps": 0, "wall_s": 0.0, "tokens": 0,
-            "compile_s": 0.0, "device_s": 0.0, "host_s": 0.0,
-            "tokens_per_s": 0.0, "mfu": 0.0})
+            "compile_s": 0.0, "device_s": 0.0, "comm_s": 0.0,
+            "host_s": 0.0, "tokens_per_s": 0.0, "mfu": 0.0})
         agg["steps"] += steps
         agg["wall_s"] += wall_s
         agg["tokens"] += tokens
         agg["compile_s"] += compile_s
         agg["device_s"] += device_s
+        agg["comm_s"] += comm_s
         agg["host_s"] += host_s
         if tokens_per_s is not None:
             prev = agg["tokens_per_s"]
@@ -665,7 +674,7 @@ def report_step(kind: str, wall_s: float, tokens: int = 0,
             agg["mfu"] = mfu
     return {"wall_s": wall_s, "tokens_per_s": tokens_per_s or 0.0,
             "mfu": mfu or 0.0, "compile_s": compile_s,
-            "device_s": device_s, "host_s": host_s}
+            "device_s": device_s, "comm_s": comm_s, "host_s": host_s}
 
 
 def step_summary() -> List[Dict[str, Any]]:
@@ -691,7 +700,7 @@ class StepAccumulator:
 
     __slots__ = ("kind", "every", "device_kind",
                  "_n", "_wall", "_tokens", "_device", "_compile",
-                 "_flops")
+                 "_comm", "_flops")
 
     def __init__(self, kind: str, every: int = 16,
                  device_kind: Optional[str] = None):
@@ -699,16 +708,19 @@ class StepAccumulator:
         self.every = max(1, int(every))
         self.device_kind = device_kind
         self._n = 0
-        self._wall = self._device = self._compile = self._flops = 0.0
+        self._wall = self._device = self._compile = 0.0
+        self._comm = self._flops = 0.0
         self._tokens = 0
 
     def add(self, wall_s: float, tokens: int = 0, device_s: float = 0.0,
-            compile_s: float = 0.0, flops: float = 0.0):
+            compile_s: float = 0.0, flops: float = 0.0,
+            comm_s: float = 0.0):
         self._n += 1
         self._wall += wall_s
         self._tokens += tokens
         self._device += device_s
         self._compile += compile_s
+        self._comm += comm_s
         self._flops += flops
         if self._n >= self.every:
             self.flush()
@@ -720,9 +732,11 @@ class StepAccumulator:
         out = report_step(
             self.kind, self._wall, tokens=self._tokens,
             device_s=self._device, compile_s=self._compile,
-            flops=self._flops, device_kind=self.device_kind, steps=n)
+            flops=self._flops, device_kind=self.device_kind, steps=n,
+            comm_s=self._comm)
         self._n = 0
-        self._wall = self._device = self._compile = self._flops = 0.0
+        self._wall = self._device = self._compile = 0.0
+        self._comm = self._flops = 0.0
         self._tokens = 0
         return out
 
@@ -745,7 +759,7 @@ class StepTimer:
     checks and report nothing."""
 
     __slots__ = ("kind", "tokens", "flops", "device_kind", "enabled",
-                 "device_s", "result", "sink", "_t0", "_c0")
+                 "device_s", "comm_s", "result", "sink", "_t0", "_c0")
 
     def __init__(self, kind: str, tokens: int = 0, flops: float = 0.0,
                  device_kind: Optional[str] = None,
@@ -757,6 +771,7 @@ class StepTimer:
         self.sink = sink
         self.enabled = not accel_disabled()
         self.device_s = 0.0
+        self.comm_s = 0.0
         self.result: Optional[Dict[str, float]] = None
         self._t0 = 0.0
         self._c0 = 0.0
@@ -771,6 +786,12 @@ class StepTimer:
     def device(self):
         return _DeviceSpan(self)
 
+    def comm(self):
+        """``with timer.comm():`` — host-plane collective time (gradient
+        allreduce, loss reduction) lands in the ``comm`` goodput bucket
+        instead of being misread as host-blocked."""
+        return _CommSpan(self)
+
     def __exit__(self, exc_type, _exc, _tb):
         if not self.enabled or exc_type is not None:
             return False
@@ -779,18 +800,46 @@ class StepTimer:
         if self.sink is not None:
             self.sink.add(wall, tokens=self.tokens,
                           device_s=self.device_s, compile_s=compile_s,
-                          flops=self.flops)
+                          flops=self.flops, comm_s=self.comm_s)
         else:
             self.result = report_step(
                 self.kind, wall, tokens=self.tokens,
                 device_s=self.device_s, compile_s=compile_s,
-                flops=self.flops, device_kind=self.device_kind)
+                flops=self.flops, device_kind=self.device_kind,
+                comm_s=self.comm_s)
         return False
 
 
 class _DeviceSpan:
     """Accumulates time spent inside ``with timer.device():`` into the
-    owning StepTimer's device-compute bucket."""
+    owning StepTimer's device-compute bucket. A span that straddles an
+    XLA recompile (the first call of a freshly-traced step fn compiles
+    INSIDE the span) would bill the compile seconds as device compute;
+    the disjoint backend-compile window the tracker already measures is
+    subtracted, so those seconds land in the compile bucket alone."""
+
+    __slots__ = ("_timer", "_t0", "_c0")
+
+    def __init__(self, timer: StepTimer):
+        self._timer = timer
+        self._t0 = 0.0
+        self._c0 = 0.0
+
+    def __enter__(self):
+        self._c0 = backend_compile_seconds_total()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb):
+        span = time.perf_counter() - self._t0
+        span -= backend_compile_seconds_total() - self._c0
+        self._timer.device_s += max(0.0, span)
+        return False
+
+
+class _CommSpan:
+    """Accumulates time spent inside ``with timer.comm():`` into the
+    owning StepTimer's comm (host-plane collective) bucket."""
 
     __slots__ = ("_timer", "_t0")
 
@@ -803,7 +852,7 @@ class _DeviceSpan:
         return self
 
     def __exit__(self, exc_type, _exc, _tb):
-        self._timer.device_s += time.perf_counter() - self._t0
+        self._timer.comm_s += time.perf_counter() - self._t0
         return False
 
 

@@ -1,0 +1,421 @@
+"""SLO alert engine: declarative rules over the metrics registry.
+
+An :class:`AlertRule` names a metric, a rolling window, and a predicate
+(``metric, window_s, predicate, severity``); the :class:`AlertEngine`
+samples each rule's metric from process snapshots (counter -> sum,
+gauge -> max, histogram -> p95 by default), keeps a per-rule rolling
+``(ts, value)`` window, and when the predicate trips fires a bounded
+GCS alert-table row (``add_alert`` -> ``SLO_ALERT`` event) — surfaced
+via ``cli alerts``, ``/api/alerts``, and the dashboard Alerts tab.
+
+Two evaluation paths share all the logic:
+
+* ``ensure_engine()`` — a registry-registered daemon thread evaluating
+  every ``alert_eval_interval_s``; the production path.
+* ``engine.evaluate_once(snapshots=..., now=...)`` — one deterministic
+  evaluation over caller-supplied snapshots and clock; what the tests
+  and ``bench.py --multichip`` drive.
+
+Firing is rate-limited per rule (``alert_min_interval_s``) so a
+breached SLO produces a heartbeat, not an event flood. Default rules:
+collective-wait p95 (the straggler SLO), HBM high-watermark, and
+step-time regression vs an EWMA baseline.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# metric sampling (snapshots -> one scalar per rule per eval)
+# ---------------------------------------------------------------------------
+
+
+def _fold_metric(snapshots: List[Dict[str, Any]], name: str):
+    """Merge every process's series of metric ``name`` into one value:
+    counters sum, gauges max (worst process wins for SLO purposes),
+    histograms merge bucket/sum/count. Returns (kind, folded) or None
+    if no process has the metric yet."""
+    from ..util.metrics import _iter_series
+    kind = None
+    acc: Any = None
+    for snap in snapshots:
+        if snap.get("name") != name:
+            continue
+        kind = snap.get("kind", "untyped")
+        for _tags, value in _iter_series(snap):
+            if kind == "histogram":
+                if acc is None:
+                    acc = {"boundaries": list(value.get("boundaries", [])),
+                           "buckets": list(value.get("buckets", [])),
+                           "sum": float(value.get("sum", 0.0)),
+                           "count": int(value.get("count", 0))}
+                elif acc["boundaries"] == value.get("boundaries"):
+                    acc["buckets"] = [a + b for a, b in
+                                      zip(acc["buckets"], value["buckets"])]
+                    acc["sum"] += float(value.get("sum", 0.0))
+                    acc["count"] += int(value.get("count", 0))
+            elif kind == "counter":
+                acc = (acc or 0.0) + float(value)
+            else:  # gauge/untyped
+                acc = float(value) if acc is None else max(acc, float(value))
+    if kind is None or acc is None:
+        return None
+    return kind, acc
+
+
+def _hist_quantile(state: Dict[str, Any], q: float) -> Optional[float]:
+    """Upper-bound quantile estimate from merged histogram buckets: the
+    smallest boundary whose cumulative count covers q of observations
+    (the overflow bucket reports the last finite boundary — a floor,
+    but a breach at that resolution already breached any finite SLO)."""
+    count = int(state.get("count", 0))
+    if count <= 0:
+        return None
+    target = q * count
+    cum = 0
+    boundaries = state.get("boundaries", [])
+    for i, n in enumerate(state.get("buckets", [])):
+        cum += n
+        if cum >= target:
+            return float(boundaries[i]) if i < len(boundaries) \
+                else float(boundaries[-1]) if boundaries else None
+    return float(boundaries[-1]) if boundaries else None
+
+
+def sample_metric(snapshots: List[Dict[str, Any]], name: str,
+                  reduce: str = "auto") -> Optional[float]:
+    """One scalar sample of metric ``name`` from snapshots. ``reduce``:
+    ``sum`` / ``max`` / ``mean`` / ``p95`` / ``p99``, or ``auto`` (by
+    kind: counter -> sum, gauge -> max, histogram -> p95)."""
+    folded = _fold_metric(snapshots, name)
+    if folded is None:
+        return None
+    kind, acc = folded
+    if kind == "histogram":
+        if reduce == "mean":
+            return acc["sum"] / acc["count"] if acc["count"] else None
+        if reduce == "p99":
+            return _hist_quantile(acc, 0.99)
+        return _hist_quantile(acc, 0.95)
+    return float(acc)
+
+
+class DeltaMean:
+    """Stateful ``value_fn``: the mean of a histogram's NEW observations
+    since the previous evaluation (cumulative sum/count deltas), so a
+    recent regression isn't diluted by the all-time average. Returns
+    None on evals with no new observations — the rule skips them."""
+
+    def __init__(self, metric: str):
+        self.metric = metric
+        self._last: Tuple[float, int] = (0.0, 0)
+
+    def __call__(self, snapshots: List[Dict[str, Any]]) -> Optional[float]:
+        folded = _fold_metric(snapshots, self.metric)
+        if folded is None or folded[0] != "histogram":
+            return None
+        acc = folded[1]
+        last_sum, last_count = self._last
+        d_sum = acc["sum"] - last_sum
+        d_count = acc["count"] - last_count
+        if d_count <= 0:
+            return None
+        self._last = (acc["sum"], acc["count"])
+        return d_sum / d_count
+
+
+class EwmaRegression:
+    """Stateful predicate: fires when the sample exceeds ``multiple`` x
+    the EWMA of PRIOR samples (the baseline excludes the sample under
+    test, so a sustained regression keeps firing until the baseline
+    catches up). Warmup: never fires before ``min_samples`` priors."""
+
+    def __init__(self, multiple: float = 1.5, alpha: float = 0.3,
+                 min_samples: int = 3):
+        self.multiple = float(multiple)
+        self.alpha = float(alpha)
+        self.min_samples = int(min_samples)
+        self._ewma: Optional[float] = None
+        self._n = 0
+
+    def __call__(self, value: float, window: List[float]) -> bool:
+        prior, n = self._ewma, self._n
+        self._n += 1
+        self._ewma = value if prior is None else \
+            self.alpha * value + (1.0 - self.alpha) * prior
+        return (prior is not None and n >= self.min_samples
+                and value > self.multiple * prior)
+
+
+# ---------------------------------------------------------------------------
+# rules + engine
+# ---------------------------------------------------------------------------
+
+
+class AlertRule:
+    """One declarative SLO: sample ``metric`` (or ``value_fn``), keep a
+    ``window_s`` rolling window, fire at ``severity`` when
+    ``predicate(value, window_values)`` is true. ``predicate`` may be
+    stateful (e.g. :class:`EwmaRegression`); ``message`` is a callable
+    ``value -> str`` or None for the default."""
+
+    def __init__(self, name: str, metric: Optional[str] = None, *,
+                 window_s: float = 60.0,
+                 predicate: Callable[[float, List[float]], bool],
+                 severity: str = "WARNING",
+                 reduce: str = "auto",
+                 value_fn: Optional[Callable[[List[Dict[str, Any]]],
+                                             Optional[float]]] = None,
+                 message: Optional[Callable[[float], str]] = None,
+                 min_interval_s: Optional[float] = None):
+        if metric is None and value_fn is None:
+            raise ValueError(f"rule {name!r} needs metric= or value_fn=")
+        self.name = name
+        self.metric = metric
+        self.window_s = float(window_s)
+        self.predicate = predicate
+        self.severity = severity
+        self.reduce = reduce
+        self.value_fn = value_fn
+        self.message = message
+        self.min_interval_s = min_interval_s
+
+    def sample(self, snapshots: List[Dict[str, Any]]) -> Optional[float]:
+        if self.value_fn is not None:
+            return self.value_fn(snapshots)
+        return sample_metric(snapshots, self.metric, self.reduce)
+
+    def render(self, value: float) -> str:
+        if self.message is not None:
+            return self.message(value)
+        return (f"{self.name}: value {value:.6g} breached SLO over "
+                f"{self.window_s:.0f}s window"
+                + (f" (metric {self.metric})" if self.metric else ""))
+
+
+def _hbm_watermark_ratio(snapshots: List[Dict[str, Any]]
+                         ) -> Optional[float]:
+    """used/limit across the worst accelerator process — the HBM
+    high-watermark SLO's sample."""
+    used = sample_metric(snapshots, "rtpu_accel_hbm_used_bytes", "max")
+    limit = sample_metric(snapshots, "rtpu_accel_hbm_limit_bytes", "max")
+    if used is None or not limit:
+        return None
+    return used / limit
+
+
+def default_rules() -> List[AlertRule]:
+    """The stock train-plane SLOs. Thresholds are CONFIG-free literals
+    except the HBM watermark (shared with the accel pressure plane)."""
+    return [
+        AlertRule(
+            "collective_wait_p95",
+            metric="rtpu_collective_wait_seconds",
+            window_s=60.0, reduce="p95",
+            predicate=lambda v, _w: v > 0.025,
+            severity="WARNING",
+            message=lambda v: (f"collective entry-wait p95 {v:.3f}s "
+                               f"exceeds 25ms SLO — a rank is holding "
+                               f"up the fabric (see cli stragglers)")),
+        AlertRule(
+            "hbm_watermark",
+            value_fn=_hbm_watermark_ratio,
+            window_s=60.0,
+            predicate=lambda v, _w: v > float(CONFIG.accel_hbm_watermark),
+            severity="CRITICAL",
+            message=lambda v: (f"HBM use at {v:.0%} of device limit "
+                               f"(watermark "
+                               f"{float(CONFIG.accel_hbm_watermark):.0%})")),
+        AlertRule(
+            "step_time_regression",
+            window_s=300.0,
+            value_fn=DeltaMean("rtpu_step_time_seconds"),
+            predicate=EwmaRegression(multiple=1.5),
+            severity="WARNING",
+            message=lambda v: (f"step time regressed to {v:.3f}s — "
+                               f">1.5x the EWMA baseline")),
+    ]
+
+
+class AlertEngine:
+    """Evaluates rules over metric snapshots and fires rate-limited
+    alerts through the GCS alert table. ``emit`` is injectable for
+    tests; the default posts ``add_alert`` over the sync GCS bridge."""
+
+    def __init__(self, rules: Optional[List[AlertRule]] = None,
+                 emit: Optional[Callable[[Dict[str, Any]], Any]] = None):
+        self.rules = list(rules) if rules is not None else default_rules()
+        self._emit = emit if emit is not None else _emit_alert
+        self._lock = threading.Lock()
+        # rule name -> deque[(ts, value)] rolling window
+        self._windows: Dict[str, deque] = {}
+        # rule name -> ts of last fire (rate limit)
+        self._last_fire: Dict[str, float] = {}
+        self.evals = 0
+        self.fired: List[Dict[str, Any]] = []
+
+    def add_rule(self, rule: AlertRule):
+        with self._lock:
+            self.rules.append(rule)
+
+    def evaluate_once(self,
+                      snapshots: Optional[List[Dict[str, Any]]] = None,
+                      now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation pass. With ``snapshots``/``now`` supplied this
+        is fully deterministic (the test/bench path); without, it reads
+        this process's live registry and the monotonic clock."""
+        if snapshots is None:
+            from ..util.metrics import snapshot_all
+            snapshots = snapshot_all()
+        if now is None:
+            now = time.monotonic()
+        fired: List[Dict[str, Any]] = []
+        with self._lock:
+            self.evals += 1
+            rules = list(self.rules)
+        for rule in rules:
+            try:
+                value = rule.sample(snapshots)
+            except Exception:  # noqa: BLE001 — one bad rule can't stall the pass
+                logger.debug("alert rule %s sample failed", rule.name,
+                             exc_info=True)
+                continue
+            if value is None:
+                continue
+            with self._lock:
+                win = self._windows.setdefault(rule.name, deque())
+                win.append((now, float(value)))
+                while win and win[0][0] < now - rule.window_s:
+                    win.popleft()
+                values = [v for _, v in win]
+            try:
+                hit = bool(rule.predicate(float(value), values))
+            except Exception:  # noqa: BLE001
+                logger.debug("alert rule %s predicate failed", rule.name,
+                             exc_info=True)
+                continue
+            if not hit:
+                continue
+            min_interval = rule.min_interval_s
+            if min_interval is None:
+                min_interval = float(CONFIG.alert_min_interval_s)
+            with self._lock:
+                last = self._last_fire.get(rule.name)
+                if last is not None and now - last < min_interval:
+                    continue
+                self._last_fire[rule.name] = now
+            row = {
+                "rule": rule.name,
+                "severity": rule.severity,
+                "message": rule.render(float(value)),
+                "value": round(float(value), 6),
+                "window_s": rule.window_s,
+                "metric": rule.metric or "",
+            }
+            with self._lock:
+                self.fired.append(row)
+            fired.append(row)
+            try:
+                self._emit(row)
+            except Exception:  # noqa: BLE001 — alerting is best-effort
+                logger.debug("alert emit failed for %s", rule.name,
+                             exc_info=True)
+        return fired
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"rules": [r.name for r in self.rules],
+                    "evals": self.evals,
+                    "fired": list(self.fired)}
+
+
+def _emit_alert(row: Dict[str, Any]) -> bool:
+    """Post one alert row into the GCS alert table from a user thread
+    (same sync bridge as the straggler/pressure events)."""
+    try:
+        from .core_worker import try_get_core_worker
+        worker = try_get_core_worker()
+        if worker is None:
+            return False
+        worker.gcs.call_sync(
+            "add_alert", rule=row["rule"], message=row["message"],
+            severity=row["severity"],
+            fields={"value": row["value"], "window_s": row["window_s"],
+                    "metric": row["metric"]},
+            timeout=5)
+        return True
+    except Exception:  # noqa: BLE001
+        logger.debug("add_alert RPC failed", exc_info=True)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# daemon lifecycle
+# ---------------------------------------------------------------------------
+
+_engine_lock = threading.Lock()
+_engine: Optional[AlertEngine] = None
+_engine_thread: Optional[threading.Thread] = None
+_engine_stop: Optional[threading.Event] = None
+
+
+def ensure_engine(rules: Optional[List[AlertRule]] = None) -> AlertEngine:
+    """Start (or return) this process's alert-engine daemon: evaluates
+    every ``alert_eval_interval_s`` against the live registry. Liveness
+    -keyed like the metrics flusher — after node teardown joins the
+    thread, the next ensure_engine() restarts it cleanly."""
+    global _engine, _engine_thread, _engine_stop
+    with _engine_lock:
+        if _engine is not None and _engine_thread is not None \
+                and (_engine_thread.ident is None
+                     or _engine_thread.is_alive()) \
+                and not _engine_stop.is_set():
+            return _engine
+        engine = AlertEngine(rules=rules)
+        stop = threading.Event()
+        _engine, _engine_stop = engine, stop
+        from .threads import spawn_daemon
+        _engine_thread = spawn_daemon(
+            _eval_loop, name="rtpu-alert-engine", args=(engine, stop),
+            stop=stop.set)
+        return engine
+
+
+def _cluster_snapshots() -> List[Dict[str, Any]]:
+    """The daemon's snapshot source: every process's flushed metrics
+    from the GCS KV when a cluster is reachable (SLOs are cluster
+    properties), else this process's live registry."""
+    try:
+        from .core_worker import try_get_core_worker
+        worker = try_get_core_worker()
+        if worker is not None:
+            from ..util.metrics import collect_cluster_metrics
+            snaps = collect_cluster_metrics(worker.gcs)
+            if snaps:
+                return snaps
+    except Exception:  # noqa: BLE001 — fall back to the local registry
+        logger.debug("cluster metric collect failed", exc_info=True)
+    from ..util.metrics import snapshot_all
+    return snapshot_all()
+
+
+def _eval_loop(engine: AlertEngine, stop: threading.Event):
+    while not stop.wait(float(CONFIG.alert_eval_interval_s)):
+        try:
+            engine.evaluate_once(snapshots=_cluster_snapshots())
+        except Exception:  # noqa: BLE001 — the loop must survive
+            logger.debug("alert evaluation pass failed", exc_info=True)
+
+
+def get_engine() -> Optional[AlertEngine]:
+    return _engine

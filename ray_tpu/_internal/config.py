@@ -253,6 +253,25 @@ _DEFAULTS: Dict[str, Any] = {
     # delay, the requesting shard asks every other shard to return its
     # idle leases (zero in-flight, no local waiters) immediately.
     "lease_reclaim_delay_s": 0.1,
+    # --- train-plane flight deck (steptrace / straggler / alerts) ---
+    # Bounded per-process step-span ring (a span is 5 small fields;
+    # overflow drops the oldest — steady-state loops keep the tail).
+    "steptrace_max_spans": 4096,
+    # Straggler detector: a peer whose collective entry-wait exceeds
+    # BOTH the absolute floor and median_multiple x the median wait of
+    # the other peers for `consecutive` collective ops in a row is
+    # flagged (rate-limited per peer below).
+    "straggler_median_multiple": 4.0,
+    "straggler_consecutive_ops": 3,
+    "straggler_min_wait_s": 0.02,
+    "straggler_min_interval_s": 30.0,
+    # SLO alert engine: evaluation tick of the daemon thread, and the
+    # per-rule re-fire rate limit (a sustained breach is one alert per
+    # interval, not one per tick).
+    "alert_eval_interval_s": 5.0,
+    "alert_min_interval_s": 60.0,
+    # Bounded GCS alert table (rows beyond this drop the oldest).
+    "alert_log_max_entries": 1000,
     # --- train ---
     "train_health_check_interval_s": 1.0,
     # GSPMD trainer: ZeRO-1 cross-replica sharded weight updates
@@ -293,6 +312,10 @@ _DEFAULTS: Dict[str, Any] = {
     # workers, no raylet rings, exact-legacy pump wiring (DEVNULL with
     # log_to_driver off), no postmortem assembly — zero extra threads.
     "no_log_plane": False,
+    # Kill switch for the cross-rank step timeline: span() degrades to
+    # a no-op context (one flag check), nothing is recorded or flushed,
+    # and the collective straggler detector stops attributing waits.
+    "no_steptrace": False,
     # --- overrides re-read from the environment at their use site
     # (tests monkeypatch them after CONFIG construction; registered here
     # so L003 can resolve the names) ---

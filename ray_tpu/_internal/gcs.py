@@ -221,6 +221,13 @@ class GcsServer:
         # replay (a crash between compact()'s snapshot rename and the
         # WAL truncation replays rows the snapshot already holds).
         self._event_seq = 0
+        # SLO alert table (flight deck): bounded rows fired by the
+        # alert engine (_internal/alerts.py) — in-memory like the rest
+        # of the live observability plane; every fire also lands an
+        # SLO_ALERT row in the persisted event log above.
+        self.alerts: collections.deque = collections.deque(
+            maxlen=CONFIG.alert_log_max_entries)
+        self._alert_seq = 0
         # add_job idempotency-token index (token -> job id): O(1) dedupe
         # of retried registrations; rebuilt from job records at recovery.
         self._job_tokens: Dict[str, JobID] = {}
@@ -1343,6 +1350,51 @@ class GcsServer:
             if severity and ev["severity"] != severity:
                 continue
             out.append(ev)
+            if len(out) >= limit:
+                break
+        out.reverse()
+        return out
+
+    # ------------------------------------------------------------------
+    # SLO alert table (flight deck: bounded rows the alert engine
+    # fires; each fire also lands an SLO_ALERT event so the alert is
+    # visible in the ordinary event stream and its WAL persistence)
+    # ------------------------------------------------------------------
+
+    def add_alert(self, rule: str, message: str = "",
+                  severity: str = "WARNING",
+                  fields: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+        self._alert_seq += 1
+        row = {"ts": time.time(), "rule": rule, "severity": severity,
+               "message": message, "seq": self._alert_seq}
+        row.update(fields or {})
+        self.alerts.append(row)
+        self.add_event("SLO_ALERT", message=message, severity=severity,
+                       rule=rule, **(fields or {}))
+        return row
+
+    async def handle_add_alert(self, rule: str, message: str = "",
+                               severity: str = "WARNING",
+                               fields: Optional[Dict[str, Any]] = None):
+        """External publish point — the alert engine's daemon thread
+        (wherever it runs) fires through here."""
+        self.add_alert(rule, message, severity, fields)
+        return True
+
+    async def handle_get_alerts(self, rule: Optional[str] = None,
+                                since: Optional[float] = None,
+                                severity: Optional[str] = None,
+                                limit: int = 100):
+        out = []
+        for row in reversed(self.alerts):
+            if since is not None and row["ts"] <= since:
+                break
+            if rule and row["rule"] != rule:
+                continue
+            if severity and row["severity"] != severity:
+                continue
+            out.append(row)
             if len(out) >= limit:
                 break
         out.reverse()

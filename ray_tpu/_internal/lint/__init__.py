@@ -44,6 +44,10 @@ L009 retry backoff: ``time.sleep``/``asyncio.sleep`` on the error path
      ``backoff.Backoff`` (jittered exponential, cap, deadline) so
      fleet-wide retry storms don't synchronize, or annotate the line
      ``# backoff ok: <why>``
+L010 metric-catalog sync: every ``rtpu_*`` series constructed in the
+     tree must have a row in README.md's metric catalog table, and
+     every cataloged series must still be constructed somewhere —
+     both directions, so the catalog can't silently rot
 ==== =====================================================================
 
 Violations report ``file:line`` and carry a stable allowlist key
@@ -206,6 +210,7 @@ def run_lint(root: Optional[str] = None,
         report.checked_files += 1
 
     all_violations.extend(_check_metric_consistency(metric_decls))
+    all_violations.extend(_check_metric_catalog(metric_decls, root))
     all_violations.extend(
         check_shard_confinement(shard_decls, shard_accesses))
 
@@ -247,11 +252,65 @@ def _check_metric_consistency(decls: List[MetricDecl]) -> List[Violation]:
     return out
 
 
+def _catalog_names(root: str) -> Tuple[Dict[str, int], Optional[str]]:
+    """Parse README.md's metric-catalog table: every backticked
+    ``rtpu_*`` token in the *first* cell of a table row is a cataloged
+    series name. Returns ``{name: lineno}`` (first occurrence wins) and
+    the README's path, or ``(_, None)`` when no README exists (sdist
+    slices of the tree skip the check rather than flag everything)."""
+    import re
+    readme = os.path.join(root, "README.md")
+    if not os.path.exists(readme):
+        return {}, None
+    names: Dict[str, int] = {}
+    with open(readme, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            cells = line.split("|")
+            if len(cells) < 3:
+                continue
+            for tok in re.findall(r"`(rtpu_[a-z0-9_]+)`", cells[1]):
+                names.setdefault(tok, lineno)
+    return names, readme
+
+
+def _check_metric_catalog(decls: List[MetricDecl],
+                          root: str) -> List[Violation]:
+    """L010 cross-file check: the README metric catalog and the set of
+    constructed series must match in both directions. An uncataloged
+    series is invisible to operators reading the docs; a cataloged
+    series nobody constructs is a dashboard query that silently returns
+    nothing."""
+    catalog, readme = _catalog_names(root)
+    if readme is None:
+        return []
+    out: List[Violation] = []
+    first: Dict[str, MetricDecl] = {}
+    for d in decls:
+        first.setdefault(d.name, d)
+    for name in sorted(first):
+        if name not in catalog:
+            d = first[name]
+            out.append(Violation(
+                rule="L010", path=d.path, line=d.line, scope=d.scope,
+                message=(f"metric {name!r} constructed here but missing "
+                         "from README.md's metric catalog — add a row")))
+    for name in sorted(catalog):
+        if name not in first:
+            out.append(Violation(
+                rule="L010", path="README.md", line=catalog[name],
+                scope=name,
+                message=(f"cataloged metric {name!r} is not constructed "
+                         "anywhere in the tree — stale row")))
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     import argparse
     parser = argparse.ArgumentParser(
         prog="rtpulint",
-        description="ray_tpu project lint (rules L001-L009)")
+        description="ray_tpu project lint (rules L001-L010)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     parser.add_argument("--root", default=None,

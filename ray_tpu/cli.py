@@ -12,7 +12,9 @@ Usage: python -m ray_tpu.cli <command> ...
   list     {nodes,actors,tasks,placement_groups,objects,workers,jobs}
   memory   [--json] [--limit N]                          cluster memory report
   events   [--type T] [--json] [--limit N]               cluster event log
-  timeline [--output FILE]                               chrome trace
+  timeline [--output FILE] [--train]                     chrome trace
+  stragglers [--json] [--limit N]                        skew/straggler view
+  alerts   [--rule R] [--severity S] [--json]            SLO alert table
   trace    [TRACE_ID] [--json] [--logs]                  span tree / list
   logs     [--task|--actor|--job|--node|--level|--grep]  cluster log search
            [--tail N] [--follow] [--json]                (worker ring query)
@@ -366,8 +368,63 @@ def cmd_events(args):
 def cmd_timeline(args):
     _connect(args)
     from ray_tpu.util import state as st
+    if getattr(args, "train", False):
+        trace = st.train_timeline(args.output)
+        tracks = sorted({row["pid"] for row in trace})
+        print(f"wrote {len(trace)} train spans across "
+              f"{len(tracks)} tracks ({', '.join(map(str, tracks))}) "
+              f"to {args.output}")
+        return
     trace = st.timeline(args.output)
     print(f"wrote {len(trace)} spans to {args.output}")
+
+
+def cmd_stragglers(args):
+    """Render the straggler/skew view: STRAGGLER_DETECTED events plus
+    the per-track (rank/stage) rolling step-time fold."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    view = st.stragglers(limit=args.limit)
+    if args.json:
+        print(json.dumps(view, indent=1, default=str))
+        return
+    stats = view["step_stats"]
+    if stats:
+        print("track       steps  mean_step_s  last_step_s")
+        for track in sorted(stats):
+            row = stats[track]
+            print(f"{track:<10} {row['steps']:>6} "
+                  f"{row['mean_step_s']:>12.4f} {row['last_s']:>12.4f}")
+    if not view["events"]:
+        print("no stragglers detected")
+        return
+    print()
+    for ev in view["events"]:
+        stamp = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
+        print(f"{stamp}  rank {ev.get('rank')}  "
+              f"phase={ev.get('phase', '?')}  "
+              f"wait={ev.get('wait_s', 0):.3f}s "
+              f"(median of peers {ev.get('median_others_s', 0):.3f}s, "
+              f"seen by rank {ev.get('observer_rank')} "
+              f"x{ev.get('consecutive_ops')} ops)")
+
+
+def cmd_alerts(args):
+    """Render the GCS SLO alert table (what the alert engine fired)."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    rows = st.alerts(rule=args.rule, severity=args.severity,
+                     limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=1, default=str))
+        return
+    if not rows:
+        print("no alerts fired")
+        return
+    for row in rows:
+        stamp = time.strftime("%H:%M:%S", time.localtime(row["ts"]))
+        print(f"{stamp}  {row['severity']:<8} {row['rule']:<22} "
+              f"{row.get('message', '')}")
 
 
 def cmd_trace(args):
@@ -863,8 +920,25 @@ def main(argv=None):
 
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="timeline.json")
+    p.add_argument("--train", action="store_true",
+                   help="cross-rank train-step timeline (steptrace) "
+                        "instead of the task timeline")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("stragglers")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_stragglers)
+
+    p = sub.add_parser("alerts")
+    p.add_argument("--rule", default=None)
+    p.add_argument("--severity", default=None)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_alerts)
 
     p = sub.add_parser("trace")
     p.add_argument("trace_id", nargs="?")

@@ -12,6 +12,9 @@ Routes:
   GET  /api/jobs/<id>/logs            {"logs": ...}
   POST /api/jobs/<id>/stop
   GET  /api/timeline                  chrome-trace JSON of task spans
+  GET  /api/train_timeline            cross-rank train-step timeline
+  GET  /api/stragglers                straggler events + step-time skew
+  GET  /api/alerts                    SLO alert table (alert engine)
                                       (?since= for incremental polls)
   GET  /api/memory                    cluster memory summary (stores,
                                       per-object refs, leak heuristic)
@@ -67,6 +70,11 @@ class DashboardHead:
             self._server = await asyncio.start_server(
                 self._handle_conn, self._host, self._port)
             self._port = self._server.sockets[0].getsockname()[1]
+            # The SLO alert engine rides with the dashboard head: one
+            # registry-registered daemon evaluating the cluster's
+            # flushed metrics every alert_eval_interval_s.
+            from .._internal.alerts import ensure_engine
+            ensure_engine()
         return (self._host, self._port)
 
     # -- HTTP plumbing (same shape as serve's proxy) ----------------------
@@ -200,6 +208,20 @@ class DashboardHead:
             return self._json(st.timeline(
                 job_id=query.get("job_id"),
                 since=float(since) if since else None))
+        if path == "/api/train_timeline":
+            # cross-rank train-step timeline (steptrace fold) — the
+            # Timeline tab's train view
+            return self._json(st.train_timeline())
+        if path == "/api/stragglers":
+            return self._json(st.stragglers(
+                limit=int(query.get("limit", 100))))
+        if path == "/api/alerts":
+            since = query.get("since")
+            return self._json(st.alerts(
+                rule=query.get("rule"),
+                severity=query.get("severity"),
+                since=float(since) if since else None,
+                limit=int(query.get("limit", 100))))
         if path == "/api/memory":
             return self._json(st.memory_summary(
                 limit=int(query.get("limit", 1000))))

@@ -87,6 +87,7 @@ class TrainController:
                 tokens=int(metrics.get("tokens")
                            or metrics.get("tokens_per_step") or 0),
                 device_s=float(metrics.get("device_time_s") or 0.0),
+                comm_s=float(metrics.get("comm_time_s") or 0.0),
                 flops=flops, device_kind=device_kind)
         except Exception:  # noqa: BLE001 — telemetry must not fail a run
             logger.debug("train step-telemetry fold failed",

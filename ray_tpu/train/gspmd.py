@@ -33,6 +33,7 @@ import math
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
+from . import steptrace
 from .context import get_context, report
 
 
@@ -110,6 +111,7 @@ def _telemetry_report(rank: int, step: int, loss: float,
         metrics.update(
             step_time_s=res["wall_s"],
             device_time_s=res["device_s"],
+            comm_time_s=res.get("comm_s", 0.0),
             tokens=spec.tokens_per_step,
             step_flops=spec.flops_per_step,
             device_kind=_device_kind(),
@@ -140,7 +142,7 @@ def _final_fold(metrics: Dict[str, Any], losses, t_start: float,
         row = steps[0]
         fold["goodput"] = {
             "compile_s": row["compile_s"], "device_s": row["device_s"],
-            "host_s": row["host_s"]}
+            "comm_s": row.get("comm_s", 0.0), "host_s": row["host_s"]}
         fold["mean_step_s"] = row["mean_step_s"]
         if row.get("mfu"):
             fold["mfu"] = row["mfu"]
@@ -196,19 +198,26 @@ def _run_gspmd(spec: GSPMDTrainSpec) -> Dict[str, Any]:
 
     losses = []
     metrics: Dict[str, Any] = {}
+    track = f"rank{ctx.rank}"
     with mesh:
         for i in range(spec.steps):
-            batch = _to_device(spec.batch_fn(i, 0, 1))
-            with accel.StepTimer(
-                    "train", tokens=spec.tokens_per_step,
-                    flops=spec.flops_per_step) as timer:
-                with timer.device():
-                    state, step_metrics = step(state, batch)
-                    loss = float(jax.device_get(step_metrics["loss"]))
+            with steptrace.span(track, i, "step"):
+                with steptrace.span(track, i, "data"):
+                    batch = _to_device(spec.batch_fn(i, 0, 1))
+                with accel.StepTimer(
+                        "train", tokens=spec.tokens_per_step,
+                        flops=spec.flops_per_step) as timer:
+                    # one jitted program: every collective (ICI + DCN)
+                    # is GSPMD-inserted inside the forward span
+                    with steptrace.span(track, i, "forward"), \
+                            timer.device():
+                        state, step_metrics = step(state, batch)
+                        loss = float(jax.device_get(step_metrics["loss"]))
             losses.append(loss)
             metrics = _telemetry_report(ctx.rank, i, loss, timer, spec,
                                         extra={"schedule": "gspmd",
                                                "zero1": zero1})
+    steptrace.flush()
     final = _final_fold(metrics, losses, t_start, spec)
     report(final)
     return final
@@ -300,41 +309,52 @@ def _run_two_level(spec: GSPMDTrainSpec) -> Dict[str, Any]:
         grad_step = make_grad_step(loss_fn, local_mesh, rules,
                                    batch_axes=("batch", None))
 
+        track = f"rank{rank}"
         with local_mesh:
             for i in range(spec.steps):
-                batch = _to_device(spec.batch_fn(i, rank, world))
-                with accel.StepTimer(
-                        "train", tokens=spec.tokens_per_step,
-                        flops=spec.flops_per_step) as timer:
-                    with timer.device():
-                        loss_local, grads = grad_step(params, batch)
-                        loss_local = float(jax.device_get(loss_local))
-                        grads = jax.device_get(grads)
-                    if algo is None:
-                        algo = col.selected_algorithm(
-                            4 * _leaf_count(grads), group_name=group_name)
-                    # cross-slice hop: host plane, selected backend
-                    grads = allreduce_gradients(grads,
-                                                group_name=group_name)
-                    # global loss = mean of the slice-local (mean-type)
-                    # losses — 4 bytes per step next to the grad buffer
-                    loss = float(col.allreduce(
-                        np.float32(loss_local),
-                        group_name=group_name)) / world
-                    with timer.device():
-                        if zero1:
-                            state, _ = apply_step(state, grads)
-                            params = state.params
-                            jax.block_until_ready(state.m)
-                        else:
-                            params, opt_state = apply_fn(
-                                params, opt_state, grads)
-                            jax.block_until_ready(params)
+                with steptrace.span(track, i, "step"):
+                    with steptrace.span(track, i, "data"):
+                        batch = _to_device(spec.batch_fn(i, rank, world))
+                    with accel.StepTimer(
+                            "train", tokens=spec.tokens_per_step,
+                            flops=spec.flops_per_step) as timer:
+                        with steptrace.span(track, i, "forward"), \
+                                timer.device():
+                            loss_local, grads = grad_step(params, batch)
+                            loss_local = float(jax.device_get(loss_local))
+                            grads = jax.device_get(grads)
+                        if algo is None:
+                            algo = col.selected_algorithm(
+                                4 * _leaf_count(grads),
+                                group_name=group_name)
+                        # cross-slice hop: host plane, selected backend
+                        # — the comm goodput bucket + collective span
+                        with steptrace.span(track, i, "collective"), \
+                                timer.comm():
+                            grads = allreduce_gradients(
+                                grads, group_name=group_name)
+                            # global loss = mean of the slice-local
+                            # (mean-type) losses — 4 bytes per step
+                            # next to the grad buffer
+                            loss = float(col.allreduce(
+                                np.float32(loss_local),
+                                group_name=group_name)) / world
+                        with steptrace.span(track, i, "optimizer"), \
+                                timer.device():
+                            if zero1:
+                                state, _ = apply_step(state, grads)
+                                params = state.params
+                                jax.block_until_ready(state.m)
+                            else:
+                                params, opt_state = apply_fn(
+                                    params, opt_state, grads)
+                                jax.block_until_ready(params)
                 losses.append(loss)
                 metrics = _telemetry_report(
                     rank, i, loss, timer, spec,
                     extra={"schedule": "two_level", "zero1": zero1,
                            "loss_local": loss_local})
+        steptrace.flush()
         final = _final_fold(metrics, losses, t_start, spec)
         final["collective_bytes"] = col.bytes_sent(group_name)
         final["collective_algo"] = algo
@@ -454,26 +474,36 @@ def _run_dp_python(spec: GSPMDTrainSpec) -> Dict[str, Any]:
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state
 
+        track = f"rank{rank}"
         for i in range(spec.steps):
-            batch = _to_device(spec.batch_fn(i, rank, world))
-            with accel.StepTimer(
-                    "train", tokens=spec.tokens_per_step,
-                    flops=spec.flops_per_step) as timer:
-                with timer.device():
-                    loss_local, grads = grad_fn(params, batch)
-                    loss_local = float(jax.device_get(loss_local))
-                    grads = jax.device_get(grads)
-                grads = allreduce_gradients(grads, group_name=group_name)
-                loss = float(col.allreduce(
-                    np.float32(loss_local),
-                    group_name=group_name)) / world
-                with timer.device():
-                    params, opt_state = apply_fn(params, opt_state, grads)
-                    jax.block_until_ready(params)
+            with steptrace.span(track, i, "step"):
+                with steptrace.span(track, i, "data"):
+                    batch = _to_device(spec.batch_fn(i, rank, world))
+                with accel.StepTimer(
+                        "train", tokens=spec.tokens_per_step,
+                        flops=spec.flops_per_step) as timer:
+                    with steptrace.span(track, i, "forward"), \
+                            timer.device():
+                        loss_local, grads = grad_fn(params, batch)
+                        loss_local = float(jax.device_get(loss_local))
+                        grads = jax.device_get(grads)
+                    with steptrace.span(track, i, "collective"), \
+                            timer.comm():
+                        grads = allreduce_gradients(
+                            grads, group_name=group_name)
+                        loss = float(col.allreduce(
+                            np.float32(loss_local),
+                            group_name=group_name)) / world
+                    with steptrace.span(track, i, "optimizer"), \
+                            timer.device():
+                        params, opt_state = apply_fn(
+                            params, opt_state, grads)
+                        jax.block_until_ready(params)
             losses.append(loss)
             metrics = _telemetry_report(
                 rank, i, loss, timer, spec,
                 extra={"schedule": "dp_python", "zero1": False})
+        steptrace.flush()
         final = _final_fold(metrics, losses, t_start, spec)
         final["collective_bytes"] = col.bytes_sent(group_name)
         if rank == 0:

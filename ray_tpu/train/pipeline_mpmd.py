@@ -24,9 +24,61 @@ from __future__ import annotations
 
 import logging
 import time
+from types import SimpleNamespace
 from typing import Any, Callable, Dict, List, Optional
 
+from ..util.metrics import LazyMetrics
+from . import steptrace
+
 logger = logging.getLogger(__name__)
+
+
+def _build_metrics() -> SimpleNamespace:
+    from ..util.metrics import Counter, Gauge
+    return SimpleNamespace(
+        bubble=Gauge(
+            "rtpu_pipeline_bubble_fraction",
+            "Measured pipeline bubble over the current window: 1 - "
+            "busy/span per stage (stage=\"all\" is the aggregate "
+            "1 - sum(busy)/(S*span) from bubble_report())",
+            tag_keys=("stage",)),
+        busy=Counter(
+            "rtpu_pipeline_stage_busy_seconds_total",
+            "Cumulative busy seconds per pipeline stage (monotonic "
+            "CLOCK_MONOTONIC busy-interval stamps; window resets do "
+            "not rewind the counter)",
+            tag_keys=("stage",)),
+    )
+
+
+_metrics = LazyMetrics(_build_metrics)
+
+
+def export_pipeline_metrics(report: Dict[str, Any],
+                            exported: Dict[str, float]) -> None:
+    """Fold one ``bubble_report()`` into the metrics plane:
+    per-stage (and aggregate) bubble-fraction gauges plus per-stage
+    busy-seconds counters. ``exported`` is the caller's per-stage
+    last-cumulative-busy map — deltas feed the counter, so repeated
+    reports over one window don't double-count, and a window reset
+    (busy rewound to ~0) restarts the delta base instead of going
+    negative. Mutated in place."""
+    m = _metrics()
+    overall = report.get("bubble_fraction")
+    if overall is not None:
+        m.bubble.set(float(overall), tags={"stage": "all"})
+    span = float(report.get("span_s") or 0.0)
+    for s in report.get("per_stage", []):
+        stage = str(s.get("stage"))
+        busy = float(s.get("busy_s") or 0.0)
+        if span > 0:
+            m.bubble.set(max(0.0, 1.0 - busy / span),
+                         tags={"stage": stage})
+        last = exported.get(stage, 0.0)
+        delta = busy - last if busy >= last else busy
+        if delta > 0:
+            m.busy.inc(delta, tags={"stage": stage})
+        exported[stage] = busy
 
 
 class PipelineStage:
@@ -130,12 +182,16 @@ class PipelineStage:
         self._live_refs.append(ref)
         return ref
 
-    def _busy(self, t0: float):
+    def _busy(self, t0: float, phase: str = "busy"):
         t1 = time.monotonic()
         self.busy_s += t1 - t0
         if self.t_first is None:
             self.t_first = t0
         self.t_last = t1
+        # The same stamps feed the cross-rank timeline: one span per
+        # busy interval on the stage's track, shared monotonic clock.
+        steptrace.record(f"stage{self.stage_index}", self._step,
+                         phase, t0, t1)
 
     # -- GPipe phases ------------------------------------------------------
 
@@ -153,11 +209,11 @@ class PipelineStage:
             # grads AND the loss come in the backward phase: bwd_last's
             # value_and_grad is the single forward+backward this stage
             # runs per microbatch
-            self._busy(t0)
+            self._busy(t0, "forward")
             return (mb_index, None)
         y = self._fwd(self.params, x)
         y.block_until_ready()
-        self._busy(t0)
+        self._busy(t0, "forward")
         return (mb_index, self._ship(y))
 
     def backward(self, packet):
@@ -177,10 +233,10 @@ class PipelineStage:
             dparams, dx = self._bwd_mid(self.params, x, g)
         self._accumulate(dparams)
         if self.is_first:
-            self._busy(t0)
+            self._busy(t0, "backward")
             return (mb_index, None)
         dx.block_until_ready()
-        self._busy(t0)
+        self._busy(t0, "backward")
         return (mb_index, self._ship(dx))
 
     def _accumulate(self, dparams):
@@ -215,8 +271,9 @@ class PipelineStage:
         self._grad_accum = None
         self._stash.clear()
         self._live_refs.clear()  # consumers are done: pins may drop
+        self._busy(t0, "apply")
         self._step += 1
-        self._busy(t0)
+        steptrace.flush()  # round boundary: publish this stage's spans
         return {"stage": self.stage_index, "grad_norm": gnorm,
                 "step": self._step, "losses": losses}
 
@@ -288,6 +345,9 @@ class MPMDPipeline:
         self._bwd_dag = node.experimental_compile(
             channel_capacity=channel_capacity, timeout_s=timeout_s)
         self._rounds = 0
+        # per-stage last cumulative busy_s shipped to the busy counter
+        # (delta tracking across bubble_report() calls)
+        self._busy_exported: Dict[str, float] = {}
 
     # -- schedule ----------------------------------------------------------
 
@@ -364,7 +424,7 @@ class MPMDPipeline:
         span = (max(ends) - min(starts)) if starts and ends else 0.0
         busy = sum(s["busy_s"] for s in stats)
         S, M = self.num_stages, self.microbatches
-        return {
+        report = {
             "num_stages": S,
             "microbatches": M,
             "span_s": span,
@@ -376,6 +436,8 @@ class MPMDPipeline:
             "device_pulls": sum(s["device_pulls"] for s in stats),
             "per_stage": stats,
         }
+        export_pipeline_metrics(report, self._busy_exported)
+        return report
 
     def get_params(self) -> List[Any]:
         import ray_tpu

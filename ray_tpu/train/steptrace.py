@@ -1,0 +1,357 @@
+"""Cross-rank step timeline + straggler detection (the train-plane
+flight deck's recording layer).
+
+Two concerns, both per-process and both bounded:
+
+- **Span recorder** — every train rank (and every MPMD pipeline stage)
+  stamps per-step phase spans (data / forward / collective / optimizer;
+  pipeline stages stamp their busy intervals) with the host-shared
+  ``time.monotonic()`` clock into a bounded ring. Processes flush their
+  rings into the GCS KV (ns ``steptrace``); the driver folds every
+  process's spans into ONE chrome-trace/perfetto artifact
+  (`state.train_timeline()` / ``cli timeline --train`` / the dashboard
+  Timeline tab) where pid = track (rank/stage) and spans on one track
+  nest by time containment — which rank, which phase, which step ate
+  the wall clock, on one shared time axis.
+
+- **Straggler detector** — the collective backend attributes each
+  receive's entry-wait to the PEER it was blocked on (the rank whose
+  message arrived late). Per completed collective op the detector
+  compares each peer's attributed wait against the median of the other
+  peers (``straggler_median_multiple``) and an absolute floor
+  (``straggler_min_wait_s``); a peer above both for
+  ``straggler_consecutive_ops`` ops in a row is flagged with a
+  rate-limited ``STRAGGLER_DETECTED`` GCS event carrying the offending
+  rank and phase (queryable via ``cli stragglers``).
+
+Kill switch: ``RTPU_NO_STEPTRACE=1`` — ``span()`` degrades to a no-op
+context (one flag check), nothing is recorded, flushed, or attributed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import statistics
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .._internal.config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+STEPTRACE_KV_NS = "steptrace"
+
+
+def steptrace_disabled() -> bool:
+    return bool(CONFIG.no_steptrace)
+
+
+# ---------------------------------------------------------------------------
+# span recorder
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    """Bounded per-process span ring. A span is (track, step, phase,
+    t0, t1) on the shared monotonic clock; tracks are "rank3" /
+    "stage1" strings — the timeline's process rows."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=int(CONFIG.steptrace_max_spans))
+        # track -> {"steps", "wall_s", "last_s"} rolling step-time fold
+        self._steps: Dict[str, Dict[str, float]] = {}
+
+    def record(self, track: str, step: int, phase: str,
+               t0: float, t1: float):
+        with self._lock:
+            self._spans.append((track, int(step), phase,
+                                float(t0), float(t1)))
+            if phase == "step":
+                agg = self._steps.setdefault(
+                    track, {"steps": 0, "wall_s": 0.0, "last_s": 0.0})
+                agg["steps"] += 1
+                agg["wall_s"] += t1 - t0
+                agg["last_s"] = t1 - t0
+
+    def spans(self) -> List[tuple]:
+        with self._lock:
+            return list(self._spans)
+
+    def payload(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "pid": os.getpid(),
+                "spans": [list(s) for s in self._spans],
+                "steps": {k: dict(v) for k, v in self._steps.items()},
+            }
+
+    def clear(self):
+        with self._lock:
+            self._spans.clear()
+            self._steps.clear()
+
+
+_RECORDER = _Recorder()
+
+
+class _Span:
+    """``with span(track, step, phase):`` — stamps one interval into
+    the ring on exit. Under the kill switch __enter__/__exit__ are two
+    attribute checks and nothing is recorded."""
+
+    __slots__ = ("track", "step", "phase", "enabled", "_t0")
+
+    def __init__(self, track: str, step: int, phase: str):
+        self.track = track
+        self.step = step
+        self.phase = phase
+        self.enabled = not steptrace_disabled()
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        if self.enabled:
+            self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb):
+        if self.enabled:
+            _RECORDER.record(self.track, self.step, self.phase,
+                             self._t0, time.monotonic())
+        return False
+
+
+def span(track: str, step: int, phase: str) -> _Span:
+    return _Span(track, step, phase)
+
+
+def record(track: str, step: int, phase: str, t0: float, t1: float):
+    """Direct stamp for callers that already hold monotonic timestamps
+    (the pipeline stages' busy intervals)."""
+    if not steptrace_disabled():
+        _RECORDER.record(track, step, phase, t0, t1)
+
+
+def spans() -> List[tuple]:
+    return _RECORDER.spans()
+
+
+def clear():
+    _RECORDER.clear()
+
+
+# ---------------------------------------------------------------------------
+# flush / collect / chrome-trace fold
+# ---------------------------------------------------------------------------
+
+
+def flush(gcs=None, key: Optional[str] = None) -> bool:
+    """Push this process's span ring into the GCS KV (ns ``steptrace``)
+    under a per-process key — what `state.train_timeline()` collects.
+    Best-effort, like the metrics flusher; returns False with no GCS."""
+    if steptrace_disabled():
+        return False
+    try:
+        import json
+        if gcs is None:
+            from .._internal.core_worker import try_get_core_worker
+            worker = try_get_core_worker()
+            if worker is None:
+                return False
+            gcs = worker.gcs
+        if key is None:
+            key = str(os.getpid())
+        gcs.put(STEPTRACE_KV_NS, key,
+                json.dumps(_RECORDER.payload()).encode())
+        return True
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        logger.debug("steptrace flush failed", exc_info=True)
+        return False
+
+
+def collect(gcs) -> List[Dict[str, Any]]:
+    """Every process's flushed payload from the GCS KV (driver side)."""
+    import json
+    out = []
+    for key in gcs.keys(STEPTRACE_KV_NS, ""):
+        raw = gcs.get(STEPTRACE_KV_NS, key)
+        if raw:
+            try:
+                out.append(json.loads(raw.decode()))
+            except ValueError:
+                pass
+    return out
+
+
+def to_chrome_trace(payloads: List[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Fold flushed payloads into chrome-trace rows (the PR-1 timeline
+    row shape): ph:"X" complete events, ts/dur in µs on the shared
+    monotonic clock, pid = track (rank/stage), one "train" tid per
+    track so a step span and the phase spans inside it nest by time
+    containment in Perfetto."""
+    rows: List[Dict[str, Any]] = []
+    for payload in payloads:
+        for track, step, phase, t0, t1 in payload.get("spans", []):
+            rows.append({
+                "name": (f"step {step}" if phase == "step"
+                         else f"{phase} {step}"),
+                "cat": "steptrace" if phase != "busy" else "pipeline",
+                "ph": "X",
+                "ts": t0 * 1e6,
+                "dur": max(0.0, (t1 - t0)) * 1e6,
+                "pid": track,
+                "tid": "train",
+                "args": {"track": track, "step": step, "phase": phase},
+            })
+    rows.sort(key=lambda r: (str(r["pid"]), r["ts"]))
+    return rows
+
+
+def step_stats(payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-track rolling step-time fold across every flushed payload —
+    the skew view `state.stragglers()` reports next to the events."""
+    out: Dict[str, Any] = {}
+    for payload in payloads:
+        for track, agg in (payload.get("steps") or {}).items():
+            row = out.setdefault(track, {"steps": 0, "wall_s": 0.0,
+                                         "last_s": 0.0})
+            row["steps"] += int(agg.get("steps", 0))
+            row["wall_s"] += float(agg.get("wall_s", 0.0))
+            row["last_s"] = float(agg.get("last_s", row["last_s"]))
+    for row in out.values():
+        row["mean_step_s"] = row["wall_s"] / max(1, row["steps"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# straggler detector
+# ---------------------------------------------------------------------------
+
+
+class StragglerDetector:
+    """Per-process rolling per-peer entry-lag detector. The collective
+    backend feeds it one ``{peer_rank: wait_s}`` map per completed op
+    (the wait this rank spent blocked on each peer's message). A peer
+    above BOTH the absolute floor and ``median_multiple`` x the median
+    wait of the OTHER peers — for ``consecutive`` ops in a row — gets a
+    rate-limited STRAGGLER_DETECTED event. The median-of-others form
+    keeps a uniformly slow fabric (everyone waits) from flagging
+    anyone, while a single skewed rank stands out immediately.
+    Single-sender ops borrow the median from other peers' recent
+    waits; an observer with NO cross-peer context (it only ever hears
+    from one peer) never flags — skew is undecidable there."""
+
+    def __init__(self, group_name: str, observer_rank: int,
+                 emit=None):
+        self.group_name = group_name
+        self.observer_rank = observer_rank
+        self._emit = emit if emit is not None else _emit_straggler_event
+        self._lock = threading.Lock()
+        # peer -> consecutive ops above threshold
+        self._consecutive: Dict[int, int] = {}
+        # peer -> bounded recent waits (the stragglers-report view)
+        self._recent: Dict[int, deque] = {}
+        # peer -> monotonic time of last emitted event (rate limit)
+        self._last_emit: Dict[int, float] = {}
+        self.ops = 0
+        self.flagged: List[Dict[str, Any]] = []
+
+    def note_op(self, waits: Dict[int, float], phase: str):
+        """Fold one completed collective op's per-peer waits; emits
+        (rate-limited) the moment a peer crosses the consecutive-ops
+        threshold."""
+        if not waits or steptrace_disabled():
+            return
+        multiple = float(CONFIG.straggler_median_multiple)
+        floor = float(CONFIG.straggler_min_wait_s)
+        need = int(CONFIG.straggler_consecutive_ops)
+        to_emit = []
+        with self._lock:
+            self.ops += 1
+            for peer, wait in waits.items():
+                self._recent.setdefault(peer, deque(maxlen=64)) \
+                    .append(float(wait))
+            for peer, wait in waits.items():
+                others = [w for p, w in waits.items() if p != peer]
+                if not others:
+                    # single-sender op (a ring/chain hop): borrow
+                    # context from other peers' recent waits instead
+                    others = [sum(d) / len(d)
+                              for p, d in self._recent.items()
+                              if p != peer and d]
+                if not others:
+                    # no cross-peer context at all — this observer
+                    # cannot tell one slow peer from a uniformly slow
+                    # fabric, so it never flags (ranks that only ever
+                    # hear from one peer stay silent; the multi-link
+                    # observer — e.g. the star root — does the flagging)
+                    continue
+                med = statistics.median(others)
+                if wait >= floor and wait > multiple * med:
+                    self._consecutive[peer] = \
+                        self._consecutive.get(peer, 0) + 1
+                else:
+                    self._consecutive[peer] = 0
+                    continue
+                if self._consecutive[peer] < need:
+                    continue
+                now = time.monotonic()
+                last = self._last_emit.get(peer, 0.0)
+                if now - last < CONFIG.straggler_min_interval_s:
+                    continue
+                self._last_emit[peer] = now
+                row = {
+                    "rank": peer,
+                    "phase": phase,
+                    "group": self.group_name,
+                    "observer_rank": self.observer_rank,
+                    "wait_s": round(float(wait), 6),
+                    "median_others_s": round(float(med), 6),
+                    "consecutive_ops": self._consecutive[peer],
+                }
+                self.flagged.append(row)
+                to_emit.append(row)
+        for row in to_emit:
+            self._emit(row)
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "group": self.group_name,
+                "observer_rank": self.observer_rank,
+                "ops": self.ops,
+                "peers": {
+                    str(peer): {
+                        "mean_wait_s": sum(w) / len(w),
+                        "max_wait_s": max(w),
+                        "consecutive": self._consecutive.get(peer, 0),
+                    }
+                    for peer, w in self._recent.items() if w},
+                "flagged": list(self.flagged),
+            }
+
+
+def _emit_straggler_event(row: Dict[str, Any]) -> bool:
+    """Best-effort STRAGGLER_DETECTED publish from the training thread
+    (sync GCS bridge — the same user-thread path as the accel plane's
+    pressure events)."""
+    try:
+        from .._internal.core_worker import try_get_core_worker
+        worker = try_get_core_worker()
+        if worker is None:
+            return False
+        worker.gcs.call_sync(
+            "add_event", event_type="STRAGGLER_DETECTED",
+            message=(f"rank {row['rank']} straggling in {row['phase']}: "
+                     f"entry wait {row['wait_s']}s vs "
+                     f"{row['median_others_s']}s median of peers"),
+            severity="WARNING", fields=dict(row, pid=os.getpid()),
+            timeout=5)
+        return True
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        logger.debug("STRAGGLER_DETECTED emit failed", exc_info=True)
+        return False

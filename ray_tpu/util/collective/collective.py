@@ -38,9 +38,13 @@ hier|star``):
 
 Sends are one-way messages over the framework RPC plane (reliable,
 in-order per connection); receives block on a local mailbox. Per-op
-wall time and per-link bytes ride the flight recorder
+wall time, per-link bytes, per-rank entry-wait, and per-link achieved
+rate ride the flight recorder
 (``rtpu_collective_op_seconds{op,algo}``,
-``rtpu_collective_bytes_total{link,quant}``).
+``rtpu_collective_bytes_total{link,quant}``,
+``rtpu_collective_wait_seconds{rank}``,
+``rtpu_collective_link_gbps{link}``); per-peer entry-wait attribution
+feeds the straggler detector (see `train.steptrace`).
 """
 
 from __future__ import annotations
@@ -72,7 +76,7 @@ _OP_BOUNDARIES = [0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
 
 
 def _build_metrics() -> SimpleNamespace:
-    from ...util.metrics import Counter, Histogram
+    from ...util.metrics import Counter, Gauge, Histogram
     return SimpleNamespace(
         op_seconds=Histogram(
             "rtpu_collective_op_seconds",
@@ -86,6 +90,19 @@ def _build_metrics() -> SimpleNamespace:
             "class (ici = intra-slice, dcn = cross-slice) and "
             "quantization arm",
             tag_keys=("link", "quant")),
+        wait_seconds=Histogram(
+            "rtpu_collective_wait_seconds",
+            "Entry-wait: time this rank spent blocked on a peer's "
+            "message inside one collective receive (the straggler "
+            "signal — a skewed rank inflates every OTHER rank's wait)",
+            boundaries=_OP_BOUNDARIES,
+            tag_keys=("rank",)),
+        link_gbps=Gauge(
+            "rtpu_collective_link_gbps",
+            "Achieved GB/s over one link class during the most recent "
+            "collective op (bytes this rank pushed onto the link / op "
+            "wall time) — the ledger's rate view",
+            tag_keys=("link",)),
     )
 
 
@@ -181,6 +198,48 @@ class CollectiveGroup:
                                    self.topology.slices)
                                for r in group}
         self._my_slice = self._slice_by_rank[rank]
+        # entry-wait attribution: peer rank -> seconds this rank spent
+        # blocked on that peer's messages during the CURRENT op; folded
+        # into the straggler detector at op end (see _op_end)
+        self._op_waits: Dict[int, float] = {}
+        self._rank_tag = {"rank": str(rank)}
+        self._detector = None
+
+    # -- per-op telemetry (wait / link rate / straggler fold) ------------
+
+    def _op_begin(self) -> Tuple[float, Dict[Tuple[str, str], int]]:
+        self._op_waits.clear()
+        return time.perf_counter(), dict(self._bytes)
+
+    def _op_end(self, op: str, algo: str,
+                begin: Tuple[float, Dict[Tuple[str, str], int]]):
+        t0, bytes0 = begin
+        elapsed = time.perf_counter() - t0
+        _metrics().op_seconds.observe(elapsed, tags={"op": op,
+                                                     "algo": algo})
+        if elapsed > 0:
+            per_link: Dict[str, int] = {}
+            for (link, _arm), n in self._bytes.items():
+                delta = n - bytes0.get((link, _arm), 0)
+                if delta > 0:
+                    per_link[link] = per_link.get(link, 0) + delta
+            for link, nbytes in per_link.items():
+                _metrics().link_gbps.set(nbytes / elapsed / 1e9,
+                                         tags={"link": link})
+        if self._op_waits:
+            waits = dict(self._op_waits)
+            self._op_waits.clear()
+            detector = self._detector
+            if detector is None:
+                from ...train.steptrace import StragglerDetector
+                detector = self._detector = StragglerDetector(
+                    self.name, self.rank)
+            detector.note_op(waits, op)
+
+    def straggler_summary(self) -> Optional[Dict[str, Any]]:
+        """This rank's straggler-detector fold (None before the first
+        attributed wait) — what the worker flushes next to its spans."""
+        return self._detector.summary() if self._detector else None
 
     def _account(self, rank: int, nbytes: int, quant: bool = False):
         link = "dcn" if self._slice_by_rank[rank] != self._my_slice \
@@ -226,8 +285,22 @@ class CollectiveGroup:
         EventLoopThread.get().post(
             client.oneway("collective_msg", key=key, data=payload))
 
-    def _recv_from(self, key: Tuple) -> np.ndarray:
-        return _unpack(_mailbox.take(key))
+    def _recv_from(self, key: Tuple,
+                   src: Optional[int] = None) -> np.ndarray:
+        return _unpack(self._take_raw(key, src=src))
+
+    def _take_raw(self, key: Tuple, src: Optional[int] = None) -> bytes:
+        """Blocking mailbox take with entry-wait stamping: the blocked
+        time rides the per-rank wait histogram, and — when the caller
+        knows which peer it is blocked on — accrues to that peer in the
+        current op's attribution map (the straggler detector's input)."""
+        t0 = time.perf_counter()
+        data = _mailbox.take(key)
+        wait = time.perf_counter() - t0
+        _metrics().wait_seconds.observe(wait, tags=self._rank_tag)
+        if src is not None:
+            self._op_waits[src] = self._op_waits.get(src, 0.0) + wait
+        return data
 
     # -- primitives ------------------------------------------------------
 
@@ -236,7 +309,7 @@ class CollectiveGroup:
         algo = select_algorithm(array.nbytes, self.topology,
                                 self.world_size,
                                 ring_min_bytes=_RING_MIN_BYTES)
-        t0 = time.perf_counter()
+        begin = self._op_begin()
         if algo == "hier":
             out = self._hier_allreduce(array, op, seq)
         elif algo == "tree":
@@ -249,9 +322,7 @@ class CollectiveGroup:
             reduced = self.reduce(array, dst_rank=0, op=op, _seq=seq)
             out = self.broadcast(reduced if self.rank == 0 else array,
                                  src_rank=0, _seq=seq)
-        _metrics().op_seconds.observe(time.perf_counter() - t0,
-                                      tags={"op": "allreduce",
-                                            "algo": algo})
+        self._op_end("allreduce", algo, begin)
         return out
 
     # -- binomial tree ---------------------------------------------------
@@ -273,13 +344,13 @@ class CollectiveGroup:
                 break  # sent up; wait for the broadcast phase
             if r % (2 * step) == 0 and r + step < W:
                 inc = self._recv_from(
-                    (self.name, "tr", seq, s, r + step))
+                    (self.name, "tr", seq, s, r + step), src=r + step)
                 acc = fn(acc, inc)
         for s in reversed(range(rounds)):
             step = 1 << s
             if r % (2 * step) == step:
                 acc = self._recv_from(
-                    (self.name, "tb", seq, s, r - step))
+                    (self.name, "tb", seq, s, r - step), src=r - step)
             elif r % (2 * step) == 0 and r + step < W:
                 self._post_to(r + step, (self.name, "tb", seq, s, r),
                               acc)
@@ -323,13 +394,14 @@ class CollectiveGroup:
         W = len(members)
         fn = _OPS[op]
         nxt = members[(i + 1) % W]
+        prv = members[(i - 1) % W]
         for s in range(W - 1):
             send_idx = (i - s - 1) % W
             recv_idx = (i - s - 2) % W
             self._post_to(nxt, (self.name, "hrs", seq, s, send_idx),
                           chunks[send_idx])
             incoming = self._recv_from(
-                (self.name, "hrs", seq, s, recv_idx))
+                (self.name, "hrs", seq, s, recv_idx), src=prv)
             chunks[recv_idx] = fn(chunks[recv_idx], incoming)
         return chunks
 
@@ -338,13 +410,14 @@ class CollectiveGroup:
                             seq: int) -> List[np.ndarray]:
         W = len(members)
         nxt = members[(i + 1) % W]
+        prv = members[(i - 1) % W]
         for s in range(W - 1):
             send_idx = (i - s) % W
             recv_idx = (i - s - 1) % W
             self._post_to(nxt, (self.name, "hag", seq, s, send_idx),
                           chunks[send_idx])
             chunks[recv_idx] = self._recv_from(
-                (self.name, "hag", seq, s, recv_idx))
+                (self.name, "hag", seq, s, recv_idx), src=prv)
         return chunks
 
     def _dcn_allreduce(self, peers: Tuple[int, ...], own: np.ndarray,
@@ -372,6 +445,7 @@ class CollectiveGroup:
         S = len(peers)
         j = peers.index(self.rank)
         nxt = peers[(j + 1) % S]
+        prv = peers[(j - 1) % S]
         use_quant = (CONFIG.collective_quant == "int8" and op == SUM
                      and own.dtype.kind == "f")
         parts: List[Optional[np.ndarray]] = [None] * S
@@ -383,7 +457,8 @@ class CollectiveGroup:
             for s in range(S - 1):
                 self._post_raw(nxt, (self.name, "hq", seq, s), blob,
                                quant=True)
-                blob = _mailbox.take((self.name, "hq", seq, s))
+                blob = self._take_raw((self.name, "hq", seq, s),
+                                      src=prv)
                 # step-s arrival originated at peer (j - 1 - s) mod S
                 parts[(j - 1 - s) % S] = quant_mod.dequantize(
                     quant_mod.unpack(blob)).ravel()
@@ -396,7 +471,7 @@ class CollectiveGroup:
         cur = own
         for s in range(S - 1):
             self._post_to(nxt, (self.name, "hx", seq, s), cur)
-            cur = self._recv_from((self.name, "hx", seq, s))
+            cur = self._recv_from((self.name, "hx", seq, s), src=prv)
             parts[(j - 1 - s) % S] = cur
         acc = np.array(parts[0], copy=True)
         for part in parts[1:]:
@@ -458,13 +533,15 @@ class CollectiveGroup:
         W, r = self.world_size, self.rank
         pos = (r - src_rank) % W
         succ = (r + 1) % W if pos < W - 1 else None
+        prev = (r - 1) % W
         n_chunks, shape, dtype = serialization.loads(header_data)
         if succ is not None:
             self._post_obj(succ, (self.name, "bh", seq),
                            (n_chunks, shape, dtype))
         pieces = []
         for k in range(n_chunks):
-            piece = self._recv_from((self.name, "bch", seq, k))
+            piece = self._recv_from((self.name, "bch", seq, k),
+                                    src=prev)
             if succ is not None:
                 self._post_to(succ, (self.name, "bch", seq, k), piece)
             pieces.append(piece)
@@ -481,7 +558,7 @@ class CollectiveGroup:
                 if src == dst_rank:
                     continue
                 acc = fn(acc, self._recv_from(
-                    (self.name, "red", seq, src)))
+                    (self.name, "red", seq, src), src=src))
             return acc
         self._send_to(dst_rank, (self.name, "red", seq, self.rank), array)
         return array
@@ -499,7 +576,13 @@ class CollectiveGroup:
             for dst in range(self.world_size):
                 if dst == src_rank:
                     continue
-                self._send_to(dst, (self.name, "bc", seq, src_rank), array)
+                # One-way like the ring's hops: an acked send would
+                # serialize W-1 round trips at the source AND let one
+                # slow receiver head-of-line-block every later dst
+                # (which also smears a straggler's lag onto the src,
+                # hiding it from the wait attribution).
+                self._post_to(dst, (self.name, "bc", seq, src_rank),
+                              array)
             return array
         key, data = _mailbox.take_any([
             (self.name, "bc", seq, src_rank),   # star payload
@@ -513,13 +596,11 @@ class CollectiveGroup:
         # the cutover lives HERE only; _allgather branches on the label
         algo = "ring" if (array.nbytes >= _RING_MIN_BYTES
                           and self.world_size >= 3) else "star"
-        t0 = time.perf_counter()
+        begin = self._op_begin()
         try:
             return self._allgather(array, algo)
         finally:
-            _metrics().op_seconds.observe(time.perf_counter() - t0,
-                                          tags={"op": "allgather",
-                                                "algo": algo})
+            self._op_end("allgather", algo, begin)
 
     def _allgather(self, array: np.ndarray, algo: str
                    ) -> List[np.ndarray]:
@@ -529,19 +610,22 @@ class CollectiveGroup:
             # (W-1) x N per rank over neighbor links, no root funnel
             W, r = self.world_size, self.rank
             nxt = (r + 1) % W
+            prv = (r - 1) % W
             parts: List[Optional[np.ndarray]] = [None] * W
             parts[r] = np.asarray(array)
             cur = parts[r]
             for s in range(W - 1):
                 self._post_to(nxt, (self.name, "agr", seq, s), cur)
-                cur = self._recv_from((self.name, "agr", seq, s))
+                cur = self._recv_from((self.name, "agr", seq, s),
+                                      src=prv)
                 parts[(r - s - 1) % W] = cur
             return parts
         if self.rank == 0:
             parts = [None] * self.world_size
             parts[0] = np.asarray(array)
             for src in range(1, self.world_size):
-                parts[src] = self._recv_from((self.name, "ag", seq, src))
+                parts[src] = self._recv_from((self.name, "ag", seq, src),
+                                             src=src)
             stacked = parts
         else:
             self._send_to(0, (self.name, "ag", seq, self.rank), array)
@@ -563,13 +647,11 @@ class CollectiveGroup:
     def reducescatter(self, array: np.ndarray, op: str = SUM) -> np.ndarray:
         if array.nbytes >= _RING_MIN_BYTES and self.world_size >= 3:
             seq = self._next_seq("reducescatter")
-            t0 = time.perf_counter()
+            begin = self._op_begin()
             # ring reduce-scatter alone: (W-1)/W x N bytes per rank,
             # half the full allreduce's traffic
             out = self._ring_reduce_scatter(array, op, seq)[self.rank]
-            _metrics().op_seconds.observe(
-                time.perf_counter() - t0,
-                tags={"op": "reducescatter", "algo": "ring"})
+            self._op_end("reducescatter", "ring", begin)
             return out
         reduced = self.allreduce(array, op)
         chunks = np.array_split(reduced.ravel(), self.world_size)
@@ -581,7 +663,8 @@ class CollectiveGroup:
 
     def recv(self, src_rank: int) -> np.ndarray:
         seq = self._next_seq(f"p2p-{src_rank}-{self.rank}")
-        return self._recv_from((self.name, "p2p", seq, src_rank))
+        return self._recv_from((self.name, "p2p", seq, src_rank),
+                               src=src_rank)
 
     def barrier(self):
         self.allreduce(np.zeros(1, np.int8))
@@ -610,7 +693,8 @@ class CollectiveGroup:
 
     def _recv_obj(self, seq):
         from ..._internal import serialization
-        return serialization.loads(_mailbox.take((self.name, "bco", seq, 0)))
+        return serialization.loads(
+            self._take_raw((self.name, "bco", seq, 0), src=0))
 
 
 def _pack(array: np.ndarray) -> bytes:

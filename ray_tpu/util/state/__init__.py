@@ -1,23 +1,24 @@
 """State API (reference: python/ray/util/state — api.py list_actors/
 list_tasks/list_objects/list_nodes/..., common.py state schemas)."""
 
-from .api import (accel_summary, autoscaler_state, drain_node,
+from .api import (accel_summary, alerts, autoscaler_state, drain_node,
                   gcs_info, get_actor, get_logs, get_node, get_trace,
                   list_actors, list_events, list_jobs, list_logs,
                   list_nodes, list_object_refs, list_objects,
                   list_placement_groups, list_tasks, list_traces,
                   list_workers, memory_summary, profile_cluster,
                   profiling_status, set_chaos, shard_summary,
-                  stack_cluster, summarize_tasks, tail_logs, timeline)
+                  stack_cluster, stragglers, summarize_tasks, tail_logs,
+                  timeline, train_timeline)
 
 __all__ = [
-    "accel_summary", "autoscaler_state", "drain_node", "gcs_info",
-    "get_actor",
+    "accel_summary", "alerts", "autoscaler_state", "drain_node",
+    "gcs_info", "get_actor",
     "get_logs", "get_node", "get_trace",
     "list_actors", "list_events", "list_jobs", "list_logs", "list_nodes",
     "list_object_refs", "list_objects", "list_placement_groups",
     "list_tasks", "list_traces", "list_workers", "memory_summary",
     "profile_cluster", "profiling_status", "set_chaos",
-    "shard_summary", "stack_cluster", "summarize_tasks", "tail_logs",
-    "timeline",
+    "shard_summary", "stack_cluster", "stragglers", "summarize_tasks",
+    "tail_logs", "timeline", "train_timeline",
 ]

@@ -1093,6 +1093,43 @@ def list_events(event_type: Optional[str] = None,
                             since=since, severity=severity, limit=limit)
 
 
+def train_timeline(filename: Optional[str] = None
+                   ) -> List[Dict[str, Any]]:
+    """Cross-rank train-step timeline: every rank's (and every MPMD
+    pipeline stage's) flushed phase spans folded into one chrome-trace
+    JSON on the shared monotonic clock — pid = rank/stage track, spans
+    nest by time containment (step > data/forward/collective/optimizer).
+    Load the output in chrome://tracing or Perfetto; the train-plane
+    companion to `timeline()`'s task view."""
+    from ...train import steptrace
+    trace = steptrace.to_chrome_trace(steptrace.collect(_gcs()))
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def stragglers(limit: int = 100) -> Dict[str, Any]:
+    """The straggler/skew view: STRAGGLER_DETECTED events (which rank,
+    which phase, how far above the peer median) next to the per-track
+    rolling step-time fold from the flushed steptrace payloads."""
+    from ...train import steptrace
+    return {
+        "events": list_events(event_type="STRAGGLER_DETECTED",
+                              limit=limit),
+        "step_stats": steptrace.step_stats(steptrace.collect(_gcs())),
+    }
+
+
+def alerts(rule: Optional[str] = None, since: Optional[float] = None,
+           severity: Optional[str] = None,
+           limit: int = 100) -> List[Dict[str, Any]]:
+    """The GCS's bounded SLO alert table (what the alert engine fired),
+    newest last — `cli alerts` / `/api/alerts`."""
+    return _gcs().call_sync("get_alerts", rule=rule, since=since,
+                            severity=severity, limit=limit)
+
+
 def gcs_info() -> Dict[str, Any]:
     """GCS identity + durability status: incarnation, persist mode, WAL
     size, failover count (the `cli chaos` / dashboard failover surface)."""

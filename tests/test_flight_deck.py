@@ -1,0 +1,609 @@
+"""Train-plane flight deck: step timelines, straggler detection, and
+the SLO alert engine (PR-16).
+
+Unit layers first (span recorder / chrome-trace fold, straggler
+detector thresholds, alert rule windows + predicates + rate limits,
+goodput comm bucket, pipeline bubble exposition, lint L010), then one
+end-to-end arm: a live 4-rank collective group with a seeded chaos
+delay on rank 1 that must trip the straggler detector AND the
+collective-wait SLO alert, deterministically."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu._internal.config import CONFIG
+
+
+def _override(**kv):
+    """Apply CONFIG overrides, return the restore dict."""
+    old = {k: getattr(CONFIG, k) for k in kv}
+    CONFIG.apply_system_config(kv)
+    return old
+
+
+# ---------------------------------------------------------------------------
+# span recorder + chrome-trace fold
+# ---------------------------------------------------------------------------
+
+
+def test_span_recording_and_chrome_schema():
+    from ray_tpu.train import steptrace
+    steptrace.clear()
+    with steptrace.span("rank0", 3, "step"):
+        with steptrace.span("rank0", 3, "forward"):
+            time.sleep(0.002)
+    t0 = time.monotonic()
+    steptrace.record("stage1", 3, "busy", t0, t0 + 0.001)
+    payload = steptrace._RECORDER.payload()
+    rows = steptrace.to_chrome_trace([payload])
+    assert {r["pid"] for r in rows} == {"rank0", "stage1"}
+    for r in rows:
+        assert r["ph"] == "X" and r["tid"] == "train"
+        assert set(r) >= {"name", "cat", "ts", "dur", "pid", "args"}
+        assert r["dur"] >= 0
+    by_phase = {r["args"]["phase"]: r for r in rows}
+    # the step span contains its forward span (Perfetto nesting is by
+    # time containment on one track)
+    step, fwd = by_phase["step"], by_phase["forward"]
+    assert step["ts"] <= fwd["ts"]
+    assert step["ts"] + step["dur"] >= fwd["ts"] + fwd["dur"]
+    assert by_phase["busy"]["cat"] == "pipeline"
+    assert fwd["cat"] == "steptrace"
+    assert fwd["name"] == "forward 3" and step["name"] == "step 3"
+    steptrace.clear()
+
+
+def test_step_stats_fold_and_flush_roundtrip():
+    from ray_tpu.train import steptrace
+    steptrace.clear()
+    base = time.monotonic()
+    for i in range(3):
+        steptrace.record("rank0", i, "step", base + i, base + i + 0.5)
+    steptrace.record("rank1", 0, "step", base, base + 1.0)
+
+    class FakeGcs:
+        def __init__(self):
+            self.kv = {}
+
+        def put(self, ns, key, value):
+            self.kv[(ns, key)] = value
+
+        def get(self, ns, key):
+            return self.kv.get((ns, key))
+
+        def keys(self, ns, prefix):
+            return [k for (n, k) in self.kv if n == ns
+                    and k.startswith(prefix)]
+
+    gcs = FakeGcs()
+    assert steptrace.flush(gcs=gcs, key="9999")
+    payloads = steptrace.collect(gcs)
+    assert len(payloads) == 1 and payloads[0]["pid"]
+    stats = steptrace.step_stats(payloads)
+    assert stats["rank0"]["steps"] == 3
+    assert stats["rank0"]["mean_step_s"] == pytest.approx(0.5)
+    assert stats["rank1"]["last_s"] == pytest.approx(1.0)
+    assert len(steptrace.to_chrome_trace(payloads)) == 4
+    steptrace.clear()
+
+
+def test_steptrace_kill_switch():
+    from ray_tpu.train import steptrace
+    steptrace.clear()
+    old = _override(no_steptrace=True)
+    try:
+        with steptrace.span("rank0", 0, "step"):
+            pass
+        steptrace.record("rank0", 0, "forward", 0.0, 1.0)
+        assert steptrace.spans() == []
+        assert steptrace.flush(gcs=object(), key="x") is False
+        det = steptrace.StragglerDetector("g", 0, emit=lambda r: None)
+        det.note_op({1: 9.0, 2: 0.0, 3: 0.0}, "allreduce")
+        assert det.ops == 0 and det.flagged == []
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+def test_span_ring_bounded():
+    from ray_tpu.train import steptrace
+    old = _override(steptrace_max_spans=8)
+    try:
+        rec = steptrace._Recorder()
+        for i in range(50):
+            rec.record("rank0", i, "forward", float(i), float(i) + 0.1)
+        assert len(rec.spans()) == 8
+        # the ring keeps the newest spans
+        assert rec.spans()[-1][1] == 49
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+# ---------------------------------------------------------------------------
+# straggler detector
+# ---------------------------------------------------------------------------
+
+
+def _detector(emitted, **over):
+    from ray_tpu.train.steptrace import StragglerDetector
+    over.setdefault("straggler_median_multiple", 4.0)
+    over.setdefault("straggler_consecutive_ops", 3)
+    over.setdefault("straggler_min_wait_s", 0.02)
+    over.setdefault("straggler_min_interval_s", 30.0)
+    return StragglerDetector("g", 0, emit=emitted.append), _override(**over)
+
+
+def test_straggler_flags_after_consecutive_ops():
+    emitted = []
+    det, old = _detector(emitted)
+    try:
+        for i in range(4):
+            det.note_op({1: 0.05, 2: 0.001, 3: 0.001}, "allreduce")
+            if i < 2:
+                assert not emitted  # below the consecutive-ops bar
+        assert len(emitted) == 1  # 4th op rate-limited, not re-flagged
+        row = emitted[0]
+        assert row["rank"] == 1 and row["phase"] == "allreduce"
+        assert row["observer_rank"] == 0 and row["consecutive_ops"] == 3
+        assert row["wait_s"] == pytest.approx(0.05)
+        assert row["median_others_s"] == pytest.approx(0.001)
+        assert det.summary()["flagged"] == [row]
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+def test_straggler_floor_and_uniform_slowness_never_flag():
+    emitted = []
+    det, old = _detector(emitted)
+    try:
+        for _ in range(6):
+            # rank 1 is 50x the median of its peers but under the
+            # absolute floor: microsecond jitter must never page
+            det.note_op({1: 0.005, 2: 0.0001, 3: 0.0001}, "allreduce")
+        for _ in range(6):
+            # uniformly slow fabric: everyone waits, nobody stands out
+            det.note_op({1: 0.05, 2: 0.05, 3: 0.05}, "allreduce")
+        assert emitted == []
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+def test_straggler_consecutive_counter_resets():
+    emitted = []
+    det, old = _detector(emitted)
+    try:
+        skew = {1: 0.05, 2: 0.001, 3: 0.001}
+        clean = {1: 0.001, 2: 0.001, 3: 0.001}
+        for waits in (skew, skew, clean, skew, skew):
+            det.note_op(waits, "allreduce")
+        assert emitted == []  # the clean op broke the streak
+        det.note_op(skew, "allreduce")
+        assert len(emitted) == 1
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+def test_straggler_single_sender_borrows_recent_context():
+    emitted = []
+    det, old = _detector(emitted)
+    try:
+        # ring/chain hops deliver one peer per op; context for judging
+        # peer 1 comes from peer 2's recent waits
+        for _ in range(3):
+            det.note_op({2: 0.001}, "allreduce")
+        for _ in range(3):
+            det.note_op({1: 0.05}, "allreduce")
+        assert len(emitted) == 1 and emitted[0]["rank"] == 1
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+def test_straggler_no_cross_peer_context_never_flags():
+    emitted = []
+    det, old = _detector(emitted)
+    try:
+        # an observer that only ever hears from one peer cannot tell a
+        # slow peer from a slow fabric — it must stay silent
+        for _ in range(10):
+            det.note_op({0: 0.05}, "allreduce")
+        assert emitted == [] and det.ops == 10
+    finally:
+        CONFIG.apply_system_config(old)
+
+
+# ---------------------------------------------------------------------------
+# alert rules + engine
+# ---------------------------------------------------------------------------
+
+
+def _hist_snap(name, boundaries, buckets, total, count, tags=("0",),
+               tag_keys=("rank",)):
+    return {"name": name, "kind": "histogram", "tag_keys": list(tag_keys),
+            "series": [[list(tags), {"boundaries": list(boundaries),
+                                     "buckets": list(buckets),
+                                     "sum": total, "count": count}]]}
+
+
+def _gauge_snap(name, value, tags=(), tag_keys=()):
+    return {"name": name, "kind": "gauge", "tag_keys": list(tag_keys),
+            "series": [[list(tags), value]]}
+
+
+def test_sample_metric_reductions():
+    from ray_tpu._internal.alerts import sample_metric
+    snaps = [
+        _hist_snap("rtpu_collective_wait_seconds", [0.01, 0.05, 0.1],
+                   [0, 18, 2], 1.1, 20),
+        _gauge_snap("rtpu_accel_hbm_used_bytes", 70.0),
+        _gauge_snap("rtpu_accel_hbm_used_bytes", 90.0),
+        {"name": "rtpu_step_tokens_total", "kind": "counter",
+         "tag_keys": ["kind"], "series": [[["train"], 5.0]]},
+        {"name": "rtpu_step_tokens_total", "kind": "counter",
+         "tag_keys": ["kind"], "series": [[["train"], 7.0]]},
+    ]
+    # histogram auto -> p95: 18/20 observations within 0.05; covering
+    # the 19th (the p95 target) needs the 0.1 bucket
+    assert sample_metric(snaps, "rtpu_collective_wait_seconds") == 0.1
+    assert sample_metric(snaps, "rtpu_collective_wait_seconds",
+                         "mean") == pytest.approx(1.1 / 20)
+    assert sample_metric(snaps, "rtpu_accel_hbm_used_bytes") == 90.0
+    assert sample_metric(snaps, "rtpu_step_tokens_total") == 12.0
+    assert sample_metric(snaps, "rtpu_missing") is None
+
+
+def test_alert_engine_fires_and_rate_limits():
+    from ray_tpu._internal.alerts import AlertEngine, AlertRule
+    emitted = []
+    rule = AlertRule("wait_p95", metric="rtpu_collective_wait_seconds",
+                     window_s=60.0, reduce="p95",
+                     predicate=lambda v, _w: v > 0.025)
+    engine = AlertEngine(rules=[rule], emit=emitted.append)
+    hot = [_hist_snap("rtpu_collective_wait_seconds", [0.01, 0.05, 0.1],
+                      [0, 20, 0], 1.0, 20)]
+    assert engine.evaluate_once(snapshots=hot, now=100.0)
+    assert engine.evaluate_once(snapshots=hot, now=130.0) == []  # limited
+    assert engine.evaluate_once(snapshots=hot, now=200.0)  # heartbeat
+    assert [r["rule"] for r in emitted] == ["wait_p95", "wait_p95"]
+    assert emitted[0]["severity"] == "WARNING"
+    assert emitted[0]["value"] == pytest.approx(0.05)
+    assert engine.summary()["evals"] == 3
+
+
+def test_alert_window_trims_and_predicate_sees_it():
+    from ray_tpu._internal.alerts import AlertEngine, AlertRule
+    seen = []
+
+    def predicate(value, window):
+        seen.append(list(window))
+        return False
+
+    rule = AlertRule("g", metric="rtpu_accel_hbm_used_bytes",
+                     window_s=10.0, predicate=predicate)
+    engine = AlertEngine(rules=[rule], emit=lambda r: None)
+    snap = [_gauge_snap("rtpu_accel_hbm_used_bytes", 1.0)]
+    for now in (0.0, 5.0, 20.0):
+        engine.evaluate_once(snapshots=snap, now=now)
+    # at t=20 the t=0 and t=5 samples fell out of the 10s window
+    assert [len(w) for w in seen] == [1, 2, 1]
+
+
+def test_alert_missing_metric_and_bad_rule_skip():
+    from ray_tpu._internal.alerts import AlertEngine, AlertRule
+
+    def boom(snapshots):
+        raise RuntimeError("bad rule")
+
+    emitted = []
+    engine = AlertEngine(rules=[
+        AlertRule("broken", value_fn=boom, predicate=lambda v, w: True),
+        AlertRule("absent", metric="rtpu_not_a_metric",
+                  predicate=lambda v, w: True),
+        AlertRule("live", metric="rtpu_accel_hbm_used_bytes",
+                  predicate=lambda v, w: True),
+    ], emit=emitted.append)
+    fired = engine.evaluate_once(
+        snapshots=[_gauge_snap("rtpu_accel_hbm_used_bytes", 1.0)],
+        now=0.0)
+    # one bad rule can't stall the pass; a missing metric is a skip
+    assert [r["rule"] for r in fired] == ["live"]
+    with pytest.raises(ValueError):
+        AlertRule("neither", predicate=lambda v, w: True)
+
+
+def test_delta_mean_and_ewma_regression():
+    from ray_tpu._internal.alerts import DeltaMean, EwmaRegression
+    dm = DeltaMean("rtpu_step_time_seconds")
+
+    def snap(total, count):
+        return [_hist_snap("rtpu_step_time_seconds", [1.0, 10.0],
+                           [count, 0], total, count, tags=("train",),
+                           tag_keys=("kind",))]
+
+    assert dm(snap(1.0, 10)) == pytest.approx(0.1)
+    assert dm(snap(1.0, 10)) is None  # no new observations
+    # 10 new observations averaging 0.5 each
+    assert dm(snap(6.0, 20)) == pytest.approx(0.5)
+
+    ewma = EwmaRegression(multiple=1.5, alpha=0.3, min_samples=3)
+    assert not ewma(0.1, [])   # warmup
+    assert not ewma(0.1, [])
+    assert not ewma(0.1, [])
+    assert not ewma(0.1, [])   # steady
+    assert ewma(0.5, [])       # 5x the baseline -> regression
+    # baseline keeps lagging the regression, so it keeps firing
+    assert ewma(0.5, [])
+
+
+def test_hbm_watermark_rule():
+    from ray_tpu._internal.alerts import AlertEngine, default_rules
+    emitted = []
+    engine = AlertEngine(rules=default_rules(), emit=emitted.append)
+    snaps = [
+        _gauge_snap("rtpu_accel_hbm_used_bytes", 95.0),
+        _gauge_snap("rtpu_accel_hbm_limit_bytes", 100.0),
+    ]
+    fired = engine.evaluate_once(snapshots=snaps, now=0.0)
+    assert [r["rule"] for r in fired] == ["hbm_watermark"]
+    assert fired[0]["severity"] == "CRITICAL"
+    assert fired[0]["value"] == pytest.approx(0.95)
+
+
+def test_gcs_alert_table_filters():
+    from ray_tpu._internal.gcs import GcsServer
+    gcs = GcsServer("alert-test")
+
+    async def run():
+        await gcs.handle_add_alert(rule="a", message="m1",
+                                   severity="WARNING",
+                                   fields={"value": 1.0})
+        mid = time.time()
+        await asyncio.sleep(0.01)
+        await gcs.handle_add_alert(rule="b", message="m2",
+                                   severity="CRITICAL")
+        await gcs.handle_add_alert(rule="a", message="m3",
+                                   severity="WARNING")
+        all_rows = await gcs.handle_get_alerts()
+        assert [r["rule"] for r in all_rows] == ["a", "b", "a"]
+        assert all_rows[0]["value"] == 1.0
+        only_a = await gcs.handle_get_alerts(rule="a")
+        assert [r["message"] for r in only_a] == ["m1", "m3"]
+        crit = await gcs.handle_get_alerts(severity="CRITICAL")
+        assert [r["rule"] for r in crit] == ["b"]
+        recent = await gcs.handle_get_alerts(since=mid)
+        assert [r["message"] for r in recent] == ["m2", "m3"]
+        limited = await gcs.handle_get_alerts(limit=1)
+        assert [r["message"] for r in limited] == ["m3"]
+
+    asyncio.run(run())
+    assert gcs.alerts.maxlen == int(CONFIG.alert_log_max_entries)
+    # every alert also lands as an SLO_ALERT event in the event log
+    assert sum(1 for e in gcs.events
+               if e.get("type") == "SLO_ALERT") == 3
+
+
+# ---------------------------------------------------------------------------
+# goodput comm bucket + StepTimer spans
+# ---------------------------------------------------------------------------
+
+
+def test_report_step_comm_bucket_and_clamp():
+    from ray_tpu._internal import accel
+    res = accel.report_step("train", 1.0, tokens=10, device_s=0.4,
+                            compile_s=0.1, comm_s=0.3)
+    assert res["comm_s"] == pytest.approx(0.3)
+    assert res["host_s"] == pytest.approx(0.2)
+    # comm is clamped to what's left after compile+device
+    res = accel.report_step("train", 1.0, device_s=0.9, comm_s=0.5)
+    assert res["comm_s"] == pytest.approx(0.1)
+    assert res["host_s"] == pytest.approx(0.0)
+
+    from ray_tpu.util.metrics import prometheus_text, snapshot_all
+    text = prometheus_text(snapshot_all())
+    assert 'rtpu_goodput_seconds_total{' in text
+    assert 'bucket="comm"' in text
+
+
+def test_step_timer_comm_span():
+    from ray_tpu._internal import accel
+    with accel.StepTimer("train") as t:
+        with t.comm():
+            time.sleep(0.01)
+    assert t.comm_s >= 0.009
+    assert t.result is not None
+    assert t.result["comm_s"] == pytest.approx(t.comm_s)
+
+
+def test_device_span_subtracts_compile_overlap():
+    from ray_tpu._internal import accel
+    with accel.StepTimer("train") as t:
+        with t.device():
+            time.sleep(0.005)
+            # simulate an XLA recompile landing inside the device span
+            # (first call of a freshly-traced step fn)
+            with accel._TRACKER.lock:
+                accel._TRACKER.backend_seconds += 100.0
+    # the 100 compile-seconds must NOT be billed as device compute
+    assert 0.0 <= t.device_s < 1.0
+
+
+# ---------------------------------------------------------------------------
+# pipeline bubble exposition
+# ---------------------------------------------------------------------------
+
+
+def test_export_pipeline_metrics_deltas_and_exposition():
+    from ray_tpu.train.pipeline_mpmd import export_pipeline_metrics
+
+    def report(busy_by_stage, span):
+        busy = sum(busy_by_stage.values())
+        return {"span_s": span,
+                "bubble_fraction": 1.0 - busy / (len(busy_by_stage) * span),
+                "per_stage": [{"stage": s, "busy_s": b}
+                              for s, b in busy_by_stage.items()]}
+
+    exported = {}
+    export_pipeline_metrics(report({"0": 2.0, "1": 1.0}, 4.0), exported)
+    assert exported == {"0": 2.0, "1": 1.0}
+    # second window: cumulative busy grew by 1.0 on stage 0
+    export_pipeline_metrics(report({"0": 3.0, "1": 1.0}, 4.0), exported)
+    assert exported["0"] == 3.0
+    # a window reset (busy below the exported base) restarts the base
+    # instead of rewinding the counter
+    export_pipeline_metrics(report({"0": 0.5, "1": 1.0}, 4.0), exported)
+    assert exported["0"] == 0.5
+
+    from ray_tpu.util.metrics import prometheus_text, snapshot_all
+    text = prometheus_text(snapshot_all())
+    assert "rtpu_pipeline_bubble_fraction{" in text
+    assert 'stage="all"' in text
+    assert "rtpu_pipeline_stage_busy_seconds_total{" in text
+    # stage-0 counter: 2.0 + 1.0 delta + 0.5 post-reset
+    assert 'rtpu_pipeline_stage_busy_seconds_total{stage="0"} 3.5' in text
+
+
+def test_collective_wait_and_link_exposition():
+    from ray_tpu.util.collective import collective as col
+    m = col._metrics()
+    m.wait_seconds.observe(0.04, tags={"rank": "2"})
+    m.link_gbps.set(1.25, tags={"link": "ici"})
+    from ray_tpu.util.metrics import prometheus_text, snapshot_all
+    text = prometheus_text(snapshot_all())
+    assert "rtpu_collective_wait_seconds_bucket{" in text
+    assert 'rank="2"' in text
+    assert 'rtpu_collective_link_gbps{link="ici"} 1.25' in text
+
+
+# ---------------------------------------------------------------------------
+# lint L010 (metric-catalog sync)
+# ---------------------------------------------------------------------------
+
+
+def test_lint_metric_catalog_sync(tmp_path):
+    from ray_tpu._internal.lint import _check_metric_catalog
+    from ray_tpu._internal.lint.rules import MetricDecl
+    (tmp_path / "README.md").write_text(
+        "prose mentioning `rtpu_not_a_row` outside any table\n"
+        "| series | kind |\n"
+        "|---|---|\n"
+        "| `rtpu_known_total` | counter |\n"
+        "| `rtpu_pair_a` / `rtpu_pair_b` | gauge |\n"
+        "| `rtpu_stale_total` | counter |\n"
+        "| L004 | rule row whose first cell has no `rtpu_x_total` |\n")
+    decls = [
+        MetricDecl("rtpu_known_total", "Counter", (), "a.py", 1, "s"),
+        MetricDecl("rtpu_pair_a", "Gauge", (), "a.py", 2, "s"),
+        MetricDecl("rtpu_pair_b", "Gauge", (), "a.py", 3, "s"),
+        MetricDecl("rtpu_uncataloged", "Gauge", (), "b.py", 9, "t"),
+    ]
+    violations = _check_metric_catalog(decls, str(tmp_path))
+    assert {(v.rule, v.path, v.scope) for v in violations} == {
+        ("L010", "b.py", "t"),              # constructed, no row
+        ("L010", "README.md", "rtpu_stale_total"),  # row, no decl
+    }
+    # without a README the check is a no-op, not a flag-everything
+    assert _check_metric_catalog(decls, str(tmp_path / "nope")) == []
+
+
+def test_lint_tree_is_catalog_clean():
+    """The real tree: every constructed series cataloged, no stale rows
+    (the README catalog is load-bearing, enforced both directions)."""
+    from ray_tpu._internal.lint import (_check_metric_catalog,
+                                        lint_source, iter_source_files,
+                                        package_root)
+    root = package_root()
+    decls = []
+    for path in iter_source_files(root):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        import os
+        _v, d, _sd, _sa = lint_source(src, os.path.relpath(path, root))
+        decls.extend(d)
+    assert decls, "metric declarations should be discoverable"
+    assert _check_metric_catalog(decls, root) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: seeded chaos delay -> straggler event -> SLO alert
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.timeout_s(180)
+def test_chaos_delay_trips_straggler_and_slo_alert():
+    import numpy as np  # noqa: F401 — actors import it remotely
+
+    import ray_tpu
+    from ray_tpu._internal.alerts import AlertEngine, default_rules
+    from ray_tpu._internal.core_worker import get_core_worker
+    from ray_tpu.util import state as st
+    from ray_tpu.util.metrics import collect_cluster_metrics
+
+    world, group, ops = 4, "flightdeck-e2e", 6
+    ray_tpu.init(num_cpus=world + 1)
+    try:
+        @ray_tpu.remote(num_cpus=1)
+        class Rank:
+            def __init__(self, rank):
+                self.rank = rank
+
+            def join(self, chaos_spec=""):
+                if chaos_spec:
+                    from ray_tpu._internal.chaos import REGISTRY
+                    REGISTRY.arm(spec=chaos_spec, seed=7)
+                from ray_tpu.util.collective import collective as col
+                col.init_collective_group(world, self.rank,
+                                          group_name=group)
+                return True
+
+            def run_ops(self, n):
+                import numpy as np
+
+                from ray_tpu.util.collective import collective as col
+                for _ in range(n):
+                    col.allreduce(np.arange(64, dtype=np.int64),
+                                  group_name=group)
+                return col._group(group).straggler_summary()
+
+            def flush(self):
+                from ray_tpu.util import metrics
+                return metrics.flush_now()
+
+        actors = [Rank.remote(r) for r in range(world)]
+        # rank 1's process delays every incoming collective hop 50ms:
+        # it enters each subsequent op late, and rank 0 (the star root,
+        # the only multi-peer observer) attributes the skew to it
+        spec = "collective_msg:delay:1.0:0.05"
+        ray_tpu.get([a.join.remote(spec if r == 1 else "")
+                     for r, a in enumerate(actors)], timeout=120)
+        summaries = ray_tpu.get([a.run_ops.remote(ops) for a in actors],
+                                timeout=120)
+
+        flagged = summaries[0]["flagged"]
+        assert flagged, "rank-0 observer must flag the seeded straggler"
+        assert all(row["rank"] == 1 for row in flagged)
+        assert flagged[0]["wait_s"] >= 0.02
+        # ranks that only hear from the star root have no cross-peer
+        # context and must not counter-accuse anyone (their summary is
+        # None when no wait was ever attributed at all)
+        for s in summaries[1:]:
+            assert s is None or not s["flagged"]
+
+        events = st.list_events(event_type="STRAGGLER_DETECTED")
+        assert events and events[-1]["rank"] == 1
+        assert st.stragglers()["events"]
+
+        # one deterministic alert-engine pass over the cluster's
+        # flushed metrics must trip the collective-wait p95 SLO
+        ray_tpu.get([a.flush.remote() for a in actors], timeout=60)
+        engine = AlertEngine(rules=default_rules())
+        fired = engine.evaluate_once(
+            snapshots=collect_cluster_metrics(get_core_worker().gcs))
+        assert "collective_wait_p95" in [r["rule"] for r in fired]
+        rows = st.alerts(rule="collective_wait_p95")
+        assert rows and rows[-1]["severity"] == "WARNING"
+        assert st.alerts(severity="CRITICAL") == [
+            r for r in st.alerts() if r["severity"] == "CRITICAL"]
+    finally:
+        ray_tpu.shutdown()
