@@ -784,6 +784,40 @@ def _unboxed_init(model, rng, tokens):
     return unbox(model.init(rng, tokens)["params"])
 
 
+def serve_bench(out_path: str = "BENCH_serve_r01.json") -> dict:
+    """LLM serving headline (`bench.py --serve`): the in-process
+    continuous-batching vs RTPU_NO_CONT_BATCH legacy engine A/B plus
+    the radix shared-prefix arm — req/s, p50/p95 TTFT, prefill FLOPs
+    saved — recorded as a BENCH_serve JSON artifact."""
+    from ray_tpu.perf_workloads import serve_engine_ab
+
+    ab = serve_engine_ab()
+    result = {
+        "metric": "llm_serve_engine_ab",
+        "backend": jax.default_backend(),
+        "requests": ab["continuous"]["requests"],
+        "continuous": {k: ab["continuous"][k] for k in
+                       ("requests_per_s", "decode_tokens_per_s",
+                        "ttft_p50_s", "ttft_p95_s", "prefill_tokens",
+                        "preemptions", "leaked_pages")},
+        "legacy": {k: ab["legacy"][k] for k in
+                   ("requests_per_s", "decode_tokens_per_s",
+                    "ttft_p50_s", "ttft_p95_s", "prefill_tokens",
+                    "preemptions", "leaked_pages")},
+        "radix_shared_prefix": {
+            k: ab["radix_shared_prefix"][k] for k in
+            ("prefill_tokens", "prompt_tokens_submitted",
+             "prefill_tokens_saved_frac", "shared_prefix_hits")},
+        "gates": ab["gates"],
+        "passed": ab["passed"],
+    }
+    print(json.dumps(result))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 if __name__ == "__main__":
     import sys
     if "--dryrun7b" in sys.argv:
@@ -793,5 +827,7 @@ if __name__ == "__main__":
             gspmd_parity_dryrun()
     elif "--multichip" in sys.argv:
         multichip_ab(out_path="MULTICHIP_r06.json")
+    elif "--serve" in sys.argv:
+        serve_bench()
     else:
         main()

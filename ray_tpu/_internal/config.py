@@ -284,6 +284,11 @@ _DEFAULTS: Dict[str, Any] = {
     # (S-1)/(S-1+M) on parallel hardware; more microbatches = smaller
     # bubble, more in-flight activation memory).
     "train_pipeline_microbatches": 4,
+    # --- LLM serving (llm/paged.py) ---
+    # Prefix-cache entry ceiling: radix-tree nodes (continuous batching)
+    # or token-tuple LRU entries (legacy arm) kept before LRU eviction
+    # of refcount-1 leaves. Each entry pins one KV page.
+    "prefix_cache_entries": 128,
     # --- A/B kill switches (every switch lives here so a typo'd
     # RTPU_* spelling is caught by rtpulint rule L003 instead of
     # silently doing nothing) ---
@@ -316,6 +321,10 @@ _DEFAULTS: Dict[str, Any] = {
     # a no-op context (one flag check), nothing is recorded or flushed,
     # and the collective straggler detector stops attributing waits.
     "no_steptrace": False,
+    # Kill switch for continuous batching in the paged LLM engine:
+    # exact-legacy per-drain admission (blocking inline prefill, upfront
+    # page reservation, token-tuple prefix LRU, no preemption).
+    "no_cont_batch": False,
     # --- overrides re-read from the environment at their use site
     # (tests monkeypatch them after CONFIG construction; registered here
     # so L003 can resolve the names) ---

@@ -12,10 +12,11 @@ from .disagg import (PDDecodeServer, PrefillServer, build_pd_disagg_app)
 from .engine import EngineConfig, GenerationRequest, LLMEngine
 from .openai import ByteTokenizer, OpenAIServer, build_openai_app
 from .paged import PagedEngineConfig, PagedLLMEngine
+from .radix import RadixPrefixCache
 from .serving import LLMServer, build_llm_deployment
 
 __all__ = ["EngineConfig", "GenerationRequest", "LLMEngine",
            "PagedEngineConfig", "PagedLLMEngine", "LLMServer",
            "build_llm_deployment", "OpenAIServer", "build_openai_app",
            "ByteTokenizer", "PrefillServer", "PDDecodeServer",
-           "build_pd_disagg_app"]
+           "build_pd_disagg_app", "RadixPrefixCache"]

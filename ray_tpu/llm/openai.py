@@ -135,6 +135,12 @@ class OpenAIServer(LLMServer):
                          "choices": [{"index": 0, "text": text,
                                       "finish_reason": None}]}
             events.append(f"data: {json.dumps(chunk)}\n\n")
+        if batch.get("error"):
+            # mid-stream engine failure: surface it as an SSE event so
+            # the client sees the error, not a silent [DONE]
+            events.append("data: " + json.dumps(
+                {"error": {"message": batch["error"],
+                           "type": "engine_error"}}) + "\n\n")
         if batch["done"]:
             self._sse.pop(stream_id, None)
             events.append("data: [DONE]\n\n")

@@ -9,6 +9,18 @@ vs the slot engine (`engine.py`): HBM scales with tokens-in-flight
 (`num_pages x page_size`), not `max_batch x max_len`; full prompt pages
 shared byte-identically across requests via a prefix hash (system
 prompts stored once); admission blocks on page budget, not slot shape.
+
+Scheduling is CONTINUOUS (iteration-level) by default: every tick fills
+freed slots from the waiting queue, advances at most
+`prefill_decode_ratio` chunked-prefill chunks interleaved with the
+decode batch, and under page pressure preempts the youngest sequence
+(pages released, request parked for re-admission with its generated
+tokens as a prompt extension) instead of exhausting the pool. Prefix
+reuse rides a radix tree over KV pages (`radix.py`): admission maps the
+longest cached prefix copy-on-write into the block table and prefills
+only the tail. RTPU_NO_CONT_BATCH=1 is the exact-legacy per-drain A/B
+arm (blocking inline prefill, upfront page reservation, token-tuple
+prefix LRU, no preemption).
 """
 
 from __future__ import annotations
@@ -25,9 +37,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .._internal.config import CONFIG
 from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
 from ._metrics import llm_metrics
 from .engine import GenerationRequest
+from .radix import RadixPrefixCache
 
 _TAGS = {"engine": "paged"}
 # gauges are per-process series (see _metrics.py on the merge semantics)
@@ -45,6 +59,9 @@ class PagedEngineConfig:
     temperature: float = 0.0
     eos_token: Optional[int] = None
     seed: int = 0
+    # continuous batching: prefill chunks advanced per scheduler tick
+    # (bounds how much prefill compute a tick may steal from decode)
+    prefill_decode_ratio: int = 1
 
     @property
     def pages_per_seq(self) -> int:
@@ -88,6 +105,15 @@ class _Seq:
     generated: List[int] = dataclasses.field(default_factory=list)
     last_token: int = 0
     cancelled: bool = False
+    # continuous-batching state
+    phase: str = "decode"        # "prefill" until the prompt is cached
+    prompt: List[int] = dataclasses.field(default_factory=list)
+    # tokens generated before a preemption, re-prefilled as prompt
+    resume: List[int] = dataclasses.field(default_factory=list)
+    prefill_off: int = 0         # prompt tokens cached so far
+    dense_caches: Any = None     # in-flight chunked-prefill cache
+    last_logits: Any = None
+    admit_at: int = 0            # admission order (preemption picks max)
 
 
 class PagedLLMEngine:
@@ -161,6 +187,25 @@ class PagedLLMEngine:
         self.k_pages = [_zero_pages() for _ in range(cfg.num_layers)]
         self.v_pages = [_zero_pages() for _ in range(cfg.num_layers)]
         self.pool = PagePool(P)
+        # scheduling mode: continuous (per-tick admission, chunked
+        # prefill interleave, preemption, radix prefix tree) unless the
+        # exact-legacy kill switch is set. Read once — a mode is an
+        # engine-lifetime property, not a per-tick branch.
+        self._continuous = not CONFIG.no_cont_batch
+        self.radix: Optional[RadixPrefixCache] = None
+        if self._continuous:
+            self.radix = RadixPrefixCache(
+                self.pool, ps,
+                max_entries=int(CONFIG.prefix_cache_entries))
+        # waiting queue (continuous mode): _pending is the thread-safe
+        # ingress; the tick drains it into _parked, which also receives
+        # preempted requests at its FRONT (they re-admit first)
+        self._parked: "collections.deque" = collections.deque()
+        self._admit_clock = 0
+        self._preemptions = 0
+        # recent TTFTs feed autoscaling_metrics() (median over a window)
+        self._recent_ttfts: "collections.deque" = collections.deque(
+            maxlen=64)
         # prefix cache: hash(token-prefix through page k) -> per-layer page
         self.prefix_pages: Dict[Tuple, List[int]] = {}
         # true LRU: ordered keys, O(1) move-to-end on hit / popitem on
@@ -252,18 +297,24 @@ class PagedLLMEngine:
                         start_tok):
             """Scatter pages of a [1, kvh, L, hd] dense prefill cache
             into the pools at physical ids `page_ids`, starting at token
-            offset `start_tok` (traced: no recompile per prefix hit)."""
+            offset `start_tok`. `page_ids` is padded to pages_per_seq
+            with the null page so there is ONE compiled shape per
+            dense-cache length (a per-sequence page count would compile
+            a program per distinct tail size); clamped gathers send the
+            pad lanes' garbage to the reserved null page, never a live
+            one."""
             ps_ = config.page_size
+            n = page_ids.shape[0]
             nk, nv = [], []
             for (kp, vp, (dk, dv)) in zip(k_pages, v_pages, dense_caches):
-                # [1, kvh, L, hd] -> [n, kvh, ps, hd] page-major rows
-                seg_k = jax.lax.dynamic_slice_in_dim(
-                    dk[0], start_tok, page_ids.shape[0] * ps_, axis=1)
-                seg_v = jax.lax.dynamic_slice_in_dim(
-                    dv[0], start_tok, page_ids.shape[0] * ps_, axis=1)
+                # [1, kvh, L, hd] -> [kvh, n, ps, hd] page-major rows
+                idx = start_tok + jnp.arange(n * ps_, dtype=jnp.int32)
+                idx = jnp.minimum(idx, dk.shape[2] - 1)
+                seg_k = jnp.take(dk[0], idx, axis=1)
+                seg_v = jnp.take(dv[0], idx, axis=1)
                 kvh_ = seg_k.shape[0]
-                seg_k = seg_k.reshape(kvh_, page_ids.shape[0], ps_, -1)
-                seg_v = seg_v.reshape(kvh_, page_ids.shape[0], ps_, -1)
+                seg_k = seg_k.reshape(kvh_, n, ps_, -1)
+                seg_v = seg_v.reshape(kvh_, n, ps_, -1)
                 uk = kp.at[:, page_ids].set(seg_k.astype(kp.dtype))
                 uv = vp.at[:, page_ids].set(seg_v.astype(vp.dtype))
                 if page_sharding is not None:
@@ -275,6 +326,36 @@ class PagedLLMEngine:
 
         self._write_pages = jax.jit(write_pages, donate_argnums=(0, 1),
                                     static_argnums=())
+        dense_sharding = self._dense_sharding
+
+        def gather_pages(k_pages, v_pages, dense_caches, page_ids):
+            """Inverse of write_pages: copy pooled pages into the head
+            of a dense prefill cache, so a radix-shared prefix span is
+            attended over without recomputing it (zero prefill FLOPs
+            for the span). `page_ids` is padded to pages_per_seq with
+            the null page for a single compiled shape; padded garbage
+            lands at or after the first real tail position, so it is
+            either overwritten by the tail chunks or causally masked."""
+            out = []
+            for (kp, vp, (dk, dv)) in zip(k_pages, v_pages, dense_caches):
+                kvh_ = kp.shape[0]
+                seg_k = kp[:, page_ids].reshape(
+                    kvh_, -1, kp.shape[-1])[None]
+                seg_v = vp[:, page_ids].reshape(
+                    kvh_, -1, vp.shape[-1])[None]
+                ndk = jax.lax.dynamic_update_slice_in_dim(
+                    dk, seg_k.astype(dk.dtype), 0, axis=2)
+                ndv = jax.lax.dynamic_update_slice_in_dim(
+                    dv, seg_v.astype(dv.dtype), 0, axis=2)
+                if dense_sharding is not None:
+                    ndk = jax.lax.with_sharding_constraint(
+                        ndk, dense_sharding)
+                    ndv = jax.lax.with_sharding_constraint(
+                        ndv, dense_sharding)
+                out.append((ndk, ndv))
+            return out
+
+        self._gather_pages = jax.jit(gather_pages, donate_argnums=(2,))
 
     def _mesh_scope(self):
         """Context for jit calls: marks the serving mesh active so the
@@ -342,6 +423,19 @@ class PagedLLMEngine:
             pass
         for r in kept:
             self._pending.put(r)
+        if not found:
+            # continuous-mode waiting queue (drained arrivals and
+            # preemption-parked requests)
+            for entry in list(self._parked):
+                r = entry[0] if isinstance(entry, tuple) else entry
+                if r.request_id == request_id:
+                    try:
+                        self._parked.remove(entry)
+                    except ValueError:
+                        break  # admitted concurrently
+                    found = True
+                    dropped = r
+                    break
         if dropped is not None:
             # queued cancellations must still resolve their waiters
             llm_metrics().requests_finished.inc(
@@ -352,7 +446,7 @@ class PagedLLMEngine:
         return found
 
     def has_work(self) -> bool:
-        return (not self._pending.empty()) or \
+        return (not self._pending.empty()) or bool(self._parked) or \
             any(s.request is not None for s in self.seqs)
 
     def fail_all(self, error: Exception):
@@ -370,21 +464,21 @@ class PagedLLMEngine:
             callback = getattr(request, "_done_callback", None)
             if callback is not None:
                 callback(request, error)
-        try:
-            while True:
-                entry = self._pending.get_nowait()
-                r = entry[0] if isinstance(entry, tuple) else entry
-                llm_metrics().requests_finished.inc(
-                    tags=dict(_TAGS, outcome="error"))
-                callback = getattr(r, "_done_callback", None)
-                if callback is not None:
-                    callback(r, error)
-        except queue.Empty:
-            pass
+        self._drain_pending()
+        while self._parked:
+            entry = self._parked.popleft()
+            r = entry[0] if isinstance(entry, tuple) else entry
+            llm_metrics().requests_finished.inc(
+                tags=dict(_TAGS, outcome="error"))
+            callback = getattr(r, "_done_callback", None)
+            if callback is not None:
+                callback(r, error)
 
     # -- scheduler tick ----------------------------------------------------
 
     def step(self) -> List[Tuple[GenerationRequest, Any]]:
+        if self._continuous:
+            return self._step_continuous()
         self._admit()
         finished = []
         active = [i for i, s in enumerate(self.seqs)
@@ -396,15 +490,334 @@ class PagedLLMEngine:
             # never lags a drained engine by up to `every` ticks
             self._step_accum.flush()
         self._steps += 1
+        self._set_gauges()
+        return finished
+
+    def _step_continuous(self) -> List[Tuple[GenerationRequest, Any]]:
+        """One continuous-batching tick: reap cancellations, fill freed
+        slots from the waiting queue (radix prefix match, tail-only
+        prefill setup), advance bounded chunked prefill, then decode the
+        running batch — admission happens every tick, not per drain."""
+        finished: List[Tuple[GenerationRequest, Any]] = []
+        self._reap_cancelled()
+        self._admit_continuous()
+        self._prefill_tick(finished)
+        active = [i for i, s in enumerate(self.seqs)
+                  if s.request is not None and s.phase == "decode"]
+        if active:
+            finished.extend(self._decode_tick(active))
+        elif self._step_accum is not None:
+            self._step_accum.flush()
+        self._steps += 1
+        self._set_gauges()
+        return finished
+
+    def _waiting_count(self) -> int:
+        return self._pending.qsize() + len(self._parked)
+
+    def _set_gauges(self):
         metrics = llm_metrics()
-        metrics.queue_depth.set(self._pending.qsize(), tags=_GAUGE_TAGS)
+        metrics.queue_depth.set(self._waiting_count(), tags=_GAUGE_TAGS)
         metrics.running.set(
             sum(1 for s in self.seqs if s.request is not None),
             tags=_GAUGE_TAGS)
+        free = self.pool.num_free()
         metrics.kv_utilization.set(
-            1.0 - self.pool.num_free() / max(1, self.config.num_pages),
-            tags=_GAUGE_TAGS)
-        return finished
+            1.0 - free / max(1, self.config.num_pages), tags=_GAUGE_TAGS)
+        metrics.kv_occupancy.set(self.config.num_pages - 1 - free,
+                                 tags=_GAUGE_TAGS)
+        metrics.waiting.set(self._waiting_count(), tags=_GAUGE_TAGS)
+        if self.radix is not None:
+            shared = self.radix.shared_pages()
+        else:
+            shared = sum(1 for p in self.prefix_pinned_pages()
+                         if self.pool.refs[p] > 1)
+        metrics.shared_pages.set(shared, tags=_GAUGE_TAGS)
+
+    def _reap_cancelled(self):
+        """Release cancelled sequences in ANY phase (a mid-prefill
+        cancel must return its pages too) before admission reuses the
+        slots."""
+        for i, seq in enumerate(self.seqs):
+            if seq.request is None or not seq.cancelled:
+                continue
+            request = seq.request
+            self._release(seq)
+            self.seqs[i] = _Seq()
+            llm_metrics().requests_finished.inc(
+                tags=dict(_TAGS, outcome="cancelled"))
+            callback = getattr(request, "_done_callback", None)
+            if callback is not None:
+                callback(request, None)  # None = cancelled
+
+    def _drain_pending(self):
+        try:
+            while True:
+                self._parked.append(self._pending.get_nowait())
+        except queue.Empty:
+            pass
+
+    def _next_admit_id(self) -> int:
+        self._admit_clock += 1
+        return self._admit_clock
+
+    def _admit_continuous(self):
+        self._drain_pending()
+        for index, seq in enumerate(self.seqs):
+            if seq.request is not None:
+                continue
+            if not self._parked:
+                return
+            entry = self._parked.popleft()
+            prefilled = isinstance(entry, tuple)
+            request = entry[0] if prefilled else entry
+            try:
+                if prefilled:
+                    # disaggregated prefill: the KV arrives whole, so
+                    # this admission reserves pages up front (legacy
+                    # budget), prefix machinery still rides the radix
+                    need = self._pages_needed(request)
+                    if self.pool.num_free() < need and \
+                            self.radix is not None:
+                        self.radix.evict_pages(
+                            need - self.pool.num_free())
+                    if self.pool.num_free() < need:
+                        self._parked.appendleft(entry)
+                        return
+                    self._admit_prefilled(index, request, entry[1],
+                                          entry[2])
+                elif not self._begin_prefill(index, request):
+                    self._parked.appendleft(entry)
+                    return
+            except Exception as e:  # noqa: BLE001
+                llm_metrics().requests_finished.inc(
+                    tags=dict(_TAGS, outcome="error"))
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, e)
+
+    def _begin_prefill(self, index: int,
+                       request: GenerationRequest) -> bool:
+        """Admit a request into the prefill phase: radix-match the
+        longest cached prefix (mapped copy-on-write into the block
+        table), allocate only the tail prompt pages, and gather the
+        shared span into the dense chunk cache so the tail attends over
+        it without recomputing. Returns False when pages are short even
+        after pressure eviction (caller re-parks the request)."""
+        cfg = self.config
+        ps = cfg.page_size
+        resume = list(getattr(request, "_resume_tokens", []))
+        prompt = list(request.prompt_tokens) + resume
+        shared = self._match_prefix(prompt)
+        n_prompt_pages = -(-len(prompt) // ps)
+        tail_pages = n_prompt_pages - len(shared)
+        if self.pool.num_free() < tail_pages:
+            if self.radix is not None:
+                self.radix.evict_pages(
+                    tail_pages - self.pool.num_free())
+            if self.pool.num_free() < tail_pages:
+                for page in shared:
+                    self.pool.decref(page)
+                return False
+        new_ids = []
+        for _ in range(tail_pages):
+            page = self.pool.alloc()
+            assert page is not None, "budget checked above"
+            new_ids.append(page)
+        with self._mesh_scope():
+            dense = self._dense_zero_caches()
+            if shared:
+                pad = np.zeros(cfg.pages_per_seq, np.int32)
+                pad[:len(shared)] = shared
+                dense = self._gather_pages(self.k_pages, self.v_pages,
+                                           dense, jnp.asarray(pad))
+        seq = self.seqs[index]
+        seq.request = request
+        seq.prompt = prompt
+        seq.resume = resume
+        seq.phase = "prefill"
+        seq.pages = shared + new_ids
+        seq.own_from = len(shared)
+        seq.length = 0
+        seq.generated = []
+        seq.last_token = 0
+        seq.cancelled = False
+        seq.prefill_off = len(shared) * ps
+        seq.dense_caches = dense
+        seq.last_logits = None
+        seq.admit_at = self._next_admit_id()
+        self._by_id[request.request_id] = seq
+        return True
+
+    def _prefill_tick(self, finished: List):
+        """Advance at most `prefill_decode_ratio` prefill chunks,
+        round-robin across prefilling sequences in admission order, so
+        a long prompt never stalls the decode batch for more than one
+        bounded chunk per tick."""
+        budget = max(1, int(self.config.prefill_decode_ratio))
+        order = sorted(
+            (i for i, s in enumerate(self.seqs)
+             if s.request is not None and s.phase == "prefill"
+             and not s.cancelled),
+            key=lambda i: self.seqs[i].admit_at)
+        if not any(s.request is not None and s.phase == "decode"
+                   for s in self.seqs):
+            # nothing decoding → no decode latency to protect; drain
+            # the prefill backlog at full speed (cold-start ramp)
+            budget = max(budget, len(order))
+        while budget > 0 and order:
+            i = order.pop(0)
+            seq = self.seqs[i]
+            self._prefill_chunk(seq)
+            budget -= 1
+            if seq.prefill_off >= len(seq.prompt):
+                self._finish_prefill(i, finished)
+            else:
+                order.append(i)
+
+    def _prefill_chunk(self, seq: _Seq):
+        """One bucket-rounded chunk of `seq`'s remaining prompt into its
+        dense cache (same program as the legacy inline prefill — one
+        compiled shape per bucket)."""
+        cfg = self.config
+        prompt = seq.prompt
+        largest = cfg.prefill_buckets[-1]
+        off = seq.prefill_off
+        rem = len(prompt) - off
+        chunk = self._bucket(min(rem, largest))
+        take = min(rem, chunk)
+        tokens = np.zeros((1, chunk), np.int32)
+        tokens[0, :take] = prompt[off:off + take]
+        positions = np.minimum(
+            np.arange(off, off + chunk, dtype=np.int32),
+            cfg.model.max_seq_len - 1)[None, :]
+        with self._mesh_scope():
+            logits, seq.dense_caches = self._chunk_prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                seq.dense_caches, jnp.asarray(off, jnp.int32))
+        if off + take == len(prompt):
+            seq.last_logits = np.asarray(logits[0, take - 1], np.float64)
+        seq.prefill_off = off + take
+        # counts COMPUTED tokens only — a radix-shared span costs zero
+        # here, which is exactly the prefill-FLOPs win the A/B measures
+        llm_metrics().prefill_tokens.inc(take, tags=_TAGS)
+
+    def _write_owned_pages(self, dense_caches, write_ids, start_page):
+        """Commit owned prompt pages from a dense prefill cache to the
+        pools. The id list is padded to pages_per_seq with the null
+        page so `_write_pages` keeps one compiled shape per dense-cache
+        length instead of one per tail size."""
+        cfg = self.config
+        ids = list(write_ids) + [0] * (cfg.pages_per_seq
+                                       - len(write_ids))
+        with self._mesh_scope():
+            self.k_pages, self.v_pages = self._write_pages(
+                self.k_pages, self.v_pages, dense_caches,
+                jnp.asarray(ids, jnp.int32),
+                jnp.asarray(start_page * cfg.page_size, jnp.int32))
+
+    def _finish_prefill(self, index: int, finished: List):
+        """Prompt fully cached: write the owned tail pages, commit full
+        pages to the radix, sample the first token from the prefill
+        logits, and move the sequence to the decode phase."""
+        cfg = self.config
+        ps = cfg.page_size
+        seq = self.seqs[index]
+        request = seq.request
+        prompt = seq.prompt
+        write_ids = seq.pages[seq.own_from:]
+        if write_ids:
+            self._write_owned_pages(seq.dense_caches, write_ids,
+                                    seq.own_from)
+        seq.dense_caches = None
+        self._register_prefix(prompt, seq.pages)
+        first_token = self._first_token(request, seq.last_logits)
+        seq.last_logits = None
+        seq.phase = "decode"
+        seq.length = len(prompt)
+        seq.generated = [first_token]
+        seq.last_token = first_token
+        self._tokens_generated += 1
+        metrics = llm_metrics()
+        submit_ts = getattr(request, "_submit_ts", None)
+        if submit_ts is not None and not seq.resume:
+            ttft = time.monotonic() - submit_ts
+            metrics.ttft.observe(ttft, tags=_TAGS)
+            self._recent_ttfts.append(ttft)
+        self._emit_token(seq, first_token)
+        if seq.resume:
+            # a resumed sequence may hit its budget/eos on the token the
+            # tail prefill just produced — apply the decode-tick finish
+            # conditions here so resume never overshoots the unpreempted
+            # run (token parity)
+            hit_eos = (cfg.eos_token is not None
+                       and first_token == cfg.eos_token)
+            total = len(seq.resume) + len(seq.generated)
+            if hit_eos or total >= request.max_new_tokens \
+                    or seq.length >= cfg.max_len - 1:
+                tokens = seq.resume + list(seq.generated)
+                finished.append((request, tokens))
+                callback = getattr(request, "_done_callback", None)
+                if callback is not None:
+                    callback(request, tokens)
+                self._release(seq)
+                self.seqs[index] = _Seq()
+                metrics.requests_finished.inc(
+                    tags=dict(_TAGS, outcome="done"))
+                if submit_ts is not None:
+                    metrics.request_latency.observe(
+                        time.monotonic() - submit_ts, tags=_TAGS)
+
+    def _alloc_page(self) -> Optional[int]:
+        """Allocate with radix pressure relief: cold unshared prefix
+        pages are reclaimed before giving up."""
+        page = self.pool.alloc()
+        if page is None and self.radix is not None \
+                and self.radix.evict_pages(1):
+            page = self.pool.alloc()
+        return page
+
+    def _ensure_decode_pages(self, active: List[int]) -> List[int]:
+        """Lazy page growth before the decode tick: every decoding
+        sequence needs the page its next token writes into. Under pool
+        exhaustion the YOUNGEST sequence is preempted (pages released,
+        request parked at the queue front with its generated tokens as
+        a prompt extension) until the rest fit — the continuous-batching
+        answer to OOM."""
+        ps = self.config.page_size
+        alive = sorted(active, key=lambda i: self.seqs[i].admit_at)
+        for i in list(alive):
+            if i not in alive:
+                continue
+            seq = self.seqs[i]
+            while seq.request is not None \
+                    and seq.length // ps >= len(seq.pages):
+                page = self._alloc_page()
+                if page is not None:
+                    seq.pages.append(page)
+                    continue
+                victims = [j for j in alive
+                           if self.seqs[j].request is not None]
+                victim = max(victims,
+                             key=lambda j: self.seqs[j].admit_at)
+                self._preempt(victim, reason="page_pressure")
+                alive.remove(victim)
+                if victim == i:
+                    break
+        return [i for i in alive if self.seqs[i].request is not None]
+
+    def _preempt(self, index: int, reason: str):
+        seq = self.seqs[index]
+        request = seq.request
+        # generated-so-far becomes a prompt extension; re-admission
+        # radix-matches the already-registered prompt pages, so only
+        # the generated span (plus the partial page) re-prefills
+        request._resume_tokens = seq.resume + list(seq.generated)
+        self._release(seq)
+        self.seqs[index] = _Seq()
+        self._parked.appendleft(request)
+        self._preemptions += 1
+        llm_metrics().preemptions.inc(tags=dict(_TAGS, reason=reason))
 
     def _pages_needed(self, request: GenerationRequest) -> int:
         total = len(request.prompt_tokens) + request.max_new_tokens
@@ -510,8 +923,88 @@ class PagedLLMEngine:
         dense_caches = [(jnp.asarray(k), jnp.asarray(v))
                         for (k, v) in dense_caches]
         # 1. prefix reuse: full pages whose token prefix is already pooled
-        shared: List[int] = []
+        shared = self._match_prefix(prompt)
+        n_pages = self._pages_needed(request)
+        new_ids = []
+        for _ in range(n_pages - len(shared)):
+            page = self.pool.alloc()
+            assert page is not None, "admission checked the budget"
+            new_ids.append(page)
+        # write only non-shared pages holding PROMPT tokens (shared ones
+        # are byte-identical by construction; generation-room pages are
+        # filled token-by-token at decode — and a disaggregated prefill
+        # ships a cache trimmed to exactly the prompt pages)
+        n_prompt_pages = -(-len(prompt) // ps)
+        write_ids = new_ids[:max(0, n_prompt_pages - len(shared))]
+        if write_ids:
+            self._write_owned_pages(dense_caches, write_ids, len(shared))
+        pages = shared + new_ids
+        # 3. register newly-complete full-page prefixes for reuse
+        self._register_prefix(prompt, pages)
+        # 4. first token from the prefill logits
+        first_token = self._first_token(request, last_logits)
+        seq = self.seqs[index]
+        seq.request = request
+        seq.prompt = list(prompt)
+        seq.resume = []
+        seq.phase = "decode"
+        seq.pages = pages
+        seq.own_from = len(shared)
+        seq.length = len(prompt)
+        seq.generated = [first_token]
+        seq.last_token = first_token
+        seq.cancelled = False
+        seq.admit_at = self._next_admit_id()
+        self._by_id[request.request_id] = seq
+        self._tokens_generated += 1
+        metrics = llm_metrics()
+        metrics.prefill_tokens.inc(len(prompt), tags=_TAGS)
+        submit_ts = getattr(request, "_submit_ts", None)
+        if submit_ts is not None:
+            ttft = time.monotonic() - submit_ts
+            metrics.ttft.observe(ttft, tags=_TAGS)
+            self._recent_ttfts.append(ttft)
+        self._emit_token(seq, first_token)
+
+    def _first_token(self, request: GenerationRequest,
+                     last_logits) -> int:
+        """First token from prefill logits (sampled when the request
+        asks for temperature > 0, mirroring the slot engine's branch —
+        engine.py:195-204 — so the two engines agree beyond greedy)."""
+        temp = request.temperature if request.temperature is not None \
+            else self.config.temperature
+        if temp > 0:
+            self._rng, key = jax.random.split(self._rng)
+            scaled = last_logits / max(temp, 1e-6)
+            # shared host-side filter (sampling.filter_logits) so the
+            # FIRST token honors the request's top_k/top_p too
+            from .sampling import filter_logits
+            scaled = filter_logits(
+                scaled, top_k=getattr(request, "top_k", None) or 0,
+                top_p=getattr(request, "top_p", None))
+            probs = np.exp(scaled - scaled.max())
+            probs /= probs.sum()
+            return int(np.random.default_rng(
+                int(jax.random.randint(key, (), 0, 2**31 - 1))
+            ).choice(len(probs), p=probs))
+        return int(np.argmax(last_logits))
+
+    def _match_prefix(self, prompt: List[int]) -> List[int]:
+        """Longest cached full-page prefix of `prompt`: refcounted page
+        ids the caller maps copy-on-write into its block table (radix
+        walk in continuous mode, token-tuple LRU on the legacy arm)."""
+        ps = self.config.page_size
         n_full = len(prompt) // ps
+        if self.radix is not None:
+            shared = self.radix.match(prompt)
+            if shared:
+                self._prefix_hits += 1
+                llm_metrics().prefix_hits.inc(tags=_TAGS)
+            elif n_full:
+                self._prefix_misses += 1
+                llm_metrics().prefix_misses.inc(tags=_TAGS)
+            return shared
+        shared: List[int] = []
         for k in range(n_full, 0, -1):
             key = tuple(prompt[:k * ps])
             hit = self.prefix_pages.get(key)
@@ -536,26 +1029,21 @@ class PagedLLMEngine:
             if n_full:
                 self._prefix_misses += 1
                 llm_metrics().prefix_misses.inc(tags=_TAGS)
-        n_pages = self._pages_needed(request)
-        new_ids = []
-        for _ in range(n_pages - len(shared)):
-            page = self.pool.alloc()
-            assert page is not None, "admission checked the budget"
-            new_ids.append(page)
-        # write only non-shared pages holding PROMPT tokens (shared ones
-        # are byte-identical by construction; generation-room pages are
-        # filled token-by-token at decode — and a disaggregated prefill
-        # ships a cache trimmed to exactly the prompt pages)
-        n_prompt_pages = -(-len(prompt) // ps)
-        write_ids = new_ids[:max(0, n_prompt_pages - len(shared))]
-        if write_ids:
-            with self._mesh_scope():
-                self.k_pages, self.v_pages = self._write_pages(
-                    self.k_pages, self.v_pages, dense_caches,
-                    jnp.asarray(write_ids, jnp.int32),
-                    jnp.asarray(len(shared) * ps, jnp.int32))
-        pages = shared + new_ids
-        # 3. register newly-complete full-page prefixes for reuse
+        return shared
+
+    def _register_prefix(self, prompt: List[int], pages: List[int]):
+        """Commit the full prompt pages for reuse, then enforce the
+        entry budget (`RTPU_PREFIX_CACHE_ENTRIES`)."""
+        ps = self.config.page_size
+        n_full = len(prompt) // ps
+        if self.radix is not None:
+            # re-read the flag so tests / live reconfig take effect
+            self.radix.max_entries = int(CONFIG.prefix_cache_entries)
+            if n_full:
+                self.radix.insert(prompt, pages[:n_full])
+            llm_metrics().prefix_entries.set(self.radix.entries,
+                                             tags=_GAUGE_TAGS)
+            return
         for k in range(1, n_full + 1):
             key = tuple(prompt[:k * ps])
             if key not in self.prefix_pages:
@@ -564,45 +1052,15 @@ class PagedLLMEngine:
                 self.prefix_pages[key] = pages[:k]
                 self._prefix_lru[key] = None
         self._evict_prefixes()
-        # 4. first token from the prefill logits (sampled when the request
-        # asks for temperature > 0, mirroring the slot engine's branch —
-        # engine.py:195-204 — so the two engines agree beyond greedy)
-        temp = request.temperature if request.temperature is not None \
-            else self.config.temperature
-        if temp > 0:
-            self._rng, key = jax.random.split(self._rng)
-            scaled = last_logits / max(temp, 1e-6)
-            # shared host-side filter (sampling.filter_logits) so the
-            # FIRST token honors the request's top_k/top_p too
-            from .sampling import filter_logits
-            scaled = filter_logits(
-                scaled, top_k=getattr(request, "top_k", None) or 0,
-                top_p=getattr(request, "top_p", None))
-            probs = np.exp(scaled - scaled.max())
-            probs /= probs.sum()
-            first_token = int(np.random.default_rng(
-                int(jax.random.randint(key, (), 0, 2**31 - 1))
-            ).choice(len(probs), p=probs))
-        else:
-            first_token = int(np.argmax(last_logits))
-        seq = self.seqs[index]
-        seq.request = request
-        seq.pages = pages
-        seq.own_from = len(shared)
-        seq.length = len(prompt)
-        seq.generated = [first_token]
-        seq.last_token = first_token
-        seq.cancelled = False
-        self._by_id[request.request_id] = seq
-        self._tokens_generated += 1
-        metrics = llm_metrics()
-        metrics.prefill_tokens.inc(len(prompt), tags=_TAGS)
-        submit_ts = getattr(request, "_submit_ts", None)
-        if submit_ts is not None:
-            metrics.ttft.observe(time.monotonic() - submit_ts, tags=_TAGS)
-        self._emit_token(seq, first_token)
 
-    def _evict_prefixes(self, max_entries: int = 128):
+    def _evict_prefixes(self, max_entries: Optional[int] = None):
+        if max_entries is None:
+            max_entries = int(CONFIG.prefix_cache_entries)
+        if self.radix is not None:
+            self.radix.evict(max_entries)
+            llm_metrics().prefix_entries.set(self.radix.entries,
+                                             tags=_GAUGE_TAGS)
+            return
         while len(self._prefix_lru) > max_entries:
             key, _ = self._prefix_lru.popitem(last=False)  # oldest first
             pages = self.prefix_pages.pop(key, None)
@@ -611,6 +1069,59 @@ class PagedLLMEngine:
                     self.pool.decref(page)
         llm_metrics().prefix_entries.set(len(self._prefix_lru),
                                          tags=_GAUGE_TAGS)
+
+    def prefix_pinned_pages(self) -> set:
+        """Distinct physical pages the prefix store holds a reference
+        on (radix nodes or legacy LRU entries)."""
+        if self.radix is not None:
+            return set(self.radix.pages())
+        return {p for pages in self.prefix_pages.values() for p in pages}
+
+    def release_prefix_cache(self) -> int:
+        """Evict every unshared prefix entry (pages mapped by live
+        sequences stay pinned until they release). Returns pages
+        freed back to the pool."""
+        before = self.pool.num_free()
+        self._evict_prefixes(max_entries=0)
+        return self.pool.num_free() - before
+
+    def page_leak_check(self) -> int:
+        """Pool-balance audit: recompute every page's expected refcount
+        from live sequences plus the prefix store and compare against
+        the allocator. Returns the number of inconsistent pages (0 =
+        balanced); call between steps — completions, cancels, preempts
+        and evictions must all keep this at zero."""
+        expected = np.zeros(self.config.num_pages, np.int64)
+        expected[0] = 1  # the null pad page
+        for seq in self.seqs:
+            for page in seq.pages:
+                expected[page] += 1
+        if self.radix is not None:
+            for page in self.radix.pages():
+                expected[page] += 1
+        else:
+            for pages in self.prefix_pages.values():
+                for page in pages:
+                    expected[page] += 1
+        bad = int(np.sum(expected != self.pool.refs))
+        # the free list must hold exactly the zero-ref pages
+        if len(self.pool._free) != int(np.sum(self.pool.refs[1:] == 0)):
+            bad += 1
+        return bad
+
+    def autoscaling_metrics(self) -> Dict[str, Any]:
+        """Signals for the serve autoscaler's closed loop (the replica's
+        get_metrics() forwards them to the controller): waiting work,
+        recent median TTFT, and KV page occupancy."""
+        ttfts = sorted(self._recent_ttfts)
+        usable = max(1, self.config.num_pages - 1)
+        out: Dict[str, Any] = {
+            "queued": self._waiting_count(),
+            "kv_occupancy": 1.0 - self.pool.num_free() / usable,
+        }
+        if ttfts:
+            out["ttft_s"] = ttfts[len(ttfts) // 2]
+        return out
 
     def _emit_token(self, seq: _Seq, token: int):
         callback = getattr(seq.request, "_token_callback", None)
@@ -640,6 +1151,10 @@ class PagedLLMEngine:
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, None)  # None = cancelled
+        if self._continuous and active:
+            # lazy page growth (+ preemption under pressure) replaces
+            # the legacy upfront prompt+max_new reservation
+            active = self._ensure_decode_pages(active)
         if not active:
             return finished
         block_tables = np.zeros((B, cfg.pages_per_seq), np.int32)
@@ -687,15 +1202,21 @@ class PagedLLMEngine:
                 request = seq.request
                 hit_eos = (cfg.eos_token is not None
                            and token == cfg.eos_token)
+                # total includes tokens generated before a preemption
+                # (empty resume on the legacy arm and fresh sequences)
+                total_gen = len(seq.resume) + len(seq.generated)
                 capacity = len(seq.pages) * cfg.page_size
+                at_capacity = (not self._continuous
+                               and seq.length + 1 >= capacity)
                 if hit_eos \
-                        or len(seq.generated) >= request.max_new_tokens \
-                        or seq.length + 1 >= capacity \
+                        or total_gen >= request.max_new_tokens \
+                        or at_capacity \
                         or seq.length >= cfg.max_len - 1:
-                    finished.append((request, list(seq.generated)))
+                    tokens = seq.resume + list(seq.generated)
+                    finished.append((request, tokens))
                     callback = getattr(request, "_done_callback", None)
                     if callback is not None:
-                        callback(request, list(seq.generated))
+                        callback(request, tokens)
                     self._release(seq)
                     self.seqs[i] = _Seq()
             metrics = llm_metrics()
@@ -742,11 +1263,15 @@ class PagedLLMEngine:
             "steps": self._steps,
             "tokens_generated": self._tokens_generated,
             "active": sum(1 for s in self.seqs if s.request is not None),
-            "pending": self._pending.qsize(),
+            "pending": self._waiting_count(),
             "free_pages": self.pool.num_free(),
-            "prefix_entries": len(self.prefix_pages),
+            "prefix_entries": (self.radix.entries
+                               if self.radix is not None
+                               else len(self.prefix_pages)),
             "prefix_hits": self._prefix_hits,
             "prefix_misses": self._prefix_misses,
+            "preemptions": self._preemptions,
+            "continuous": self._continuous,
             "tp": self._tp,
             "hbm_cache_bytes": cache_bytes,
             # per-chip residency: pages shard on kv_heads, params on

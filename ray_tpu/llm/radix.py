@@ -1,0 +1,183 @@
+"""Radix tree over KV pages: prefix sharing for the paged engine
+(reference: SGLang RadixAttention / vLLM automatic prefix caching — the
+prefix store is a tree keyed by page-sized token runs, each node owning
+one refcounted physical page, so lookup cost scales with the match
+length and eviction can drop cold leaves without touching hot ancestor
+pages).
+
+The tree holds a reference (via ``PagePool.incref``) on every page it
+caches. ``match`` walks the tree for the longest cached prefix of a
+prompt and hands the caller refcounted page ids — the caller maps them
+into a block table copy-on-write style (the engine never writes a page
+it does not own, so no copy is ever actually needed). ``insert`` commits
+the full prompt pages of an admitted sequence. Eviction removes only
+refcount-1 leaves (pages nothing else maps), oldest ``last_use`` first,
+so an entry disappears only when both cold and unshared.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class _Node:
+    __slots__ = ("key", "page", "parent", "children", "last_use")
+
+    def __init__(self, key: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], _Node] = {}
+        self.last_use = 0
+
+
+class RadixPrefixCache:
+    """Prefix store over a :class:`PagePool`.
+
+    One node = one full page of prompt tokens = one physical page id
+    (ids are shared across layers, exactly like sequence block tables).
+    ``max_entries`` is the node budget enforced after each insert;
+    ``evict_pages`` frees pages on demand under pool pressure.
+    """
+
+    def __init__(self, pool, page_size: int, max_entries: int = 128):
+        self._pool = pool
+        self._page_size = page_size
+        self.max_entries = max_entries
+        self._root = _Node((), -1, None)
+        self._clock = 0
+        self.entries = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- lookup / commit ---------------------------------------------------
+
+    def _max_match_pages(self, tokens: List[int]) -> int:
+        # Cap the match one token short of the prompt: at least one tail
+        # token must prefill so the sequence has last-position logits to
+        # sample its first token from (and the engine always owns the
+        # page decode first writes into).
+        return max(0, (len(tokens) - 1) // self._page_size)
+
+    def match(self, tokens: List[int]) -> List[int]:
+        """Longest cached prefix of ``tokens`` in whole pages. Returns
+        the page ids with ONE REFERENCE EACH taken for the caller (drop
+        with ``release`` if the caller cannot admit after all). Every
+        node on the match path has its recency refreshed."""
+        ps = self._page_size
+        self._clock += 1
+        node = self._root
+        pages: List[int] = []
+        for i in range(self._max_match_pages(tokens)):
+            child = node.children.get(tuple(tokens[i * ps:(i + 1) * ps]))
+            if child is None:
+                break
+            child.last_use = self._clock
+            pages.append(child.page)
+            node = child
+        if pages:
+            self.hits += 1
+            for page in pages:
+                self._pool.incref(page)
+        elif len(tokens) // ps:
+            # only a prompt with at least one full page can miss — a
+            # short prompt has nothing the tree could have held
+            self.misses += 1
+        return pages
+
+    def release(self, pages: List[int]):
+        """Return references handed out by ``match``."""
+        for page in pages:
+            self._pool.decref(page)
+
+    def insert(self, tokens: List[int], pages: List[int]) -> int:
+        """Commit the full prompt pages of ``tokens`` (physical ids
+        ``pages``, one per full page). Nodes already present keep their
+        existing page (byte-identical by construction); new nodes take a
+        reference on theirs. Returns the number of new nodes."""
+        ps = self._page_size
+        self._clock += 1
+        node = self._root
+        added = 0
+        for i in range(len(tokens) // ps):
+            key = tuple(tokens[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, pages[i], node)
+                node.children[key] = child
+                self._pool.incref(pages[i])
+                self.entries += 1
+                added += 1
+            child.last_use = self._clock
+            node = child
+        self.evict(self.max_entries)
+        return added
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evictable_leaves(self) -> List[_Node]:
+        out = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is not self._root and not node.children \
+                    and self._pool.refs[node.page] == 1:
+                out.append(node)
+        return out
+
+    def _drop(self, node: _Node):
+        del node.parent.children[node.key]
+        self._pool.decref(node.page)
+        self.entries -= 1
+
+    def evict(self, max_entries: Optional[int] = None) -> int:
+        """Evict LRU refcount-1 leaves until at most ``max_entries``
+        nodes remain (pinned/shared pages never move). Returns pages
+        freed."""
+        if max_entries is None:
+            max_entries = self.max_entries
+        freed = 0
+        while self.entries > max_entries:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break  # everything left is shared with a live sequence
+            victim = min(leaves, key=lambda n: n.last_use)
+            self._drop(victim)
+            freed += 1
+        return freed
+
+    def evict_pages(self, want: int) -> int:
+        """Pool-pressure path: free up to ``want`` pages by evicting LRU
+        refcount-1 leaves regardless of the entry budget. Returns pages
+        freed."""
+        freed = 0
+        while freed < want:
+            leaves = self._evictable_leaves()
+            if not leaves:
+                break
+            self._drop(min(leaves, key=lambda n: n.last_use))
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every unshared entry (pages mapped by live sequences
+        stay). Returns pages freed."""
+        return self.evict_pages(self.entries)
+
+    # -- introspection -----------------------------------------------------
+
+    def pages(self) -> List[int]:
+        out = []
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            out.append(node.page)
+            stack.extend(node.children.values())
+        return out
+
+    def shared_pages(self) -> int:
+        """Cached pages currently also mapped by at least one live
+        sequence (refcount above the tree's own reference)."""
+        return sum(1 for p in self.pages() if self._pool.refs[p] > 1)

@@ -300,6 +300,15 @@ class LLMServer:
     def engine_stats(self) -> Dict[str, Any]:
         return self._engine.stats()
 
+    def autoscaling_metrics(self) -> Dict[str, Any]:
+        """Replica autoscaling hook (replica.get_metrics() folds this
+        into the controller's closed loop): the engine's waiting-queue
+        depth, median TTFT, and KV page occupancy."""
+        hook = getattr(self._engine, "autoscaling_metrics", None)
+        if hook is None:
+            return {}
+        return dict(hook())
+
 
 def build_llm_deployment(engine_config, *, name: str = "LLMServer",
                          num_replicas: int = 1, params=None,

@@ -296,6 +296,7 @@ class ServeController:
             total = 0.0
             queued = 0.0
             ttfts = []
+            kv_occs = []
             probes = []
             replicas = [r for r in state.replicas.values()
                         if r.state == "RUNNING" and r.handle is not None]
@@ -318,7 +319,11 @@ class ServeController:
                         queued += res.get("queued", 0) or 0
                         if res.get("ttft_s"):
                             ttfts.append(res["ttft_s"])
+                        if res.get("kv_occupancy") is not None:
+                            kv_occs.append(res["kv_occupancy"])
             ttfts.sort()
             state.autoscale_tick(
                 total, total_queued=queued,
-                p50_ttft_s=ttfts[len(ttfts) // 2] if ttfts else None)
+                p50_ttft_s=ttfts[len(ttfts) // 2] if ttfts else None,
+                kv_occupancy=(sum(kv_occs) / len(kv_occs)
+                              if kv_occs else None))

@@ -258,7 +258,8 @@ class DeploymentState:
 
     def autoscale_tick(self, total_ongoing: float,
                        total_queued: float = 0.0,
-                       p50_ttft_s: Optional[float] = None):
+                       p50_ttft_s: Optional[float] = None,
+                       kv_occupancy: Optional[float] = None):
         """Adjust target_num_replicas from the replica metrics
         (reference: serve/autoscaling_policy.py:13
         _calculate_desired_num_replicas + autoscaling_state.py delays).
@@ -274,7 +275,7 @@ class DeploymentState:
         from ..autoscaling_policy import calculate_desired_num_replicas
         desired = calculate_desired_num_replicas(
             auto, total_ongoing, total_queued=total_queued,
-            p50_ttft_s=p50_ttft_s,
+            p50_ttft_s=p50_ttft_s, kv_occupancy=kv_occupancy,
             current_num_replicas=self.target_num_replicas)
         now = time.monotonic()
         if desired > self.target_num_replicas:

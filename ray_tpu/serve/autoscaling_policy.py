@@ -21,6 +21,7 @@ def calculate_desired_num_replicas(
         total_ongoing_requests: float,
         total_queued: float = 0.0,
         p50_ttft_s: Optional[float] = None,
+        kv_occupancy: Optional[float] = None,
         current_num_replicas: int = 0) -> int:
     """max over the configured signals, clamped to [min, max]:
 
@@ -32,7 +33,12 @@ def calculate_desired_num_replicas(
     - ``current * ttft / target_ttft_s`` when ``target_ttft_s`` is
       configured and the reported median TTFT exceeds it — latency
       over target means the current fleet is undersized roughly in
-      proportion.
+      proportion,
+    - ``current * occ / target_kv_occupancy`` when
+      ``target_kv_occupancy`` is configured and the mean KV-page
+      occupancy the engines report exceeds it — memory-bound serving
+      saturates its KV pool (preempting sequences) long before the
+      request-count signals look busy.
     """
     target = autoscaling_config["target_ongoing_requests"]
     if target <= 0:
@@ -46,5 +52,10 @@ def calculate_desired_num_replicas(
             and p50_ttft_s > target_ttft and current_num_replicas > 0:
         desired = max(desired, math.ceil(
             current_num_replicas * p50_ttft_s / target_ttft))
+    target_kv = autoscaling_config.get("target_kv_occupancy")
+    if target_kv and target_kv > 0 and kv_occupancy \
+            and kv_occupancy > target_kv and current_num_replicas > 0:
+        desired = max(desired, math.ceil(
+            current_num_replicas * kv_occupancy / target_kv))
     return min(max(desired, autoscaling_config["min_replicas"]),
                autoscaling_config["max_replicas"])

@@ -1,0 +1,671 @@
+"""Continuous batching + radix prefix cache (PR 17 tentpole).
+
+Per-tick admission/eviction, chunked-prefill interleave, preemption
+with token-parity resume, the radix tree over KV pages (insert / match
+/ COW map / LRU evict), the RTPU_NO_CONT_BATCH kill switch, page-ledger
+balance under cancel/fail, the autoscaler KV-occupancy signal, and
+streaming end-to-end through the serve proxy with a mid-stream
+replica-side engine error surfaced to the client."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._internal.config import CONFIG
+from ray_tpu.llm import (EngineConfig, GenerationRequest, LLMEngine,
+                         PagedEngineConfig, PagedLLMEngine,
+                         RadixPrefixCache)
+from ray_tpu.llm.paged import PagePool
+from ray_tpu.models.llama import LlamaConfig
+
+
+def tiny_model():
+    return LlamaConfig(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=4, max_seq_len=256, remat=False,
+                       use_flash=False, attention_impl="reference")
+
+
+def _series_value(metric, tags):
+    snap = metric.snapshot()
+    key = [tags.get(k, "") for k in snap["tag_keys"]]
+    for tag_values, value in snap["series"]:
+        if tag_values == key:
+            return value
+    return 0.0
+
+
+# ---------------------------------------------------------------------------
+# radix tree over KV pages (no engine, no jax compute)
+# ---------------------------------------------------------------------------
+
+PS = 4  # radix-unit page size
+
+
+def _alloc_chain(pool, n):
+    return [pool.alloc() for _ in range(n)]
+
+
+def test_radix_insert_match_refcounts():
+    pool = PagePool(32)
+    radix = RadixPrefixCache(pool, PS, max_entries=128)
+    prompt = list(range(1, 13))  # 3 full pages of 4
+    pages = _alloc_chain(pool, 3)
+    radix.insert(prompt, pages)
+    # insert increfs each node's page: owner ref + cache ref
+    assert all(pool.refs[p] == 2 for p in pages)
+    assert radix.entries == 3
+    # exact re-match is capped at (len-1)//ps: the last full page is NOT
+    # returned, so the tail always has >= 1 token to prefill and the
+    # admitted sequence always OWNS >= 1 page (preemption can free it)
+    shared = radix.match(prompt)
+    assert shared == pages[:2]
+    assert all(pool.refs[p] == 3 for p in pages[:2])
+    radix.release(shared)
+    assert all(pool.refs[p] == 2 for p in pages[:2])
+    # longer prompt with the same prefix reuses all 3 cached pages
+    shared = radix.match(prompt + [99, 98, 97, 96, 95])
+    assert shared == pages
+    radix.release(shared)
+    # diverging second token shares nothing
+    other = [prompt[0], 77] + prompt[2:]
+    assert radix.match(other) == []
+    assert radix.hits == 2 and radix.misses == 1
+
+
+def test_radix_match_partial_prefix():
+    pool = PagePool(32)
+    radix = RadixPrefixCache(pool, PS, max_entries=128)
+    prompt = list(range(1, 13))
+    pages = _alloc_chain(pool, 3)
+    radix.insert(prompt, pages)
+    # shares only the first full page
+    fork = prompt[:4] + [88] * 8
+    shared = radix.match(fork)
+    assert shared == pages[:1]
+    radix.release(shared)
+    # shorter than one page: no match, and not a "miss" either (no full
+    # page to even look up)
+    misses0 = radix.misses
+    assert radix.match([1, 2, 3]) == []
+    assert radix.misses == misses0
+
+
+def test_radix_lru_evicts_only_unreferenced_leaves():
+    pool = PagePool(64)
+    radix = RadixPrefixCache(pool, PS, max_entries=128)
+    chains = {}
+    for base in (10, 20, 30):
+        prompt = [base + j for j in range(8)]  # 2 full pages
+        pages = _alloc_chain(pool, 2)
+        radix.insert(prompt, pages)
+        chains[base] = (prompt, pages)
+        for p in pages:  # owner drops its ref: cache holds the last one
+            pool.decref(p)
+    assert radix.entries == 6
+    # a live sequence still maps chain-20's leaf (COW share)
+    live = chains[20][1][1]
+    pool.incref(live)
+    # refresh chain 10 so chain 30 is the LRU unreferenced victim
+    radix.release(radix.match(chains[10][0] + [1, 2, 3, 4]))
+    radix.evict(4)
+    remaining = set(radix.pages())
+    assert set(chains[30][1]).isdisjoint(remaining), "LRU chain kept"
+    assert live in remaining, "evicted a leaf still mapped by a sequence"
+    assert set(chains[10][1]) <= remaining, "refreshed chain evicted"
+    # chain-30's pages went back to the pool
+    assert all(pool.refs[p] == 0 for p in chains[30][1])
+    # pressure eviction ignores the entry budget but still refuses
+    # referenced leaves
+    freed = radix.evict_pages(10)
+    assert freed >= 2
+    assert live in set(radix.pages())
+    pool.decref(live)
+    assert radix.evict_pages(10) >= 1
+    assert radix.entries == 0 and radix.pages() == []
+
+
+def test_radix_property_vs_reference():
+    """Random insert/match traffic against a brute-force reference:
+    match() must return exactly the longest inserted full-page prefix
+    (capped one page below the query's own full pages), and every
+    cached page must keep a live pool ref."""
+    rng = np.random.RandomState(11)
+    pool = PagePool(512)
+    radix = RadixPrefixCache(pool, PS, max_entries=10_000)
+    inserted = []  # list of token tuples fully cached
+
+    def ref_match_len(tokens):
+        cap = max(0, (len(tokens) - 1) // PS)
+        best = 0
+        for toks in inserted:
+            n = 0
+            while (n < min(len(toks), len(tokens)) // PS * PS
+                   and toks[:n + PS] == tokens[:n + PS]):
+                n += PS
+            best = max(best, min(n // PS, len(toks) // PS))
+        return min(best, cap)
+
+    for _ in range(150):
+        tokens = [int(t) for t in
+                  rng.randint(1, 5, size=rng.randint(1, 20))]
+        expect = ref_match_len(tokens)
+        shared = radix.match(tokens)
+        assert len(shared) == expect, (tokens, inserted)
+        if rng.rand() < 0.6 and pool.num_free() >= 5:
+            # admit: reuse the matched pages (we hold their refs), own
+            # the rest, then hand the full-page span to the cache
+            n_full = len(tokens) // PS
+            pages = list(shared[:n_full])
+            while len(pages) < n_full:
+                pages.append(pool.alloc())
+            radix.insert(tokens, pages)
+            for p in pages:
+                pool.decref(p)  # cache keeps its own ref
+            inserted.append(list(tokens))
+        else:
+            radix.release(shared)
+    for p in radix.pages():
+        assert pool.refs[p] >= 1
+    # free-list consistency after the churn
+    assert len(pool._free) == int((pool.refs[1:] == 0).sum())
+
+
+def test_radix_insert_idempotent_refcounts():
+    """Re-inserting a cached prefix must not double-count refs (only
+    NEW nodes incref)."""
+    pool = PagePool(16)
+    radix = RadixPrefixCache(pool, PS, max_entries=128)
+    prompt = list(range(1, 9))
+    pages = _alloc_chain(pool, 2)
+    radix.insert(prompt, pages)
+    refs_before = [int(pool.refs[p]) for p in pages]
+    radix.insert(prompt, pages)
+    assert [int(pool.refs[p]) for p in pages] == refs_before
+    assert radix.entries == 2
+
+
+# ---------------------------------------------------------------------------
+# engine-level continuous batching
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cb_engines():
+    model = tiny_model()
+    slot = LLMEngine(EngineConfig(model=model, max_batch=4, max_len=128,
+                                  prefill_buckets=(16, 32, 64)))
+    paged = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=128, page_size=8, num_pages=128,
+        prefill_buckets=(16, 32, 64)), params=slot.params)
+    assert paged._continuous
+    return slot, paged
+
+
+def _submit_all(engine, prompts, max_new, results, token_cb=None):
+    for i, prompt in enumerate(prompts):
+        req = GenerationRequest(prompt_tokens=list(prompt),
+                                max_new_tokens=max_new,
+                                request_id=f"cb-{i}-{id(prompts)}")
+
+        def on_done(request, tokens, i=i):
+            results[i] = tokens
+        engine.submit(req, done_callback=on_done, token_callback=token_cb)
+
+
+def test_per_tick_admission_fills_freed_slots(cb_engines):
+    """Admission is per decode tick: the engine never runs more than
+    max_batch, later requests join as earlier ones finish WITHIN one
+    drain, and the batch is never starved below min(waiting, slots)."""
+    _slot, paged = cb_engines
+    rng = np.random.RandomState(1)
+    prompts = [list(rng.randint(1, 128, size=rng.randint(4, 12)))
+               for _ in range(10)]
+    results = {}
+    _submit_all(paged, prompts, 6, results)
+    occupancies = []
+    steps = 0
+    while paged.has_work():
+        paged.step()
+        steps += 1
+        occupancies.append(
+            sum(1 for s in paged.seqs if s.request is not None))
+        assert steps < 500
+    assert len(results) == 10
+    assert max(occupancies) == 4  # full batch reached
+    # every tick with waiting work ran a full batch right after
+    # admission — no drain barrier ever idled a freed slot
+    assert paged.page_leak_check() == 0
+    assert paged.stats()["pending"] == 0
+
+
+def test_prefill_interleaves_with_decode(cb_engines):
+    """A long prompt admitted mid-decode prefills one chunk per tick
+    (prefill_decode_ratio=1) while the running sequence keeps
+    generating — no decode stall for the whole prefill."""
+    _slot, paged = cb_engines
+    results = {}
+    rng = np.random.RandomState(2)
+    _submit_all(paged, [list(rng.randint(1, 128, size=6))], 24, results)
+    paged.step()  # admit + prefill + first decode
+    first = next(s for s in paged.seqs if s.request is not None)
+    assert first.phase == "decode"
+    gen_before = len(first.generated)
+    # now a 100-token prompt arrives: chunked over (64, 64-bucket) ticks
+    long_prompt = [int(t) for t in rng.randint(1, 128, size=100)]
+    results2 = {}
+    _submit_all(paged, [long_prompt], 4, results2)
+    paged.step()
+    second = next(s for s in paged.seqs
+                  if s.request is not None and s is not first)
+    assert second.phase == "prefill"          # mid-prefill after 1 tick
+    assert 0 < second.prefill_off < 100       # one chunk done
+    assert len(first.generated) > gen_before  # decode kept moving
+    while paged.has_work():
+        paged.step()
+    assert len(results[0]) == 24 and len(results2[0]) == 4
+    assert paged.page_leak_check() == 0
+
+
+def test_preempt_resume_token_parity():
+    """Under page pressure the youngest sequence is preempted (pages
+    released, request parked) and later resumed with its generated
+    tokens re-prefilled as prompt extension — final outputs are
+    bit-identical to an unpressured run, nothing is dropped, and the
+    page ledger balances."""
+    model = tiny_model()
+    big = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=64, page_size=8, num_pages=128,
+        prefill_buckets=(16, 32, 64)))
+    small = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=64, page_size=8, num_pages=14,
+        prefill_buckets=(16, 32, 64)), params=big.params)
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(1, 128, size=rng.randint(4, 8)))
+               for _ in range(6)]
+    out_big = big.generate(prompts, max_new_tokens=40)
+    out_small = small.generate(prompts, max_new_tokens=40)
+    assert small.stats()["preemptions"] > 0, \
+        "pool of 13 usable pages must preempt 4x6-page sequences"
+    assert out_small == out_big
+    assert all(len(t) == 40 for t in out_small)
+    assert small.page_leak_check() == 0
+    assert big.stats()["preemptions"] == 0
+
+
+def test_preempted_stream_replays_no_duplicate_tokens():
+    """Token callbacks across a preemption: the resumed sequence must
+    not re-emit the tokens generated before preemption."""
+    model = tiny_model()
+    engine = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=64, page_size=8, num_pages=14,
+        prefill_buckets=(16, 32, 64)))
+    rng = np.random.RandomState(4)
+    prompts = [list(rng.randint(1, 128, size=6)) for _ in range(6)]
+    streamed = {i: [] for i in range(6)}
+    results = {}
+    for i, prompt in enumerate(prompts):
+        req = GenerationRequest(prompt_tokens=prompt, max_new_tokens=30,
+                                request_id=f"st-{i}")
+
+        def on_tok(request, token, i=i):
+            streamed[i].append(int(token))
+
+        def on_done(request, tokens, i=i):
+            results[i] = tokens
+        engine.submit(req, done_callback=on_done, token_callback=on_tok)
+    while engine.has_work():
+        engine.step()
+    assert engine.stats()["preemptions"] > 0
+    for i in range(6):
+        assert streamed[i] == list(results[i])
+    assert engine.page_leak_check() == 0
+
+
+def test_cancel_mid_decode_and_mid_prefill_page_balance(cb_engines):
+    """Cancelling a sequence mid-decode AND one mid-chunked-prefill
+    returns every page (including gathered shared-prefix refs) — the
+    pool ledger stays balanced (PR 17 satellite: the old release path
+    only handled decode-phase slots)."""
+    _slot, paged = cb_engines
+    rng = np.random.RandomState(5)
+    results = {}
+    _submit_all(paged, [list(rng.randint(1, 128, size=10))], 40, results)
+    paged.step()
+    paged.step()  # mid-decode now
+    running = next(s for s in paged.seqs if s.request is not None)
+    assert running.phase == "decode" and running.generated
+    assert paged.cancel(running.request.request_id)
+    # long prompt: bucket 64 chunks => still prefilling after one tick
+    long_prompt = [int(t) for t in rng.randint(1, 128, size=100)]
+    results2 = {}
+    _submit_all(paged, [long_prompt], 4, results2)
+    paged.step()
+    mid = next((s for s in paged.seqs
+                if s.request is not None and s.phase == "prefill"), None)
+    assert mid is not None and 0 < mid.prefill_off < 100
+    assert paged.cancel(mid.request.request_id)
+    paged.step()  # reap both
+    assert results[0] is None and results2[0] is None  # cancelled
+    assert paged.page_leak_check() == 0
+    assert all(s.request is None for s in paged.seqs)
+
+
+def test_cancel_parked_request(cb_engines):
+    """A request parked by admission pressure (or still queued) cancels
+    cleanly without ever owning pages."""
+    _slot, paged = cb_engines
+    rng = np.random.RandomState(6)
+    results = {}
+    prompts = [list(rng.randint(1, 128, size=6)) for _ in range(6)]
+    for i, prompt in enumerate(prompts):
+        req = GenerationRequest(prompt_tokens=prompt, max_new_tokens=8,
+                                request_id=f"park-{i}")
+
+        def on_done(request, tokens, i=i):
+            results[i] = tokens
+        paged.submit(req, done_callback=on_done)
+    paged.step()  # admits 4, parks 2
+    assert paged.cancel("park-5")
+    while paged.has_work():
+        paged.step()
+    assert results[5] is None
+    assert all(len(results[i]) == 8 for i in range(5))
+    assert paged.page_leak_check() == 0
+
+
+def test_fail_all_releases_every_phase(cb_engines):
+    """fail_all mid-flight (decoding + prefilling + parked) errors every
+    callback and frees every page."""
+    _slot, paged = cb_engines
+    rng = np.random.RandomState(7)
+    results = {}
+    prompts = [list(rng.randint(1, 128, size=6)) for _ in range(4)]
+    prompts.append([int(t) for t in rng.randint(1, 128, size=100)])
+    prompts.append(list(rng.randint(1, 128, size=6)))
+    _submit_all(paged, prompts, 20, results)
+    paged.step()
+    boom = RuntimeError("boom")
+    paged.fail_all(boom)
+    assert len(results) == 6
+    assert all(isinstance(t, RuntimeError) for t in results.values())
+    assert paged.page_leak_check() == 0
+    assert not paged.has_work()
+
+
+def test_kill_switch_reproduces_legacy_exactly():
+    """RTPU_NO_CONT_BATCH=1 is the exact-legacy A/B arm: same prompts,
+    same seed => bit-identical outputs from the continuous engine, the
+    legacy engine, and the slot engine."""
+    model = tiny_model()
+    slot = LLMEngine(EngineConfig(model=model, max_batch=4, max_len=128,
+                                  prefill_buckets=(16, 32, 64)))
+    rng = np.random.RandomState(8)
+    prompts = [list(rng.randint(1, 128, size=rng.randint(4, 30)))
+               for _ in range(12)]
+    cont = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=128, page_size=8, num_pages=128,
+        prefill_buckets=(16, 32, 64)), params=slot.params)
+    assert cont._continuous and cont.radix is not None
+    out_cont = cont.generate(prompts, max_new_tokens=10)
+    CONFIG.apply_system_config({"no_cont_batch": True})
+    try:
+        legacy = PagedLLMEngine(PagedEngineConfig(
+            model=model, max_batch=4, max_len=128, page_size=8,
+            num_pages=128, prefill_buckets=(16, 32, 64)),
+            params=slot.params)
+        assert not legacy._continuous and legacy.radix is None
+        out_legacy = legacy.generate(prompts, max_new_tokens=10)
+    finally:
+        CONFIG.apply_system_config({"no_cont_batch": False})
+    out_slot = slot.generate(prompts, max_new_tokens=10)
+    assert out_cont == out_slot == out_legacy
+
+
+def test_prefix_cache_entries_flag_bounds_radix():
+    """The prefix_cache_entries flag (PR 17 satellite: promoted from the
+    hardcoded _evict_prefixes(max_entries=128)) bounds the radix tree's
+    node count; unreferenced LRU leaves go first."""
+    model = tiny_model()
+    CONFIG.apply_system_config({"prefix_cache_entries": 4})
+    try:
+        engine = PagedLLMEngine(PagedEngineConfig(
+            model=model, max_batch=2, max_len=128, page_size=8,
+            num_pages=128, prefill_buckets=(32,)))
+        assert engine.radix.max_entries == 4
+        rng = np.random.RandomState(9)
+        for i in range(6):
+            prompt = list(rng.randint(1, 128, size=24))  # 3 full pages
+            engine.generate([prompt], max_new_tokens=2)
+            assert engine.stats()["prefix_entries"] <= 4
+        assert engine.page_leak_check() == 0
+    finally:
+        CONFIG.apply_system_config({"prefix_cache_entries": 128})
+
+
+def test_radix_prefill_flops_saved_on_shared_prefix():
+    """A shared system prompt prefills ONCE: follow-up requests only
+    compute the tail (>= 2x fewer prefill tokens — the PR 17 acceptance
+    bar for the radix arm)."""
+    from ray_tpu.llm._metrics import llm_metrics
+    m = llm_metrics()
+    tags = {"engine": "paged"}
+    model = tiny_model()
+    engine = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=128, page_size=8,
+        num_pages=128, prefill_buckets=(16, 32, 64)))
+    system = list(range(1, 57))  # 56 tokens = 7 full pages
+    t0 = _series_value(m.prefill_tokens, tags)
+    first = engine.generate([system + [60 + 0]], max_new_tokens=2)
+    t1 = _series_value(m.prefill_tokens, tags)
+    cold_tokens = t1 - t0
+    outs = engine.generate([system + [60 + i] for i in range(1, 4)],
+                           max_new_tokens=2)
+    t2 = _series_value(m.prefill_tokens, tags)
+    warm_tokens = (t2 - t1) / 3  # per request
+    assert cold_tokens >= 56
+    # warm requests skip the 6 shared full pages (48 tokens): they
+    # prefill only the 9-token tail, bucket-rounded to 16
+    assert warm_tokens * 2 <= cold_tokens
+    assert engine.stats()["prefix_hits"] >= 3
+    assert len(first[0]) == 2 and all(len(o) == 2 for o in outs)
+    assert engine.page_leak_check() == 0
+
+
+def test_continuous_metrics_exposition():
+    """The four PR 17 series (kv occupancy, waiting, preemptions,
+    shared prefix pages) flow through the Prometheus pipeline."""
+    from ray_tpu.llm._metrics import llm_metrics
+    from ray_tpu.util.metrics import prometheus_text
+    m = llm_metrics()
+    model = tiny_model()
+    engine = PagedLLMEngine(PagedEngineConfig(
+        model=model, max_batch=4, max_len=64, page_size=8, num_pages=14,
+        prefill_buckets=(16, 32)))
+    rng = np.random.RandomState(10)
+    prompts = [list(rng.randint(1, 128, size=6)) for _ in range(6)]
+    engine.generate(prompts, max_new_tokens=30)
+    gauge_tags = {"engine": "paged", "pid": str(os.getpid())}
+    preempt_tags = {"engine": "paged", "reason": "page_pressure"}
+    assert _series_value(m.preemptions, preempt_tags) > 0
+    text = prometheus_text([m.kv_occupancy.snapshot(),
+                            m.waiting.snapshot(),
+                            m.preemptions.snapshot(),
+                            m.shared_pages.snapshot()])
+    assert "# TYPE rtpu_kv_page_occupancy gauge" in text
+    assert "# TYPE rtpu_engine_waiting_requests gauge" in text
+    assert "# TYPE rtpu_engine_preemptions_total counter" in text
+    assert "# TYPE rtpu_prefix_shared_pages gauge" in text
+    assert ('rtpu_engine_preemptions_total{engine="paged",'
+            'reason="page_pressure"}') in text
+    # gauges settle to drained state
+    assert _series_value(m.waiting, gauge_tags) == 0
+
+
+# ---------------------------------------------------------------------------
+# autoscaling: the KV-occupancy signal
+# ---------------------------------------------------------------------------
+
+
+def test_engine_autoscaling_metrics(cb_engines):
+    _slot, paged = cb_engines
+    metrics = paged.autoscaling_metrics()
+    assert set(metrics) >= {"queued", "kv_occupancy"}
+    assert metrics["queued"] == 0
+    assert 0.0 <= metrics["kv_occupancy"] <= 1.0
+    assert metrics.get("ttft_s", 0) >= 0  # engines above already served
+    req = GenerationRequest(prompt_tokens=[1, 2, 3], max_new_tokens=2,
+                            request_id="asm-1")
+    paged.submit(req)
+    assert paged.autoscaling_metrics()["queued"] == 1
+    while paged.has_work():
+        paged.step()
+    assert "ttft_s" in paged.autoscaling_metrics()
+
+
+def test_server_forwards_autoscaling_metrics():
+    from ray_tpu.llm.serving import LLMServer
+    model = tiny_model()
+    server = LLMServer(PagedEngineConfig(
+        model=model, max_batch=2, max_len=64, page_size=8, num_pages=32,
+        prefill_buckets=(16,)))
+    metrics = server.autoscaling_metrics()
+    assert set(metrics) >= {"queued", "kv_occupancy"}
+
+
+def test_policy_scales_on_kv_occupancy():
+    from ray_tpu.serve.autoscaling_policy import \
+        calculate_desired_num_replicas
+    auto = {"min_replicas": 1, "max_replicas": 10,
+            "target_ongoing_requests": 8,
+            "target_kv_occupancy": 0.5}
+    # request count looks idle but KV pool is 90% full: scale by ratio
+    assert calculate_desired_num_replicas(
+        auto, 2.0, kv_occupancy=0.9, current_num_replicas=2) == 4
+    # under target: the ongoing formula rules
+    assert calculate_desired_num_replicas(
+        auto, 2.0, kv_occupancy=0.3, current_num_replicas=2) == 1
+    # unset target ignores the signal
+    del auto["target_kv_occupancy"]
+    assert calculate_desired_num_replicas(
+        auto, 2.0, kv_occupancy=0.99, current_num_replicas=2) == 1
+
+
+# ---------------------------------------------------------------------------
+# serve plane: streaming e2e + mid-stream engine error
+# ---------------------------------------------------------------------------
+
+
+class _FlakyLLMServer:
+    """LLMServer whose engine blows up after a few ticks — deployed on a
+    real replica to prove a mid-stream engine failure reaches the
+    streaming client instead of hanging the chunked response."""
+
+    def __new__(cls, engine_config, params=None, fail_after=3):
+        from ray_tpu.llm.serving import LLMServer
+        server = LLMServer(engine_config, params=params)
+        engine = server._engine
+        real_step = engine.step
+        state = {"n": 0}
+
+        def step():
+            state["n"] += 1
+            if state["n"] > fail_after:
+                raise RuntimeError("injected engine failure")
+            return real_step()
+        engine.step = step
+        return server
+
+
+@pytest.mark.timeout_s(600)
+def test_stream_error_surfaced_through_proxy(llm_cluster):
+    """Streaming end-to-end through the HTTP proxy: tokens arrive as
+    chunked ndjson, then the replica's engine dies mid-stream and the
+    client receives an explicit error line (not a silent hang or a
+    clean end)."""
+    from ray_tpu import serve
+    from conftest import raw_http
+
+    cfg = PagedEngineConfig(model=tiny_model(), max_batch=2, max_len=96,
+                            page_size=8, num_pages=64,
+                            prefill_buckets=(8, 16))
+    app = serve.deployment(_FlakyLLMServer, name="flaky").bind(cfg)
+    serve.run(app, name="llm", route_prefix="/llm",
+              wait_for_ready_timeout_s=240)
+    addr = serve.get_http_address().replace("http://", "")
+    host, port = addr.rsplit(":", 1)
+    head, raw = raw_http(host, int(port), "POST", "/llm",
+                         {"prompt_tokens": [1, 2, 3],
+                          "max_new_tokens": 50, "stream": True})
+    assert "Transfer-Encoding: chunked" in head
+    lines = []
+    buf = raw
+    while buf:
+        line, _, buf = buf.partition(b"\r\n")
+        if not line:
+            continue
+        try:
+            n = int(line, 16)
+        except ValueError:
+            continue
+        if n == 0:
+            break
+        chunk, buf = buf[:n], buf[n + 2:]
+        for ln in chunk.decode().splitlines():
+            if ln.strip():
+                lines.append(json.loads(ln))
+    tokens = [t for ln in lines for t in ln.get("tokens", [])]
+    errors = [ln["error"] for ln in lines if ln.get("error")]
+    assert tokens, "no tokens streamed before the failure"
+    assert len(tokens) < 50, "engine failure did not interrupt the stream"
+    assert errors and "injected engine failure" in errors[0]
+    assert lines[-1]["done"] is True
+
+
+@pytest.mark.timeout_s(600)
+def test_openai_sse_surfaces_midstream_error():
+    """The OpenAI SSE formatter forwards a mid-stream engine error as an
+    explicit error event before [DONE] (PR 17: previously dropped)."""
+    from ray_tpu.llm.openai import OpenAIServer
+    from ray_tpu.serve._private.proxy import Request
+
+    model = tiny_model()
+    cfg = PagedEngineConfig(model=model, max_batch=2, max_len=96,
+                            page_size=8, num_pages=64,
+                            prefill_buckets=(8, 16))
+    server = OpenAIServer(cfg, model_id="tiny")
+    engine = server._engine
+    real_step = engine.step
+    state = {"n": 0}
+
+    def step():
+        state["n"] += 1
+        if state["n"] > 3:
+            raise RuntimeError("kv cache exploded")
+        return real_step()
+    engine.step = step
+
+    async def scenario():
+        body = json.dumps({"prompt": "hi", "max_tokens": 50,
+                           "stream": True}).encode()
+        out = await server(Request("POST", "/v1/completions", {}, {},
+                                   body))
+        sid = out["__rtpu_stream__"]
+        events, done = [], False
+        while not done:
+            batch = await server.stream_next(sid, timeout_s=60)
+            if batch.get("data"):
+                events.append(batch["data"])
+            done = batch["done"]
+        return "".join(events)
+
+    joined = asyncio.run(scenario())
+    assert '"engine_error"' in joined
+    assert "kv cache exploded" in joined
+    assert joined.rstrip().endswith("data: [DONE]")
+    assert joined.index("engine_error") < joined.index("[DONE]")

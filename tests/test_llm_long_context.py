@@ -86,5 +86,6 @@ def test_prefix_lru_eviction_under_strain():
     # pool accounting: free + distinct prefix-pinned pages must cover
     # the whole pool (page 0 is the reserved null page; entries are
     # cumulative per prefix depth, so count distinct pages)
-    pinned = {pg for pages in engine.prefix_pages.values() for pg in pages}
+    pinned = engine.prefix_pinned_pages()
     assert stats["free_pages"] + len(pinned) == 256 - 1
+    assert engine.page_leak_check() == 0

@@ -85,11 +85,24 @@ def test_prefix_pages_shared():
 def test_prefix_lru_hit_refreshes_recency_and_counts():
     """A reused prefix must not age out of the LRU while hot, and
     stats() exposes the hit/miss counters (PR-12 satellite: the old
-    list-based LRU popped in insertion order regardless of hits)."""
+    list-based LRU popped in insertion order regardless of hits).
+    Exercises the LEGACY token-tuple LRU — the RTPU_NO_CONT_BATCH path;
+    the radix cache that replaces it is covered by
+    test_continuous_batching.py."""
+    from ray_tpu._internal.config import CONFIG
+    CONFIG.apply_system_config({"no_cont_batch": True})
+    try:
+        _run_legacy_prefix_lru_checks()
+    finally:
+        CONFIG.apply_system_config({"no_cont_batch": False})
+
+
+def _run_legacy_prefix_lru_checks():
     model = tiny_model()
     paged = PagedLLMEngine(PagedEngineConfig(
         model=model, max_batch=4, max_len=128, page_size=8,
         num_pages=128, prefill_buckets=(32, 64)))
+    assert not paged._continuous
     hot = list(range(1, 17))  # 16 tokens = 2 full pages
     paged.generate([hot + [30]], max_new_tokens=2)
     s0 = paged.stats()
@@ -154,7 +167,7 @@ def test_prefix_cache_metrics_exposition():
         == s["prefix_misses"]
     gauge_tags = {"engine": "paged", "pid": str(os.getpid())}
     assert _series_value(m.prefix_entries, gauge_tags) \
-        == len(paged._prefix_lru) > 0
+        == paged.stats()["prefix_entries"] > 0
 
     text = prometheus_text([m.prefix_hits.snapshot(),
                             m.prefix_misses.snapshot(),
