@@ -788,10 +788,14 @@ def serve_bench(out_path: str = "BENCH_serve_r01.json") -> dict:
     """LLM serving headline (`bench.py --serve`): the in-process
     continuous-batching vs RTPU_NO_CONT_BATCH legacy engine A/B plus
     the radix shared-prefix arm — req/s, p50/p95 TTFT, prefill FLOPs
-    saved — recorded as a BENCH_serve JSON artifact."""
-    from ray_tpu.perf_workloads import serve_engine_ab
+    saved — recorded as a BENCH_serve JSON artifact. Also runs the
+    request-lifecycle tracing on/off A/B (same seed, same weights):
+    reqtrace overhead must stay within machine noise."""
+    from ray_tpu.perf_workloads import (reqtrace_overhead_ab,
+                                        serve_engine_ab)
 
     ab = serve_engine_ab()
+    rab = reqtrace_overhead_ab()
     result = {
         "metric": "llm_serve_engine_ab",
         "backend": jax.default_backend(),
@@ -808,8 +812,17 @@ def serve_bench(out_path: str = "BENCH_serve_r01.json") -> dict:
             k: ab["radix_shared_prefix"][k] for k in
             ("prefill_tokens", "prompt_tokens_submitted",
              "prefill_tokens_saved_frac", "shared_prefix_hits")},
+        "reqtrace_ab": {
+            "on": {k: rab["reqtrace_on"][k] for k in
+                   ("requests_per_s", "decode_tokens_per_s",
+                    "ttft_p50_s", "ttft_p95_s")},
+            "off": {k: rab["reqtrace_off"][k] for k in
+                    ("requests_per_s", "decode_tokens_per_s",
+                     "ttft_p50_s", "ttft_p95_s")},
+            "gates": rab["gates"],
+        },
         "gates": ab["gates"],
-        "passed": ab["passed"],
+        "passed": ab["passed"] and rab["passed"],
     }
     print(json.dumps(result))
     if out_path:

@@ -215,8 +215,10 @@ def _hbm_watermark_ratio(snapshots: List[Dict[str, Any]]
 
 
 def default_rules() -> List[AlertRule]:
-    """The stock train-plane SLOs. Thresholds are CONFIG-free literals
-    except the HBM watermark (shared with the accel pressure plane)."""
+    """The stock SLOs — train plane (collective wait, HBM watermark,
+    step-time regression) and serve plane (TTFT p95, lease-queue age,
+    KV-page occupancy; thresholds from the RTPU_SERVE_*_SLO flags).
+    One engine covers both planes."""
     return [
         AlertRule(
             "collective_wait_p95",
@@ -244,6 +246,39 @@ def default_rules() -> List[AlertRule]:
             severity="WARNING",
             message=lambda v: (f"step time regressed to {v:.3f}s — "
                                f">1.5x the EWMA baseline")),
+        AlertRule(
+            "serve_ttft_p95",
+            metric="rtpu_llm_ttft_seconds",
+            window_s=60.0, reduce="p95",
+            predicate=lambda v, _w: v > float(
+                CONFIG.serve_ttft_p95_slo_s),
+            severity="WARNING",
+            message=lambda v: (
+                f"serve TTFT p95 {v:.3f}s exceeds "
+                f"{float(CONFIG.serve_ttft_p95_slo_s):.3g}s SLO — "
+                f"decompose the tail with cli requests / why_slow")),
+        AlertRule(
+            "serve_queue_age",
+            metric="rtpu_lease_queue_age_seconds",
+            window_s=60.0, reduce="max",
+            predicate=lambda v, _w: v > float(
+                CONFIG.serve_queue_age_slo_s),
+            severity="WARNING",
+            message=lambda v: (
+                f"lease queue age {v:.1f}s exceeds "
+                f"{float(CONFIG.serve_queue_age_slo_s):.3g}s SLO — "
+                f"requests are starving behind held leases")),
+        AlertRule(
+            "serve_kv_occupancy",
+            metric="rtpu_llm_kv_page_utilization",
+            window_s=60.0, reduce="max",
+            predicate=lambda v, _w: v > float(
+                CONFIG.serve_kv_occupancy_slo),
+            severity="WARNING",
+            message=lambda v: (
+                f"KV page pool {v:.0%} full (SLO "
+                f"{float(CONFIG.serve_kv_occupancy_slo):.0%}) — "
+                f"preemption churn imminent; add replicas or pages")),
     ]
 
 

@@ -289,6 +289,17 @@ _DEFAULTS: Dict[str, Any] = {
     # or token-tuple LRU entries (legacy arm) kept before LRU eviction
     # of refcount-1 leaves. Each entry pins one KV page.
     "prefix_cache_entries": 128,
+    # --- serve-plane request observatory (llm/reqtrace.py) ---
+    # Bounded per-process request-lifecycle event ring (an event is 4
+    # small fields; overflow drops the oldest — steady-state serving
+    # keeps the tail).
+    "reqtrace_max_events": 8192,
+    # Serve SLO thresholds for the default alert rules (alerts.py):
+    # TTFT p95 over the window, max lease-queue age, and max KV-page
+    # occupancy fraction before an alert fires.
+    "serve_ttft_p95_slo_s": 2.0,
+    "serve_queue_age_slo_s": 30.0,
+    "serve_kv_occupancy_slo": 0.95,
     # --- A/B kill switches (every switch lives here so a typo'd
     # RTPU_* spelling is caught by rtpulint rule L003 instead of
     # silently doing nothing) ---
@@ -321,6 +332,11 @@ _DEFAULTS: Dict[str, Any] = {
     # a no-op context (one flag check), nothing is recorded or flushed,
     # and the collective straggler detector stops attributing waits.
     "no_steptrace": False,
+    # Kill switch for the serve-plane request observatory: record()
+    # degrades to one flag check, no lifecycle ring is ever
+    # constructed, nothing piggybacks on the metrics flush —
+    # exact-legacy behavior with zero rings and zero extra threads.
+    "no_reqtrace": False,
     # Kill switch for continuous batching in the paged LLM engine:
     # exact-legacy per-drain admission (blocking inline prefill, upfront
     # page reservation, token-tuple prefix LRU, no preemption).

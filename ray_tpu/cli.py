@@ -12,7 +12,8 @@ Usage: python -m ray_tpu.cli <command> ...
   list     {nodes,actors,tasks,placement_groups,objects,workers,jobs}
   memory   [--json] [--limit N]                          cluster memory report
   events   [--type T] [--json] [--limit N]               cluster event log
-  timeline [--output FILE] [--train]                     chrome trace
+  timeline [--output FILE] [--train|--serve]             chrome trace
+  requests [--by-tenant|--by-route] [--why ID] [--json]  serve request folds
   stragglers [--json] [--limit N]                        skew/straggler view
   alerts   [--rule R] [--severity S] [--json]            SLO alert table
   trace    [TRACE_ID] [--json] [--logs]                  span tree / list
@@ -375,8 +376,79 @@ def cmd_timeline(args):
               f"{len(tracks)} tracks ({', '.join(map(str, tracks))}) "
               f"to {args.output}")
         return
+    if getattr(args, "serve", False):
+        trace = st.serve_timeline(args.output)
+        tracks = sorted({row["tid"] for row in trace if "tid" in row})
+        print(f"wrote {len(trace)} serve spans across "
+              f"{len(tracks)} requests to {args.output}")
+        return
     trace = st.timeline(args.output)
     print(f"wrote {len(trace)} spans to {args.output}")
+
+
+def cmd_requests(args):
+    """Render the serve-plane request observatory: percentile folds over
+    every traced request (optionally grouped by tenant/route), or one
+    request's `why_slow` latency-attribution report with --why."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    if args.why:
+        report = st.why_slow(args.why)
+        if args.json:
+            print(json.dumps(report, indent=1, default=str))
+            return
+        if "error" in report:
+            print(report["error"])
+            return
+        print(f"request {report['request_id']}  "
+              f"outcome={report.get('outcome') or 'in-flight'}"
+              + (f"  tenant={report['tenant']}"
+                 if report.get("tenant") else "")
+              + (f"  route={report['route']}"
+                 if report.get("route") else ""))
+        for horizon in ("ttft", "e2e"):
+            total = report.get(f"{horizon}_s")
+            buckets = report.get(f"{horizon}_buckets")
+            if total is None or not buckets:
+                continue
+            print(f"  {horizon}: {total:.4f}s")
+            for name, sec in sorted(buckets.items(),
+                                    key=lambda kv: -kv[1]):
+                if sec <= 0:
+                    continue
+                print(f"    {name:<16} {sec:>9.4f}s "
+                      f"({100.0 * sec / total if total else 0:5.1f}%)")
+        if report.get("preemptions"):
+            print(f"  preemptions: {report['preemptions']}")
+        for ev in report.get("events", []):
+            args_s = " ".join(
+                f"{k}={v}" for k, v in sorted(ev.items())
+                if k not in ("event", "t_s"))
+            print(f"  +{ev['t_s']:>8.4f}s  {ev['event']:<14} {args_s}")
+        return
+    by = "tenant" if args.by_tenant else (
+        "route" if args.by_route else None)
+    fold = st.serve_requests(by=by)
+    if args.json:
+        print(json.dumps(fold, indent=1, default=str))
+        return
+    groups = fold["groups"]
+    if not groups:
+        print("no requests traced")
+        return
+    label = fold.get("by") or "all"
+    print(f"{label:<18} reqs  done fail  preempt   park_s "
+          f"ttft_p50  ttft_p95   e2e_p50   e2e_p95")
+    for key in sorted(groups):
+        g = groups[key]
+
+        def _f(v):
+            return f"{v:>8.4f}" if v is not None else "       -"
+        print(f"{key:<18} {g['requests']:>4} {g['finished']:>5} "
+              f"{g['failed']:>4} {g['preemptions']:>8} "
+              f"{g['park_s_total']:>8.3f} "
+              f"{_f(g['ttft_p50_s'])}  {_f(g['ttft_p95_s'])}  "
+              f"{_f(g['e2e_p50_s'])}  {_f(g['e2e_p95_s'])}")
 
 
 def cmd_stragglers(args):
@@ -923,8 +995,23 @@ def main(argv=None):
     p.add_argument("--train", action="store_true",
                    help="cross-rank train-step timeline (steptrace) "
                         "instead of the task timeline")
+    p.add_argument("--serve", action="store_true",
+                   help="serve-plane per-request lifecycle timeline "
+                        "(reqtrace) instead of the task timeline")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("requests")
+    p.add_argument("--by-tenant", action="store_true",
+                   help="group percentile folds by tenant label")
+    p.add_argument("--by-route", action="store_true",
+                   help="group percentile folds by serve route")
+    p.add_argument("--why", default=None, metavar="REQUEST_ID",
+                   help="latency-attribution report for one request "
+                        "(unique id prefix ok)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_requests)
 
     p = sub.add_parser("stragglers")
     p.add_argument("--json", action="store_true")

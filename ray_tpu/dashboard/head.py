@@ -13,6 +13,9 @@ Routes:
   POST /api/jobs/<id>/stop
   GET  /api/timeline                  chrome-trace JSON of task spans
   GET  /api/train_timeline            cross-rank train-step timeline
+  GET  /api/serve_timeline            per-request serve lifecycle trace
+  GET  /api/requests                  serve request folds (?by=tenant|
+                                      route) / ?why=<id> attribution
   GET  /api/stragglers                straggler events + step-time skew
   GET  /api/alerts                    SLO alert table (alert engine)
                                       (?since= for incremental polls)
@@ -212,6 +215,17 @@ class DashboardHead:
             # cross-rank train-step timeline (steptrace fold) — the
             # Timeline tab's train view
             return self._json(st.train_timeline())
+        if path == "/api/serve_timeline":
+            # per-request serve lifecycle timeline (reqtrace fold) —
+            # the Serve tab's chrome-trace view
+            return self._json(st.serve_timeline())
+        if path == "/api/requests":
+            # serve request observatory: percentile folds (optionally
+            # ?by=tenant|route) or one request's ?why=<id> attribution
+            why = query.get("why")
+            if why:
+                return self._json(st.why_slow(why))
+            return self._json(st.serve_requests(by=query.get("by")))
         if path == "/api/stragglers":
             return self._json(st.stragglers(
                 limit=int(query.get("limit", 100))))

@@ -6,17 +6,42 @@ serve/llm/__init__.py:92 build_llm_deployment / :168 build_openai_app).
 The reference delegates the engine to vLLM (CUDA); no such engine exists
 for TPU, so this package IS the engine (SURVEY §7 step 8): a
 continuous-batching decode loop over slot-structured KV caches, jitted
-once per shape bucket, deployed behind ray_tpu.serve."""
+once per shape bucket, deployed behind ray_tpu.serve.
 
-from .disagg import (PDDecodeServer, PrefillServer, build_pd_disagg_app)
-from .engine import EngineConfig, GenerationRequest, LLMEngine
-from .openai import ByteTokenizer, OpenAIServer, build_openai_app
-from .paged import PagedEngineConfig, PagedLLMEngine
-from .radix import RadixPrefixCache
-from .serving import LLMServer, build_llm_deployment
+Exports resolve lazily (PEP 562): the engines pull in jax at import
+time, but jax-free processes — the serve proxy stamping request-trace
+events, the dashboard folding `reqtrace` payloads — must be able to
+import this package (and its light submodules) without paying the jax
+import."""
 
-__all__ = ["EngineConfig", "GenerationRequest", "LLMEngine",
-           "PagedEngineConfig", "PagedLLMEngine", "LLMServer",
-           "build_llm_deployment", "OpenAIServer", "build_openai_app",
-           "ByteTokenizer", "PrefillServer", "PDDecodeServer",
-           "build_pd_disagg_app", "RadixPrefixCache"]
+_EXPORTS = {
+    "EngineConfig": ".engine",
+    "GenerationRequest": ".engine",
+    "LLMEngine": ".engine",
+    "PagedEngineConfig": ".paged",
+    "PagedLLMEngine": ".paged",
+    "LLMServer": ".serving",
+    "build_llm_deployment": ".serving",
+    "OpenAIServer": ".openai",
+    "build_openai_app": ".openai",
+    "ByteTokenizer": ".openai",
+    "PrefillServer": ".disagg",
+    "PDDecodeServer": ".disagg",
+    "build_pd_disagg_app": ".disagg",
+    "RadixPrefixCache": ".radix",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    submodule = _EXPORTS.get(name)
+    if submodule is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    from importlib import import_module
+    return getattr(import_module(submodule, __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

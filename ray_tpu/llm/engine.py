@@ -32,6 +32,7 @@ import numpy as np
 import os
 
 from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
+from . import reqtrace
 from ._metrics import llm_metrics
 
 _TAGS = {"engine": "slot"}
@@ -60,6 +61,11 @@ class GenerationRequest:
     # SamplingParams; applied inside the jitted decode, sampling.py)
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    # request-observatory labels: propagated by the serve proxy
+    # (X-RTPU-Tenant, matched route prefix) down to the engine and
+    # folded into per-tenant/per-route percentiles (llm/reqtrace.py)
+    tenant: Optional[str] = None
+    route: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -146,6 +152,10 @@ class LLMEngine:
                 f"{self.config.prefill_buckets[-1]}")
         request._done_callback = done_callback  # type: ignore[attr-defined]
         request._submit_ts = time.monotonic()  # type: ignore[attr-defined]
+        reqtrace.record(request.request_id, reqtrace.QUEUED,
+                        engine="slot", prompt_tokens=n,
+                        max_new=request.max_new_tokens,
+                        tenant=request.tenant, route=request.route)
         self._pending.put(request)
         llm_metrics().queue_depth.set(self._pending.qsize(),
                                       tags=_GAUGE_TAGS)
@@ -164,6 +174,8 @@ class LLMEngine:
             request, slot.request = slot.request, None
             llm_metrics().requests_finished.inc(
                 tags=dict(_TAGS, outcome="error"))
+            reqtrace.record(request.request_id, reqtrace.FAILED,
+                            error=type(error).__name__)
             callback = getattr(request, "_done_callback", None)
             if callback is not None:
                 callback(request, error)
@@ -172,6 +184,8 @@ class LLMEngine:
                 request = self._pending.get_nowait()
                 llm_metrics().requests_finished.inc(
                     tags=dict(_TAGS, outcome="error"))
+                reqtrace.record(request.request_id, reqtrace.FAILED,
+                                error=type(error).__name__)
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, error)
@@ -214,6 +228,8 @@ class LLMEngine:
                 # callback (tokens slot carries the exception).
                 llm_metrics().requests_finished.inc(
                     tags=dict(_TAGS, outcome="error"))
+                reqtrace.record(request.request_id, reqtrace.FAILED,
+                                error=type(e).__name__)
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, e)
@@ -227,6 +243,8 @@ class LLMEngine:
 
     def _prefill_into(self, index: int, request: GenerationRequest):
         prompt = request.prompt_tokens
+        reqtrace.record(request.request_id, reqtrace.ADMITTED,
+                        slot=index)
         bucket = self._bucket(len(prompt))
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :len(prompt)] = prompt
@@ -262,7 +280,10 @@ class LLMEngine:
         metrics.prefill_tokens.inc(len(prompt), tags=_TAGS)
         submit_ts = getattr(request, "_submit_ts", None)
         if submit_ts is not None:
-            metrics.ttft.observe(time.monotonic() - submit_ts, tags=_TAGS)
+            ttft = time.monotonic() - submit_ts
+            metrics.ttft.observe(ttft, tags=_TAGS)
+            reqtrace.record(request.request_id, reqtrace.DECODE,
+                            ttft_s=round(ttft, 6))
 
     def _temp_of(self, request: GenerationRequest) -> float:
         return request.temperature if request.temperature is not None \
@@ -316,6 +337,8 @@ class LLMEngine:
         for request, _tokens in finished:
             metrics.requests_finished.inc(
                 tags=dict(_TAGS, outcome="done"))
+            reqtrace.record(request.request_id, reqtrace.FINISHED,
+                            tokens=len(_tokens))
             submit_ts = getattr(request, "_submit_ts", None)
             if submit_ts is not None:
                 metrics.request_latency.observe(

@@ -73,7 +73,10 @@ class OpenAIServer(LLMServer):
         temperature = body.get("temperature")
         top_k = body.get("top_k")
         top_p = body.get("top_p")
-        request_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+        # the proxy-stamped id (X-RTPU-Request-Id) IS the completion id
+        # when present, so `why_slow(<header id>)` resolves client-side
+        request_id = self._context_request_id() \
+            or f"cmpl-{uuid.uuid4().hex[:24]}"
         if body.get("stream"):
             stream_id = await self.generate_stream_start(
                 prompt_tokens, max_new_tokens=max_new,
@@ -137,10 +140,12 @@ class OpenAIServer(LLMServer):
             events.append(f"data: {json.dumps(chunk)}\n\n")
         if batch.get("error"):
             # mid-stream engine failure: surface it as an SSE event so
-            # the client sees the error, not a silent [DONE]
+            # the client sees the error, not a silent [DONE] — with the
+            # request id, so the failure stays attributable (why_slow)
             events.append("data: " + json.dumps(
                 {"error": {"message": batch["error"],
-                           "type": "engine_error"}}) + "\n\n")
+                           "type": "engine_error",
+                           "request_id": meta["id"]}}) + "\n\n")
         if batch["done"]:
             self._sse.pop(stream_id, None)
             events.append("data: [DONE]\n\n")

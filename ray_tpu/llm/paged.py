@@ -39,6 +39,7 @@ import numpy as np
 
 from .._internal.config import CONFIG
 from ..models.llama import LlamaConfig, LlamaModel, init_kv_caches
+from . import reqtrace
 from ._metrics import llm_metrics
 from .engine import GenerationRequest
 from .radix import RadixPrefixCache
@@ -377,6 +378,11 @@ class PagedLLMEngine:
         request._done_callback = done_callback  # type: ignore
         request._token_callback = token_callback  # type: ignore
         request._submit_ts = time.monotonic()  # type: ignore
+        reqtrace.record(request.request_id, reqtrace.QUEUED,
+                        engine="paged", prompt_tokens=n,
+                        max_new=request.max_new_tokens,
+                        tenant=getattr(request, "tenant", None),
+                        route=getattr(request, "route", None))
         self._pending.put(request)
         llm_metrics().queue_depth.set(self._pending.qsize(),
                                       tags=_GAUGE_TAGS)
@@ -396,6 +402,11 @@ class PagedLLMEngine:
         request._done_callback = done_callback  # type: ignore
         request._token_callback = token_callback  # type: ignore
         request._submit_ts = time.monotonic()  # type: ignore
+        reqtrace.record(request.request_id, reqtrace.QUEUED,
+                        engine="paged", prompt_tokens=n,
+                        max_new=request.max_new_tokens, prefilled=True,
+                        tenant=getattr(request, "tenant", None),
+                        route=getattr(request, "route", None))
         self._pending.put((request, dense_caches, last_logits))
         llm_metrics().queue_depth.set(self._pending.qsize(),
                                       tags=_GAUGE_TAGS)
@@ -440,6 +451,8 @@ class PagedLLMEngine:
             # queued cancellations must still resolve their waiters
             llm_metrics().requests_finished.inc(
                 tags=dict(_TAGS, outcome="cancelled"))
+            reqtrace.record(dropped.request_id, reqtrace.CANCELLED,
+                            where="queued")
             callback = getattr(dropped, "_done_callback", None)
             if callback is not None:
                 callback(dropped, None)  # None = cancelled
@@ -461,6 +474,8 @@ class PagedLLMEngine:
             self.seqs[i] = _Seq()
             llm_metrics().requests_finished.inc(
                 tags=dict(_TAGS, outcome="error"))
+            reqtrace.record(request.request_id, reqtrace.FAILED,
+                            error=type(error).__name__)
             callback = getattr(request, "_done_callback", None)
             if callback is not None:
                 callback(request, error)
@@ -470,6 +485,8 @@ class PagedLLMEngine:
             r = entry[0] if isinstance(entry, tuple) else entry
             llm_metrics().requests_finished.inc(
                 tags=dict(_TAGS, outcome="error"))
+            reqtrace.record(r.request_id, reqtrace.FAILED,
+                            error=type(error).__name__)
             callback = getattr(r, "_done_callback", None)
             if callback is not None:
                 callback(r, error)
@@ -546,6 +563,8 @@ class PagedLLMEngine:
             self.seqs[i] = _Seq()
             llm_metrics().requests_finished.inc(
                 tags=dict(_TAGS, outcome="cancelled"))
+            reqtrace.record(request.request_id, reqtrace.CANCELLED,
+                            where=seq.phase)
             callback = getattr(request, "_done_callback", None)
             if callback is not None:
                 callback(request, None)  # None = cancelled
@@ -560,6 +579,45 @@ class PagedLLMEngine:
     def _next_admit_id(self) -> int:
         self._admit_clock += 1
         return self._admit_clock
+
+    # -- park bookkeeping (request observatory + park histogram) ---------
+
+    def _compile_total(self) -> float:
+        """Disjoint backend-compile seconds so far (the PR-7 tracker);
+        0 when the accel plane is killed — compile attribution then
+        degrades to zero, it never invents time."""
+        return (self._accel.backend_compile_seconds_total()
+                if self._accel is not None else 0.0)
+
+    def _park_note(self, request: GenerationRequest, reason: str):
+        """Open a park episode ONCE (admission retries every tick while
+        pages are short — one PARKED event and one histogram sample per
+        episode, not per retry)."""
+        if getattr(request, "_rt_park_ts", None) is None:
+            request._rt_park_ts = time.monotonic()  # type: ignore
+            request._rt_park_reason = reason  # type: ignore
+            reqtrace.record(request.request_id, reqtrace.PARKED,
+                            reason=reason)
+
+    def _unpark_note(self, request: GenerationRequest) -> float:
+        """Close a park episode at (re-)admission: observe the park
+        histogram by reason, accumulate per-request park seconds (the
+        why_slow park bucket's metric twin), and stamp RESUMED for
+        preempted requests. Returns total park seconds so far."""
+        park_ts = getattr(request, "_rt_park_ts", None)
+        if park_ts is not None:
+            parked = time.monotonic() - park_ts
+            reason = getattr(request, "_rt_park_reason", "unknown")
+            llm_metrics().park_seconds.observe(
+                parked, tags=dict(_TAGS, reason=reason))
+            request._rt_park_total = parked + \
+                getattr(request, "_rt_park_total", 0.0)  # type: ignore
+            request._rt_park_ts = None  # type: ignore
+            if getattr(request, "_resume_tokens", None):
+                reqtrace.record(request.request_id, reqtrace.RESUMED,
+                                reason=reason,
+                                parked_s=round(parked, 6))
+        return getattr(request, "_rt_park_total", 0.0)
 
     def _admit_continuous(self):
         self._drain_pending()
@@ -582,16 +640,20 @@ class PagedLLMEngine:
                         self.radix.evict_pages(
                             need - self.pool.num_free())
                     if self.pool.num_free() < need:
+                        self._park_note(request, "no_pages")
                         self._parked.appendleft(entry)
                         return
                     self._admit_prefilled(index, request, entry[1],
                                           entry[2])
                 elif not self._begin_prefill(index, request):
+                    self._park_note(request, "no_pages")
                     self._parked.appendleft(entry)
                     return
             except Exception as e:  # noqa: BLE001
                 llm_metrics().requests_finished.inc(
                     tags=dict(_TAGS, outcome="error"))
+                reqtrace.record(request.request_id, reqtrace.FAILED,
+                                error=type(e).__name__)
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, e)
@@ -647,6 +709,11 @@ class PagedLLMEngine:
         seq.last_logits = None
         seq.admit_at = self._next_admit_id()
         self._by_id[request.request_id] = seq
+        self._unpark_note(request)
+        reqtrace.record(request.request_id, reqtrace.ADMITTED,
+                        shared_pages=len(shared),
+                        tail_pages=tail_pages,
+                        resume_tokens=len(resume) or None)
         return True
 
     def _prefill_tick(self, finished: List):
@@ -691,6 +758,10 @@ class PagedLLMEngine:
         positions = np.minimum(
             np.arange(off, off + chunk, dtype=np.int32),
             cfg.model.max_seq_len - 1)[None, :]
+        trace = not reqtrace.reqtrace_disabled()
+        if trace:
+            chunk_t0 = time.monotonic()
+            compile_t0 = self._compile_total()
         with self._mesh_scope():
             logits, seq.dense_caches = self._chunk_prefill(
                 self.params, jnp.asarray(tokens), jnp.asarray(positions),
@@ -698,6 +769,13 @@ class PagedLLMEngine:
         if off + take == len(prompt):
             seq.last_logits = np.asarray(logits[0, take - 1], np.float64)
         seq.prefill_off = off + take
+        if trace and seq.request is not None:
+            reqtrace.record(
+                seq.request.request_id, reqtrace.PREFILL_CHUNK,
+                tokens=take, bucket=chunk,
+                dur_s=round(time.monotonic() - chunk_t0, 6),
+                compile_s=round(
+                    self._compile_total() - compile_t0, 6) or None)
         # counts COMPUTED tokens only — a radix-shared span costs zero
         # here, which is exactly the prefill-FLOPs win the A/B measures
         llm_metrics().prefill_tokens.inc(take, tags=_TAGS)
@@ -740,10 +818,20 @@ class PagedLLMEngine:
         self._tokens_generated += 1
         metrics = llm_metrics()
         submit_ts = getattr(request, "_submit_ts", None)
+        park_s = getattr(request, "_rt_park_total", 0.0)
         if submit_ts is not None and not seq.resume:
             ttft = time.monotonic() - submit_ts
             metrics.ttft.observe(ttft, tags=_TAGS)
             self._recent_ttfts.append(ttft)
+            # the DECODE stamp splits a parked request's TTFT: park_s
+            # is the admission-blocked share, the rest is real prefill
+            reqtrace.record(request.request_id, reqtrace.DECODE,
+                            ttft_s=round(ttft, 6),
+                            park_s=round(park_s, 6) or None)
+        else:
+            reqtrace.record(request.request_id, reqtrace.DECODE,
+                            resumed=True,
+                            park_s=round(park_s, 6) or None)
         self._emit_token(seq, first_token)
         if seq.resume:
             # a resumed sequence may hit its budget/eos on the token the
@@ -764,6 +852,8 @@ class PagedLLMEngine:
                 self.seqs[index] = _Seq()
                 metrics.requests_finished.inc(
                     tags=dict(_TAGS, outcome="done"))
+                reqtrace.record(request.request_id, reqtrace.FINISHED,
+                                tokens=len(tokens))
                 if submit_ts is not None:
                     metrics.request_latency.observe(
                         time.monotonic() - submit_ts, tags=_TAGS)
@@ -815,6 +905,10 @@ class PagedLLMEngine:
         request._resume_tokens = seq.resume + list(seq.generated)
         self._release(seq)
         self.seqs[index] = _Seq()
+        reqtrace.record(request.request_id, reqtrace.PREEMPTED,
+                        reason=reason,
+                        generated=len(request._resume_tokens))
+        self._park_note(request, reason)
         self._parked.appendleft(request)
         self._preemptions += 1
         llm_metrics().preemptions.inc(tags=dict(_TAGS, reason=reason))
@@ -850,6 +944,8 @@ class PagedLLMEngine:
             except Exception as e:  # noqa: BLE001
                 llm_metrics().requests_finished.inc(
                     tags=dict(_TAGS, outcome="error"))
+                reqtrace.record(request.request_id, reqtrace.FAILED,
+                                error=type(e).__name__)
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, e)
@@ -957,6 +1053,10 @@ class PagedLLMEngine:
         seq.admit_at = self._next_admit_id()
         self._by_id[request.request_id] = seq
         self._tokens_generated += 1
+        park_s = self._unpark_note(request)
+        reqtrace.record(request.request_id, reqtrace.ADMITTED,
+                        shared_pages=len(shared),
+                        tail_pages=len(new_ids))
         metrics = llm_metrics()
         metrics.prefill_tokens.inc(len(prompt), tags=_TAGS)
         submit_ts = getattr(request, "_submit_ts", None)
@@ -964,6 +1064,9 @@ class PagedLLMEngine:
             ttft = time.monotonic() - submit_ts
             metrics.ttft.observe(ttft, tags=_TAGS)
             self._recent_ttfts.append(ttft)
+            reqtrace.record(request.request_id, reqtrace.DECODE,
+                            ttft_s=round(ttft, 6),
+                            park_s=round(park_s, 6) or None)
         self._emit_token(seq, first_token)
 
     def _first_token(self, request: GenerationRequest,
@@ -1148,6 +1251,8 @@ class PagedLLMEngine:
                 active.remove(i)
                 llm_metrics().requests_finished.inc(
                     tags=dict(_TAGS, outcome="cancelled"))
+                reqtrace.record(request.request_id, reqtrace.CANCELLED,
+                                where="decode")
                 callback = getattr(request, "_done_callback", None)
                 if callback is not None:
                     callback(request, None)  # None = cancelled
@@ -1157,6 +1262,13 @@ class PagedLLMEngine:
             active = self._ensure_decode_pages(active)
         if not active:
             return finished
+        trace = not reqtrace.reqtrace_disabled()
+        if trace:
+            # snapshot ids now: finished slots are reset before the
+            # compile delta is attributed below
+            trace_rids = [self.seqs[i].request.request_id
+                          for i in active]
+            compile_t0 = self._compile_total()
         block_tables = np.zeros((B, cfg.pages_per_seq), np.int32)
         lengths = np.zeros((B,), np.int32)
         tokens = np.zeros((B, 1), np.int32)
@@ -1191,6 +1303,16 @@ class PagedLLMEngine:
                         jnp.asarray(tokens), key, jnp.asarray(temps),
                         jnp.asarray(top_ks), jnp.asarray(top_ps))
                     out = np.asarray(out)  # fences the dispatch
+            if trace:
+                compile_s = self._compile_total() - compile_t0
+                if compile_s > 1e-6:
+                    # every active request's wall clock contained the
+                    # stall — charge it to each (why_slow's compile
+                    # bucket, subtracted from its decode span)
+                    for rid in trace_rids:
+                        reqtrace.record(rid, reqtrace.COMPILE,
+                                        compile_s=round(compile_s, 6),
+                                        phase="decode")
             for i in active:
                 seq = self.seqs[i]
                 token = int(out[i])
@@ -1226,6 +1348,8 @@ class PagedLLMEngine:
             for request, _tokens in finished:
                 metrics.requests_finished.inc(
                     tags=dict(_TAGS, outcome="done"))
+                reqtrace.record(request.request_id, reqtrace.FINISHED,
+                                tokens=len(_tokens))
                 submit_ts = getattr(request, "_submit_ts", None)
                 if submit_ts is not None:
                     metrics.request_latency.observe(

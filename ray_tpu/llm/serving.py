@@ -134,6 +134,17 @@ class LLMServer:
                                  "raised", exc_info=True)
                 await asyncio.sleep(0.1)
 
+    @staticmethod
+    def _context():
+        """Proxy-stamped request context (request id + tenant/route
+        labels) of the serve call being handled — empty off-replica."""
+        from ..serve.context import get_request_context
+        return get_request_context()
+
+    @classmethod
+    def _context_request_id(cls) -> str:
+        return cls._context().request_id
+
     async def _submit(self, request, done_callback, token_callback=None):
         # async so subclasses can do remote work first (PD-disagg fetches
         # the prefilled KV from the prefill deployment here)
@@ -152,7 +163,9 @@ class LLMServer:
                        temperature: Optional[float] = None,
                        top_k: Optional[int] = None,
                        top_p: Optional[float] = None,
-                       request_id: Optional[str] = None) -> Dict[str, Any]:
+                       request_id: Optional[str] = None,
+                       tenant: Optional[str] = None,
+                       route: Optional[str] = None) -> Dict[str, Any]:
         from .engine import GenerationRequest
         loop = asyncio.get_running_loop()
         future = loop.create_future()
@@ -173,7 +186,10 @@ class LLMServer:
             prompt_tokens=list(prompt_tokens),
             max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            request_id=request_id or uuid.uuid4().hex)
+            request_id=request_id or self._context_request_id()
+            or uuid.uuid4().hex,
+            tenant=tenant or self._context().tenant,
+            route=route or self._context().route)
         from ._metrics import llm_metrics
         await self._submit(request, on_done)
         try:
@@ -196,14 +212,17 @@ class LLMServer:
             temperature: Optional[float] = None,
             top_k: Optional[int] = None,
             top_p: Optional[float] = None,
-            request_id: Optional[str] = None) -> str:
+            request_id: Optional[str] = None,
+            tenant: Optional[str] = None,
+            route: Optional[str] = None) -> str:
         """Begin a streamed generation; returns a stream id the caller
         polls with `stream_next` (the proxy relays it as chunked HTTP)."""
         from .engine import GenerationRequest
         if not self._paged:
             raise RuntimeError("streaming requires the paged engine")
         loop = asyncio.get_running_loop()
-        request_id = request_id or uuid.uuid4().hex
+        request_id = request_id or self._context_request_id() \
+            or uuid.uuid4().hex
         stream_id = uuid.uuid4().hex
         stream = _Stream(request_id)
         self._streams[stream_id] = stream
@@ -236,7 +255,9 @@ class LLMServer:
             prompt_tokens=list(prompt_tokens),
             max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            request_id=request_id)
+            request_id=request_id,
+            tenant=tenant or self._context().tenant,
+            route=route or self._context().route)
         await self._submit(request, on_done, token_callback=on_token)
         return stream_id
 
@@ -255,7 +276,10 @@ class LLMServer:
                 pass
         tokens, stream.tokens = stream.tokens, []
         done = stream.done and not stream.tokens
-        out = {"tokens": tokens, "done": done}
+        # every batch echoes the request id so clients can correlate
+        # chunks (and why_slow the request) mid-stream
+        out = {"tokens": tokens, "done": done,
+               "request_id": stream.request_id}
         if stream.error:
             out["error"] = stream.error
         if done:
@@ -286,16 +310,21 @@ class LLMServer:
             raise ValueError("body must contain prompt_tokens")
         max_new = int(body.get("max_new_tokens", 32))
         temp = body.get("temperature")
+        headers = getattr(http_request, "headers", None) or {}
+        request_id = body.get("request_id") \
+            or headers.get("x-rtpu-request-id")
+        tenant = body.get("tenant") or headers.get("x-rtpu-tenant")
+        route = headers.get("x-rtpu-route")
         if body.get("stream"):
             stream_id = await self.generate_stream_start(
                 prompt, max_new_tokens=max_new, temperature=temp,
-                request_id=body.get("request_id"))
+                request_id=request_id, tenant=tenant, route=route)
             # The proxy recognises this marker and relays stream_next
             # batches as chunked HTTP on the same replica.
             return {"__rtpu_stream__": stream_id}
         return await self.generate(
             prompt, max_new_tokens=max_new, temperature=temp,
-            request_id=body.get("request_id"))
+            request_id=request_id, tenant=tenant, route=route)
 
     def engine_stats(self) -> Dict[str, Any]:
         return self._engine.stats()

@@ -18,7 +18,10 @@ RTPU_NO_CONT_BATCH legacy engine A/B (same seed, same weights, same
 mixed-length workload), the radix shared-prefix arm, and a sustained
 streaming load through the real serve proxy — gated on SLOs (p95 TTFT,
 zero dropped streams, zero leaked KV pages, cross-arm token parity)
-and recorded as ``tests/artifacts_serve_saturation.json``.
+and recorded as ``tests/artifacts_serve_saturation.json``. The same
+run regression-gates request-lifecycle tracing overhead (reqtrace
+on/off req/s within noise) and exports the per-request serve timeline
+to ``tests/artifacts_requests_timeline.json``.
 
 Run: python -m ray_tpu.perf_workloads \
     [--which all|ppo|impala|serve|data|llm|soak|serve_saturation]
@@ -368,10 +371,74 @@ def serve_engine_ab(seed: int = 1234, n_requests: int = 24) -> dict:
     return result
 
 
+def reqtrace_overhead_ab(seed: int = 1234, n_requests: int = 24,
+                         rounds: int = 3) -> dict:
+    """Paired A/B (same seed, same params, same workload): request
+    lifecycle tracing ON (default) vs the RTPU_NO_REQTRACE kill switch.
+    Both arms interleave round-robin and the BEST round per arm is
+    compared (the round-11 idiom: on a contended container, min-wall
+    is the only stable estimator — single-shot walls swing 50%+).
+    Regression gate: tracing stays within machine noise — the traced
+    arm's best req/s must hold >= 0.8x the untraced arm's (a real
+    per-event regression shows up far below that). Token parity is
+    gated too: tracing must never perturb scheduling."""
+    from ray_tpu._internal.config import CONFIG
+    from ray_tpu.llm import PagedLLMEngine
+
+    workload = _sat_mixed_workload(seed, n_requests)
+    _warmup = [([1] * 8, 2), ([2] * 30, 2), ([3] * 60, 2),
+               ([4] * 70, 2), ([5] * 90, 2), ([6] * 100, 2),
+               ([7] * 24 + [1], 2), ([7] * 24 + [2], 2)]
+    # same tight pool as serve_engine_ab so the arms see real page
+    # pressure — parks/preemptions are where tracing records most
+    CONFIG.apply_system_config({"prefix_cache_entries": 12})
+    try:
+        on_engine = PagedLLMEngine(_sat_engine_config(num_pages=40))
+        off_engine = PagedLLMEngine(_sat_engine_config(num_pages=40),
+                                    params=on_engine.params)
+        _drive_engine_arm(on_engine, _warmup)
+        _drive_engine_arm(off_engine, _warmup)
+        on_rows, off_rows = [], []
+        for _ in range(max(1, int(rounds))):
+            CONFIG.apply_system_config({"no_reqtrace": True})
+            try:
+                off_rows.append(_drive_engine_arm(off_engine, workload))
+            finally:
+                CONFIG.apply_system_config({"no_reqtrace": False})
+            on_rows.append(_drive_engine_arm(on_engine, workload))
+    finally:
+        CONFIG.apply_system_config({"prefix_cache_entries": 128})
+    parity_ok = all(row["outputs"] == on_rows[0]["outputs"]
+                    for row in on_rows + off_rows)
+    on_row = max(on_rows, key=lambda r: r["requests_per_s"])
+    off_row = max(off_rows, key=lambda r: r["requests_per_s"])
+    for row in on_rows + off_rows:
+        row.pop("outputs")
+    result = {
+        "seed": seed,
+        "rounds": len(on_rows),
+        "reqtrace_on": on_row,
+        "reqtrace_off": off_row,
+        "reqtrace_on_req_per_s_rounds":
+        [r["requests_per_s"] for r in on_rows],
+        "reqtrace_off_req_per_s_rounds":
+        [r["requests_per_s"] for r in off_rows],
+        "gates": {
+            "token_parity": parity_ok,
+            "overhead_within_noise": on_row["requests_per_s"]
+            >= 0.8 * off_row["requests_per_s"],
+        },
+    }
+    result["passed"] = all(result["gates"].values())
+    return result
+
+
 class _SatLLMServer:
     """LLMServer + a stats op the saturation client polls for the
     zero-leaked-pages SLO (the proxy only routes __call__, so the leak
-    probe rides the same HTTP path as the load)."""
+    probe rides the same HTTP path as the load), + a reqtrace flush op
+    so the driver can collect the replica's request-lifecycle ring
+    deterministically (no waiting on the metrics-flush cadence)."""
 
     def __new__(cls, engine_config, params=None):
         from ray_tpu.llm.serving import LLMServer
@@ -384,6 +451,14 @@ class _SatLLMServer:
                     stats["leaked_pages"] = \
                         self._engine.page_leak_check()
                     return stats
+                if body.get("op") == "reqtrace_flush":
+                    import asyncio
+
+                    from ray_tpu.llm import reqtrace
+                    # gcs.put must run off the replica's io loop
+                    ok = await asyncio.get_event_loop() \
+                        .run_in_executor(None, reqtrace.flush)
+                    return {"flushed": ok}
                 return await super().__call__(http_request)
         return _Server(engine_config, params=params)
 
@@ -446,15 +521,21 @@ def bench_serve_saturation(seed: int = 1234, clients: int = 3,
                            slo_ttft_p95_s: float = 30.0,
                            artifact_path: str =
                            "tests/artifacts_serve_saturation.json",
+                           timeline_artifact_path: str =
+                           "tests/artifacts_requests_timeline.json",
                            skip_cluster: bool = False) -> dict:
     """PR 17 headline bench: the in-process engine A/B (continuous vs
     RTPU_NO_CONT_BATCH legacy, radix shared-prefix arm), then sustained
     mixed-length streaming saturation through the REAL serve proxy.
     SLO gates: p95 TTFT bounded, zero dropped streams, zero leaked KV
-    pages, preempted requests complete with token parity."""
+    pages, preempted requests complete with token parity. Also runs the
+    reqtrace on/off overhead A/B (regression gate: tracing within
+    noise) and exports the per-request lifecycle chrome trace next to
+    the SLO artifact."""
     import threading
 
-    result = {"seed": seed, "engine_ab": serve_engine_ab(seed)}
+    result = {"seed": seed, "engine_ab": serve_engine_ab(seed),
+              "reqtrace_ab": reqtrace_overhead_ab(seed)}
 
     if not skip_cluster:
         import ray_tpu
@@ -537,12 +618,34 @@ def bench_serve_saturation(seed: int = 1234, clients: int = 3,
             }
             sat["passed"] = all(sat["slo"].values())
             result["serve_saturation"] = sat
+            # requests-timeline artifact: flush the replica's reqtrace
+            # ring into the GCS on demand, then fold every flushed
+            # lifecycle into one chrome trace next to the SLO gates
+            if timeline_artifact_path:
+                from ray_tpu.llm import reqtrace
+                if not reqtrace.reqtrace_disabled():
+                    flushed = json.loads(urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"http://{host}:{port}/llm",
+                            data=json.dumps(
+                                {"op": "reqtrace_flush"}).encode(),
+                            method="POST"), timeout=60).read())
+                    from ray_tpu.util import state as rt_state
+                    trace = rt_state.serve_timeline(
+                        timeline_artifact_path)
+                    sat["requests_timeline"] = {
+                        "path": timeline_artifact_path,
+                        "spans": len(trace),
+                        "replica_flushed": flushed.get("flushed"),
+                    }
             serve.shutdown()
         finally:
             ray_tpu.shutdown()
 
-    result["passed"] = result["engine_ab"]["passed"] and \
-        result.get("serve_saturation", {}).get("passed", True)
+    result["passed"] = (result["engine_ab"]["passed"]
+                        and result["reqtrace_ab"]["passed"]
+                        and result.get("serve_saturation",
+                                       {}).get("passed", True))
     ab = result["engine_ab"]
     _report("serve_sat_cont_req_per_s",
             ab["continuous"]["requests_per_s"], "req/s")
@@ -555,8 +658,14 @@ def bench_serve_saturation(seed: int = 1234, clients: int = 3,
     _report("serve_sat_radix_prefill_saved",
             ab["radix_shared_prefix"]["prefill_tokens_saved_frac"],
             "frac")
+    rab = result["reqtrace_ab"]
+    _report("serve_sat_reqtrace_on_req_per_s",
+            rab["reqtrace_on"]["requests_per_s"], "req/s")
+    _report("serve_sat_reqtrace_off_req_per_s",
+            rab["reqtrace_off"]["requests_per_s"], "req/s")
     _report("serve_sat_passed", 1.0 if result["passed"] else 0.0,
-            "bool", gates=ab["gates"])
+            "bool", gates=dict(ab["gates"], **{
+                "reqtrace_" + k: v for k, v in rab["gates"].items()}))
     if artifact_path:
         with open(artifact_path, "w") as f:
             json.dump(result, f, indent=1)

@@ -137,6 +137,20 @@ class GrpcProxyActor:
         if key is None:
             await context.abort(grpc.StatusCode.NOT_FOUND,
                                 f"no application {app!r}")
+        # request observatory: accept/mint the request id, echo it in
+        # the initial metadata, and thread it (plus tenant/route) to the
+        # replica as the reserved context kwarg
+        import uuid
+        from ...llm import reqtrace
+        from ..context import REQUEST_CONTEXT_KWARG
+        request_id = meta.get(reqtrace.REQUEST_ID_HEADER) \
+            or uuid.uuid4().hex
+        tenant = meta.get(reqtrace.TENANT_HEADER)
+        try:
+            await context.send_initial_metadata(
+                ((reqtrace.REQUEST_ID_HEADER, request_id),))
+        except Exception:  # noqa: BLE001 — metadata already sent
+            logger.debug("initial metadata send failed", exc_info=True)
         router = self._router_for(key)
         model_id = meta.get("serve_multiplexed_model_id")
         hint = hash(model_id) if model_id else None
@@ -148,6 +162,11 @@ class GrpcProxyActor:
         if model_id:
             from ..multiplex import MODEL_ID_KWARG
             kwargs[MODEL_ID_KWARG] = model_id
+        kwargs[REQUEST_CONTEXT_KWARG] = (request_id, tenant,
+                                         f"grpc:{app or ''}")
+        reqtrace.record(request_id, reqtrace.ROUTED,
+                        route=f"grpc:{app or ''}",
+                        replica=tracked.actor_name, tenant=tenant)
         router._inc(tracked.actor_name)
         try:
             result = await tracked.handle.handle_request.remote(

@@ -14,8 +14,11 @@ import asyncio
 import json
 import logging
 import urllib.parse
+import uuid
 from typing import Any, Dict, Optional, Tuple
 
+from ...llm import reqtrace
+from ..context import REQUEST_CONTEXT_KWARG
 from .common import ReplicaInfo, SERVE_NAMESPACE
 from .router import PowerOfTwoChoicesRouter, make_router
 
@@ -170,10 +173,20 @@ class ProxyActor:
                 writer, 200, json.dumps(self._routes).encode(),
                 "application/json")
             return
-        key = self._match_route(request.path)
-        if key is None:
+        matched = self._match_route(request.path)
+        if matched is None:
             await self._respond(writer, 404, b"no route", "text/plain")
             return
+        prefix, key = matched
+        # request observatory: accept the client's id or mint one, stamp
+        # the matched route, and echo the id back on every response form
+        # (plain, chunked stream preamble, per-chunk payloads)
+        request_id = request.headers.get(reqtrace.REQUEST_ID_HEADER) \
+            or uuid.uuid4().hex
+        request.headers[reqtrace.REQUEST_ID_HEADER] = request_id
+        request.headers.setdefault(reqtrace.ROUTE_HEADER, prefix)
+        tenant = request.headers.get(reqtrace.TENANT_HEADER)
+        echo = {"X-RTPU-Request-Id": request_id}
         router = self._router_for(key)
         from ..multiplex import MODEL_ID_HEADER, MODEL_ID_KWARG
         model_id = request.headers.get(MODEL_ID_HEADER)
@@ -186,9 +199,14 @@ class ProxyActor:
             hint = _prefix_hint(request)
         tracked = await router.choose_async(hint)
         if tracked is None:
-            await self._respond(writer, 503, b"no replicas", "text/plain")
+            await self._respond(writer, 503, b"no replicas", "text/plain",
+                                extra_headers=echo)
             return
         kwargs = {MODEL_ID_KWARG: model_id} if model_id else {}
+        kwargs[REQUEST_CONTEXT_KWARG] = (
+            request_id, tenant, request.headers[reqtrace.ROUTE_HEADER])
+        reqtrace.record(request_id, reqtrace.ROUTED, route=prefix,
+                        replica=tracked.actor_name, tenant=tenant)
         router._inc(tracked.actor_name)
         streamed = False
         try:
@@ -197,28 +215,34 @@ class ProxyActor:
             if isinstance(result, dict) and "__rtpu_stream__" in result:
                 streamed = True
                 await self._relay_stream(
-                    writer, tracked, result["__rtpu_stream__"])
+                    writer, tracked, result["__rtpu_stream__"],
+                    request_id)
                 return
         except Exception as e:  # noqa: BLE001
             router.evict(tracked.actor_name)
             logger.warning("replica %s failed: %s", tracked.actor_name, e)
             if not streamed:
                 await self._respond(writer, 500, str(e).encode(),
-                                    "text/plain")
+                                    "text/plain", extra_headers=echo)
             return
         finally:
             router._dec(tracked.actor_name)
         status, payload, ctype = _encode_response(result)
-        await self._respond(writer, status, payload, ctype)
+        await self._respond(writer, status, payload, ctype,
+                            extra_headers=echo)
 
     async def _relay_stream(self, writer: asyncio.StreamWriter, tracked,
-                            stream_id: str):
+                            stream_id: str, request_id: str = ""):
         """Relay a replica token stream as chunked HTTP: long-poll
         `stream_next` on the SAME replica (its engine owns the stream
         buffer) and write each batch as one chunk of JSON lines. A client
-        disconnect cancels the generation on the replica."""
+        disconnect cancels the generation on the replica. The request id
+        rides the preamble header AND every JSON chunk (mid-stream
+        errors stay attributable after the 200 is long gone)."""
         writer.write(b"HTTP/1.1 200 OK\r\n"
-                     b"Content-Type: application/x-ndjson\r\n"
+                     b"Content-Type: application/x-ndjson\r\n" +
+                     (f"X-RTPU-Request-Id: {request_id}\r\n".encode(
+                         "latin1") if request_id else b"") +
                      b"Transfer-Encoding: chunked\r\n\r\n")
         try:
             while True:
@@ -229,6 +253,8 @@ class ProxyActor:
                     # `data:` events from the OpenAI-compat server)
                     payload = batch["data"].encode()
                 elif batch.get("tokens") or batch.get("error"):
+                    if request_id:
+                        batch.setdefault("request_id", request_id)
                     payload = json.dumps(batch).encode() + b"\n"
                 else:
                     payload = b""
@@ -269,24 +295,29 @@ class ProxyActor:
                 logger.debug("proxy conn close failed", exc_info=True)
             raise
 
-    def _match_route(self, path: str) -> Optional[str]:
+    def _match_route(self, path: str) -> Optional[Tuple[str, str]]:
+        """Longest-prefix match: (route prefix, deployment key)."""
         best = None
         best_len = -1
         for prefix, key in self._routes.items():
             if (path == prefix or path.startswith(prefix.rstrip("/") + "/")
                     or prefix == "/") and len(prefix) > best_len:
-                best = key
+                best = (prefix, key)
                 best_len = len(prefix)
         return best
 
     async def _respond(self, writer: asyncio.StreamWriter, status: int,
-                       body: bytes, content_type: str):
+                       body: bytes, content_type: str,
+                       extra_headers: Optional[Dict[str, str]] = None):
         reason = {200: "OK", 404: "Not Found", 500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "")
+        extra = "".join(f"{name}: {value}\r\n"
+                        for name, value in (extra_headers or {}).items())
         writer.write(
             f"HTTP/1.1 {status} {reason}\r\n"
             f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
+            f"{extra}"
             f"\r\n".encode("latin1") + body)
         await writer.drain()
 

@@ -95,6 +95,13 @@ class Replica:
         model_id = kwargs.pop(MODEL_ID_KWARG, None)
         if model_id is not None:
             _set_current_model_id(model_id)
+        # proxy-stamped request context (request id, tenant, route) —
+        # same reserved-kwarg smuggling; read via
+        # serve.context.get_request_context() (request observatory)
+        from ..context import REQUEST_CONTEXT_KWARG, _set_request_context
+        request_context = kwargs.pop(REQUEST_CONTEXT_KWARG, None)
+        if request_context is not None:
+            _set_request_context(*request_context)
         self._ongoing += 1
         metrics = _replica_metrics()
         tags = {"deployment": self.deployment_name}
@@ -124,6 +131,10 @@ class Replica:
         """Generator variant: yields chunks (called with
         num_returns='streaming'). The user target must return a (sync or
         async) generator."""
+        from ..context import REQUEST_CONTEXT_KWARG, _set_request_context
+        request_context = kwargs.pop(REQUEST_CONTEXT_KWARG, None)
+        if request_context is not None:
+            _set_request_context(*request_context)
         self._ongoing += 1
         metrics = _replica_metrics()
         tags = {"deployment": self.deployment_name}

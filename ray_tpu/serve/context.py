@@ -1,0 +1,46 @@
+"""Per-request serve context: the request id + tenant/route labels the
+proxy stamps on every request (request observatory, llm/reqtrace.py).
+
+The HTTP/gRPC proxies accept or generate an ``X-RTPU-Request-Id``
+(echoed back to the client on the response and on every ndjson/SSE
+stream chunk), resolve the matched route prefix, and smuggle all three
+through the router -> replica hop as reserved kwargs (the multiplex
+MODEL_ID_KWARG pattern). ``replica.handle_request`` pops them and binds
+this contextvar, so deployment code — e.g. ``llm.LLMServer`` labeling
+its ``GenerationRequest`` — reads them via
+``serve.context.get_request_context()`` without any signature
+plumbing."""
+
+from __future__ import annotations
+
+import contextvars
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestContext:
+    request_id: str = ""
+    tenant: Optional[str] = None
+    route: Optional[str] = None
+
+
+_current: contextvars.ContextVar[RequestContext] = contextvars.ContextVar(
+    "rtpu_serve_request_context", default=RequestContext())
+
+#: reserved kwarg smuggling (request_id, tenant, route) through
+#: handle_request — popped by the replica before user code sees kwargs
+REQUEST_CONTEXT_KWARG = "__rtpu_request_context__"
+
+
+def get_request_context() -> RequestContext:
+    """Context of the serve request currently being handled (empty
+    outside a replica call)."""
+    return _current.get()
+
+
+def _set_request_context(request_id: str = "",
+                         tenant: Optional[str] = None,
+                         route: Optional[str] = None):
+    _current.set(RequestContext(request_id=request_id, tenant=tenant,
+                                route=route))

@@ -227,6 +227,14 @@ def flush_now(gcs=None, key: Optional[str] = None) -> bool:
                 key = worker.worker_id.hex() if isinstance(
                     worker.worker_id, bytes) else str(worker.worker_id)
         gcs.put(METRICS_KV_NS, key, snapshot_all_json())
+        # request-observatory piggyback (steptrace pattern): the serve
+        # plane's lifecycle rings ride the same flush cadence. Guarded
+        # via sys.modules so processes that never imported the serve
+        # plane pay nothing (and never import it from here).
+        import sys
+        mod = sys.modules.get("ray_tpu.llm.reqtrace")
+        if mod is not None:
+            mod.flush(gcs=gcs, key=key)
         return True
     except Exception:  # noqa: BLE001
         return False

@@ -1109,6 +1109,39 @@ def train_timeline(filename: Optional[str] = None
     return trace
 
 
+def serve_timeline(filename: Optional[str] = None
+                   ) -> List[Dict[str, Any]]:
+    """Serve-plane request timeline: every process's flushed
+    request-lifecycle events (llm/reqtrace.py) folded into one
+    chrome-trace JSON on the shared monotonic clock — one row per
+    request id, queue/park/prefill/decode state spans with
+    prefill-chunk and XLA-compile spans nested, PREEMPTED/RESUMED/
+    ROUTED as instants. The serve twin of `train_timeline()`."""
+    from ...llm import reqtrace
+    trace = reqtrace.to_chrome_trace(reqtrace.collect(_gcs()))
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def why_slow(request_id: str) -> Dict[str, Any]:
+    """Latency attribution for one served request: TTFT and e2e
+    decomposed into queue / prefill-compute / park / decode /
+    XLA-compile / other buckets from its flushed lifecycle events,
+    plus the raw event list. Accepts a unique request-id prefix."""
+    from ...llm import reqtrace
+    return reqtrace.why_slow(request_id, reqtrace.collect(_gcs()))
+
+
+def serve_requests(by: Optional[str] = None) -> Dict[str, Any]:
+    """Percentile fold over every traced serve request — TTFT/e2e
+    p50/p95, outcomes, preemptions, total park time — grouped by
+    "tenant" or "route" when `by` is given (`cli requests`)."""
+    from ...llm import reqtrace
+    return reqtrace.fold_requests(reqtrace.collect(_gcs()), by=by)
+
+
 def stragglers(limit: int = 100) -> Dict[str, Any]:
     """The straggler/skew view: STRAGGLER_DETECTED events (which rank,
     which phase, how far above the peer median) next to the per-track
