@@ -1,0 +1,88 @@
+"""Sanctioned fire-and-forget task spawning (rtpulint rule A001).
+
+``asyncio.create_task(coro())`` with the handle dropped is how
+background work silently dies: an exception raised by the coroutine
+sits in the garbage-collected task and surfaces — if ever — as an
+"exception was never retrieved" line at loop shutdown, long after the
+subsystem it killed stopped making progress. rtpulint's A001 flags
+every such site; :func:`spawn` is the approved replacement. It attaches
+a done-callback that retrieves the task's exception, logs it through
+the structured logger with the spawn's ``what`` label, and bumps
+``rtpu_async_task_errors_total`` so a dying background loop shows up on
+dashboards instead of in a post-mortem.
+
+Intentionally tiny: no retry, no supervision — a failed background task
+is a bug to surface, not a condition to paper over.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import logging
+import threading
+from types import SimpleNamespace
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _build_aio_metrics():
+    from ..util.metrics import Counter
+    return SimpleNamespace(
+        task_errors=Counter(
+            "rtpu_async_task_errors_total",
+            "Exceptions raised by fire-and-forget background tasks "
+            "(spawned via _internal.aio.spawn), by task label",
+            tag_keys=("what",)),
+    )
+
+
+# util.metrics' LazyMetrics can't be imported at module scope here:
+# core_worker imports this module, and ray_tpu.util's package __init__
+# imports core_worker back — so even the import must be deferred to
+# first use, not just the build().
+_METRICS_LOCK = threading.Lock()
+_METRICS_NS = None
+
+
+def _METRICS():
+    global _METRICS_NS
+    if _METRICS_NS is None:
+        with _METRICS_LOCK:
+            if _METRICS_NS is None:
+                _METRICS_NS = _build_aio_metrics()
+    return _METRICS_NS
+
+
+def _sink(what: str, task: "asyncio.Task"):
+    if task.cancelled():
+        return                      # orderly shutdown, not a failure
+    exc = task.exception()
+    if exc is None:
+        return
+    try:
+        _METRICS().task_errors.inc(tags={"what": what})
+    except Exception:  # metrics must never mask the error log below
+        logger.debug("task-error metric bump failed", exc_info=True)
+    logger.error("background task %r failed", what, exc_info=exc)
+
+
+def spawn(coro, *, what: str = "",
+          loop: Optional[asyncio.AbstractEventLoop] = None
+          ) -> "asyncio.Task":
+    """Schedule ``coro`` as a background task whose failures are logged
+    and counted instead of silently dropped.
+
+    ``what`` labels the task in logs and in the
+    ``rtpu_async_task_errors_total`` counter (defaults to the
+    coroutine's qualname). Pass ``loop`` to schedule onto a specific
+    loop (``loop.create_task``); otherwise the running loop is used.
+    Returns the task — callers MAY still retain it for cancellation,
+    but don't have to for error visibility.
+    """
+    name = what or getattr(coro, "__qualname__", "") or repr(coro)
+    task = loop.create_task(coro) if loop is not None \
+        else asyncio.ensure_future(coro)
+    task.add_done_callback(functools.partial(_sink, name))
+    return task

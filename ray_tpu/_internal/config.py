@@ -341,6 +341,12 @@ _DEFAULTS: Dict[str, Any] = {
     # exact-legacy per-drain admission (blocking inline prefill, upfront
     # page reservation, token-tuple prefix LRU, no preemption).
     "no_cont_batch": False,
+    # --- event-loop stall sanitizer (_internal/lint/loopstall.py) ---
+    # Armed together with the lock-order sanitizer (RTPU_SANITIZE=1):
+    # any single callback that holds a ray_tpu-owned event loop longer
+    # than this budget is recorded with its creation site. 0 disables
+    # recording even when sanitized.
+    "loopstall_budget_ms": 50.0,
     # --- overrides re-read from the environment at their use site
     # (tests monkeypatch them after CONFIG construction; registered here
     # so L003 can resolve the names) ---

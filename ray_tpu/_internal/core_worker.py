@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 import numpy as np
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
+from . import aio
 from .backoff import Backoff
 from .config import CONFIG
 from .errors import (ActorDiedError, ActorUnavailableError, GetTimeoutError,
@@ -1091,7 +1092,7 @@ class NormalTaskSubmitter:
         self._probed[spec.task_id] = ps
         if not self._probe_sweeper_on:
             self._probe_sweeper_on = True
-            asyncio.ensure_future(self._probe_sweeper())
+            aio.spawn(self._probe_sweeper(), what="probe_sweeper")
         try:
             return await push
         except asyncio.CancelledError:
@@ -1503,7 +1504,8 @@ class NormalTaskSubmitter:
                 continue
             if not self._cleaner_started:
                 self._cleaner_started = True
-                asyncio.ensure_future(self._idle_lease_cleaner())
+                aio.spawn(self._idle_lease_cleaner(),
+                          what="idle_lease_cleaner")
             return Lease(
                 lease_id=reply["lease_id"],
                 worker_address=tuple(reply["worker_address"]),
@@ -1992,7 +1994,7 @@ class ActorTaskSubmitter:
                     (st.address, spec.flat_template.tid))
             if spec.attempt_number < 3:
                 spec.attempt_number += 1
-                asyncio.ensure_future(self._push(st, spec))
+                aio.spawn(self._push(st, spec), what="actor_task_repush")
             else:
                 self._fail(spec, sys_err)
                 self._push_untracked_tombstone(st, spec)
@@ -2054,7 +2056,8 @@ class ActorTaskSubmitter:
                     if self._awaiting.pop(task_id, None) is not None:
                         self._push_time.pop(task_id, None)
                         st.inflight.pop(spec.sequence_number, None)
-                        asyncio.ensure_future(self._push(st, spec))
+                        aio.spawn(self._push(st, spec),
+                                  what="actor_task_resend")
                 else:  # lost: executed but reply evicted — unrecoverable
                     if self._awaiting.pop(task_id, None) is not None:
                         self._push_time.pop(task_id, None)
@@ -2162,7 +2165,7 @@ class ActorTaskSubmitter:
                         spec.sequence_number = st.seq
                         st.seq += 1
             for spec in pending:
-                asyncio.ensure_future(self._push(st, spec))
+                aio.spawn(self._push(st, spec), what="actor_task_replay")
         elif state == "RESTARTING":
             with st.lock:
                 st.state = "RESTARTING"
@@ -2358,7 +2361,8 @@ class TaskExecutor:
             spec, fut = buffer.pop(self._next_seq[caller])
             self._next_seq[caller] += 1
             if self._is_asyncio:
-                asyncio.ensure_future(self._run_async_actor_task(spec, fut))
+                aio.spawn(self._run_async_actor_task(spec, fut),
+                          what="async_actor_task")
             else:
                 group = spec.concurrency_groups.get("_group") \
                     if spec.concurrency_groups else None
@@ -3834,7 +3838,7 @@ class CoreWorker:
         from . import accel
         report = accel.accel_report()
         for pressed in report.get("pressure", ()):
-            asyncio.ensure_future(self.gcs.call(
+            aio.spawn(self.gcs.call(
                 "add_event", event_type="DEVICE_MEMORY_PRESSURE",
                 message=(f"device {pressed['device']} "
                          f"({pressed['device_kind']}) HBM at "

@@ -39,6 +39,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import aio
 from .backoff import Backoff
 from .config import CONFIG
 from .errors import ActorDiedError, PlacementGroupError
@@ -259,10 +260,11 @@ class GcsServer:
             for record in self.actors.values():
                 if record.state in ("PENDING", "RESTARTING"):
                     record.sched_epoch += 1
-                    asyncio.ensure_future(self._schedule_actor(record))
+                    aio.spawn(self._schedule_actor(record),
+                              what="schedule_actor")
             for pg in self.pgs.values():
                 if pg.state in ("PENDING", "RESCHEDULING"):
-                    asyncio.ensure_future(self._schedule_pg(pg))
+                    aio.spawn(self._schedule_pg(pg), what="schedule_pg")
         if self._persist_mode == "wal":
             self._mutate("meta", "incarnation", self.incarnation)
             # Clean base for the new incarnation: fold the recovered WAL
@@ -944,7 +946,7 @@ class GcsServer:
             except Exception:
                 logger.debug("kill_actor during drain migration failed "
                              "(worker already gone?)", exc_info=True)
-        asyncio.ensure_future(self._schedule_actor(record))
+        aio.spawn(self._schedule_actor(record), what="schedule_actor")
         self._mutate("actor", record.actor_id, record)
         logger.info("migrating actor %s: %s",
                     record.actor_id.hex()[:12], cause)
@@ -1084,7 +1086,7 @@ class GcsServer:
             if pg.state in ("CREATED", "PENDING") and \
                     node_id in [n for n in pg.bundle_nodes if n]:
                 pg.state = "RESCHEDULING"
-                asyncio.ensure_future(self._schedule_pg(pg))
+                aio.spawn(self._schedule_pg(pg), what="schedule_pg")
         self._mutate("node", node_id, rec)
 
     async def handle_report_node_death(self, node_id: str, cause: str,
@@ -1239,8 +1241,9 @@ class GcsServer:
             rec = self.nodes.get(node_id)
             if rec and rec.state == "ALIVE":
                 client = self.clients.get(rec.address)
-                asyncio.ensure_future(client.call(
-                    "free_objects", object_hexes=hexes, timeout=5))
+                aio.spawn(client.call(
+                    "free_objects", object_hexes=hexes, timeout=5),
+                    what="free_objects")
         return True
 
     # ------------------------------------------------------------------
@@ -1437,7 +1440,7 @@ class GcsServer:
             self._mutate("named", (namespace, name), actor_id,
                          legacy_persist=False)
         record.sched_epoch += 1
-        asyncio.ensure_future(self._schedule_actor(record))
+        aio.spawn(self._schedule_actor(record), what="schedule_actor")
         self._mutate("actor", actor_id, record)
         return {"actor_id": actor_id, "existing": False}
 
@@ -1524,9 +1527,9 @@ class GcsServer:
             lease_id = reply["lease_id"]
             if record.sched_epoch != epoch or record.state == "DEAD":
                 # Stale loop: give the worker back and bow out.
-                asyncio.ensure_future(raylet.call(
+                aio.spawn(raylet.call(
                     "return_worker", lease_id=lease_id, dispose=True,
-                    timeout=10))
+                    timeout=10), what="return_worker")
                 return
             # Push the creation task directly to the leased worker. Bounded:
             # a worker wedged inside a pathological __init__ (alive, never
@@ -1541,17 +1544,17 @@ class GcsServer:
                 # Dispose the (possibly wedged) worker and free its lease —
                 # a gang-reserved slice must not stay held by a failed
                 # creation attempt or the restart can never place.
-                asyncio.ensure_future(raylet.call(
+                aio.spawn(raylet.call(
                     "return_worker", lease_id=lease_id, dispose=True,
-                    timeout=10))
+                    timeout=10), what="return_worker")
                 if record.sched_epoch == epoch:
                     await self._handle_actor_failure(
                         record, f"creation task push failed: {e}")
                 return
             if record.sched_epoch != epoch or record.state == "DEAD":
-                asyncio.ensure_future(raylet.call(
+                aio.spawn(raylet.call(
                     "return_worker", lease_id=lease_id, dispose=True,
-                    timeout=10))
+                    timeout=10), what="return_worker")
                 return
             if result.get("error") is not None:
                 if "double-granted lease" in str(result["error"]):
@@ -1563,14 +1566,14 @@ class GcsServer:
                         "actor %s creation hit a double-granted worker "
                         "on %s; rescheduling", spec.actor_id.hex()[:12],
                         node_id[:12])
-                    asyncio.ensure_future(raylet.call(
+                    aio.spawn(raylet.call(
                         "return_worker", lease_id=lease_id, dispose=True,
-                        timeout=10))
+                        timeout=10), what="return_worker")
                     if record.sched_epoch == epoch and \
                             record.state != "DEAD":
                         record.sched_epoch += 1
-                        asyncio.ensure_future(
-                            self._schedule_actor(record))
+                        aio.spawn(self._schedule_actor(record),
+                                  what="schedule_actor")
                     return
                 record.state = "DEAD"
                 record.death_cause = f"creation failed: {result['error']}"
@@ -1648,7 +1651,7 @@ class GcsServer:
             record.node_id = None
             record.sched_epoch += 1
             self._publish_actor(record)
-            asyncio.ensure_future(self._schedule_actor(record))
+            aio.spawn(self._schedule_actor(record), what="schedule_actor")
         else:
             record.state = "DEAD"
             record.death_cause = cause
@@ -1790,7 +1793,7 @@ class GcsServer:
             creator_job=creator_job, is_detached=is_detached,
             bundle_nodes=[None] * len(bundles))
         self.pgs[pg_id] = record
-        asyncio.ensure_future(self._schedule_pg(record))
+        aio.spawn(self._schedule_pg(record), what="schedule_pg")
         self._mutate("pg", pg_id, record)
         return True
 

@@ -50,23 +50,58 @@ L010 metric-catalog sync: every ``rtpu_*`` series constructed in the
      both directions, so the catalog can't silently rot
 ==== =====================================================================
 
+On top of the per-file L-series, ``.crossmod`` runs a two-pass
+cross-module analysis (pass 1 indexes the whole tree: defs, internal
+call edges, async defs, jit-wrapped functions; pass 2 runs flow-aware
+rules over the index):
+
+==== =====================================================================
+A001 fire-and-forget ``create_task``/``ensure_future``: handle dropped
+     and the coroutine (call graph walked through thin await-wrappers)
+     has no terminal exception sink — use ``_internal.aio.spawn()``,
+     retain the handle, or annotate ``# task ok: <why>``
+A002 coroutine called as a bare statement but never awaited/scheduled
+     (the body never runs)
+A003 known-blocking call (the L001 table) lexically inside an
+     ``async def`` — stalls the whole loop; ``run_in_executor`` it or
+     annotate ``# blocking ok: <why>``
+J001 host-sync primitive (``block_until_ready``, ``device_get``,
+     ``np.asarray``, ``.item()``, ``float()/int()`` of an array)
+     reachable from a per-step hot function (jit-wrapped, driving a
+     jit step, or annotated ``# rtpu: hot-loop``) — annotate deliberate
+     sync points ``# host-sync ok: <why>``
+J002 jit-staged function closing over a mutable dict/list (module
+     global or enclosing-function local): stale captures / recompile
+     hazard — pass as argument or annotate ``# jit capture ok: <why>``
+J003 donated-argument reuse after a ``donate_argnums`` call site —
+     rebind the result or annotate ``# donate ok: <why>``
+==== =====================================================================
+
 Violations report ``file:line`` and carry a stable allowlist key
 ``RULE path:scope`` (scope = enclosing def/class qualname, so the key
 survives unrelated line shifts). ``allowlist.txt`` is a burn-down list:
-tests assert it only shrinks, and unused entries are themselves errors.
+tests assert it only shrinks and that every entry still matches a live
+violation (stale entries are themselves errors).
 
-Run: ``python -m ray_tpu._internal.lint [--json]`` or ``cli lint``.
-The companion runtime lock-order sanitizer lives in ``.sanitizer``
-(enable with ``RTPU_SANITIZE=1``; see that module's docstring).
+Run: ``python -m ray_tpu._internal.lint [--json] [--changed]`` or
+``cli lint``. Exit codes: 0 clean, 1 violations (or stale/malformed
+allowlist entries), 2 usage/environment error (bad --root, git
+unavailable for --changed). The companion *dynamic* checkers live in
+``.sanitizer`` (lock-order) and ``.loopstall`` (event-loop stall
+budget); both arm under ``RTPU_SANITIZE=1``.
 """
 
 from __future__ import annotations
 
+import ast
 import json
 import os
+import re
+import sys
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from . import crossmod
 from .rules import (MetricDecl, ShardAccess, ShardTableDecl, Violation,
                     check_shard_confinement, lint_source)
 
@@ -151,7 +186,7 @@ def load_allowlist(path: str) -> Tuple[List[AllowEntry], List[str]]:
             if not line or line.startswith("#"):
                 continue
             parts = line.split(None, 2)
-            if len(parts) < 3 or not parts[0].startswith("L") \
+            if len(parts) < 3 or not re.match(r"^[ALJ]\d{3}$", parts[0]) \
                     or ":" not in parts[1]:
                 bad.append(line)
                 continue
@@ -192,6 +227,7 @@ def run_lint(root: Optional[str] = None,
     metric_decls: List[MetricDecl] = []
     shard_decls: List[ShardTableDecl] = []
     shard_accesses: List[ShardAccess] = []
+    module_facts: List[crossmod.ModuleFacts] = []
     for filepath in iter_source_files(root):
         rel = os.path.relpath(filepath, root)
         try:
@@ -202,17 +238,29 @@ def run_lint(root: Optional[str] = None,
                 rule="L000", path=rel, line=0, scope="<module>",
                 message=f"unreadable source file: {e}"))
             continue
-        violations, decls, sdecls, saccs = lint_source(src, rel)
+        # One parse feeds both the per-file visitor and the
+        # cross-module facts collector.
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            all_violations.append(Violation(
+                rule="L000", path=rel, line=e.lineno or 0,
+                scope="<module>", message=f"syntax error: {e.msg}"))
+            report.checked_files += 1
+            continue
+        violations, decls, sdecls, saccs = lint_source(src, rel, tree=tree)
         all_violations.extend(violations)
         metric_decls.extend(decls)
         shard_decls.extend(sdecls)
         shard_accesses.extend(saccs)
+        module_facts.append(crossmod.collect(tree, rel, src.splitlines()))
         report.checked_files += 1
 
     all_violations.extend(_check_metric_consistency(metric_decls))
     all_violations.extend(_check_metric_catalog(metric_decls, root))
     all_violations.extend(
         check_shard_confinement(shard_decls, shard_accesses))
+    all_violations.extend(crossmod.check_tree(module_facts))
 
     for v in all_violations:
         entry = by_key.get(v.key)
@@ -306,11 +354,30 @@ def _check_metric_catalog(decls: List[MetricDecl],
     return out
 
 
+def changed_files(root: str) -> List[str]:
+    """Repo-relative paths touched vs HEAD (staged + unstaged +
+    untracked), for ``--changed``. Raises OSError/CalledProcessError
+    when git is unavailable — main() maps that to exit code 2."""
+    import subprocess
+    rels: List[str] = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        out = subprocess.check_output(cmd, cwd=root, text=True,
+                                      stderr=subprocess.DEVNULL)
+        rels.extend(line.strip() for line in out.splitlines()
+                    if line.strip())
+    return sorted(set(rels))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    """Exit codes: 0 clean; 1 violations (or stale/malformed allowlist
+    entries); 2 usage or environment error (``--changed`` without a
+    usable git checkout)."""
     import argparse
     parser = argparse.ArgumentParser(
         prog="rtpulint",
-        description="ray_tpu project lint (rules L001-L010)")
+        description="ray_tpu project lint (rules L001-L010, A001-A003, "
+                    "J001-J003)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable report on stdout")
     parser.add_argument("--root", default=None,
@@ -319,9 +386,26 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="alternative allowlist file")
     parser.add_argument("--no-allowlist", action="store_true",
                         help="report allowlisted violations too")
+    parser.add_argument("--changed", action="store_true",
+                        help="report only violations in files changed "
+                             "vs HEAD (the whole tree is still "
+                             "analyzed: cross-module rules need the "
+                             "full index)")
     args = parser.parse_args(argv)
     report = run_lint(root=args.root, allowlist_path=args.allowlist,
                       use_allowlist=not args.no_allowlist)
+    if args.changed:
+        root = args.root or package_root()
+        try:
+            touched = set(changed_files(root))
+        except Exception as e:  # noqa: BLE001 — any git failure is fatal
+            print(f"rtpulint: --changed needs git: {e}",  # stdout ok: CLI
+                  file=sys.stderr)
+            return 2
+        report.violations = [v for v in report.violations
+                             if v.path in touched]
+        # Allowlist staleness stays a whole-tree property: an entry
+        # whose violation lives in an untouched file is still live.
     print(report.to_json() if args.json  # stdout ok: CLI output
           else report.render())
     return 0 if report.ok else 1

@@ -1,0 +1,872 @@
+"""Cross-module pass for rtpulint: asyncio lifecycle + JAX hygiene.
+
+The per-file visitor in :mod:`.rules` is deliberately blind across
+module boundaries; this module adds the two-pass engine that isn't.
+Pass 1 (:func:`collect`) walks every file's AST once and records
+*facts* — function definitions (async? jit-wrapped? has an exception
+sink?), internal call edges, task-spawn sites, host-sync primitives,
+mutable module globals, ``donate_argnums`` wrappers. Pass 2
+(:func:`check_tree`) folds the whole-tree index and runs the flow-aware
+rules:
+
+==== =====================================================================
+A001 fire-and-forget ``create_task``/``ensure_future`` whose handle is
+     dropped AND whose coroutine has no terminal exception sink (a broad
+     ``except`` that doesn't just re-raise, found by walking the local
+     call graph through thin ``await``-delegation wrappers). An
+     unhandled exception in such a task is invisible until the loop's
+     exception handler prints it at shutdown — use ``_internal.aio
+     .spawn()`` (logs + counts failures), retain the handle, or
+     annotate ``# task ok: <why>``
+A002 coroutine called as a bare statement but never awaited or
+     scheduled — the call builds a coroutine object and drops it; the
+     body never runs (Python warns only at GC time, and only sometimes)
+A003 known-blocking call (the L001 blocking table: ``time.sleep``,
+     subprocess, socket connect, ``.call_sync``/``.run_sync``,
+     socket send/recv) lexically inside an ``async def`` — it stalls
+     the whole event loop, not just this coroutine; move it to
+     ``run_in_executor`` or annotate ``# blocking ok: <why>``
+J001 host-sync primitive (``.block_until_ready()``, ``device_get``,
+     ``np.asarray``/``np.array``, ``.item()``, ``float()``/``int()`` of
+     an array) reachable from a per-step hot function — jit-wrapped,
+     annotated ``# rtpu: hot-loop``, or directly driving a jit-wrapped
+     step. Every such sync serializes host and device (the Podracer
+     failure mode); deliberate sync points annotate
+     ``# host-sync ok: <why>``
+J002 jit-staged function closes over a mutable dict/list (module
+     global or enclosing-function local): mutations after trace are
+     silently stale (captured as constants) or force recompiles —
+     pass it as an argument, or annotate ``# jit capture ok: <why>``
+J003 donated-argument reuse: after ``f = jax.jit(g, donate_argnums=k)``
+     the buffer passed at position ``k`` is invalidated by the call;
+     a later read of the same variable (without rebinding) is
+     use-after-donate. Rebind (``state = step(state)``) or annotate
+     ``# donate ok: <why>``
+==== =====================================================================
+
+All six report the same stable allowlist key shape as the L-series
+(``RULE path:scope``). The sibling *dynamic* checker for the A-series
+bug class is :mod:`.loopstall` (event-loop stall sanitizer).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import Violation, _broad_handler, _dotted, _terminal
+
+__all__ = ["ModuleFacts", "TreeIndex", "collect", "collect_source",
+           "check_tree", "analyze_sources"]
+
+# -- suppression marks (same-line comments) ---------------------------------
+_TASK_OK_MARK = "# task ok"
+_BLOCKING_OK_MARK = "# blocking ok"
+_HOST_SYNC_OK_MARK = "# host-sync ok"
+_JIT_CAPTURE_OK_MARK = "# jit capture ok"
+_DONATE_OK_MARK = "# donate ok"
+_HOT_LOOP_MARK = "# rtpu: hot-loop"
+
+_SPAWN_TERMS = {"create_task", "ensure_future"}
+
+# A003 reuses the L001 blocking tables, minus the bare ``.call`` method:
+# in this codebase ``.call()`` is the *async* RPC verb (``.call_sync``
+# is its blocking twin), so flagging it inside async defs would ban the
+# normal path.
+_A003_DOTTED = {
+    "time.sleep",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "socket.create_connection",
+}
+_A003_METHODS = {"call_sync", "run_sync", "recv", "sendall", "accept"}
+
+# J001 host-sync primitives.
+_HOST_SYNC_DOTTED = {
+    "jax.device_get", "np.asarray", "numpy.asarray", "onp.asarray",
+    "np.array", "numpy.array", "onp.array",
+}
+_JIT_DOTTED = {"jax.jit", "jit"}
+_PARTIAL_DOTTED = {"partial", "functools.partial"}
+_MUTABLE_CTORS = {"dict", "list", "defaultdict", "OrderedDict"}
+
+_MAX_SINK_DEPTH = 5      # A001 delegation walk
+_J001_DEPTH = 2          # J001 reachability from a hot function
+
+# J001: int()/float() over shape/size metadata is host math on ints the
+# runtime already has — never a device sync. Exempt args whose subtree
+# reads one of these attributes or calls one of these size functions.
+_SHAPE_ATTRS = {"shape", "size", "ndim", "nbytes", "itemsize"}
+_SHAPE_FUNCS = {"len", "prod", "size", "ndim"}
+
+
+def _is_shape_math(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            return True
+        if isinstance(node, ast.Call) \
+                and _terminal(_dotted(node.func)) in _SHAPE_FUNCS:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# pass-1 fact records
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    module: str
+    qualname: str            # scope-style qualname ("Cls.meth")
+    name: str                # bare name
+    line: int
+    is_async: bool = False
+    jit: bool = False        # jit-decorated, or jax.jit-wrapped by name
+    hot_annotated: bool = False   # "# rtpu: hot-loop" on the def line
+    has_sink: bool = False   # broad except that doesn't just re-raise
+    delegate_only: bool = False   # body is nothing but awaited calls
+    delegates: Tuple[str, ...] = ()   # terminal names it awaits
+    # terminal callee name -> called inside a loop? (True wins)
+    calls: Dict[str, bool] = field(default_factory=dict)
+    parent: Optional["FuncInfo"] = None            # enclosing function
+    # (kind, line, annotated, in_loop) host-sync sites in this func
+    host_syncs: List[Tuple[str, int, bool, bool]] = field(
+        default_factory=list)
+    # Name loads/stores: {name: [lineno, ...]} — J003's reuse window
+    loads: Dict[str, List[int]] = field(default_factory=dict)
+    stores: Dict[str, List[int]] = field(default_factory=dict)
+    local_names: Set[str] = field(default_factory=set)
+    # locals bound to a dict/list literal (J002 closure hazard)
+    mutable_locals: Dict[str, int] = field(default_factory=dict)
+    # free Name loads (resolved against globals in pass 2): (name, line,
+    # annotated)
+    free_loads: List[Tuple[str, int, bool]] = field(default_factory=list)
+
+
+@dataclass
+class SpawnSite:
+    """A create_task/ensure_future call whose handle is dropped."""
+    module: str
+    line: int
+    scope: str
+    coro_term: Optional[str]     # terminal name of the coroutine call
+    coro_recv: Optional[str]     # dotted receiver ("self.gcs"), if any
+    annotated: bool
+
+
+@dataclass
+class StmtCall:
+    """A bare expression-statement call (A002 candidate)."""
+    module: str
+    line: int
+    scope: str
+    term: str
+    recv: Optional[str]          # dotted receiver, None for bare names
+
+
+@dataclass
+class DonationCall:
+    """A call through a donate_argnums wrapper with a plain-Name arg at
+    a donated position."""
+    module: str
+    line: int
+    scope: str
+    callee: str
+    argname: str
+    annotated: bool
+    func: FuncInfo               # enclosing function (loads/stores live here)
+
+
+@dataclass
+class ModuleFacts:
+    path: str
+    funcs: List[FuncInfo] = field(default_factory=list)
+    by_name: Dict[str, List[FuncInfo]] = field(default_factory=dict)
+    # from-import bindings: local name -> (module path guess, orig name)
+    imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    stmt_calls: List[StmtCall] = field(default_factory=list)
+    blocking_in_async: List[Violation] = field(default_factory=list)
+    mutable_globals: Dict[str, int] = field(default_factory=dict)
+    # callable name -> donated arg positions (jax.jit donate_argnums)
+    donations: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    donation_calls: List[DonationCall] = field(default_factory=list)
+
+
+# ---------------------------------------------------------------------------
+# pass-1 visitor
+# ---------------------------------------------------------------------------
+
+
+def _resolve_import(path: str, level: int, module: str) -> Optional[str]:
+    """Guess the repo-relative .py path a from-import refers to.
+    ``path`` is the importing file ("ray_tpu/serve/_private/proxy.py")."""
+    if level == 0:
+        if not module.startswith("ray_tpu"):
+            return None
+        return module.replace(".", "/") + ".py"
+    parts = path.split("/")[:-1]          # package dirs of the importer
+    if level - 1 > 0:
+        parts = parts[:-(level - 1)] if level - 1 <= len(parts) else []
+    if module:
+        parts = parts + module.split(".")
+    return "/".join(parts) + ".py" if parts else None
+
+
+class _FactsVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, src_lines: Sequence[str]):
+        self.facts = ModuleFacts(path=path)
+        self._lines = src_lines
+        # Pseudo-function holding module-level (and class-body) code so
+        # J003 works in train-script-style modules.
+        self._module_func = FuncInfo(module=path, qualname="<module>",
+                                     name="<module>", line=0)
+        self.facts.funcs.append(self._module_func)
+        self._func_stack: List[FuncInfo] = [self._module_func]
+        self._scope_names: List[str] = []
+        self._class_depth = 0
+        self._loop_depth = 0      # For/While nesting INSIDE current func
+        self._awaited: Set[int] = set()     # id() of awaited Call nodes
+        self._dropped: Set[int] = set()     # id() of discarded-value Calls
+        # jax.jit(f) wrappers seen: (bare name of f, enclosing func) —
+        # resolved after the walk so forward references work
+        self._jit_wraps: List[Tuple[str, FuncInfo]] = []
+
+    # -- helpers ------------------------------------------------------------
+
+    @property
+    def _fn(self) -> FuncInfo:
+        return self._func_stack[-1]
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._scope_names) if self._scope_names \
+            else "<module>"
+
+    def _marked(self, node: ast.AST, mark: str) -> bool:
+        line = getattr(node, "lineno", 0)
+        if 0 < line <= len(self._lines):
+            return mark in self._lines[line - 1]
+        return False
+
+    # -- imports ------------------------------------------------------------
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        target = _resolve_import(self.facts.path, node.level,
+                                 node.module or "")
+        if target is not None:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                self.facts.imports[alias.asname or alias.name] = \
+                    (target, alias.name)
+        for alias in node.names:
+            self._fn.local_names.add(alias.asname
+                                     or alias.name.split(".")[0])
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            self._fn.local_names.add(alias.asname
+                                     or alias.name.split(".")[0])
+        self.generic_visit(node)
+
+    # -- scopes -------------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._scope_names.append(node.name)
+        self._class_depth += 1
+        self.generic_visit(node)
+        self._class_depth -= 1
+        self._scope_names.pop()
+
+    def _visit_func(self, node, is_async: bool):
+        info = FuncInfo(
+            module=self.facts.path,
+            qualname=".".join(self._scope_names + [node.name]),
+            name=node.name, line=node.lineno, is_async=is_async,
+            parent=(self._fn if self._fn is not self._module_func
+                    else None))
+        info.hot_annotated = self._marked(node, _HOT_LOOP_MARK)
+        info.jit = self._decorated_jit(node)
+        for arg in (list(node.args.posonlyargs) + list(node.args.args)
+                    + list(node.args.kwonlyargs)
+                    + [a for a in (node.args.vararg, node.args.kwarg)
+                       if a is not None]):
+            info.local_names.add(arg.arg)
+        self._collect_delegation(node, info)
+        self.facts.funcs.append(info)
+        self.facts.by_name.setdefault(node.name, []).append(info)
+        self._func_stack.append(info)
+        self._scope_names.append(node.name)
+        outer_loop, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = outer_loop
+        self._scope_names.pop()
+        self._func_stack.pop()
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = visit_AsyncFor = visit_While = _visit_loop
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self._visit_func(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._visit_func(node, is_async=True)
+
+    def visit_Lambda(self, node: ast.Lambda):
+        # A lambda body doesn't run where it's written: give it its own
+        # (non-async) scope so A003 doesn't flag executor thunks, and
+        # mark a spawn that IS the whole body as dropped (the common
+        # `call_soon(lambda: ensure_future(coro()))` trampoline returns
+        # the task to a caller that discards it).
+        if isinstance(node.body, ast.Call):
+            self._dropped.add(id(node.body))
+        info = FuncInfo(module=self.facts.path,
+                        qualname=".".join(self._scope_names + ["<lambda>"]),
+                        name="<lambda>", line=node.lineno,
+                        parent=(self._fn if self._fn is not self._module_func
+                                else None))
+        for arg in node.args.args:
+            info.local_names.add(arg.arg)
+        self.facts.funcs.append(info)
+        self._func_stack.append(info)
+        self._scope_names.append("<lambda>")
+        outer_loop, self._loop_depth = self._loop_depth, 0
+        self.generic_visit(node)
+        self._loop_depth = outer_loop
+        self._scope_names.pop()
+        self._func_stack.pop()
+
+    def _decorated_jit(self, node) -> bool:
+        for dec in node.decorator_list:
+            d = _dotted(dec)
+            if d in _JIT_DOTTED:
+                return True
+            if isinstance(dec, ast.Call):
+                dfunc = _dotted(dec.func)
+                if dfunc in _JIT_DOTTED:
+                    self._record_donation(node.name, dec)
+                    return True
+                if dfunc in _PARTIAL_DOTTED and dec.args \
+                        and _dotted(dec.args[0]) in _JIT_DOTTED:
+                    self._record_donation(node.name, dec)
+                    return True
+        return False
+
+    def _record_donation(self, callee_name: str, call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            positions: List[int] = []
+            vals = kw.value.elts if isinstance(kw.value, (ast.Tuple,
+                                                          ast.List)) \
+                else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    positions.append(v.value)
+            if positions:
+                self.facts.donations[callee_name] = tuple(sorted(positions))
+
+    def _collect_delegation(self, node, info: FuncInfo):
+        """Thin-wrapper detection for the A001 sink walk: a body that is
+        nothing but ``await <call>`` statements (plus a docstring)
+        delegates its exception story to the awaited callees."""
+        body = list(node.body)
+        if body and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Constant) \
+                and isinstance(body[0].value.value, str):
+            body = body[1:]
+        terms: List[str] = []
+        for stmt in body:
+            value = None
+            if isinstance(stmt, (ast.Expr, ast.Return)):
+                value = stmt.value
+            elif isinstance(stmt, ast.Pass):
+                continue
+            if isinstance(value, ast.Await) \
+                    and isinstance(value.value, ast.Call):
+                term = _terminal(_dotted(value.value.func))
+                if term:
+                    terms.append(term)
+                    continue
+            return  # anything else: not a pure delegation wrapper
+        if terms:
+            info.delegate_only = True
+            info.delegates = tuple(terms)
+
+    # -- exception sinks (A001) ---------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if _broad_handler(node) is not None and not all(
+                isinstance(s, ast.Raise) for s in node.body):
+            self._fn.has_sink = True
+        self.generic_visit(node)
+
+    # -- statement / await context ------------------------------------------
+
+    def visit_Expr(self, node: ast.Expr):
+        if isinstance(node.value, ast.Call):
+            self._dropped.add(id(node.value))
+            call = node.value
+            term = _terminal(_dotted(call.func))
+            # A002 candidate: a bare statement call that isn't awaited
+            # and isn't itself a spawn. Recorded here (statement
+            # context); resolution to an async def happens in pass 2.
+            if term and term not in _SPAWN_TERMS:
+                recv = None
+                if isinstance(call.func, ast.Attribute):
+                    recv = _dotted(call.func.value)
+                self.facts.stmt_calls.append(StmtCall(
+                    module=self.facts.path, line=call.lineno,
+                    scope=self.scope, term=term, recv=recv))
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    # -- names (J002/J003) --------------------------------------------------
+
+    def visit_Name(self, node: ast.Name):
+        fn = self._fn
+        if isinstance(node.ctx, ast.Load):
+            fn.loads.setdefault(node.id, []).append(node.lineno)
+            if node.id not in fn.local_names:
+                fn.free_loads.append(
+                    (node.id, node.lineno,
+                     self._marked(node, _JIT_CAPTURE_OK_MARK)))
+        else:
+            fn.stores.setdefault(node.id, []).append(node.lineno)
+            fn.local_names.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        self._maybe_mutable_binding(node.targets, node.value, node.lineno)
+        self._maybe_jit_wrap(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._maybe_mutable_binding([node.target], node.value,
+                                        node.lineno)
+            self._maybe_jit_wrap([node.target], node.value)
+        self.generic_visit(node)
+
+    def _maybe_mutable_binding(self, targets, value, lineno: int):
+        mutable = isinstance(value, (ast.Dict, ast.List, ast.DictComp,
+                                     ast.ListComp)) \
+            or (isinstance(value, ast.Call)
+                and _terminal(_dotted(value.func)) in _MUTABLE_CTORS)
+        if not mutable:
+            return
+        for target in targets:
+            if not isinstance(target, ast.Name):
+                continue
+            if self._fn is self._module_func and self._class_depth == 0:
+                self.facts.mutable_globals.setdefault(target.id, lineno)
+            elif self._fn is not self._module_func:
+                self._fn.mutable_locals.setdefault(target.id, lineno)
+
+    def _maybe_jit_wrap(self, targets, value):
+        """``step = jax.jit(f, donate_argnums=...)``: mark ``f`` as
+        jit-staged and register the wrapper name's donated positions."""
+        if not (isinstance(value, ast.Call)
+                and _dotted(value.func) in _JIT_DOTTED and value.args):
+            return
+        wrapped = value.args[0]
+        if isinstance(wrapped, ast.Name):
+            self._jit_wraps.append((wrapped.id, self._fn))
+        for target in targets:
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute):
+                name = target.attr
+            if name:
+                self._record_donation(name, value)
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call):
+        dotted = _dotted(node.func)
+        term = _terminal(dotted)
+        fn = self._fn
+        in_loop = self._loop_depth > 0
+        # Call-graph edges (J001 reachability) only for calls that can
+        # resolve to tree-internal defs: bare names and self/cls
+        # methods. `tx.update(...)` must not edge to OUR `update`.
+        if term and (isinstance(node.func, ast.Name)
+                     or (isinstance(node.func, ast.Attribute)
+                         and isinstance(node.func.value, ast.Name)
+                         and node.func.value.id in ("self", "cls"))):
+            fn.calls[term] = fn.calls.get(term, False) or in_loop
+
+        # A001: spawn with a discarded handle
+        if term in _SPAWN_TERMS and id(node) in self._dropped:
+            coro_term = coro_recv = None
+            if node.args and isinstance(node.args[0], ast.Call):
+                coro_term = _terminal(_dotted(node.args[0].func)) or None
+                if isinstance(node.args[0].func, ast.Attribute):
+                    coro_recv = _dotted(node.args[0].func.value)
+            self.facts.spawns.append(SpawnSite(
+                module=self.facts.path, line=node.lineno, scope=self.scope,
+                coro_term=coro_term, coro_recv=coro_recv,
+                annotated=self._marked(node, _TASK_OK_MARK)))
+
+        # A003: blocking call lexically inside an async def
+        if fn.is_async and id(node) not in self._awaited \
+                and (dotted in _A003_DOTTED or term in _A003_METHODS) \
+                and not self._marked(node, _BLOCKING_OK_MARK):
+            self.facts.blocking_in_async.append(Violation(
+                rule="A003", path=self.facts.path, line=node.lineno,
+                scope=self.scope,
+                message=(f"blocking call {dotted or term}() inside "
+                         f"async def {fn.name} stalls the whole event "
+                         "loop — run_in_executor it, use the async "
+                         "variant, or annotate `# blocking ok: <why>`")))
+
+        # J001: host-sync primitive sites
+        sync = None
+        if term == "block_until_ready" \
+                and isinstance(node.func, ast.Attribute):
+            sync = ".block_until_ready()"
+        elif dotted in _HOST_SYNC_DOTTED or term == "device_get":
+            sync = f"{dotted or term}()"
+        elif term == "item" and isinstance(node.func, ast.Attribute) \
+                and not node.args and not node.keywords:
+            sync = ".item()"
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int") \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], (ast.Call, ast.Attribute,
+                                              ast.Subscript, ast.Name)) \
+                and not _is_shape_math(node.args[0]):
+            sync = f"{node.func.id}(...)"
+        if sync is not None:
+            fn.host_syncs.append(
+                (sync, node.lineno,
+                 self._marked(node, _HOST_SYNC_OK_MARK), in_loop))
+
+        # J003: call through a donate_argnums wrapper
+        if term in self.facts.donations:
+            positions = self.facts.donations[term]
+            for pos in positions:
+                if pos < len(node.args) \
+                        and isinstance(node.args[pos], ast.Name):
+                    self.facts.donation_calls.append(DonationCall(
+                        module=self.facts.path, line=node.lineno,
+                        scope=self.scope, callee=term,
+                        argname=node.args[pos].id,
+                        annotated=self._marked(node, _DONATE_OK_MARK),
+                        func=fn))
+
+        self.generic_visit(node)
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self, tree: ast.Module) -> ModuleFacts:
+        self.visit(tree)
+        for name, enclosing in self._jit_wraps:
+            # Python name resolution, approximately: a wrap written
+            # inside a function binds to that function's local defs
+            # first; otherwise fall back to top-level defs (so
+            # `self._step = jax.jit(update)` inside __init__ marks the
+            # nested `update`, NOT an unrelated method of that name).
+            cands = self.facts.by_name.get(name, ())
+            local = [c for c in cands
+                     if c.parent is enclosing
+                     and enclosing is not self._module_func]
+            targets = local or [c for c in cands if c.parent is None] \
+                or list(cands)
+            for info in targets:
+                info.jit = True
+        return self.facts
+
+
+def collect(tree: ast.Module, path: str,
+            src_lines: Sequence[str]) -> ModuleFacts:
+    """Pass 1 over one parsed module."""
+    return _FactsVisitor(path, src_lines).run(tree)
+
+
+def collect_source(src: str, path: str) -> Optional[ModuleFacts]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None   # rules.lint_source already reports L000
+    return collect(tree, path, src.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# pass 2: the whole-tree index + checks
+# ---------------------------------------------------------------------------
+
+
+class TreeIndex:
+    def __init__(self, modules: List[ModuleFacts]):
+        self.modules: Dict[str, ModuleFacts] = {m.path: m for m in modules}
+        self.all_by_name: Dict[str, List[FuncInfo]] = {}
+        for m in modules:
+            for name, infos in m.by_name.items():
+                self.all_by_name.setdefault(name, []).extend(infos)
+
+    def resolve(self, module: str, name: str,
+                tree_wide: bool = True) -> List[FuncInfo]:
+        """Candidate defs for a bare callee name seen in ``module``:
+        same-module first, then the from-import edge, then (optionally)
+        every def of that name anywhere in the tree."""
+        facts = self.modules.get(module)
+        if facts is not None:
+            local = facts.by_name.get(name)
+            if local:
+                return local
+            imp = facts.imports.get(name)
+            if imp is not None:
+                target, orig = imp
+                tm = self.modules.get(target) \
+                    or self.modules.get(target[:-3] + "/__init__.py")
+                if tm is not None and tm.by_name.get(orig):
+                    return tm.by_name[orig]
+        if tree_wide:
+            return self.all_by_name.get(name, [])
+        return []
+
+    # -- A001 sink walk -----------------------------------------------------
+
+    def has_sink(self, info: FuncInfo, _depth: int = 0,
+                 _seen: Optional[Set[int]] = None) -> bool:
+        if info.has_sink:
+            return True
+        if _depth >= _MAX_SINK_DEPTH or not info.delegate_only:
+            return False
+        seen = _seen if _seen is not None else set()
+        if id(info) in seen:
+            return False
+        seen.add(id(info))
+        for term in info.delegates:
+            cands = self.resolve(info.module, term, tree_wide=False)
+            if not cands:
+                return False
+            if not all(self.has_sink(c, _depth + 1, seen) for c in cands):
+                return False
+        return True
+
+
+def check_tree(modules: List[ModuleFacts]) -> List[Violation]:
+    """Pass 2: fold the index and emit A/J-series violations."""
+    index = TreeIndex(modules)
+    out: List[Violation] = []
+    for m in modules:
+        out.extend(m.blocking_in_async)          # A003 (already built)
+        out.extend(_check_a001(index, m))
+        out.extend(_check_a002(index, m))
+        out.extend(_check_j002(m))
+        out.extend(_check_j003(m))
+    out.extend(_check_j001(index, modules))
+    return out
+
+
+def _check_a001(index: TreeIndex, m: ModuleFacts) -> List[Violation]:
+    out: List[Violation] = []
+    for site in m.spawns:
+        if site.annotated:
+            continue
+        fix = ("retain the handle, use _internal.aio.spawn() "
+               "(logs + counts failures), or annotate "
+               "`# task ok: <why>`")
+        if site.coro_term is None:
+            out.append(Violation(
+                rule="A001", path=m.path, line=site.line,
+                scope=site.scope,
+                message=("fire-and-forget task: handle dropped and the "
+                         "coroutine is not statically resolvable — "
+                         + fix)))
+            continue
+        cands = index.resolve(m.path, site.coro_term)
+        if not cands:
+            out.append(Violation(
+                rule="A001", path=m.path, line=site.line,
+                scope=site.scope,
+                message=(f"fire-and-forget task {site.coro_term}(): "
+                         "handle dropped and no definition found to "
+                         "prove an exception sink — " + fix)))
+            continue
+        unsunk = [c for c in cands if not index.has_sink(c)]
+        if unsunk:
+            c = unsunk[0]
+            out.append(Violation(
+                rule="A001", path=m.path, line=site.line,
+                scope=site.scope,
+                message=(f"fire-and-forget task {site.coro_term}(): "
+                         "handle dropped and "
+                         f"{c.module}:{c.line} {c.qualname} has no "
+                         "terminal exception sink (unhandled errors "
+                         "vanish until loop shutdown) — " + fix)))
+    return out
+
+
+def _check_a002(index: TreeIndex, m: ModuleFacts) -> List[Violation]:
+    out: List[Violation] = []
+    for call in m.stmt_calls:
+        # Only bare names / self-calls / from-imported names resolve:
+        # matching arbitrary receivers' methods tree-wide by bare name
+        # would drown the rule in stdlib homonyms.
+        if call.recv is not None and call.recv not in ("self", "cls"):
+            continue
+        cands = index.resolve(m.path, call.term, tree_wide=False)
+        if cands and all(c.is_async for c in cands):
+            c = cands[0]
+            out.append(Violation(
+                rule="A002", path=m.path, line=call.line, scope=call.scope,
+                message=(f"coroutine {call.term}() "
+                         f"({c.module}:{c.line}) called but never "
+                         "awaited or scheduled — the body never runs; "
+                         "await it, or wrap it in "
+                         "create_task/aio.spawn")))
+    return out
+
+
+def _check_j001(index: TreeIndex,
+                modules: List[ModuleFacts]) -> List[Violation]:
+    out: List[Violation] = []
+    # Hot roots: jit-staged functions, functions annotated hot-loop, and
+    # the per-step host loops — functions that call a jit-staged step
+    # *inside a loop*. Loop position matters for the drivers: setup code
+    # before the loop and finalization after it sync once per run, not
+    # once per step, so only their in-loop syncs count; for jit-staged
+    # and hot-annotated functions every sync counts (the whole body IS
+    # the per-step region). Anything *reached* from a per-step call site
+    # runs per step in full.
+    for m in modules:
+        jit_names = {f.name for f in m.funcs if f.jit} \
+            | set(m.donations)
+        for f in m.funcs:
+            whole_body_hot = f.jit or f.hot_annotated
+            driver = not whole_body_hot and any(
+                in_loop and term in jit_names
+                for term, in_loop in f.calls.items())
+            if not (whole_body_hot or driver):
+                continue
+            # BFS over same-module / imported callees. (func, depth,
+            # everything_counts): at depth 0 a driver only counts its
+            # in-loop sites; reached callees count in full.
+            seen = {id(f)}
+            frontier = [(f, 0, whole_body_hot)]
+            while frontier:
+                cur, depth, full = frontier.pop()
+                for kind, line, annotated, in_loop in cur.host_syncs:
+                    if annotated or not (full or in_loop):
+                        continue
+                    via = "" if cur is f \
+                        else f" (reached via {cur.qualname})"
+                    out.append(Violation(
+                        rule="J001", path=cur.module, line=line,
+                        scope=cur.qualname,
+                        message=(f"host-sync {kind} inside per-step hot "
+                                 f"function {f.qualname}{via} — forces a "
+                                 "device->host round-trip every step; "
+                                 "keep values on device, batch the "
+                                 "readback, or annotate "
+                                 "`# host-sync ok: <why>`")))
+                if depth >= _J001_DEPTH:
+                    continue
+                for term in sorted(cur.calls):
+                    if not (full or cur.calls[term]):
+                        continue   # driver's out-of-loop call: not hot
+                    for cand in index.resolve(cur.module, term,
+                                              tree_wide=False):
+                        if id(cand) not in seen:
+                            seen.add(id(cand))
+                            frontier.append((cand, depth + 1, True))
+    # De-dup: one site can be reachable from several hot roots.
+    uniq: Dict[Tuple[str, int], Violation] = {}
+    for v in out:
+        uniq.setdefault((v.path, v.line), v)
+    return list(uniq.values())
+
+
+def _check_j002(m: ModuleFacts) -> List[Violation]:
+    out: List[Violation] = []
+    for f in m.funcs:
+        if not f.jit:
+            continue
+        seen_names: Set[str] = set()
+        for name, line, annotated in f.free_loads:
+            # local_names is the post-walk set: a name stored ANYWHERE
+            # in the function is local throughout (load-before-store is
+            # an UnboundLocalError, not a closure), so filter against
+            # the final set rather than walk order.
+            if annotated:
+                # One annotated load acknowledges the capture for the
+                # whole function — don't walk the finding to the next
+                # load of the same name.
+                seen_names.add(name)
+                continue
+            if name in f.local_names:
+                continue
+            src = None
+            if name in m.mutable_globals:
+                src = f"module global (line {m.mutable_globals[name]})"
+            else:
+                p = f.parent
+                while p is not None and src is None:
+                    if name in p.mutable_locals:
+                        src = (f"local of enclosing {p.qualname} "
+                               f"(line {p.mutable_locals[name]})")
+                    p = p.parent
+            if src is not None and name not in seen_names:
+                # One finding per captured name per function: the first
+                # load is where the annotation goes.
+                seen_names.add(name)
+                out.append(Violation(
+                    rule="J002", path=m.path, line=line, scope=f.qualname,
+                    message=(f"jit-staged {f.name} closes over mutable "
+                             f"{name!r} [{src}] — mutations after trace "
+                             "are stale or force recompiles; pass it as "
+                             "an argument or annotate "
+                             "`# jit capture ok: <why>`")))
+    return out
+
+
+def _check_j003(m: ModuleFacts) -> List[Violation]:
+    out: List[Violation] = []
+    for call in m.donation_calls:
+        if call.annotated:
+            continue
+        f = call.func
+        stores_after = [ln for ln in f.stores.get(call.argname, ())
+                        if ln >= call.line]
+        rebind = min(stores_after) if stores_after else None
+        for load_line in f.loads.get(call.argname, ()):
+            if load_line <= call.line:
+                continue
+            if rebind is not None and load_line >= rebind:
+                continue
+            out.append(Violation(
+                rule="J003", path=m.path, line=load_line, scope=call.scope,
+                message=(f"{call.argname!r} read after being donated to "
+                         f"{call.callee}() at line {call.line} "
+                         "(donate_argnums invalidates the buffer) — "
+                         "rebind the result to the same name or "
+                         "annotate `# donate ok: <why>`")))
+            break   # one finding per donation site is enough
+    return out
+
+
+def analyze_sources(sources: Dict[str, str]) -> List[Violation]:
+    """Test helper: run the full two-pass analysis over in-memory
+    sources ({repo-relative path: source})."""
+    modules = []
+    for path, src in sources.items():
+        facts = collect_source(src, path)
+        if facts is not None:
+            modules.append(facts)
+    return check_tree(modules)

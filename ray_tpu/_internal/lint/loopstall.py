@@ -1,0 +1,216 @@
+"""Event-loop stall sanitizer (the asyncio half of ``RTPU_SANITIZE``).
+
+The io loop is ray_tpu's data plane: every RPC reply, actor dispatch,
+heartbeat and serve request is a callback on one of a handful of
+ray_tpu-owned loops. One callback that computes (or blocks) for 200ms
+stalls *everything* behind it — the symptom shows up as tail latency
+three subsystems away, with nothing in any log. rtpulint's static
+A003/J001 rules catch the blocking calls they can see; this module is
+the dynamic backstop that catches the ones they can't.
+
+When armed (``RTPU_SANITIZE=1``, same switch as the lock-order
+sanitizer in ``.sanitizer``), :func:`enable` patches
+``asyncio.events.Handle._run`` — the single choke point every scheduled
+callback and task step passes through — and times each callback run on
+**registered** loops only (``IoLoopThread`` and the serve local-testing
+loop register themselves; foreign loops see the real unpatched path
+minus one dict probe). A run exceeding ``CONFIG.loopstall_budget_ms``
+(default 50ms) is recorded in a bounded per-loop ring with:
+
+* the stall duration,
+* the callback's *creation site*: for a task step, the coroutine's
+  code object (file:line qualname of the async def); for a plain
+  callback, its function's code object — so the report names the
+  offending coroutine, not ``Handle._run``,
+* the loop's registered name.
+
+Reporting rides the lock sanitizer's paths: the pytest plugin prints
+both reports in the terminal summary, and the atexit hook prints to
+stderr when anything was recorded. Overhead when off: zero (nothing
+patched). When on: one dict probe per callback on unregistered loops;
+two ``perf_counter`` calls on registered ones.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import asyncio.events
+import collections
+import functools
+import threading
+import time
+from typing import Dict, List, Optional
+
+_RING_CAP = 128                 # stalls kept per loop (oldest dropped)
+
+_enabled = False
+_budget_ms = 50.0
+_atexit_registered = False
+
+_reg_lock = threading.Lock()
+_rings: Dict[int, "collections.deque"] = {}     # id(loop) -> stall ring
+_loop_names: Dict[int, str] = {}
+_totals: Dict[int, int] = {}    # stalls per loop incl. ring-evicted ones
+
+_REAL_RUN = None                # unpatched Handle._run
+
+
+def _callback_site(handle) -> str:
+    """Creation-site attribution for a stalled callback.
+
+    A task step's callback is the bound ``Task.__step``; naming that
+    would make every stall look identical. Unwrap to the task's
+    coroutine code object instead, falling back through partials to a
+    plain function's ``__code__``.
+    """
+    cb = getattr(handle, "_callback", None)
+    owner = getattr(cb, "__self__", None)
+    get_coro = getattr(owner, "get_coro", None)
+    if get_coro is not None:
+        try:
+            coro = get_coro()
+            code = getattr(coro, "cr_code", None) \
+                or getattr(coro, "gi_code", None)
+            if code is not None:
+                return (f"{code.co_filename}:{code.co_firstlineno} "
+                        f"{code.co_name}")
+        except (AttributeError, TypeError):
+            pass        # exotic awaitable: fall through to __code__
+    func = cb
+    while isinstance(func, functools.partial):
+        func = func.func
+    func = getattr(func, "__func__", func)      # unwrap bound methods
+    code = getattr(func, "__code__", None)
+    if code is not None:
+        return f"{code.co_filename}:{code.co_firstlineno} {code.co_name}"
+    return repr(cb)
+
+
+def _patched_run(self):
+    ring = _rings.get(id(getattr(self, "_loop", None)))
+    if ring is None or _budget_ms <= 0:
+        return _REAL_RUN(self)
+    t0 = time.perf_counter()
+    try:
+        return _REAL_RUN(self)
+    finally:
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        if elapsed_ms >= _budget_ms:
+            loop_id = id(self._loop)
+            site = _callback_site(self)
+            with _reg_lock:
+                _totals[loop_id] = _totals.get(loop_id, 0) + 1
+                ring.append({"loop": _loop_names.get(loop_id, "?"),
+                             "site": site, "ms": round(elapsed_ms, 2)})
+
+
+def register_loop(loop: "asyncio.AbstractEventLoop", name: str = ""):
+    """Opt a ray_tpu-owned loop into stall recording. No-op unless the
+    sanitizer is armed — registration happens at loop construction,
+    which is after process-start arming, so the ordering is safe."""
+    if not _enabled:
+        return
+    with _reg_lock:
+        _rings[id(loop)] = collections.deque(maxlen=_RING_CAP)
+        _loop_names[id(loop)] = name or repr(loop)
+        _totals.setdefault(id(loop), 0)
+
+
+def enable(budget_ms: Optional[float] = None, register_atexit: bool = True):
+    """Patch ``Handle._run``. Idempotent; call before loops register."""
+    global _enabled, _budget_ms, _REAL_RUN, _atexit_registered
+    if budget_ms is not None:
+        _budget_ms = float(budget_ms)
+    if _enabled:
+        return
+    _enabled = True
+    if _REAL_RUN is None:
+        _REAL_RUN = asyncio.events.Handle._run
+    asyncio.events.Handle._run = _patched_run
+    if register_atexit and not _atexit_registered:
+        _atexit_registered = True
+        import atexit
+        atexit.register(_exit_report)
+
+
+def disable():
+    """Restore the real ``Handle._run`` and forget registered loops."""
+    global _enabled
+    if _REAL_RUN is not None:
+        asyncio.events.Handle._run = _REAL_RUN
+    _enabled = False
+    with _reg_lock:
+        _rings.clear()
+        _loop_names.clear()
+        _totals.clear()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def reset():
+    """Clear recorded stalls (between unit-test scenarios); registered
+    loops stay registered."""
+    with _reg_lock:
+        for ring in _rings.values():
+            ring.clear()
+        for k in _totals:
+            _totals[k] = 0
+
+
+def budget_ms() -> float:
+    return _budget_ms
+
+
+def report() -> dict:
+    with _reg_lock:
+        stalls: List[dict] = [s for ring in _rings.values() for s in ring]
+        stalls.sort(key=lambda s: -s["ms"])
+        return {
+            "enabled": _enabled,
+            "budget_ms": _budget_ms,
+            "loops": len(_rings),
+            "total_stalls": sum(_totals.values()),
+            "stalls": stalls,
+        }
+
+
+def render_report(rep: Optional[dict] = None) -> str:
+    rep = rep or report()
+    lines = [f"event-loop stall sanitizer: {rep['loops']} loop(s) "
+             f"watched, budget {rep['budget_ms']:g}ms, "
+             f"{rep['total_stalls']} stall(s)"]
+    for s in rep["stalls"][:20]:
+        lines.append(f"  LOOP STALL {s['ms']:.1f}ms on {s['loop']}: "
+                     f"{s['site']}")
+    if rep["total_stalls"] > len(rep["stalls"]):
+        lines.append(f"  ... ring dropped "
+                     f"{rep['total_stalls'] - len(rep['stalls'])} older "
+                     "stall(s)")
+    if not rep["stalls"]:
+        lines.append("  no stalls over budget")
+    return "\n".join(lines)
+
+
+def _exit_report():
+    rep = report()
+    if rep["total_stalls"]:
+        import sys
+        print(render_report(rep),  # stdout ok: atexit report
+              file=sys.stderr, flush=True)
+
+
+def enable_from_env() -> bool:
+    """Arm iff ``RTPU_SANITIZE`` is truthy — called from
+    ``sanitizer.enable_from_env()`` so every existing arming point
+    (pytest plugin, worker/raylet mains) covers loop stalls too.
+    Budget comes from ``CONFIG.loopstall_budget_ms`` (env-overridable
+    as ``RTPU_LOOPSTALL_BUDGET_MS``); 0 disables recording."""
+    import os
+    if os.environ.get("RTPU_SANITIZE", "").lower() not in ("1", "true",
+                                                           "yes", "on"):
+        return False
+    from ..config import CONFIG
+    enable(budget_ms=CONFIG.loopstall_budget_ms)
+    return True

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 
+from . import loopstall
 from . import sanitizer
 
 SANITIZED_TEST_MODULES = ("test_actor_storm", "test_push_recovery",
@@ -65,6 +66,8 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     rep = sanitizer.report()
     terminalreporter.write_line("")
     terminalreporter.write_line(sanitizer.render_report(rep))
+    if loopstall.is_enabled():
+        terminalreporter.write_line(loopstall.render_report())
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -679,21 +679,25 @@ def _project_tables() -> Tuple[frozenset, frozenset]:
 def lint_source(src: str, path: str,
                 known_flags: Optional[Sequence[str]] = None,
                 bootstrap_env: Optional[Sequence[str]] = None,
+                tree: Optional[ast.Module] = None,
                 ) -> Tuple[List[Violation], List[MetricDecl],
                            List[ShardTableDecl], List[ShardAccess]]:
     """Lint one file's source. ``path`` must be repo-relative with
     forward slashes (it selects per-module rule behavior and becomes the
-    allowlist key)."""
+    allowlist key). Pass ``tree`` to reuse an AST the caller already
+    parsed (the engine shares one parse between this visitor and the
+    cross-module pass)."""
     if known_flags is None or bootstrap_env is None:
         flags, env = _project_tables()
         known_flags = known_flags if known_flags is not None else flags
         bootstrap_env = bootstrap_env if bootstrap_env is not None else env
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [Violation(rule="L000", path=path, line=e.lineno or 0,
-                          scope="<module>",
-                          message=f"syntax error: {e.msg}")], [], [], []
+    if tree is None:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            return [Violation(rule="L000", path=path, line=e.lineno or 0,
+                              scope="<module>",
+                              message=f"syntax error: {e.msg}")], [], [], []
     return _Linter(path, known_flags, bootstrap_env,
                    src_lines=src.splitlines()).run(tree)
 

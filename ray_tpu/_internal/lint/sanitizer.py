@@ -293,9 +293,13 @@ def _exit_report():
 
 def enable_from_env():
     """Enable iff RTPU_SANITIZE is truthy (the worker/raylet mains call
-    this so sanitized runs cover every process in the cluster)."""
+    this so sanitized runs cover every process in the cluster). Arms
+    the event-loop stall sanitizer (.loopstall) off the same switch so
+    one env var covers both dynamic checkers in every process."""
     if os.environ.get("RTPU_SANITIZE", "").lower() in ("1", "true", "yes",
                                                        "on"):
         enable()
+        from . import loopstall
+        loopstall.enable_from_env()
         return True
     return False

@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from . import aio
 from .backoff import Backoff
 from .config import CONFIG
 from .ids import NodeID, ObjectID, PlacementGroupID, WorkerID
@@ -1680,12 +1681,12 @@ class Raylet:
                                                last_access=time.monotonic())
         self.store_used += size
         gcs = self.clients.get(self.gcs_address)
-        asyncio.ensure_future(gcs.call(
+        aio.spawn(gcs.call(
             "add_object_location", object_hex=object_hex,
             node_id=self.node_id, size=size, owner_address=owner_address,
-            timeout=10))
+            timeout=10), what="add_object_location")
         if self.store_used > self.capacity * CONFIG.object_spilling_threshold:
-            asyncio.ensure_future(self._evict_until_under())
+            aio.spawn(self._evict_until_under(), what="evict_until_under")
         return True
 
     async def _evict_until_under(self):
@@ -2211,10 +2212,10 @@ class Raylet:
                 size=size, last_access=time.monotonic())
             self.store_used += size
             gcs = self.clients.get(self.gcs_address)
-            asyncio.ensure_future(gcs.call(
+            aio.spawn(gcs.call(
                 "add_object_location", object_hex=object_hex,
                 node_id=self.node_id, size=size, owner_address=None,
-                timeout=10))
+                timeout=10), what="add_object_location")
         return {"ok": True}
 
     async def handle_free_objects(self, object_hexes: List[str]):

@@ -41,6 +41,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 from .config import CONFIG
 from .errors import RpcError
+from . import aio
 from . import serialization
 
 logger = logging.getLogger(__name__)
@@ -142,6 +143,10 @@ class IoLoopThread:
         if hasattr(asyncio, "eager_task_factory") and \
                 not CONFIG.no_eager_tasks:
             self.loop.set_task_factory(asyncio.eager_task_factory)
+        # Stall sanitizer: no-op unless RTPU_SANITIZE armed it at
+        # process start (lazy import — lint is tooling, not data plane).
+        from .lint import loopstall
+        loopstall.register_loop(self.loop, name=name)
         self._post_q: collections.deque = collections.deque()
         self._post_lock = threading.Lock()
         self._post_scheduled = False
@@ -202,7 +207,10 @@ class IoLoopThread:
                 except Exception:
                     logger.exception("posted callback failed")
             else:
-                self.loop.create_task(item)
+                # Posted coroutines are fire-and-forget by contract:
+                # route through the logged sink so a failing one is
+                # visible (A001).
+                aio.spawn(item, loop=self.loop)
 
     def post_call(self, fn) -> None:
         """Like post() but for a plain callable run on the loop."""
@@ -909,7 +917,8 @@ class RpcClient:
                             local._dispatch(method, kwargs), owner),
                         method)
                 else:
-                    asyncio.ensure_future(local._dispatch(method, kwargs))
+                    aio.spawn(local._dispatch(method, kwargs),
+                              what=f"oneway:{method}")
             return
         await self._ensure_conn()
         await self._send_frame(pack_frame(
@@ -954,7 +963,8 @@ class RpcClient:
                                                          owner),
                         method)
                 else:
-                    asyncio.ensure_future(handler(payload))
+                    aio.spawn(handler(payload),
+                              what=f"oneway_raw:{method}")
             return
         await self._ensure_conn()
         await self._send_frame(pack_frame(0, FLAG_RAW, method.encode(),

@@ -913,12 +913,16 @@ def cmd_perf(args):
 
 
 def cmd_lint(args):
-    """rtpulint: project-specific static analysis (rules L001-L008,
-    burn-down allowlist). Exits non-zero on violations."""
+    """rtpulint: project-specific static analysis (per-file rules
+    L001-L010 plus cross-module A001-A003/J001-J003, burn-down
+    allowlist). Exit codes: 0 clean, 1 violations or a stale/malformed
+    allowlist entry, 2 usage/environment error (--changed without a
+    usable git checkout)."""
     from ray_tpu._internal import lint
     raise SystemExit(lint.main(
         (["--json"] if args.json else [])
-        + (["--no-allowlist"] if args.no_allowlist else [])))
+        + (["--no-allowlist"] if args.no_allowlist else [])
+        + (["--changed"] if args.changed else [])))
 
 
 def cmd_serve(args):
@@ -1170,6 +1174,8 @@ def main(argv=None):
     p = sub.add_parser("lint")
     p.add_argument("--json", action="store_true")
     p.add_argument("--no-allowlist", action="store_true")
+    p.add_argument("--changed", action="store_true",
+                   help="only report violations in files changed vs HEAD")
     p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("serve")

@@ -981,7 +981,7 @@ class PagedLLMEngine:
                     jnp.asarray(positions), caches,
                     jnp.asarray(off, jnp.int32))
                 if off + take == len(prompt):
-                    last_logits = np.asarray(
+                    last_logits = np.asarray(  # host-sync ok: once per prompt, scoring path
                         logits[0, take - 1], np.float64)
                 off += take
             return last_logits, caches

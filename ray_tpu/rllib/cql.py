@@ -119,7 +119,7 @@ class CQL:
 
         @jax.jit
         def step(params, target_params, opt_state, idx):
-            b_obs = jd["obs"][idx]
+            b_obs = jd["obs"][idx]  # jit capture ok: trace-constant dataset tensors
             b_act = jd["actions"][idx]
             b_rew = jd["rewards"][idx]
             b_next = jd["next_obs"][idx]
@@ -161,7 +161,7 @@ class CQL:
             params, opt_state, total, td, pen = step(
                 params, target_params, opt_state, idx)
             if first_pen is None:
-                first_pen = float(pen)
+                first_pen = float(pen)  # host-sync ok: once per fit
             if (i + 1) % c.target_update_freq == 0:
                 target_params = jax.tree.map(lambda x: x, params)
 

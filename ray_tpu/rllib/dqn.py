@@ -478,7 +478,7 @@ class DQN:
             obs, _ = env.reset(seed=10_000 + ep)
             done = False
             while not done:
-                action = int(act(jnp.asarray(obs, jnp.float32)))
+                action = int(act(jnp.asarray(obs, jnp.float32)))  # host-sync ok: env.step needs a host int
                 obs, reward, terminated, truncated, _ = env.step(action)
                 total += reward
                 done = terminated or truncated

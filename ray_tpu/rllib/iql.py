@@ -102,7 +102,7 @@ class IQL:
 
         @jax.jit
         def step(params, target_params, opt_state, idx):
-            b_obs = jd["obs"][idx]
+            b_obs = jd["obs"][idx]  # jit capture ok: trace-constant dataset tensors
             b_act = jd["actions"][idx]
             b_rew = jd["rewards"][idx]
             b_next = jd["next_obs"][idx]

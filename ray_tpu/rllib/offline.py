@@ -252,12 +252,12 @@ class MARWIL:
             ret = 0.0
             returns = []
             for r in reversed(ep_rows):
-                ret = float(r["reward"]) + c.gamma * ret
+                ret = float(r["reward"]) + c.gamma * ret  # host-sync ok: host JSON row
                 returns.append(ret)
             returns.reverse()
             for r, g in zip(ep_rows, returns):
-                obs_l.append(np.asarray(r["obs"], np.float32))
-                act_l.append(int(r["action"]))
+                obs_l.append(np.asarray(r["obs"], np.float32))  # host-sync ok: host JSON row
+                act_l.append(int(r["action"]))  # host-sync ok: host JSON row
                 ret_l.append(g)
         obs = jnp.asarray(np.stack(obs_l))
         actions = jnp.asarray(np.asarray(act_l, np.int32))
@@ -281,7 +281,7 @@ class MARWIL:
 
         @jax.jit
         def step(params, opt_state, ma_sq, idx):
-            b_obs, b_act, b_ret = obs[idx], actions[idx], returns[idx]
+            b_obs, b_act, b_ret = obs[idx], actions[idx], returns[idx]  # jit capture ok: trace-constant dataset tensors
 
             def loss_fn(p):
                 logits, values = model.apply({"params": p}, b_obs)

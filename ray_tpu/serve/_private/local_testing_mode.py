@@ -22,6 +22,9 @@ class _LocalLoop:
 
     def __init__(self):
         self.loop = asyncio.new_event_loop()
+        # Stall sanitizer: no-op unless RTPU_SANITIZE armed it.
+        from ..._internal.lint import loopstall
+        loopstall.register_loop(self.loop, name="serve-local-loop")
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serve-local-loop")
         # Singleton loop, re-created on demand (get() checks liveness):

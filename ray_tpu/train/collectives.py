@@ -45,7 +45,7 @@ def allreduce_gradients(grads: Any, group_name: str = "default") -> Any:
     from ..util.collective import collective as col
 
     leaves, treedef = jax.tree_util.tree_flatten(grads)
-    arrays = [np.asarray(leaf) for leaf in leaves]
+    arrays = [np.asarray(leaf) for leaf in leaves]  # host-sync ok: host-plane collective; the transfer IS the op
     flat = np.concatenate(
         [a.astype(np.float32, copy=False).ravel() for a in arrays]) \
         if arrays else np.zeros(0, np.float32)

@@ -212,7 +212,7 @@ def _run_gspmd(spec: GSPMDTrainSpec) -> Dict[str, Any]:
                     with steptrace.span(track, i, "forward"), \
                             timer.device():
                         state, step_metrics = step(state, batch)
-                        loss = float(jax.device_get(step_metrics["loss"]))
+                        loss = float(jax.device_get(step_metrics["loss"]))  # host-sync ok: per-step loss telemetry
             losses.append(loss)
             metrics = _telemetry_report(ctx.rank, i, loss, timer, spec,
                                         extra={"schedule": "gspmd",
@@ -321,8 +321,8 @@ def _run_two_level(spec: GSPMDTrainSpec) -> Dict[str, Any]:
                         with steptrace.span(track, i, "forward"), \
                                 timer.device():
                             loss_local, grads = grad_step(params, batch)
-                            loss_local = float(jax.device_get(loss_local))
-                            grads = jax.device_get(grads)
+                            loss_local = float(jax.device_get(loss_local))  # host-sync ok: feeds host-plane allreduce
+                            grads = jax.device_get(grads)  # host-sync ok: host-plane collective input
                         if algo is None:
                             algo = col.selected_algorithm(
                                 4 * _leaf_count(grads),
@@ -336,7 +336,7 @@ def _run_two_level(spec: GSPMDTrainSpec) -> Dict[str, Any]:
                             # global loss = mean of the slice-local
                             # (mean-type) losses — 4 bytes per step
                             # next to the grad buffer
-                            loss = float(col.allreduce(
+                            loss = float(col.allreduce(  # host-sync ok: 4-byte host allreduce
                                 np.float32(loss_local),
                                 group_name=group_name)) / world
                         with steptrace.span(track, i, "optimizer"), \
@@ -344,11 +344,11 @@ def _run_two_level(spec: GSPMDTrainSpec) -> Dict[str, Any]:
                             if zero1:
                                 state, _ = apply_step(state, grads)
                                 params = state.params
-                                jax.block_until_ready(state.m)
+                                jax.block_until_ready(state.m)  # host-sync ok: StepTimer optimizer fence
                             else:
                                 params, opt_state = apply_fn(
                                     params, opt_state, grads)
-                                jax.block_until_ready(params)
+                                jax.block_until_ready(params)  # host-sync ok: StepTimer optimizer fence
                 losses.append(loss)
                 metrics = _telemetry_report(
                     rank, i, loss, timer, spec,
@@ -370,7 +370,8 @@ def _run_two_level(spec: GSPMDTrainSpec) -> Dict[str, Any]:
 def _leaf_count(grads) -> int:
     import numpy as np
     import jax
-    return sum(int(np.asarray(l).size)
+    # np.size reads the .size attribute — no host copy of the leaf.
+    return sum(int(np.size(l))
                for l in jax.tree_util.tree_leaves(grads))
 
 
@@ -485,20 +486,20 @@ def _run_dp_python(spec: GSPMDTrainSpec) -> Dict[str, Any]:
                     with steptrace.span(track, i, "forward"), \
                             timer.device():
                         loss_local, grads = grad_fn(params, batch)
-                        loss_local = float(jax.device_get(loss_local))
-                        grads = jax.device_get(grads)
+                        loss_local = float(jax.device_get(loss_local))  # host-sync ok: feeds host-plane allreduce
+                        grads = jax.device_get(grads)  # host-sync ok: host-plane collective input
                     with steptrace.span(track, i, "collective"), \
                             timer.comm():
                         grads = allreduce_gradients(
                             grads, group_name=group_name)
-                        loss = float(col.allreduce(
+                        loss = float(col.allreduce(  # host-sync ok: 4-byte host allreduce
                             np.float32(loss_local),
                             group_name=group_name)) / world
                     with steptrace.span(track, i, "optimizer"), \
                             timer.device():
                         params, opt_state = apply_fn(
                             params, opt_state, grads)
-                        jax.block_until_ready(params)
+                        jax.block_until_ready(params)  # host-sync ok: StepTimer optimizer fence
             losses.append(loss)
             metrics = _telemetry_report(
                 rank, i, loss, timer, spec,
@@ -566,5 +567,5 @@ def run_single_process_baseline(spec: GSPMDTrainSpec) -> Dict[str, Any]:
     for i in range(spec.steps):
         batch = _to_device(spec.batch_fn(i, 0, 1))
         params, opt_state, loss = step(params, opt_state, batch)
-        losses.append(float(jax.device_get(loss)))
+        losses.append(float(jax.device_get(loss)))  # host-sync ok: baseline loss log
     return {"losses": losses, "loss": losses[-1] if losses else None}

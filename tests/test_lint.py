@@ -518,6 +518,15 @@ def test_allowlist_only_burns_down():
         "it, or justify raising the ceiling in review.")
     # every suppression must carry a justification
     assert all(e.justification for e in entries)
+    # staleness gate: every entry must still match a LIVE violation —
+    # an entry whose violation was fixed is debt pretending to be paid;
+    # delete it (and lower the ceiling) in the same PR as the fix.
+    report = run_lint()
+    live = {v.key for v in report.allowlisted}
+    stale = [e.key for e in entries if e.key not in live]
+    assert not stale, (
+        "allowlist entries no longer matching any violation "
+        f"(delete them to burn down): {stale}")
 
 
 def test_module_entrypoint_exits_zero():
@@ -708,3 +717,471 @@ def test_daemon_registry_nonjoinable_tracked_not_joined():
     assert t.is_alive()                       # still running, by design
     release.set()
     t.join(5)
+
+
+# ---------------------------------------------------------------------------
+# cross-module rules (A-series: async lifecycle)
+# ---------------------------------------------------------------------------
+
+from ray_tpu._internal.lint import crossmod
+
+
+def _cross(sources):
+    """Rule codes from the two-pass analysis over in-memory sources."""
+    return [v.rule for v in crossmod.analyze_sources(sources)]
+
+
+def test_a001_dropped_handle_no_sink_fires():
+    src = """
+import asyncio
+
+async def pump():
+    await work()
+
+def kick():
+    asyncio.ensure_future(pump())
+"""
+    assert _cross({"ray_tpu/fake/a.py": src}) == ["A001"]
+
+
+def test_a001_sink_handle_or_annotation_ok():
+    sink = """
+import asyncio
+
+async def pump():
+    try:
+        await work()
+    except Exception:
+        log.exception("pump died")
+
+def kick():
+    asyncio.ensure_future(pump())
+"""
+    retained = """
+import asyncio
+
+async def pump():
+    await work()
+
+def kick():
+    t = asyncio.ensure_future(pump())
+    return t
+"""
+    annotated = """
+import asyncio
+
+async def pump():
+    await work()
+
+def kick():
+    asyncio.ensure_future(pump())  # task ok: joined at shutdown
+"""
+    for src in (sink, retained, annotated):
+        assert _cross({"ray_tpu/fake/a.py": src}) == []
+
+
+def test_a001_cross_module_sink_resolution():
+    spawner = """
+import asyncio
+from .b import pump
+
+def kick():
+    asyncio.create_task(pump())
+"""
+    no_sink = """
+async def pump():
+    await work()
+"""
+    with_sink = """
+async def pump():
+    try:
+        await work()
+    except Exception:
+        log.exception("pump died")
+"""
+    assert _cross({"ray_tpu/fake/a.py": spawner,
+                   "ray_tpu/fake/b.py": no_sink}) == ["A001"]
+    assert _cross({"ray_tpu/fake/a.py": spawner,
+                   "ray_tpu/fake/b.py": with_sink}) == []
+
+
+def test_a001_sink_through_delegating_wrapper():
+    """A thin await-wrapper delegates sink-ness to its callee."""
+    src = """
+import asyncio
+
+async def inner():
+    try:
+        await work()
+    except Exception:
+        log.exception("inner died")
+
+async def outer():
+    await inner()
+
+def kick():
+    asyncio.create_task(outer())
+"""
+    assert _cross({"ray_tpu/fake/a.py": src}) == []
+
+
+def test_a002_unawaited_coroutine_fires():
+    src = """
+async def notify(x):
+    return x
+
+def fire():
+    notify(1)
+"""
+    assert _cross({"ray_tpu/fake/a.py": src}) == ["A002"]
+
+
+def test_a002_awaited_or_scheduled_ok():
+    src = """
+import asyncio
+
+async def notify(x):
+    return x
+
+async def fire():
+    await notify(1)
+    t = asyncio.ensure_future(notify(2))
+    return t
+"""
+    assert _cross({"ray_tpu/fake/a.py": src}) == []
+
+
+def test_a003_blocking_call_in_async_fires():
+    src = """
+import time
+
+async def handler():
+    time.sleep(0.1)
+"""
+    assert _cross({"ray_tpu/fake/a.py": src}) == ["A003"]
+
+
+def test_a003_sync_context_or_annotation_ok():
+    sync = """
+import time
+
+def handler():
+    time.sleep(0.1)
+"""
+    annotated = """
+import time
+
+async def handler():
+    time.sleep(0.1)  # blocking ok: startup path, loop not serving yet
+"""
+    for src in (sync, annotated):
+        assert _cross({"ray_tpu/fake/a.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# cross-module rules (J-series: JAX hygiene)
+# ---------------------------------------------------------------------------
+
+def test_j001_host_sync_in_driver_loop_fires():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def train(xs, out):
+    for x in xs:
+        y = step(x)
+        out.append(float(y))
+"""
+    assert _cross({"ray_tpu/fake/t.py": src}) == ["J001"]
+
+
+def test_j001_reached_callee_counts():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def log_metrics(y):
+    return float(y)
+
+def train(xs):
+    for x in xs:
+        y = step(x)
+        log_metrics(y)
+"""
+    v = crossmod.analyze_sources({"ray_tpu/fake/t.py": src})
+    assert [x.rule for x in v] == ["J001"]
+    assert "log_metrics" in v[0].message
+
+
+def test_j001_setup_and_finalization_ok():
+    """Syncs before/after the hot loop are once-per-run, not per-step."""
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def train(xs):
+    data = np.asarray(xs)
+    y = None
+    for x in data:
+        y = step(x)
+    return float(y)
+"""
+    assert _cross({"ray_tpu/fake/t.py": src}) == []
+
+
+def test_j001_hot_loop_annotation_marks_function():
+    src = """
+def decode_tick(state):  # rtpu: hot-loop
+    return float(state)
+"""
+    assert _cross({"ray_tpu/fake/t.py": src}) == ["J001"]
+
+
+def test_j001_host_sync_ok_annotation():
+    src = """
+import jax
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def train(xs, out):
+    for x in xs:
+        y = step(x)
+        out.append(float(y))  # host-sync ok: per-step telemetry
+"""
+    assert _cross({"ray_tpu/fake/t.py": src}) == []
+
+
+def test_j001_shape_math_exempt():
+    """int()/float() over .shape/.size metadata is host math, not a
+    device sync."""
+    src = """
+import numpy as np
+
+def sizes(leaves):  # rtpu: hot-loop
+    return sum(int(np.prod(l.shape)) for l in leaves)
+"""
+    assert _cross({"ray_tpu/fake/t.py": src}) == []
+
+
+def test_j002_jit_mutable_capture_fires():
+    src = """
+import jax
+
+CFG = {"lr": 0.1}
+
+@jax.jit
+def step(x):
+    return x * CFG["lr"]
+"""
+    v = crossmod.analyze_sources({"ray_tpu/fake/t.py": src})
+    assert [x.rule for x in v] == ["J002"]
+    assert "CFG" in v[0].message
+
+
+def test_j002_annotation_or_argument_ok():
+    annotated = """
+import jax
+
+CFG = {"lr": 0.1}
+
+@jax.jit
+def step(x):
+    return x * CFG["lr"]  # jit capture ok: frozen before first trace
+"""
+    as_arg = """
+import jax
+
+@jax.jit
+def step(x, lr):
+    return x * lr
+"""
+    for src in (annotated, as_arg):
+        assert _cross({"ray_tpu/fake/t.py": src}) == []
+
+
+def test_j002_jit_wrapped_assignment_detected():
+    """jit applied by wrapping (not decorating) still marks the def."""
+    src = """
+import jax
+
+STATE = {"n": 0}
+
+def _step(x):
+    return x * STATE["n"]
+
+step = jax.jit(_step)
+"""
+    assert _cross({"ray_tpu/fake/t.py": src}) == ["J002"]
+
+
+def test_j003_donated_arg_reuse_fires():
+    src = """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, grad):
+    return state + grad
+
+def train(state, grad):
+    new_state = update(state, grad)
+    norm = state.sum()
+    return new_state, norm
+"""
+    v = crossmod.analyze_sources({"ray_tpu/fake/t.py": src})
+    assert [x.rule for x in v] == ["J003"]
+    assert "state" in v[0].message
+
+
+def test_j003_rebind_or_annotation_ok():
+    rebound = """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, grad):
+    return state + grad
+
+def train(state, grad):
+    state = update(state, grad)
+    return state.sum()
+"""
+    annotated = """
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def update(state, grad):
+    return state + grad
+
+def train(state, grad):
+    new = update(state, grad)  # donate ok: CPU backend aliases nothing
+    return state.sum()
+"""
+    for src in (rebound, annotated):
+        assert _cross({"ray_tpu/fake/t.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# event-loop stall sanitizer
+# ---------------------------------------------------------------------------
+
+import asyncio
+import os
+import time
+
+from ray_tpu._internal.lint import loopstall as LS
+
+
+@pytest.fixture
+def stall_sanitizer():
+    was_enabled = LS.is_enabled()
+    LS.enable(budget_ms=50, register_atexit=False)
+    yield LS
+    LS.disable()
+    if was_enabled:
+        LS.enable()
+
+
+def test_loopstall_records_slow_callback_with_site(stall_sanitizer):
+    loop = asyncio.new_event_loop()
+    LS.register_loop(loop, name="stall-test")
+
+    async def chunky_callback():
+        time.sleep(0.1)          # blocks the loop for 2x the budget
+
+    async def main():
+        await asyncio.ensure_future(chunky_callback())
+
+    loop.run_until_complete(main())
+    loop.close()
+    rep = LS.report()
+    assert rep["total_stalls"] >= 1, rep
+    stall = rep["stalls"][0]
+    assert stall["loop"] == "stall-test"
+    assert stall["ms"] >= 50
+    # attribution names the offending coroutine, not Task.__step
+    assert "chunky_callback" in stall["site"], stall
+    assert "test_lint" in stall["site"], stall
+    assert "LOOP STALL" in LS.render_report(rep)
+
+
+def test_loopstall_clean_loop_negative(stall_sanitizer):
+    loop = asyncio.new_event_loop()
+    LS.register_loop(loop, name="clean-test")
+
+    async def quick():
+        for _ in range(20):
+            await asyncio.sleep(0)
+
+    loop.run_until_complete(quick())
+    loop.close()
+    rep = LS.report()
+    assert [s for s in rep["stalls"] if s["loop"] == "clean-test"] == []
+    assert "no stalls over budget" in LS.render_report(
+        {**rep, "stalls": [], "total_stalls": 0})
+
+
+def test_loopstall_unregistered_loop_untouched(stall_sanitizer):
+    loop = asyncio.new_event_loop()   # never registered
+
+    async def chunky():
+        time.sleep(0.08)
+
+    loop.run_until_complete(chunky())
+    loop.close()
+    assert LS.report()["total_stalls"] == 0
+
+
+def test_serve_saturation_sanitized_smoke():
+    """Representative sanitized e2e: a local-mode serve app under
+    concurrent load with RTPU_SANITIZE=1 must finish with zero lock
+    cycles and zero loop stalls over budget (generous 250ms budget so
+    CI scheduling noise can't flake it)."""
+    import json as _json
+    import textwrap
+    script = textwrap.dedent("""
+        import json
+        from ray_tpu._internal.lint import sanitizer, loopstall
+        assert sanitizer.enable_from_env()       # arms both sanitizers
+        assert loopstall.is_enabled()
+        from ray_tpu import serve
+
+        @serve.deployment
+        class Echo:
+            async def __call__(self, x):
+                return x + 1
+
+        handle = serve.run(Echo.bind(), _local_testing=True)
+        futs = [handle.remote(i) for i in range(64)]
+        assert [f.result(timeout_s=30) for f in futs] == \\
+            [i + 1 for i in range(64)]
+        print("RESULT:" + json.dumps({
+            "cycles": sanitizer.report()["cycles"],
+            "stalls": loopstall.report()["stalls"],
+            "loops": loopstall.report()["loops"],
+        }))
+    """)
+    env = dict(os.environ, RTPU_SANITIZE="1",
+               RTPU_LOOPSTALL_BUDGET_MS="250", JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT:")][-1]
+    out = _json.loads(line[len("RESULT:"):])
+    assert out["loops"] >= 1, "serve local loop never registered"
+    assert out["cycles"] == [], out
+    assert out["stalls"] == [], out
