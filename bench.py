@@ -831,6 +831,36 @@ def serve_bench(out_path: str = "BENCH_serve_r01.json") -> dict:
     return result
 
 
+def rpc_transport_bench(out_path: str = "BENCH_rpc_r01.json") -> dict:
+    """Transport-observatory overhead (`bench.py --rpc`): real-socket
+    loopback echo with instrumentation on vs the RTPU_NO_RPC_METRICS
+    kill switch, interleaved on/off rounds (min-of-runs each side), as
+    a BENCH_rpc JSON artifact. The gate is deliberately loose (50%):
+    the loopback echo is the worst case — ~100us baseline against a
+    fixed per-call instrumentation cost of a few us — and run-to-run
+    noise on a shared box swings the ratio by tens of percent."""
+    from ray_tpu.perf import rpc_bench
+
+    out = rpc_bench(n=2000)
+    overhead = out["rpc_metrics_overhead_pct"]
+    gates = {"rpc_metrics_overhead_pct_lt_50": overhead < 50.0}
+    result = {
+        "metric": "rpc_transport_overhead_ab",
+        "rpc_call_us": round(out["rpc_call_us"], 2),
+        "rpc_call_nometrics_us": round(out["rpc_call_nometrics_us"], 2),
+        "rpc_metrics_overhead_pct": round(overhead, 2),
+        "ring_stats_read_ns": round(out["ring_stats_read_ns"], 1)
+        if "ring_stats_read_ns" in out else None,
+        "gates": gates,
+        "passed": all(gates.values()),
+    }
+    print(json.dumps(result))
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+    return result
+
+
 if __name__ == "__main__":
     import sys
     if "--dryrun7b" in sys.argv:
@@ -842,5 +872,7 @@ if __name__ == "__main__":
         multichip_ab(out_path="MULTICHIP_r06.json")
     elif "--serve" in sys.argv:
         serve_bench()
+    elif "--rpc" in sys.argv:
+        rpc_transport_bench()
     else:
         main()

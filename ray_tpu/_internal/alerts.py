@@ -279,6 +279,28 @@ def default_rules() -> List[AlertRule]:
                 f"KV page pool {v:.0%} full (SLO "
                 f"{float(CONFIG.serve_kv_occupancy_slo):.0%}) — "
                 f"preemption churn imminent; add replicas or pages")),
+        AlertRule(
+            "rpc_client_p99",
+            metric="rtpu_rpc_client_seconds",
+            window_s=60.0, reduce="p99",
+            predicate=lambda v, _w: v > float(
+                CONFIG.rpc_client_p99_slo_s),
+            severity="WARNING",
+            message=lambda v: (
+                f"rpc client p99 {v:.3f}s exceeds "
+                f"{float(CONFIG.rpc_client_p99_slo_s):.3g}s SLO — "
+                f"attribute the tail with cli rpc --slow")),
+        AlertRule(
+            "ring_backpressure",
+            metric="rtpu_ring_queue_depth",
+            window_s=60.0, reduce="max",
+            predicate=lambda v, _w: v > float(
+                CONFIG.ring_backpressure_depth),
+            severity="WARNING",
+            message=lambda v: (
+                f"native ring queue depth {v:.0f} exceeds "
+                f"{CONFIG.ring_backpressure_depth} — a drain loop is "
+                f"not keeping up (see cli rpc rings)")),
     ]
 
 

@@ -26,9 +26,12 @@ replacement (and is itself exempt, being the implementation).
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 from typing import Optional
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["Backoff"]
 
@@ -36,19 +39,26 @@ __all__ = ["Backoff"]
 class Backoff:
     """Jittered exponential backoff with a cap and an optional deadline.
 
-    Not thread-safe: one instance per retry loop (they're cheap)."""
+    Not thread-safe: one instance per retry loop (they're cheap).
 
-    __slots__ = ("base_s", "max_s", "mult", "deadline", "attempt", "_rng")
+    ``site`` labels this loop in the ``rtpu_rpc_retries_total`` counter
+    (rpc_metrics): every scheduled delay is one retry, counted from the
+    shared primitive instead of hand-rolled per-call-site counters.
+    Empty site = uncounted (ad-hoc loops that predate the label)."""
+
+    __slots__ = ("base_s", "max_s", "mult", "deadline", "attempt", "site",
+                 "_rng")
 
     def __init__(self, base_s: float = 0.05, max_s: float = 2.0,
                  mult: float = 2.0, deadline_s: Optional[float] = None,
-                 seed: Optional[int] = None):
+                 seed: Optional[int] = None, site: str = ""):
         self.base_s = base_s
         self.max_s = max_s
         self.mult = mult
         self.deadline = (time.monotonic() + deadline_s
                          if deadline_s is not None else None)
         self.attempt = 0
+        self.site = site
         # Seedable for deterministic tests; unseeded instances share no
         # state (each loop gets an independent stream).
         self._rng = random.Random(seed)
@@ -61,6 +71,15 @@ class Backoff:
         attempt counter."""
         raw = min(self.base_s * (self.mult ** self.attempt), self.max_s)
         self.attempt += 1
+        if self.site:
+            try:
+                from . import rpc_metrics
+                m = rpc_metrics.metrics()
+                if m is not None:
+                    m.retries.inc(tags={"site": self.site})
+            except Exception:  # noqa: BLE001 — metrics never break a retry
+                logger.debug("retry-site metric bump failed",
+                             exc_info=True)
         delay = raw * (0.5 + self._rng.random())
         if self.deadline is not None:
             remaining = self.deadline - time.monotonic()

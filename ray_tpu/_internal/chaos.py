@@ -243,6 +243,17 @@ class ChaosRegistry:
                     and self._rng.random() < rule.prob:
                 key = f"{rule.pattern}:{rule.action}"
                 self._hits[key] = self._hits.get(key, 0) + 1
+                try:
+                    from . import rpc_metrics
+                    m = rpc_metrics.metrics()
+                    if m is not None:
+                        # method label = the rule's pattern (stable,
+                        # bounded cardinality), not the matched method.
+                        m.chaos_hits.inc(tags={"method": rule.pattern,
+                                               "action": rule.action})
+                except Exception:  # noqa: BLE001 — metrics never gate chaos
+                    logger.debug("chaos-hit metric bump failed",
+                                 exc_info=True)
                 return rule
         return None
 

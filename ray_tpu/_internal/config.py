@@ -300,6 +300,21 @@ _DEFAULTS: Dict[str, Any] = {
     "serve_ttft_p95_slo_s": 2.0,
     "serve_queue_age_slo_s": 30.0,
     "serve_kv_occupancy_slo": 0.95,
+    # --- RPC/transport observatory (_internal/rpc_metrics.py) ---
+    # Any client call slower than this lands in the slow-RPC watchdog
+    # ring with method + peer + creation-site attribution.
+    "rpc_slow_call_s": 1.0,
+    # Bounded watchdog ring (a row is 6 small fields; overflow drops
+    # the oldest).
+    "rpc_slow_ring_size": 256,
+    # Rate limit for the SLOW_RPC GCS event the watchdog posts (one
+    # event per window per process; the ring keeps everything).
+    "rpc_slow_event_interval_s": 30.0,
+    # Transport SLO thresholds for the default alert rules (alerts.py):
+    # client-call p99 over the window, and max native-ring queue depth
+    # before the ring_backpressure alert fires.
+    "rpc_client_p99_slo_s": 5.0,
+    "ring_backpressure_depth": 4096,
     # --- A/B kill switches (every switch lives here so a typo'd
     # RTPU_* spelling is caught by rtpulint rule L003 instead of
     # silently doing nothing) ---
@@ -341,6 +356,11 @@ _DEFAULTS: Dict[str, Any] = {
     # exact-legacy per-drain admission (blocking inline prefill, upfront
     # page reservation, token-tuple prefix LRU, no preemption).
     "no_cont_batch": False,
+    # Kill switch for the RPC/transport observatory: zero rpc/ring/chaos
+    # series constructed, no slow-RPC watchdog ring, no frame-meta trace
+    # propagation — exact-legacy frames on the wire, so mixed on/off
+    # processes interoperate.
+    "no_rpc_metrics": False,
     # --- event-loop stall sanitizer (_internal/lint/loopstall.py) ---
     # Armed together with the lock-order sanitizer (RTPU_SANITIZE=1):
     # any single callback that holds a ray_tpu-owned event loop longer

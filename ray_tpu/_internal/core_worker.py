@@ -3798,6 +3798,17 @@ class CoreWorker:
                 "num_shards": len(self.shards),
                 "shards": self.shards.stats()}
 
+    async def handle_get_rpc_stats(self):
+        """Transport-observatory introspection: this process's per-ring
+        native stats, slow-RPC ring, and retry/transport-error totals
+        (state.rpc_summary() fans this out cluster-wide)."""
+        from . import rpc_metrics
+        stats = rpc_metrics.local_stats()
+        stats["worker_id"] = self.worker_id.hex() \
+            if isinstance(self.worker_id, bytes) else str(self.worker_id)
+        stats["mode"] = self.mode
+        return stats
+
     async def handle_get_memory_report(self, limit: int = 10_000):
         """Owner-side memory introspection (reference: the per-worker
         reference-table dump behind `ray memory` / memory_summary()):

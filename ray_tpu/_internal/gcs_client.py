@@ -99,7 +99,8 @@ class GcsClient:
             return await self.call(method, timeout=timeout, **kwargs)
         bo = Backoff(base_s=CONFIG.gcs_reconnect_base_delay_ms / 1000.0,
                      max_s=CONFIG.gcs_reconnect_max_delay_ms / 1000.0,
-                     deadline_s=window)
+                     deadline_s=window,
+                     site="gcs_reconnecting_call")
         while True:
             try:
                 return await self.client.call(
@@ -163,7 +164,8 @@ class GcsClient:
         re-subscribe + fire hooks if the incarnation changed."""
         bo = Backoff(base_s=CONFIG.gcs_reconnect_base_delay_ms / 1000.0,
                      max_s=CONFIG.gcs_reconnect_max_delay_ms / 1000.0,
-                     deadline_s=CONFIG.gcs_reconnect_timeout_s or None)
+                     deadline_s=CONFIG.gcs_reconnect_timeout_s or None,
+                     site="gcs_probe")
         try:
             await self._probe_reconnect_inner(bo)
         except asyncio.CancelledError:

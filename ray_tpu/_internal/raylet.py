@@ -2442,6 +2442,15 @@ class Raylet:
                     *(_one(h) for h in targets)))
         return report
 
+    async def handle_get_rpc_stats(self):
+        """Transport-observatory introspection for this raylet process
+        (state.rpc_summary() merges these with the driver/worker rows)."""
+        from . import rpc_metrics
+        stats = rpc_metrics.local_stats()
+        stats["node_id"] = self.node_id
+        stats["mode"] = "raylet"
+        return stats
+
     async def handle_get_node_stats(self):
         return {
             "node_id": self.node_id,

@@ -25,7 +25,13 @@ Wire frames (both transports):
   flags: bit0 = response, bit1 = ok (responses only),
          bit2 = raw (payload is an opaque byte frame dispatched to a
          raw handler with NO kwargs pickling — the flat task path's
-         template-announce + delta frames ride this type).
+         template-announce + delta frames ride this type),
+         bit3 = meta (non-raw requests only): u16le meta_len | meta
+         bytes follow the method, before the payload — currently the
+         "trace_id:span_id" control-plane trace context. OPTIONAL on
+         the wire: receivers accept both forms, and the
+         RTPU_NO_RPC_METRICS=1 kill switch never sets it, so frames
+         are exact-legacy and mixed on/off processes interoperate.
 """
 
 from __future__ import annotations
@@ -42,6 +48,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 from .config import CONFIG
 from .errors import RpcError
 from . import aio
+from . import rpc_metrics as rpcm
 from . import serialization
 
 logger = logging.getLogger(__name__)
@@ -56,6 +63,7 @@ _BODY_HDR_LEN = _BODY_HDR.size
 FLAG_RESP = 1
 FLAG_OK = 2
 FLAG_RAW = 4
+FLAG_META = 8
 # Internal-only (never on the wire): the payload reaching
 # _handle_request is a record the native ring already decoded
 # (src/fastrpc.cpp), so dispatch selects the decoded handler table.
@@ -69,22 +77,36 @@ _DECODED_KIND_METHOD = {
     5: "actor_tasks_done",   # KIND_DONE_STREAM (oneway)
 }
 _U64LE = struct.Struct("<Q")
+_U16LE = struct.Struct("<H")
 
 
 def pack_frame(msg_id: int, flags: int, method: bytes,
-               payload: bytes) -> bytes:
+               payload: bytes, meta: bytes = b"") -> bytes:
+    if meta:
+        flags |= FLAG_META
+        payload = _U16LE.pack(len(meta)) + meta + payload
     return _FRAME_HDR.pack(_BODY_HDR_LEN + len(method) + len(payload),
                            msg_id, flags, len(method)) + method + payload
 
 
-def unpack_body(body) -> Tuple[int, int, str, bytes]:
+def unpack_body(body) -> Tuple[int, int, str, bytes, bytes]:
     """Parse a frame body (past the length prefix) -> (id, flags, method,
-    payload). Copies the payload: callers may outlive the recv buffer."""
+    payload, meta). Copies the payload: callers may outlive the recv
+    buffer. FLAG_META is consumed here (meta extracted, flag stripped),
+    so downstream flag logic is identical for both wire forms."""
     msg_id, flags, mlen = _BODY_HDR.unpack_from(body, 0)
     method = bytes(body[_BODY_HDR_LEN:_BODY_HDR_LEN + mlen]).decode() \
         if mlen else ""
-    payload = bytes(body[_BODY_HDR_LEN + mlen:])
-    return msg_id, flags, method, payload
+    off = _BODY_HDR_LEN + mlen
+    meta = b""
+    if flags & FLAG_META:
+        (meta_len,) = _U16LE.unpack_from(body, off)
+        off += 2
+        meta = bytes(body[off:off + meta_len])
+        off += meta_len
+        flags &= ~FLAG_META
+    payload = bytes(body[off:])
+    return msg_id, flags, method, payload, meta
 
 
 class FrameReader:
@@ -318,6 +340,20 @@ from .chaos import REGISTRY as CHAOS  # noqa: E402  (after config import)
 # timeout=None, which means no deadline at all (unbounded pushes).
 DEFAULT_TIMEOUT = object()
 
+# Lazy tracing accessor: ray_tpu.util's package __init__ pulls in the
+# core (placement groups -> core_worker), which imports this module —
+# a module-scope import would cycle. After the first call this is a
+# plain global read.
+_tracing_mod = None
+
+
+def _tracing():
+    global _tracing_mod
+    if _tracing_mod is None:
+        from ..util import tracing
+        _tracing_mod = tracing
+    return _tracing_mod
+
 
 # --------------------------------------------------------------------------
 # Write coalescing
@@ -513,6 +549,9 @@ class RpcServer:
         self._native = None            # NativeIO when serving natively
         self._native_listener: Optional[int] = None
         self._native_conns: set = set()
+        # 1/64 sampling tick for the handler-latency histogram
+        # (single-loop server: no race on the increment).
+        self._obs_tick = 0
 
     def register(self, method: str, handler: Handler):
         self._handlers[method] = handler
@@ -616,10 +655,11 @@ class RpcServer:
                                          self._native_reply, coalescer,
                                          FLAG_RAW | FLAG_DECODED))
                 return
-            msg_id, flags, method, payload = unpack_body(body)
+            msg_id, flags, method, payload, meta = unpack_body(body)
             asyncio.ensure_future(
                 self._handle_request(method, payload, msg_id,
-                                     self._native_reply, coalescer, flags))
+                                     self._native_reply, coalescer, flags,
+                                     meta=meta))
         return sink
 
     def _native_reply(self, coalescer: "NativeCoalescer", frame: bytes):
@@ -644,10 +684,11 @@ class RpcServer:
                 if not chunk:
                     break
                 for body in frames.feed(chunk):
-                    msg_id, flags, method, payload = unpack_body(body)
+                    msg_id, flags, method, payload, meta = unpack_body(body)
                     asyncio.ensure_future(
                         self._handle_request(method, payload, msg_id,
-                                             reply, None, flags))
+                                             reply, None, flags,
+                                             meta=meta))
         except (ConnectionResetError, asyncio.IncompleteReadError):
             pass
         finally:
@@ -659,12 +700,27 @@ class RpcServer:
     # -- shared dispatch -------------------------------------------------
 
     async def _handle_request(self, method: str, payload: bytes,
-                              msg_id: int, reply, conn, flags: int = 0):
+                              msg_id: int, reply, conn, flags: int = 0,
+                              meta: bytes = b""):
         if CHAOS.drop_request(method):
             return
         delay = CHAOS.request_delay(method)
         if delay > 0:
             await asyncio.sleep(delay)
+        m = rpcm.metrics()
+        start = 0.0
+        if m is not None:
+            rpcm.inflight_delta("server", 1)
+            rpcm.note_bytes(method, "in", len(payload))
+            if meta:
+                # Adopt the caller's trace context for the handler: this
+                # coroutine is its own task, so the set is task-local —
+                # RPCs the handler issues chain as children of the
+                # client-side rpc span shipped in the meta.
+                tctx = rpcm.parse_meta(meta)
+                if tctx is not None:
+                    _tracing().set_trace_context(tctx)
+            start = time.perf_counter()
         try:
             if flags & FLAG_RAW:
                 if flags & FLAG_DECODED:
@@ -683,6 +739,13 @@ class RpcServer:
             ok, body = False, e
             if msg_id == 0:
                 logger.warning("one-way rpc %s failed: %s", method, e)
+        if m is not None:
+            dur = time.perf_counter() - start
+            rpcm.inflight_delta("server", -1)
+            self._obs_tick = (self._obs_tick + 1) & 63
+            if self._obs_tick == 0 \
+                    or dur >= float(CONFIG.rpc_slow_call_s):
+                m.server_seconds.observe(dur, tags={"method": method})
         if msg_id == 0:
             return  # one-way message: no response frame
         if CHAOS.drop_response(method):
@@ -694,6 +757,8 @@ class RpcServer:
                 RpcError(f"unpicklable reply: {e}"))
         flags = FLAG_RESP | (FLAG_OK if ok else 0)
         frame = pack_frame(msg_id, flags, b"", data)
+        if m is not None:
+            rpcm.note_bytes(method, "out", len(frame))
         waiter = reply(conn, frame)
         if waiter is not None:
             await waiter  # transport backpressure
@@ -731,6 +796,9 @@ class RpcClient:
         self._next_id = 0
         self._conn_lock: Optional[asyncio.Lock] = None
         self._reader_task: Optional[asyncio.Task] = None
+        # 1/64 sampling tick for the client-latency histogram
+        # (loop-affine client: no race on the increment).
+        self._obs_tick = 0
 
     def _local(self) -> Optional[RpcServer]:
         with _local_servers_lock:
@@ -781,7 +849,7 @@ class RpcClient:
             self._fail_pending(
                 RpcError(f"connection to {self.address} closed"))
             return
-        msg_id, flags, _method, payload = unpack_body(body)
+        msg_id, flags, _method, payload, _meta = unpack_body(body)
         fut = self._pending.pop(msg_id, None)
         if fut is not None and not fut.done():
             _resolve_future(fut, (flags, payload))
@@ -794,7 +862,7 @@ class RpcClient:
                 if not chunk:
                     break
                 for body in frames.feed(chunk):
-                    msg_id, flags, _method, payload = unpack_body(body)
+                    msg_id, flags, _method, payload, _meta = unpack_body(body)
                     fut = self._pending.pop(msg_id, None)
                     if fut is not None and not fut.done():
                         _resolve_future(fut, (flags, payload))
@@ -840,12 +908,29 @@ class RpcClient:
         connection read-loop still fails the call if the peer dies."""
         if timeout is DEFAULT_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
+        if not rpcm.enabled():
+            return await self._call_retrying(method, kwargs, timeout,
+                                             retries)
+        rpcm.inflight_delta("client", 1)
+        start = time.perf_counter()
+        try:
+            return await self._call_retrying(method, kwargs, timeout,
+                                             retries)
+        finally:
+            rpcm.inflight_delta("client", -1)
+            self._observe_call(method, time.perf_counter() - start)
+
+    async def _call_retrying(self, method: str, kwargs: Dict[str, Any],
+                             timeout: Optional[float], retries: int) -> Any:
         attempt = 0
         bo = None  # built on first failure — the success path pays nothing
         while True:
             try:
                 return await self._call_once(method, kwargs, timeout)
             except (RpcError, ConnectionError, asyncio.TimeoutError, OSError) as e:
+                m = rpcm.metrics()
+                if m is not None:
+                    m.transport_errors.inc(tags={"method": method})
                 attempt += 1
                 if attempt > retries:
                     if isinstance(e, asyncio.TimeoutError):
@@ -856,8 +941,27 @@ class RpcClient:
                     from .backoff import Backoff
                     bo = Backoff(
                         base_s=CONFIG.rpc_retry_base_delay_ms / 1000.0,
-                        max_s=CONFIG.rpc_retry_max_delay_ms / 1000.0)
+                        max_s=CONFIG.rpc_retry_max_delay_ms / 1000.0,
+                        site="rpc_call")
                 await bo.async_sleep()
+
+    def _observe_call(self, method: str, duration_s: float):
+        """Per-logical-call accounting: 1/64-sampled latency histogram
+        (slow calls always recorded — they're the ones the p99 and the
+        watchdog exist for) + watchdog attribution."""
+        m = rpcm.metrics()
+        if m is None:
+            return
+        slow = duration_s >= float(CONFIG.rpc_slow_call_s)
+        self._obs_tick = (self._obs_tick + 1) & 63
+        if self._obs_tick == 0 or slow:
+            m.client_seconds.observe(duration_s, tags={"method": method})
+        if slow:
+            wd = rpcm.watchdog()
+            if wd is not None:
+                wd.note(method,
+                        f"{self.address[0]}:{self.address[1]}",
+                        duration_s)
 
     async def _call_once(self, method: str, payload: Dict[str, Any],
                          timeout: float) -> Any:
@@ -891,12 +995,35 @@ class RpcClient:
         msg_id = self._next_id
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
-        frame = pack_frame(msg_id, flags, method.encode(), payload)
+        meta = b""
+        span = None
+        m = rpcm.metrics()
+        if m is not None and not (flags & FLAG_RAW) \
+                and method not in rpcm.NO_SPAN_METHODS:
+            ctx = _tracing().get_trace_context()
+            if ctx is not None:
+                # Pre-generate the rpc span's id so the wire meta can
+                # ship it: the server adopts (trace_id, rpc_span_id),
+                # making handler-issued RPCs children of this hop in
+                # the trace tree.
+                span_id = _tracing().new_span_id()
+                meta = f"{ctx[0]}:{span_id}".encode()
+                span = (ctx, span_id, time.time())
+        frame = pack_frame(msg_id, flags, method.encode(), payload, meta)
+        if m is not None:
+            rpcm.note_bytes(method, "out", len(frame))
         try:
             await self._send_frame(frame)
             rflags, data = await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(msg_id, None)
+            if span is not None:
+                ctx, span_id, span_start = span
+                _tracing().record_child_span(
+                    f"rpc:{method}", ctx, span_start, time.time(),
+                    span_id=span_id)
+        if m is not None:
+            rpcm.note_bytes(method, "in", len(data))
         body = serialization.loads(data)
         if not (rflags & FLAG_OK):
             raise body
@@ -921,9 +1048,10 @@ class RpcClient:
                               what=f"oneway:{method}")
             return
         await self._ensure_conn()
-        await self._send_frame(pack_frame(
-            0, 0, method.encode(),
-            serialization.dumps(kwargs) if kwargs else b""))
+        frame = pack_frame(0, 0, method.encode(),
+                           serialization.dumps(kwargs) if kwargs else b"")
+        rpcm.note_bytes(method, "out", len(frame))
+        await self._send_frame(frame)
 
     async def call_raw(self, method: str, payload: bytes,
                        timeout: Optional[float] = DEFAULT_TIMEOUT) -> Any:
@@ -932,6 +1060,18 @@ class RpcClient:
         the reply travels the normal pickled-response path."""
         if timeout is DEFAULT_TIMEOUT:
             timeout = CONFIG.rpc_call_timeout_s
+        if not rpcm.enabled():
+            return await self._call_raw_once(method, payload, timeout)
+        rpcm.inflight_delta("client", 1)
+        start = time.perf_counter()
+        try:
+            return await self._call_raw_once(method, payload, timeout)
+        finally:
+            rpcm.inflight_delta("client", -1)
+            self._observe_call(method, time.perf_counter() - start)
+
+    async def _call_raw_once(self, method: str, payload: bytes,
+                             timeout: Optional[float]) -> Any:
         local = self._local()
         if local is not None:
             if CHAOS.drop_request(method) or CHAOS.drop_response(method):
@@ -967,8 +1107,9 @@ class RpcClient:
                               what=f"oneway_raw:{method}")
             return
         await self._ensure_conn()
-        await self._send_frame(pack_frame(0, FLAG_RAW, method.encode(),
-                                          payload))
+        frame = pack_frame(0, FLAG_RAW, method.encode(), payload)
+        rpcm.note_bytes(method, "out", len(frame))
+        await self._send_frame(frame)
 
     def call_sync(self, method: str, timeout: Optional[float] = DEFAULT_TIMEOUT,
                   retries: int = 0, **kwargs) -> Any:

@@ -1,0 +1,507 @@
+"""RPC/transport observatory (reference: src/ray/rpc/ metrics +
+common/asio event-loop instrumentation).
+
+Every observability plane rides the RPC layer; this module gives the
+layer itself eyes: per-method client/server latency histograms,
+in-flight gauges, byte/retry/transport-error/chaos counters, a slow-RPC
+watchdog ring with creation-site attribution, and the native-ring stats
+export (src/fastrpc.cpp `frpc_ring_stats`).
+
+Kill switch: ``RTPU_NO_RPC_METRICS=1`` -> :func:`enabled` is False,
+no series is ever constructed, the watchdog ring never exists, and the
+wire layer sends exact-legacy frames (no FLAG_META trace propagation) —
+mixed on/off processes interoperate.
+
+Separate namespace from ``runtime_metrics`` on purpose: the kill switch
+must guarantee ZERO new series, so these metrics cannot live in the
+always-built runtime namespace.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from collections import deque
+from types import SimpleNamespace
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import CONFIG
+
+logger = logging.getLogger(__name__)
+
+# Methods that never get a control-plane span and never trigger a
+# SLOW_RPC event post: the span/event recorders call these very methods
+# (add_task_events / add_event / add_alert), so instrumenting them would
+# recurse; the rest are high-rate housekeeping whose spans would drown
+# the trace tree (heartbeats, pubsub, metric flushes).
+NO_SPAN_METHODS = frozenset({
+    "add_task_events", "add_event", "add_alert",
+    "heartbeat", "ping", "pubsub_message", "subscribe",
+    "kv_put", "kv_get", "kv_del", "kv_keys",
+    "get_rpc_stats", "report_metrics",
+})
+
+_SECONDS_BOUNDARIES = [
+    0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0]
+
+
+def _build_rpc_metrics() -> SimpleNamespace:
+    from ..util.metrics import Counter, Gauge, Histogram
+    return SimpleNamespace(
+        client_seconds=Histogram(
+            "rtpu_rpc_client_seconds",
+            "Client-observed RPC latency by method (1/64 sampled; "
+            "calls over rpc_slow_call_s always recorded)",
+            boundaries=_SECONDS_BOUNDARIES,
+            tag_keys=("method",)),
+        server_seconds=Histogram(
+            "rtpu_rpc_server_seconds",
+            "Server handler latency by method (1/64 sampled; "
+            "handlers over rpc_slow_call_s always recorded)",
+            boundaries=_SECONDS_BOUNDARIES,
+            tag_keys=("method",)),
+        # pid tag: per-process gauge — cross-process merge is
+        # last-write-wins per tag tuple (see runtime_metrics).
+        inflight=Gauge(
+            "rtpu_rpc_inflight",
+            "RPC calls currently in flight in this process "
+            "(dir=client: issued, awaiting reply; dir=server: "
+            "handler running)",
+            tag_keys=("pid", "dir")),
+        bytes_total=Counter(
+            "rtpu_rpc_bytes_total",
+            "Wire bytes by method and direction (client requests "
+            "out / replies in, server requests in / replies out)",
+            tag_keys=("method", "dir")),
+        retries=Counter(
+            "rtpu_rpc_retries_total",
+            "Backoff-mediated retries, by call site (every "
+            "Backoff constructed with site= reports here)",
+            tag_keys=("site",)),
+        transport_errors=Counter(
+            "rtpu_rpc_transport_errors_total",
+            "Transport-level call failures (connection lost/refused, "
+            "deadline, send failure) by method — per attempt, so a "
+            "retried call counts each failed leg",
+            tag_keys=("method",)),
+        slow_calls=Counter(
+            "rtpu_rpc_slow_calls_total",
+            "Client calls exceeding rpc_slow_call_s (every one lands "
+            "in the slow-RPC watchdog ring with attribution)",
+            tag_keys=("method",)),
+        chaos_hits=Counter(
+            "rtpu_chaos_hits_total",
+            "Armed chaos-rule activations by method pattern and "
+            "action (drop_req / drop_resp / delay / dup)",
+            tag_keys=("method", "action")),
+        # Native-ring stats (src/fastrpc.cpp frpc_ring_stats): counters
+        # are deltas of the C core's cumulative relaxed-atomic totals,
+        # exported on the metrics flush cadence; gauges are the live
+        # values. ring tag = ring index within the process.
+        ring_frames=Counter(
+            "rtpu_ring_frames_total",
+            "Frames through a native ring by direction",
+            tag_keys=("pid", "ring", "dir")),
+        ring_bytes=Counter(
+            "rtpu_ring_bytes_total",
+            "Frame bytes through a native ring by direction",
+            tag_keys=("pid", "ring", "dir")),
+        ring_decode=Counter(
+            "rtpu_ring_decode_total",
+            "In-ring native decode outcomes (hit = decoded record "
+            "delivered, fallback = passthrough while decode armed)",
+            tag_keys=("pid", "ring", "result")),
+        ring_fold_batches=Counter(
+            "rtpu_ring_fold_batches_total",
+            "Decref fold batches delivered by a native ring",
+            tag_keys=("pid", "ring")),
+        ring_wakeups=Counter(
+            "rtpu_ring_notify_wakeups_total",
+            "Python loop wakeups signalled by a native ring (one "
+            "wakeup drains a whole batch of frames)",
+            tag_keys=("pid", "ring")),
+        ring_depth=Gauge(
+            "rtpu_ring_queue_depth",
+            "Events currently queued in a native ring awaiting the "
+            "Python drain",
+            tag_keys=("pid", "ring")),
+        ring_depth_hwm=Gauge(
+            "rtpu_ring_depth_hwm",
+            "High-water mark of a native ring's event queue since "
+            "process start",
+            tag_keys=("pid", "ring")),
+    )
+
+
+# Lazy namespace, same pattern as runtime_metrics — but behind the kill
+# switch: metrics() returns None when disabled, and _build only runs on
+# the first *enabled* use, so RTPU_NO_RPC_METRICS=1 constructs nothing.
+_NS_LOCK = threading.Lock()
+_NS: Optional[SimpleNamespace] = None
+_ENABLED: Optional[bool] = None
+_PID: Optional[str] = None
+
+
+def enabled() -> bool:
+    """Kill-switch gate, cached after first read (the flag is a
+    process-lifetime A/B arm; tests flip it via _reset_for_tests)."""
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = not bool(CONFIG.no_rpc_metrics)
+    return _ENABLED
+
+
+def metrics() -> Optional[SimpleNamespace]:
+    global _NS
+    if not enabled():
+        return None
+    if _NS is None:
+        with _NS_LOCK:
+            if _NS is None:
+                _NS = _build_rpc_metrics()
+    return _NS
+
+
+def _pid() -> str:
+    global _PID
+    if _PID is None:
+        _PID = str(os.getpid())
+    return _PID
+
+
+def _reset_for_tests():
+    """Drop every cached singleton so a test can flip the kill switch
+    or re-seed the watchdog. NOT for production use: re-building the
+    namespace re-registers the series (evicting prior objects)."""
+    global _NS, _ENABLED, _PID, _WATCHDOG, _RING_LAST
+    with _NS_LOCK:
+        _NS = None
+        _ENABLED = None
+        _PID = None
+    with _WATCHDOG_LOCK:
+        _WATCHDOG = None
+    with _INFLIGHT_LOCK:
+        _INFLIGHT["client"] = 0
+        _INFLIGHT["server"] = 0
+    with _BYTES_LOCK:
+        _BYTES.clear()
+    _RING_LAST = {}
+
+
+# ---------------------------------------------------------------------------
+# hot-path accumulators (in-flight + wire bytes)
+#
+# These two run on EVERY rpc (4x each per request/response round trip),
+# so they must not touch the metric registry inline: a tagged set()/
+# inc() costs a dict merge + tag validation + lock per call, which
+# benched at ~35% overhead on a loopback echo. Instead the hot path
+# does a plain dict update under a cheap lock and export_transport()
+# folds the totals into the registry on the metrics flush cadence —
+# the same deferred pattern the native-ring stats already use.
+# ---------------------------------------------------------------------------
+
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT = {"client": 0, "server": 0}
+
+_BYTES_LOCK = threading.Lock()
+# (method, dir) -> bytes accumulated since the last export_transport().
+_BYTES: Dict[Tuple[str, str], int] = {}
+
+
+def inflight_delta(direction: str, delta: int):
+    if not enabled():
+        return
+    with _INFLIGHT_LOCK:
+        value = _INFLIGHT[direction] + delta
+        if value < 0:
+            value = 0
+        _INFLIGHT[direction] = value
+
+
+def note_bytes(method: str, direction: str, nbytes: int):
+    """Account wire bytes for one frame (dir in {"in", "out"} from the
+    caller's perspective). Registry fold is deferred to
+    export_transport()."""
+    if not enabled():
+        return
+    key = (method, direction)
+    with _BYTES_LOCK:
+        _BYTES[key] = _BYTES.get(key, 0) + nbytes
+
+
+def export_transport():
+    """Fold the hot-path accumulators (wire bytes, in-flight counts)
+    and the native-ring stats into the metric registry. Called from
+    util.metrics.flush_now right before snapshotting, so every flush
+    carries current totals; tests call it directly before asserting."""
+    m = metrics()
+    if m is None:
+        return
+    with _BYTES_LOCK:
+        pending, drained = (_BYTES.copy(), True) if _BYTES else ({}, False)
+        _BYTES.clear()
+    if drained:
+        for (method, direction), nbytes in pending.items():
+            try:
+                m.bytes_total.inc(nbytes, tags={"method": method,
+                                                "dir": direction})
+            except Exception:  # noqa: BLE001 — observability is best-effort
+                logger.debug("bytes fold failed", exc_info=True)
+    with _INFLIGHT_LOCK:
+        inflight = dict(_INFLIGHT)
+    for direction, value in inflight.items():
+        try:
+            m.inflight.set(value, tags={"pid": _pid(), "dir": direction})
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.debug("inflight fold failed", exc_info=True)
+    export_ring_stats()
+
+
+# ---------------------------------------------------------------------------
+# frame meta (trace propagation)
+# ---------------------------------------------------------------------------
+
+
+def parse_meta(meta: bytes) -> Optional[Tuple[str, str]]:
+    """Frame meta -> (trace_id, span_id), or None on any malformation
+    (meta is advisory: a bad one must never fail the request)."""
+    try:
+        trace_id, _, span_id = meta.decode("utf-8", "replace") \
+            .partition(":")
+        if trace_id and span_id:
+            return trace_id, span_id
+    except Exception:  # noqa: BLE001 — advisory field
+        logger.debug("unparseable frame meta", exc_info=True)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# slow-RPC watchdog
+# ---------------------------------------------------------------------------
+
+# Frames from these files are the transport itself, not the caller —
+# the watchdog walks past them to attribute a slow call to the code
+# that issued it.
+_TRANSPORT_FILES = ("rpc.py", "rpc_metrics.py", "gcs_client.py",
+                    "tasks.py", "aio.py")
+
+
+def _caller_site() -> str:
+    """Nearest stack frame outside the transport layer, as file:line.
+    Bounded walk — a slow call is already >=1s, the walk is noise."""
+    try:
+        f = sys._getframe(3)
+    except ValueError:
+        return ""
+    for _ in range(16):
+        if f is None:
+            break
+        filename = f.f_code.co_filename
+        base = os.path.basename(filename)
+        if base not in _TRANSPORT_FILES \
+                and not base.startswith(("asyncio", "base_events",
+                                         "events", "tasks", "futures")):
+            return f"{base}:{f.f_lineno}"
+        f = f.f_back
+    return ""
+
+
+class SlowRpcWatchdog:
+    """Bounded ring of slow client calls (method + peer + duration +
+    creation site) plus a rate-limited ``SLOW_RPC`` GCS event so one
+    slow peer shows up cluster-wide without an event flood."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(
+            maxlen=max(1, int(CONFIG.rpc_slow_ring_size)))
+        self._last_event = 0.0
+        self.total = 0
+
+    def note(self, method: str, peer: str, duration_s: float):
+        row = {
+            "ts": time.time(),
+            "method": method,
+            "peer": peer,
+            "duration_s": round(float(duration_s), 6),
+            "site": _caller_site(),
+            "pid": os.getpid(),
+        }
+        emit = False
+        with self._lock:
+            self._ring.append(row)
+            self.total += 1
+            if method not in NO_SPAN_METHODS:
+                now = time.monotonic()
+                if now - self._last_event >= float(
+                        CONFIG.rpc_slow_event_interval_s):
+                    self._last_event = now
+                    emit = True
+        m = metrics()
+        if m is not None:
+            try:
+                m.slow_calls.inc(tags={"method": method})
+            except Exception:  # noqa: BLE001 — observability is best-effort
+                logger.debug("slow-call metric bump failed", exc_info=True)
+        if emit:
+            self._emit_event(row)
+
+    def _emit_event(self, row: Dict[str, Any]):
+        try:
+            from .core_worker import try_get_core_worker
+            worker = try_get_core_worker()
+            if worker is None:
+                return
+            worker.loop_post(worker.gcs.call(
+                "add_event", event_type="SLOW_RPC",
+                message=(f"slow RPC {row['method']} to {row['peer']}: "
+                         f"{row['duration_s']:.3f}s"
+                         + (f" (from {row['site']})" if row["site"]
+                            else "")),
+                severity="WARNING",
+                fields={"method": row["method"], "peer": row["peer"],
+                        "duration_s": row["duration_s"],
+                        "site": row["site"], "pid": row["pid"]}))
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.debug("SLOW_RPC event post failed", exc_info=True)
+
+    def snapshot(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            rows = list(self._ring)
+        if limit is not None and limit > 0:
+            rows = rows[-limit:]
+        return rows
+
+
+_WATCHDOG_LOCK = threading.Lock()
+_WATCHDOG: Optional[SlowRpcWatchdog] = None
+
+
+def watchdog() -> Optional[SlowRpcWatchdog]:
+    """The process watchdog singleton, or None when the observatory is
+    disabled (the ring is never constructed under the kill switch)."""
+    global _WATCHDOG
+    if not enabled():
+        return None
+    if _WATCHDOG is None:
+        with _WATCHDOG_LOCK:
+            if _WATCHDOG is None:
+                _WATCHDOG = SlowRpcWatchdog()
+    return _WATCHDOG
+
+
+# ---------------------------------------------------------------------------
+# native-ring stats export (piggybacks on the metrics flush cadence)
+# ---------------------------------------------------------------------------
+
+# Field order fixed by src/fastrpc.cpp frpc_ring_stats.
+RING_STAT_FIELDS = (
+    "frames_in", "frames_out", "bytes_in", "bytes_out",
+    "decode_hits", "decode_fallbacks", "fold_batches",
+    "notify_wakeups", "queue_depth", "depth_hwm")
+
+# (ring, field) -> last cumulative value seen, for counter deltas.
+_RING_LAST: Dict[Tuple[int, str], int] = {}
+
+
+def collect_ring_stats() -> List[Dict[str, int]]:
+    """Live per-ring stats dicts from the native core (empty when the
+    native library never loaded in this process). Read path only — no
+    metric series touched, usable under the kill switch (cli/state
+    surfaces still show ring health)."""
+    mod = sys.modules.get("ray_tpu._native.fastrpc")
+    if mod is None:
+        return []
+    try:
+        rows = []
+        for ring_idx, io in mod.NativeIO.all_instances():
+            stats = io.ring_stats()
+            if stats is not None:
+                stats["ring"] = ring_idx
+                rows.append(stats)
+        return rows
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        logger.debug("ring-stats read failed", exc_info=True)
+        return []
+
+
+def export_ring_stats():
+    """Fold the C core's cumulative per-ring totals into the metric
+    registry: counters advance by delta since the previous export,
+    gauges take the live value. Called from util.metrics.flush_now via
+    a sys.modules guard (processes that never imported this pay
+    nothing)."""
+    m = metrics()
+    if m is None:
+        return
+    for stats in collect_ring_stats():
+        ring = str(stats["ring"])
+        tags = {"pid": _pid(), "ring": ring}
+        try:
+            for field, counter, extra in (
+                    ("frames_in", m.ring_frames, {"dir": "in"}),
+                    ("frames_out", m.ring_frames, {"dir": "out"}),
+                    ("bytes_in", m.ring_bytes, {"dir": "in"}),
+                    ("bytes_out", m.ring_bytes, {"dir": "out"}),
+                    ("decode_hits", m.ring_decode, {"result": "hit"}),
+                    ("decode_fallbacks", m.ring_decode,
+                     {"result": "fallback"}),
+                    ("fold_batches", m.ring_fold_batches, {}),
+                    ("notify_wakeups", m.ring_wakeups, {})):
+                value = int(stats.get(field, 0))
+                key = (stats["ring"], field)
+                delta = value - _RING_LAST.get(key, 0)
+                _RING_LAST[key] = value
+                if delta > 0:
+                    counter.inc(delta, tags=dict(tags, **extra))
+            m.ring_depth.set(int(stats.get("queue_depth", 0)), tags=tags)
+            m.ring_depth_hwm.set(int(stats.get("depth_hwm", 0)),
+                                 tags=tags)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            logger.debug("ring-stats export failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# per-process stats view (the get_rpc_stats handler's payload)
+# ---------------------------------------------------------------------------
+
+
+def local_stats() -> Dict[str, Any]:
+    """This process's transport view: counter totals, the slow-call
+    ring, and live native-ring stats. Works (degraded to ring stats
+    only) under the kill switch."""
+    out: Dict[str, Any] = {
+        "pid": os.getpid(),
+        "enabled": enabled(),
+        "rings": collect_ring_stats(),
+        "slow": [],
+        "slow_total": 0,
+        "transport_errors": 0,
+        "retries": 0,
+    }
+    with _INFLIGHT_LOCK:
+        out["inflight"] = dict(_INFLIGHT)
+    wd = _WATCHDOG
+    if wd is not None:
+        out["slow"] = wd.snapshot(limit=64)
+        out["slow_total"] = wd.total
+    ns = _NS
+    if ns is not None:
+        try:
+            out["transport_errors"] = sum(
+                v for _t, v in _series_pairs(ns.transport_errors))
+            out["retries"] = sum(
+                v for _t, v in _series_pairs(ns.retries))
+        except Exception:  # noqa: BLE001
+            logger.debug("counter total read failed", exc_info=True)
+    return out
+
+
+def _series_pairs(metric):
+    snap = metric.snapshot()
+    for tags, value in snap.get("series") or []:
+        yield tuple(tags), value

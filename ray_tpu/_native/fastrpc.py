@@ -41,6 +41,14 @@ KIND_DECREF_FOLD = 6         # accumulated borrow_decref_fold ids
 
 _RECV_CAP = 1024
 
+# Field order of the frpc_ring_stats C export — MUST match both the C
+# side (src/fastrpc.cpp) and rpc_metrics.RING_STAT_FIELDS, which maps
+# these onto the rtpu_ring_* series.
+RING_STAT_FIELDS = (
+    "frames_in", "frames_out", "bytes_in", "bytes_out",
+    "decode_hits", "decode_fallbacks", "fold_batches",
+    "notify_wakeups", "queue_depth", "depth_hwm")
+
 _RECV_ARGTYPES = [
     ctypes.c_int,
     ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint8),
@@ -86,6 +94,13 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.frpc_test_decode.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p,
         ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint8)]
+    # Older cached .so builds predate the ring-stats export; guard so a
+    # stale RTPU_NATIVE_CACHE keeps working (ring_stats() returns None).
+    if hasattr(lib, "frpc_ring_stats"):
+        lib.frpc_ring_stats.restype = ctypes.c_int
+        lib.frpc_ring_stats.argtypes = [ctypes.c_int,
+                                        ctypes.POINTER(ctypes.c_uint64),
+                                        ctypes.c_int]
     return lib
 
 
@@ -169,6 +184,12 @@ class NativeIO:
     # one CoreWorker per process owns borrow-decref handling). Runs on
     # whichever loop drains the ring; the fold consumer is thread-safe.
     _fold_sink: Optional[Callable[[memoryview], None]] = None
+    # Every ring ever created in this process, by ring index — the
+    # transport observatory walks this to export per-ring stats. Rings
+    # are process-lifetime resources in the C core, so entries are never
+    # removed (a pooled ring keeps reporting its totals, which is what a
+    # monotonic counter wants).
+    _ring_registry: Dict[int, "NativeIO"] = {}
 
     def __init__(self, lib: ctypes.CDLL, notify_fd: int, ring: int = 0):
         self._lib = lib
@@ -206,6 +227,7 @@ class NativeIO:
             if fd < 0:
                 return None
             cls._instance = cls(lib, fd)
+            cls._ring_registry[0] = cls._instance
         return cls._instance
 
     @classmethod
@@ -245,7 +267,9 @@ class NativeIO:
             fd = base._lib.frpc_ring_fd(ring)
             if fd < 0:
                 return None
-            return cls(base._lib, fd, ring=ring)
+            io = cls(base._lib, fd, ring=ring)
+            cls._ring_registry[ring] = io
+            return io
 
     @classmethod
     def release_ring(cls, ring: "NativeIO"):
@@ -259,6 +283,27 @@ class NativeIO:
         ring._orphans.clear()
         with cls._lock:
             cls._ring_pool.append(ring)
+
+    @classmethod
+    def all_instances(cls) -> List[Tuple[int, "NativeIO"]]:
+        """Snapshot of every ring this process has created, as
+        ``(ring_index, io)`` pairs sorted by index — the stats exporter
+        iterates this without holding the class lock for long."""
+        with cls._lock:
+            return sorted(cls._ring_registry.items())
+
+    def ring_stats(self) -> Optional[Dict[str, int]]:
+        """Lock-free stats snapshot of this ring from the C core, keyed
+        by ``RING_STAT_FIELDS``. None when the loaded library predates
+        the export (stale build cache) or the ring is gone."""
+        lib = self._lib
+        if not hasattr(lib, "frpc_ring_stats"):
+            return None
+        out = (ctypes.c_uint64 * len(RING_STAT_FIELDS))()
+        n = lib.frpc_ring_stats(self._ring, out, len(RING_STAT_FIELDS))
+        if n < len(RING_STAT_FIELDS):
+            return None
+        return dict(zip(RING_STAT_FIELDS, out))
 
     # -- loop integration ------------------------------------------------
 

@@ -229,6 +229,24 @@ def cmd_status(args):
                       f"({row['compiles']} compiles)")
     except Exception as e:  # noqa: BLE001 — status must render anyway
         print(f"accelerators: unavailable ({e})")
+    # Transport plane: per-process rpc error/retry/slow totals from the
+    # observatory fan-out — best-effort like the accel block (and empty
+    # under RTPU_NO_RPC_METRICS, where the counters don't exist).
+    try:
+        rows = [p for p in st.rpc_summary()["processes"]
+                if "error" not in p]
+        if any(p.get("transport_errors") or p.get("retries")
+               or p.get("slow_total") for p in rows):
+            print("rpc transport:")
+            for p in rows:
+                node = (p.get("node_id") or "")[:12] or "-"
+                print(f"  {p.get('mode', '?'):8s} pid={p.get('pid')} "
+                      f"node={node}  "
+                      f"errors={p.get('transport_errors', 0):g}  "
+                      f"retries={p.get('retries', 0):g}  "
+                      f"slow={p.get('slow_total', 0)}")
+    except Exception as e:  # noqa: BLE001 — status must render anyway
+        print(f"rpc transport: unavailable ({e})")
     # Per-shape pending demand with a feasibility check, so "why is my
     # task pending" is answerable from here: a shape no amount of
     # waiting can satisfy is flagged INFEASIBLE. A shape must fit on
@@ -907,6 +925,65 @@ def cmd_chaos(args):
         raise SystemExit(f"unknown chaos action {args.action!r}")
 
 
+def cmd_rpc(args):
+    """Transport observatory (`state.rpc_summary()`): per-method client
+    latency percentiles + error rates, retry/chaos counters, per-ring
+    native stats, and every process's slow-RPC ring."""
+    _connect(args)
+    from ray_tpu.util import state as st
+    summary = st.rpc_summary()
+    if args.json:
+        print(json.dumps(summary, indent=1, default=str))
+        return
+
+    def _ms(v):
+        return f"{v * 1000:.2f}ms" if v is not None else "-"
+
+    methods = summary["methods"]
+    if args.method:
+        methods = [m for m in methods if args.method in m["method"]]
+    print(f"methods: {len(methods)} "
+          f"(client latency, 1/64-sampled + every slow call)")
+    for m in methods:
+        print(f"  {m['method']:<24s} n={m['sampled']:<6d} "
+              f"p50={_ms(m['p50_s'])} p95={_ms(m['p95_s'])} "
+              f"p99={_ms(m['p99_s'])} errors={m['transport_errors']:g}")
+    if summary["retries_by_site"]:
+        print("retries:")
+        for site, n in sorted(summary["retries_by_site"].items()):
+            print(f"  {site}: {n:g}")
+    if summary["chaos_hits"]:
+        print("chaos hits:")
+        for pattern, n in sorted(summary["chaos_hits"].items()):
+            print(f"  {pattern}: {n:g}")
+    if summary["rings"]:
+        print("native rings:")
+        for r in summary["rings"]:
+            print(f"  pid={r['pid']} ring={r['ring']}  "
+                  f"depth={r.get('queue_depth', 0):g} "
+                  f"hwm={r.get('depth_hwm', 0):g}  "
+                  f"frames in/out={r.get('frames_in', 0):g}/"
+                  f"{r.get('frames_out', 0):g}")
+    processes = summary["processes"]
+    if args.node:
+        processes = [p for p in processes
+                     if (p.get("node_id") or "").startswith(args.node)]
+    for p in processes:
+        if "error" in p:
+            print(f"process {p.get('node_id') or p.get('job_id')}: "
+                  f"unreachable ({p['error']})")
+            continue
+        print(f"process pid={p.get('pid')} mode={p.get('mode', '?')} "
+              f"errors={p.get('transport_errors', 0):g} "
+              f"retries={p.get('retries', 0):g} "
+              f"slow={p.get('slow_total', 0)}")
+        if args.slow:
+            for row in p.get("slow", ()):
+                print(f"    {row['method']:<20s} "
+                      f"{row['duration_s'] * 1000:8.1f}ms  "
+                      f"peer={row['peer']}  site={row['site']}")
+
+
 def cmd_perf(args):
     from ray_tpu import perf
     perf.main(quick=args.quick)
@@ -1166,6 +1243,21 @@ def main(argv=None):
                    help="kill-worker: restrict to one node id prefix")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "rpc",
+        help="transport observatory: per-method latency percentiles, "
+             "retry/error rates, native-ring stats, slow-RPC ring")
+    p.add_argument("--address")
+    p.add_argument("--method", default="",
+                   help="filter the method table by substring")
+    p.add_argument("--node", default="",
+                   help="restrict process rows to one node id prefix")
+    p.add_argument("--slow", action="store_true",
+                   help="print each process's slow-RPC ring (method, "
+                        "duration, peer, creation site)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_rpc)
 
     p = sub.add_parser("perf")
     p.add_argument("--quick", action="store_true")

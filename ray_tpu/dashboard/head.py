@@ -6,6 +6,8 @@ asyncio HTTP server inside a detached actor).
 Routes:
   GET  /api/cluster_status            nodes + aggregate resources
   GET  /api/nodes|actors|tasks|placement_groups|objects|workers
+  GET  /api/rpc                       transport observatory (per-method
+                                      latency, rings, slow-RPC ring)
   GET  /api/jobs/                     submitted jobs
   POST /api/jobs/                     {entrypoint, ...} -> submission_id
   GET  /api/jobs/<id>                 job info
@@ -206,6 +208,10 @@ class DashboardHead:
             # owner-shard rows per fan-in process (drivers + self):
             # queue depth / submits / loop lag per shard
             return self._json(st.shard_summary())
+        if path == "/api/rpc":
+            # transport observatory: per-method latency percentiles,
+            # retry/error/chaos counters, native-ring stats, slow ring
+            return self._json(st.rpc_summary())
         if path == "/api/timeline":
             since = query.get("since")
             return self._json(st.timeline(

@@ -395,6 +395,78 @@ def callsite_bench(n: int = 200_000,
     return out
 
 
+def rpc_bench(n: int = 2000,
+              results: Optional[Dict[str, float]] = None
+              ) -> Dict[str, float]:
+    """Transport-observatory overhead: per-call latency of a real-socket
+    loopback echo with instrumentation on vs the RTPU_NO_RPC_METRICS
+    kill switch, interleaved (on/off/on/off...) so clock drift and
+    allocator state cancel instead of biasing one side, plus the
+    lock-free frpc_ring_stats read cost. Runs in-process (no cluster)."""
+    import asyncio
+
+    from ray_tpu._internal import rpc, rpc_metrics
+    from ray_tpu._internal.config import CONFIG
+
+    async def _run(count: int) -> float:
+        server = rpc.RpcServer("perf-rpc")
+
+        async def echo(x=0):
+            return x
+        server.register("echo", echo)
+        await server.start("127.0.0.1", 0)
+        # Defeat the in-process fast path: the observatory instruments
+        # the wire, so the bench must cross it.
+        with rpc._local_servers_lock:
+            rpc._local_servers.pop(server.address, None)
+        client = rpc.RpcClient(server.address)
+        for i in range(100):
+            await client.call("echo", x=i)  # warm
+        t0 = time.perf_counter()
+        for i in range(count):
+            await client.call("echo", x=i)
+        per_call = (time.perf_counter() - t0) / count
+        await client.close()
+        await server.stop()
+        return per_call * 1e6
+
+    def _with_switch(disabled: bool) -> float:
+        saved = CONFIG.no_rpc_metrics
+        CONFIG.no_rpc_metrics = disabled
+        rpc_metrics._reset_for_tests()
+        try:
+            return asyncio.run(_run(n))
+        finally:
+            CONFIG.no_rpc_metrics = saved
+            rpc_metrics._reset_for_tests()
+
+    on_runs, off_runs = [], []
+    for _ in range(3):
+        on_runs.append(_with_switch(False))
+        off_runs.append(_with_switch(True))
+    on_us, off_us = min(on_runs), min(off_runs)
+    out: Dict[str, float] = {
+        "rpc_call_us": on_us,
+        "rpc_call_nometrics_us": off_us,
+        "rpc_metrics_overhead_pct": (on_us - off_us) / off_us * 100.0,
+    }
+    from ray_tpu._native.fastrpc import NativeIO
+    io = NativeIO.get()
+    if io is not None and io.ring_stats() is not None:
+        k = 20_000
+        t0 = time.perf_counter()
+        for _ in range(k):
+            io.ring_stats()
+        out["ring_stats_read_ns"] = (time.perf_counter() - t0) / k * 1e9
+    for metric, value in out.items():
+        unit = ("%" if metric.endswith("pct")
+                else "ns" if metric.endswith("ns") else "us")
+        _report(metric, value, unit)
+    if results is not None:
+        results.update(out)
+    return out
+
+
 def sampler_bench(results: Optional[Dict[str, float]] = None
                   ) -> Dict[str, float]:
     """Stack-sampler overhead: wall time of a fixed pure-Python workload
@@ -1123,6 +1195,11 @@ if __name__ == "__main__":
     parser.add_argument("--sampler", action="store_true",
                         help="stack-sampler overhead microbench only "
                              "(no cluster)")
+    parser.add_argument("--rpc", action="store_true",
+                        help="transport-observatory overhead "
+                             "microbench: loopback call cost with "
+                             "metrics on vs RTPU_NO_RPC_METRICS, plus "
+                             "the ring-stats read cost (no cluster)")
     parser.add_argument("--accel", action="store_true",
                         help="accelerator-plane overhead microbench: "
                              "snapshot cost + decode-loop on/off A/B "
@@ -1157,6 +1234,8 @@ if __name__ == "__main__":
         callsite_bench()
     elif args.sampler:
         sampler_bench()
+    elif args.rpc:
+        rpc_bench()
     elif args.accel:
         accel_bench()
     elif args.logplane:

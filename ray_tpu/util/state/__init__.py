@@ -7,10 +7,10 @@ from .api import (accel_summary, alerts, autoscaler_state, drain_node,
                   list_nodes, list_object_refs, list_objects,
                   list_placement_groups, list_tasks, list_traces,
                   list_workers, memory_summary, profile_cluster,
-                  profiling_status, serve_requests, serve_timeline,
-                  set_chaos, shard_summary, stack_cluster, stragglers,
-                  summarize_tasks, tail_logs, timeline, train_timeline,
-                  why_slow)
+                  profiling_status, rpc_summary, serve_requests,
+                  serve_timeline, set_chaos, shard_summary,
+                  stack_cluster, stragglers, summarize_tasks, tail_logs,
+                  timeline, train_timeline, why_slow)
 
 __all__ = [
     "accel_summary", "alerts", "autoscaler_state", "drain_node",
@@ -19,8 +19,8 @@ __all__ = [
     "list_actors", "list_events", "list_jobs", "list_logs", "list_nodes",
     "list_object_refs", "list_objects", "list_placement_groups",
     "list_tasks", "list_traces", "list_workers", "memory_summary",
-    "profile_cluster", "profiling_status", "serve_requests",
-    "serve_timeline", "set_chaos",
+    "profile_cluster", "profiling_status", "rpc_summary",
+    "serve_requests", "serve_timeline", "set_chaos",
     "shard_summary", "stack_cluster", "stragglers", "summarize_tasks",
     "tail_logs", "timeline", "train_timeline", "why_slow",
 ]

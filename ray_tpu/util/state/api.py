@@ -173,6 +173,129 @@ def shard_summary() -> List[Dict[str, Any]]:
     return rows
 
 
+def rpc_summary() -> Dict[str, Any]:
+    """Transport-observatory fold (`cli rpc` / `/api/rpc`): per-method
+    client-latency percentiles and error/retry rates from the flushed
+    cluster metric snapshots, plus one row per live process (raylets +
+    RUNNING drivers + the caller) with its native-ring stats and
+    slow-RPC ring — unreachable processes become error rows.
+
+    Percentiles come from the 1/64-sampled `rtpu_rpc_client_seconds`
+    histograms, so they describe the sampled population (slow calls are
+    always observed — the tail is exact, the body approximate)."""
+    from ..._internal.alerts import _hist_quantile
+    from ..._internal.core_worker import get_core_worker
+    from ..metrics import _iter_series, collect_cluster_metrics
+    cw = get_core_worker()
+    snapshots = collect_cluster_metrics(_gcs())
+
+    def _fold_by_tag(name: str, tag: str):
+        """Merge every process's series of `name` keyed by one tag."""
+        out: Dict[str, Any] = {}
+        for snap in snapshots:
+            if snap.get("name") != name:
+                continue
+            keys = snap.get("tag_keys") or []
+            for tagvals, value in _iter_series(snap):
+                label = dict(zip(keys, tagvals)).get(tag, "?")
+                if isinstance(value, dict):       # histogram state
+                    acc = out.setdefault(label, {
+                        "count": 0, "sum": 0.0,
+                        "buckets": [0] * len(value.get("buckets", ())),
+                        "boundaries": value.get("boundaries", [])})
+                    if len(acc["buckets"]) == len(value.get(
+                            "buckets", ())):
+                        for i, n in enumerate(value["buckets"]):
+                            acc["buckets"][i] += n
+                    acc["count"] += value.get("count", 0)
+                    acc["sum"] += value.get("sum", 0.0)
+                else:
+                    out[label] = out.get(label, 0.0) + value
+        return out
+
+    errors_by_method = _fold_by_tag(
+        "rtpu_rpc_transport_errors_total", "method")
+    methods = []
+    for method, acc in sorted(_fold_by_tag(
+            "rtpu_rpc_client_seconds", "method").items()):
+        methods.append({
+            "method": method,
+            "sampled": acc["count"],
+            "mean_s": acc["sum"] / acc["count"] if acc["count"] else None,
+            "p50_s": _hist_quantile(acc, 0.50),
+            "p95_s": _hist_quantile(acc, 0.95),
+            "p99_s": _hist_quantile(acc, 0.99),
+            "transport_errors": errors_by_method.get(method, 0.0),
+        })
+
+    # Per-ring depth table from the flushed gauges: one row per
+    # (pid, ring), depth last-write-wins per process flush.
+    rings: Dict[tuple, Dict[str, Any]] = {}
+    for name, field in (("rtpu_ring_queue_depth", "queue_depth"),
+                        ("rtpu_ring_depth_hwm", "depth_hwm"),
+                        ("rtpu_ring_frames_total", None),
+                        ("rtpu_ring_bytes_total", None)):
+        for snap in snapshots:
+            if snap.get("name") != name:
+                continue
+            keys = snap.get("tag_keys") or []
+            for tagvals, value in _iter_series(snap):
+                tags = dict(zip(keys, tagvals))
+                key = (tags.get("pid", "?"), tags.get("ring", "?"))
+                row = rings.setdefault(key, {"pid": key[0],
+                                             "ring": key[1]})
+                if field is not None:
+                    row[field] = value
+                else:
+                    col = name.rsplit("_", 1)[0].replace(
+                        "rtpu_ring_", "") + "_" + tags.get("dir", "?")
+                    row[col] = row.get(col, 0.0) + value
+
+    # Per-process rows: every live raylet + every RUNNING driver,
+    # fetched concurrently; the calling process reports in-process.
+    from ..._internal import rpc_metrics
+    processes: List[Dict[str, Any]] = []
+    own = rpc_metrics.local_stats()
+    own.update(mode=cw.mode, node_id=cw.node_id)
+    processes.append(own)
+
+    def _node_stats(node):
+        return cw.clients.get(tuple(node["address"])).call_sync(
+            "get_rpc_stats", timeout=2)
+
+    for node, stats, error in _fanout(_live_nodes(), _node_stats):
+        if error is not None:
+            processes.append({"node_id": node["node_id"],
+                              "mode": "raylet", "error": error})
+        else:
+            processes.append(stats)
+    own_addr = tuple(cw.rpc_address) if cw.rpc_address else None
+    drivers = [j for j in _gcs().call_sync("get_all_jobs")
+               if j.get("state") == "RUNNING" and j.get("driver_address")
+               and tuple(j["driver_address"]) != own_addr]
+
+    def _driver_stats(job):
+        return cw.clients.get(tuple(job["driver_address"])).call_sync(
+            "get_rpc_stats", timeout=2)
+
+    for job, stats, error in _fanout(drivers, _driver_stats):
+        if error is not None:
+            processes.append({"job_id": job.get("job_id"),
+                              "mode": "driver", "error": error})
+        else:
+            processes.append(stats)
+
+    return {
+        "methods": methods,
+        "rings": sorted(rings.values(),
+                        key=lambda r: (r["pid"], r["ring"])),
+        "retries_by_site": _fold_by_tag(
+            "rtpu_rpc_retries_total", "site"),
+        "chaos_hits": _fold_by_tag("rtpu_chaos_hits_total", "method"),
+        "processes": processes,
+    }
+
+
 def list_workers(limit: int = 1000) -> List[Dict[str, Any]]:
     """Per-node worker processes, from each raylet's node stats. Nodes
     are queried concurrently; an unreachable node contributes a

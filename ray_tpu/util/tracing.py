@@ -35,6 +35,13 @@ def get_trace_context() -> Optional[Tuple[str, str]]:
     return _current.get()
 
 
+def new_span_id() -> str:
+    """A fresh span id, for callers that must know the id BEFORE the
+    span is recorded (the RPC layer ships it in the frame meta so the
+    server side can chain children under the in-flight hop)."""
+    return _new_id()
+
+
 def set_trace_context(ctx: Optional[Tuple[str, str]]):
     _current.set(ctx)
 
@@ -89,16 +96,19 @@ def _record(name: str, trace_id: str, span_id: str,
 
 def record_child_span(name: str, parent_ctx: Tuple[str, str],
                       start: float, end: float,
-                      task_id: Optional[str] = None):
+                      task_id: Optional[str] = None,
+                      span_id: Optional[str] = None):
     """Record a completed span as a child of `parent_ctx` WITHOUT
     touching the active context (the task executor uses this for the
     execution span: user code must keep inheriting the caller's
     (trace_id, span_id) unchanged — the documented propagation
-    contract)."""
+    contract). Pass `span_id` when the id was pre-generated and
+    already shipped to a peer (the RPC frame meta), so remote children
+    attach to THIS span."""
     if parent_ctx is None:
         return
-    _record(name, parent_ctx[0], _new_id(), parent_ctx[1], start, end,
-            task_id=task_id)
+    _record(name, parent_ctx[0], span_id or _new_id(), parent_ctx[1],
+            start, end, task_id=task_id)
 
 
 def child_context_for_submit() -> Optional[Tuple[str, str]]:

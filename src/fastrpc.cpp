@@ -163,6 +163,20 @@ struct Ring {
   // one event per frame; frpc_recv_decoded drains it as ONE kind-6
   // event per wakeup. Guarded by mu; counts toward `bytes`.
   std::string fold;
+  // Transport-observatory stats (frpc_ring_stats): monotonic totals +
+  // live depth, written by the io thread (mostly under mu) and read
+  // LOCK-FREE from Python — relaxed atomics, no ordering needed for
+  // statistics.
+  std::atomic<uint64_t> frames_in{0};
+  std::atomic<uint64_t> frames_out{0};
+  std::atomic<uint64_t> bytes_in{0};
+  std::atomic<uint64_t> bytes_out{0};
+  std::atomic<uint64_t> decode_hits{0};
+  std::atomic<uint64_t> decode_fallbacks{0};
+  std::atomic<uint64_t> fold_batches{0};
+  std::atomic<uint64_t> notify_wakeups{0};
+  std::atomic<uint64_t> depth{0};       // events queued awaiting drain
+  std::atomic<uint64_t> depth_hwm{0};
 };
 
 struct Core {
@@ -206,6 +220,7 @@ void notify_python(Ring* r) {
   // caller holds r->mu
   if (!r->notified) {
     r->notified = true;
+    r->notify_wakeups.fetch_add(1, std::memory_order_relaxed);
     uint64_t one = 1;
     ssize_t w = write(r->notifyfd, &one, sizeof(one));
     (void)w;
@@ -215,9 +230,15 @@ void notify_python(Ring* r) {
 void push_event(Core* c, int ring, int64_t conn, uint8_t kind,
                 std::string data) {
   Ring* r = c->rings[ring];
+  size_t sz = data.size();
   std::lock_guard<std::mutex> lk(r->mu);
-  r->bytes += data.size();
+  r->bytes += sz;
   r->q.push_back(InEvent{conn, kind, std::move(data)});
+  r->frames_in.fetch_add(1, std::memory_order_relaxed);
+  r->bytes_in.fetch_add(sz, std::memory_order_relaxed);
+  uint64_t d = r->depth.fetch_add(1, std::memory_order_relaxed) + 1;
+  if (d > r->depth_hwm.load(std::memory_order_relaxed))
+    r->depth_hwm.store(d, std::memory_order_relaxed);
   notify_python(r);
 }
 
@@ -563,18 +584,25 @@ void deliver_frame(Core* c, Conn* conn, const char* p, size_t len) {
     uint8_t kind = 0;
     int cls = classify_frame(reinterpret_cast<const uint8_t*>(p), len,
                              &kind, &out);
+    Ring* r = c->rings[conn->ring];
     if (cls == 1) {
+      r->decode_hits.fetch_add(1, std::memory_order_relaxed);
       push_event(c, conn->ring, conn->id, kind, std::move(out));
       return;
     }
     if (cls == 2) {
-      Ring* r = c->rings[conn->ring];
+      r->decode_hits.fetch_add(1, std::memory_order_relaxed);
       std::lock_guard<std::mutex> lk(r->mu);
       r->fold.append(out);
       r->bytes += out.size();
+      r->frames_in.fetch_add(1, std::memory_order_relaxed);
+      r->bytes_in.fetch_add(out.size(), std::memory_order_relaxed);
       notify_python(r);
       return;
     }
+    // Passthrough while decode is armed: either a non-decodable method
+    // (expected) or a decoder bounds-check bail (the safety net).
+    r->decode_fallbacks.fetch_add(1, std::memory_order_relaxed);
   }
   push_event(c, conn->ring, conn->id, 0, std::string(p, len));
 }
@@ -942,6 +970,35 @@ int frpc_ring_fd(int ring) {
   return c->rings[ring]->notifyfd;
 }
 
+// Lock-free stats snapshot for one ring (Python exports these as the
+// rtpu_ring_* series). Fills out[0..n) in the FIXED order mirrored by
+// rpc_metrics.RING_STAT_FIELDS: frames_in, frames_out, bytes_in,
+// bytes_out, decode_hits, decode_fallbacks, fold_batches,
+// notify_wakeups, queue_depth, depth_hwm. Returns the number of fields
+// written (<= cap), or -1 for a bad ring. Values are relaxed-atomic
+// reads — individually exact, not a consistent cross-field cut, which
+// is fine for monotonic telemetry.
+int frpc_ring_stats(int ring, uint64_t* out, int cap) {
+  Core* c = g_core;
+  if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
+    return -1;
+  Ring* r = c->rings[ring];
+  const uint64_t vals[10] = {
+      r->frames_in.load(std::memory_order_relaxed),
+      r->frames_out.load(std::memory_order_relaxed),
+      r->bytes_in.load(std::memory_order_relaxed),
+      r->bytes_out.load(std::memory_order_relaxed),
+      r->decode_hits.load(std::memory_order_relaxed),
+      r->decode_fallbacks.load(std::memory_order_relaxed),
+      r->fold_batches.load(std::memory_order_relaxed),
+      r->notify_wakeups.load(std::memory_order_relaxed),
+      r->depth.load(std::memory_order_relaxed),
+      r->depth_hwm.load(std::memory_order_relaxed)};
+  int n = cap < 10 ? cap : 10;
+  for (int i = 0; i < n; i++) out[i] = vals[i];
+  return n;
+}
+
 int64_t frpc_listen2(const char* ip, int* port_inout, int ring) {
   Core* c = g_core;
   if (!c || ring < 0 || ring >= c->n_rings.load(std::memory_order_acquire))
@@ -1050,6 +1107,15 @@ int frpc_send(int64_t conn_id, const void* buf, uint64_t len) {
     conn->out.emplace_back(static_cast<const char*>(buf), len);
     conn->out_bytes.fetch_add(len);
   }
+  {
+    // Outbound stats on the conn's home ring (valid while pinned).
+    int ring = conn->ring;
+    if (ring >= 0 && ring < c->n_rings.load(std::memory_order_acquire)) {
+      Ring* r = c->rings[ring];
+      r->frames_out.fetch_add(1, std::memory_order_relaxed);
+      r->bytes_out.fetch_add(len, std::memory_order_relaxed);
+    }
+  }
   bool wake = false;
   // The conn may have been unmapped since the pin; the flush pass
   // looks dirty ids up in the map and skips vanished ones.
@@ -1114,6 +1180,7 @@ int64_t recv_impl(int ring, bool with_fold, int64_t* conn_ids,
     used += e.data.size();
     r->bytes -= e.data.size();
     r->q.pop_front();
+    r->depth.fetch_sub(1, std::memory_order_relaxed);
     n++;
   }
   // The fold is delivered AFTER the queued frames, and only on a call
@@ -1131,6 +1198,7 @@ int64_t recv_impl(int ring, bool with_fold, int64_t* conn_ids,
     used += r->fold.size();
     r->bytes -= r->fold.size();
     r->fold.clear();
+    r->fold_batches.fetch_add(1, std::memory_order_relaxed);
     n++;
   }
   if (r->q.empty() && r->fold.empty()) {

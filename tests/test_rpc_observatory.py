@@ -1,0 +1,506 @@
+"""RPC/transport observatory tests: frame-meta wire format (new and
+legacy forms), per-method sampling + the slow-RPC watchdog, the
+RTPU_NO_RPC_METRICS kill switch (subprocess), chaos-hit accounting and
+the rpc_client_p99 / ring_backpressure alert rules, native-ring stats,
+the backoff retry-site counter, state.rpc_summary() + cli rpc +
+/api/rpc fold surfaces, and control-plane spans in the trace tree
+(reference: src/ray/rpc metrics + tests/test_metrics_agent)."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._internal import rpc, rpc_metrics
+from ray_tpu._internal.config import CONFIG
+
+
+@pytest.fixture
+def fresh_observatory():
+    """Clean rpc-metrics state on both sides of a test: rebuilding the
+    namespace re-registers every series, so each test starts at zero."""
+    saved_slow = CONFIG.rpc_slow_call_s
+    saved_switch = CONFIG.no_rpc_metrics
+    rpc_metrics._reset_for_tests()
+    yield
+    CONFIG.rpc_slow_call_s = saved_slow
+    CONFIG.no_rpc_metrics = saved_switch
+    rpc_metrics._reset_for_tests()
+
+
+@pytest.fixture
+def obs_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=200 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+async def _socket_pair(name="obs", handlers=None):
+    """RpcServer + RpcClient forced over the real socket path (the
+    in-process fast path skips the wire the observatory instruments)."""
+    server = rpc.RpcServer(name)
+    for mname, fn in (handlers or {}).items():
+        server.register(mname, fn)
+    await server.start("127.0.0.1", 0)
+    with rpc._local_servers_lock:
+        rpc._local_servers.pop(server.address, None)
+    client = rpc.RpcClient(server.address)
+    return server, client
+
+
+def _series(metric_name):
+    from ray_tpu.util.metrics import snapshot_all
+    for snap in snapshot_all():
+        if snap.get("name") == metric_name:
+            return snap.get("series") or []
+    return []
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+def test_frame_meta_roundtrip_and_legacy_interop():
+    """FLAG_META frames carry trace meta; meta-less frames are
+    byte-identical to the pre-observatory wire format, and one parser
+    accepts both (mixed old/new processes interoperate)."""
+    frame = rpc.pack_frame(7, 0, b"lease_worker", b"payload",
+                           b"trace123:span456")
+    msg_id, flags, method, payload, meta = rpc.unpack_body(
+        memoryview(frame)[4:])
+    assert (msg_id, method, payload) == (7, "lease_worker", b"payload")
+    assert meta == b"trace123:span456"
+    assert not flags & rpc.FLAG_META  # consumed + stripped by the parser
+
+    legacy = rpc.pack_frame(7, 0, b"lease_worker", b"payload")
+    assert legacy == rpc.pack_frame(7, 0, b"lease_worker", b"payload",
+                                    meta=b"")
+    assert not legacy[12] & rpc.FLAG_META  # flags byte: legacy form
+    msg_id, flags, method, payload, meta = rpc.unpack_body(
+        memoryview(legacy)[4:])
+    assert (msg_id, method, payload, meta) == (
+        7, "lease_worker", b"payload", b"")
+
+    assert rpc_metrics.parse_meta(b"trace123:span456") == (
+        "trace123", "span456")
+    assert rpc_metrics.parse_meta(b"garbage") is None
+    assert rpc_metrics.parse_meta(b"") is None
+
+
+# ---------------------------------------------------------------------------
+# sampling + watchdog + deferred hot-path accounting
+# ---------------------------------------------------------------------------
+
+def test_sampling_watchdog_and_transport_fold(fresh_observatory):
+    CONFIG.rpc_slow_call_s = 0.05
+
+    async def main():
+        async def echo(x=0):
+            return x
+
+        async def slow():
+            await asyncio.sleep(0.1)
+            return "done"
+
+        server, client = await _socket_pair(
+            handlers={"echo": echo, "slow": slow})
+        for i in range(129):
+            await client.call("echo", x=i)
+        await client.call("slow")
+        peer = f"{server.address[0]}:{server.address[1]}"
+        await client.close()
+        await server.stop()
+        return peer
+
+    peer = asyncio.run(main())
+    rpc_metrics.export_transport()
+
+    hist = {tuple(t): v for t, v in _series("rtpu_rpc_client_seconds")}
+    # 130 calls at 1/64 sampling -> 2 ticks; the slow call is always
+    # recorded regardless of where its tick lands.
+    sampled = sum(v["count"] for v in hist.values())
+    assert sampled >= 2
+    assert ("slow",) in hist and hist[("slow",)]["count"] >= 1
+    assert hist[("slow",)]["sum"] >= 0.05
+
+    wd = rpc_metrics.watchdog()
+    rows = wd.snapshot()
+    assert wd.total == 1 and len(rows) == 1
+    row = rows[0]
+    assert row["method"] == "slow"
+    assert row["peer"] == peer
+    assert row["duration_s"] >= 0.05
+    # creation-site attribution walks past the transport frames to the
+    # code that issued the call — this file.
+    assert row["site"].startswith(os.path.basename(__file__))
+
+    assert sum(v for _t, v in _series("rtpu_rpc_slow_calls_total")) == 1
+    bytes_series = {tuple(t): v
+                    for t, v in _series("rtpu_rpc_bytes_total")}
+    assert bytes_series[("echo", "out")] > 0
+    assert bytes_series[("echo", "in")] > 0
+    inflight = {tuple(t): v for t, v in _series("rtpu_rpc_inflight")}
+    assert set(inflight.values()) == {0.0}  # all returned to idle
+
+    stats = rpc_metrics.local_stats()
+    assert stats["enabled"] and stats["slow_total"] == 1
+    assert stats["inflight"] == {"client": 0, "server": 0}
+
+
+# ---------------------------------------------------------------------------
+# kill switch
+# ---------------------------------------------------------------------------
+
+_KILL_SWITCH_SCRIPT = """
+import asyncio, json
+from ray_tpu._internal import rpc, rpc_metrics
+from ray_tpu.util.metrics import snapshot_all
+from ray_tpu.util.tracing import trace_span
+
+assert not rpc_metrics.enabled()
+assert rpc_metrics.metrics() is None
+assert rpc_metrics.watchdog() is None
+
+async def main():
+    server = rpc.RpcServer("ks")
+    async def echo(x=0):
+        return x
+    server.register("echo", echo)
+    await server.start("127.0.0.1", 0)
+    with rpc._local_servers_lock:
+        rpc._local_servers.pop(server.address, None)
+    client = rpc.RpcClient(server.address)
+    with trace_span("outer"):  # active context must NOT produce meta
+        for i in range(70):
+            assert await client.call("echo", x=i) == i
+    await client.close()
+    await server.stop()
+
+asyncio.run(main())
+rpc_metrics.export_transport()  # must be a no-op
+names = [s["name"] for s in snapshot_all()
+         if s["name"].startswith(("rtpu_rpc", "rtpu_ring",
+                                  "rtpu_chaos"))]
+print(json.dumps({"observatory_series": names}))
+"""
+
+
+def test_kill_switch_subprocess_zero_series():
+    """RTPU_NO_RPC_METRICS=1: real calls over the socket path construct
+    ZERO observatory series and no watchdog, even inside an active
+    trace span."""
+    env = dict(os.environ, RTPU_NO_RPC_METRICS="1")
+    out = subprocess.run(
+        [sys.executable, "-c", _KILL_SWITCH_SCRIPT], env=env,
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["observatory_series"] == []
+
+
+def test_kill_switch_sends_legacy_frames(fresh_observatory):
+    """Disabled side never ships FLAG_META (its frames are
+    byte-compatible with pre-observatory peers); an enabled server
+    still serves it — mixed-version interop."""
+    CONFIG.no_rpc_metrics = True
+    rpc_metrics._reset_for_tests()
+    try:
+        sent = []
+
+        async def main():
+            from ray_tpu.util.tracing import trace_span
+
+            async def echo(x=0):
+                return x
+            server, client = await _socket_pair(handlers={"echo": echo})
+            orig = client._send_frame
+
+            async def spy(frame):
+                sent.append(bytes(frame))
+                return await orig(frame)
+            client._send_frame = spy
+            with trace_span("outer"):
+                assert await client.call("echo", x=1) == 1
+            await client.close()
+            await server.stop()
+
+        asyncio.run(main())
+        assert sent and all(
+            not frame[12] & rpc.FLAG_META for frame in sent)
+    finally:
+        CONFIG.no_rpc_metrics = False
+        rpc_metrics._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# chaos e2e: seeded delay -> watchdog attribution -> alert
+# ---------------------------------------------------------------------------
+
+def test_chaos_delay_watchdog_and_p99_alert(fresh_observatory):
+    from ray_tpu._internal.alerts import AlertEngine, default_rules
+    from ray_tpu._internal.chaos import REGISTRY
+    from ray_tpu.util.metrics import snapshot_all
+
+    CONFIG.rpc_slow_call_s = 0.05
+    hits_before = REGISTRY.hit_counts().get("push_task:delay", 0)
+    REGISTRY.arm(spec="push_task:delay:1.0:0.1", seed=7)
+    try:
+        async def main():
+            async def push_task(i=0):
+                return i
+            server, client = await _socket_pair(
+                handlers={"push_task": push_task})
+            for i in range(3):
+                await client.call("push_task", i=i)
+            peer = f"{server.address[0]}:{server.address[1]}"
+            await client.close()
+            await server.stop()
+            return peer
+
+        peer = asyncio.run(main())
+    finally:
+        REGISTRY.arm(spec="", seed=0, schedule="")
+
+    # deterministic injection: prob=1.0 delay fired on every call, and
+    # the metric total agrees with the registry's own hit counter
+    # (what `cli chaos show` prints).
+    hits = REGISTRY.hit_counts().get("push_task:delay", 0) - hits_before
+    assert hits == 3
+    chaos_series = {tuple(t): v for t, v in _series("rtpu_chaos_hits_total")}
+    assert chaos_series[("push_task", "delay")] == 3
+
+    # every delayed call breached rpc_slow_call_s -> watchdog rows with
+    # method + peer attribution.
+    rows = rpc_metrics.watchdog().snapshot()
+    assert len(rows) == 3
+    assert all(r["method"] == "push_task" and r["peer"] == peer
+               for r in rows)
+
+    # the injected tail trips rpc_client_p99 via a deterministic
+    # evaluate_once over this process's snapshots.
+    saved = CONFIG.rpc_client_p99_slo_s
+    CONFIG.rpc_client_p99_slo_s = 0.05
+    try:
+        fired = []
+        engine = AlertEngine(rules=default_rules(),
+                             emit=lambda a: fired.append(a))
+        engine.evaluate_once(snapshots=snapshot_all(), now=100.0)
+        assert any(a["rule"] == "rpc_client_p99" for a in fired), fired
+    finally:
+        CONFIG.rpc_client_p99_slo_s = saved
+
+
+def test_ring_backpressure_alert_fires():
+    from ray_tpu._internal.alerts import AlertEngine, default_rules
+
+    snapshots = [{"name": "rtpu_ring_queue_depth", "kind": "gauge",
+                  "description": "", "tag_keys": ["pid", "ring"],
+                  "series": [[["1234", "0"],
+                              float(CONFIG.ring_backpressure_depth) + 1]]}]
+    fired = []
+    engine = AlertEngine(rules=default_rules(),
+                         emit=lambda a: fired.append(a))
+    engine.evaluate_once(snapshots=snapshots, now=100.0)
+    assert any(a["rule"] == "ring_backpressure" for a in fired), fired
+
+
+# ---------------------------------------------------------------------------
+# native-ring stats
+# ---------------------------------------------------------------------------
+
+def test_ring_stats_move_and_export(fresh_observatory):
+    from ray_tpu._native.fastrpc import RING_STAT_FIELDS, NativeIO
+
+    assert RING_STAT_FIELDS == rpc_metrics.RING_STAT_FIELDS
+
+    async def main():
+        async def echo(x=0):
+            return x
+        server, client = await _socket_pair(handlers={"echo": echo})
+        io = NativeIO.get()
+        before = io.ring_stats() if io is not None else None
+        for i in range(50):
+            await client.call("echo", x=i)
+        after = io.ring_stats() if io is not None else None
+        await client.close()
+        await server.stop()
+        return before, after
+
+    before, after = asyncio.run(main())
+    if after is None:
+        pytest.skip("native fastrpc not available")
+    assert set(after) == set(RING_STAT_FIELDS)
+    assert after["frames_in"] > before["frames_in"]
+    assert after["bytes_in"] > before["bytes_in"]
+    assert after["notify_wakeups"] > 0
+
+    rows = rpc_metrics.collect_ring_stats()
+    assert rows and all("ring" in r for r in rows)
+
+    rpc_metrics.export_ring_stats()
+    frames = {tuple(t): v for t, v in _series("rtpu_ring_frames_total")}
+    assert any(k[-1] == "in" and v > 0 for k, v in frames.items())
+    depth = _series("rtpu_ring_queue_depth")
+    assert depth and all(v >= 0 for _t, v in depth)
+
+
+# ---------------------------------------------------------------------------
+# retry-site counter + async-task-error exposition
+# ---------------------------------------------------------------------------
+
+def test_backoff_reports_retry_site(fresh_observatory):
+    from ray_tpu._internal.backoff import Backoff
+
+    bo = Backoff(base_s=0.0001, max_s=0.001, site="obs_test")
+    for _ in range(3):
+        bo.next_delay()
+    series = {tuple(t): v for t, v in _series("rtpu_rpc_retries_total")}
+    assert series[("obs_test",)] == 3
+    assert rpc_metrics.local_stats()["retries"] == 3
+
+    # unlabelled loops stay uncounted (no empty-site series).
+    Backoff(base_s=0.0001).next_delay()
+    series = {tuple(t): v for t, v in _series("rtpu_rpc_retries_total")}
+    assert ("",) not in series
+
+
+def test_async_task_errors_exposed_in_prometheus_text():
+    """The aio.spawn failure counter reaches the Prometheus exposition
+    (README catalog row `rtpu_async_task_errors_total`)."""
+    from ray_tpu._internal import aio
+    from ray_tpu.util.metrics import prometheus_text, snapshot_all
+
+    async def main():
+        async def boom():
+            raise RuntimeError("observatory test failure")
+        aio.spawn(boom(), what="obs_test_boom")
+        await asyncio.sleep(0.05)
+
+    asyncio.run(main())
+    text = prometheus_text(snapshot_all())
+    assert "rtpu_async_task_errors_total" in text
+    assert 'what="obs_test_boom"' in text
+
+
+# ---------------------------------------------------------------------------
+# fold surfaces: state.rpc_summary / cli rpc / dashboard /api/rpc
+# ---------------------------------------------------------------------------
+
+def test_rpc_summary_cli_and_dashboard(obs_cluster, capsys):
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util import state as st
+    from ray_tpu.util.metrics import flush_now
+
+    async def main():
+        async def echo(x=0):
+            return x
+        server, client = await _socket_pair(handlers={"echo": echo})
+        for i in range(70):  # > sampling period: guarantees a histogram row
+            await client.call("echo", x=i)
+        await client.close()
+        await server.stop()
+
+    asyncio.run(main())
+    assert flush_now()  # fold + publish this process's snapshots
+
+    summary = st.rpc_summary()
+    assert set(summary) >= {"methods", "rings", "retries_by_site",
+                            "chaos_hits", "processes"}
+    methods = {m["method"]: m for m in summary["methods"]}
+    assert "echo" in methods
+    echo_row = methods["echo"]
+    assert echo_row["sampled"] >= 1
+    assert echo_row["p50_s"] is not None
+    assert {"p95_s", "p99_s", "mean_s", "transport_errors"} <= set(echo_row)
+    own = [p for p in summary["processes"]
+           if p.get("pid") == os.getpid()]
+    assert own and own[0]["enabled"]
+
+    from ray_tpu import cli
+
+    class A:
+        address = None
+        method = None
+        node = None
+        slow = False
+        json = False
+    cli.cmd_rpc(A())
+    out = capsys.readouterr().out
+    assert "methods:" in out and "echo" in out
+
+    class S:
+        address = None
+    cli.cmd_status(S())
+    assert "nodes: 1" in capsys.readouterr().out
+
+    address = start_dashboard()
+    with urllib.request.urlopen(f"{address}/api/rpc", timeout=15) as resp:
+        assert resp.status == 200
+        body = json.loads(resp.read())
+    assert "methods" in body and "processes" in body
+
+
+# ---------------------------------------------------------------------------
+# control-plane spans in the trace tree
+# ---------------------------------------------------------------------------
+
+def test_control_plane_spans_in_trace_tree(obs_cluster):
+    """A traced client call records an `rpc:<method>` span; the server
+    adopts the meta shipped in the frame, so an RPC issued inside the
+    handler nests as a child of the first hop — the lease->grant->push
+    chaining contract, assembled by state.get_trace()."""
+    from ray_tpu.util import state as st
+    from ray_tpu.util.tracing import trace_span
+
+    async def main():
+        async def echo(x=0):
+            return x
+        backend_server, backend_client = await _socket_pair(
+            name="backend", handlers={"echo": echo})
+
+        async def relay(x=0):
+            return await backend_client.call("echo", x=x)
+        front_server, front_client = await _socket_pair(
+            name="front", handlers={"relay": relay})
+
+        with trace_span("obs-outer") as (trace_id, _sid):
+            assert await front_client.call("relay", x=5) == 5
+        await front_client.close()
+        await front_server.stop()
+        await backend_client.close()
+        await backend_server.stop()
+        return trace_id
+
+    trace_id = asyncio.run(main())
+
+    deadline = time.time() + 30
+    tree = None
+    while time.time() < deadline:
+        tree = st.get_trace(trace_id)
+        if tree["num_spans"] >= 3:
+            break
+        time.sleep(0.5)
+    assert tree is not None and tree["num_spans"] >= 3, tree
+
+    def find(node, name):
+        if node["name"] == name:
+            return node
+        for child in node["children"]:
+            hit = find(child, name)
+            if hit is not None:
+                return hit
+        return None
+
+    outer = next((find(r, "obs-outer") for r in tree["roots"]
+                  if find(r, "obs-outer")), None)
+    assert outer is not None, tree
+    relay_span = find(outer, "rpc:relay")
+    assert relay_span is not None, tree
+    # the backend hop nests UNDER the first hop via the shipped meta.
+    assert find(relay_span, "rpc:echo") is not None, tree
